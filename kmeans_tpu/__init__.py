@@ -1,0 +1,41 @@
+"""kmeans_tpu — a TPU-native k-means framework.
+
+Built from scratch in JAX/XLA with the capabilities of the reference
+collaborative k-means teaching app (schusto/k-means-demo; see SURVEY.md):
+the numeric engine runs the Lloyd loop the reference performs manually, the
+session layer round-trips the reference's document schema, and the serve
+layer feeds a browser visualizer.
+
+Layout:
+  ops/       fused assign+reduce kernels, centroid update
+  models/    Lloyd + minibatch estimators, k-means++/random init
+  parallel/  mesh construction, shard_map engine (DP over points, TP over k)
+  session/   document model, metrics, export/import JSON (reference schema)
+  serve/     HTTP/SSE shim + browser front-end
+  data/      synthetic datasets for the BASELINE configs
+  utils/     room codes, ids, small helpers
+"""
+
+__version__ = "0.1.0"
+
+from kmeans_tpu.config import KMeansConfig, MeshConfig, RunConfig, ServeConfig
+from kmeans_tpu.models import (
+    KMeans,
+    KMeansState,
+    MiniBatchKMeans,
+    fit_lloyd,
+    fit_minibatch,
+)
+
+__all__ = [
+    "KMeansConfig",
+    "MeshConfig",
+    "RunConfig",
+    "ServeConfig",
+    "KMeans",
+    "KMeansState",
+    "MiniBatchKMeans",
+    "fit_lloyd",
+    "fit_minibatch",
+    "__version__",
+]

@@ -1,0 +1,176 @@
+"""Typed configuration for the whole framework.
+
+The reference scatters its configuration across URL params, localStorage,
+Yjs meta, constant tables and deploy-time headers (see SURVEY.md §5.6;
+/root/reference/app.mjs:8,15-18,22-23,39-46,127,285-288,304,366-367 and
+/root/reference/_headers:1-21).  Here every knob lives in one typed place.
+
+Policy constants preserved from the reference (behavioral contract):
+
+* ``COLORS`` — the 6-color centroid palette (app.mjs:8).
+* ``MAX_CENTROIDS`` — the hard cap of 3 centroid zones (app.mjs:127).
+* ``ROOM_ALPHABET`` / room-code length (app.mjs:19) — 32-char alphabet with
+  no I/O/0/1.
+* drag/drop position clamp bounds (app.mjs:366-367).
+* card geometry used for zone min-height (app.mjs:302-306).
+* ``MAX_AVATARS`` — presence chip cap (app.mjs:62).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Reference policy constants (session / UI behavioral contract)
+# ---------------------------------------------------------------------------
+
+#: Centroid color palette, first-unused-wins (app.mjs:8,125).
+COLORS: Tuple[str, ...] = (
+    "#6EE7B7", "#93C5FD", "#FBCFE8", "#FDE68A", "#C7D2FE", "#FCA5A5",
+)
+
+#: Hard cap on centroid zones in the collaborative session (app.mjs:127).
+MAX_CENTROIDS: int = 3
+
+#: Room-code alphabet: A-Z + 2-9 minus lookalikes I/O/0/1 (app.mjs:19).
+ROOM_ALPHABET: str = "ABCDEFGHJKLMNPQRSTUVWXYZ23456789"
+ROOM_CODE_LEN: int = 4
+
+#: Presence strip shows at most this many avatar chips (app.mjs:62).
+MAX_AVATARS: int = 6
+
+#: Normalized drop-position clamp bounds: x ∈ [0.02, 0.92], y ∈ [0.10, 0.92]
+#: (app.mjs:366-367).
+POS_CLAMP_X: Tuple[float, float] = (0.02, 0.92)
+POS_CLAMP_Y: Tuple[float, float] = (0.10, 0.92)
+
+#: Card geometry for zone min-height: max(260, 64 + n*(110+10)) px
+#: (app.mjs:302-306).
+CARD_H_PX: int = 110
+CARD_GAP_PX: int = 10
+ZONE_BASE_PX: int = 64
+ZONE_MIN_PX: int = 260
+
+#: localStorage key the reference persists the display name under
+#: (app.mjs:22); the serve layer uses it as a cookie/query name.
+NAME_KEY: str = "icekmeans:name"
+
+#: Session modes (index.html:125-127). ``mode`` is synced but never branched
+#: on in the reference (SURVEY.md §8.7); we preserve it as a document field.
+MODES: Tuple[str, ...] = ("learn", "playtest", "custom")
+
+
+def zone_min_height_px(n_cards: int) -> int:
+    """Zone min-height rule from app.mjs:302-306."""
+    return max(ZONE_MIN_PX, ZONE_BASE_PX + n_cards * (CARD_H_PX + CARD_GAP_PX))
+
+
+def clamp_pos(x: float, y: float) -> Tuple[float, float]:
+    """Clamp a normalized board position exactly as the drop handler does
+    (app.mjs:362-367)."""
+    cx = min(max(x, POS_CLAMP_X[0]), POS_CLAMP_X[1])
+    cy = min(max(y, POS_CLAMP_Y[0]), POS_CLAMP_Y[1])
+    return (cx, cy)
+
+
+# ---------------------------------------------------------------------------
+# Numeric-engine configuration
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class KMeansConfig:
+    """Configuration of the numeric Lloyd / minibatch engine.
+
+    This is the typed replacement for the reference's scattered knobs, extended
+    with everything the TPU engine needs (SURVEY.md §5.6 "New build" note).
+    """
+
+    k: int = 3
+    init: str = "k-means++"          # "k-means++" | "random" | "given"
+    max_iter: int = 100
+    #: Convergence: stop when the summed squared centroid shift <= tol.
+    tol: float = 1e-4
+    seed: int = 0
+    #: Rows per scan tile in the fused assign+reduce pass.
+    chunk_size: int = 4096
+    #: Matmul input dtype ("bfloat16" | "float32" | None = x.dtype).
+    #: Accumulation is always float32.
+    compute_dtype: Optional[str] = None
+    #: Centroid-update reduction: "matmul" (one-hot^T @ X on the MXU) or
+    #: "segment" (jax.ops.segment_sum scatter-add).
+    update: str = "matmul"
+    #: Empty-cluster policy: "keep" (retain old centroid) or "farthest"
+    #: (reseed to the currently-worst-fit points).
+    empty: str = "keep"
+
+    # Minibatch engine.
+    batch_size: int = 8192
+    steps: int = 200
+
+    def validate(self) -> "KMeansConfig":
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {self.k}")
+        if self.init not in ("k-means++", "random", "given"):
+            raise ValueError(f"unknown init {self.init!r}")
+        if self.update not in ("matmul", "segment"):
+            raise ValueError(f"unknown update {self.update!r}")
+        if self.empty not in ("keep", "farthest"):
+            raise ValueError(f"unknown empty-cluster policy {self.empty!r}")
+        if self.chunk_size < 1:
+            raise ValueError("chunk_size must be positive")
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for the sharded engine (SURVEY.md §2.6).
+
+    ``data`` shards points (DP, the north-star axis); ``model`` optionally
+    shards centroids over k (TP) when k·d is too large per chip.
+    """
+
+    data: int = 1
+    model: int = 1
+    data_axis: str = "data"
+    model_axis: str = "model"
+    platform: Optional[str] = None   # None = default backend
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.data, self.model)
+
+    @property
+    def axis_names(self) -> Tuple[str, str]:
+        return (self.data_axis, self.model_axis)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """HTTP/SSE serving shim (SURVEY.md §7 stage 4)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8787
+    #: Cap on cards materialized into a browser-facing document.
+    max_render_cards: int = 2000
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """One fully-specified run: data shape + engine + mesh."""
+
+    n: int = 500
+    d: int = 2
+    kmeans: KMeansConfig = dataclasses.field(default_factory=KMeansConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    minibatch: bool = False
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def run_config_from_dict(d: dict) -> RunConfig:
+    d = dict(d)
+    km = KMeansConfig(**d.pop("kmeans", {}))
+    mesh = MeshConfig(**d.pop("mesh", {}))
+    return RunConfig(kmeans=km.validate(), mesh=mesh, **d)

@@ -1,0 +1,5 @@
+"""Datasets: synthetic generators matching the BASELINE evaluation configs."""
+
+from kmeans_tpu.data.synthetic import BENCH_CONFIGS, bench_config, make_blobs
+
+__all__ = ["BENCH_CONFIGS", "bench_config", "make_blobs"]

@@ -1,0 +1,55 @@
+"""Synthetic datasets for tests and benchmarks.
+
+``make_blobs`` covers BASELINE.md config 1 (2D Gaussian blobs, k=3, N=500 —
+the reference's in-browser operating scale) and, with larger shapes, stands in
+for the feature-matrix configs (no dataset egress in this environment, so the
+MNIST/GloVe/CIFAR/ImageNet rows are exercised at their exact shapes with
+synthetic data of matching statistics; see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["make_blobs", "BENCH_CONFIGS", "bench_config"]
+
+
+def make_blobs(
+    key: jax.Array,
+    n: int,
+    d: int,
+    k: int,
+    *,
+    cluster_std: float = 1.0,
+    center_box: float = 10.0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Gaussian blobs: returns (x [n,d], labels [n], centers [k,d])."""
+    kc, kl, kn = jax.random.split(key, 3)
+    centers = jax.random.uniform(
+        kc, (k, d), minval=-center_box, maxval=center_box, dtype=jnp.float32
+    )
+    labels = jax.random.randint(kl, (n,), 0, k)
+    noise = jax.random.normal(kn, (n, d), dtype=jnp.float32) * cluster_std
+    x = centers[labels] + noise
+    return x.astype(dtype), labels.astype(jnp.int32), centers
+
+
+#: The five evaluation configs from BASELINE.json (shapes only; data is
+#: synthetic with matching dimensions — zero-egress environment).
+BENCH_CONFIGS = {
+    "blobs2d": dict(n=500, d=2, k=3, minibatch=False),
+    "mnist": dict(n=60_000, d=784, k=10, minibatch=False),
+    "glove": dict(n=400_000, d=300, k=1000, minibatch=False),
+    "cifar10": dict(n=50_000, d=3072, k=100, minibatch=True),
+    "imagenet": dict(n=1_280_000, d=2048, k=1000, minibatch=True),
+}
+
+
+def bench_config(name: str) -> dict:
+    if name not in BENCH_CONFIGS:
+        raise KeyError(f"unknown bench config {name!r}; have {sorted(BENCH_CONFIGS)}")
+    return dict(BENCH_CONFIGS[name])

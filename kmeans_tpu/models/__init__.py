@@ -1,0 +1,16 @@
+"""Model families: full-batch Lloyd, minibatch, and initialization."""
+
+from kmeans_tpu.models.init import init_centroids, kmeans_plus_plus, random_init
+from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
+from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
+
+__all__ = [
+    "init_centroids",
+    "kmeans_plus_plus",
+    "random_init",
+    "KMeans",
+    "KMeansState",
+    "fit_lloyd",
+    "MiniBatchKMeans",
+    "fit_minibatch",
+]

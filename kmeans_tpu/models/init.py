@@ -1,0 +1,113 @@
+"""Centroid initialization: random subset and k-means++.
+
+The reference initializes clusters by a human clicking "+ Add centroid"
+(/root/reference/app.mjs:126-129) — up to three, named and colored.  The
+numeric engine needs real seeding:
+
+* ``random_init`` — k distinct points chosen uniformly.
+* ``kmeans_plus_plus`` — D² sampling (Arthur & Vassilvitskii 2007), written
+  sharding-friendly: each round draws the next center with the Gumbel-max
+  trick (``argmax(log(w·D²) + Gumbel)``), which is an exact categorical
+  sample and reduces to a global argmax — under ``jit`` on a sharded array
+  XLA lowers it to a per-shard argmax + cross-device reduce, so the same code
+  serves single-chip and mesh runs (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.ops.distance import sq_norms
+
+__all__ = ["random_init", "kmeans_plus_plus", "init_centroids"]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def random_init(
+    key: jax.Array,
+    x: jax.Array,
+    k: int,
+    *,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """k distinct rows of x, uniformly (weights bias the draw if given)."""
+    n = x.shape[0]
+    if weights is None:
+        idx = jax.random.choice(key, n, shape=(k,), replace=False)
+    else:
+        p = weights / jnp.sum(weights)
+        idx = jax.random.choice(key, n, shape=(k,), replace=False, p=p)
+    return x[idx].astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "compute_dtype"))
+def kmeans_plus_plus(
+    key: jax.Array,
+    x: jax.Array,
+    k: int,
+    *,
+    weights: Optional[jax.Array] = None,
+    compute_dtype=None,
+) -> jax.Array:
+    """k-means++ seeding by exact D²-categorical sampling via Gumbel-max.
+
+    Cost: k rounds × O(n·d) distance updates — comparable to one Lloyd
+    iteration's matmul when k ≈ d, and fully jittable (``fori_loop``).
+    """
+    n, d = x.shape
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    x_sq = sq_norms(x)
+
+    key0, key_g = jax.random.split(key)
+    first = jax.random.randint(key0, (), 0, n)
+    c0 = x[first].astype(f32)
+
+    centroids = jnp.zeros((k, d), f32).at[0].set(c0)
+
+    def d2_to(c):
+        prod = jnp.matmul(
+            x.astype(cd), c.astype(cd), preferred_element_type=f32
+        )
+        return jnp.maximum(x_sq - 2.0 * prod + jnp.sum(c * c), 0.0)
+
+    d2 = d2_to(c0)
+
+    def body(i, carry):
+        centroids, d2 = carry
+        # P(idx) ∝ w · D²; log(0) = -inf excludes already-chosen points.
+        # Per-round Gumbel noise from a folded key — never materializes (k, n).
+        g = jax.random.gumbel(jax.random.fold_in(key_g, i), (n,), dtype=f32)
+        score = jnp.log(w * d2) + g
+        idx = jnp.argmax(score)
+        c = x[idx].astype(f32)
+        centroids = centroids.at[i].set(c)
+        d2 = jnp.minimum(d2, d2_to(c))
+        return centroids, d2
+
+    centroids, _ = lax.fori_loop(1, k, body, (centroids, d2))
+    return centroids
+
+
+def init_centroids(
+    key: jax.Array,
+    x: jax.Array,
+    k: int,
+    *,
+    method: str = "k-means++",
+    weights: Optional[jax.Array] = None,
+    compute_dtype=None,
+) -> jax.Array:
+    if method == "k-means++":
+        return kmeans_plus_plus(
+            key, x, k, weights=weights, compute_dtype=compute_dtype
+        )
+    if method == "random":
+        return random_init(key, x, k, weights=weights)
+    raise ValueError(f"unknown init method {method!r}")

@@ -1,0 +1,242 @@
+"""Full-batch Lloyd k-means: the flagship model.
+
+This runs the loop the reference performs manually — humans assign
+(/root/reference/app.mjs:358-372), bump the iteration counter
+(app.mjs:288,499-508) and read the metric deltas — as a jit-compiled
+``lax.while_loop`` on TPU:
+
+  assign+reduce (fused pass) → centroid update → shift-based convergence test
+
+with the same observable semantics the session layer exposes (per-iteration
+metric snapshots; see :mod:`kmeans_tpu.session.metrics`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import init_centroids
+from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
+
+__all__ = ["KMeansState", "fit_lloyd", "KMeans"]
+
+
+class KMeansState(NamedTuple):
+    """Result of a fit: arrays are committed (device) values."""
+
+    centroids: jax.Array      # (k, d) float32
+    labels: jax.Array         # (n,) int32
+    inertia: jax.Array        # scalar float32 (objective at final centroids)
+    n_iter: jax.Array         # scalar int32 (Lloyd iterations applied)
+    converged: jax.Array      # scalar bool (shift <= tol before max_iter)
+    counts: jax.Array         # (k,) float32 cluster sizes at final labels
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_iter", "chunk_size", "compute_dtype", "update", "empty"
+    ),
+)
+def _lloyd_loop(
+    x,
+    centroids0,
+    weights,
+    tol,
+    *,
+    max_iter,
+    chunk_size,
+    compute_dtype,
+    update,
+    empty,
+):
+    kw = dict(
+        weights=weights,
+        chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
+        update=update,
+    )
+
+    def cond(s):
+        c, it, shift_sq, done = s
+        return (it < max_iter) & ~done
+
+    def body(s):
+        c, it, _, _ = s
+        labels, min_d2, sums, counts, _ = lloyd_pass(x, c, **kw)
+        new_c = apply_update(c, sums, counts)
+        if empty == "farthest":
+            new_c = reseed_empty_farthest(new_c, counts, x, min_d2)
+        shift_sq = jnp.sum((new_c - c) ** 2)
+        return (new_c, it + 1, shift_sq, shift_sq <= tol)
+
+    init = (
+        centroids0.astype(jnp.float32),
+        jnp.zeros((), jnp.int32),
+        jnp.asarray(jnp.inf, jnp.float32),
+        jnp.zeros((), bool),
+    )
+    centroids, n_iter, shift_sq, converged = lax.while_loop(cond, body, init)
+    # Final consistent view: labels/inertia/counts at the *final* centroids.
+    labels, _, _, counts, inertia = lloyd_pass(x, centroids, **kw)
+    return KMeansState(centroids, labels, inertia, n_iter, converged, counts)
+
+
+def fit_lloyd(
+    x: jax.Array,
+    k: int,
+    *,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init: Union[str, jax.Array, None] = None,
+    weights: Optional[jax.Array] = None,
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+) -> KMeansState:
+    """Fit full-batch Lloyd k-means.
+
+    ``init`` may be an (k, d) array of starting centroids (overrides
+    ``config.init``) or a method name.
+    """
+    cfg = (config or KMeansConfig(k=k)).validate()
+    if config is not None and config.k != k:
+        raise ValueError(
+            f"k={k} contradicts config.k={config.k}; pass matching values"
+        )
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if key is None:
+        key = jax.random.key(cfg.seed)
+    if isinstance(init, (jnp.ndarray, jax.Array)) or (
+        init is not None and not isinstance(init, str)
+    ):
+        centroids0 = jnp.asarray(init, jnp.float32)
+        if centroids0.shape != (k, x.shape[1]):
+            raise ValueError(
+                f"init centroids shape {centroids0.shape} != {(k, x.shape[1])}"
+            )
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        centroids0 = init_centroids(
+            key, x, k,
+            method=method,
+            weights=weights,
+            compute_dtype=cfg.compute_dtype,
+        )
+    return _lloyd_loop(
+        x,
+        centroids0,
+        weights,
+        jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32),
+        max_iter=max_iter if max_iter is not None else cfg.max_iter,
+        chunk_size=cfg.chunk_size,
+        compute_dtype=cfg.compute_dtype,
+        update=cfg.update,
+        empty=cfg.empty,
+    )
+
+
+@dataclasses.dataclass
+class KMeans:
+    """Estimator-style wrapper (sklearn-like surface) over :func:`fit_lloyd`.
+
+    >>> km = KMeans(n_clusters=3, seed=0).fit(x)
+    >>> km.labels_, km.cluster_centers_, km.inertia_
+    """
+
+    n_clusters: int = 3
+    init: Union[str, jax.Array] = "k-means++"
+    max_iter: int = 100
+    tol: float = 1e-4
+    seed: int = 0
+    chunk_size: int = 4096
+    compute_dtype: Optional[str] = None
+    update: str = "matmul"
+    empty: str = "keep"
+
+    state: Optional[KMeansState] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def _config(self) -> KMeansConfig:
+        return KMeansConfig(
+            k=self.n_clusters,
+            init=self.init if isinstance(self.init, str) else "given",
+            max_iter=self.max_iter,
+            tol=self.tol,
+            seed=self.seed,
+            chunk_size=self.chunk_size,
+            compute_dtype=self.compute_dtype,
+            update=self.update,
+            empty=self.empty,
+        )
+
+    def fit(self, x, weights=None) -> "KMeans":
+        x = jnp.asarray(x)
+        init = None if isinstance(self.init, str) else self.init
+        self.state = fit_lloyd(
+            x,
+            self.n_clusters,
+            config=self._config(),
+            init=init,
+            weights=weights,
+        )
+        return self
+
+    # sklearn-flavored accessors -------------------------------------------
+    @property
+    def cluster_centers_(self):
+        return self.state.centroids
+
+    @property
+    def labels_(self):
+        return self.state.labels
+
+    @property
+    def inertia_(self):
+        return float(self.state.inertia)
+
+    @property
+    def n_iter_(self):
+        return int(self.state.n_iter)
+
+    def predict(self, x):
+        from kmeans_tpu.ops.distance import assign
+
+        labels, _ = assign(
+            jnp.asarray(x),
+            self.state.centroids,
+            chunk_size=self.chunk_size,
+            compute_dtype=self.compute_dtype,
+        )
+        return labels
+
+    def transform(self, x):
+        from kmeans_tpu.ops.distance import pairwise_sq_dists
+
+        return jnp.sqrt(
+            pairwise_sq_dists(
+                jnp.asarray(x),
+                self.state.centroids,
+                compute_dtype=self.compute_dtype,
+            )
+        )
+
+    def score(self, x):
+        from kmeans_tpu.ops.distance import assign
+
+        _, mind = assign(
+            jnp.asarray(x),
+            self.state.centroids,
+            chunk_size=self.chunk_size,
+            compute_dtype=self.compute_dtype,
+        )
+        return -float(jnp.sum(mind))

@@ -1,0 +1,185 @@
+"""Minibatch k-means (Sculley 2010-style) for the large configs.
+
+BASELINE.md configs 4 and 5 (CIFAR-10 50k×3072 k=100 and ImageNet-features
+1.28M×2048 k=1000) call for minibatch k-means: per step, assign one sampled
+batch and move each touched centroid toward the batch mean with a per-center
+learning rate 1/n_seen — the streaming average update.
+
+The whole optimization is one ``lax.scan`` over steps under jit: batch index
+draws use folded PRNG keys, the batch gather is a device-side take, and the
+assign step reuses the fused pass tile kernel.  A final full-data pass
+produces consistent labels/inertia.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import init_centroids
+from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.ops.distance import sq_norms
+from kmeans_tpu.ops.lloyd import lloyd_pass
+
+__all__ = ["fit_minibatch", "MiniBatchKMeans"]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("batch_size", "steps", "chunk_size", "compute_dtype"),
+)
+def _minibatch_loop(
+    x,
+    centroids0,
+    key,
+    *,
+    batch_size,
+    steps,
+    chunk_size,
+    compute_dtype,
+):
+    n, d = x.shape
+    k = centroids0.shape[0]
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    def step(carry, i):
+        centroids, n_seen = carry
+        bkey = jax.random.fold_in(key, i)
+        idx = jax.random.randint(bkey, (batch_size,), 0, n)
+        xb = x[idx]
+        # Assign the batch (batch_size × k fits on-chip for our configs).
+        prod = jnp.matmul(
+            xb.astype(cd), centroids.astype(cd).T, preferred_element_type=f32
+        )
+        part = sq_norms(centroids)[None, :] - 2.0 * prod
+        labels = jnp.argmin(part, axis=1).astype(jnp.int32)
+        bc = jax.ops.segment_sum(jnp.ones((batch_size,), f32), labels, k)
+        bs = jax.ops.segment_sum(xb.astype(f32), labels, k)
+        n_after = n_seen + bc
+        # Streaming mean: c += (batch_sum - batch_count·c) / n_seen_total.
+        delta = (bs - bc[:, None] * centroids) / jnp.maximum(n_after, 1.0)[:, None]
+        centroids = centroids + jnp.where((bc > 0)[:, None], delta, 0.0)
+        shift_sq = jnp.sum(jnp.where((bc > 0)[:, None], delta, 0.0) ** 2)
+        return (centroids, n_after), shift_sq
+
+    (centroids, _), shifts = lax.scan(
+        step, (centroids0.astype(f32), jnp.zeros((k,), f32)),
+        jnp.arange(steps),
+    )
+    labels, _, _, counts, inertia = lloyd_pass(
+        x, centroids, chunk_size=chunk_size, compute_dtype=compute_dtype
+    )
+    # Minibatch has no tol-based stop; "converged" is only True in the
+    # degenerate no-movement case (steps is static, so guard in Python).
+    converged = (shifts[-1] <= 0.0) if steps > 0 else jnp.asarray(False)
+    return KMeansState(
+        centroids,
+        labels,
+        inertia,
+        jnp.asarray(steps, jnp.int32),
+        converged,
+        counts,
+    )
+
+
+def fit_minibatch(
+    x: jax.Array,
+    k: int,
+    *,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init: Union[str, jax.Array, None] = None,
+    batch_size: Optional[int] = None,
+    steps: Optional[int] = None,
+) -> KMeansState:
+    """Fit minibatch k-means; see module docstring for the update rule."""
+    cfg = (config or KMeansConfig(k=k)).validate()
+    if config is not None and config.k != k:
+        raise ValueError(
+            f"k={k} contradicts config.k={config.k}; pass matching values"
+        )
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if key is None:
+        key = jax.random.key(cfg.seed)
+    ikey, lkey = jax.random.split(key)
+    if init is not None and not isinstance(init, str):
+        centroids0 = jnp.asarray(init, jnp.float32)
+        if centroids0.shape != (k, x.shape[1]):
+            raise ValueError(
+                f"init centroids shape {centroids0.shape} != {(k, x.shape[1])}"
+            )
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        # Seed k-means++ on a subsample for speed at very large n.
+        n = x.shape[0]
+        sub = min(n, max(4 * k * 16, 65536))
+        skey, ikey2 = jax.random.split(ikey)
+        if sub < n:
+            sidx = jax.random.choice(skey, n, shape=(sub,), replace=False)
+            xs = x[sidx]
+        else:
+            xs = x
+        centroids0 = init_centroids(
+            ikey2, xs, k, method=method, compute_dtype=cfg.compute_dtype
+        )
+    return _minibatch_loop(
+        x,
+        centroids0,
+        lkey,
+        batch_size=batch_size if batch_size is not None else cfg.batch_size,
+        steps=steps if steps is not None else cfg.steps,
+        chunk_size=cfg.chunk_size,
+        compute_dtype=cfg.compute_dtype,
+    )
+
+
+@dataclasses.dataclass
+class MiniBatchKMeans:
+    """Estimator-style wrapper over :func:`fit_minibatch`."""
+
+    n_clusters: int = 8
+    init: Union[str, jax.Array] = "k-means++"
+    batch_size: int = 8192
+    steps: int = 200
+    seed: int = 0
+    chunk_size: int = 4096
+    compute_dtype: Optional[str] = None
+
+    state: Optional[KMeansState] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def fit(self, x) -> "MiniBatchKMeans":
+        x = jnp.asarray(x)
+        cfg = KMeansConfig(
+            k=self.n_clusters,
+            init=self.init if isinstance(self.init, str) else "given",
+            seed=self.seed,
+            chunk_size=self.chunk_size,
+            compute_dtype=self.compute_dtype,
+            batch_size=self.batch_size,
+            steps=self.steps,
+        )
+        init = None if isinstance(self.init, str) else self.init
+        self.state = fit_minibatch(x, self.n_clusters, config=cfg, init=init)
+        return self
+
+    @property
+    def cluster_centers_(self):
+        return self.state.centroids
+
+    @property
+    def labels_(self):
+        return self.state.labels
+
+    @property
+    def inertia_(self):
+        return float(self.state.inertia)

@@ -1,0 +1,14 @@
+"""Numeric kernels: assignment, fused Lloyd pass, centroid update."""
+
+from kmeans_tpu.ops.distance import assign, pairwise_sq_dists, sq_norms
+from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
+
+__all__ = [
+    "assign",
+    "pairwise_sq_dists",
+    "sq_norms",
+    "lloyd_pass",
+    "apply_update",
+    "reseed_empty_farthest",
+]

@@ -1,0 +1,84 @@
+"""Pairwise-distance and assignment kernels (the Lloyd "assign" step).
+
+TPU-native replacement for the reference's *manual* assignment step — in the
+reference a human drags a card onto a centroid zone
+(/root/reference/app.mjs:358-372) or picks a centroid from the card's select
+(app.mjs:398-402).  Here assignment is ``argmin_k ||x - c_k||²`` computed as
+``argmin_k (||c_k||² - 2·x·c_kᵀ)`` — the row term ``||x||²`` is constant per
+point and dropped from the argmin, then added back for the inertia value.
+
+Design notes (TPU-first):
+
+* The N×k distance matrix is never materialized globally: the pass scans over
+  row tiles of ``chunk_size`` points so only a (chunk × k) tile is live.
+* The inner product is a single (chunk × d) @ (d × k) matmul in a configurable
+  compute dtype (bf16 for the MXU) with float32 accumulation
+  (``preferred_element_type``).
+* Static shapes only; padding rows carry weight 0 so they contribute nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sq_norms", "pairwise_sq_dists", "assign"]
+
+
+def _as_dtype(compute_dtype, fallback):
+    if compute_dtype is None:
+        return fallback
+    return jnp.dtype(compute_dtype)
+
+
+def sq_norms(x: jax.Array) -> jax.Array:
+    """Row-wise squared L2 norms in float32."""
+    xf = x.astype(jnp.float32)
+    return jnp.sum(xf * xf, axis=-1)
+
+
+def pairwise_sq_dists(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    compute_dtype=None,
+) -> jax.Array:
+    """Full (n × k) squared-distance matrix.
+
+    Materializes n×k — only for small problems and tests; the training path
+    uses the tiled pass in :mod:`kmeans_tpu.ops.lloyd`.
+    """
+    cd = _as_dtype(compute_dtype, x.dtype)
+    prod = jnp.matmul(
+        x.astype(cd), centroids.astype(cd).T, preferred_element_type=jnp.float32
+    )
+    d2 = sq_norms(x)[:, None] - 2.0 * prod + sq_norms(centroids)[None, :]
+    return jnp.maximum(d2, 0.0)
+
+
+def assign(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Nearest-centroid labels and squared distances, tiled over rows.
+
+    Returns ``(labels int32 [n], min_sq_dists float32 [n])``.  Ties break
+    toward the lower centroid index (``jnp.argmin`` semantics) — the sharded
+    tensor-parallel combine in :mod:`kmeans_tpu.parallel.engine` preserves
+    this tie-break so results are mesh-shape-independent.
+    """
+    from kmeans_tpu.ops.lloyd import lloyd_pass  # cycle-free at call time
+
+    labels, mind, _, _, _ = lloyd_pass(
+        x,
+        centroids,
+        chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
+        with_update=False,
+    )
+    return labels, mind

@@ -1,0 +1,139 @@
+"""The fused Lloyd pass: assign + reduce in one scan over the data.
+
+This is the numeric heart of the framework — the TPU-native replacement for
+the reference's entire "compute" layer, where assignment is performed by
+humans (/root/reference/app.mjs:358-372) and the only numeric kernel is the
+O(n²·tokens) cohesion metric (app.mjs:462-475).
+
+One call produces, in a single read of ``x`` from HBM:
+
+* ``labels``   — nearest-centroid index per point (the assign step),
+* ``min_d2``   — squared distance to that centroid (for inertia / reseeding),
+* ``sums``     — per-cluster weighted coordinate sums (the update numerator),
+* ``counts``   — per-cluster weighted counts (the update denominator),
+* ``inertia``  — Σ w·min_d2 (the objective).
+
+TPU-first design:
+
+* ``lax.scan`` over static row tiles; each tile does one
+  (chunk × d) @ (d × k) matmul on the MXU in ``compute_dtype`` (bf16 by
+  default on TPU) with float32 accumulation.
+* The centroid update's numerator is itself a matmul — one_hotᵀ @ x on the
+  MXU (``update="matmul"``) — or a ``jax.ops.segment_sum`` scatter
+  (``update="segment"``); both produce float32 and are tested equal.
+* Everything is static-shaped; ragged N is handled by zero-weight padding.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.ops.distance import sq_norms
+
+__all__ = ["lloyd_pass"]
+
+
+def _pad_to_chunks(x, w, chunk_size):
+    n = x.shape[0]
+    pad = (-n) % chunk_size
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+    return x, w, n + pad
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk_size", "compute_dtype", "update", "with_update"),
+)
+def lloyd_pass(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    weights: Optional[jax.Array] = None,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+    update: str = "matmul",
+    with_update: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One fused assign(+reduce) sweep.
+
+    Args:
+      x: (n, d) points.
+      centroids: (k, d) current centroids (float32 recommended).
+      weights: optional (n,) float weights; padding uses weight 0.
+      chunk_size: rows per scan tile (static).
+      compute_dtype: matmul input dtype (None = x.dtype); accumulate f32.
+      update: "matmul" | "segment" reduction flavor for sums.
+      with_update: when False, skip sums/counts (pure assignment pass).
+
+    Returns:
+      (labels int32 [n], min_d2 f32 [n], sums f32 [k, d], counts f32 [k],
+       inertia f32 scalar).  ``sums``/``counts`` are zeros when
+      ``with_update=False``.
+    """
+    n, d = x.shape
+    k = centroids.shape[0]
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    xp, wp, n_pad = _pad_to_chunks(x, w, chunk_size)
+    n_chunks = n_pad // chunk_size
+
+    c_t = centroids.astype(cd).T                      # (d, k) resident operand
+    c_sq = sq_norms(centroids)                        # (k,) f32
+
+    xs = xp.reshape(n_chunks, chunk_size, d)
+    ws = wp.reshape(n_chunks, chunk_size)
+
+    def body(carry, tile):
+        sums, counts, inertia = carry
+        xb, wb = tile
+        xb_c = xb.astype(cd)
+        # argmin_k ||x-c||² == argmin_k (||c||² - 2 x·c); row norm added later.
+        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32)   # (chunk, k)
+        part = c_sq[None, :] - 2.0 * prod
+        labels = jnp.argmin(part, axis=1).astype(jnp.int32)
+        min_d2 = jnp.maximum(jnp.min(part, axis=1) + sq_norms(xb), 0.0)
+        inertia = inertia + jnp.sum(min_d2 * wb)
+        if with_update:
+            counts = counts + jax.ops.segment_sum(wb, labels, num_segments=k)
+            # The MXU one-hot path is exact only when the one-hot entries are
+            # representable in cd — true for the internal 0/1 padding weights
+            # (weights=None) but not for arbitrary fractional user weights in
+            # bf16.  Route fractional-weight runs through the exact f32
+            # segment reduction instead of silently quantizing.
+            eff_update = update
+            if update == "matmul" and weights is not None and cd != f32:
+                eff_update = "segment"
+            if eff_update == "matmul":
+                onehot = (labels[:, None] == jnp.arange(k)[None, :])
+                wt = (onehot * wb[:, None]).astype(cd)             # (chunk, k)
+                sums = sums + jnp.matmul(
+                    wt.T, xb_c, preferred_element_type=f32
+                )
+            elif eff_update == "segment":
+                sums = sums + jax.ops.segment_sum(
+                    xb.astype(f32) * wb[:, None], labels, num_segments=k
+                )
+            else:
+                raise ValueError(f"unknown update {update!r}")
+        return (sums, counts, inertia), (labels, min_d2)
+
+    init = (
+        jnp.zeros((k, d), f32),
+        jnp.zeros((k,), f32),
+        jnp.zeros((), f32),
+    )
+    (sums, counts, inertia), (labels, min_d2) = lax.scan(
+        body, init, (xs, ws)
+    )
+    labels = labels.reshape(n_pad)[:n]
+    min_d2 = min_d2.reshape(n_pad)[:n]
+    return labels, min_d2, sums, counts, inertia

@@ -1,0 +1,58 @@
+"""Centroid update (the Lloyd "update" step) and empty-cluster policies.
+
+The reference has no numeric update step — humans reposition their mental
+centroids between iterations and the app only snapshots metrics at iteration
+boundaries (/root/reference/app.mjs:498-508).  Here the update is the mean of
+assigned points, computed from the (sums, counts) reduction that
+:func:`kmeans_tpu.ops.lloyd.lloyd_pass` produces in the same sweep as the
+assignment.
+
+Empty clusters:
+
+* ``"keep"``     — retain the previous centroid (deterministic across any
+  mesh shape; default).
+* ``"farthest"`` — reseed empty clusters to the points currently worst fit
+  (largest min-squared-distance), via a global top-k; deterministic given the
+  same global data order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["apply_update", "reseed_empty_farthest"]
+
+
+def apply_update(
+    centroids: jax.Array,
+    sums: jax.Array,
+    counts: jax.Array,
+) -> jax.Array:
+    """New centroids = sums/counts where count > 0, else the old centroid."""
+    denom = jnp.where(counts > 0, counts, 1.0)
+    means = sums / denom[:, None]
+    keep = (counts > 0)[:, None]
+    return jnp.where(keep, means, centroids.astype(jnp.float32))
+
+
+def reseed_empty_farthest(
+    centroids: jax.Array,
+    counts: jax.Array,
+    x: jax.Array,
+    min_d2: jax.Array,
+) -> jax.Array:
+    """Replace empty clusters with the globally worst-fit points.
+
+    The j-th empty cluster (in index order) takes the point with the j-th
+    largest ``min_d2``.  Uses ``lax.top_k`` over n with k candidates, so cost
+    is O(n log k) — negligible next to the distance matmul.
+    """
+    k = centroids.shape[0]
+    empty = counts <= 0
+    # Rank of each empty cluster among empties: 0, 1, 2, ...
+    rank = jnp.where(empty, jnp.cumsum(empty.astype(jnp.int32)) - 1, 0)
+    _, cand = lax.top_k(min_d2, k)                  # indices of worst-fit pts
+    repl = x[cand[rank]].astype(jnp.float32)        # (k, d) candidate per slot
+    return jnp.where(empty[:, None], repl, centroids.astype(jnp.float32))

@@ -1,0 +1,5 @@
+"""Small shared helpers: room codes, ids, presence initials."""
+
+from kmeans_tpu.utils.rooms import code4, initials, new_card_id, new_centroid_id
+
+__all__ = ["code4", "initials", "new_card_id", "new_centroid_id"]

@@ -1,0 +1,44 @@
+"""Test harness: force an 8-device CPU mesh (SURVEY.md §4).
+
+Multi-host/multi-chip paths are tested without a cluster: 8 virtual CPU
+devices via ``--xla_force_host_platform_device_count`` so ``shard_map`` /
+``psum`` code runs against a real mesh in CI, and the default backend is
+pinned to CPU so tests never touch (or wait on) the real TPU chip.
+
+Must run before anything imports jax's backends — conftest import time is
+early enough because jax initializes backends lazily.
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+_flag = "--xla_force_host_platform_device_count=8"
+_existing = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _existing:
+    os.environ["XLA_FLAGS"] = (_existing + " " + _flag).strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # already initialized with cpu available — fall through
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,56 @@
+"""Pure-NumPy oracles for the numeric kernels (SURVEY.md §4 test strategy).
+
+Deliberately naive implementations — O(n·k·d) dense distance matrices and
+Python-level loops — used as ground truth for the JAX kernels on small inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sq_dists(x: np.ndarray, c: np.ndarray) -> np.ndarray:
+    diff = x[:, None, :] - c[None, :, :]
+    return np.sum(diff * diff, axis=-1)
+
+
+def assign(x: np.ndarray, c: np.ndarray):
+    d2 = sq_dists(x, c)
+    labels = np.argmin(d2, axis=1)
+    return labels, d2[np.arange(len(x)), labels]
+
+
+def update(x: np.ndarray, labels: np.ndarray, k: int, old_c: np.ndarray,
+           weights: np.ndarray | None = None):
+    w = np.ones(len(x)) if weights is None else weights
+    sums = np.zeros((k, x.shape[1]))
+    counts = np.zeros(k)
+    for i, l in enumerate(labels):
+        sums[l] += w[i] * x[i]
+        counts[l] += w[i]
+    new_c = old_c.astype(np.float64).copy()
+    nz = counts > 0
+    new_c[nz] = sums[nz] / counts[nz, None]
+    return new_c, sums, counts
+
+
+def lloyd(x: np.ndarray, c0: np.ndarray, max_iter: int, tol: float):
+    c = c0.astype(np.float64).copy()
+    k = len(c0)
+    n_iter = 0
+    for _ in range(max_iter):
+        labels, _ = assign(x, c)
+        new_c, _, _ = update(x, labels, k, c)
+        shift = np.sum((new_c - c) ** 2)
+        c = new_c
+        n_iter += 1
+        if shift <= tol:
+            break
+    labels, mind = assign(x, c)
+    return c, labels, float(np.sum(mind)), n_iter
+
+
+def inertia(x: np.ndarray, c: np.ndarray, weights: np.ndarray | None = None):
+    _, mind = assign(x, c)
+    w = np.ones(len(x)) if weights is None else weights
+    return float(np.sum(w * mind))

@@ -1,0 +1,155 @@
+"""Estimator tests: Lloyd fit, k-means++/random init, minibatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracles
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import (
+    KMeans,
+    MiniBatchKMeans,
+    fit_lloyd,
+    fit_minibatch,
+    kmeans_plus_plus,
+    random_init,
+)
+
+
+def test_lloyd_matches_numpy_oracle_given_init(rng):
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    c0 = x[:5].copy()
+    state = fit_lloyd(jnp.asarray(x), 5, init=jnp.asarray(c0), tol=1e-10,
+                      max_iter=50)
+    want_c, want_labels, want_inertia, want_iters = oracles.lloyd(
+        x, c0, max_iter=50, tol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(state.centroids), want_c, rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_array_equal(np.asarray(state.labels), want_labels)
+    np.testing.assert_allclose(float(state.inertia), want_inertia, rtol=1e-4)
+
+
+def test_lloyd_inertia_monotone_nonincreasing(rng):
+    x = jnp.asarray(rng.normal(size=(300, 6)).astype(np.float32))
+    c0 = x[:8]
+    from kmeans_tpu.ops import apply_update, lloyd_pass
+
+    c = c0
+    prev = None
+    for _ in range(12):
+        _, _, sums, counts, inertia = lloyd_pass(x, c, chunk_size=64)
+        if prev is not None:
+            assert float(inertia) <= prev + 1e-3
+        prev = float(inertia)
+        c = apply_update(c, sums, counts)
+
+
+def test_lloyd_converges_on_blobs():
+    key = jax.random.key(0)
+    x, true_labels, _ = make_blobs(key, 500, 2, 3, cluster_std=0.3)
+    state = fit_lloyd(x, 3, key=jax.random.key(1))
+    assert bool(state.converged)
+    # Well-separated blobs: clustering must match ground truth up to relabel.
+    got = np.asarray(state.labels)
+    want = np.asarray(true_labels)
+    # Build the best label mapping and check accuracy.
+    acc = 0
+    import itertools
+
+    for perm in itertools.permutations(range(3)):
+        mapped = np.array([perm[g] for g in got])
+        acc = max(acc, np.mean(mapped == want))
+    assert acc > 0.98
+
+
+def test_kmeans_estimator_surface(rng):
+    x = rng.normal(size=(120, 3)).astype(np.float32)
+    km = KMeans(n_clusters=4, seed=0).fit(x)
+    assert km.cluster_centers_.shape == (4, 3)
+    assert km.labels_.shape == (120,)
+    assert km.inertia_ > 0
+    assert km.n_iter_ >= 1
+    pred = km.predict(x)
+    np.testing.assert_array_equal(np.asarray(pred), np.asarray(km.labels_))
+    t = km.transform(x[:7])
+    assert t.shape == (7, 4)
+    assert km.score(x) == pytest.approx(-km.inertia_, rel=1e-5)
+
+
+def test_random_init_picks_distinct_points(rng):
+    x = jnp.asarray(rng.normal(size=(50, 2)).astype(np.float32))
+    c = random_init(jax.random.key(0), x, 10)
+    # each centroid is an actual row of x, all distinct
+    xn = np.asarray(x)
+    cn = np.asarray(c)
+    matches = [np.where(np.all(np.isclose(xn, row), axis=1))[0] for row in cn]
+    idx = [m[0] for m in matches]
+    assert len(set(idx)) == 10
+
+
+def test_kmeans_plus_plus_spreads_centroids():
+    # Three tight, well-separated blobs: k-means++ must hit all three;
+    # uniform-random init frequently would not.
+    key = jax.random.key(3)
+    x, _, centers = make_blobs(key, 300, 2, 3, cluster_std=0.05)
+    c = kmeans_plus_plus(jax.random.key(7), x, 3)
+    cn = np.asarray(c)
+    d2 = oracles.sq_dists(cn, np.asarray(centers))
+    # each seeded centroid is near a distinct true center
+    assert len(set(np.argmin(d2, axis=1))) == 3
+
+
+def test_kmeans_plus_plus_deterministic_given_key():
+    x, _, _ = make_blobs(jax.random.key(0), 200, 3, 4)
+    c1 = kmeans_plus_plus(jax.random.key(5), x, 4)
+    c2 = kmeans_plus_plus(jax.random.key(5), x, 4)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+
+def test_minibatch_reduces_inertia_vs_init():
+    key = jax.random.key(0)
+    x, _, _ = make_blobs(key, 5000, 8, 10, cluster_std=0.5)
+    c0 = random_init(jax.random.key(1), x, 10)
+    init_inertia = oracles.inertia(np.asarray(x), np.asarray(c0))
+    state = fit_minibatch(x, 10, init=c0, batch_size=512, steps=100)
+    assert float(state.inertia) < init_inertia * 0.7
+
+
+def test_minibatch_estimator_surface(rng):
+    x = rng.normal(size=(2000, 5)).astype(np.float32)
+    mb = MiniBatchKMeans(n_clusters=6, batch_size=256, steps=50, seed=0).fit(x)
+    assert mb.cluster_centers_.shape == (6, 5)
+    assert mb.labels_.shape == (2000,)
+    assert mb.inertia_ > 0
+
+
+def test_empty_cluster_farthest_policy_fills_all_clusters():
+    # Duplicate data collapsed at origin except a few satellites: with k too
+    # large, some clusters start empty; "farthest" must reseed them.
+    rng = np.random.default_rng(1)
+    x = np.concatenate([
+        np.zeros((50, 2), np.float32),
+        rng.normal(size=(10, 2)).astype(np.float32) * 5 + 20,
+    ])
+    state = fit_lloyd(
+        jnp.asarray(x), 4,
+        init=jnp.asarray(np.zeros((4, 2), np.float32)),
+        max_iter=10,
+    )
+    # with "keep" (default), duplicated zero centroids persist
+    from kmeans_tpu.config import KMeansConfig
+
+    cfg = KMeansConfig(k=4, empty="farthest", init="given")
+    state_f = fit_lloyd(
+        jnp.asarray(x), 4,
+        config=cfg,
+        init=jnp.asarray(np.zeros((4, 2), np.float32)),
+        max_iter=10,
+    )
+    assert float(state_f.inertia) <= float(state.inertia) + 1e-3
+    assert int(np.sum(np.asarray(state_f.counts) > 0)) >= int(
+        np.sum(np.asarray(state.counts) > 0)
+    )

@@ -1,0 +1,125 @@
+"""Kernel tests: assignment, fused pass, update — against NumPy oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracles
+from kmeans_tpu.ops import (
+    apply_update,
+    assign,
+    lloyd_pass,
+    pairwise_sq_dists,
+    reseed_empty_farthest,
+)
+
+
+def _data(rng, n=97, d=5, k=7):
+    x = rng.normal(size=(n, d)).astype(np.float32) * 3
+    c = rng.normal(size=(k, d)).astype(np.float32) * 3
+    return x, c
+
+
+def test_pairwise_sq_dists_matches_oracle(rng):
+    x, c = _data(rng)
+    got = np.asarray(pairwise_sq_dists(jnp.asarray(x), jnp.asarray(c)))
+    want = oracles.sq_dists(x, c)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("chunk_size", [7, 32, 256])
+def test_assign_matches_oracle_any_chunking(rng, chunk_size):
+    x, c = _data(rng)
+    labels, mind = assign(jnp.asarray(x), jnp.asarray(c), chunk_size=chunk_size)
+    want_labels, want_mind = oracles.assign(x, c)
+    np.testing.assert_array_equal(np.asarray(labels), want_labels)
+    np.testing.assert_allclose(np.asarray(mind), want_mind, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("update", ["matmul", "segment"])
+def test_lloyd_pass_sums_counts_inertia(rng, update):
+    x, c = _data(rng)
+    labels, mind, sums, counts, inertia = lloyd_pass(
+        jnp.asarray(x), jnp.asarray(c), chunk_size=16, update=update
+    )
+    want_labels, _ = oracles.assign(x, c)
+    _, want_sums, want_counts = oracles.update(x, want_labels, len(c), c)
+    np.testing.assert_array_equal(np.asarray(labels), want_labels)
+    np.testing.assert_allclose(np.asarray(sums), want_sums, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(counts), want_counts, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(inertia), oracles.inertia(x, c), rtol=1e-4
+    )
+
+
+def test_lloyd_pass_update_paths_agree(rng):
+    x, c = _data(rng, n=128, d=8, k=5)
+    out_m = lloyd_pass(jnp.asarray(x), jnp.asarray(c), chunk_size=32, update="matmul")
+    out_s = lloyd_pass(jnp.asarray(x), jnp.asarray(c), chunk_size=32, update="segment")
+    np.testing.assert_allclose(
+        np.asarray(out_m[2]), np.asarray(out_s[2]), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(out_m[3]), np.asarray(out_s[3]))
+
+
+def test_lloyd_pass_weights_zero_rows_are_ignored(rng):
+    x, c = _data(rng, n=40)
+    w = np.ones(40, np.float32)
+    w[10:20] = 0.0
+    _, _, sums, counts, inertia = lloyd_pass(
+        jnp.asarray(x), jnp.asarray(c), weights=jnp.asarray(w), chunk_size=8
+    )
+    keep = np.concatenate([np.arange(10), np.arange(20, 40)])
+    want_labels, _ = oracles.assign(x[keep], c)
+    _, want_sums, want_counts = oracles.update(x[keep], want_labels, len(c), c)
+    np.testing.assert_allclose(np.asarray(sums), want_sums, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(counts), want_counts, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(inertia), oracles.inertia(x[keep], c), rtol=1e-4
+    )
+
+
+def test_apply_update_keeps_empty_clusters(rng):
+    x, c = _data(rng, n=20, d=3, k=4)
+    labels = np.zeros(20, np.int64)  # everything in cluster 0
+    _, sums, counts = oracles.update(x, labels, 4, c)
+    new_c = apply_update(jnp.asarray(c), jnp.asarray(sums, dtype=jnp.float32),
+                         jnp.asarray(counts, dtype=jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(new_c)[0], x.mean(axis=0), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(new_c)[1:], c[1:], rtol=1e-6)
+
+
+def test_reseed_empty_farthest_takes_worst_fit_points(rng):
+    x, c = _data(rng, n=30, d=3, k=4)
+    counts = jnp.asarray([5.0, 0.0, 3.0, 0.0])
+    mind = rng.uniform(size=30).astype(np.float32)
+    new_c = reseed_empty_farthest(
+        jnp.asarray(c), counts, jnp.asarray(x), jnp.asarray(mind)
+    )
+    order = np.argsort(-mind)
+    np.testing.assert_allclose(np.asarray(new_c)[1], x[order[0]], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_c)[3], x[order[1]], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_c)[0], c[0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_c)[2], c[2], rtol=1e-6)
+
+
+def test_assign_permutation_invariance(rng):
+    x, c = _data(rng, n=50)
+    perm = rng.permutation(50)
+    l1, _ = assign(jnp.asarray(x), jnp.asarray(c), chunk_size=16)
+    l2, _ = assign(jnp.asarray(x[perm]), jnp.asarray(c), chunk_size=16)
+    np.testing.assert_array_equal(np.asarray(l1)[perm], np.asarray(l2))
+
+
+def test_bf16_compute_dtype_runs_and_is_close(rng):
+    x, c = _data(rng, n=64, d=16, k=4)
+    labels32, _ = assign(jnp.asarray(x), jnp.asarray(c), chunk_size=16)
+    labels16, _ = assign(
+        jnp.asarray(x), jnp.asarray(c), chunk_size=16, compute_dtype="bfloat16"
+    )
+    # bf16 rounding may flip a few boundary points; most must agree.
+    agree = np.mean(np.asarray(labels32) == np.asarray(labels16))
+    assert agree > 0.9

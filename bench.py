@@ -1,0 +1,179 @@
+"""Benchmark: Lloyd iterations/sec/chip at the north-star config.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric (BASELINE.json): Lloyd iters/sec/chip at N=1.28M, d=2048,
+k=1000 (synthetic features — zero-egress environment, shapes are what
+matter).  The north-star target implies >= ~10 iter/s sustained on a v5e-8,
+i.e. 1.25 iter/s/chip; ``vs_baseline`` is measured-rate / 1.25, so 1.0 means
+exactly on target and higher is better.
+
+Run `python bench.py --all` for the full 5-config table (human-readable,
+extra lines go to stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_ITERS_PER_S_PER_CHIP = 10.0 / 8.0   # BASELINE.md derivation
+
+
+def _make_data(n, d, seed=0, dtype="bfloat16", tile=32768):
+    """Blob-ish synthetic features, generated on-device tile by tile.
+
+    Tiled so no f32 (n, d) intermediate ever exists — at the headline config
+    that intermediate alone would be ~10 GB, more than half of a v5e chip's
+    HBM.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    k_gen = 64
+    rng = np.random.default_rng(seed)
+    centers = jnp.asarray(rng.normal(size=(k_gen, d)).astype(np.float32) * 3)
+
+    n_pad = -(-n // tile) * tile
+
+    @jax.jit
+    def gen(key):
+        keys = jax.random.split(key, n_pad // tile)
+
+        def one(key):
+            kl, kn = jax.random.split(key)
+            labels = jax.random.randint(kl, (tile,), 0, k_gen)
+            noise = jax.random.normal(kn, (tile, d), dtype=jnp.float32)
+            return (centers[labels] + noise).astype(dtype)
+
+        return lax.map(one, keys).reshape(n_pad, d)
+
+    x = gen(jax.random.key(seed))[:n]
+    x.block_until_ready()
+    return x
+
+
+def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
+                            chunk_size=65536, verbose=False):
+    """One Lloyd iteration rate, using ALL local devices (DP-sharded when
+    more than one chip is present, so iter/s ÷ n_chips is honest)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kmeans_tpu.ops.lloyd import lloyd_pass
+    from kmeans_tpu.ops.update import apply_update
+
+    x = _make_data(n, d)
+    rng = np.random.default_rng(1)
+    c0 = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32) * 3)
+    n_dev = len(jax.devices())
+
+    if n_dev > 1:
+        from kmeans_tpu.parallel import make_mesh
+        from kmeans_tpu.parallel.engine import _dp_local_pass, _pad_rows
+
+        mesh = make_mesh((n_dev, 1), ("data", "model"))
+        x, w_host, _ = _pad_rows(x, n_dev)
+        x = jax.device_put(x, NamedSharding(mesh, P("data")))
+        w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P("data")))
+        local = functools.partial(
+            _dp_local_pass, data_axis="data", chunk_size=chunk_size,
+            compute_dtype="bfloat16", update="matmul", with_labels=False,
+        )
+        step_sm = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P("data"), P(), P("data")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        step = jax.jit(lambda x, c, w: step_sm(x, c, w)[0])
+        args = (w,)
+    else:
+        @jax.jit
+        def step(x, c):
+            # x must be an argument, not a closure: a closed-over array
+            # becomes an XLA constant and constant-folding a multi-GB
+            # literal stalls compilation for minutes.
+            _, _, sums, counts, _ = lloyd_pass(
+                x, c, chunk_size=chunk_size, compute_dtype="bfloat16"
+            )
+            return apply_update(c, sums, counts)
+
+        args = ()
+
+    # Warm-up / compile.
+    c = step(x, c0, *args)
+    c.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        c = step(x, c, *args)
+    c.block_until_ready()
+    dt = time.perf_counter() - t0
+    rate = iters / dt
+    if verbose:
+        flops = 4.0 * n * d * k  # distance matmul + one-hot update matmul
+        print(
+            f"  {iters} iters in {dt:.2f}s -> {rate:.2f} iter/s "
+            f"({flops * rate / 1e12:.1f} TFLOP/s sustained)",
+            file=sys.stderr,
+        )
+    return rate
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true", help="run all 5 configs")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    n_chips = len(jax.devices())
+    print(f"platform={dev.platform} devices={n_chips}", file=sys.stderr)
+
+    if args.all:
+        from kmeans_tpu.data import BENCH_CONFIGS
+
+        for name, cfg in BENCH_CONFIGS.items():
+            r = bench_lloyd_iters_per_s(
+                cfg["n"], cfg["d"], cfg["k"], iters=args.iters, verbose=True
+            )
+            print(f"{name}: {r:.2f} Lloyd iter/s", file=sys.stderr)
+
+    # Headline: the north-star config on however many chips we have.
+    if dev.platform != "tpu":
+        # CI/CPU fallback: scaled-down shape so the line still prints.
+        rate = bench_lloyd_iters_per_s(
+            20_000, 256, 64, iters=args.iters, verbose=True
+        )
+        print(json.dumps({
+            "metric": "lloyd_iters_per_sec_per_chip_cpu_fallback_20k_256_64",
+            "value": round(rate, 3),
+            "unit": "iter/s/chip",
+            "vs_baseline": None,
+        }))
+        return
+
+    rate = bench_lloyd_iters_per_s(iters=args.iters, verbose=True)
+    per_chip = rate / max(1, n_chips)
+    print(json.dumps({
+        "metric": "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000",
+        "value": round(per_chip, 3),
+        "unit": "iter/s/chip",
+        "vs_baseline": round(per_chip / NORTH_STAR_ITERS_PER_S_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

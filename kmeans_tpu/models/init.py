@@ -66,7 +66,10 @@ def kmeans_plus_plus(
     x_sq = sq_norms(x)
 
     key0, key_g = jax.random.split(key)
-    first = jax.random.randint(key0, (), 0, n)
+    # First center ∝ weights (uniform when weights are None) via Gumbel-max,
+    # so zero-weight rows (e.g. shard padding) are never selected.
+    g0 = jax.random.gumbel(key0, (n,), dtype=f32)
+    first = jnp.argmax(jnp.log(w) + g0)
     c0 = x[first].astype(f32)
 
     centroids = jnp.zeros((k, d), f32).at[0].set(c0)

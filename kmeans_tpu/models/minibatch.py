@@ -32,7 +32,10 @@ __all__ = ["fit_minibatch", "MiniBatchKMeans"]
 
 @functools.partial(
     jax.jit,
-    static_argnames=("batch_size", "steps", "chunk_size", "compute_dtype"),
+    static_argnames=(
+        "batch_size", "steps", "chunk_size", "compute_dtype", "n_valid",
+        "with_final",
+    ),
 )
 def _minibatch_loop(
     x,
@@ -43,8 +46,12 @@ def _minibatch_loop(
     steps,
     chunk_size,
     compute_dtype,
+    n_valid=None,
+    with_final=True,
 ):
-    n, d = x.shape
+    # n_valid < n means trailing rows are shard padding: never sample them.
+    n = n_valid if n_valid is not None else x.shape[0]
+    d = x.shape[1]
     k = centroids0.shape[0]
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
@@ -73,12 +80,24 @@ def _minibatch_loop(
         step, (centroids0.astype(f32), jnp.zeros((k,), f32)),
         jnp.arange(steps),
     )
-    labels, _, _, counts, inertia = lloyd_pass(
-        x, centroids, chunk_size=chunk_size, compute_dtype=compute_dtype
-    )
     # Minibatch has no tol-based stop; "converged" is only True in the
     # degenerate no-movement case (steps is static, so guard in Python).
     converged = (shifts[-1] <= 0.0) if steps > 0 else jnp.asarray(False)
+    if not with_final:
+        # Caller does its own (e.g. sharded) labeling pass — skip the full
+        # O(n·d·k) sweep here.
+        zero = jnp.zeros((), f32)
+        return KMeansState(
+            centroids,
+            jnp.zeros((0,), jnp.int32),
+            zero,
+            jnp.asarray(steps, jnp.int32),
+            converged,
+            jnp.zeros((k,), f32),
+        )
+    labels, _, _, counts, inertia = lloyd_pass(
+        x, centroids, chunk_size=chunk_size, compute_dtype=compute_dtype
+    )
     return KMeansState(
         centroids,
         labels,

@@ -49,7 +49,10 @@ def _pad_to_chunks(x, w, chunk_size):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("chunk_size", "compute_dtype", "update", "with_update"),
+    static_argnames=(
+        "chunk_size", "compute_dtype", "update", "with_update",
+        "weights_are_binary",
+    ),
 )
 def lloyd_pass(
     x: jax.Array,
@@ -60,6 +63,7 @@ def lloyd_pass(
     compute_dtype=None,
     update: str = "matmul",
     with_update: bool = True,
+    weights_are_binary: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """One fused assign(+reduce) sweep.
 
@@ -106,11 +110,17 @@ def lloyd_pass(
             counts = counts + jax.ops.segment_sum(wb, labels, num_segments=k)
             # The MXU one-hot path is exact only when the one-hot entries are
             # representable in cd — true for the internal 0/1 padding weights
-            # (weights=None) but not for arbitrary fractional user weights in
-            # bf16.  Route fractional-weight runs through the exact f32
-            # segment reduction instead of silently quantizing.
+            # (weights=None or weights_are_binary) but not for arbitrary
+            # fractional user weights in bf16.  Route fractional-weight runs
+            # through the exact f32 segment reduction instead of silently
+            # quantizing.
             eff_update = update
-            if update == "matmul" and weights is not None and cd != f32:
+            if (
+                update == "matmul"
+                and weights is not None
+                and not weights_are_binary
+                and cd != f32
+            ):
                 eff_update = "segment"
             if eff_update == "matmul":
                 onehot = (labels[:, None] == jnp.arange(k)[None, :])

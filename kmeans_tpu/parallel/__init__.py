@@ -1,0 +1,20 @@
+"""Parallelism: mesh construction, sharded engine, multi-host bootstrap."""
+
+from kmeans_tpu.parallel.distributed import ensure_initialized, process_info
+from kmeans_tpu.parallel.engine import (
+    fit_lloyd_sharded,
+    fit_minibatch_sharded,
+    sharded_assign,
+)
+from kmeans_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_from_config
+
+__all__ = [
+    "ensure_initialized",
+    "process_info",
+    "fit_lloyd_sharded",
+    "fit_minibatch_sharded",
+    "sharded_assign",
+    "cpu_mesh",
+    "make_mesh",
+    "mesh_from_config",
+]

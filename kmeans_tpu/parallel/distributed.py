@@ -1,0 +1,70 @@
+"""Multi-host bootstrap (DCN) for the sharded engine.
+
+The reference's join/rendezvous is tracker-brokered WebRTC with a full-state
+sync on connect (/root/reference/app.mjs:70-118; SURVEY.md §3 CS-E).  The
+TPU-native equivalent is ``jax.distributed.initialize``: every host joins a
+coordinator, after which ``jax.devices()`` spans the pod and the same mesh /
+``shard_map`` code from :mod:`kmeans_tpu.parallel.engine` runs with psum
+riding ICI within a slice and DCN across slices — no separate code path.
+
+Single-host (and this container's single tunneled chip) is the degenerate
+case: ``ensure_initialized`` is a no-op, so every entry point can call it
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["ensure_initialized", "is_multiprocess", "process_info"]
+
+_initialized = False
+
+
+def ensure_initialized(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the jax.distributed cluster if configured, else no-op.
+
+    Configuration comes from arguments or the standard environment variables
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``,
+    or cloud-TPU auto-detection inside ``jax.distributed.initialize``).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        # Single-process run — nothing to join.
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def process_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "device_count": jax.device_count(),
+    }

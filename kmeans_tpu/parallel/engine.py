@@ -1,0 +1,398 @@
+"""Sharded Lloyd engine: shard_map over the mesh with explicit collectives.
+
+This is the TPU-native answer to the reference's replication layer
+(/root/reference/app.mjs:35-121; SURVEY.md §2.6): instead of gossiping CRDT
+updates between human peers, per-iteration partial sums and counts ride the
+ICI as a ``lax.psum`` all-reduce — exactly the layout the north star names
+(BASELINE.json).
+
+Two parallel strategies, composable on one 2-axis mesh:
+
+* **DP** (``data`` axis): points are sharded by rows.  Each device runs the
+  fused local pass from :mod:`kmeans_tpu.ops.lloyd` on its shard, then
+  ``psum`` merges (sums, counts, inertia).  Centroids stay replicated.
+* **TP** (``model`` axis): centroids are sharded over k.  Each device scores
+  its k-slice, and the global argmin is recovered with two ``pmin``
+  collectives — first the winning distance, then the *lowest global index*
+  achieving it, which reproduces ``jnp.argmin``'s tie-break exactly, so
+  labels are identical across mesh shapes.  Updates touch only the local
+  k-slice (a reduce-scatter by construction: each shard keeps its slice).
+
+Convergence control (shift tolerance, max_iter) runs in a ``lax.while_loop``
+over the stepped ``shard_map`` — one compiled program for the whole fit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import init_centroids
+from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.ops.distance import sq_norms
+from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.update import apply_update
+
+__all__ = ["fit_lloyd_sharded", "fit_minibatch_sharded", "sharded_assign"]
+
+
+# ---------------------------------------------------------------------------
+# Local (per-shard) passes
+# ---------------------------------------------------------------------------
+
+def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
+                   update, with_labels):
+    """DP shard body: fused local pass + psum merge; centroids replicated."""
+    labels, _, sums, counts, inertia = lloyd_pass(
+        x_loc, c,
+        weights=w_loc,
+        chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
+        update=update,
+        weights_are_binary=True,
+    )
+    sums = lax.psum(sums, data_axis)
+    counts = lax.psum(counts, data_axis)
+    inertia = lax.psum(inertia, data_axis)
+    new_c = apply_update(c, sums, counts)
+    if with_labels:
+        return new_c, inertia, counts, labels
+    return new_c, inertia, counts
+
+
+def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
+                   chunk_size, compute_dtype, update, with_labels):
+    """DP×TP shard body: centroids sharded over k on ``model_axis``.
+
+    Padded centroid slots (global column >= k_real) are masked to +inf before
+    the argmin so padding never wins.
+    """
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
+    n_loc, d = x_loc.shape
+    k_loc = c_loc.shape[0]
+    k_pad_total = k_loc * lax.psum(1, model_axis)
+    k_off = lax.axis_index(model_axis) * k_loc
+
+    valid_col = (k_off + jnp.arange(k_loc)) < k_real        # (k_loc,)
+    c_t = c_loc.astype(cd).T
+    c_sq = sq_norms(c_loc)
+
+    pad = (-n_loc) % chunk_size
+    xp = jnp.concatenate([x_loc, jnp.zeros((pad, d), x_loc.dtype)]) if pad else x_loc
+    wp = jnp.concatenate([w_loc, jnp.zeros((pad,), f32)]) if pad else w_loc
+    n_chunks = xp.shape[0] // chunk_size
+    xs = xp.reshape(n_chunks, chunk_size, d)
+    ws = wp.reshape(n_chunks, chunk_size)
+
+    def body(carry, tile):
+        sums, counts, inertia = carry
+        xb, wb = tile
+        xb_c = xb.astype(cd)
+        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32)
+        part = jnp.where(
+            valid_col[None, :], c_sq[None, :] - 2.0 * prod, jnp.inf
+        )
+        lab_l = jnp.argmin(part, axis=1).astype(jnp.int32)
+        mind_l = jnp.min(part, axis=1)
+        # Global argmin across the model axis, jnp.argmin tie-break (lowest
+        # global index wins): pmin the value, then pmin the candidate index.
+        g = lax.pmin(mind_l, model_axis)
+        cand = jnp.where(mind_l == g, lab_l + k_off, k_pad_total)
+        lab_g = lax.pmin(cand, model_axis).astype(jnp.int32)
+        mind_g = jnp.maximum(g + sq_norms(xb), 0.0)
+        inertia = inertia + jnp.sum(mind_g * wb)
+        # Local k-slice update: rows whose winner lives on this shard.
+        rel = lab_g - k_off
+        if update == "matmul":
+            onehot = rel[:, None] == jnp.arange(k_loc)[None, :]
+            wt = (onehot * wb[:, None]).astype(cd)
+            sums = sums + jnp.matmul(wt.T, xb_c, preferred_element_type=f32)
+            counts = counts + jnp.sum(
+                onehot.astype(f32) * wb[:, None], axis=0
+            )
+        else:  # "segment": clamp out-of-shard rows to an extra dropped slot
+            in_shard = (rel >= 0) & (rel < k_loc)
+            seg = jnp.where(in_shard, rel, k_loc)
+            sums = sums + jax.ops.segment_sum(
+                xb.astype(f32) * wb[:, None], seg, num_segments=k_loc + 1
+            )[:k_loc]
+            counts = counts + jax.ops.segment_sum(
+                wb * in_shard, seg, num_segments=k_loc + 1
+            )[:k_loc]
+        return (sums, counts, inertia), (lab_g if with_labels else 0)
+
+    init = (jnp.zeros((k_loc, d), f32), jnp.zeros((k_loc,), f32),
+            jnp.zeros((), f32))
+    (sums, counts, inertia), labs = lax.scan(body, init, (xs, ws))
+
+    sums = lax.psum(sums, data_axis)
+    counts = lax.psum(counts, data_axis)
+    inertia = lax.psum(inertia, data_axis)
+    new_c_loc = apply_update(c_loc, sums, counts)
+    if with_labels:
+        labels = labs.reshape(-1)[:n_loc]
+        return new_c_loc, inertia, counts, labels
+    return new_c_loc, inertia, counts
+
+
+# ---------------------------------------------------------------------------
+# Global-view fit
+# ---------------------------------------------------------------------------
+
+def _pad_rows(x: jax.Array, multiple: int):
+    n = x.shape[0]
+    pad = (-n) % multiple
+    w = np.ones(n + pad, np.float32)
+    if pad:
+        x = np.concatenate(
+            [np.asarray(x), np.zeros((pad,) + x.shape[1:], x.dtype)]
+        ) if isinstance(x, np.ndarray) else jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]
+        )
+        w[n:] = 0.0
+    return x, w, n
+
+
+def fit_lloyd_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    data_axis: str = "data",
+    model_axis: Optional[str] = None,
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+) -> KMeansState:
+    """Full-batch Lloyd on a device mesh (DP, optionally DP×TP).
+
+    ``x`` may be host memory (numpy) or a jax.Array; it is placed with rows
+    sharded over ``data_axis``.  With ``model_axis`` set, centroids shard
+    over k (padded up to a multiple of the axis size).
+    """
+    cfg = (config or KMeansConfig(k=k)).validate()
+    if config is not None and config.k != k:
+        raise ValueError(f"k={k} contradicts config.k={config.k}")
+    if cfg.empty == "farthest":
+        raise NotImplementedError(
+            "empty='farthest' is not supported in the sharded engine yet "
+            "(needs a global top-k across shards); use empty='keep' or the "
+            "single-device fit_lloyd"
+        )
+    if key is None:
+        key = jax.random.key(cfg.seed)
+
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes[data_axis]
+    mp = axis_sizes[model_axis] if model_axis else 1
+
+    x, w_host, n = _pad_rows(x, dp)
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+
+    # --- init (global view; XLA auto-shards the init computation) ---
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, x.shape[1]):
+            raise ValueError(f"init centroids shape {c0.shape} != {(k, x.shape[1])}")
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        c0 = init_centroids(
+            key, x, k, method=method, weights=w,
+            compute_dtype=cfg.compute_dtype,
+        )
+
+    k_pad = (-k) % mp
+    if k_pad:
+        c0 = jnp.concatenate([c0, jnp.zeros((k_pad, x.shape[1]), jnp.float32)])
+    c_spec = P(model_axis) if model_axis else P()
+    c0 = jax.device_put(c0, NamedSharding(mesh, c_spec))
+
+    tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
+    max_it = max_iter if max_iter is not None else cfg.max_iter
+    run = _build_lloyd_run(
+        mesh, data_axis, model_axis, k, cfg.chunk_size, cfg.compute_dtype,
+        cfg.update, max_it,
+    )
+    c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
+    return KMeansState(
+        c[:k], labels[:n], inertia, n_iter, converged, counts[:k]
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
+                     compute_dtype, update, max_it):
+    """Jitted whole-fit program, cached so repeated same-shaped fits reuse
+    the compiled executable (jax.jit caches by function identity)."""
+    if model_axis is None:
+        local = functools.partial(
+            _dp_local_pass,
+            data_axis=data_axis,
+            chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            update=update,
+        )
+        in_specs = (P(data_axis), P(), P(data_axis))
+        out_step = (P(), P(), P())
+        out_final = (P(), P(), P(), P(data_axis))
+    else:
+        local = functools.partial(
+            _tp_local_pass,
+            data_axis=data_axis,
+            model_axis=model_axis,
+            k_real=k_real,
+            chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            update=update,
+        )
+        in_specs = (P(data_axis), P(model_axis), P(data_axis))
+        out_step = (P(model_axis), P(), P(model_axis))
+        out_final = (P(model_axis), P(), P(model_axis), P(data_axis))
+
+    step = jax.shard_map(
+        functools.partial(local, with_labels=False),
+        mesh=mesh, in_specs=in_specs, out_specs=out_step, check_vma=False,
+    )
+    final = jax.shard_map(
+        functools.partial(local, with_labels=True),
+        mesh=mesh, in_specs=in_specs, out_specs=out_final, check_vma=False,
+    )
+
+    @jax.jit
+    def run(x, w, c0, tol_v):
+        def cond(s):
+            c, it, shift_sq, done = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            c, it, _, _ = s
+            new_c, _, _ = step(x, c, w)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v)
+
+        c, n_iter, _, converged = lax.while_loop(
+            cond, body, (c0, jnp.zeros((), jnp.int32),
+                         jnp.asarray(jnp.inf, jnp.float32),
+                         jnp.zeros((), bool)),
+        )
+        _, inertia, counts, labels = final(x, c, w)
+        return c, labels, inertia, n_iter, converged, counts
+
+    return run
+
+
+def sharded_assign(
+    x,
+    centroids,
+    *,
+    mesh: Mesh,
+    data_axis: str = "data",
+    chunk_size: int = 4096,
+    compute_dtype=None,
+):
+    """Labels + min-squared-distances for sharded points, replicated centroids."""
+    x, w_host, n = _pad_rows(x, dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis])
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+
+    def local(x_loc, c):
+        labels, mind, _, _, _ = lloyd_pass(
+            x_loc, c, chunk_size=chunk_size, compute_dtype=compute_dtype,
+            with_update=False,
+        )
+        return labels, mind
+
+    f = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P()),
+        out_specs=(P(data_axis), P(data_axis)),
+        check_vma=False,
+    )
+    labels, mind = jax.jit(f)(x, jnp.asarray(centroids, jnp.float32))
+    return labels[:n], mind[:n]
+
+
+def fit_minibatch_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    data_axis: str = "data",
+    batch_size: Optional[int] = None,
+    steps: Optional[int] = None,
+) -> KMeansState:
+    """Sharded minibatch k-means (BASELINE config 5).
+
+    Points live sharded over ``data_axis``; each step draws a global batch by
+    index (XLA turns the gather into collective traffic), runs the batch
+    update with replicated centroids, and the final labeling pass reuses the
+    sharded assign.  The per-step compute is small next to the gather, so
+    this path leans on GSPMD rather than hand-written collectives.
+    """
+    from kmeans_tpu.models.minibatch import _minibatch_loop
+
+    cfg = (config or KMeansConfig(k=k)).validate()
+    if config is not None and config.k != k:
+        raise ValueError(f"k={k} contradicts config.k={config.k}")
+    if key is None:
+        key = jax.random.key(cfg.seed)
+    ikey, lkey = jax.random.split(key)
+
+    # Rows are padded up to the data-axis size (device_put requires even
+    # shards); n_valid below keeps padding out of the batch sampling.
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    x, w_host, n = _pad_rows(x, axis_sizes[data_axis])
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, x.shape[1]):
+            raise ValueError(f"init centroids shape {c0.shape} != {(k, x.shape[1])}")
+    else:
+        # Mirror fit_minibatch: seed on a subsample so init doesn't cost the
+        # full-data passes minibatch exists to avoid.  Sampling only real
+        # rows (< n) also keeps shard padding out of the seed set.
+        method = init if isinstance(init, str) else cfg.init
+        sub = min(n, max(4 * k * 16, 65536))
+        skey, ikey2 = jax.random.split(ikey)
+        if sub < n:
+            sidx = jax.random.choice(skey, n, shape=(sub,), replace=False)
+            xs = x[sidx]
+        else:
+            xs = x[:n]
+        c0 = init_centroids(
+            ikey2, xs, k, method=method, compute_dtype=cfg.compute_dtype
+        )
+
+    state = _minibatch_loop(
+        x, c0, lkey,
+        batch_size=batch_size if batch_size is not None else cfg.batch_size,
+        steps=steps if steps is not None else cfg.steps,
+        chunk_size=cfg.chunk_size,
+        compute_dtype=cfg.compute_dtype,
+        n_valid=n,
+        with_final=False,
+    )
+    labels, mind = sharded_assign(
+        x, state.centroids, mesh=mesh, data_axis=data_axis,
+        chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
+    )
+    labels, mind = labels[:n], mind[:n]
+    inertia = jnp.sum(mind)
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), labels, k)
+    return KMeansState(
+        state.centroids, labels, inertia, state.n_iter, state.converged, counts
+    )

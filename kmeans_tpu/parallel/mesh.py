@@ -1,0 +1,59 @@
+"""Device-mesh construction.
+
+The reference's "distributed layer" is WebRTC peer replication of a CRDT
+document (/root/reference/app.mjs:35-121) — it parallelizes human
+collaborators, not compute (SURVEY.md §2.6).  The TPU-native equivalent is a
+``jax.sharding.Mesh`` over the ICI fabric: points shard along the ``data``
+axis (DP — the north-star layout), centroids optionally shard over k along
+the ``model`` axis (TP) when k·d is too large per chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from kmeans_tpu.config import MeshConfig
+
+__all__ = ["make_mesh", "cpu_mesh", "mesh_from_config"]
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Tuple[str, ...] = ("data", "model"),
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    platform: Optional[str] = None,
+) -> jax.sharding.Mesh:
+    """Build a mesh over ``devices`` (default: all devices of ``platform``).
+
+    With no ``shape``, all devices land on the first axis (pure DP).
+    """
+    if devices is None:
+        devices = jax.devices(platform) if platform else jax.devices()
+    devices = list(devices)
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n_needed = int(np.prod(shape))
+    if n_needed > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n_needed} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:n_needed]).reshape(shape)
+    return jax.sharding.Mesh(arr, axis_names)
+
+
+def cpu_mesh(
+    shape: Tuple[int, ...],
+    axis_names: Tuple[str, ...] = ("data", "model"),
+) -> jax.sharding.Mesh:
+    """Mesh over the virtual CPU devices (tests / dry runs; SURVEY.md §4)."""
+    return make_mesh(shape, axis_names, devices=jax.devices("cpu"))
+
+
+def mesh_from_config(cfg: MeshConfig) -> jax.sharding.Mesh:
+    return make_mesh(
+        cfg.shape, cfg.axis_names, platform=cfg.platform
+    )

@@ -1,0 +1,129 @@
+"""Sharded-engine tests on the 8-virtual-CPU-device mesh (SURVEY.md §4).
+
+The key invariant: sharded results match the single-device engine — labels
+exactly (tie-breaks preserved), centroids/inertia to float tolerance — for
+pure DP, DP×TP, and a k that doesn't divide the model axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import fit_lloyd
+from kmeans_tpu.parallel import (
+    cpu_mesh,
+    fit_lloyd_sharded,
+    fit_minibatch_sharded,
+    sharded_assign,
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    x, _, _ = make_blobs(jax.random.key(0), 1000, 16, 5, cluster_std=1.0)
+    c0 = np.asarray(x[:5])
+    return np.asarray(x), c0
+
+
+def _single(problem, **kw):
+    x, c0 = problem
+    return fit_lloyd(jnp.asarray(x), 5, init=jnp.asarray(c0), tol=1e-10,
+                     max_iter=25, **kw)
+
+
+def test_dp_matches_single_device(problem, cpu_devices):
+    x, c0 = problem
+    want = _single(problem)
+    mesh = cpu_mesh((8, 1))
+    got = fit_lloyd_sharded(x, 5, mesh=mesh, init=c0, tol=1e-10, max_iter=25)
+    np.testing.assert_array_equal(np.asarray(got.labels), np.asarray(want.labels))
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia), rtol=1e-4)
+    assert int(got.n_iter) == int(want.n_iter)
+
+
+def test_dp_tp_matches_single_device(problem, cpu_devices):
+    x, c0 = problem
+    want = _single(problem)
+    mesh = cpu_mesh((4, 2))
+    got = fit_lloyd_sharded(
+        x, 5, mesh=mesh, init=c0, tol=1e-10, max_iter=25, model_axis="model"
+    )
+    # k=5 does not divide model=2: exercises centroid padding.
+    np.testing.assert_array_equal(np.asarray(got.labels), np.asarray(want.labels))
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia), rtol=1e-4)
+
+
+def test_dp_uneven_rows_are_padded(cpu_devices):
+    # n=1003 is not divisible by 8: padding rows must not affect results.
+    x, _, _ = make_blobs(jax.random.key(1), 1003, 8, 4, cluster_std=0.5)
+    x = np.asarray(x)
+    c0 = x[:4].copy()
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0), tol=1e-10, max_iter=20)
+    mesh = cpu_mesh((8, 1))
+    got = fit_lloyd_sharded(x, 4, mesh=mesh, init=c0, tol=1e-10, max_iter=20)
+    assert got.labels.shape == (1003,)
+    np.testing.assert_array_equal(np.asarray(got.labels), np.asarray(want.labels))
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_sharded_assign_matches_oracle(cpu_devices, rng):
+    import oracles
+
+    x = rng.normal(size=(203, 6)).astype(np.float32)
+    c = rng.normal(size=(7, 6)).astype(np.float32)
+    mesh = cpu_mesh((8, 1))
+    labels, mind = sharded_assign(x, c, mesh=mesh)
+    want_labels, want_mind = oracles.assign(x, c)
+    np.testing.assert_array_equal(np.asarray(labels), want_labels)
+    np.testing.assert_allclose(np.asarray(mind), want_mind, rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_kmeans_plus_plus_runs_on_mesh(cpu_devices):
+    # init computed on globally-sharded x under jit auto-sharding
+    x, _, _ = make_blobs(jax.random.key(2), 512, 8, 6, cluster_std=0.3)
+    mesh = cpu_mesh((8, 1))
+    state = fit_lloyd_sharded(np.asarray(x), 6, mesh=mesh, max_iter=30)
+    assert state.centroids.shape == (6, 8)
+    assert bool(jnp.all(state.counts > 0))
+
+
+def test_sharded_minibatch_runs_and_labels_consistently(cpu_devices):
+    x, _, _ = make_blobs(jax.random.key(3), 2005, 12, 6, cluster_std=0.4)
+    x = np.asarray(x)
+    mesh = cpu_mesh((8, 1))
+    state = fit_minibatch_sharded(
+        x, 6, mesh=mesh, batch_size=256, steps=40,
+    )
+    assert state.labels.shape == (2005,)
+    # labels must be the argmin assignment of the returned centroids
+    import oracles
+
+    want_labels, want_mind = oracles.assign(x, np.asarray(state.centroids))
+    np.testing.assert_array_equal(np.asarray(state.labels), want_labels)
+    np.testing.assert_allclose(
+        float(state.inertia), float(want_mind.sum()), rtol=1e-4
+    )
+
+
+def test_mesh_shape_independence_dp_2_vs_8(problem, cpu_devices):
+    x, c0 = problem
+    got2 = fit_lloyd_sharded(
+        x, 5, mesh=cpu_mesh((2, 1)), init=c0, tol=1e-10, max_iter=25
+    )
+    got8 = fit_lloyd_sharded(
+        x, 5, mesh=cpu_mesh((8, 1)), init=c0, tol=1e-10, max_iter=25
+    )
+    np.testing.assert_array_equal(np.asarray(got2.labels), np.asarray(got8.labels))
+    np.testing.assert_allclose(
+        np.asarray(got2.centroids), np.asarray(got8.centroids), rtol=1e-4, atol=1e-4
+    )

@@ -1,0 +1,144 @@
+"""CLI entry points: train / serve / bench (SURVEY.md §7 stage 4).
+
+The minimum end-to-end slice (SURVEY.md §7): ``train --config blobs2d --out
+room.json`` runs Lloyd on TPU and writes reference-schema JSON that the
+browser front-end (ours, or the untouched reference app) can Import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _cmd_train(args) -> int:
+    import jax
+    import numpy as np
+
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.data import bench_config, make_blobs
+    from kmeans_tpu.models import fit_lloyd, fit_minibatch
+    from kmeans_tpu.session import dataset_to_document, export_json
+
+    if args.config:
+        cfg = bench_config(args.config)
+        n, d, k = cfg["n"], cfg["d"], cfg["k"]
+        minibatch = cfg["minibatch"] if args.minibatch is None else args.minibatch
+    else:
+        n, d, k = args.n, args.d, args.k
+        minibatch = bool(args.minibatch)
+
+    if args.input:
+        x = np.load(args.input)
+        if x.ndim != 2:
+            print(f"error: {args.input} must be a 2-D array", file=sys.stderr)
+            return 2
+        n, d = x.shape
+    else:
+        x, _, _ = make_blobs(
+            jax.random.key(args.seed), n, d, k, cluster_std=args.cluster_std
+        )
+
+    kcfg = KMeansConfig(
+        k=k, max_iter=args.max_iter, tol=args.tol, seed=args.seed,
+        compute_dtype=args.dtype,
+    )
+
+    t0 = time.perf_counter()
+    if args.mesh and args.mesh > 1:
+        from kmeans_tpu.parallel import fit_lloyd_sharded, fit_minibatch_sharded, make_mesh
+
+        mesh = make_mesh((args.mesh, 1), ("data", "model"))
+        fit = fit_minibatch_sharded if minibatch else fit_lloyd_sharded
+        state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
+    elif minibatch:
+        state = fit_minibatch(x, k, config=kcfg)
+    else:
+        state = fit_lloyd(x, k, config=kcfg)
+    jax_done = time.perf_counter() - t0
+
+    result = {
+        "n": int(n), "d": int(d), "k": int(k),
+        "inertia": float(state.inertia),
+        "n_iter": int(state.n_iter),
+        "converged": bool(state.converged),
+        "wall_s": round(jax_done, 4),
+        "mode": "minibatch" if minibatch else "lloyd",
+    }
+    print(json.dumps(result))
+
+    if args.out:
+        doc = dataset_to_document(
+            np.asarray(x), np.asarray(state.labels),
+            max_cards=args.max_cards,
+            enforce_limit=k <= 3,
+        )
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(export_json(doc))
+        print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    from kmeans_tpu.serve import serve
+
+    print(f"serving on http://{args.host}:{args.port}/ (Ctrl-C to stop)",
+          file=sys.stderr)
+    try:
+        serve(args.host, args.port, background=False)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    import bench
+
+    sys.argv = ["bench.py"] + (["--all"] if args.all else [])
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kmeans_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="fit k-means and optionally export JSON")
+    t.add_argument("--config", choices=[
+        "blobs2d", "mnist", "glove", "cifar10", "imagenet"
+    ], help="named BASELINE config (synthetic data at its shape)")
+    t.add_argument("--input", help="path to a .npy (n, d) feature matrix")
+    t.add_argument("--n", type=int, default=500)
+    t.add_argument("--d", type=int, default=2)
+    t.add_argument("--k", type=int, default=3)
+    t.add_argument("--minibatch", action=argparse.BooleanOptionalAction,
+                   default=None)
+    t.add_argument("--mesh", type=int, default=0,
+                   help="data-parallel mesh size (0/1 = single device)")
+    t.add_argument("--max-iter", type=int, default=100)
+    t.add_argument("--tol", type=float, default=1e-4)
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--dtype", default=None,
+                   choices=[None, "bfloat16", "float32"])
+    t.add_argument("--cluster-std", type=float, default=0.6)
+    t.add_argument("--out", help="write reference-schema export JSON here")
+    t.add_argument("--max-cards", type=int, default=500)
+    t.set_defaults(fn=_cmd_train)
+
+    s = sub.add_parser("serve", help="run the HTTP/SSE visualizer server")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8787)
+    s.set_defaults(fn=_cmd_serve)
+
+    b = sub.add_parser("bench", help="run the benchmark (one JSON line)")
+    b.add_argument("--all", action="store_true")
+    b.set_defaults(fn=_cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

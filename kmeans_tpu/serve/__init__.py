@@ -1,0 +1,5 @@
+"""Serving shim: HTTP/SSE server + browser front-end."""
+
+from kmeans_tpu.serve.server import KMeansServer, serve
+
+__all__ = ["KMeansServer", "serve"]

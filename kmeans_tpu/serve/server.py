@@ -1,0 +1,469 @@
+"""HTTP/SSE serving shim (SURVEY.md §7 stage 4).
+
+Replaces the reference's replication layer — P2PT/WebRTC with tracker
+rendezvous and a 3-verb string protocol ``U:``/``HELLO:``/``ROSTER:``
+(/root/reference/app.mjs:35-121) — with server-authoritative sync from the
+TPU-VM host:
+
+* the CRDT document becomes the server-side :class:`Document` (one per room),
+* ``U:`` broadcast → an SSE ``change`` event; clients refetch ``/api/state``
+  (the analog of the full-state one-shot the reference sends on join,
+  app.mjs:96 — trivially resync-safe, same as SURVEY.md §5.3 notes),
+* ``HELLO:``/``ROSTER:`` → ``POST /api/hello`` heartbeats + a server-pruned
+  roster in the state payload (fixing the never-pruned ``namesSeen`` leak,
+  SURVEY.md §8.4),
+* the status chip's peer count (app.mjs:51-58) becomes the number of other
+  live SSE subscribers in the room.
+
+Deploy-time security headers (_headers:1-21) are emitted on every response,
+adapted to same-origin serving: no remote CDNs or trackers in connect-src.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.parse
+from http import HTTPStatus
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Dict, Optional
+
+from kmeans_tpu.config import MAX_CENTROIDS, ServeConfig
+from kmeans_tpu.session import (
+    CentroidLimitError,
+    Document,
+    auto_assign,
+    dataset_to_document,
+    ensure_jessica_once,
+    export_filename,
+    export_json,
+    hard_reset,
+    import_json,
+    metrics_deltas,
+    populate_test_data,
+    snapshot_metrics,
+    suggestion_from_counts,
+    trait_counts_for,
+)
+from kmeans_tpu.utils.rooms import code4
+
+__all__ = ["KMeansServer", "serve"]
+
+_STATIC = Path(__file__).parent / "static"
+
+#: _headers:1-21 adapted to same-origin serving (no CDNs, no trackers).
+_SECURITY_HEADERS = {
+    "Content-Security-Policy": (
+        "default-src 'none'; script-src 'self'; style-src 'self' "
+        "'unsafe-inline'; img-src 'self' data:; connect-src 'self'; "
+        "base-uri 'none'; form-action 'self'; frame-ancestors 'none'"
+    ),
+    "Referrer-Policy": "no-referrer",
+    "Permissions-Policy": (
+        "camera=(), microphone=(), geolocation=(), payment=()"
+    ),
+    "X-Content-Type-Options": "nosniff",
+    "X-Frame-Options": "DENY",
+    "Cache-Control": "no-store",
+}
+
+_PRESENCE_TTL_S = 30.0
+
+import re as _re
+
+_ROOM_RE = _re.compile(r"[A-Z0-9-]{1,16}")
+_MAX_ROOMS = 256
+
+
+class RoomTableFullError(RuntimeError):
+    pass
+
+
+class _Room:
+    def __init__(self, code: str):
+        self.code = code
+        self.doc = Document(room=code)
+        self.subscribers: Dict[int, queue.Queue] = {}
+        self.presence: Dict[str, float] = {}     # name -> last heartbeat
+        self.last_active = time.time()
+        self._next_sub = 0
+        self._lock = threading.Lock()
+        ensure_jessica_once(self.doc)
+        self.doc.on_change(self._broadcast)
+
+    def touch(self) -> None:
+        self.last_active = time.time()
+
+    # -- presence ----------------------------------------------------------
+    def hello(self, name: str) -> None:
+        if name:
+            with self._lock:
+                self.presence[name] = time.time()
+
+    def roster(self) -> list:
+        now = time.time()
+        with self._lock:
+            stale = [n for n, t in self.presence.items()
+                     if now - t > _PRESENCE_TTL_S]
+            for n in stale:
+                del self.presence[n]
+            return sorted(self.presence)
+
+    # -- SSE ---------------------------------------------------------------
+    def subscribe(self) -> tuple[int, queue.Queue]:
+        with self._lock:
+            sid = self._next_sub
+            self._next_sub += 1
+            q: queue.Queue = queue.Queue(maxsize=64)
+            self.subscribers[sid] = q
+            return sid, q
+
+    def unsubscribe(self, sid: int) -> None:
+        with self._lock:
+            self.subscribers.pop(sid, None)
+
+    def _broadcast(self, doc: Document) -> None:
+        event = {"type": "change", "version": doc.version}
+        with self._lock:
+            for q in self.subscribers.values():
+                try:
+                    q.put_nowait(event)
+                except queue.Full:
+                    pass   # slow client refetches state on next event anyway
+
+    def peer_count(self) -> int:
+        with self._lock:
+            return len(self.subscribers)
+
+    # -- state payload ------------------------------------------------------
+    def state(self) -> dict:
+        doc = self.doc
+        with doc.read_lock():
+            return self._state_locked()
+
+    def _state_locked(self) -> dict:
+        doc = self.doc
+        now_m = snapshot_metrics(doc.cards, doc.centroids)
+        prev = doc.meta.get("prevSnapshot")
+        suggestions = {}
+        for cent in doc.centroids:
+            cs = [c for c in doc.cards if c.get("assignedTo") == cent["id"]]
+            counts = trait_counts_for(cs)
+            top = sorted(
+                counts.values(), key=lambda v: (-v["count"], v["label"])
+            )[:3]
+            suggestions[cent["id"]] = {
+                "top": top,
+                "suggested": suggestion_from_counts(counts),
+            }
+        from kmeans_tpu.session.schema import _js_safe
+
+        return _js_safe({
+            "room": self.code,
+            "version": doc.version,
+            "cards": doc.cards,
+            "centroids": doc.centroids,
+            "meta": doc.meta,
+            "metrics": now_m,
+            "deltas": metrics_deltas(prev, now_m),
+            "suggestions": suggestions,
+            "unassigned": doc.unassigned_count,
+            "presence": self.roster(),
+            "peers": max(0, self.peer_count() - 1),
+            "maxCentroids": MAX_CENTROIDS,
+        })
+
+
+class KMeansServer:
+    """All rooms + the HTTP server object."""
+
+    def __init__(self, config: Optional[ServeConfig] = None):
+        self.config = config or ServeConfig()
+        self.rooms: Dict[str, _Room] = {}
+        self._lock = threading.Lock()
+        self.httpd: Optional[ThreadingHTTPServer] = None
+
+    def room(self, code: Optional[str]) -> _Room:
+        # Restrict to the reference's room-code alphabet shape (app.mjs:19):
+        # alnum/dash, <=16 chars — keeps arbitrary strings out of the
+        # Content-Disposition filename and the room table.
+        code = (code or "").strip().upper()
+        if not _ROOM_RE.fullmatch(code or ""):
+            code = code4() if not code else "".join(
+                ch for ch in code if ch.isalnum() or ch == "-"
+            )[:16] or code4()
+        with self._lock:
+            room = self.rooms.get(code)
+            if room is None:
+                # Bounded room table: evict the longest-idle subscriber-free
+                # room (the reference's namesSeen grows forever, SURVEY.md
+                # §8.4 — we don't repeat that one level up).
+                if len(self.rooms) >= _MAX_ROOMS:
+                    idle = [r for r in self.rooms.values()
+                            if r.peer_count() == 0]
+                    if not idle:
+                        raise RoomTableFullError(
+                            f"room table full ({_MAX_ROOMS} active rooms)"
+                        )
+                    victim = min(idle, key=lambda r: r.last_active)
+                    del self.rooms[victim.code]
+                room = self.rooms[code] = _Room(code)
+            room.touch()
+            return room
+
+    # ------------------------------------------------------------- mutate
+    def apply(self, room: _Room, op: str, args: dict) -> dict:
+        """Apply one mutation op; returns a small result payload.
+
+        Ops mirror the reference's controls (app.mjs:239-288) plus the
+        TPU-native ``autoAssign``/``train``.
+        """
+        doc = room.doc
+        if op == "addCard":
+            title = str(args.get("title", "")).strip()
+            if not title:
+                raise ValueError("title required")     # app.mjs:251 guard
+            card = doc.add_card(
+                title,
+                (str(args.get("traitA", "")).strip(),
+                 str(args.get("traitB", "")).strip()),
+                created_by=str(args.get("by", "anon")) or "anon",
+            )
+            return {"id": card["id"]}
+        if op == "addCentroid":
+            cent = doc.add_centroid(str(args.get("name", "")).strip())
+            return {"id": cent["id"]}
+        if op == "removeCentroid":
+            doc.remove_centroid(args["id"])
+            return {}
+        if op == "renameCentroid":
+            doc.rename_centroid(args["id"], str(args.get("name", "")))
+            return {}
+        if op == "setLocked":
+            doc.set_locked(args["id"], bool(args.get("locked")))
+            return {}
+        if op == "assign":
+            pos = args.get("pos")
+            ok = doc.assign_card(
+                args["id"], args.get("centroid"),
+                pos=(pos["x"], pos["y"]) if pos else None,
+            )
+            return {"ok": ok}
+        if op == "setPos":
+            doc.set_card_pos(args["id"], args["x"], args["y"])
+            return {}
+        if op == "deleteCard":
+            doc.delete_card(args["id"])
+            return {}
+        if op == "shuffleUnassigned":
+            doc.shuffle_unassigned()
+            return {}
+        if op == "restartAll":
+            doc.restart_all()
+            return {}
+        if op == "setMode":
+            doc.set_mode(str(args.get("mode", "learn")))
+            return {}
+        if op == "setIteration":
+            doc.set_iteration(int(args.get("iteration", 0)))
+            return {}
+        if op == "populate":
+            return {"added": populate_test_data(doc)}
+        if op == "hardReset":
+            hard_reset(doc, args.get("mode"))
+            return {}
+        if op == "hello":
+            room.hello(str(args.get("name", "")).strip())
+            return {"roster": room.roster()}
+        if op == "autoAssign":
+            from kmeans_tpu.session.schema import _js_safe
+
+            snap = auto_assign(doc, seed=int(args.get("seed", 0)),
+                               features=str(args.get("features", "traits")))
+            return {"metrics": _js_safe(snap)}
+        raise ValueError(f"unknown op {op!r}")
+
+    # -------------------------------------------------------------- serve
+    def make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):   # quiet by default
+                pass
+
+            # -- plumbing --------------------------------------------------
+            def _headers_for(self, ctype, extra=None, length=None):
+                self.send_response(HTTPStatus.OK)
+                self.send_header("Content-Type", ctype)
+                for k, v in _SECURITY_HEADERS.items():
+                    self.send_header(k, v)
+                if extra:
+                    for k, v in extra.items():
+                        self.send_header(k, v)
+                if length is not None:
+                    self.send_header("Content-Length", str(length))
+                self.end_headers()
+
+            def _json(self, obj, status=HTTPStatus.OK):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                for k, v in _SECURITY_HEADERS.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, msg, status=HTTPStatus.BAD_REQUEST):
+                self._json({"error": str(msg)}, status=status)
+
+            def _query(self):
+                return dict(urllib.parse.parse_qsl(
+                    urllib.parse.urlparse(self.path).query
+                ))
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                if not raw:
+                    return {}
+                return json.loads(raw)
+
+            # -- GET -------------------------------------------------------
+            def do_GET(self):
+                path = urllib.parse.urlparse(self.path).path
+                q = self._query()
+                try:
+                    return self._do_get(path, q)
+                except RoomTableFullError as e:
+                    return self._error(e, HTTPStatus.SERVICE_UNAVAILABLE)
+
+            def _do_get(self, path, q):
+                if path in ("/", "/index.html"):
+                    return self._static("index.html", "text/html; charset=utf-8")
+                if path == "/app.js":
+                    return self._static(
+                        "app.js", "application/javascript; charset=utf-8"
+                    )
+                if path == "/api/state":
+                    room = server.room(q.get("room"))
+                    return self._json(room.state())
+                if path == "/api/export":
+                    room = server.room(q.get("room"))
+                    with room.doc.read_lock():
+                        body = export_json(room.doc).encode()
+                    self._headers_for(
+                        "application/json",
+                        extra={
+                            "Content-Disposition":
+                                "attachment; filename="
+                                f"\"{export_filename(room.code)}\"",
+                        },
+                        length=len(body),
+                    )
+                    self.wfile.write(body)
+                    return
+                if path == "/api/events":
+                    return self._sse(server.room(q.get("room")))
+                if path == "/healthz":
+                    return self._json({"ok": True, "rooms": len(server.rooms)})
+                self._error("not found", HTTPStatus.NOT_FOUND)
+
+            def _static(self, name, ctype):
+                p = _STATIC / name
+                if not p.exists():
+                    return self._error("missing static", HTTPStatus.NOT_FOUND)
+                body = p.read_bytes()
+                self._headers_for(ctype, length=len(body))
+                self.wfile.write(body)
+
+            def _sse(self, room):
+                sid, q = room.subscribe()
+                try:
+                    self.send_response(HTTPStatus.OK)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-store")
+                    for k, v in _SECURITY_HEADERS.items():
+                        if k not in ("Cache-Control", "Content-Security-Policy"):
+                            self.send_header(k, v)
+                    self.end_headers()
+                    hello = {"type": "hello", "version": room.doc.version,
+                             "peers": max(0, room.peer_count() - 1)}
+                    self.wfile.write(
+                        f"data: {json.dumps(hello)}\n\n".encode()
+                    )
+                    self.wfile.flush()
+                    while True:
+                        try:
+                            ev = q.get(timeout=15.0)
+                        except queue.Empty:
+                            ev = {"type": "ping",
+                                  "peers": max(0, room.peer_count() - 1)}
+                        self.wfile.write(
+                            f"data: {json.dumps(ev)}\n\n".encode()
+                        )
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    room.unsubscribe(sid)
+
+            # -- POST ------------------------------------------------------
+            def do_POST(self):
+                path = urllib.parse.urlparse(self.path).path
+                q = self._query()
+                try:
+                    if path == "/api/mutate":
+                        room = server.room(q.get("room"))
+                        body = self._body()
+                        result = server.apply(
+                            room, str(body.get("op", "")), body.get("args") or {}
+                        )
+                        return self._json({"ok": True, **result})
+                    if path == "/api/hello":
+                        room = server.room(q.get("room"))
+                        room.hello(str(self._body().get("name", "")).strip())
+                        return self._json({"roster": room.roster()})
+                    if path == "/api/import":
+                        room = server.room(q.get("room"))
+                        import_json(room.doc, self.rfile.read(
+                            int(self.headers.get("Content-Length") or 0)
+                        ))
+                        return self._json({"ok": True})
+                    self._error("not found", HTTPStatus.NOT_FOUND)
+                except CentroidLimitError as e:
+                    self._error(str(e), HTTPStatus.CONFLICT)
+                except RoomTableFullError as e:
+                    self._error(e, HTTPStatus.SERVICE_UNAVAILABLE)
+                except (KeyError, ValueError, TypeError) as e:
+                    self._error(e)
+
+        return Handler
+
+    def start(self, *, background: bool = True) -> ThreadingHTTPServer:
+        self.httpd = ThreadingHTTPServer(
+            (self.config.host, self.config.port), self.make_handler()
+        )
+        if background:
+            t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+            t.start()
+        else:
+            self.httpd.serve_forever()
+        return self.httpd
+
+    def stop(self):
+        if self.httpd:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+
+
+def serve(host: str = "127.0.0.1", port: int = 8787, *,
+          background: bool = False) -> KMeansServer:
+    s = KMeansServer(ServeConfig(host=host, port=port))
+    s.start(background=background)
+    return s

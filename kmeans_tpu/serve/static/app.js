@@ -1,0 +1,395 @@
+/* Front-end for the TPU k-means serving shim.
+ *
+ * A from-scratch implementation of the reference UI's behaviors
+ * (schusto/k-means-demo): room codes, presence chips, centroid zones with
+ * lock/remove/rename, drag & drop with grab-offset + clamped normalized
+ * positions, per-card assignment select, the metrics dashboard with
+ * per-iteration deltas and auto-naming suggestions, export/import/reset.
+ * State sync is server-authoritative over SSE instead of the reference's
+ * WebRTC CRDT gossip; every mutation is a POST /api/mutate op.
+ */
+"use strict";
+
+const $id = (id) => document.getElementById(id);
+
+// ---------- room ----------
+const url = new URL(location.href);
+let room = (url.searchParams.get("room") || "").toUpperCase();
+if (!room) {
+  const cs = "ABCDEFGHJKLMNPQRSTUVWXYZ23456789";
+  room = Array.from({ length: 4 }, () => cs[Math.floor(Math.random() * cs.length)]).join("");
+  url.searchParams.set("room", room);
+  history.replaceState(null, "", url.toString());
+}
+$id("room").textContent = `Room: ${room}`;
+
+// ---------- presence ----------
+const LS_NAME = "icekmeans:name";
+let myName = localStorage.getItem(LS_NAME) || `Guest ${room}`;
+$id("name").value = myName;
+const initials = (n) => {
+  const out = (n || "??").trim().split(/\s+/).slice(0, 2)
+    .map((s) => (s[0] || "").toUpperCase()).join("");
+  return out || "??";
+};
+
+// ---------- server API ----------
+const api = (path) => `${path}?room=${encodeURIComponent(room)}`;
+let state = null;
+let peers = 0;
+
+async function fetchState() {
+  const r = await fetch(api("/api/state"));
+  state = await r.json();
+  renderAll();
+}
+async function mutate(op, args = {}) {
+  const r = await fetch(api("/api/mutate"), {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify({ op, args }),
+  });
+  const out = await r.json();
+  if (!r.ok) { alert(out.error || "Request failed"); return null; }
+  await fetchState();
+  return out;
+}
+async function hello() {
+  await fetch(api("/api/hello"), {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify({ name: myName }),
+  });
+}
+
+function connectEvents() {
+  const es = new EventSource(api("/api/events"));
+  es.onmessage = (ev) => {
+    const msg = JSON.parse(ev.data);
+    if (typeof msg.peers === "number") { peers = msg.peers; setStatusChip(); }
+    if (msg.type === "change" && (!state || msg.version !== state.version)) fetchState();
+  };
+  es.onerror = () => { setStatusChip(true); };
+  return es;
+}
+
+// ---------- status / presence ----------
+function setStatusChip(err) {
+  const s = $id("status");
+  s.textContent = err ? "reconnecting…" : `Peers: ${peers} | Server: 1/1`;
+  s.classList.toggle("ok", !err && peers > 0);
+  s.classList.toggle("warn", !!err || peers === 0);
+}
+function renderPresence() {
+  const box = $id("presence");
+  box.innerHTML = "";
+  const names = [myName, ...(state?.presence || []).filter((n) => n !== myName)];
+  for (const n of names.slice(0, 6)) {
+    const a = document.createElement("span");
+    a.className = "avatar";
+    a.title = n;
+    a.textContent = initials(n);
+    box.appendChild(a);
+  }
+}
+
+// ---------- rendering ----------
+const dragCtx = { id: null, dx: 0, dy: 0 };
+
+function renderAll() {
+  if (!state) return;
+  setStatusChip();
+  renderPresence();
+  renderCanvas();
+  renderUnassigned();
+  renderKMeans();
+  syncMeta();
+}
+function syncMeta() {
+  const m = state.meta || {};
+  if (m.mode) $id("mode").value = m.mode;
+  if (typeof m.iteration === "number") $id("iter").value = String(m.iteration);
+}
+
+function computeMinHeightPx(n) { return Math.max(260, 64 + n * (110 + 10)); }
+
+function cardEl(card) {
+  const el = document.createElement("div");
+  el.className = "card";
+  el.draggable = true;
+  const t = document.createElement("div");
+  t.className = "t"; t.textContent = card.title;
+  const tr = document.createElement("div");
+  tr.className = "traits";
+  tr.textContent = `${card.traits?.[0] || ""} • ${card.traits?.[1] || ""}`;
+  const row = document.createElement("div");
+  row.className = "row";
+  const sel = document.createElement("select");
+  const optU = document.createElement("option");
+  optU.value = ""; optU.textContent = "Unassigned";
+  sel.appendChild(optU);
+  for (const c of state.centroids) {
+    const o = document.createElement("option");
+    o.value = c.id; o.textContent = c.name;
+    sel.appendChild(o);
+  }
+  sel.value = card.assignedTo || "";
+  sel.addEventListener("change", () =>
+    mutate("assign", { id: card.id, centroid: sel.value || null }));
+  const del = document.createElement("button");
+  del.className = "btn danger"; del.textContent = "Delete";
+  del.addEventListener("click", () => {
+    if (confirm(`Delete "${card.title}"?`)) mutate("deleteCard", { id: card.id });
+  });
+  row.append(sel, del);
+  el.append(t, tr, row);
+  el.addEventListener("dragstart", (ev) => {
+    dragCtx.id = card.id;
+    const r = el.getBoundingClientRect();
+    dragCtx.dx = ev.clientX - r.left;
+    dragCtx.dy = ev.clientY - r.top;
+    ev.dataTransfer.setData("text/plain", card.id);
+  });
+  return el;
+}
+
+function renderCanvas() {
+  const root = $id("canvas");
+  root.innerHTML = "";
+  if (!state.centroids.length) {
+    const hint = document.createElement("div");
+    hint.className = "empty-hint";
+    hint.textContent = "Add a centroid to start clustering (max 3).";
+    root.appendChild(hint);
+    return;
+  }
+  for (const cent of state.centroids) {
+    const zone = document.createElement("div");
+    zone.className = "centroid";
+    const assigned = state.cards.filter((c) => c.assignedTo === cent.id);
+    zone.style.minHeight = computeMinHeightPx(assigned.length) + "px";
+
+    const head = document.createElement("div");
+    head.className = "zhead";
+    const sw = document.createElement("span");
+    sw.className = "swatch"; sw.style.background = cent.color;
+    const name = document.createElement("input");
+    name.className = "zname"; name.value = cent.name;
+    name.addEventListener("change", () =>
+      mutate("renameCentroid", { id: cent.id, name: name.value }));
+    const lock = document.createElement("button");
+    lock.className = "btn ghost";
+    lock.textContent = cent.locked ? "Unlock" : "Lock";
+    lock.addEventListener("click", () =>
+      mutate("setLocked", { id: cent.id, locked: !cent.locked }));
+    const rm = document.createElement("button");
+    rm.className = "btn danger"; rm.textContent = "✕";
+    rm.addEventListener("click", () => {
+      if (confirm(`Remove centroid "${cent.name}"?`))
+        mutate("removeCentroid", { id: cent.id });
+    });
+    head.append(sw, name, lock, rm);
+    zone.appendChild(head);
+
+    zone.addEventListener("dragover", (ev) => {
+      ev.preventDefault();
+      zone.classList.add("drop-target");
+    });
+    zone.addEventListener("dragleave", () => zone.classList.remove("drop-target"));
+    zone.addEventListener("drop", (ev) => {
+      ev.preventDefault();
+      zone.classList.remove("drop-target");
+      if (cent.locked || !dragCtx.id) return;
+      const r = zone.getBoundingClientRect();
+      let x = (ev.clientX - dragCtx.dx - r.left) / r.width;
+      let y = (ev.clientY - dragCtx.dy - r.top) / r.height;
+      x = Math.min(Math.max(x, 0.02), 0.92);
+      y = Math.min(Math.max(y, 0.10), 0.92);
+      mutate("assign", { id: dragCtx.id, centroid: cent.id, pos: { x, y } });
+    });
+
+    for (const card of assigned) {
+      const el = cardEl(card);
+      const pos = state.meta[`pos:${card.id}`];
+      if (pos) {
+        el.classList.add("float");
+        el.style.left = (pos.x * 100) + "%";
+        el.style.top = (pos.y * 100) + "%";
+      }
+      zone.appendChild(el);
+    }
+    root.appendChild(zone);
+  }
+}
+
+function renderUnassigned() {
+  const root = $id("unassigned");
+  root.innerHTML = "";
+  for (const card of state.cards.filter((c) => !c.assignedTo)) {
+    root.appendChild(cardEl(card));
+  }
+  if (!root.dataset.dropWired) {        // wire once (reference bug §8.2 fixed)
+    root.dataset.dropWired = "1";
+    root.addEventListener("dragover", (ev) => {
+      ev.preventDefault(); root.classList.add("drop-target");
+    });
+    root.addEventListener("dragleave", () => root.classList.remove("drop-target"));
+    root.addEventListener("drop", (ev) => {
+      ev.preventDefault();
+      root.classList.remove("drop-target");
+      if (dragCtx.id) mutate("assign", { id: dragCtx.id, centroid: null });
+    });
+  }
+}
+
+function chip(text, tip) {
+  const el = document.createElement("span");
+  el.className = "chip"; el.textContent = text;
+  if (tip) el.title = tip;
+  return el;
+}
+function deltaSpan(text, good) {
+  const el = document.createElement("span");
+  el.className = "delta" + (good ? "" : " bad");
+  el.textContent = text;
+  return el;
+}
+
+function renderKMeans() {
+  const root = $id("kmeans");
+  root.innerHTML = "";
+  const m = state.metrics, d = state.deltas;
+  const bar = document.createElement("div");
+  bar.className = "km-metrics";
+  bar.append(
+    chip(`k = ${state.centroids.length}`,
+      "k = number of clusters (centroids). Pick it before you start."),
+    chip(`balance gap = ${m.balance.gap}`,
+      "Largest cluster size minus smallest. Closer to 0 is more balanced."),
+    chip(`avg cohesion = ${Math.trunc(m.avgCohesion * 100)}%`,
+      "Share of cards that share ≥1 trait with another card in the same cluster."),
+    chip(`unassigned = ${state.unassigned}`,
+      "Cards not yet assigned. Many unassigned may indicate outliers.")
+  );
+  if (d) {
+    bar.append(deltaSpan(
+      d.tighter ? ` (↑ tighter ${Math.abs(d.gap)})` : ` (↓ looser ${d.gap})`,
+      d.tighter));
+    const pp = d.avgCohesion_pp;
+    bar.append(deltaSpan(pp === 0 ? " (±0)" : (pp > 0 ? ` (+${pp}pp)` : ` (${pp}pp)`),
+      pp >= 0));
+  }
+  root.appendChild(bar);
+
+  const total = state.cards.length || 1;
+  for (const cent of state.centroids) {
+    const row = document.createElement("div");
+    row.className = "kmrow";
+    const count = m.counts[cent.id] || 0;
+    row.append(chip(`${cent.name}: ${count}`));
+    const barEl = document.createElement("div");
+    barEl.className = "bar";
+    const fill = document.createElement("div");
+    fill.className = "fill";
+    fill.style.width = Math.round((count / total) * 100) + "%";
+    fill.style.background = cent.color;
+    barEl.appendChild(fill);
+    row.append(barEl);
+    const coh = Math.round((m.cohesion[cent.id] || 0) * 100);
+    row.append(chip(`cohesion ${coh}%`));
+    if (d && d.per_centroid[cent.id]?.cohesion_pp != null) {
+      const pp = d.per_centroid[cent.id].cohesion_pp;
+      row.append(deltaSpan(pp === 0 ? "(±0)" : (pp > 0 ? `(+${pp}pp)` : `(${pp}pp)`),
+        pp >= 0));
+    }
+    const sug = state.suggestions[cent.id];
+    if (sug?.top?.length) {
+      const t = document.createElement("span");
+      t.className = "traits-inline";
+      t.textContent = "Top: " + sug.top.map((x) => `${x.label} (${x.count})`).join(", ");
+      row.append(t);
+    }
+    if (sug?.suggested) {
+      const s = document.createElement("span");
+      s.className = "suggest-inline";
+      s.textContent = `Suggested: ${sug.suggested}`;
+      const use = document.createElement("button");
+      use.className = "btn ghost"; use.textContent = "Use";
+      use.addEventListener("click", () =>
+        mutate("renameCentroid", { id: cent.id, name: sug.suggested }));
+      row.append(s, use);
+    }
+    root.appendChild(row);
+  }
+}
+
+// ---------- controls ----------
+$id("copy").addEventListener("click", async () => {
+  try {
+    await navigator.clipboard.writeText(location.href);
+    const b = $id("copy");
+    b.textContent = "Copied!";
+    setTimeout(() => { b.textContent = "Copy link"; }, 1200);
+  } catch { alert("Copy failed. Use the address bar."); }
+});
+$id("populate").addEventListener("click", () => mutate("populate"));
+$id("addCentroid").addEventListener("click", () => {
+  const i = $id("centroidName");
+  mutate("addCentroid", { name: i.value.trim() });
+  i.value = "";
+});
+$id("addCard").addEventListener("click", () => {
+  const t = $id("flavorTitle"), a = $id("traitA"), b = $id("traitB");
+  if (!t.value.trim()) return;
+  mutate("addCard", {
+    title: t.value.trim(), traitA: a.value.trim(), traitB: b.value.trim(),
+    by: myName || "anon",
+  });
+  t.value = a.value = b.value = "";
+});
+$id("coin").addEventListener("click", () =>
+  alert(Math.random() < 0.5 ? "Heads" : "Tails"));
+$id("d12").addEventListener("click", () =>
+  alert(`d12 → ${1 + Math.floor(Math.random() * 12)}`));
+$id("shuffle").addEventListener("click", () => {
+  const names = state.cards.map((c) => c.title);
+  for (let i = names.length - 1; i > 0; i--) {
+    const j = Math.floor(Math.random() * (i + 1));
+    [names[i], names[j]] = [names[j], names[i]];
+  }
+  alert("Suggested order:\n\n" + names.join("\n"));
+});
+$id("shuffleUnassigned").addEventListener("click", () => mutate("shuffleUnassigned"));
+$id("restartAll").addEventListener("click", () => mutate("restartAll"));
+$id("tpuAssign").addEventListener("click", () => mutate("autoAssign"));
+$id("saveName").addEventListener("click", () => {
+  myName = $id("name").value.trim() || myName;
+  localStorage.setItem(LS_NAME, myName);
+  hello().then(fetchState);
+});
+$id("mode").addEventListener("change", () =>
+  mutate("setMode", { mode: $id("mode").value }));
+$id("iter").addEventListener("change", () =>
+  mutate("setIteration", { iteration: parseInt($id("iter").value || "0") || 0 }));
+$id("export").addEventListener("click", () => {
+  location.href = api("/api/export");
+});
+$id("import").addEventListener("change", async (ev) => {
+  const f = ev.target.files?.[0];
+  if (!f) return;
+  try {
+    const r = await fetch(api("/api/import"), { method: "POST", body: await f.text() });
+    if (!r.ok) throw new Error((await r.json()).error);
+    await fetchState();
+  } catch (e) { alert("Import failed: " + e.message); }
+  finally { ev.target.value = ""; }
+});
+$id("reset").addEventListener("click", () => {
+  if (confirm("Reset board and re-seed Jessica?"))
+    mutate("hardReset", { mode: $id("mode").value });
+});
+
+// ---------- boot ----------
+hello().then(fetchState);
+connectEvents();
+setInterval(hello, 10_000);

@@ -1,0 +1,174 @@
+"""Bridge between the numeric engine and the session document.
+
+This is what makes the TPU loop drive the reference's visualizer (the north
+star: "index.html and its Canvas renderer remain the visualizer front-end"):
+
+* ``dataset_to_document`` — turn a fitted :class:`KMeansState` over 2-D data
+  into a session document whose cards sit at their data coordinates
+  (normalized into the reference's drop-clamp box, app.mjs:366-367) and are
+  assigned to colored, named centroid zones — export it and the untouched
+  reference front-end can Import it (app.mjs:268-282).
+* ``cards_to_features`` — featurize cards for the numeric engine: binary
+  bag-of-trait-tokens vectors using the reference's own tokenizer
+  (:func:`kmeans_tpu.session.metrics.tokens_for_card`), so the TPU can run
+  the assignment step the humans perform manually.
+* ``auto_assign`` — one TPU Lloyd fit over the document's cards, writing
+  assignments back through the normal mutators (locked zones are respected:
+  their cards are kept, everyone else is re-assigned).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kmeans_tpu.config import MAX_CENTROIDS, POS_CLAMP_X, POS_CLAMP_Y
+from kmeans_tpu.session.document import Document
+from kmeans_tpu.session.metrics import tokens_for_card
+
+__all__ = [
+    "dataset_to_document",
+    "cards_to_features",
+    "auto_assign",
+]
+
+
+def _normalize_positions(x2: np.ndarray) -> np.ndarray:
+    """Map 2-D points into the reference's position box
+    ([0.02, 0.92] × [0.10, 0.92], app.mjs:366-367)."""
+    lo = x2.min(axis=0)
+    hi = x2.max(axis=0)
+    span = np.where(hi - lo > 0, hi - lo, 1.0)
+    unit = (x2 - lo) / span
+    out = np.empty_like(unit)
+    out[:, 0] = POS_CLAMP_X[0] + unit[:, 0] * (POS_CLAMP_X[1] - POS_CLAMP_X[0])
+    out[:, 1] = POS_CLAMP_Y[0] + unit[:, 1] * (POS_CLAMP_Y[1] - POS_CLAMP_Y[0])
+    return out
+
+
+def dataset_to_document(
+    x,
+    labels,
+    *,
+    room: str = "TPU0",
+    names: Optional[Sequence[str]] = None,
+    max_cards: int = 500,
+    enforce_limit: bool = True,
+) -> Document:
+    """Build a session document from a fitted clustering over 2-D data.
+
+    Only the first two feature dimensions are used for board positions.
+    ``max_cards`` caps the rendered cards (the browser board is built for
+    dozens, not millions).  With ``enforce_limit`` (default), the number of
+    distinct clusters must respect the reference's 3-centroid cap
+    (app.mjs:127); pass False to emit framework-native documents with more.
+    """
+    x = np.asarray(x)
+    labels = np.asarray(labels)
+    n = min(len(x), max_cards)
+    used = sorted(set(labels[:n].tolist()))
+    if enforce_limit and len(used) > MAX_CENTROIDS:
+        raise ValueError(
+            f"{len(used)} clusters exceed the reference's cap of "
+            f"{MAX_CENTROIDS}; pass enforce_limit=False for a "
+            "framework-native document"
+        )
+
+    doc = Document(room=room)
+    cent_ids = {}
+    with doc.txn():
+        for j, lab in enumerate(used):
+            cent = {
+                "id": f"c:tpu-{lab}",
+                "name": (names[j] if names and j < len(names)
+                         else f"Cluster {lab}"),
+                "color": doc.next_color(),
+                "locked": False,
+            }
+            doc.centroids.append(cent)
+            cent_ids[lab] = cent["id"]
+        pos = _normalize_positions(x[:n, :2].astype(np.float64))
+        for i in range(n):
+            cid = f"card:tpu-{i}"
+            doc.cards.append({
+                "id": cid,
+                "title": f"p{i}",
+                "traits": ["", ""],
+                "assignedTo": cent_ids[int(labels[i])],
+                "createdBy": "tpu",
+            })
+            doc.meta[f"pos:{cid}"] = {
+                "x": float(pos[i, 0]), "y": float(pos[i, 1])
+            }
+        doc.meta.setdefault("mode", "custom")
+        doc.meta.setdefault("iteration", 0)
+        doc._mutate()
+    return doc
+
+
+def cards_to_features(
+    cards: Sequence[dict],
+) -> Tuple[np.ndarray, List[str]]:
+    """Binary bag-of-tokens matrix (n_cards × vocab) + the sorted vocab.
+
+    Uses the reference's tokenizer so "Sweet / Creamy" and "sweet,creamy"
+    featurize identically (app.mjs:436-449).
+    """
+    tokens = [tokens_for_card(c) for c in cards]
+    vocab = sorted(set().union(*tokens)) if tokens else []
+    index = {t: i for i, t in enumerate(vocab)}
+    x = np.zeros((len(cards), max(len(vocab), 1)), np.float32)
+    for i, ts in enumerate(tokens):
+        for t in ts:
+            x[i, index[t]] = 1.0
+    return x, vocab
+
+
+def auto_assign(
+    doc: Document,
+    *,
+    seed: int = 0,
+    features: str = "traits",
+) -> dict:
+    """Run the TPU assign step for the humans: fit k = len(centroids) on the
+    document's cards and write assignments back.
+
+    ``features``: "traits" (bag-of-tokens) or "pos" (board coordinates; cards
+    without a position fall back to traits=0 vectors).  Locked zones follow
+    app.mjs:360 semantics in both directions: their cards keep their
+    assignment AND no card is moved into them — clustering runs with
+    k = number of *unlocked* centroids.  Returns the new metrics snapshot.
+    """
+    import jax
+
+    from kmeans_tpu.models import fit_lloyd
+
+    unlocked = [c for c in doc.centroids if not c.get("locked")]
+    k = len(unlocked)
+    if k == 0 or not doc.cards:
+        return doc.snapshot()
+
+    if features == "pos":
+        x = np.zeros((len(doc.cards), 2), np.float32)
+        for i, c in enumerate(doc.cards):
+            p = doc.get_card_pos(c["id"])
+            if p:
+                x[i] = (p["x"], p["y"])
+    else:
+        x, _ = cards_to_features(doc.cards)
+
+    from kmeans_tpu.config import KMeansConfig
+
+    cfg = KMeansConfig(k=k, max_iter=50, chunk_size=max(64, len(doc.cards)))
+    state = fit_lloyd(x, k, key=jax.random.key(seed), config=cfg)
+    labels = np.asarray(state.labels)
+
+    locked_ids = {c["id"] for c in doc.centroids if c.get("locked")}
+    order = [c["id"] for c in unlocked]
+    with doc.txn():
+        for i, card in enumerate(doc.cards):
+            if card.get("assignedTo") in locked_ids:
+                continue
+            doc.update_card_assign(card["id"], order[int(labels[i]) % k])
+    return doc.snapshot()

@@ -1,0 +1,89 @@
+"""Numeric↔session bridge: the minimum end-to-end slice (SURVEY.md §7)."""
+
+import json
+
+import jax
+import numpy as np
+
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import fit_lloyd
+from kmeans_tpu.session import (
+    Document,
+    auto_assign,
+    cards_to_features,
+    dataset_to_document,
+    export_json,
+    import_json,
+    populate_test_data,
+)
+
+
+def test_end_to_end_blobs_to_reference_schema():
+    # BASELINE config 1: 2D blobs, k=3, N=500 -> importable export JSON.
+    x, _, _ = make_blobs(jax.random.key(0), 500, 2, 3, cluster_std=0.4)
+    state = fit_lloyd(x, 3, key=jax.random.key(1))
+    doc = dataset_to_document(np.asarray(x), np.asarray(state.labels))
+    blob = export_json(doc)
+
+    other = Document()
+    import_json(other, blob)
+    assert len(other.cards) == 500
+    assert len(other.centroids) == 3
+    # every card assigned, every card has an in-bounds position
+    for c in other.cards:
+        assert c["assignedTo"] in {z["id"] for z in other.centroids}
+        p = other.meta[f"pos:{c['id']}"]
+        assert 0.02 <= p["x"] <= 0.92 and 0.10 <= p["y"] <= 0.92
+    # schema is exactly the reference's card shape
+    assert set(other.cards[0]) == {"id", "title", "traits", "assignedTo", "createdBy"}
+
+
+def test_dataset_to_document_enforces_centroid_cap():
+    x = np.random.default_rng(0).normal(size=(40, 2)).astype(np.float32)
+    labels = np.arange(40) % 5
+    import pytest
+
+    with pytest.raises(ValueError):
+        dataset_to_document(x, labels)
+    doc = dataset_to_document(x, labels, enforce_limit=False)
+    assert len(doc.centroids) == 5
+
+
+def test_cards_to_features_uses_reference_tokenizer():
+    doc = Document()
+    doc.add_card("A", ("Sweet/Creamy", "rich"))
+    doc.add_card("B", ("sweet", "Not Sweet"))
+    x, vocab = cards_to_features(doc.cards)
+    assert vocab == ["creamy", "not sweet", "rich", "sweet"]
+    np.testing.assert_array_equal(
+        x, [[1, 0, 1, 1], [0, 1, 0, 1]]
+    )
+
+
+def test_auto_assign_clusters_the_fixture():
+    doc = Document()
+    populate_test_data(doc)
+    doc.add_centroid("A")
+    doc.add_centroid("B")
+    snap = auto_assign(doc, seed=0)
+    assert doc.unassigned_count == 0
+    assert sum(snap["counts"].values()) == 11
+
+
+def test_auto_assign_respects_locked_zones():
+    doc = Document()
+    populate_test_data(doc)
+    a = doc.add_centroid("A")
+    doc.add_centroid("B")
+    doc.update_card_assign("seed:t10", a["id"])
+    doc.set_locked(a["id"], True)
+    auto_assign(doc, seed=0)
+    assert doc.get_card("seed:t10")["assignedTo"] == a["id"]
+
+
+def test_auto_assign_no_centroids_is_noop():
+    doc = Document()
+    populate_test_data(doc)
+    snap = auto_assign(doc)
+    assert snap["counts"] == {}
+    assert doc.unassigned_count == 11

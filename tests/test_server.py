@@ -1,0 +1,249 @@
+"""Serving shim tests: real HTTP against an ephemeral-port server
+(SURVEY.md §4 "browser shim tested with recorded HTTP transcripts")."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kmeans_tpu.config import ServeConfig
+from kmeans_tpu.serve import KMeansServer
+
+
+@pytest.fixture()
+def server():
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0))
+    httpd = s.start(background=True)
+    s.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield s
+    s.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(server.base + path, timeout=5) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _post(server, path, obj=None, raw=None):
+    data = raw if raw is not None else json.dumps(obj or {}).encode()
+    req = urllib.request.Request(
+        server.base + path, data=data,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _mutate(server, room, op, args=None):
+    return _post(server, f"/api/mutate?room={room}", {"op": op, "args": args or {}})
+
+
+def test_state_bootstraps_room_with_jessica(server):
+    status, _, body = _get(server, "/api/state?room=AAAA")
+    assert status == 200
+    st = json.loads(body)
+    assert st["room"] == "AAAA"
+    assert [c["id"] for c in st["cards"]] == ["seed:jessica"]
+    assert st["meta"]["seededJessica"] is True
+    assert st["maxCentroids"] == 3
+
+
+def test_security_headers_on_every_response(server):
+    for path in ("/", "/api/state?room=AAAA"):
+        _, headers, _ = _get(server, path)
+        assert headers["X-Frame-Options"] == "DENY"
+        assert headers["X-Content-Type-Options"] == "nosniff"
+        assert headers["Referrer-Policy"] == "no-referrer"
+        assert "frame-ancestors 'none'" in headers["Content-Security-Policy"]
+
+
+def test_mutate_flow_and_metrics(server):
+    room = "BBBB"
+    _mutate(server, room, "populate")
+    st, out = _mutate(server, room, "addCentroid", {"name": "Sweet"})
+    assert st == 200
+    cid = out["id"]
+    st, _ = _mutate(server, room, "assign",
+                    {"id": "seed:t1", "centroid": cid,
+                     "pos": {"x": 0.5, "y": 0.5}})
+    assert st == 200
+    _, _, body = _get(server, f"/api/state?room={room}")
+    state = json.loads(body)
+    assert state["metrics"]["counts"][cid] == 1
+    assert state["meta"]["pos:seed:t1"] == {"x": 0.5, "y": 0.5}
+    assert state["unassigned"] == 11   # jessica + 11 fixtures - 1 assigned
+    assert state["suggestions"][cid]["suggested"] == "Creamy + Sweet"
+
+
+def test_centroid_cap_returns_409(server):
+    room = "CCCC"
+    for _ in range(3):
+        st, _ = _mutate(server, room, "addCentroid")
+        assert st == 200
+    st, out = _mutate(server, room, "addCentroid")
+    assert st == 409
+    assert "at most 3" in out["error"]
+
+
+def test_locked_zone_refuses_assign(server):
+    room = "DDDD"
+    _, out = _mutate(server, room, "addCentroid")
+    cid = out["id"]
+    _mutate(server, room, "setLocked", {"id": cid, "locked": True})
+    st, out = _mutate(server, room, "assign",
+                      {"id": "seed:jessica", "centroid": cid})
+    assert st == 200 and out["ok"] is False
+
+
+def test_unknown_op_and_bad_json(server):
+    st, out = _mutate(server, "EEEE", "frobnicate")
+    assert st == 400 and "unknown op" in out["error"]
+    st, out = _post(server, "/api/mutate?room=EEEE", raw=b"{nope")
+    assert st == 400
+
+
+def test_export_import_round_trip(server):
+    room = "FFFF"
+    _mutate(server, room, "populate")
+    _mutate(server, room, "addCentroid", {"name": "Zesty"})
+    _, headers, body = _get(server, f"/api/export?room={room}")
+    assert "kmeans-room-FFFF.json" in headers["Content-Disposition"]
+    exported = json.loads(body)
+    assert {c["id"] for c in exported["cards"]} >= {"seed:t1", "seed:t11"}
+
+    st, _ = _post(server, "/api/import?room=GGGG", raw=body)
+    assert st == 200
+    _, _, body2 = _get(server, "/api/state?room=GGGG")
+    st2 = json.loads(body2)
+    assert {c["id"] for c in st2["cards"]} == {c["id"] for c in exported["cards"]}
+    assert st2["centroids"][0]["name"] == "Zesty"
+
+
+def test_presence_hello_roster(server):
+    room = "HHHH"
+    _post(server, f"/api/hello?room={room}", {"name": "Ada"})
+    _post(server, f"/api/hello?room={room}", {"name": "Bob"})
+    _, _, body = _get(server, f"/api/state?room={room}")
+    assert json.loads(body)["presence"] == ["Ada", "Bob"]
+
+
+def test_iteration_snapshot_deltas_over_http(server):
+    room = "IIII"
+    _mutate(server, room, "populate")
+    _, out = _mutate(server, room, "addCentroid")
+    cid = out["id"]
+    _mutate(server, room, "assign", {"id": "seed:t1", "centroid": cid})
+    _mutate(server, room, "setIteration", {"iteration": 1})
+    _mutate(server, room, "assign", {"id": "seed:t10", "centroid": cid})
+    _, _, body = _get(server, f"/api/state?room={room}")
+    st = json.loads(body)
+    d = st["deltas"]
+    assert d["per_centroid"][cid]["count"] == 1
+    # prev: {t1} alone -> cohesion 1.0 (n<=1 rule); now t1 (Sweet,Creamy) +
+    # t10 (Espresso,Hot) share nothing -> 0.0: a -100pp delta
+    assert d["per_centroid"][cid]["cohesion_pp"] == -100
+
+
+def test_sse_emits_change_events(server):
+    import socket
+
+    room = "JJJJ"
+    # raw socket SSE read (urllib buffers forever on streams)
+    host, port = server.httpd.server_address
+    sock = socket.create_connection((host, port), timeout=5)
+    sock.sendall(
+        f"GET /api/events?room={room} HTTP/1.1\r\n"
+        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
+    )
+    buf = b""
+    while b"data:" not in buf:
+        buf += sock.recv(4096)
+    assert b'"type": "hello"' in buf
+
+    done = threading.Event()
+    received = []
+
+    def reader():
+        nonlocal buf
+        local = b""
+        while b"change" not in local:
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            local += chunk
+        received.append(local)
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    _mutate(server, room, "addCentroid")
+    assert done.wait(5.0), "no SSE change event within 5s"
+    assert b'"type": "change"' in received[0]
+    sock.close()
+
+
+def test_index_and_app_js_served(server):
+    _, headers, body = _get(server, "/")
+    assert b"TPU" in body and "text/html" in headers["Content-Type"]
+    _, _, body = _get(server, "/app.js")
+    assert b"mutate" in body
+
+
+def test_healthz(server):
+    _, _, body = _get(server, "/healthz")
+    assert json.loads(body)["ok"] is True
+
+
+def test_import_rejects_malformed_card_elements(server):
+    st, out = _post(server, "/api/import?room=KKKK",
+                    raw=b'{"cards": ["x"], "centroids": [], "meta": {}}')
+    assert st == 400 and "cards[0]" in out["error"]
+    # room still healthy afterwards
+    st, _, body = _get(server, "/api/state?room=KKKK")
+    assert st == 200
+    assert json.loads(body)["cards"][0]["id"] == "seed:jessica"
+
+
+def test_auto_assign_never_targets_locked_zone(server):
+    room = "LLLL"
+    _mutate(server, room, "populate")
+    _, out = _mutate(server, room, "addCentroid", {"name": "Frozen"})
+    locked = out["id"]
+    _, out = _mutate(server, room, "addCentroid", {"name": "Open"})
+    open_id = out["id"]
+    _mutate(server, room, "setLocked", {"id": locked, "locked": True})
+    st, out = _mutate(server, room, "autoAssign")
+    assert st == 200
+    _, _, body = _get(server, f"/api/state?room={room}")
+    state = json.loads(body)
+    assert state["metrics"]["counts"][locked] == 0
+    assert state["metrics"]["counts"][open_id] == 12
+
+
+def test_auto_assign_infinite_ratio_is_json_null(server):
+    room = "MMMM"
+    _, out = _mutate(server, room, "addCentroid")
+    locked = out["id"]
+    _mutate(server, room, "addCentroid")
+    _mutate(server, room, "setLocked", {"id": locked, "locked": True})
+    # one card, one unlocked centroid, one locked-and-empty -> ratio inf
+    st, out = _mutate(server, room, "autoAssign")
+    assert st == 200   # must be parseable JSON (Infinity would 500 here)
+    assert out["metrics"]["balance"]["ratio"] is None
+
+
+def test_room_table_is_bounded():
+    from kmeans_tpu.serve.server import _MAX_ROOMS, RoomTableFullError
+
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0))
+    for i in range(_MAX_ROOMS):
+        s.room(f"R{i}")
+    assert len(s.rooms) == _MAX_ROOMS
+    # next new room evicts the longest-idle (no subscribers anywhere)
+    s.room("FRESH")
+    assert len(s.rooms) == _MAX_ROOMS
+    assert "FRESH" in s.rooms and "R0" not in s.rooms

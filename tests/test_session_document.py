@@ -1,0 +1,277 @@
+"""Document mutator semantics (app.mjs:123-237) and schema round-trip."""
+
+import json
+import math
+import random
+
+import pytest
+
+from kmeans_tpu.config import COLORS, MAX_CENTROIDS
+from kmeans_tpu.session import (
+    CentroidLimitError,
+    Document,
+    JESSICA,
+    TEST_ITEMS,
+    dedupe_seeds,
+    ensure_jessica_once,
+    export_filename,
+    export_json,
+    hard_reset,
+    import_json,
+    populate_test_data,
+    to_plain,
+)
+
+
+@pytest.fixture()
+def doc():
+    return Document(room="TEST", rng=random.Random(0))
+
+
+class TestCentroids:
+    def test_add_defaults_and_palette(self, doc):
+        c1 = doc.add_centroid()
+        c2 = doc.add_centroid("Fruity")
+        assert c1["name"] == "Centroid 1"
+        assert c2["name"] == "Fruity"
+        assert c1["color"] == COLORS[0] and c2["color"] == COLORS[1]
+        assert c1["id"].startswith("c:")
+        assert c1["locked"] is False
+
+    def test_cap_at_three(self, doc):
+        for _ in range(MAX_CENTROIDS):
+            doc.add_centroid()
+        with pytest.raises(CentroidLimitError):
+            doc.add_centroid()
+        assert len(doc.centroids) == 3
+
+    def test_next_color_skips_used(self, doc):
+        a = doc.add_centroid()
+        doc.remove_centroid(a["id"])
+        b = doc.add_centroid()
+        assert b["color"] == COLORS[0]  # first unused again
+
+    def test_remove_unassigns_cards_and_clears_pos(self, doc):
+        c = doc.add_centroid()
+        card = doc.add_card("X", ("a", "b"))
+        doc.assign_card(card["id"], c["id"], pos=(0.5, 0.5))
+        assert doc.get_card_pos(card["id"]) is not None
+        doc.remove_centroid(c["id"])
+        assert doc.get_card(card["id"])["assignedTo"] is None
+        assert doc.get_card_pos(card["id"]) is None
+        assert doc.centroids == []
+
+    def test_lock_refuses_drop(self, doc):
+        c = doc.add_centroid()
+        doc.set_locked(c["id"], True)
+        card = doc.add_card("X")
+        assert doc.assign_card(card["id"], c["id"], pos=(0.5, 0.5)) is False
+        assert doc.get_card(card["id"])["assignedTo"] is None
+        doc.set_locked(c["id"], False)
+        assert doc.assign_card(card["id"], c["id"], pos=(0.5, 0.5)) is True
+
+    def test_rename(self, doc):
+        c = doc.add_centroid("Old")
+        doc.rename_centroid(c["id"], "Sweet + Creamy")
+        assert doc.get_centroid(c["id"])["name"] == "Sweet + Creamy"
+
+
+class TestCards:
+    def test_add_card_shape(self, doc):
+        card = doc.add_card("Jess", ("Fresh", "Sorbet"), created_by="me")
+        assert set(card) == {"id", "title", "traits", "assignedTo", "createdBy"}
+        assert card["id"].startswith("card:")
+        assert card["assignedTo"] is None
+
+    def test_unassign_clears_pos(self, doc):
+        c = doc.add_centroid()
+        card = doc.add_card("X")
+        doc.assign_card(card["id"], c["id"], pos=(0.4, 0.6))
+        doc.update_card_assign(card["id"], None)
+        assert doc.get_card_pos(card["id"]) is None
+
+    def test_pos_clamped_to_reference_bounds(self, doc):
+        card = doc.add_card("X")
+        doc.set_card_pos(card["id"], -1.0, 2.0)
+        p = doc.get_card_pos(card["id"])
+        assert p == {"x": 0.02, "y": 0.92}
+
+    def test_delete_card_removes_pos(self, doc):
+        card = doc.add_card("X")
+        doc.set_card_pos(card["id"], 0.5, 0.5)
+        doc.delete_card(card["id"])
+        assert doc.get_card(card["id"]) is None
+        assert doc.get_card_pos(card["id"]) is None
+
+    def test_shuffle_unassigned_keeps_assigned_first(self, doc):
+        c = doc.add_centroid()
+        a = doc.add_card("A")
+        doc.add_card("B")
+        doc.add_card("C")
+        doc.update_card_assign(a["id"], c["id"])
+        doc.shuffle_unassigned()
+        assert doc.cards[0]["id"] == a["id"]
+        assert {x["title"] for x in doc.cards[1:]} == {"B", "C"}
+
+    def test_restart_all(self, doc):
+        c = doc.add_centroid()
+        a = doc.add_card("A")
+        doc.assign_card(a["id"], c["id"], pos=(0.5, 0.5))
+        doc.restart_all()
+        assert all(x["assignedTo"] is None for x in doc.cards)
+        assert not any(k.startswith("pos:") for k in doc.meta)
+        assert doc.centroids  # centroids survive restart
+
+
+class TestIterationSnapshot:
+    def test_prev_snapshot_saved_on_change(self, doc):
+        c = doc.add_centroid()
+        a = doc.add_card("A", ("Sweet", "x"))
+        doc.update_card_assign(a["id"], c["id"])
+        doc.set_iteration(1)
+        snap = doc.meta["prevSnapshot"]
+        assert snap["counts"] == {c["id"]: 1}
+        # adding a card then re-setting the SAME iteration doesn't re-snapshot
+        b = doc.add_card("B", ("Sweet", "y"))
+        doc.update_card_assign(b["id"], c["id"])
+        doc.set_iteration(1)
+        assert doc.meta["prevSnapshot"]["counts"] == {c["id"]: 1}
+        # a new iteration value does
+        doc.set_iteration(2)
+        assert doc.meta["prevSnapshot"]["counts"] == {c["id"]: 2}
+
+
+class TestTxnAndVersioning:
+    def test_txn_batches_notifications(self, doc):
+        fired = []
+        doc.on_change(lambda d: fired.append(d.version))
+        with doc.txn():
+            doc.add_card("A")
+            doc.add_card("B")
+            doc.add_centroid()
+        assert len(fired) == 1
+        assert doc.version == 1
+
+    def test_unbatched_mutations_fire_each(self, doc):
+        fired = []
+        doc.on_change(lambda d: fired.append(d.version))
+        doc.add_card("A")
+        doc.add_card("B")
+        assert fired == [1, 2]
+
+
+class TestSeeds:
+    def test_ensure_jessica_once_double_guard(self, doc):
+        assert ensure_jessica_once(doc) is True
+        assert ensure_jessica_once(doc) is False
+        assert [c["id"] for c in doc.cards] == ["seed:jessica"]
+        # flag set but card deleted -> still no re-seed (meta guard)
+        doc.delete_card("seed:jessica")
+        assert ensure_jessica_once(doc) is False
+
+    def test_populate_is_idempotent(self, doc):
+        assert populate_test_data(doc) == 11
+        assert populate_test_data(doc) == 0
+        assert len(doc.cards) == 11
+        ids = [c["id"] for c in doc.cards]
+        assert ids == [t[0] for t in TEST_ITEMS]
+        # outliers designated by the reference (app.mjs:214-215)
+        t10 = doc.get_card("seed:t10")
+        t11 = doc.get_card("seed:t11")
+        assert t10["traits"] == ["Espresso", "Hot"]
+        assert t11["traits"] == ["Vegan", "Not Sweet"]
+
+    def test_dedupe_seeds_keeps_first(self, doc):
+        populate_test_data(doc)
+        doc.cards.append(dict(doc.cards[0]))
+        doc.cards.append({"id": "card:x", "title": "X", "traits": ["", ""],
+                          "assignedTo": None, "createdBy": "u"})
+        doc.cards.append(dict(doc.cards[0]))
+        assert dedupe_seeds(doc) == 2
+        assert len([c for c in doc.cards if c["id"] == "seed:t1"]) == 1
+        assert doc.get_card("card:x") is not None
+
+    def test_hard_reset(self, doc):
+        populate_test_data(doc)
+        c = doc.add_centroid()
+        doc.assign_card(doc.cards[0]["id"], c["id"], pos=(0.5, 0.5))
+        doc.set_iteration(3)
+        hard_reset(doc, mode="playtest")
+        assert [c["id"] for c in doc.cards] == ["seed:jessica"]
+        assert doc.centroids == []
+        assert doc.meta["iteration"] == 0
+        assert doc.meta["mode"] == "playtest"
+        assert doc.meta["seededJessica"] is True
+        assert "prevSnapshot" not in doc.meta
+        assert not any(k.startswith("pos:") for k in doc.meta)
+
+
+class TestSchema:
+    def test_export_shape_and_filename(self, doc):
+        populate_test_data(doc)
+        c = doc.add_centroid("Sweet")
+        doc.assign_card("seed:t1", c["id"], pos=(0.3, 0.4))
+        doc.set_iteration(1)
+        s = export_json(doc)
+        obj = json.loads(s)
+        assert set(obj) == {"cards", "centroids", "meta"}
+        assert obj["cards"][0] == {
+            "id": "seed:t1", "title": "Nguyen",
+            "traits": ["Sweet", "Creamy"], "assignedTo": c["id"],
+            "createdBy": "seed",
+        }
+        assert obj["centroids"][0]["name"] == "Sweet"
+        assert obj["meta"]["pos:seed:t1"] == {"x": 0.3, "y": 0.4}
+        assert export_filename(doc.room) == "kmeans-room-TEST.json"
+        # pretty-printed with indent=2 like JSON.stringify(data, null, 2)
+        assert s.startswith('{\n  "cards": [')
+
+    def test_round_trip(self, doc):
+        populate_test_data(doc)
+        c = doc.add_centroid("Sweet")
+        doc.assign_card("seed:t2", c["id"], pos=(0.5, 0.5))
+        doc.set_iteration(2)
+        blob = export_json(doc)
+
+        other = Document(room="OTHER")
+        import_json(other, blob)
+        assert to_plain(other) == to_plain(doc)
+
+    def test_import_replaces_arrays_merges_meta(self, doc):
+        populate_test_data(doc)
+        doc.meta["keepme"] = 42
+        import_json(doc, {"cards": [], "centroids": [], "meta": {"mode": "custom"}})
+        assert doc.cards == [] and doc.centroids == []
+        assert doc.meta["keepme"] == 42       # merge, not replace
+        assert doc.meta["mode"] == "custom"
+
+    def test_import_dedupes_seeds(self, doc):
+        cards = [
+            {"id": "seed:t1", "title": "A", "traits": ["", ""],
+             "assignedTo": None, "createdBy": "s"},
+            {"id": "seed:t1", "title": "B", "traits": ["", ""],
+             "assignedTo": None, "createdBy": "s"},
+        ]
+        import_json(doc, {"cards": cards, "centroids": [], "meta": {}})
+        assert len(doc.cards) == 1
+        assert doc.cards[0]["title"] == "A"  # first occurrence kept
+
+    def test_import_malformed_raises(self, doc):
+        with pytest.raises(ValueError):
+            import_json(doc, "{not json")
+        with pytest.raises(ValueError):
+            import_json(doc, "[1,2,3]")
+
+    def test_infinity_ratio_serializes_as_null(self, doc):
+        c = doc.add_centroid()
+        doc.add_centroid()
+        a = doc.add_card("A")
+        doc.update_card_assign(a["id"], c["id"])
+        doc.set_iteration(1)     # snapshot has ratio == inf (one empty)
+        assert doc.meta["prevSnapshot"]["balance"]["ratio"] == math.inf
+        obj = json.loads(export_json(doc))
+        assert obj["meta"]["prevSnapshot"]["balance"]["ratio"] is None
+        # and import maps it back to inf
+        other = Document()
+        import_json(other, obj)
+        assert other.meta["prevSnapshot"]["balance"]["ratio"] == math.inf

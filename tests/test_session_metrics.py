@@ -1,0 +1,176 @@
+"""Metrics parity tests (reference semantics from app.mjs:435-496)."""
+
+import math
+
+from kmeans_tpu.session.metrics import (
+    cohesion_for,
+    metrics_deltas,
+    norm_tokens,
+    snapshot_metrics,
+    suggestion_from_counts,
+    title_case,
+    tokens_for_card,
+    trait_counts_for,
+)
+
+
+def card(title, a, b, assigned=None, cid=None):
+    return {
+        "id": cid or f"card:{title}",
+        "title": title,
+        "traits": [a, b],
+        "assignedTo": assigned,
+        "createdBy": "t",
+    }
+
+
+class TestNormTokens:
+    def test_basic_lowercase(self):
+        assert norm_tokens("Sweet") == ["sweet"]
+
+    def test_split_chars(self):
+        assert norm_tokens("Sweet/Creamy") == ["sweet", "creamy"]
+        assert norm_tokens("Sweet, Creamy") == ["sweet", "creamy"]
+        assert norm_tokens("Sweet & Creamy") == ["sweet", "creamy"]
+        assert norm_tokens("Sweet • Creamy") == ["sweet", "creamy"]
+        assert norm_tokens("Sweet + Creamy") == ["sweet", "creamy"]
+        assert norm_tokens("Sweet | Creamy") == ["sweet", "creamy"]
+
+    def test_word_and_needs_whitespace(self):
+        assert norm_tokens("Sweet and Creamy") == ["sweet", "creamy"]
+        assert norm_tokens("Sweet AND Creamy") == ["sweet", "creamy"]
+        # no surrounding whitespace -> not a separator
+        assert norm_tokens("Sandy") == ["sandy"]
+        assert norm_tokens("Brandy") == ["brandy"]
+
+    def test_empty_and_none(self):
+        assert norm_tokens(None) == []
+        assert norm_tokens("") == []
+        assert norm_tokens("  ,  /  ") == []
+
+    def test_multi_word_token_kept_whole(self):
+        assert norm_tokens("Not Sweet") == ["not sweet"]
+
+
+class TestTitleCase:
+    def test_per_word_first_char(self):
+        assert title_case("not sweet") == "Not Sweet"
+        assert title_case("espresso") == "Espresso"
+
+    def test_rest_of_word_unchanged(self):
+        # JS: w[0].toUpperCase() + w.slice(1) — no lowering of the tail
+        assert title_case("aBC dEF") == "ABC DEF"
+
+
+class TestTokensForCard:
+    def test_union_both_traits_dedup(self):
+        c = card("X", "Sweet/Creamy", "creamy & rich")
+        assert tokens_for_card(c) == {"sweet", "creamy", "rich"}
+
+    def test_missing_traits(self):
+        assert tokens_for_card({"id": "x"}) == set()
+        assert tokens_for_card({"id": "x", "traits": ["Sweet"]}) == {"sweet"}
+
+
+class TestCohesion:
+    def test_small_clusters_are_perfect(self):
+        assert cohesion_for([]) == 1.0
+        assert cohesion_for([card("a", "x", "y")]) == 1.0
+
+    def test_all_share(self):
+        cs = [card("a", "Sweet", "x"), card("b", "sweet", "y")]
+        assert cohesion_for(cs) == 1.0
+
+    def test_partial_share(self):
+        cs = [
+            card("a", "Sweet", "Creamy"),
+            card("b", "Sweet", "Rich"),
+            card("c", "Espresso", "Hot"),
+        ]
+        # a and b share "sweet"; c shares nothing -> 2/3
+        assert cohesion_for(cs) == 2 / 3
+
+    def test_none_share(self):
+        cs = [card("a", "x1", "y1"), card("b", "x2", "y2")]
+        assert cohesion_for(cs) == 0.0
+
+
+class TestSuggestion:
+    def test_top_two_by_count_then_label(self):
+        counts = trait_counts_for([
+            card("a", "Sweet", "Creamy"),
+            card("b", "Sweet", "Rich"),
+            card("c", "Creamy", "Rich"),
+            card("d", "Sweet", ""),
+        ])
+        # sweet=3, creamy=2, rich=2 -> tie broken by label: Creamy < Rich
+        assert suggestion_from_counts(counts) == "Sweet + Creamy"
+
+    def test_single_token(self):
+        counts = trait_counts_for([card("a", "Sweet", "")])
+        assert suggestion_from_counts(counts) == "Sweet"
+
+    def test_empty(self):
+        assert suggestion_from_counts({}) is None
+
+
+class TestSnapshot:
+    def _doc(self):
+        cents = [
+            {"id": "c:1", "name": "A", "color": "#fff", "locked": False},
+            {"id": "c:2", "name": "B", "color": "#000", "locked": False},
+        ]
+        cards = [
+            card("a", "Sweet", "Creamy", assigned="c:1"),
+            card("b", "Sweet", "Rich", assigned="c:1"),
+            card("c", "Espresso", "Hot", assigned="c:2"),
+            card("d", "Vegan", "Not Sweet", assigned=None),
+        ]
+        return cards, cents
+
+    def test_counts_and_cohesion(self):
+        cards, cents = self._doc()
+        m = snapshot_metrics(cards, cents)
+        assert m["counts"] == {"c:1": 2, "c:2": 1}
+        assert m["cohesion"]["c:1"] == 1.0
+        assert m["cohesion"]["c:2"] == 1.0
+        assert m["balance"] == {"max": 2, "min": 1, "gap": 1, "ratio": 2.0}
+        assert m["avgCohesion"] == 1.0
+
+    def test_ratio_infinity_when_some_empty(self):
+        cards, cents = self._doc()
+        cards = [c for c in cards if c["assignedTo"] != "c:2"]
+        m = snapshot_metrics(cards, cents)
+        assert m["balance"]["ratio"] == math.inf
+
+    def test_no_centroids(self):
+        m = snapshot_metrics([], [])
+        assert m["balance"] == {"max": 0, "min": 0, "gap": 0, "ratio": 1}
+        assert m["avgCohesion"] == 1
+
+    def test_all_empty_clusters_ratio_one(self):
+        _, cents = self._doc()
+        m = snapshot_metrics([], cents)
+        assert m["balance"]["ratio"] == 1
+        assert m["avgCohesion"] == 1.0  # empty clusters have cohesion 1
+
+
+class TestDeltas:
+    def test_none_without_prev(self):
+        assert metrics_deltas(None, {"balance": {"gap": 0}}) is None
+
+    def test_pp_rounding_and_gap_direction(self):
+        cents = [{"id": "c:1", "name": "A", "color": "#fff", "locked": False}]
+        prev = snapshot_metrics(
+            [card("a", "x1", "y1", "c:1"), card("b", "x2", "y2", "c:1")], cents
+        )
+        now = snapshot_metrics(
+            [card("a", "Sweet", "y1", "c:1"), card("b", "sweet", "y2", "c:1"),
+             card("c", "sweet", "z", "c:1")],
+            cents,
+        )
+        d = metrics_deltas(prev, now)
+        assert d["gap"] == 0 and d["tighter"]
+        assert d["avgCohesion_pp"] == 100      # 0% -> 100%
+        assert d["per_centroid"]["c:1"]["count"] == 1
+        assert d["per_centroid"]["c:1"]["cohesion_pp"] == 100

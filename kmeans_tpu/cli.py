@@ -46,11 +46,54 @@ def _cmd_train(args) -> int:
         compute_dtype=args.dtype,
     )
 
-    t0 = time.perf_counter()
+    mesh = None
     if args.mesh and args.mesh > 1:
-        from kmeans_tpu.parallel import fit_lloyd_sharded, fit_minibatch_sharded, make_mesh
+        from kmeans_tpu.parallel import make_mesh
 
         mesh = make_mesh((args.mesh, 1), ("data", "model"))
+
+    want_runner = bool(
+        args.progress or args.checkpoint or args.resume or args.profile
+    )
+    if want_runner and minibatch:
+        print(
+            "error: --progress/--checkpoint/--resume/--profile require the "
+            "full-batch Lloyd path (they would be silently ignored in "
+            "minibatch mode); drop --minibatch or those flags",
+            file=sys.stderr,
+        )
+        return 2
+
+    t0 = time.perf_counter()
+    if want_runner and not minibatch:
+        from kmeans_tpu.models import LloydRunner
+        import contextlib
+
+        from kmeans_tpu.utils import trace
+
+        runner = LloydRunner(np.asarray(x), k, config=kcfg, mesh=mesh)
+        if args.resume:
+            step = runner.resume(args.resume)
+            print(f"resumed from {args.resume} at iteration {step}",
+                  file=sys.stderr)
+        else:
+            runner.init()
+
+        def progress(info):
+            if args.progress:
+                print(json.dumps({"event": "iter", **info.as_dict()}),
+                      file=sys.stderr)
+
+        ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
+        with ctx:
+            state = runner.run(
+                callback=progress,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+            )
+    elif mesh is not None:
+        from kmeans_tpu.parallel import fit_lloyd_sharded, fit_minibatch_sharded
+
         fit = fit_minibatch_sharded if minibatch else fit_lloyd_sharded
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
     elif minibatch:
@@ -125,6 +168,12 @@ def main(argv=None) -> int:
     t.add_argument("--cluster-std", type=float, default=0.6)
     t.add_argument("--out", help="write reference-schema export JSON here")
     t.add_argument("--max-cards", type=int, default=500)
+    t.add_argument("--progress", action="store_true",
+                   help="print one JSON line per Lloyd iteration to stderr")
+    t.add_argument("--checkpoint", help="checkpoint directory (periodic saves)")
+    t.add_argument("--checkpoint-every", type=int, default=10)
+    t.add_argument("--resume", help="resume from this checkpoint directory")
+    t.add_argument("--profile", help="write a jax.profiler trace to this dir")
     t.set_defaults(fn=_cmd_train)
 
     s = sub.add_parser("serve", help="run the HTTP/SSE visualizer server")

@@ -3,8 +3,11 @@
 from kmeans_tpu.models.init import init_centroids, kmeans_plus_plus, random_init
 from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
+from kmeans_tpu.models.runner import IterInfo, LloydRunner
 
 __all__ = [
+    "IterInfo",
+    "LloydRunner",
     "init_centroids",
     "kmeans_plus_plus",
     "random_init",

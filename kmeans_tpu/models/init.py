@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from kmeans_tpu.ops.distance import sq_norms
+from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
 __all__ = ["random_init", "kmeans_plus_plus", "init_centroids"]
 
@@ -76,7 +76,8 @@ def kmeans_plus_plus(
 
     def d2_to(c):
         prod = jnp.matmul(
-            x.astype(cd), c.astype(cd), preferred_element_type=f32
+            x.astype(cd), c.astype(cd), preferred_element_type=f32,
+            precision=matmul_precision(cd),
         )
         return jnp.maximum(x_sq - 2.0 * prod + jnp.sum(c * c), 0.0)
 

@@ -24,7 +24,7 @@ from jax import lax
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState
-from kmeans_tpu.ops.distance import sq_norms
+from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import lloyd_pass
 
 __all__ = ["fit_minibatch", "MiniBatchKMeans"]
@@ -63,7 +63,8 @@ def _minibatch_loop(
         xb = x[idx]
         # Assign the batch (batch_size × k fits on-chip for our configs).
         prod = jnp.matmul(
-            xb.astype(cd), centroids.astype(cd).T, preferred_element_type=f32
+            xb.astype(cd), centroids.astype(cd).T,
+            preferred_element_type=f32, precision=matmul_precision(cd),
         )
         part = sq_norms(centroids)[None, :] - 2.0 * prod
         labels = jnp.argmin(part, axis=1).astype(jnp.int32)

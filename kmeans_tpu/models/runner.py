@@ -1,0 +1,265 @@
+"""Step-wise Lloyd runner: observability, callbacks, checkpoint/resume.
+
+The fused :func:`fit_lloyd` compiles the whole loop into one XLA program —
+fastest, but opaque while running.  The reference, by contrast, is *all*
+observability: every iteration boundary snapshots metrics and renders deltas
+(app.mjs:499-508; SURVEY.md §5.5).  ``LloydRunner`` is the middle ground the
+serve layer and long jobs use:
+
+* one jitted step per Lloyd iteration (compiled once, reused),
+* a callback per iteration with (iteration, inertia, shift², wall-time) —
+  the numeric analog of the dashboard's per-iteration delta stream,
+* periodic checkpointing + resume (SURVEY.md §5.3 failure recovery),
+* optional DP/TP sharding via the parallel engine's cached step builder.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import init_centroids
+from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
+
+__all__ = ["LloydRunner", "IterInfo"]
+
+
+class IterInfo:
+    __slots__ = ("iteration", "inertia", "shift_sq", "seconds", "converged")
+
+    def __init__(self, iteration, inertia, shift_sq, seconds, converged):
+        self.iteration = iteration
+        self.inertia = inertia
+        self.shift_sq = shift_sq
+        self.seconds = seconds
+        self.converged = converged
+
+    def as_dict(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "inertia": self.inertia,
+            "shift_sq": self.shift_sq,
+            "seconds": self.seconds,
+            "converged": self.converged,
+        }
+
+
+class LloydRunner:
+    """Python-paced Lloyd loop with per-iteration visibility."""
+
+    def __init__(
+        self,
+        x,
+        k: int,
+        *,
+        config: Optional[KMeansConfig] = None,
+        key: Optional[jax.Array] = None,
+        mesh=None,
+        data_axis: str = "data",
+        model_axis: Optional[str] = None,
+    ):
+        self.cfg = (config or KMeansConfig(k=k)).validate()
+        if config is not None and config.k != k:
+            raise ValueError(f"k={k} contradicts config.k={config.k}")
+        self.k = k
+        self.key = key if key is not None else jax.random.key(self.cfg.seed)
+        self.mesh = mesh
+        self.iteration = 0
+        self.centroids: Optional[jax.Array] = None
+        self.last_inertia: Optional[float] = None
+
+        if mesh is None:
+            self.x = jnp.asarray(x)
+            cfg = self.cfg
+
+            @jax.jit
+            def step(x, c):
+                labels, min_d2, sums, counts, inertia = lloyd_pass(
+                    x, c,
+                    chunk_size=cfg.chunk_size,
+                    compute_dtype=cfg.compute_dtype,
+                    update=cfg.update,
+                )
+                new_c = apply_update(c, sums, counts)
+                if cfg.empty == "farthest":
+                    new_c = reseed_empty_farthest(new_c, counts, x, min_d2)
+                shift_sq = jnp.sum((new_c - c) ** 2)
+                return new_c, inertia, shift_sq
+
+            self._step = step
+        else:
+            import functools
+
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from kmeans_tpu.parallel.engine import (
+                _dp_local_pass, _pad_rows, _tp_local_pass,
+            )
+
+            if self.cfg.empty == "farthest":
+                raise NotImplementedError(
+                    "empty='farthest' is not supported on a mesh yet"
+                )
+            axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            xp, w_host, self._n = _pad_rows(np.asarray(x), axis_sizes[data_axis])
+            self.x = jax.device_put(xp, NamedSharding(mesh, P(data_axis)))
+            self._w = jax.device_put(
+                jnp.asarray(w_host), NamedSharding(mesh, P(data_axis))
+            )
+            if model_axis is None:
+                local = functools.partial(
+                    _dp_local_pass, data_axis=data_axis,
+                    chunk_size=self.cfg.chunk_size,
+                    compute_dtype=self.cfg.compute_dtype,
+                    update=self.cfg.update, with_labels=False,
+                )
+                in_specs = (P(data_axis), P(), P(data_axis))
+                out_specs = (P(), P(), P())
+            else:
+                if k % axis_sizes[model_axis] != 0:
+                    raise ValueError(
+                        f"LloydRunner TP path needs k % model axis == 0 "
+                        f"(k={k}, model={axis_sizes[model_axis]}); use "
+                        "fit_lloyd_sharded for automatic k padding"
+                    )
+                local = functools.partial(
+                    _tp_local_pass, data_axis=data_axis,
+                    model_axis=model_axis, k_real=k,
+                    chunk_size=self.cfg.chunk_size,
+                    compute_dtype=self.cfg.compute_dtype,
+                    update=self.cfg.update, with_labels=False,
+                )
+                in_specs = (P(data_axis), P(model_axis), P(data_axis))
+                out_specs = (P(model_axis), P(), P(model_axis))
+            sm = jax.shard_map(
+                local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+
+            @jax.jit
+            def step(x, c, w):
+                new_c, inertia, _counts = sm(x, c, w)
+                shift_sq = jnp.sum((new_c - c) ** 2)
+                return new_c, inertia, shift_sq
+
+            self._step = lambda x, c: step(x, c, self._w)
+
+    # ------------------------------------------------------------------ API
+    def init(self, init=None) -> None:
+        if init is not None and not isinstance(init, str):
+            self.centroids = jnp.asarray(init, jnp.float32)
+        else:
+            method = init if isinstance(init, str) else self.cfg.init
+            # On a mesh, self.x carries zero padding rows — exclude them from
+            # seeding with zero weights (same as fit_lloyd_sharded).
+            weights = self._w if self.mesh is not None else None
+            self.centroids = init_centroids(
+                self.key, self.x, self.k, method=method, weights=weights,
+                compute_dtype=self.cfg.compute_dtype,
+            )
+
+    def run(
+        self,
+        *,
+        max_iter: Optional[int] = None,
+        tol: Optional[float] = None,
+        callback: Optional[Callable[[IterInfo], None]] = None,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 10,
+    ) -> KMeansState:
+        """Iterate until convergence; fire ``callback`` each iteration."""
+        if self.centroids is None:
+            self.init()
+        if checkpoint_path and checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
+        max_iter = max_iter if max_iter is not None else self.cfg.max_iter
+        tol = tol if tol is not None else self.cfg.tol
+
+        converged = False
+        for _ in range(max_iter):
+            t0 = time.perf_counter()
+            new_c, inertia, shift_sq = self._step(self.x, self.centroids)
+            new_c.block_until_ready()
+            dt = time.perf_counter() - t0
+            self.centroids = new_c
+            self.iteration += 1
+            self.last_inertia = float(inertia)
+            converged = float(shift_sq) <= tol
+            if callback:
+                callback(IterInfo(
+                    self.iteration, float(inertia), float(shift_sq), dt,
+                    converged,
+                ))
+            if checkpoint_path and (
+                self.iteration % checkpoint_every == 0 or converged
+            ):
+                self.checkpoint(checkpoint_path)
+            if converged:
+                break
+        return self.finalize(converged=converged)
+
+    def finalize(self, *, converged: bool = False) -> KMeansState:
+        """Labels/inertia/counts at the current centroids."""
+        if self.mesh is None:
+            labels, _, _, counts, inertia = lloyd_pass(
+                self.x, self.centroids,
+                chunk_size=self.cfg.chunk_size,
+                compute_dtype=self.cfg.compute_dtype,
+            )
+        else:
+            from kmeans_tpu.parallel.engine import sharded_assign
+
+            c_full = self.centroids
+            labels, mind = sharded_assign(
+                np.asarray(self.x)[: self._n], np.asarray(c_full),
+                mesh=self.mesh,
+                chunk_size=self.cfg.chunk_size,
+                compute_dtype=self.cfg.compute_dtype,
+            )
+            inertia = jnp.sum(mind)
+            counts = jax.ops.segment_sum(
+                jnp.ones(labels.shape, jnp.float32), labels, self.k
+            )
+        return KMeansState(
+            self.centroids[: self.k],
+            labels,
+            inertia,
+            jnp.asarray(self.iteration, jnp.int32),
+            jnp.asarray(converged),
+            counts[: self.k],
+        )
+
+    # --------------------------------------------------------- checkpointing
+    def checkpoint(self, path: str) -> str:
+        from kmeans_tpu.utils.checkpoint import save_checkpoint
+
+        state = KMeansState(
+            self.centroids,
+            jnp.zeros((0,), jnp.int32),
+            jnp.asarray(self.last_inertia or 0.0, jnp.float32),
+            jnp.asarray(self.iteration, jnp.int32),
+            jnp.asarray(False),
+            jnp.zeros((self.k,), jnp.float32),
+        )
+        return save_checkpoint(
+            path, state, step=self.iteration, config=self.cfg, key=self.key,
+        )
+
+    def resume(self, path: str) -> int:
+        """Restore centroids + iteration from a checkpoint; returns the step."""
+        from kmeans_tpu.utils.checkpoint import load_checkpoint
+
+        state, meta = load_checkpoint(path)
+        self.centroids = jnp.asarray(state.centroids, jnp.float32)
+        self.iteration = int(meta["step"])
+        if "key" in meta:
+            self.key = meta["key"]
+        return self.iteration

@@ -33,6 +33,17 @@ def _as_dtype(compute_dtype, fallback):
     return jnp.dtype(compute_dtype)
 
 
+def matmul_precision(cd):
+    """f32 compute means *real* f32: on TPU the default matmul precision
+    downcasts inputs to bf16 (fast but slightly lossy), which makes Lloyd's
+    objective non-monotone near cluster boundaries.  bf16 compute keeps the
+    fast default."""
+    return (
+        jax.lax.Precision.HIGHEST
+        if jnp.dtype(cd) == jnp.float32 else None
+    )
+
+
 def sq_norms(x: jax.Array) -> jax.Array:
     """Row-wise squared L2 norms in float32."""
     xf = x.astype(jnp.float32)
@@ -52,7 +63,9 @@ def pairwise_sq_dists(
     """
     cd = _as_dtype(compute_dtype, x.dtype)
     prod = jnp.matmul(
-        x.astype(cd), centroids.astype(cd).T, preferred_element_type=jnp.float32
+        x.astype(cd), centroids.astype(cd).T,
+        preferred_element_type=jnp.float32,
+        precision=matmul_precision(cd),
     )
     d2 = sq_norms(x)[:, None] - 2.0 * prod + sq_norms(centroids)[None, :]
     return jnp.maximum(d2, 0.0)

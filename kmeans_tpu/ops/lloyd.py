@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from kmeans_tpu.ops.distance import sq_norms
+from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
 __all__ = ["lloyd_pass"]
 
@@ -101,7 +101,8 @@ def lloyd_pass(
         xb, wb = tile
         xb_c = xb.astype(cd)
         # argmin_k ||x-c||² == argmin_k (||c||² - 2 x·c); row norm added later.
-        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32)   # (chunk, k)
+        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32,
+                         precision=matmul_precision(cd))   # (chunk, k)
         part = c_sq[None, :] - 2.0 * prod
         labels = jnp.argmin(part, axis=1).astype(jnp.int32)
         min_d2 = jnp.maximum(jnp.min(part, axis=1) + sq_norms(xb), 0.0)
@@ -126,7 +127,8 @@ def lloyd_pass(
                 onehot = (labels[:, None] == jnp.arange(k)[None, :])
                 wt = (onehot * wb[:, None]).astype(cd)             # (chunk, k)
                 sums = sums + jnp.matmul(
-                    wt.T, xb_c, preferred_element_type=f32
+                    wt.T, xb_c, preferred_element_type=f32,
+                    precision=matmul_precision(cd),
                 )
             elif eff_update == "segment":
                 sums = sums + jax.ops.segment_sum(

@@ -36,7 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState
-from kmeans_tpu.ops.distance import sq_norms
+from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import lloyd_pass
 from kmeans_tpu.ops.update import apply_update
 
@@ -96,7 +96,8 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
         sums, counts, inertia = carry
         xb, wb = tile
         xb_c = xb.astype(cd)
-        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32)
+        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32,
+                         precision=matmul_precision(cd))
         part = jnp.where(
             valid_col[None, :], c_sq[None, :] - 2.0 * prod, jnp.inf
         )
@@ -114,7 +115,8 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
         if update == "matmul":
             onehot = rel[:, None] == jnp.arange(k_loc)[None, :]
             wt = (onehot * wb[:, None]).astype(cd)
-            sums = sums + jnp.matmul(wt.T, xb_c, preferred_element_type=f32)
+            sums = sums + jnp.matmul(wt.T, xb_c, preferred_element_type=f32,
+                                     precision=matmul_precision(cd))
             counts = counts + jnp.sum(
                 onehot.astype(f32) * wb[:, None], axis=0
             )

@@ -91,6 +91,7 @@ class _Room:
         self.last_active = time.time()
         self._next_sub = 0
         self._lock = threading.Lock()
+        self.train_lock = threading.Lock()
         ensure_jessica_once(self.doc)
         self.doc.on_change(self._broadcast)
 
@@ -126,7 +127,9 @@ class _Room:
             self.subscribers.pop(sid, None)
 
     def _broadcast(self, doc: Document) -> None:
-        event = {"type": "change", "version": doc.version}
+        self.broadcast_event({"type": "change", "version": doc.version})
+
+    def broadcast_event(self, event: dict) -> None:
         with self._lock:
             for q in self.subscribers.values():
                 try:
@@ -284,7 +287,71 @@ class KMeansServer:
             snap = auto_assign(doc, seed=int(args.get("seed", 0)),
                                features=str(args.get("features", "traits")))
             return {"metrics": _js_safe(snap)}
+        if op == "train":
+            return self._start_training(room, args)
         raise ValueError(f"unknown op {op!r}")
+
+    # ------------------------------------------------------- live training
+    def _start_training(self, room: _Room, args: dict) -> dict:
+        """Run a Lloyd fit in a worker thread, streaming one SSE ``train``
+        event per iteration (the numeric analog of the reference's
+        per-iteration snapshot stream, app.mjs:499-508); on a 2-D k<=3 run
+        the result replaces the room's board as an importable document."""
+        import numpy as np
+
+        n = min(int(args.get("n", 2000)), 200_000)
+        d = min(int(args.get("d", 2)), 4096)
+        k = min(int(args.get("k", 3)), 1000)
+        max_iter = min(int(args.get("max_iter", 30)), 300)
+        seed = int(args.get("seed", 0))
+        if n < k or n < 1 or d < 1 or k < 1:
+            raise ValueError("invalid train shape")
+        if not room.train_lock.acquire(blocking=False):
+            raise ValueError("training already running in this room")
+
+        def work():
+            try:
+                import jax
+
+                from kmeans_tpu.data import make_blobs
+                from kmeans_tpu.models.runner import LloydRunner
+
+                x, _, _ = make_blobs(
+                    jax.random.key(seed), n, d, k, cluster_std=0.6
+                )
+                runner = LloydRunner(
+                    np.asarray(x), k, key=jax.random.key(seed + 1)
+                )
+                runner.init()
+
+                def cb(info):
+                    room.broadcast_event({
+                        "type": "train", **info.as_dict(),
+                    })
+
+                state = runner.run(max_iter=max_iter, callback=cb)
+                if d >= 2 and k <= MAX_CENTROIDS:
+                    from kmeans_tpu.session.schema import to_plain
+
+                    viz = dataset_to_document(
+                        np.asarray(x), np.asarray(state.labels),
+                        room=room.code,
+                        max_cards=self.config.max_render_cards,
+                    )
+                    import_json(room.doc, to_plain(viz))
+                room.broadcast_event({
+                    "type": "train_done",
+                    "inertia": float(state.inertia),
+                    "n_iter": int(state.n_iter),
+                    "converged": bool(state.converged),
+                })
+            except Exception as e:   # stream the failure, don't kill the room
+                room.broadcast_event({"type": "train_error", "error": str(e)})
+            finally:
+                room.train_lock.release()
+
+        threading.Thread(target=work, daemon=True).start()
+        return {"started": True, "n": n, "d": d, "k": k}
 
     # -------------------------------------------------------------- serve
     def make_handler(self):

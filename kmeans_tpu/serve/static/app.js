@@ -68,6 +68,15 @@ function connectEvents() {
     const msg = JSON.parse(ev.data);
     if (typeof msg.peers === "number") { peers = msg.peers; setStatusChip(); }
     if (msg.type === "change" && (!state || msg.version !== state.version)) fetchState();
+    if (msg.type === "train" || msg.type === "train_done" || msg.type === "train_error") {
+      const t = $id("trainStatus");
+      t.style.display = "";
+      if (msg.type === "train")
+        t.textContent = `iter ${msg.iteration}: inertia ${msg.inertia.toFixed(1)} (${(msg.seconds * 1000).toFixed(0)}ms)`;
+      else if (msg.type === "train_done")
+        t.textContent = `done: ${msg.n_iter} iters, inertia ${msg.inertia.toFixed(1)}${msg.converged ? " ✓" : ""}`;
+      else t.textContent = `train failed: ${msg.error}`;
+    }
   };
   es.onerror = () => { setStatusChip(true); };
   return es;
@@ -362,6 +371,8 @@ $id("shuffle").addEventListener("click", () => {
 $id("shuffleUnassigned").addEventListener("click", () => mutate("shuffleUnassigned"));
 $id("restartAll").addEventListener("click", () => mutate("restartAll"));
 $id("tpuAssign").addEventListener("click", () => mutate("autoAssign"));
+$id("tpuTrain").addEventListener("click", () =>
+  mutate("train", { n: 500, d: 2, k: 3 }));
 $id("saveName").addEventListener("click", () => {
   myName = $id("name").value.trim() || myName;
   localStorage.setItem(LS_NAME, myName);

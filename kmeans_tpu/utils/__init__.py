@@ -1,5 +1,21 @@
-"""Small shared helpers: room codes, ids, presence initials."""
+"""Shared helpers: room codes/ids, checkpointing, profiling."""
 
+from kmeans_tpu.utils.checkpoint import (
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+)
+from kmeans_tpu.utils.profiling import Timer, trace
 from kmeans_tpu.utils.rooms import code4, initials, new_card_id, new_centroid_id
 
-__all__ = ["code4", "initials", "new_card_id", "new_centroid_id"]
+__all__ = [
+    "latest_step",
+    "load_checkpoint",
+    "save_checkpoint",
+    "Timer",
+    "trace",
+    "code4",
+    "initials",
+    "new_card_id",
+    "new_centroid_id",
+]

@@ -1,0 +1,157 @@
+"""Checkpoint / resume for the numeric engine (SURVEY.md §5.4).
+
+The reference's checkpointing is the Export/Import JSON of the session layer
+(app.mjs:263-282), which :mod:`kmeans_tpu.session.schema` reproduces.  The
+numeric engine adds array checkpoints of (centroids, iteration, RNG key,
+config) — orbax-backed when available, with a numpy ``.npz`` fallback so the
+format works in minimal environments.
+
+Layout (a directory):
+    <path>/arrays/...        orbax PyTree (or arrays.npz)
+    <path>/meta.json         step, config, rng key data, format tag
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from kmeans_tpu.config import KMeansConfig
+
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step"]
+
+_META = "meta.json"
+
+
+def _state_arrays(state) -> dict:
+    return {
+        "centroids": np.asarray(state.centroids),
+        "labels": np.asarray(state.labels),
+        "inertia": np.asarray(state.inertia),
+        "n_iter": np.asarray(state.n_iter),
+        "converged": np.asarray(state.converged),
+        "counts": np.asarray(state.counts),
+    }
+
+
+def save_checkpoint(
+    path: str,
+    state,
+    *,
+    step: int = 0,
+    config: Optional[KMeansConfig] = None,
+    key=None,
+    extra: Optional[dict] = None,
+) -> str:
+    """Write a resumable checkpoint; returns ``path``.
+
+    Atomic against crashes: everything is written into ``<path>.tmp`` first,
+    then swapped into place, so ``<path>`` always holds a complete,
+    self-consistent (arrays, meta) pair (SURVEY.md §5.3 failure recovery).
+    """
+    final_path = path
+    path = path + ".tmp"
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+    os.makedirs(path, exist_ok=True)
+    arrays = _state_arrays(state)
+    # Orbax refuses zero-size arrays (e.g. the runner's empty labels in
+    # periodic checkpoints) — record their shapes/dtypes in the metadata and
+    # rebuild them at load instead.
+    empty = {
+        k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+        for k, v in arrays.items() if v.size == 0
+    }
+    arrays = {k: v for k, v in arrays.items() if v.size > 0}
+    fmt = "npz"
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(
+            os.path.join(os.path.abspath(path), "arrays"),
+            arrays,
+            force=True,
+        )
+        fmt = "orbax"
+    except Exception:
+        np.savez(os.path.join(path, "arrays.npz"), **arrays)
+
+    key_data = None
+    if key is not None:
+        import jax
+
+        key_data = np.asarray(jax.random.key_data(key)).tolist()
+    meta = {
+        "format": fmt,
+        "step": int(step),
+        "config": dataclasses.asdict(config) if config else None,
+        "key_data": key_data,
+        "empty_arrays": empty,
+        "extra": extra or {},
+    }
+    with open(os.path.join(path, _META), "w", encoding="utf-8") as f:
+        json.dump(meta, f, indent=2)
+
+    # Swap the finished tmp dir into place.  A crash mid-swap can leave
+    # <path>.old / .tmp litter but never a torn <path>.
+    old = final_path + ".old"
+    shutil.rmtree(old, ignore_errors=True)
+    if os.path.exists(final_path):
+        os.rename(final_path, old)
+    os.rename(path, final_path)
+    shutil.rmtree(old, ignore_errors=True)
+    return final_path
+
+
+def load_checkpoint(path: str) -> Tuple[Any, dict]:
+    """Returns ``(KMeansState, meta)``; ``meta['key']`` is a rebuilt PRNG key
+    when one was saved."""
+    from kmeans_tpu.models.lloyd import KMeansState
+
+    with open(os.path.join(path, _META), "r", encoding="utf-8") as f:
+        meta = json.load(f)
+
+    if meta["format"] == "orbax":
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        arrays = ckptr.restore(os.path.join(os.path.abspath(path), "arrays"))
+    else:
+        with np.load(os.path.join(path, "arrays.npz")) as z:
+            arrays = {k: z[k] for k in z.files}
+    for name, spec in (meta.get("empty_arrays") or {}).items():
+        arrays[name] = np.zeros(spec["shape"], dtype=spec["dtype"])
+
+    import jax.numpy as jnp
+
+    state = KMeansState(
+        jnp.asarray(arrays["centroids"]),
+        jnp.asarray(arrays["labels"]),
+        jnp.asarray(arrays["inertia"]),
+        jnp.asarray(arrays["n_iter"]),
+        jnp.asarray(arrays["converged"]),
+        jnp.asarray(arrays["counts"]),
+    )
+    if meta.get("key_data") is not None:
+        import jax
+
+        meta["key"] = jax.random.wrap_key_data(
+            jnp.asarray(np.asarray(meta["key_data"], dtype=np.uint32))
+        )
+    if meta.get("config"):
+        meta["config_obj"] = KMeansConfig(**meta["config"])
+    return state, meta
+
+
+def latest_step(path: str) -> Optional[int]:
+    try:
+        with open(os.path.join(path, _META), "r", encoding="utf-8") as f:
+            return int(json.load(f)["step"])
+    except (OSError, ValueError, KeyError):
+        return None

@@ -1,0 +1,58 @@
+"""Tracing / profiling hooks (SURVEY.md §5.1).
+
+The reference's only diagnostics are two ``console.warn`` sites
+(app.mjs:79,117).  The TPU build gets real tools:
+
+* :func:`trace` — context manager around ``jax.profiler.trace`` writing a
+  TensorBoard-loadable trace directory (kernel timeline, HBM, MXU util).
+* :class:`Timer` — lightweight named wall-clock sections with a summary,
+  used by the CLI and benchmarks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["trace", "Timer"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Profile everything inside the block into ``logdir``."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    """Named wall-clock sections: ``with timer.section("assign"): ...``."""
+
+    def __init__(self):
+        self.sections: Dict[str, List[float]] = {}
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.sections.setdefault(name, []).append(
+                time.perf_counter() - t0
+            )
+
+    def summary(self) -> Dict[str, dict]:
+        out = {}
+        for name, ts in self.sections.items():
+            out[name] = {
+                "count": len(ts),
+                "total_s": sum(ts),
+                "mean_s": sum(ts) / len(ts),
+                "max_s": max(ts),
+            }
+        return out

@@ -1,0 +1,104 @@
+"""LloydRunner observability + checkpoint/resume (SURVEY.md §5.1, §5.4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import LloydRunner, fit_lloyd
+from kmeans_tpu.utils import load_checkpoint, latest_step, save_checkpoint
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    x, _, _ = make_blobs(jax.random.key(0), 400, 6, 4, cluster_std=0.4)
+    return np.asarray(x)
+
+
+def test_runner_matches_fused_fit(blobs):
+    c0 = blobs[:4]
+    runner = LloydRunner(blobs, 4)
+    runner.init(c0)
+    state = runner.run(max_iter=20, tol=1e-10)
+    want = fit_lloyd(blobs, 4, init=c0, max_iter=20, tol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(state.centroids), np.asarray(want.centroids),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.labels), np.asarray(want.labels)
+    )
+    assert int(state.n_iter) == int(want.n_iter)
+    assert bool(state.converged) == bool(want.converged)
+
+
+def test_runner_callback_stream(blobs):
+    runner = LloydRunner(blobs, 4)
+    runner.init(blobs[:4])
+    infos = []
+    runner.run(max_iter=10, tol=1e-10, callback=infos.append)
+    assert len(infos) >= 2
+    assert [i.iteration for i in infos] == list(range(1, len(infos) + 1))
+    # inertia of the objective is monotone non-increasing across iterations
+    vals = [i.inertia for i in infos]
+    assert all(b <= a + 1e-3 for a, b in zip(vals, vals[1:]))
+    assert infos[-1].converged
+    assert all(i.seconds > 0 for i in infos)
+
+
+def test_runner_checkpoint_resume(tmp_path, blobs):
+    path = str(tmp_path / "ckpt")
+    r1 = LloydRunner(blobs, 4, config=KMeansConfig(k=4, seed=7))
+    r1.init(blobs[:4])
+    r1.run(max_iter=3, tol=0.0, checkpoint_path=path, checkpoint_every=1)
+    assert latest_step(path) == 3
+
+    r2 = LloydRunner(blobs, 4, config=KMeansConfig(k=4, seed=7))
+    assert r2.resume(path) == 3
+    np.testing.assert_allclose(
+        np.asarray(r2.centroids), np.asarray(r1.centroids), rtol=1e-6
+    )
+    # continuing from the checkpoint converges to the same answer as one
+    # uninterrupted run
+    s2 = r2.run(max_iter=30, tol=1e-10)
+    full = LloydRunner(blobs, 4)
+    full.init(blobs[:4])
+    sf = full.run(max_iter=33, tol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(s2.centroids), np.asarray(sf.centroids),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_checkpoint_round_trip_state(tmp_path, blobs):
+    state = fit_lloyd(blobs, 4, key=jax.random.key(1))
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, state, step=int(state.n_iter),
+                    config=KMeansConfig(k=4), key=jax.random.key(1))
+    restored, meta = load_checkpoint(path)
+    np.testing.assert_array_equal(
+        np.asarray(restored.centroids), np.asarray(state.centroids)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored.labels), np.asarray(state.labels)
+    )
+    assert meta["config_obj"].k == 4
+    assert "key" in meta
+    # restored key behaves identically
+    a = jax.random.normal(meta["key"], (3,))
+    b = jax.random.normal(jax.random.key(1), (3,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_runner_on_mesh_matches_single(blobs, cpu_devices):
+    from kmeans_tpu.parallel import cpu_mesh
+
+    mesh = cpu_mesh((4, 2))
+    r = LloydRunner(blobs, 4, mesh=mesh, model_axis="model")
+    r.init(blobs[:4])
+    state = r.run(max_iter=15, tol=1e-10)
+    want = fit_lloyd(blobs, 4, init=blobs[:4], max_iter=15, tol=1e-10)
+    np.testing.assert_array_equal(
+        np.asarray(state.labels), np.asarray(want.labels)
+    )

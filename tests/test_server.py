@@ -247,3 +247,50 @@ def test_room_table_is_bounded():
     s.room("FRESH")
     assert len(s.rooms) == _MAX_ROOMS
     assert "FRESH" in s.rooms and "R0" not in s.rooms
+
+
+def test_train_op_streams_and_updates_board(server):
+    import socket
+    import time as _time
+
+    room = "NNNN"
+    host, port = server.httpd.server_address
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.sendall(
+        f"GET /api/events?room={room} HTTP/1.1\r\n"
+        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
+    )
+    # Wait for the subscription's hello frame before mutating, else early
+    # train events can be broadcast before the subscriber is registered.
+    hello_buf = b""
+    while b'"type": "hello"' not in hello_buf:
+        hello_buf += sock.recv(4096)
+    st, out = _mutate(server, room, "train",
+                      {"n": 200, "d": 2, "k": 3, "max_iter": 10})
+    assert st == 200 and out["started"]
+
+    deadline = _time.time() + 30
+    buf = b""
+    while b"train_done" not in buf and _time.time() < deadline:
+        sock.settimeout(max(0.1, deadline - _time.time()))
+        try:
+            chunk = sock.recv(8192)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    sock.close()
+    assert b'"type": "train"' in buf, buf[:500]
+    assert b"train_done" in buf
+    # 2-D k=3 result was imported into the room board
+    _, _, body = _get(server, f"/api/state?room={room}")
+    state = json.loads(body)
+    assert len(state["cards"]) == 200
+    assert len(state["centroids"]) == 3
+    assert state["unassigned"] == 0
+
+
+def test_train_op_rejects_bad_shapes(server):
+    st, out = _mutate(server, "OOOO", "train", {"n": 2, "k": 10})
+    assert st == 400

@@ -60,7 +60,7 @@ def _make_data(n, d, seed=0, dtype="bfloat16", tile=32768):
 
 
 def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
-                            chunk_size=65536, verbose=False):
+                            chunk_size=65536, verbose=False, backend="auto"):
     """One Lloyd iteration rate, using ALL local devices (DP-sharded when
     more than one chip is present, so iter/s ÷ n_chips is honest)."""
     import functools
@@ -69,13 +69,19 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from kmeans_tpu.ops.lloyd import lloyd_pass
+    from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
     from kmeans_tpu.ops.update import apply_update
 
     x = _make_data(n, d)
     rng = np.random.default_rng(1)
     c0 = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32) * 3)
     n_dev = len(jax.devices())
+    backend = resolve_backend(
+        backend, x, k, compute_dtype="bfloat16",
+        platform=jax.devices()[0].platform,
+    )
+    if verbose:
+        print(f"  fused-pass backend: {backend}", file=sys.stderr)
 
     if n_dev > 1:
         from kmeans_tpu.parallel import make_mesh
@@ -88,6 +94,7 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
         local = functools.partial(
             _dp_local_pass, data_axis="data", chunk_size=chunk_size,
             compute_dtype="bfloat16", update="matmul", with_labels=False,
+            backend=backend,
         )
         step_sm = jax.shard_map(
             local, mesh=mesh,
@@ -104,7 +111,8 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
             # becomes an XLA constant and constant-folding a multi-GB
             # literal stalls compilation for minutes.
             _, _, sums, counts, _ = lloyd_pass(
-                x, c, chunk_size=chunk_size, compute_dtype="bfloat16"
+                x, c, chunk_size=chunk_size, compute_dtype="bfloat16",
+                backend=backend,
             )
             return apply_update(c, sums, counts)
 
@@ -134,6 +142,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--all", action="store_true", help="run all 5 configs")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--backend", default="auto",
+                    choices=("auto", "xla", "pallas"),
+                    help="fused-pass backend (auto = pallas on TPU when "
+                         "supported)")
     args = ap.parse_args()
 
     import jax
@@ -147,7 +159,8 @@ def main():
 
         for name, cfg in BENCH_CONFIGS.items():
             r = bench_lloyd_iters_per_s(
-                cfg["n"], cfg["d"], cfg["k"], iters=args.iters, verbose=True
+                cfg["n"], cfg["d"], cfg["k"], iters=args.iters, verbose=True,
+                backend=args.backend,
             )
             print(f"{name}: {r:.2f} Lloyd iter/s", file=sys.stderr)
 
@@ -155,7 +168,8 @@ def main():
     if dev.platform != "tpu":
         # CI/CPU fallback: scaled-down shape so the line still prints.
         rate = bench_lloyd_iters_per_s(
-            20_000, 256, 64, iters=args.iters, verbose=True
+            20_000, 256, 64, iters=args.iters, verbose=True,
+            backend=args.backend,
         )
         print(json.dumps({
             "metric": "lloyd_iters_per_sec_per_chip_cpu_fallback_20k_256_64",
@@ -165,7 +179,8 @@ def main():
         }))
         return
 
-    rate = bench_lloyd_iters_per_s(iters=args.iters, verbose=True)
+    rate = bench_lloyd_iters_per_s(iters=args.iters, verbose=True,
+                                   backend=args.backend)
     per_chip = rate / max(1, n_chips)
     print(json.dumps({
         "metric": "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000",

@@ -103,6 +103,10 @@ class KMeansConfig:
     #: Empty-cluster policy: "keep" (retain old centroid) or "farthest"
     #: (reseed to the currently-worst-fit points).
     empty: str = "keep"
+    #: Fused-pass backend: "auto" (hand-written Pallas kernel on TPU when its
+    #: alignment/VMEM/exactness gates pass, else the XLA scan), "xla", or
+    #: "pallas" (forced; raises when unsupported).
+    backend: str = "auto"
 
     # Minibatch engine.
     batch_size: int = 8192
@@ -117,6 +121,8 @@ class KMeansConfig:
             raise ValueError(f"unknown update {self.update!r}")
         if self.empty not in ("keep", "farthest"):
             raise ValueError(f"unknown empty-cluster policy {self.empty!r}")
+        if self.backend not in ("auto", "xla", "pallas"):
+            raise ValueError(f"unknown backend {self.backend!r}")
         if self.chunk_size < 1:
             raise ValueError("chunk_size must be positive")
         return self
@@ -153,6 +159,9 @@ class ServeConfig:
     port: int = 8787
     #: Cap on cards materialized into a browser-facing document.
     max_render_cards: int = 2000
+    #: Server-wide bound on concurrent `train` worker threads (the per-room
+    #: train_lock alone would let many rooms stack unbounded jobs).
+    max_concurrent_train: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

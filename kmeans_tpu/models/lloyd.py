@@ -23,7 +23,7 @@ from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
-from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = ["KMeansState", "fit_lloyd", "KMeans"]
@@ -43,7 +43,8 @@ class KMeansState(NamedTuple):
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "max_iter", "chunk_size", "compute_dtype", "update", "empty"
+        "max_iter", "chunk_size", "compute_dtype", "update", "empty",
+        "backend",
     ),
 )
 def _lloyd_loop(
@@ -57,12 +58,14 @@ def _lloyd_loop(
     compute_dtype,
     update,
     empty,
+    backend="xla",
 ):
     kw = dict(
         weights=weights,
         chunk_size=chunk_size,
         compute_dtype=compute_dtype,
         update=update,
+        backend=backend,
     )
 
     def cond(s):
@@ -131,6 +134,9 @@ def fit_lloyd(
             weights=weights,
             compute_dtype=cfg.compute_dtype,
         )
+    backend = resolve_backend(
+        cfg.backend, x, k, weights=weights, compute_dtype=cfg.compute_dtype,
+    )
     return _lloyd_loop(
         x,
         centroids0,
@@ -141,6 +147,7 @@ def fit_lloyd(
         compute_dtype=cfg.compute_dtype,
         update=cfg.update,
         empty=cfg.empty,
+        backend=backend,
     )
 
 
@@ -161,6 +168,7 @@ class KMeans:
     compute_dtype: Optional[str] = None
     update: str = "matmul"
     empty: str = "keep"
+    backend: str = "auto"
 
     state: Optional[KMeansState] = dataclasses.field(
         default=None, repr=False, compare=False
@@ -177,6 +185,7 @@ class KMeans:
             compute_dtype=self.compute_dtype,
             update=self.update,
             empty=self.empty,
+            backend=self.backend,
         )
 
     def fit(self, x, weights=None) -> "KMeans":

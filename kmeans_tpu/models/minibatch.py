@@ -25,7 +25,7 @@ from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
-from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 
 __all__ = ["fit_minibatch", "MiniBatchKMeans"]
 
@@ -34,7 +34,7 @@ __all__ = ["fit_minibatch", "MiniBatchKMeans"]
     jax.jit,
     static_argnames=(
         "batch_size", "steps", "chunk_size", "compute_dtype", "n_valid",
-        "with_final",
+        "with_final", "backend",
     ),
 )
 def _minibatch_loop(
@@ -48,6 +48,7 @@ def _minibatch_loop(
     compute_dtype,
     n_valid=None,
     with_final=True,
+    backend="xla",
 ):
     # n_valid < n means trailing rows are shard padding: never sample them.
     n = n_valid if n_valid is not None else x.shape[0]
@@ -97,7 +98,8 @@ def _minibatch_loop(
             jnp.zeros((k,), f32),
         )
     labels, _, _, counts, inertia = lloyd_pass(
-        x, centroids, chunk_size=chunk_size, compute_dtype=compute_dtype
+        x, centroids, chunk_size=chunk_size, compute_dtype=compute_dtype,
+        backend=backend,
     )
     return KMeansState(
         centroids,
@@ -158,6 +160,9 @@ def fit_minibatch(
         steps=steps if steps is not None else cfg.steps,
         chunk_size=cfg.chunk_size,
         compute_dtype=cfg.compute_dtype,
+        backend=resolve_backend(
+            cfg.backend, x, k, compute_dtype=cfg.compute_dtype,
+        ),
     )
 
 

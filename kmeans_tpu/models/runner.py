@@ -25,7 +25,7 @@ import numpy as np
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState
-from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = ["LloydRunner", "IterInfo"]
@@ -78,6 +78,10 @@ class LloydRunner:
         if mesh is None:
             self.x = jnp.asarray(x)
             cfg = self.cfg
+            self._backend = resolve_backend(
+                cfg.backend, self.x, k, compute_dtype=cfg.compute_dtype,
+            )
+            backend = self._backend
 
             @jax.jit
             def step(x, c):
@@ -86,6 +90,7 @@ class LloydRunner:
                     chunk_size=cfg.chunk_size,
                     compute_dtype=cfg.compute_dtype,
                     update=cfg.update,
+                    backend=backend,
                 )
                 new_c = apply_update(c, sums, counts)
                 if cfg.empty == "farthest":
@@ -113,11 +118,18 @@ class LloydRunner:
                 jnp.asarray(w_host), NamedSharding(mesh, P(data_axis))
             )
             if model_axis is None:
+                self._backend = resolve_backend(
+                    self.cfg.backend, self.x, k,
+                    weights_are_binary=True,
+                    compute_dtype=self.cfg.compute_dtype,
+                    platform=mesh.devices.flat[0].platform,
+                )
                 local = functools.partial(
                     _dp_local_pass, data_axis=data_axis,
                     chunk_size=self.cfg.chunk_size,
                     compute_dtype=self.cfg.compute_dtype,
                     update=self.cfg.update, with_labels=False,
+                    backend=self._backend,
                 )
                 in_specs = (P(data_axis), P(), P(data_axis))
                 out_specs = (P(), P(), P())
@@ -128,6 +140,8 @@ class LloydRunner:
                         f"(k={k}, model={axis_sizes[model_axis]}); use "
                         "fit_lloyd_sharded for automatic k padding"
                     )
+                # No Pallas variant of the TP local pass yet — XLA only.
+                self._backend = "xla"
                 local = functools.partial(
                     _tp_local_pass, data_axis=data_axis,
                     model_axis=model_axis, k_real=k,
@@ -213,6 +227,7 @@ class LloydRunner:
                 self.x, self.centroids,
                 chunk_size=self.cfg.chunk_size,
                 compute_dtype=self.cfg.compute_dtype,
+                backend=self._backend,
             )
         else:
             from kmeans_tpu.parallel.engine import sharded_assign

@@ -34,8 +34,65 @@ import jax.numpy as jnp
 from jax import lax
 
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
+from kmeans_tpu.ops.pallas_lloyd import lloyd_pass_pallas, pallas_supported
 
-__all__ = ["lloyd_pass"]
+__all__ = ["lloyd_pass", "resolve_backend"]
+
+
+def _platform_of(x, platform=None) -> str:
+    """Platform the computation will run on: an explicit hint, the committed
+    device of a concrete array, or the default backend (also correct for
+    tracers — tracing happens for the backend that will execute)."""
+    if platform is not None:
+        return platform
+    if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer):
+        try:
+            return next(iter(x.devices())).platform
+        except Exception:
+            pass
+    return jax.default_backend()
+
+
+def _pallas_ok(x, k, *, weights, weights_are_binary, compute_dtype,
+               platform=None) -> bool:
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    # The kernel's one-hot tile is cast to cd for the MXU — exact only for
+    # binary weights or f32 compute (mirrors the XLA path's eff_update
+    # demotion).
+    weights_ok = weights is None or weights_are_binary or cd == jnp.float32
+    return (
+        weights_ok
+        and _platform_of(x, platform) == "tpu"
+        and pallas_supported(
+            x.shape[0], x.shape[1], k,
+            x_itemsize=x.dtype.itemsize, cd_itemsize=cd.itemsize,
+        )
+    )
+
+
+def resolve_backend(
+    backend: str,
+    x,
+    k: int,
+    *,
+    weights=None,
+    weights_are_binary: bool = False,
+    compute_dtype=None,
+    platform: Optional[str] = None,
+) -> str:
+    """Resolve ``"auto"`` to a concrete ``"pallas"``/``"xla"`` choice.
+
+    Callers that know where the computation will run (e.g. the sharded
+    engine's mesh) pass ``platform`` explicitly; otherwise the committed
+    device of ``x`` or the default backend decides.
+    """
+    if backend != "auto":
+        return backend
+    ok = _pallas_ok(
+        x, k, weights=weights, weights_are_binary=weights_are_binary,
+        compute_dtype=compute_dtype, platform=platform,
+    )
+    return "pallas" if ok else "xla"
 
 
 def _pad_to_chunks(x, w, chunk_size):
@@ -47,13 +104,6 @@ def _pad_to_chunks(x, w, chunk_size):
     return x, w, n + pad
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "chunk_size", "compute_dtype", "update", "with_update",
-        "weights_are_binary",
-    ),
-)
 def lloyd_pass(
     x: jax.Array,
     centroids: jax.Array,
@@ -64,6 +114,7 @@ def lloyd_pass(
     update: str = "matmul",
     with_update: bool = True,
     weights_are_binary: bool = False,
+    backend: str = "xla",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """One fused assign(+reduce) sweep.
 
@@ -75,12 +126,59 @@ def lloyd_pass(
       compute_dtype: matmul input dtype (None = x.dtype); accumulate f32.
       update: "matmul" | "segment" reduction flavor for sums.
       with_update: when False, skip sums/counts (pure assignment pass).
+      backend: "xla" | "pallas" | "auto".  "pallas" runs the hand-written
+        Mosaic kernel (:mod:`kmeans_tpu.ops.pallas_lloyd`); "auto" picks it
+        on TPU whenever its alignment/VMEM/exactness gates pass, else XLA.
 
     Returns:
       (labels int32 [n], min_d2 f32 [n], sums f32 [k, d], counts f32 [k],
        inertia f32 scalar).  ``sums``/``counts`` are zeros when
       ``with_update=False``.
     """
+    if backend not in ("xla", "pallas", "auto"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if backend != "xla":
+        ok = _pallas_ok(
+            x, centroids.shape[0], weights=weights,
+            weights_are_binary=weights_are_binary,
+            compute_dtype=compute_dtype,
+        )
+        if backend == "pallas" and not ok:
+            raise ValueError(
+                "pallas backend unsupported here (needs TPU, d%128==0, "
+                "VMEM-resident (k,d), and binary weights unless f32)"
+            )
+        if ok:
+            return lloyd_pass_pallas(
+                x, centroids, weights=weights, compute_dtype=compute_dtype,
+                with_update=with_update,
+            )
+    return _lloyd_pass_xla(
+        x, centroids, weights=weights, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update=update, with_update=with_update,
+        weights_are_binary=weights_are_binary,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "chunk_size", "compute_dtype", "update", "with_update",
+        "weights_are_binary",
+    ),
+)
+def _lloyd_pass_xla(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    weights: Optional[jax.Array] = None,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+    update: str = "matmul",
+    with_update: bool = True,
+    weights_are_binary: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """XLA (lax.scan) implementation of the pass — see :func:`lloyd_pass`."""
     n, d = x.shape
     k = centroids.shape[0]
     f32 = jnp.float32

@@ -1,0 +1,221 @@
+"""Pallas TPU kernel for the fused Lloyd pass (assign + reduce, one sweep).
+
+Hand-written Mosaic/Pallas implementation of the same contract as
+:func:`kmeans_tpu.ops.lloyd.lloyd_pass` — the framework's hot op.  The XLA
+version tiles with ``lax.scan``; this kernel expresses the whole pass as one
+``pallas_call`` so each row tile makes exactly one trip HBM→VMEM and every
+intermediate (the (T, k) distance tile, the one-hot tile) lives and dies in
+VMEM:
+
+* grid = row tiles; ``x`` streams through VMEM with double buffering,
+* centroids (as a (d, k) resident operand), their squared norms, the
+  per-cluster ``sums``/``counts`` accumulators and the inertia scalar stay
+  pinned in VMEM/SMEM across the whole grid (constant ``index_map``),
+* the distance inner product and the one-hot update run on the MXU in the
+  compute dtype (bf16 by default) with float32 accumulation,
+* argmin / min / inertia run on the VPU.
+
+The kernel requires lane-aligned shapes (``d % 128 == 0``) and enough VMEM
+for the resident operands; :func:`pallas_supported` gates dispatch, and
+callers fall back to the XLA path otherwise (see
+:func:`kmeans_tpu.ops.lloyd.lloyd_pass` with ``backend="auto"``).
+
+The reference has no analog — its "assign" step is a human dragging a card
+(/root/reference/app.mjs:358-372) and its only numeric kernel is the
+O(n²·tokens) cohesion metric (app.mjs:462-475); this file exists for the
+north-star numeric engine (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kmeans_tpu.ops.distance import matmul_precision, sq_norms
+
+__all__ = ["lloyd_pass_pallas", "pallas_supported"]
+
+# Resident VMEM operands must fit comfortably; leave headroom for the
+# streamed x/label tiles and compiler temporaries.  Calibrated empirically on
+# a v5e chip: the north-star shape (d=2048, k=1000) compiles and runs at
+# block_rows=512 (estimate ~22 MiB) and overflows at 1024 (~31 MiB).
+_VMEM_BUDGET = 23 * 1024 * 1024
+
+_LANE = 128
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def _vmem_estimate(block_rows: int, d: int, k_pad: int, x_itemsize: int,
+                   cd_itemsize: int) -> int:
+    c_t = d * k_pad * cd_itemsize                 # resident (d, k) centroids
+    sums = k_pad * d * 4                          # resident f32 accumulator
+    counts = k_pad * 4
+    x_tile = 2 * block_rows * d * x_itemsize      # double-buffered stream
+    prod = block_rows * k_pad * 4                 # (T, k) distance tile
+    onehot = block_rows * k_pad * (4 + cd_itemsize)
+    return c_t + sums + counts + x_tile + prod + onehot
+
+
+def pallas_supported(n: int, d: int, k: int, *, block_rows: int = 512,
+                     x_itemsize: int = 2, cd_itemsize: int = 2) -> bool:
+    """Whether the kernel's alignment and VMEM constraints hold.
+
+    ``d`` must be a multiple of the 128-lane width (padding the feature axis
+    would cost a full copy of ``x``); the resident operands must fit the
+    VMEM budget.  ``n``/``k`` are padded internally, so any value works.
+    """
+    if d % _LANE:
+        return False
+    k_pad = _round_up(k, _LANE)
+    est = _vmem_estimate(block_rows, d, k_pad, x_itemsize, cd_itemsize)
+    return est <= _VMEM_BUDGET
+
+
+def _kernel(x_ref, w_ref, ct_ref, csq_ref,
+            labels_ref, mind_ref, sums_ref, counts_ref,
+            *, cd, with_update):
+    """One row tile: distances on the MXU, argmin on the VPU, accumulate."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        # Zero even when with_update=False — the contract returns zero
+        # sums/counts for a pure assignment pass.
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    xb = x_ref[:]                                  # (T, d) original dtype
+    xb_c = xb.astype(cd)
+    w = w_ref[:][:, 0]                             # (T,) f32
+    t, _ = xb.shape
+    k_pad = ct_ref.shape[1]
+
+    # argmin_k ||x-c||² == argmin_k (||c||² - 2 x·c); padded columns carry
+    # csq=+inf so they can never win.
+    prod = jnp.dot(xb_c, ct_ref[:], preferred_element_type=jnp.float32,
+                   precision=matmul_precision(cd))
+    part = csq_ref[:] - 2.0 * prod                 # (1,k)+(T,k) -> (T, k_pad)
+    part_min = jnp.min(part, axis=1)               # (T,)
+    # argmin with lowest-index tie-break, spelled as an integer min over the
+    # columns that achieve the row minimum (Mosaic has no argmin lowering).
+    cols = jax.lax.broadcasted_iota(jnp.int32, part.shape, 1)
+    labels = jnp.min(
+        jnp.where(part <= part_min[:, None], cols, k_pad), axis=1
+    ).astype(jnp.int32)
+    xf = xb.astype(jnp.float32)
+    row_sq = jnp.sum(xf * xf, axis=1)
+    mind = jnp.maximum(part_min + row_sq, 0.0)
+
+    labels_ref[:] = labels[:, None]
+    mind_ref[:] = mind[:, None]
+    # Inertia (Σ w·min_d2) is finished outside the kernel from the mind
+    # output — a scalar VPU reduction here trips a Mosaic layout bug on
+    # 1-sublane vectors, and the XLA epilogue costs one O(n) fused read.
+
+    if with_update:
+        onehot = (labels[:, None] == cols)
+        wt = onehot * w[:, None]                   # (T, k_pad) f32
+        counts_ref[:] += jnp.sum(wt, axis=0, keepdims=True)
+        # Update numerator on the MXU: wtᵀ (k, T) @ x (T, d).  The cd cast is
+        # exact for the 0/1 weights this path is gated to (see lloyd_pass
+        # dispatch) or when cd is f32.
+        sums_ref[:] += jax.lax.dot_general(
+            wt.astype(cd), xb_c,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(cd),
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_rows", "compute_dtype", "with_update",
+                     "interpret"),
+)
+def lloyd_pass_pallas(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    weights: Optional[jax.Array] = None,
+    block_rows: int = 512,
+    compute_dtype=None,
+    with_update: bool = True,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused assign(+reduce) sweep as a single Pallas kernel.
+
+    Same contract as :func:`kmeans_tpu.ops.lloyd.lloyd_pass`: returns
+    ``(labels int32 [n], min_d2 f32 [n], sums f32 [k, d], counts f32 [k],
+    inertia f32 scalar)``.  Requires ``d % 128 == 0``.
+
+    Fractional weights: the one-hot tile is cast to ``compute_dtype`` for the
+    MXU, so non-binary weights need ``compute_dtype=float32`` for exactness —
+    the auto dispatcher enforces this.
+    """
+    n, d = x.shape
+    k = centroids.shape[0]
+    if d % _LANE:
+        raise ValueError(f"pallas lloyd pass needs d % {_LANE} == 0, got {d}")
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    t = block_rows
+    n_pad = _round_up(max(n, 1), t)
+    k_pad = _round_up(k, _LANE)
+
+    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    if n_pad != n:
+        x = jnp.concatenate([x, jnp.zeros((n_pad - n, d), x.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((n_pad - n,), f32)])
+    n_chunks = n_pad // t
+
+    c_t = centroids.astype(cd).T                   # (d, k)
+    c_sq = sq_norms(centroids)                     # (k,) f32
+    if k_pad != k:
+        c_t = jnp.concatenate([c_t, jnp.zeros((d, k_pad - k), cd)], axis=1)
+        c_sq = jnp.concatenate(
+            [c_sq, jnp.full((k_pad - k,), jnp.inf, f32)]
+        )
+
+    grid = (n_chunks,)
+    kernel = functools.partial(_kernel, cd=cd, with_update=with_update)
+    labels, min_d2, sums, counts = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), f32),
+            jax.ShapeDtypeStruct((k_pad, d), f32),
+            jax.ShapeDtypeStruct((1, k_pad), f32),
+        ],
+        interpret=interpret,
+    )(x, w[:, None], c_t, c_sq[None, :])
+
+    labels = labels[:n, 0]
+    min_d2 = min_d2[:n, 0]
+    inertia = jnp.sum(min_d2 * w[:n])
+    return labels, min_d2, sums[:k], counts[0, :k], inertia

@@ -37,7 +37,7 @@ from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
-from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 from kmeans_tpu.ops.update import apply_update
 
 __all__ = ["fit_lloyd_sharded", "fit_minibatch_sharded", "sharded_assign"]
@@ -48,7 +48,7 @@ __all__ = ["fit_lloyd_sharded", "fit_minibatch_sharded", "sharded_assign"]
 # ---------------------------------------------------------------------------
 
 def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
-                   update, with_labels):
+                   update, with_labels, backend="xla"):
     """DP shard body: fused local pass + psum merge; centroids replicated."""
     labels, _, sums, counts, inertia = lloyd_pass(
         x_loc, c,
@@ -57,6 +57,7 @@ def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
         compute_dtype=compute_dtype,
         update=update,
         weights_are_binary=True,
+        backend=backend,
     )
     sums = lax.psum(sums, data_axis)
     counts = lax.psum(counts, data_axis)
@@ -222,9 +223,17 @@ def fit_lloyd_sharded(
 
     tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
     max_it = max_iter if max_iter is not None else cfg.max_iter
+    # Resolve the fused-pass backend against the *mesh's* platform (the
+    # default backend may differ, e.g. virtual-CPU-mesh tests on a TPU host).
+    # The TP local pass has no Pallas variant yet, so DP-only meshes decide.
+    backend = "xla" if model_axis else resolve_backend(
+        cfg.backend, x, k, weights_are_binary=True, weights=w_host,
+        compute_dtype=cfg.compute_dtype,
+        platform=mesh.devices.flat[0].platform,
+    )
     run = _build_lloyd_run(
         mesh, data_axis, model_axis, k, cfg.chunk_size, cfg.compute_dtype,
-        cfg.update, max_it,
+        cfg.update, max_it, backend,
     )
     c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
     return KMeansState(
@@ -234,7 +243,7 @@ def fit_lloyd_sharded(
 
 @functools.lru_cache(maxsize=64)
 def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
-                     compute_dtype, update, max_it):
+                     compute_dtype, update, max_it, backend="xla"):
     """Jitted whole-fit program, cached so repeated same-shaped fits reuse
     the compiled executable (jax.jit caches by function identity)."""
     if model_axis is None:
@@ -244,6 +253,7 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
             chunk_size=chunk_size,
             compute_dtype=compute_dtype,
             update=update,
+            backend=backend,
         )
         in_specs = (P(data_axis), P(), P(data_axis))
         out_step = (P(), P(), P())
@@ -302,15 +312,21 @@ def sharded_assign(
     data_axis: str = "data",
     chunk_size: int = 4096,
     compute_dtype=None,
+    backend: str = "auto",
 ):
     """Labels + min-squared-distances for sharded points, replicated centroids."""
     x, w_host, n = _pad_rows(x, dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis])
     x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    backend = resolve_backend(
+        backend, x, np.asarray(centroids).shape[0],
+        compute_dtype=compute_dtype,
+        platform=mesh.devices.flat[0].platform,
+    )
 
     def local(x_loc, c):
         labels, mind, _, _, _ = lloyd_pass(
             x_loc, c, chunk_size=chunk_size, compute_dtype=compute_dtype,
-            with_update=False,
+            with_update=False, backend=backend,
         )
         return labels, mind
 

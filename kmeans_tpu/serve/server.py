@@ -185,6 +185,9 @@ class KMeansServer:
 
     def __init__(self, config: Optional[ServeConfig] = None):
         self.config = config or ServeConfig()
+        self._train_sem = threading.BoundedSemaphore(
+            self.config.max_concurrent_train
+        )
         self.rooms: Dict[str, _Room] = {}
         self._lock = threading.Lock()
         self.httpd: Optional[ThreadingHTTPServer] = None
@@ -299,14 +302,23 @@ class KMeansServer:
         the result replaces the room's board as an importable document."""
         import numpy as np
 
-        n = min(int(args.get("n", 2000)), 200_000)
-        d = min(int(args.get("d", 2)), 4096)
-        k = min(int(args.get("k", 3)), 1000)
-        max_iter = min(int(args.get("max_iter", 30)), 300)
+        n = min(int(args.get("n", 2000)), 100_000)
+        d = min(int(args.get("d", 2)), 512)
+        k = min(int(args.get("k", 3)), 100)
+        max_iter = min(int(args.get("max_iter", 30)), 100)
         seed = int(args.get("seed", 0))
         if n < k or n < 1 or d < 1 or k < 1:
             raise ValueError("invalid train shape")
+        # Bound the data volume a single unauthenticated request can demand
+        # (the endpoint exists for the teaching-game scale, n=500 d=2 k=3).
+        if n * d > 8_000_000:
+            raise ValueError("train shape too large: n*d must be <= 8e6")
+        # One training per room AND a server-wide concurrency bound, so many
+        # rooms can't stack unbounded worker threads.
+        if not self._train_sem.acquire(blocking=False):
+            raise ValueError("server training capacity exhausted; retry later")
         if not room.train_lock.acquire(blocking=False):
+            self._train_sem.release()
             raise ValueError("training already running in this room")
 
         def work():
@@ -349,6 +361,7 @@ class KMeansServer:
                 room.broadcast_event({"type": "train_error", "error": str(e)})
             finally:
                 room.train_lock.release()
+                self._train_sem.release()
 
         threading.Thread(target=work, daemon=True).start()
         return {"started": True, "n": n, "d": d, "k": k}

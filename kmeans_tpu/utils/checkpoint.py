@@ -109,11 +109,26 @@ def save_checkpoint(
     return final_path
 
 
+def _resolve_dir(path: str) -> str:
+    """The checkpoint dir to read: ``<path>``, else the ``<path>.old`` kept
+    during the save swap.  A crash between the two renames in
+    :func:`save_checkpoint` leaves only ``.old`` — which holds the previous
+    complete checkpoint, so resuming from it is always safe."""
+    if os.path.exists(os.path.join(path, _META)):
+        return path
+    old = path + ".old"
+    if os.path.exists(os.path.join(old, _META)):
+        return old
+    return path
+
+
 def load_checkpoint(path: str) -> Tuple[Any, dict]:
     """Returns ``(KMeansState, meta)``; ``meta['key']`` is a rebuilt PRNG key
-    when one was saved."""
+    when one was saved.  Falls back to ``<path>.old`` when a crash during a
+    save swap left no directory at ``<path>``."""
     from kmeans_tpu.models.lloyd import KMeansState
 
+    path = _resolve_dir(path)
     with open(os.path.join(path, _META), "r", encoding="utf-8") as f:
         meta = json.load(f)
 
@@ -151,7 +166,9 @@ def load_checkpoint(path: str) -> Tuple[Any, dict]:
 
 def latest_step(path: str) -> Optional[int]:
     try:
-        with open(os.path.join(path, _META), "r", encoding="utf-8") as f:
+        with open(
+            os.path.join(_resolve_dir(path), _META), "r", encoding="utf-8"
+        ) as f:
             return int(json.load(f)["step"])
     except (OSError, ValueError, KeyError):
         return None

@@ -1,0 +1,91 @@
+"""Pallas fused-pass kernel vs the XLA scan path (SURVEY.md §7 hard part a).
+
+The CI mesh is CPU (conftest pins jax to the virtual CPU platform), so the
+kernel runs in interpreter mode here — same lowering-independent semantics,
+exact f32 arithmetic.  The compiled Mosaic path is exercised on real TPU by
+the driver's compile check and ``bench.py`` (backend=auto).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
+from kmeans_tpu.ops.pallas_lloyd import lloyd_pass_pallas, pallas_supported
+
+
+def _pair(rng, n, d, k):
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32) * 2)
+    c = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32) * 2)
+    return x, c
+
+
+@pytest.mark.parametrize(
+    "n,d,k",
+    [
+        (100, 128, 3),      # n < block_rows, k < lane width
+        (257, 256, 130),    # ragged n, k just past one lane tile
+        (1030, 128, 7),     # multiple row tiles, ragged tail
+    ],
+)
+def test_pallas_matches_xla(rng, n, d, k):
+    x, c = _pair(rng, n, d, k)
+    want = lloyd_pass(x, c)
+    got = lloyd_pass_pallas(x, c, interpret=True)
+    names = ("labels", "min_d2", "sums", "counts", "inertia")
+    for w, g, name in zip(want, got, names):
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(g), rtol=2e-5, atol=2e-5, err_msg=name
+        )
+
+
+def test_pallas_binary_weights_and_padding(rng):
+    x, c = _pair(rng, 500, 128, 9)
+    w = jnp.asarray((rng.random(500) > 0.4).astype(np.float32))
+    want = lloyd_pass(x, c, weights=w, weights_are_binary=True)
+    got = lloyd_pass_pallas(x, c, weights=w, interpret=True)
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5
+        )
+    # Zero-weight rows still get labels (parity with the XLA pass).
+    assert got[0].shape == (500,)
+
+
+def test_pallas_assignment_only(rng):
+    x, c = _pair(rng, 300, 128, 5)
+    labels, mind, sums, counts, inertia = lloyd_pass_pallas(
+        x, c, with_update=False, interpret=True
+    )
+    wl, wm, _, _, wi = lloyd_pass(x, c, with_update=False)
+    np.testing.assert_array_equal(np.asarray(labels), np.asarray(wl))
+    np.testing.assert_allclose(np.asarray(mind), np.asarray(wm), rtol=2e-5)
+    assert float(jnp.sum(jnp.abs(sums))) == 0.0
+    assert float(jnp.sum(counts)) == 0.0
+    np.testing.assert_allclose(float(inertia), float(wi), rtol=2e-5)
+
+
+def test_pallas_requires_lane_aligned_d(rng):
+    x, c = _pair(rng, 64, 100, 3)
+    with pytest.raises(ValueError, match="d % 128"):
+        lloyd_pass_pallas(x, c, interpret=True)
+
+
+def test_pallas_supported_gates():
+    assert pallas_supported(10_000, 2048, 1000)        # north-star shape
+    assert not pallas_supported(10_000, 100, 10)       # d not lane-aligned
+    assert not pallas_supported(10_000, 8192, 8192)    # (k, d) > VMEM budget
+
+
+def test_resolve_backend_on_cpu_falls_back():
+    x = jnp.zeros((64, 128), jnp.float32)
+    assert resolve_backend("auto", x, 4, platform="cpu") == "xla"
+    assert resolve_backend("xla", x, 4, platform="tpu") == "xla"
+    assert resolve_backend("pallas", x, 4, platform="cpu") == "pallas"
+
+
+def test_forced_pallas_raises_when_unsupported(rng):
+    x, c = _pair(rng, 64, 100, 3)                      # d % 128 != 0
+    with pytest.raises(ValueError, match="pallas backend unsupported"):
+        lloyd_pass(x, c, backend="pallas")

@@ -102,3 +102,23 @@ def test_runner_on_mesh_matches_single(blobs, cpu_devices):
     np.testing.assert_array_equal(
         np.asarray(state.labels), np.asarray(want.labels)
     )
+
+
+def test_load_falls_back_to_old_after_crashed_swap(blobs, tmp_path):
+    """A kill between save_checkpoint's two renames leaves only <path>.old;
+    load_checkpoint/latest_step must recover from it."""
+    import os
+
+    from kmeans_tpu.utils.checkpoint import latest_step
+
+    state = fit_lloyd(blobs, 4, key=jax.random.key(1))
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, state, step=7, config=KMeansConfig(k=4))
+    # Simulate the crash window: <path> renamed away, new tmp never landed.
+    os.rename(path, path + ".old")
+    assert latest_step(path) == 7
+    restored, meta = load_checkpoint(path)
+    assert meta["step"] == 7
+    np.testing.assert_array_equal(
+        np.asarray(restored.centroids), np.asarray(state.centroids)
+    )

@@ -212,6 +212,12 @@ def lloyd_pass_pallas(
             jax.ShapeDtypeStruct((k_pad, d), f32),
             jax.ShapeDtypeStruct((1, k_pad), f32),
         ],
+        # The default scoped-VMEM limit (16 MiB when this call is nested in a
+        # larger program, e.g. the whole-fit while_loop) is below the budget
+        # this kernel is gated on; raise it to budget + headroom explicitly.
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET + 8 * 1024 * 1024,
+        ),
         interpret=interpret,
     )(x, w[:, None], c_t, c_sq[None, :])
 

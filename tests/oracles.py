@@ -54,3 +54,105 @@ def inertia(x: np.ndarray, c: np.ndarray, weights: np.ndarray | None = None):
     _, mind = assign(x, c)
     w = np.ones(len(x)) if weights is None else weights
     return float(np.sum(w * mind))
+
+
+# ---------------------------------------------------------------------------
+# Cluster-quality metric oracles (naive O(n²) definitions)
+# ---------------------------------------------------------------------------
+
+def silhouette(x: np.ndarray, labels: np.ndarray) -> float:
+    n = len(x)
+    dist = np.sqrt(np.maximum(sq_dists(x, x), 0.0))
+    s = np.zeros(n)
+    for i in range(n):
+        own = labels == labels[i]
+        n_own = own.sum()
+        if n_own <= 1:
+            s[i] = 0.0
+            continue
+        a = dist[i][own].sum() / (n_own - 1)
+        b = np.inf
+        for l in np.unique(labels):
+            if l == labels[i]:
+                continue
+            mask = labels == l
+            if mask.sum() > 0:
+                b = min(b, dist[i][mask].mean())
+        s[i] = (b - a) / max(a, b)
+    return float(np.mean(s))
+
+
+def davies_bouldin(x: np.ndarray, labels: np.ndarray, c: np.ndarray) -> float:
+    ks = [j for j in range(len(c)) if np.any(labels == j)]
+    scatter = {
+        j: float(np.mean(np.linalg.norm(x[labels == j] - c[j], axis=1)))
+        for j in ks
+    }
+    vals = []
+    for i in ks:
+        worst = 0.0
+        for j in ks:
+            if i == j:
+                continue
+            m = np.linalg.norm(c[i] - c[j])
+            worst = max(worst, (scatter[i] + scatter[j]) / m)
+        vals.append(worst)
+    return float(np.mean(vals))
+
+
+def calinski_harabasz(x: np.ndarray, labels: np.ndarray,
+                      c: np.ndarray) -> float:
+    n = len(x)
+    ks = [j for j in range(len(c)) if np.any(labels == j)]
+    mean_all = x.mean(axis=0)
+    bss = sum(
+        (labels == j).sum() * np.sum((c[j] - mean_all) ** 2) for j in ks
+    )
+    wss = sum(
+        np.sum((x[labels == j] - c[j]) ** 2) for j in ks
+    )
+    k_eff = len(ks)
+    return float((bss / (k_eff - 1)) / (wss / (n - k_eff)))
+
+
+def adjusted_rand(a: np.ndarray, b: np.ndarray) -> float:
+    n = len(a)
+    ka, kb = a.max() + 1, b.max() + 1
+    c = np.zeros((ka, kb))
+    for i in range(n):
+        c[a[i], b[i]] += 1
+
+    def comb2(v):
+        return v * (v - 1) / 2.0
+
+    sum_ij = comb2(c).sum()
+    sum_a = comb2(c.sum(axis=1)).sum()
+    sum_b = comb2(c.sum(axis=0)).sum()
+    total = comb2(n)
+    exp = sum_a * sum_b / total
+    mx = 0.5 * (sum_a + sum_b)
+    if abs(mx - exp) < 1e-12:
+        return 1.0
+    return float((sum_ij - exp) / (mx - exp))
+
+
+def nmi(a: np.ndarray, b: np.ndarray) -> float:
+    n = len(a)
+    ka, kb = a.max() + 1, b.max() + 1
+    c = np.zeros((ka, kb))
+    for i in range(n):
+        c[a[i], b[i]] += 1
+    p = c / n
+    pa, pb = p.sum(axis=1), p.sum(axis=0)
+    mi = 0.0
+    for i in range(ka):
+        for j in range(kb):
+            if p[i, j] > 0:
+                mi += p[i, j] * np.log(p[i, j] / (pa[i] * pb[j]))
+
+    def ent(q):
+        q = q[q > 0]
+        return -np.sum(q * np.log(q))
+
+    denom = 0.5 * (ent(pa) + ent(pb))
+    return float(mi / denom) if denom > 0 else 1.0

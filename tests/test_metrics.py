@@ -1,0 +1,131 @@
+"""Numeric cluster-quality metrics vs naive NumPy oracles."""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import metrics as M
+from tests import oracles
+
+
+@pytest.fixture()
+def blobs(rng):
+    k, d, per = 4, 8, 30
+    centers = rng.normal(size=(k, d)) * 6
+    x = np.concatenate(
+        [centers[j] + rng.normal(size=(per, d)) for j in range(k)]
+    ).astype(np.float32)
+    labels = np.repeat(np.arange(k), per).astype(np.int32)
+    return x, labels, centers.astype(np.float32), k
+
+
+def test_silhouette_matches_oracle(blobs):
+    x, labels, _, k = blobs
+    got = float(M.silhouette_score(x, labels, k=k, chunk_size=32))
+    want = oracles.silhouette(x, labels)
+    assert got == pytest.approx(want, abs=1e-4)
+
+
+def test_silhouette_sampled_close(blobs, rng):
+    x, labels, _, k = blobs
+    exact = float(M.silhouette_score(x, labels, k=k))
+    import jax
+
+    sampled = float(M.silhouette_score(
+        x, labels, k=k, sample_size=60, key=jax.random.key(1)
+    ))
+    # Sample-vs-population estimator: close on well-separated blobs.
+    assert sampled == pytest.approx(exact, abs=0.1)
+
+
+def test_silhouette_random_labels_near_zero(rng):
+    x = rng.normal(size=(120, 5)).astype(np.float32)
+    labels = rng.integers(0, 3, size=120).astype(np.int32)
+    got = float(M.silhouette_score(x, labels, k=3, chunk_size=64))
+    want = oracles.silhouette(x, labels)
+    assert got == pytest.approx(want, abs=1e-4)
+    assert abs(got) < 0.2
+
+
+def test_davies_bouldin_matches_oracle(blobs):
+    x, labels, c, _ = blobs
+    # Small chunk_size exercises the scan tiling + padding path.
+    got = float(M.davies_bouldin_score(x, labels, c, chunk_size=32))
+    want = oracles.davies_bouldin(x, labels, c)
+    assert got == pytest.approx(want, rel=1e-4)
+
+
+def test_dispersion_scores_single_pass_pair(blobs):
+    x, labels, c, _ = blobs
+    db, ch = M.dispersion_scores(x, labels, c, chunk_size=50)
+    assert float(db) == pytest.approx(oracles.davies_bouldin(x, labels, c),
+                                      rel=1e-4)
+    assert float(ch) == pytest.approx(
+        oracles.calinski_harabasz(x, labels, c), rel=1e-3
+    )
+
+
+def test_davies_bouldin_skips_empty_cluster(blobs):
+    x, labels, c, k = blobs
+    c5 = np.concatenate([c, np.full((1, c.shape[1]), 1e3, np.float32)])
+    got = float(M.davies_bouldin_score(x, labels, c5))
+    want = oracles.davies_bouldin(x, labels, c)  # empty cluster ignored
+    assert got == pytest.approx(want, rel=1e-4)
+
+
+def test_calinski_harabasz_matches_oracle(blobs):
+    x, labels, c, _ = blobs
+    got = float(M.calinski_harabasz_score(x, labels, c))
+    want = oracles.calinski_harabasz(x, labels, c)
+    assert got == pytest.approx(want, rel=1e-3)
+
+
+def test_ari_identical_and_permuted(blobs, rng):
+    _, labels, _, k = blobs
+    assert float(M.adjusted_rand_index(labels, labels)) == pytest.approx(1.0)
+    perm = rng.permutation(k).astype(np.int32)
+    assert float(
+        M.adjusted_rand_index(labels, perm[labels])
+    ) == pytest.approx(1.0)
+
+
+def test_ari_matches_oracle(rng):
+    a = rng.integers(0, 4, size=200).astype(np.int32)
+    b = rng.integers(0, 3, size=200).astype(np.int32)
+    got = float(M.adjusted_rand_index(a, b))
+    want = oracles.adjusted_rand(a, b)
+    assert got == pytest.approx(want, abs=1e-5)
+    assert abs(got) < 0.1  # independent labelings
+
+
+def test_nmi_matches_oracle(rng):
+    a = rng.integers(0, 4, size=200).astype(np.int32)
+    b = rng.integers(0, 3, size=200).astype(np.int32)
+    got = float(M.normalized_mutual_info(a, b))
+    want = oracles.nmi(a, b)
+    assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_nmi_identical_is_one(blobs):
+    _, labels, _, _ = blobs
+    assert float(
+        M.normalized_mutual_info(labels, labels)
+    ) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_metrics_prefer_true_clustering(blobs, rng):
+    """All three internal metrics rank the true labeling above a random one."""
+    x, labels, c, k = blobs
+    rand_labels = rng.integers(0, k, size=len(x)).astype(np.int32)
+    rand_c = np.stack(
+        [x[rand_labels == j].mean(axis=0) for j in range(k)]
+    ).astype(np.float32)
+
+    assert float(M.silhouette_score(x, labels, k=k)) > float(
+        M.silhouette_score(x, rand_labels, k=k)
+    )
+    assert float(M.davies_bouldin_score(x, labels, c)) < float(
+        M.davies_bouldin_score(x, rand_labels, rand_c)
+    )
+    assert float(M.calinski_harabasz_score(x, labels, c)) > float(
+        M.calinski_harabasz_score(x, rand_labels, rand_c)
+    )

@@ -23,8 +23,11 @@ from kmeans_tpu.models import (
     KMeans,
     KMeansState,
     MiniBatchKMeans,
+    SphericalKMeans,
     fit_lloyd,
+    fit_lloyd_accelerated,
     fit_minibatch,
+    fit_spherical,
 )
 
 __all__ = [
@@ -35,7 +38,10 @@ __all__ = [
     "KMeans",
     "KMeansState",
     "MiniBatchKMeans",
+    "SphericalKMeans",
     "fit_lloyd",
+    "fit_lloyd_accelerated",
     "fit_minibatch",
+    "fit_spherical",
     "__version__",
 ]

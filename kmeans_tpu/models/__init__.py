@@ -1,9 +1,16 @@
-"""Model families: full-batch Lloyd, minibatch, and initialization."""
+"""Model families: full-batch Lloyd (plain + accelerated), minibatch,
+spherical (cosine), and initialization."""
 
+from kmeans_tpu.models.accelerated import fit_lloyd_accelerated
 from kmeans_tpu.models.init import init_centroids, kmeans_plus_plus, random_init
 from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.runner import IterInfo, LloydRunner
+from kmeans_tpu.models.spherical import (
+    SphericalKMeans,
+    fit_spherical,
+    normalize_rows,
+)
 
 __all__ = [
     "IterInfo",
@@ -14,6 +21,10 @@ __all__ = [
     "KMeans",
     "KMeansState",
     "fit_lloyd",
+    "fit_lloyd_accelerated",
     "MiniBatchKMeans",
     "fit_minibatch",
+    "SphericalKMeans",
+    "fit_spherical",
+    "normalize_rows",
 ]

@@ -24,7 +24,8 @@ from jax import lax
 
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
-__all__ = ["random_init", "kmeans_plus_plus", "init_centroids"]
+__all__ = ["random_init", "kmeans_plus_plus", "init_centroids",
+           "resolve_fit_inputs"]
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -115,3 +116,41 @@ def init_centroids(
     if method == "random":
         return random_init(key, x, k, weights=weights)
     raise ValueError(f"unknown init method {method!r}")
+
+
+def resolve_fit_inputs(x, k, key, config, init, weights):
+    """Shared fit-entry-point boilerplate: validated config, PRNG key, and
+    starting centroids.
+
+    Every ``fit_*`` front door (Lloyd, accelerated, spherical) needs the same
+    resolution: config-vs-k consistency, k >= 1, key from the config seed,
+    and ``init`` as either a (k, d) array (shape-checked) or a method name
+    routed through :func:`init_centroids`.  One copy here so the checks can't
+    drift between model families.
+
+    Returns ``(cfg, key, c0_float32)``.
+    """
+    from kmeans_tpu.config import KMeansConfig
+
+    cfg = (config or KMeansConfig(k=k)).validate()
+    if config is not None and config.k != k:
+        raise ValueError(
+            f"k={k} contradicts config.k={config.k}; pass matching values"
+        )
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if key is None:
+        key = jax.random.key(cfg.seed)
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, x.shape[1]):
+            raise ValueError(
+                f"init centroids shape {c0.shape} != {(k, x.shape[1])}"
+            )
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        c0 = init_centroids(
+            key, x, k, method=method, weights=weights,
+            compute_dtype=cfg.compute_dtype,
+        )
+    return cfg, key, c0

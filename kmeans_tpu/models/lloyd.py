@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
-from kmeans_tpu.models.init import init_centroids
+from kmeans_tpu.models.init import resolve_fit_inputs
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
@@ -77,7 +77,10 @@ def _lloyd_loop(
         labels, min_d2, sums, counts, _ = lloyd_pass(x, c, **kw)
         new_c = apply_update(c, sums, counts)
         if empty == "farthest":
-            new_c = reseed_empty_farthest(new_c, counts, x, min_d2)
+            mind = min_d2 if weights is None else jnp.where(
+                weights > 0, min_d2, -jnp.inf
+            )
+            new_c = reseed_empty_farthest(new_c, counts, x, mind)
         shift_sq = jnp.sum((new_c - c) ** 2)
         return (new_c, it + 1, shift_sq, shift_sq <= tol)
 
@@ -109,31 +112,7 @@ def fit_lloyd(
     ``init`` may be an (k, d) array of starting centroids (overrides
     ``config.init``) or a method name.
     """
-    cfg = (config or KMeansConfig(k=k)).validate()
-    if config is not None and config.k != k:
-        raise ValueError(
-            f"k={k} contradicts config.k={config.k}; pass matching values"
-        )
-    if k < 1:
-        raise ValueError(f"k must be >= 1, got {k}")
-    if key is None:
-        key = jax.random.key(cfg.seed)
-    if isinstance(init, (jnp.ndarray, jax.Array)) or (
-        init is not None and not isinstance(init, str)
-    ):
-        centroids0 = jnp.asarray(init, jnp.float32)
-        if centroids0.shape != (k, x.shape[1]):
-            raise ValueError(
-                f"init centroids shape {centroids0.shape} != {(k, x.shape[1])}"
-            )
-    else:
-        method = init if isinstance(init, str) else cfg.init
-        centroids0 = init_centroids(
-            key, x, k,
-            method=method,
-            weights=weights,
-            compute_dtype=cfg.compute_dtype,
-        )
+    cfg, key, centroids0 = resolve_fit_inputs(x, k, key, config, init, weights)
     backend = resolve_backend(
         cfg.backend, x, k, weights=weights, compute_dtype=cfg.compute_dtype,
     )

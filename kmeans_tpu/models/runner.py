@@ -107,9 +107,10 @@ class LloydRunner:
                 _dp_local_pass, _pad_rows, _tp_local_pass,
             )
 
-            if self.cfg.empty == "farthest":
+            if self.cfg.empty == "farthest" and model_axis is not None:
                 raise NotImplementedError(
-                    "empty='farthest' is not supported on a mesh yet"
+                    "empty='farthest' is not supported on DP×TP meshes yet "
+                    "(matches fit_lloyd_sharded); use a DP-only mesh"
                 )
             axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
             xp, w_host, self._n = _pad_rows(np.asarray(x), axis_sizes[data_axis])
@@ -129,7 +130,7 @@ class LloydRunner:
                     chunk_size=self.cfg.chunk_size,
                     compute_dtype=self.cfg.compute_dtype,
                     update=self.cfg.update, with_labels=False,
-                    backend=self._backend,
+                    backend=self._backend, empty=self.cfg.empty,
                 )
                 in_specs = (P(data_axis), P(), P(data_axis))
                 out_specs = (P(), P(), P())

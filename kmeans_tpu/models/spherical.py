@@ -1,0 +1,190 @@
+"""Spherical k-means: cosine-similarity clustering on the unit sphere.
+
+The natural model for embedding datasets (the GloVe-300d eval config in
+BASELINE.md), where direction matters and magnitude does not.  The reference
+has no numeric analog (its clustering is human assignment;
+/root/reference/app.mjs:358-372) — this is part of the numeric engine owed by
+the north star.
+
+TPU-first reuse: for unit-norm ``x`` and ``c``, ``‖x−c‖² = 2·(1−cos(x,c))``,
+so the *Euclidean* fused pass (:func:`kmeans_tpu.ops.lloyd.lloyd_pass` — XLA
+scan or the Pallas kernel, unchanged) already computes the cosine argmax
+assignment and the per-cluster sums.  Spherical k-means differs from Lloyd
+only in the update: the new centroid is the *renormalized* mean direction
+(the spherical Weiszfeld step), not the mean.  Clusters whose summed
+direction is ~zero keep their previous centroid (the analog of the
+empty-cluster "keep" policy).
+
+The reported ``inertia`` is Σ w·‖x−c‖² = Σ w·2(1−cos) — a monotone transform
+of the total cosine similarity, so convergence behavior matches the usual
+spherical k-means objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import resolve_fit_inputs
+from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
+
+__all__ = ["normalize_rows", "fit_spherical", "SphericalKMeans"]
+
+
+def normalize_rows(x: jax.Array, *, eps: float = 1e-12) -> jax.Array:
+    """Unit-normalize rows in float32; zero rows stay zero."""
+    xf = jnp.asarray(x, jnp.float32)
+    norms = jnp.sqrt(jnp.sum(xf * xf, axis=-1, keepdims=True))
+    return xf / jnp.maximum(norms, eps)
+
+
+def _renormalize_update(centroids: jax.Array, sums: jax.Array,
+                        counts: jax.Array, *, eps: float = 1e-8) -> jax.Array:
+    """New centroid = unit-normalized sum of member directions.
+
+    Degenerate clusters — empty, or members cancelling to ~zero sum — keep
+    the old centroid (which is already unit-norm).
+    """
+    norms = jnp.sqrt(jnp.sum(sums * sums, axis=-1, keepdims=True))
+    ok = (counts > 0)[:, None] & (norms > eps)
+    return jnp.where(ok, sums / jnp.maximum(norms, eps),
+                     centroids.astype(jnp.float32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_iter", "chunk_size", "compute_dtype", "update",
+                     "backend"),
+)
+def _spherical_loop(x, centroids0, weights, tol, *, max_iter, chunk_size,
+                    compute_dtype, update, backend="xla"):
+    kw = dict(weights=weights, chunk_size=chunk_size,
+              compute_dtype=compute_dtype, update=update, backend=backend)
+
+    def cond(s):
+        c, it, shift_sq, done = s
+        return (it < max_iter) & ~done
+
+    def body(s):
+        c, it, _, _ = s
+        _, _, sums, counts, _ = lloyd_pass(x, c, **kw)
+        new_c = _renormalize_update(c, sums, counts)
+        shift_sq = jnp.sum((new_c - c) ** 2)
+        return (new_c, it + 1, shift_sq, shift_sq <= tol)
+
+    init = (centroids0.astype(jnp.float32), jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, jnp.float32), jnp.zeros((), bool))
+    centroids, n_iter, _, converged = lax.while_loop(cond, body, init)
+    labels, _, _, counts, inertia = lloyd_pass(x, centroids, **kw)
+    return KMeansState(centroids, labels, inertia, n_iter, converged, counts)
+
+
+def fit_spherical(
+    x: jax.Array,
+    k: int,
+    *,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init: Union[str, jax.Array, None] = None,
+    weights: Optional[jax.Array] = None,
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+    pre_normalized: bool = False,
+) -> KMeansState:
+    """Fit spherical k-means (cosine similarity).
+
+    ``x`` is unit-normalized internally unless ``pre_normalized=True``.
+    Returned centroids are unit-norm; ``inertia`` is Σ w·2(1−cos(x, c)).
+    """
+    cfg = (config or KMeansConfig(k=k)).validate()
+    xn = jnp.asarray(x, jnp.float32) if pre_normalized else normalize_rows(x)
+    if cfg.compute_dtype is not None:
+        xn = xn.astype(cfg.compute_dtype)
+    # Seeding runs on the normalized data: k-means++ D² sampling on the
+    # sphere is exactly 2(1-cos) sampling, the spherical analog.  Centroids
+    # (given or seeded) are re-normalized onto the sphere.
+    cfg, key, c0 = resolve_fit_inputs(xn, k, key, config, init, weights)
+    c0 = normalize_rows(c0)
+
+    backend = resolve_backend(
+        cfg.backend, xn, k, weights=weights, compute_dtype=cfg.compute_dtype,
+    )
+    return _spherical_loop(
+        xn, c0, weights,
+        jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32),
+        max_iter=max_iter if max_iter is not None else cfg.max_iter,
+        chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
+        update=cfg.update, backend=backend,
+    )
+
+
+@dataclasses.dataclass
+class SphericalKMeans:
+    """Estimator wrapper over :func:`fit_spherical` (sklearn-like surface)."""
+
+    n_clusters: int = 3
+    init: Union[str, jax.Array] = "k-means++"
+    max_iter: int = 100
+    tol: float = 1e-6
+    seed: int = 0
+    chunk_size: int = 4096
+    compute_dtype: Optional[str] = None
+    backend: str = "auto"
+
+    state: Optional[KMeansState] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def fit(self, x, weights=None) -> "SphericalKMeans":
+        init = None if isinstance(self.init, str) else self.init
+        self.state = fit_spherical(
+            x, self.n_clusters,
+            config=KMeansConfig(
+                k=self.n_clusters,
+                init=self.init if isinstance(self.init, str) else "given",
+                max_iter=self.max_iter, tol=self.tol, seed=self.seed,
+                chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
+                backend=self.backend,
+            ),
+            init=init, weights=weights,
+        )
+        return self
+
+    @property
+    def cluster_centers_(self):
+        return self.state.centroids
+
+    @property
+    def labels_(self):
+        return self.state.labels
+
+    @property
+    def inertia_(self):
+        return float(self.state.inertia)
+
+    @property
+    def n_iter_(self):
+        return int(self.state.n_iter)
+
+    def predict(self, x):
+        from kmeans_tpu.ops.distance import assign
+
+        labels, _ = assign(
+            normalize_rows(x), self.state.centroids,
+            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
+        )
+        return labels
+
+    def similarity(self, x):
+        """Cosine similarity of each row to every centroid: (n, k)."""
+        return jnp.matmul(
+            normalize_rows(x), self.state.centroids.T,
+            preferred_element_type=jnp.float32,
+        )

@@ -47,10 +47,51 @@ __all__ = ["fit_lloyd_sharded", "fit_minibatch_sharded", "sharded_assign"]
 # Local (per-shard) passes
 # ---------------------------------------------------------------------------
 
+def _reseed_empty_farthest_dp(new_c, counts, x_loc, min_d2, data_axis):
+    """Sharded analog of :func:`kmeans_tpu.ops.update.reseed_empty_farthest`.
+
+    Each shard nominates its k worst-fit points; only their *values* are
+    all-gathered ((dp, k) floats).  The winning points themselves are
+    recovered with one masked ``psum`` — each winner's owner contributes the
+    row, everyone else zeros — so no (dp, k, d) gather ever rides the ICI.
+    Rows are sharded contiguously, so the flattened (shard, slot) order is
+    global-row order and the single-device lowest-index tie-break is
+    reproduced exactly (labels stay mesh-shape-independent).
+    """
+    f32 = jnp.float32
+    k = new_c.shape[0]
+    n_loc = min_d2.shape[0]
+    # A shard may hold fewer than k rows (large k or small n/dp): nominate
+    # what it has and pad the remaining slots with -inf so they never win.
+    k_nom = min(k, n_loc)
+    vals_loc, idx_loc = lax.top_k(min_d2, k_nom)        # local worst rows
+    pts_loc = x_loc[idx_loc].astype(f32)                # (k_nom, d)
+    if k_nom < k:
+        vals_loc = jnp.concatenate(
+            [vals_loc, jnp.full((k - k_nom,), -jnp.inf, vals_loc.dtype)]
+        )
+        pts_loc = jnp.concatenate(
+            [pts_loc, jnp.zeros((k - k_nom, pts_loc.shape[1]), f32)]
+        )
+    vals_all = lax.all_gather(vals_loc, data_axis)      # (dp, k)
+    dp = vals_all.shape[0]
+    _, win = lax.top_k(vals_all.reshape(dp * k), k)     # global winner ids
+    win_shard = win // k
+    win_slot = win % k
+    me = lax.axis_index(data_axis)
+    contrib = jnp.where(
+        (win_shard == me)[:, None], pts_loc[win_slot], 0.0
+    )
+    repl = lax.psum(contrib, data_axis)                 # (k, d) ranked winners
+    empty = counts <= 0
+    rank = jnp.where(empty, jnp.cumsum(empty.astype(jnp.int32)) - 1, 0)
+    return jnp.where(empty[:, None], repl[rank], new_c)
+
+
 def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
-                   update, with_labels, backend="xla"):
+                   update, with_labels, backend="xla", empty="keep"):
     """DP shard body: fused local pass + psum merge; centroids replicated."""
-    labels, _, sums, counts, inertia = lloyd_pass(
+    labels, min_d2, sums, counts, inertia = lloyd_pass(
         x_loc, c,
         weights=w_loc,
         chunk_size=chunk_size,
@@ -63,6 +104,12 @@ def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
     counts = lax.psum(counts, data_axis)
     inertia = lax.psum(inertia, data_axis)
     new_c = apply_update(c, sums, counts)
+    if empty == "farthest":
+        # Padding rows (weight 0) must never be nominated as reseed targets.
+        masked = jnp.where(w_loc > 0, min_d2, -jnp.inf)
+        new_c = _reseed_empty_farthest_dp(
+            new_c, counts, x_loc, masked, data_axis
+        )
     if with_labels:
         return new_c, inertia, counts, labels
     return new_c, inertia, counts
@@ -186,11 +233,11 @@ def fit_lloyd_sharded(
     cfg = (config or KMeansConfig(k=k)).validate()
     if config is not None and config.k != k:
         raise ValueError(f"k={k} contradicts config.k={config.k}")
-    if cfg.empty == "farthest":
+    if cfg.empty == "farthest" and model_axis is not None:
         raise NotImplementedError(
-            "empty='farthest' is not supported in the sharded engine yet "
-            "(needs a global top-k across shards); use empty='keep' or the "
-            "single-device fit_lloyd"
+            "empty='farthest' is not supported on DP×TP meshes yet (empty "
+            "slots live in sharded k-slices); use a DP-only mesh, "
+            "empty='keep', or the single-device fit_lloyd"
         )
     if key is None:
         key = jax.random.key(cfg.seed)
@@ -233,7 +280,7 @@ def fit_lloyd_sharded(
     )
     run = _build_lloyd_run(
         mesh, data_axis, model_axis, k, cfg.chunk_size, cfg.compute_dtype,
-        cfg.update, max_it, backend,
+        cfg.update, max_it, backend, cfg.empty,
     )
     c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
     return KMeansState(
@@ -243,7 +290,8 @@ def fit_lloyd_sharded(
 
 @functools.lru_cache(maxsize=64)
 def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
-                     compute_dtype, update, max_it, backend="xla"):
+                     compute_dtype, update, max_it, backend="xla",
+                     empty="keep"):
     """Jitted whole-fit program, cached so repeated same-shaped fits reuse
     the compiled executable (jax.jit caches by function identity)."""
     if model_axis is None:
@@ -254,6 +302,7 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
             compute_dtype=compute_dtype,
             update=update,
             backend=backend,
+            empty=empty,
         )
         in_specs = (P(data_axis), P(), P(data_axis))
         out_step = (P(), P(), P())
@@ -276,8 +325,13 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
         functools.partial(local, with_labels=False),
         mesh=mesh, in_specs=in_specs, out_specs=out_step, check_vma=False,
     )
+    # The final labeling pass discards its centroid output, so reseeding
+    # there would only add dead collectives — always run it plain.
+    final_kw = {"with_labels": True}
+    if model_axis is None:
+        final_kw["empty"] = "keep"
     final = jax.shard_map(
-        functools.partial(local, with_labels=True),
+        functools.partial(local, **final_kw),
         mesh=mesh, in_specs=in_specs, out_specs=out_final, check_vma=False,
     )
 

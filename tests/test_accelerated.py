@@ -1,0 +1,86 @@
+"""Safeguarded over-relaxed Lloyd: same answers, no divergence."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kmeans_tpu import fit_lloyd, fit_lloyd_accelerated
+from kmeans_tpu.data import make_blobs
+
+
+@pytest.fixture()
+def blobs():
+    x, labels, _ = make_blobs(jax.random.key(0), 600, 8, 5, cluster_std=1.5)
+    return np.asarray(x), np.asarray(labels)
+
+
+def test_beta_zero_equals_plain_lloyd(blobs, rng):
+    x, _ = blobs
+    c0 = x[rng.choice(len(x), 5, replace=False)]
+    plain = fit_lloyd(x, 5, init=c0, tol=1e-10, max_iter=100)
+    acc = fit_lloyd_accelerated(x, 5, init=c0, tol=1e-10, max_iter=100,
+                                beta_max=0.0)
+    np.testing.assert_array_equal(
+        np.asarray(plain.labels), np.asarray(acc.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(plain.centroids), np.asarray(acc.centroids), atol=1e-5
+    )
+
+
+def test_reaches_plain_quality(blobs, rng):
+    """Accelerated result is never meaningfully worse than plain Lloyd."""
+    x, _ = blobs
+    for seed in range(3):
+        c0 = x[np.random.default_rng(seed).choice(len(x), 5, replace=False)]
+        plain = fit_lloyd(x, 5, init=c0, tol=1e-10, max_iter=200)
+        acc = fit_lloyd_accelerated(x, 5, init=c0, tol=1e-10, max_iter=200)
+        assert float(acc.inertia) <= float(plain.inertia) * 1.01
+
+
+def test_converges_and_is_fixed_point(blobs, rng):
+    x, _ = blobs
+    c0 = x[rng.choice(len(x), 5, replace=False)]
+    acc = fit_lloyd_accelerated(x, 5, init=c0, tol=1e-10, max_iter=200)
+    assert bool(acc.converged)
+    # The returned centroids are (close to) a Lloyd fixed point: one more
+    # plain iteration barely moves them.
+    after = fit_lloyd(x, 5, init=np.asarray(acc.centroids), max_iter=1,
+                      tol=0.0)
+    shift = float(np.sum(
+        (np.asarray(after.centroids) - np.asarray(acc.centroids)) ** 2
+    ))
+    assert shift < 1e-6
+
+
+def test_fewer_or_equal_iterations_on_slow_problem():
+    """On an elongated, overlapping mixture (slow Lloyd convergence) the
+    accelerated variant should need fewer iterations for the same tol."""
+    rng = np.random.default_rng(7)
+    n, d = 4000, 2
+    x = np.concatenate([
+        rng.normal(size=(n // 2, d)) * [6.0, 0.5],
+        rng.normal(size=(n // 2, d)) * [6.0, 0.5] + [1.5, 1.0],
+    ]).astype(np.float32)
+    c0 = x[rng.choice(n, 8, replace=False)]
+    plain = fit_lloyd(x, 8, init=c0, tol=1e-8, max_iter=500)
+    acc = fit_lloyd_accelerated(x, 8, init=c0, tol=1e-8, max_iter=500)
+    assert int(acc.n_iter) <= int(plain.n_iter)
+    assert float(acc.inertia) <= float(plain.inertia) * 1.01
+
+
+def test_accelerated_rejects_farthest_policy(blobs):
+    from kmeans_tpu.config import KMeansConfig
+
+    x, _ = blobs
+    with pytest.raises(NotImplementedError):
+        fit_lloyd_accelerated(
+            x, 5, config=KMeansConfig(k=5, empty="farthest")
+        )
+
+
+def test_accelerated_k_zero_raises(blobs):
+    x, _ = blobs
+    with pytest.raises(ValueError):
+        fit_lloyd_accelerated(x, 0)

@@ -127,3 +127,89 @@ def test_mesh_shape_independence_dp_2_vs_8(problem, cpu_devices):
     np.testing.assert_allclose(
         np.asarray(got2.centroids), np.asarray(got8.centroids), rtol=1e-4, atol=1e-4
     )
+
+
+def test_dp_empty_farthest_matches_single_device(cpu_devices):
+    """The sharded global-top-k reseed reproduces the single-device policy
+    exactly, including tie-breaks, on a mesh with padded rows."""
+    from kmeans_tpu.config import KMeansConfig
+
+    # Force empty clusters: two far-apart seed centroids on top of each
+    # other, so one goes empty on the first assignment.
+    x, _, _ = make_blobs(jax.random.key(2), 501, 8, 4, cluster_std=0.5)
+    x = np.asarray(x)                       # 501 rows: uneven across 8 devs
+    c0 = np.stack([x[0], x[0], x[1], x[2]]).astype(np.float32)
+    cfg = KMeansConfig(k=4, empty="farthest")
+
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0), tol=1e-10,
+                     max_iter=25, config=cfg)
+    mesh = cpu_mesh((8, 1))
+    got = fit_lloyd_sharded(x, 4, mesh=mesh, init=c0, tol=1e-10, max_iter=25,
+                            config=cfg)
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    # All clusters non-empty after reseeding.
+    assert np.all(np.asarray(got.counts) > 0)
+
+
+def test_dp_empty_farthest_mesh_shape_independent(cpu_devices):
+    from kmeans_tpu.config import KMeansConfig
+
+    x, _, _ = make_blobs(jax.random.key(3), 400, 8, 4, cluster_std=0.5)
+    x = np.asarray(x)
+    c0 = np.stack([x[0], x[0], x[1], x[2]]).astype(np.float32)
+    cfg = KMeansConfig(k=4, empty="farthest")
+    a = fit_lloyd_sharded(x, 4, mesh=cpu_mesh((2, 1)), init=c0, tol=1e-10,
+                          max_iter=25, config=cfg)
+    b = fit_lloyd_sharded(x, 4, mesh=cpu_mesh((8, 1)), init=c0, tol=1e-10,
+                          max_iter=25, config=cfg)
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
+
+
+def test_tp_empty_farthest_raises(cpu_devices):
+    from kmeans_tpu.config import KMeansConfig
+
+    x = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
+    with pytest.raises(NotImplementedError):
+        fit_lloyd_sharded(
+            x, 4, mesh=cpu_mesh((4, 2)), model_axis="model",
+            config=KMeansConfig(k=4, empty="farthest"),
+        )
+
+
+def test_dp_empty_farthest_small_shards(cpu_devices):
+    """Shards holding fewer than k rows: nomination slots are padded, not a
+    top_k crash (n=20 over 8 devices = 3 rows/shard < k=4)."""
+    from kmeans_tpu.config import KMeansConfig
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(20, 4)).astype(np.float32) * 3
+    c0 = np.stack([x[0], x[0], x[1], x[2]]).astype(np.float32)
+    cfg = KMeansConfig(k=4, empty="farthest")
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0), tol=1e-10,
+                     max_iter=15, config=cfg)
+    got = fit_lloyd_sharded(x, 4, mesh=cpu_mesh((8, 1)), init=c0, tol=1e-10,
+                            max_iter=15, config=cfg)
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+
+
+def test_runner_dp_mesh_empty_farthest(cpu_devices):
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import LloydRunner
+
+    x, _, _ = make_blobs(jax.random.key(5), 200, 8, 4, cluster_std=0.5)
+    x = np.asarray(x)
+    runner = LloydRunner(
+        x, 4, config=KMeansConfig(k=4, empty="farthest"),
+        mesh=cpu_mesh((4, 1)),
+    )
+    runner.init(np.stack([x[0], x[0], x[1], x[2]]).astype(np.float32))
+    st = runner.run(max_iter=15, tol=1e-10)
+    assert np.all(np.asarray(st.counts) > 0)

@@ -1,0 +1,106 @@
+"""Spherical k-means (cosine) vs a naive NumPy oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kmeans_tpu import SphericalKMeans, fit_spherical
+from kmeans_tpu.models.spherical import normalize_rows
+
+
+def _norm(v):
+    return v / np.maximum(np.linalg.norm(v, axis=-1, keepdims=True), 1e-12)
+
+
+def spherical_oracle(x, c0, max_iter=50):
+    """Naive spherical k-means: argmax cosine, renormalized-mean update."""
+    x = _norm(x.astype(np.float64))
+    c = _norm(c0.astype(np.float64))
+    for _ in range(max_iter):
+        labels = np.argmax(x @ c.T, axis=1)
+        new_c = c.copy()
+        for j in range(len(c)):
+            m = labels == j
+            if m.any():
+                s = x[m].sum(axis=0)
+                n = np.linalg.norm(s)
+                if n > 1e-8:
+                    new_c[j] = s / n
+        if np.allclose(new_c, c, atol=1e-12):
+            c = new_c
+            break
+        c = new_c
+    return np.argmax(x @ c.T, axis=1), c
+
+
+@pytest.fixture()
+def angular_blobs(rng):
+    """Clusters separated by direction, with magnitudes scrambled so
+    Euclidean k-means on the raw data would disagree."""
+    k, d, per = 4, 6, 40
+    dirs = _norm(rng.normal(size=(k, d)))
+    x = []
+    for j in range(k):
+        pts = dirs[j] + 0.15 * rng.normal(size=(per, d))
+        scale = rng.uniform(0.1, 10.0, size=(per, 1))   # magnitude noise
+        x.append(_norm(pts) * scale)
+    x = np.concatenate(x).astype(np.float32)
+    labels = np.repeat(np.arange(k), per)
+    return x, labels, k
+
+
+def test_matches_oracle_from_same_init(angular_blobs, rng):
+    x, _, k = angular_blobs
+    c0 = x[rng.choice(len(x), k, replace=False)]
+    got = fit_spherical(x, k, init=c0, tol=1e-12, max_iter=50)
+    want_labels, want_c = spherical_oracle(x, c0)
+    np.testing.assert_array_equal(np.asarray(got.labels), want_labels)
+    np.testing.assert_allclose(np.asarray(got.centroids), want_c, atol=1e-5)
+
+
+def test_centroids_unit_norm(angular_blobs):
+    x, _, k = angular_blobs
+    st = fit_spherical(x, k, key=jax.random.key(3))
+    norms = np.linalg.norm(np.asarray(st.centroids), axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+
+def test_recovers_angular_clusters(angular_blobs):
+    x, true_labels, k = angular_blobs
+    from kmeans_tpu import metrics as M
+
+    st = fit_spherical(x, k, key=jax.random.key(0))
+    ari = float(M.adjusted_rand_index(true_labels, np.asarray(st.labels)))
+    assert ari > 0.95
+
+
+def test_scale_invariance(angular_blobs, rng):
+    """Scaling rows must not change the clustering (cosine is scale-free)."""
+    x, _, k = angular_blobs
+    c0 = x[rng.choice(len(x), k, replace=False)]
+    a = fit_spherical(x, k, init=c0, tol=1e-12)
+    scales = rng.uniform(0.5, 5.0, size=(len(x), 1)).astype(np.float32)
+    b = fit_spherical(x * scales, k, init=c0, tol=1e-12)
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
+
+
+def test_estimator_surface(angular_blobs):
+    x, _, k = angular_blobs
+    km = SphericalKMeans(n_clusters=k, seed=1).fit(x)
+    assert km.labels_.shape == (len(x),)
+    assert km.cluster_centers_.shape == (k, x.shape[1])
+    sim = np.asarray(km.similarity(x))
+    assert sim.shape == (len(x), k)
+    assert np.all(sim <= 1.0 + 1e-5)
+    # predict() on training data agrees with fit labels.
+    np.testing.assert_array_equal(
+        np.asarray(km.predict(x)), np.asarray(km.labels_)
+    )
+
+
+def test_normalize_rows_zero_safe():
+    x = np.array([[0.0, 0.0], [3.0, 4.0]], np.float32)
+    out = np.asarray(normalize_rows(x))
+    np.testing.assert_allclose(out[0], [0.0, 0.0])
+    np.testing.assert_allclose(out[1], [0.6, 0.8], atol=1e-6)

@@ -8,7 +8,7 @@ layer feeds a browser visualizer.
 
 Layout:
   ops/       fused assign+reduce kernels, centroid update
-  models/    Lloyd + minibatch estimators, k-means++/random init
+  models/    Lloyd + minibatch estimators, k-means++/k-means||/random init
   parallel/  mesh construction, shard_map engine (DP over points, TP over k)
   session/   document model, metrics, export/import JSON (reference schema)
   serve/     HTTP/SSE shim + browser front-end

@@ -87,7 +87,7 @@ class KMeansConfig:
     """
 
     k: int = 3
-    init: str = "k-means++"          # "k-means++" | "random" | "given"
+    init: str = "k-means++"          # "k-means++" | "k-means||" | "random" | "given"
     max_iter: int = 100
     #: Convergence: stop when the summed squared centroid shift <= tol.
     tol: float = 1e-4
@@ -115,7 +115,7 @@ class KMeansConfig:
     def validate(self) -> "KMeansConfig":
         if self.k < 1:
             raise ValueError(f"k must be >= 1, got {self.k}")
-        if self.init not in ("k-means++", "random", "given"):
+        if self.init not in ("k-means++", "k-means||", "random", "given"):
             raise ValueError(f"unknown init {self.init!r}")
         if self.update not in ("matmul", "segment"):
             raise ValueError(f"unknown update {self.update!r}")

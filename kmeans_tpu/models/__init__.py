@@ -2,7 +2,12 @@
 spherical (cosine), and initialization."""
 
 from kmeans_tpu.models.accelerated import fit_lloyd_accelerated
-from kmeans_tpu.models.init import init_centroids, kmeans_plus_plus, random_init
+from kmeans_tpu.models.init import (
+    init_centroids,
+    kmeans_parallel,
+    kmeans_plus_plus,
+    random_init,
+)
 from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.runner import IterInfo, LloydRunner
@@ -16,6 +21,7 @@ __all__ = [
     "IterInfo",
     "LloydRunner",
     "init_centroids",
+    "kmeans_parallel",
     "kmeans_plus_plus",
     "random_init",
     "KMeans",

@@ -24,8 +24,8 @@ from jax import lax
 
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
-__all__ = ["random_init", "kmeans_plus_plus", "init_centroids",
-           "resolve_fit_inputs"]
+__all__ = ["random_init", "kmeans_plus_plus", "kmeans_parallel",
+           "init_centroids", "resolve_fit_inputs"]
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -100,6 +100,125 @@ def kmeans_plus_plus(
     return centroids
 
 
+@functools.partial(
+    jax.jit, static_argnames=("ell", "chunk_size", "compute_dtype")
+)
+def _kmpar_round(key, x, d2, logw, *, ell, chunk_size, compute_dtype):
+    """One k-means|| sampling round: draw ``ell`` candidates without
+    replacement with P ∝ w·D² (Gumbel top-k), then fold them into the
+    running min-distance.  One (n, ell) tiled matmul per round — MXU-sized
+    work, unlike k-means++'s k sequential matvec-scale rounds."""
+    from kmeans_tpu.ops.distance import assign
+
+    g = jax.random.gumbel(key, d2.shape, dtype=jnp.float32)
+    # log(w·D²) = logw + log(D²); chosen points have D²=0 → -inf → excluded.
+    score = logw + jnp.log(d2) + g
+    top, idx = lax.top_k(score, ell)
+    cand = x[idx].astype(jnp.float32)
+    # top_k pads with -inf rows when fewer than ell rows remain eligible
+    # (zero weight or already chosen); mark those invalid so they can be
+    # weight-zeroed downstream instead of becoming seeds.
+    valid = top > -jnp.inf
+    lab, mind = assign(x, cand, chunk_size=chunk_size,
+                       compute_dtype=compute_dtype)
+    return cand, lab, mind, valid
+
+
+def kmeans_parallel(
+    key: jax.Array,
+    x: jax.Array,
+    k: int,
+    *,
+    weights: Optional[jax.Array] = None,
+    rounds: int = 4,
+    oversampling: Optional[int] = None,
+    refine_iters: int = 25,
+    chunk_size: int = 8192,
+    compute_dtype=None,
+) -> jax.Array:
+    """k-means|| seeding (Bahmani et al., "Scalable k-means++", VLDB 2012).
+
+    Where :func:`kmeans_plus_plus` runs k *sequential* D²-sampling rounds
+    (latency-bound at k=1000: each round is one (n, d)×(d,) matvec-scale op),
+    k-means|| oversamples ``ell`` candidates per round for a handful of
+    rounds — every round is one large (n, ell) tiled matmul that keeps the
+    MXU busy — then reclusters the ~``1 + rounds·ell`` weighted candidates
+    down to k with weighted k-means++ + Lloyd.  The heavy ops (``top_k``,
+    ``assign``'s psum-able partials) lower to per-shard work + small
+    collectives under ``jit`` on a sharded array, so the same code serves
+    single-chip and mesh runs (SURVEY.md §7 hard part (b); also the
+    distributed-seeding recipe referenced in PAPERS.md).
+
+    Each round draws exactly ``ell`` distinct candidates via Gumbel
+    top-``ell`` on ``log(w·D²)`` — exact Plackett–Luce sampling without
+    replacement, the fixed-size counterpart of the paper's Bernoulli draw
+    (static shapes; XLA requires them).
+
+    Falls back to exact :func:`kmeans_plus_plus` when the candidate pool
+    would reach n (small inputs), where oversampling buys nothing.
+    """
+    n, d = x.shape
+    ell = int(oversampling) if oversampling is not None else min(2 * k, n)
+    m = 1 + rounds * ell
+    if m >= n:
+        return kmeans_plus_plus(
+            key, x, k, weights=weights, compute_dtype=compute_dtype
+        )
+    if m < k:
+        raise ValueError(
+            f"candidate pool 1 + rounds*oversampling = {m} < k = {k}; "
+            f"raise rounds/oversampling"
+        )
+
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models.lloyd import fit_lloyd  # cycle-free at call time
+    from kmeans_tpu.ops.distance import assign
+
+    f32 = jnp.float32
+    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    logw = jnp.log(w)
+
+    key0, key_r = jax.random.split(key)
+    g0 = jax.random.gumbel(key0, (n,), dtype=f32)
+    first = jnp.argmax(logw + g0)
+    c0 = x[first].astype(f32)[None]
+    _, d2 = assign(x, c0, chunk_size=chunk_size, compute_dtype=compute_dtype)
+
+    cands, valids = [c0], [jnp.ones((1,), bool)]
+    labels = jnp.zeros((n,), jnp.int32)   # nearest-candidate index, running
+    for r in range(rounds):  # static trip count; one compile, reused per round
+        cand, lab, mind, valid = _kmpar_round(
+            jax.random.fold_in(key_r, r), x, d2, logw,
+            ell=ell, chunk_size=chunk_size, compute_dtype=compute_dtype,
+        )
+        cands.append(cand)
+        valids.append(valid)
+        # Fold this round's nearest-of-ell into the global nearest: strict <
+        # keeps earlier candidates on ties, matching a full argmin over all
+        # m candidates — and saves the extra (n, m) pass it would cost.
+        offset = 1 + r * ell
+        labels = jnp.where(mind < d2, offset + lab, labels)
+        d2 = jnp.minimum(d2, mind)
+    candidates = jnp.concatenate(cands, axis=0)        # (m, d) float32
+    cand_valid = jnp.concatenate(valids, axis=0)       # (m,) bool
+
+    # Weight candidates by the point mass they attract, then recluster the
+    # small weighted set to k.  Duplicate/never-nearest/invalid candidates
+    # get weight 0 and are unselectable in the weighted k-means++ below
+    # (log 0 = -inf); weighted Lloyd + farthest-reseed keep every final
+    # centroid a convex combination of positive-weight candidates.
+    cand_w = jnp.where(
+        cand_valid, jax.ops.segment_sum(w, labels, num_segments=m), 0.0
+    )
+    refine_cfg = KMeansConfig(
+        k=k, init="k-means++", max_iter=refine_iters, empty="farthest",
+        chunk_size=min(chunk_size, m), compute_dtype=compute_dtype,
+    )
+    state = fit_lloyd(candidates, k, key=jax.random.fold_in(key, 0xC11),
+                      config=refine_cfg, weights=cand_w)
+    return state.centroids
+
+
 def init_centroids(
     key: jax.Array,
     x: jax.Array,
@@ -108,10 +227,16 @@ def init_centroids(
     method: str = "k-means++",
     weights: Optional[jax.Array] = None,
     compute_dtype=None,
+    chunk_size: Optional[int] = None,
 ) -> jax.Array:
     if method == "k-means++":
         return kmeans_plus_plus(
             key, x, k, weights=weights, compute_dtype=compute_dtype
+        )
+    if method == "k-means||":
+        kw = {} if chunk_size is None else {"chunk_size": chunk_size}
+        return kmeans_parallel(
+            key, x, k, weights=weights, compute_dtype=compute_dtype, **kw
         )
     if method == "random":
         return random_init(key, x, k, weights=weights)
@@ -151,6 +276,6 @@ def resolve_fit_inputs(x, k, key, config, init, weights):
         method = init if isinstance(init, str) else cfg.init
         c0 = init_centroids(
             key, x, k, method=method, weights=weights,
-            compute_dtype=cfg.compute_dtype,
+            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
         )
     return cfg, key, c0

@@ -150,7 +150,8 @@ def fit_minibatch(
         else:
             xs = x
         centroids0 = init_centroids(
-            ikey2, xs, k, method=method, compute_dtype=cfg.compute_dtype
+            ikey2, xs, k, method=method, compute_dtype=cfg.compute_dtype,
+            chunk_size=cfg.chunk_size,
         )
     return _minibatch_loop(
         x,

@@ -177,6 +177,7 @@ class LloydRunner:
             self.centroids = init_centroids(
                 self.key, self.x, self.k, method=method, weights=weights,
                 compute_dtype=self.cfg.compute_dtype,
+                chunk_size=self.cfg.chunk_size,
             )
 
     def run(

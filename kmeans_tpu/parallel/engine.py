@@ -259,7 +259,7 @@ def fit_lloyd_sharded(
         method = init if isinstance(init, str) else cfg.init
         c0 = init_centroids(
             key, x, k, method=method, weights=w,
-            compute_dtype=cfg.compute_dtype,
+            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
         )
 
     k_pad = (-k) % mp
@@ -446,7 +446,8 @@ def fit_minibatch_sharded(
         else:
             xs = x[:n]
         c0 = init_centroids(
-            ikey2, xs, k, method=method, compute_dtype=cfg.compute_dtype
+            ikey2, xs, k, method=method, compute_dtype=cfg.compute_dtype,
+            chunk_size=cfg.chunk_size,
         )
 
     state = _minibatch_loop(

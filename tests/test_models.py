@@ -12,6 +12,7 @@ from kmeans_tpu.models import (
     MiniBatchKMeans,
     fit_lloyd,
     fit_minibatch,
+    kmeans_parallel,
     kmeans_plus_plus,
     random_init,
 )
@@ -153,3 +154,84 @@ def test_empty_cluster_farthest_policy_fills_all_clusters():
     assert int(np.sum(np.asarray(state_f.counts) > 0)) >= int(
         np.sum(np.asarray(state.counts) > 0)
     )
+
+
+def test_kmeans_parallel_hits_all_blobs():
+    # Well-separated blobs with n large enough to take the oversampling
+    # path (candidate pool < n): every true center must attract a seed.
+    key = jax.random.key(4)
+    x, _, centers = make_blobs(key, 4000, 4, 6, cluster_std=0.05)
+    c = kmeans_parallel(
+        jax.random.key(9), x, 6, rounds=3, oversampling=32, chunk_size=1024
+    )
+    assert c.shape == (6, 4)
+    d2 = oracles.sq_dists(np.asarray(c), np.asarray(centers))
+    assert len(set(np.argmin(d2, axis=1))) == 6
+
+
+def test_kmeans_parallel_quality_matches_kmeans_plus_plus():
+    # Final Lloyd inertia from a k-means|| seed should match the exact
+    # k-means++ seed's within a few percent on easy blob data.  Either
+    # init can land in a bad local optimum on any single draw (k-means),
+    # so compare best-of-3 restarts to best-of-3.
+    x, _, _ = make_blobs(jax.random.key(5), 8000, 8, 10, cluster_std=0.4)
+
+    def best(init_fn):
+        return min(
+            float(fit_lloyd(x, 10, init=init_fn(s), max_iter=50).inertia)
+            for s in range(3)
+        )
+
+    i_par = best(lambda s: kmeans_parallel(
+        jax.random.key(s), x, 10, rounds=3, oversampling=64, chunk_size=2048))
+    i_pp = best(lambda s: kmeans_plus_plus(jax.random.key(100 + s), x, 10))
+    assert i_par <= i_pp * 1.05
+
+
+def test_kmeans_parallel_deterministic_and_weighted():
+    x, _, _ = make_blobs(jax.random.key(6), 3000, 5, 4, cluster_std=0.3)
+    c1 = kmeans_parallel(jax.random.key(8), x, 4, rounds=2, oversampling=16,
+                         chunk_size=1024)
+    c2 = kmeans_parallel(jax.random.key(8), x, 4, rounds=2, oversampling=16,
+                         chunk_size=1024)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+    # A far-out outlier with weight 0 must never be seeded or pulled toward:
+    # no final centroid may sit anywhere near it.
+    out = jnp.full((1, 5), 1e4, jnp.float32)
+    xo = jnp.concatenate([x, out])
+    w = jnp.concatenate([jnp.ones((3000,), jnp.float32),
+                         jnp.zeros((1,), jnp.float32)])
+    c = kmeans_parallel(jax.random.key(8), xo, 4, weights=w, rounds=2,
+                        oversampling=16, chunk_size=1024)
+    assert float(jnp.max(jnp.abs(c))) < 1e3
+
+
+def test_kmeans_parallel_small_n_falls_back_to_exact():
+    # Pool >= n -> exact k-means++ result, bit-for-bit.
+    # default pool = 1 + 4 rounds x min(2k, n) candidates = 33 >= n = 20
+    x, _, _ = make_blobs(jax.random.key(7), 20, 3, 4)
+    c_par = kmeans_parallel(jax.random.key(3), x, 4)
+    c_pp = kmeans_plus_plus(jax.random.key(3), x, 4)
+    np.testing.assert_array_equal(np.asarray(c_par), np.asarray(c_pp))
+
+
+def test_kmeans_parallel_pool_smaller_than_k_raises():
+    x, _, _ = make_blobs(jax.random.key(0), 10000, 4, 3)
+    with pytest.raises(ValueError, match="candidate pool"):
+        kmeans_parallel(jax.random.key(1), x, 100, rounds=2, oversampling=10)
+
+
+def test_kmeans_parallel_exhausted_pool_never_seeds_zero_weight_rows():
+    # Only 6 positive-weight rows but ell=16 per round: top_k must pad with
+    # -inf picks, which may not surface as final centroids.  All positive-
+    # weight rows sit far from the zero-weight origin block, so every final
+    # centroid must land near them.
+    rng = np.random.default_rng(0)
+    good = rng.normal(size=(6, 3)).astype(np.float32) + 100.0
+    x = jnp.asarray(np.concatenate([good, np.zeros((3000, 3), np.float32)]))
+    w = jnp.concatenate([jnp.ones((6,), jnp.float32),
+                         jnp.zeros((3000,), jnp.float32)])
+    c = kmeans_parallel(jax.random.key(2), x, 4, weights=w, rounds=2,
+                        oversampling=16, chunk_size=512)
+    assert bool(jnp.all(jnp.linalg.norm(c, axis=1) > 50.0))

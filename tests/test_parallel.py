@@ -213,3 +213,17 @@ def test_runner_dp_mesh_empty_farthest(cpu_devices):
     runner.init(np.stack([x[0], x[0], x[1], x[2]]).astype(np.float32))
     st = runner.run(max_iter=15, tol=1e-10)
     assert np.all(np.asarray(st.counts) > 0)
+
+
+def test_sharded_kmeans_parallel_init_on_mesh(cpu_devices):
+    # k-means|| seeding over a sharded global x: pool (1 + 4x8 = 33) << n,
+    # so the oversampling path (Gumbel top-k + tiled assign) runs on-mesh;
+    # shard-padding rows carry weight 0 and must never be seeded.
+    x, _, _ = make_blobs(jax.random.key(11), 3001, 8, 4, cluster_std=0.3)
+    mesh = cpu_mesh((8, 1))
+    state = fit_lloyd_sharded(
+        np.asarray(x), 4, mesh=mesh, init="k-means||", max_iter=30
+    )
+    assert state.centroids.shape == (4, 8)
+    assert bool(jnp.all(state.counts > 0))
+    assert bool(jnp.all(jnp.isfinite(state.centroids)))

@@ -20,10 +20,12 @@ __version__ = "0.1.0"
 
 from kmeans_tpu.config import KMeansConfig, MeshConfig, RunConfig, ServeConfig
 from kmeans_tpu.models import (
+    BisectingKMeans,
     KMeans,
     KMeansState,
     MiniBatchKMeans,
     SphericalKMeans,
+    fit_bisecting,
     fit_lloyd,
     fit_lloyd_accelerated,
     fit_minibatch,
@@ -35,10 +37,12 @@ __all__ = [
     "MeshConfig",
     "RunConfig",
     "ServeConfig",
+    "BisectingKMeans",
     "KMeans",
     "KMeansState",
     "MiniBatchKMeans",
     "SphericalKMeans",
+    "fit_bisecting",
     "fit_lloyd",
     "fit_lloyd_accelerated",
     "fit_minibatch",

@@ -2,6 +2,7 @@
 spherical (cosine), and initialization."""
 
 from kmeans_tpu.models.accelerated import fit_lloyd_accelerated
+from kmeans_tpu.models.bisecting import BisectingKMeans, fit_bisecting
 from kmeans_tpu.models.init import (
     init_centroids,
     kmeans_parallel,
@@ -18,8 +19,10 @@ from kmeans_tpu.models.spherical import (
 )
 
 __all__ = [
+    "BisectingKMeans",
     "IterInfo",
     "LloydRunner",
+    "fit_bisecting",
     "init_centroids",
     "kmeans_parallel",
     "kmeans_plus_plus",

@@ -1,0 +1,150 @@
+"""Bisecting k-means: top-down hierarchical splitting.
+
+Another model family on the same fused kernels (the reference computes
+nothing numeric — /root/reference/app.mjs has humans assign cards by hand —
+so this, like the other estimators, is owed to the north-star numeric scope;
+surface mirrors ``sklearn.cluster.BisectingKMeans``).
+
+TPU-first shape discipline: a split never gathers the member rows.  Each of
+the k-1 splits is a *weighted* 2-means over the full (n, d) array with the
+membership mask folded into the sample weights — shapes stay static, there
+are no dynamic slices, and every split reuses the same compiled executables
+(one ``fit_lloyd`` at k=2 + one ``assign`` at k=2).  Total cost is
+O(k · n · d / split-iters) — the same order as ONE full-k Lloyd iteration
+per couple of splits, and every FLOP lands on the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import resolve_fit_config
+from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
+from kmeans_tpu.ops.distance import assign
+
+__all__ = ["fit_bisecting", "BisectingKMeans"]
+
+_STRATEGIES = ("biggest_inertia", "largest_cluster")
+
+
+def fit_bisecting(
+    x: jax.Array,
+    k: int,
+    *,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    strategy: str = "biggest_inertia",
+    weights: Optional[jax.Array] = None,
+) -> KMeansState:
+    """Fit bisecting k-means: start from one cluster, repeatedly 2-means-split
+    the worst cluster (by SSE or by size) until k clusters exist.
+
+    Labels are hierarchical — a point belongs to the leaf its split path
+    assigned it to, which on overlapping data can differ from
+    nearest-final-centroid assignment (same semantics as sklearn's
+    BisectingKMeans).  ``inertia``/``counts`` are consistent with these
+    hierarchical labels.  On degenerate data with fewer than k splittable
+    clusters, the remaining slots keep zero counts and duplicate the first
+    centroid (ties in ``predict`` resolve to the lower index, so duplicates
+    are never chosen).
+    """
+    if strategy not in _STRATEGIES:
+        raise ValueError(f"unknown strategy {strategy!r}; have {_STRATEGIES}")
+    cfg, key = resolve_fit_config(k, key, config)
+    if cfg.init == "given":
+        raise ValueError(
+            "bisecting derives every centroid from splits; init='given' "
+            "(an init array) is not supported"
+        )
+
+    n, d = x.shape
+    f32 = jnp.float32
+    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    # k=2 sub-problem config, honoring the caller's init method; "keep" for
+    # empties — a split that can't find two clusters leaves the second child
+    # empty, handled by the splittable mask.
+    cfg2 = dataclasses.replace(cfg, k=2, empty="keep")
+
+    labels = jnp.zeros((n,), jnp.int32)
+    mean0 = (w[:, None] * x.astype(f32)).sum(0) / jnp.maximum(w.sum(), 1.0)
+    _, mind0 = assign(x, mean0[None], chunk_size=cfg.chunk_size,
+                      compute_dtype=cfg.compute_dtype)
+    centroids = jnp.zeros((k, d), f32).at[0].set(mean0)
+    sse = jnp.zeros((k,), f32).at[0].set(jnp.sum(w * mind0))
+    counts = jnp.zeros((k,), f32).at[0].set(jnp.sum(w))
+    # Splittable = at least two members carrying weight (count alone can't
+    # tell 2 unit-weight points from 1 double-weight point, so track both).
+    members = jnp.zeros((k,), f32).at[0].set(jnp.sum(w > 0))
+
+    n_splits = 0
+    for i in range(1, k):
+        score = sse if strategy == "biggest_inertia" else counts
+        score = jnp.where(members >= 2, score, -jnp.inf)
+        target = int(jnp.argmax(score))
+        if not bool(score[target] > 0):
+            break  # nothing splittable (or all remaining SSE exactly 0)
+        mask_w = jnp.where(labels == target, w, 0.0)
+
+        st2 = fit_lloyd(x, 2, key=jax.random.fold_in(key, i),
+                        config=cfg2, weights=mask_w)
+        lab2, mind2 = assign(x, st2.centroids, chunk_size=cfg.chunk_size,
+                             compute_dtype=cfg.compute_dtype)
+        in_b = (labels == target) & (lab2 == 1)
+        labels = jnp.where(in_b, i, labels)
+
+        wa = jnp.where(lab2 == 0, mask_w, 0.0)
+        wb = jnp.where(lab2 == 1, mask_w, 0.0)
+        centroids = centroids.at[target].set(st2.centroids[0]).at[i].set(
+            st2.centroids[1])
+        sse = sse.at[target].set(jnp.sum(wa * mind2)).at[i].set(
+            jnp.sum(wb * mind2))
+        counts = counts.at[target].set(jnp.sum(wa)).at[i].set(jnp.sum(wb))
+        members = members.at[target].set(jnp.sum(wa > 0)).at[i].set(
+            jnp.sum(wb > 0))
+        n_splits += 1
+
+    if n_splits < k - 1:  # degenerate early stop: fill unused slots
+        used = jnp.arange(k) <= n_splits
+        centroids = jnp.where(used[:, None], centroids, centroids[0])
+
+    return KMeansState(
+        centroids=centroids,
+        labels=labels,
+        inertia=jnp.sum(sse),
+        n_iter=jnp.asarray(n_splits, jnp.int32),
+        converged=jnp.asarray(n_splits == k - 1, bool),
+        counts=counts,
+    )
+
+
+@dataclasses.dataclass
+class BisectingKMeans(KMeans):
+    """Estimator wrapper over :func:`fit_bisecting`.
+
+    ``labels_`` are the hierarchical (split-path) labels; ``predict`` is
+    nearest-final-centroid, which can differ on points near leaf boundaries.
+    """
+
+    strategy: str = "biggest_inertia"
+
+    def fit(self, x, weights=None) -> "BisectingKMeans":
+        x = jnp.asarray(x)
+        init = None if isinstance(self.init, str) else self.init
+        if init is not None:
+            raise ValueError(
+                "BisectingKMeans derives every centroid from splits; "
+                "an init array is not accepted"
+            )
+        self.state = fit_bisecting(
+            x,
+            self.n_clusters,
+            config=self._config(),
+            strategy=self.strategy,
+            weights=weights,
+        )
+        return self

@@ -196,9 +196,14 @@ def kmeans_parallel(
         # Fold this round's nearest-of-ell into the global nearest: strict <
         # keeps earlier candidates on ties, matching a full argmin over all
         # m candidates — and saves the extra (n, m) pass it would cost.
+        # Invalid (-inf-padded) picks must not shrink d2 or steal labels:
+        # they are not real samples, and letting them capture mass would
+        # both suppress later-round sampling of their region and drop that
+        # mass from the weighted recluster.
         offset = 1 + r * ell
-        labels = jnp.where(mind < d2, offset + lab, labels)
-        d2 = jnp.minimum(d2, mind)
+        take = valid[lab] & (mind < d2)
+        labels = jnp.where(take, offset + lab, labels)
+        d2 = jnp.where(take, mind, d2)
     candidates = jnp.concatenate(cands, axis=0)        # (m, d) float32
     cand_valid = jnp.concatenate(valids, axis=0)       # (m,) bool
 
@@ -243,6 +248,26 @@ def init_centroids(
     raise ValueError(f"unknown init method {method!r}")
 
 
+def resolve_fit_config(k, key, config):
+    """Config/key half of the shared fit-entry-point boilerplate:
+    config-vs-k consistency, k >= 1, key from the config seed.  Used by
+    every ``fit_*`` front door (directly, or via
+    :func:`resolve_fit_inputs`) so the checks can't drift between model
+    families.  Returns ``(cfg, key)``."""
+    from kmeans_tpu.config import KMeansConfig
+
+    cfg = (config or KMeansConfig(k=k)).validate()
+    if config is not None and config.k != k:
+        raise ValueError(
+            f"k={k} contradicts config.k={config.k}; pass matching values"
+        )
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if key is None:
+        key = jax.random.key(cfg.seed)
+    return cfg, key
+
+
 def resolve_fit_inputs(x, k, key, config, init, weights):
     """Shared fit-entry-point boilerplate: validated config, PRNG key, and
     starting centroids.
@@ -255,17 +280,7 @@ def resolve_fit_inputs(x, k, key, config, init, weights):
 
     Returns ``(cfg, key, c0_float32)``.
     """
-    from kmeans_tpu.config import KMeansConfig
-
-    cfg = (config or KMeansConfig(k=k)).validate()
-    if config is not None and config.k != k:
-        raise ValueError(
-            f"k={k} contradicts config.k={config.k}; pass matching values"
-        )
-    if k < 1:
-        raise ValueError(f"k must be >= 1, got {k}")
-    if key is None:
-        key = jax.random.key(cfg.seed)
+    cfg, key = resolve_fit_config(k, key, config)
     if init is not None and not isinstance(init, str):
         c0 = jnp.asarray(init, jnp.float32)
         if c0.shape != (k, x.shape[1]):

@@ -1,0 +1,124 @@
+"""Bisecting k-means: recovery, SSE consistency, strategies, degeneracy."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracles
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import BisectingKMeans, fit_bisecting
+
+
+def _best_accuracy(got, want, k):
+    acc = 0.0
+    for perm in itertools.permutations(range(k)):
+        mapped = np.array([perm[g] for g in got])
+        acc = max(acc, float(np.mean(mapped == want)))
+    return acc
+
+
+def test_bisecting_recovers_separated_blobs():
+    x, true_labels, _ = make_blobs(jax.random.key(0), 800, 4, 4,
+                                   cluster_std=0.2)
+    state = fit_bisecting(x, 4, key=jax.random.key(1))
+    assert bool(state.converged)
+    assert int(state.n_iter) == 3
+    assert bool(jnp.all(state.counts > 0))
+    acc = _best_accuracy(np.asarray(state.labels), np.asarray(true_labels), 4)
+    assert acc > 0.98
+
+
+def test_bisecting_inertia_consistent_with_labels_and_centroids():
+    x, _, _ = make_blobs(jax.random.key(2), 600, 6, 5, cluster_std=0.5)
+    state = fit_bisecting(x, 5, key=jax.random.key(3))
+    xn = np.asarray(x, np.float64)
+    c = np.asarray(state.centroids, np.float64)
+    lab = np.asarray(state.labels)
+    want = sum(
+        np.sum((xn[lab == j] - c[j]) ** 2) for j in range(5)
+    )
+    np.testing.assert_allclose(float(state.inertia), want, rtol=1e-3)
+    want_counts = np.bincount(lab, minlength=5)
+    np.testing.assert_allclose(np.asarray(state.counts), want_counts)
+
+
+def test_bisecting_inertia_nonincreasing_in_k():
+    x, _, _ = make_blobs(jax.random.key(4), 500, 4, 6, cluster_std=0.8)
+    prev = np.inf
+    for k in (1, 2, 4, 6):
+        st = fit_bisecting(x, k, key=jax.random.key(5))
+        assert float(st.inertia) <= prev + 1e-3
+        prev = float(st.inertia)
+
+
+def test_bisecting_largest_cluster_strategy():
+    x, true_labels, _ = make_blobs(jax.random.key(6), 900, 3, 3,
+                                   cluster_std=0.2)
+    state = fit_bisecting(x, 3, key=jax.random.key(7),
+                          strategy="largest_cluster")
+    assert bool(state.converged)
+    acc = _best_accuracy(np.asarray(state.labels), np.asarray(true_labels), 3)
+    assert acc > 0.98
+    with pytest.raises(ValueError, match="strategy"):
+        fit_bisecting(x, 3, strategy="smallest")
+
+
+def test_bisecting_weighted_excludes_zero_weight_rows():
+    x, _, _ = make_blobs(jax.random.key(8), 400, 3, 3, cluster_std=0.3)
+    out = jnp.full((1, 3), 1e4, jnp.float32)
+    xo = jnp.concatenate([x, out])
+    w = jnp.concatenate([jnp.ones((400,), jnp.float32),
+                         jnp.zeros((1,), jnp.float32)])
+    state = fit_bisecting(xo, 3, key=jax.random.key(9), weights=w)
+    assert float(jnp.max(jnp.abs(state.centroids))) < 1e3
+
+
+def test_bisecting_degenerate_fewer_distinct_points_than_k():
+    # 2 distinct points, k=4: only one split possible; remaining slots are
+    # duplicates with zero counts and the fit reports non-convergence.
+    x = jnp.asarray(np.repeat(np.array([[0.0, 0.0], [5.0, 5.0]], np.float32),
+                              20, axis=0))
+    state = fit_bisecting(x, 4, key=jax.random.key(0))
+    assert not bool(state.converged)
+    assert int(jnp.sum(state.counts > 0)) == 2
+    assert float(state.inertia) == pytest.approx(0.0, abs=1e-4)
+    assert bool(jnp.all(jnp.isfinite(state.centroids)))
+
+
+def test_bisecting_estimator_surface():
+    x, _, _ = make_blobs(jax.random.key(10), 500, 4, 4, cluster_std=0.2)
+    bk = BisectingKMeans(n_clusters=4, seed=0).fit(np.asarray(x))
+    assert bk.cluster_centers_.shape == (4, 4)
+    assert bk.labels_.shape == (500,)
+    assert bk.n_iter_ == 3
+    # Well-separated blobs: nearest-centroid predict agrees with the
+    # hierarchical fit labels.
+    pred = np.asarray(bk.predict(np.asarray(x)))
+    assert np.mean(pred == np.asarray(bk.labels_)) > 0.98
+    with pytest.raises(ValueError, match="init array"):
+        BisectingKMeans(n_clusters=2, init=np.zeros((2, 4), np.float32)).fit(
+            np.asarray(x))
+
+
+def test_bisecting_deterministic_given_key():
+    x, _, _ = make_blobs(jax.random.key(11), 300, 5, 4)
+    s1 = fit_bisecting(x, 4, key=jax.random.key(12))
+    s2 = fit_bisecting(x, 4, key=jax.random.key(12))
+    np.testing.assert_array_equal(np.asarray(s1.centroids),
+                                  np.asarray(s2.centroids))
+    np.testing.assert_array_equal(np.asarray(s1.labels),
+                                  np.asarray(s2.labels))
+
+
+def test_bisecting_honors_init_method_and_rejects_given():
+    from kmeans_tpu.config import KMeansConfig
+
+    x, _, _ = make_blobs(jax.random.key(13), 400, 3, 4, cluster_std=0.3)
+    st = fit_bisecting(x, 4, key=jax.random.key(14),
+                       config=KMeansConfig(k=4, init="random"))
+    assert bool(st.converged)
+    with pytest.raises(ValueError, match="given"):
+        fit_bisecting(x, 4, config=KMeansConfig(k=4, init="given"))

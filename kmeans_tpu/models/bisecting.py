@@ -71,7 +71,10 @@ def fit_bisecting(
     cfg2 = dataclasses.replace(cfg, k=2, empty="keep")
 
     labels = jnp.zeros((n,), jnp.int32)
-    mean0 = (w[:, None] * x.astype(f32)).sum(0) / jnp.maximum(w.sum(), 1.0)
+    w_total = w.sum()
+    mean0 = (w[:, None] * x.astype(f32)).sum(0) / jnp.where(
+        w_total > 0, w_total, 1.0
+    )
     _, mind0 = assign(x, mean0[None], chunk_size=cfg.chunk_size,
                       compute_dtype=cfg.compute_dtype)
     centroids = jnp.zeros((k, d), f32).at[0].set(mean0)

@@ -116,9 +116,13 @@ def _kmpar_round(key, x, d2, logw, *, ell, chunk_size, compute_dtype):
     top, idx = lax.top_k(score, ell)
     cand = x[idx].astype(jnp.float32)
     # top_k pads with -inf rows when fewer than ell rows remain eligible
-    # (zero weight or already chosen); mark those invalid so they can be
-    # weight-zeroed downstream instead of becoming seeds.
+    # (zero weight or already chosen): mark those invalid AND overwrite them
+    # with the round's top pick.  top_k sorts descending, so cand[0] is valid
+    # whenever any pick is, and argmin's lowest-index tie-break means a
+    # duplicate row can never win an assignment — invalid picks are thereby
+    # excluded from the distance fold without any +inf sentinel arithmetic.
     valid = top > -jnp.inf
+    cand = jnp.where(valid[:, None], cand, cand[0])
     lab, mind = assign(x, cand, chunk_size=chunk_size,
                        compute_dtype=compute_dtype)
     return cand, lab, mind, valid
@@ -196,14 +200,11 @@ def kmeans_parallel(
         # Fold this round's nearest-of-ell into the global nearest: strict <
         # keeps earlier candidates on ties, matching a full argmin over all
         # m candidates — and saves the extra (n, m) pass it would cost.
-        # Invalid (-inf-padded) picks must not shrink d2 or steal labels:
-        # they are not real samples, and letting them capture mass would
-        # both suppress later-round sampling of their region and drop that
-        # mass from the weighted recluster.
+        # Invalid picks were overwritten with cand[0] above, so the argmin
+        # tie-break already keeps them from ever being `lab`.
         offset = 1 + r * ell
-        take = valid[lab] & (mind < d2)
-        labels = jnp.where(take, offset + lab, labels)
-        d2 = jnp.where(take, mind, d2)
+        labels = jnp.where(mind < d2, offset + lab, labels)
+        d2 = jnp.minimum(d2, mind)
     candidates = jnp.concatenate(cands, axis=0)        # (m, d) float32
     cand_valid = jnp.concatenate(valids, axis=0)       # (m,) bool
 

@@ -34,7 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kmeans_tpu.config import KMeansConfig
-from kmeans_tpu.models.init import init_centroids
+from kmeans_tpu.models.init import init_centroids, resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
@@ -193,6 +193,86 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
     return new_c_loc, inertia, counts
 
 
+def _fp_local_pass(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
+                   chunk_size, compute_dtype, update, with_labels,
+                   empty="keep"):
+    """DP×FP shard body: the *feature* axis of both x and centroids is
+    sharded over ``feature_axis`` (SURVEY.md §5.7 — the long-context analog:
+    scale in d instead of sequence length).
+
+    Each device holds a (n_loc, d_loc) slice and the matching (k, d_loc)
+    centroid slice.  Per tile, the partial dot products x·cᵀ are assembled
+    with ONE ``psum`` over the feature axis — the same partial-contraction +
+    all-reduce layout sequence-parallel attention uses — after which every
+    feature shard sees identical distances, so labels/inertia are computed
+    replicated and the centroid update writes only the local d-slice (no
+    feature-axis collective on the way back).
+    """
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
+    n_loc, d_loc = x_loc.shape
+    k = c_loc.shape[0]
+
+    c_t = c_loc.astype(cd).T                                 # (d_loc, k)
+    c_sq = lax.psum(sq_norms(c_loc), feature_axis)           # (k,) full norms
+
+    pad = (-n_loc) % chunk_size
+    xp = jnp.concatenate([x_loc, jnp.zeros((pad, d_loc), x_loc.dtype)]) if pad else x_loc
+    wp = jnp.concatenate([w_loc, jnp.zeros((pad,), f32)]) if pad else w_loc
+    n_chunks = xp.shape[0] // chunk_size
+    xs = xp.reshape(n_chunks, chunk_size, d_loc)
+    ws = wp.reshape(n_chunks, chunk_size)
+
+    def body(carry, tile):
+        sums, counts, inertia = carry
+        xb, wb = tile
+        xb_c = xb.astype(cd)
+        prod = lax.psum(
+            jnp.matmul(xb_c, c_t, preferred_element_type=f32,
+                       precision=matmul_precision(cd)),
+            feature_axis,
+        )                                                    # (chunk, k) full
+        x_sq = lax.psum(sq_norms(xb), feature_axis)          # (chunk,)
+        part = c_sq[None, :] - 2.0 * prod
+        lab = jnp.argmin(part, axis=1).astype(jnp.int32)     # same on all fp
+        mind = jnp.maximum(jnp.min(part, axis=1) + x_sq, 0.0)
+        inertia = inertia + jnp.sum(mind * wb)
+        if update == "matmul":
+            onehot = lab[:, None] == jnp.arange(k)[None, :]
+            wt = (onehot * wb[:, None]).astype(cd)
+            sums = sums + jnp.matmul(wt.T, xb_c, preferred_element_type=f32,
+                                     precision=matmul_precision(cd))
+            counts = counts + jnp.sum(onehot.astype(f32) * wb[:, None], axis=0)
+        else:  # "segment"
+            sums = sums + jax.ops.segment_sum(
+                xb.astype(f32) * wb[:, None], lab, num_segments=k
+            )
+            counts = counts + jax.ops.segment_sum(wb, lab, num_segments=k)
+        return (sums, counts, inertia), (lab, mind)
+
+    init = (jnp.zeros((k, d_loc), f32), jnp.zeros((k,), f32),
+            jnp.zeros((), f32))
+    (sums, counts, inertia), (labs, minds) = lax.scan(body, init, (xs, ws))
+
+    sums = lax.psum(sums, data_axis)                         # (k, d_loc) slice
+    counts = lax.psum(counts, data_axis)
+    inertia = lax.psum(inertia, data_axis)
+    new_c_loc = apply_update(c_loc, sums, counts)
+    if empty == "farthest":
+        # min_d2 is identical on every feature shard, and x_loc carries this
+        # shard's d-slice — the DP reseed assembles each winner's local
+        # slice, which is exactly the slice this shard must hold; the winner
+        # choice (driven by mind values) agrees across feature shards.
+        mind_rows = minds.reshape(-1)[:n_loc]
+        masked = jnp.where(w_loc > 0, mind_rows, -jnp.inf)
+        new_c_loc = _reseed_empty_farthest_dp(
+            new_c_loc, counts, x_loc, masked, data_axis
+        )
+    if with_labels:
+        return new_c_loc, inertia, counts, labs.reshape(-1)[:n_loc]
+    return new_c_loc, inertia, counts
+
+
 # ---------------------------------------------------------------------------
 # Global-view fit
 # ---------------------------------------------------------------------------
@@ -221,40 +301,58 @@ def fit_lloyd_sharded(
     init=None,
     data_axis: str = "data",
     model_axis: Optional[str] = None,
+    feature_axis: Optional[str] = None,
     tol: Optional[float] = None,
     max_iter: Optional[int] = None,
 ) -> KMeansState:
-    """Full-batch Lloyd on a device mesh (DP, optionally DP×TP).
+    """Full-batch Lloyd on a device mesh (DP, optionally DP×TP or DP×FP).
 
     ``x`` may be host memory (numpy) or a jax.Array; it is placed with rows
     sharded over ``data_axis``.  With ``model_axis`` set, centroids shard
-    over k (padded up to a multiple of the axis size).
+    over k (padded up to a multiple of the axis size).  With ``feature_axis``
+    set, BOTH x and centroids shard over d (padded likewise) — the
+    long-context analog of SURVEY.md §5.7, for d too large per chip.
     """
-    cfg = (config or KMeansConfig(k=k)).validate()
-    if config is not None and config.k != k:
-        raise ValueError(f"k={k} contradicts config.k={config.k}")
+    cfg, key = resolve_fit_config(k, key, config)
+    if model_axis is not None and feature_axis is not None:
+        raise ValueError(
+            "model_axis (TP over k) and feature_axis (FP over d) are "
+            "mutually exclusive on one fit; pick the axis that is too big"
+        )
     if cfg.empty == "farthest" and model_axis is not None:
         raise NotImplementedError(
             "empty='farthest' is not supported on DP×TP meshes yet (empty "
             "slots live in sharded k-slices); use a DP-only mesh, "
             "empty='keep', or the single-device fit_lloyd"
         )
-    if key is None:
-        key = jax.random.key(cfg.seed)
 
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     dp = axis_sizes[data_axis]
     mp = axis_sizes[model_axis] if model_axis else 1
+    fp = axis_sizes[feature_axis] if feature_axis else 1
+
+    d_real = x.shape[1]
+    d_pad = (-d_real) % fp
+    if d_pad:  # zero feature columns: add 0 to every distance, mean stays 0
+        x = (np.concatenate if isinstance(x, np.ndarray) else jnp.concatenate)(
+            [x, (np if isinstance(x, np.ndarray) else jnp).zeros(
+                (x.shape[0], d_pad), x.dtype)], axis=1,
+        )
 
     x, w_host, n = _pad_rows(x, dp)
-    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    x_spec = P(data_axis, feature_axis) if feature_axis else P(data_axis)
+    x = jax.device_put(x, NamedSharding(mesh, x_spec))
     w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
 
     # --- init (global view; XLA auto-shards the init computation) ---
     if init is not None and not isinstance(init, str):
         c0 = jnp.asarray(init, jnp.float32)
-        if c0.shape != (k, x.shape[1]):
-            raise ValueError(f"init centroids shape {c0.shape} != {(k, x.shape[1])}")
+        if c0.shape != (k, d_real):
+            raise ValueError(f"init centroids shape {c0.shape} != {(k, d_real)}")
+        if d_pad:
+            c0 = jnp.concatenate(
+                [c0, jnp.zeros((k, d_pad), jnp.float32)], axis=1
+            )
     else:
         method = init if isinstance(init, str) else cfg.init
         c0 = init_centroids(
@@ -265,36 +363,56 @@ def fit_lloyd_sharded(
     k_pad = (-k) % mp
     if k_pad:
         c0 = jnp.concatenate([c0, jnp.zeros((k_pad, x.shape[1]), jnp.float32)])
-    c_spec = P(model_axis) if model_axis else P()
+    if feature_axis:
+        c_spec = P(None, feature_axis)
+    elif model_axis:
+        c_spec = P(model_axis)
+    else:
+        c_spec = P()
     c0 = jax.device_put(c0, NamedSharding(mesh, c_spec))
 
     tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
     max_it = max_iter if max_iter is not None else cfg.max_iter
     # Resolve the fused-pass backend against the *mesh's* platform (the
     # default backend may differ, e.g. virtual-CPU-mesh tests on a TPU host).
-    # The TP local pass has no Pallas variant yet, so DP-only meshes decide.
-    backend = "xla" if model_axis else resolve_backend(
+    # Only DP-only meshes use the fused lloyd_pass (the TP/FP local passes
+    # have no Pallas variant), so only they resolve a backend.
+    backend = "xla" if (model_axis or feature_axis) else resolve_backend(
         cfg.backend, x, k, weights_are_binary=True, weights=w_host,
         compute_dtype=cfg.compute_dtype,
         platform=mesh.devices.flat[0].platform,
     )
     run = _build_lloyd_run(
         mesh, data_axis, model_axis, k, cfg.chunk_size, cfg.compute_dtype,
-        cfg.update, max_it, backend, cfg.empty,
+        cfg.update, max_it, backend, cfg.empty, feature_axis,
     )
     c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
     return KMeansState(
-        c[:k], labels[:n], inertia, n_iter, converged, counts[:k]
+        c[:k, :d_real], labels[:n], inertia, n_iter, converged, counts[:k]
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
                      compute_dtype, update, max_it, backend="xla",
-                     empty="keep"):
+                     empty="keep", feature_axis=None):
     """Jitted whole-fit program, cached so repeated same-shaped fits reuse
     the compiled executable (jax.jit caches by function identity)."""
-    if model_axis is None:
+    if feature_axis is not None:
+        local = functools.partial(
+            _fp_local_pass,
+            data_axis=data_axis,
+            feature_axis=feature_axis,
+            chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            update=update,
+            empty=empty,
+        )
+        in_specs = (P(data_axis, feature_axis), P(None, feature_axis),
+                    P(data_axis))
+        out_step = (P(None, feature_axis), P(), P())
+        out_final = (P(None, feature_axis), P(), P(), P(data_axis))
+    elif model_axis is None:
         local = functools.partial(
             _dp_local_pass,
             data_axis=data_axis,
@@ -416,11 +534,7 @@ def fit_minibatch_sharded(
     """
     from kmeans_tpu.models.minibatch import _minibatch_loop
 
-    cfg = (config or KMeansConfig(k=k)).validate()
-    if config is not None and config.k != k:
-        raise ValueError(f"k={k} contradicts config.k={config.k}")
-    if key is None:
-        key = jax.random.key(cfg.seed)
+    cfg, key = resolve_fit_config(k, key, config)
     ikey, lkey = jax.random.split(key)
 
     # Rows are padded up to the data-axis size (device_put requires even

@@ -227,3 +227,74 @@ def test_sharded_kmeans_parallel_init_on_mesh(cpu_devices):
     assert state.centroids.shape == (4, 8)
     assert bool(jnp.all(state.counts > 0))
     assert bool(jnp.all(jnp.isfinite(state.centroids)))
+
+
+def test_dp_fp_matches_single_device(problem, cpu_devices):
+    # Feature-axis sharding (SURVEY.md §5.7): x and centroids sharded on d.
+    x, c0 = problem
+    want = _single(problem)
+    mesh = cpu_mesh((4, 2), ("data", "feature"))
+    got = fit_lloyd_sharded(
+        x, 5, mesh=mesh, init=c0, tol=1e-10, max_iter=25,
+        feature_axis="feature",
+    )
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)
+    assert int(got.n_iter) == int(want.n_iter)
+
+
+def test_dp_fp_uneven_d_is_padded(cpu_devices):
+    # d=13 does not divide feature=4: zero feature columns must not change
+    # anything, and returned centroids must have the original d.
+    x, _, _ = make_blobs(jax.random.key(21), 808, 13, 4, cluster_std=0.5)
+    x = np.asarray(x)
+    c0 = x[:4].copy()
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0), tol=1e-10,
+                     max_iter=20)
+    mesh = cpu_mesh((2, 4), ("data", "feature"))
+    got = fit_lloyd_sharded(x, 4, mesh=mesh, init=c0, tol=1e-10, max_iter=20,
+                            feature_axis="feature")
+    assert got.centroids.shape == (4, 13)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_dp_fp_farthest_reseed_matches_single_device(cpu_devices):
+    from kmeans_tpu.config import KMeansConfig
+
+    rng = np.random.default_rng(3)
+    x = np.concatenate([
+        np.zeros((64, 12), np.float32),
+        rng.normal(size=(16, 12)).astype(np.float32) * 5 + 20,
+    ])
+    c0 = np.zeros((4, 12), np.float32)
+    cfg = KMeansConfig(k=4, empty="farthest", init="given")
+    want = fit_lloyd(jnp.asarray(x), 4, config=cfg, init=jnp.asarray(c0),
+                     tol=1e-10, max_iter=10)
+    mesh = cpu_mesh((2, 4), ("data", "feature"))
+    got = fit_lloyd_sharded(x, 4, mesh=mesh, config=cfg, init=c0, tol=1e-10,
+                            max_iter=10, feature_axis="feature")
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_fp_and_tp_mutually_exclusive(problem, cpu_devices):
+    x, c0 = problem
+    mesh = cpu_mesh((2, 2, 2), ("data", "model", "feature"))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        fit_lloyd_sharded(x, 5, mesh=mesh, init=c0, model_axis="model",
+                          feature_axis="feature")

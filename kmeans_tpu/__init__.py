@@ -21,11 +21,13 @@ __version__ = "0.1.0"
 from kmeans_tpu.config import KMeansConfig, MeshConfig, RunConfig, ServeConfig
 from kmeans_tpu.models import (
     BisectingKMeans,
+    FuzzyCMeans,
     KMeans,
     KMeansState,
     MiniBatchKMeans,
     SphericalKMeans,
     fit_bisecting,
+    fit_fuzzy,
     fit_lloyd,
     fit_lloyd_accelerated,
     fit_minibatch,
@@ -38,11 +40,13 @@ __all__ = [
     "RunConfig",
     "ServeConfig",
     "BisectingKMeans",
+    "FuzzyCMeans",
     "KMeans",
     "KMeansState",
     "MiniBatchKMeans",
     "SphericalKMeans",
     "fit_bisecting",
+    "fit_fuzzy",
     "fit_lloyd",
     "fit_lloyd_accelerated",
     "fit_minibatch",

@@ -3,6 +3,12 @@ spherical (cosine), and initialization."""
 
 from kmeans_tpu.models.accelerated import fit_lloyd_accelerated
 from kmeans_tpu.models.bisecting import BisectingKMeans, fit_bisecting
+from kmeans_tpu.models.fuzzy import (
+    FuzzyCMeans,
+    FuzzyState,
+    fit_fuzzy,
+    fuzzy_memberships,
+)
 from kmeans_tpu.models.init import (
     init_centroids,
     kmeans_parallel,
@@ -20,9 +26,13 @@ from kmeans_tpu.models.spherical import (
 
 __all__ = [
     "BisectingKMeans",
+    "FuzzyCMeans",
+    "FuzzyState",
     "IterInfo",
     "LloydRunner",
     "fit_bisecting",
+    "fit_fuzzy",
+    "fuzzy_memberships",
     "init_centroids",
     "kmeans_parallel",
     "kmeans_plus_plus",

@@ -88,6 +88,39 @@ def _reseed_empty_farthest_dp(new_c, counts, x_loc, min_d2, data_axis):
     return jnp.where(empty[:, None], repl[rank], new_c)
 
 
+def _chunk_tiles(x_loc, w_loc, chunk_size):
+    """Pad local rows to a chunk multiple and reshape into scan tiles.
+
+    Returns ``(xs (n_chunks, chunk, d), ws (n_chunks, chunk), n_loc)`` with
+    padding rows carrying weight 0.
+    """
+    f32 = jnp.float32
+    n_loc, d = x_loc.shape
+    pad = (-n_loc) % chunk_size
+    xp = jnp.concatenate([x_loc, jnp.zeros((pad, d), x_loc.dtype)]) if pad else x_loc
+    wp = jnp.concatenate([w_loc, jnp.zeros((pad,), f32)]) if pad else w_loc
+    n_chunks = xp.shape[0] // chunk_size
+    return (xp.reshape(n_chunks, chunk_size, d),
+            wp.reshape(n_chunks, chunk_size), n_loc)
+
+
+def _accumulate_full_k(sums, counts, lab, xb, xb_c, wb, *, k, update, cd):
+    """Fold one tile's assignments into (sums, counts) over all k slots."""
+    f32 = jnp.float32
+    if update == "matmul":
+        onehot = lab[:, None] == jnp.arange(k)[None, :]
+        wt = (onehot * wb[:, None]).astype(cd)
+        sums = sums + jnp.matmul(wt.T, xb_c, preferred_element_type=f32,
+                                 precision=matmul_precision(cd))
+        counts = counts + jnp.sum(onehot.astype(f32) * wb[:, None], axis=0)
+    else:  # "segment"
+        sums = sums + jax.ops.segment_sum(
+            xb.astype(f32) * wb[:, None], lab, num_segments=k
+        )
+        counts = counts + jax.ops.segment_sum(wb, lab, num_segments=k)
+    return sums, counts
+
+
 def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
                    update, with_labels, backend="xla", empty="keep"):
     """DP shard body: fused local pass + psum merge; centroids replicated."""
@@ -133,12 +166,7 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
     c_t = c_loc.astype(cd).T
     c_sq = sq_norms(c_loc)
 
-    pad = (-n_loc) % chunk_size
-    xp = jnp.concatenate([x_loc, jnp.zeros((pad, d), x_loc.dtype)]) if pad else x_loc
-    wp = jnp.concatenate([w_loc, jnp.zeros((pad,), f32)]) if pad else w_loc
-    n_chunks = xp.shape[0] // chunk_size
-    xs = xp.reshape(n_chunks, chunk_size, d)
-    ws = wp.reshape(n_chunks, chunk_size)
+    xs, ws, _ = _chunk_tiles(x_loc, w_loc, chunk_size)
 
     def body(carry, tile):
         sums, counts, inertia = carry
@@ -210,49 +238,40 @@ def _fp_local_pass(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
     """
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
-    n_loc, d_loc = x_loc.shape
+    d_loc = x_loc.shape[1]
     k = c_loc.shape[0]
 
     c_t = c_loc.astype(cd).T                                 # (d_loc, k)
     c_sq = lax.psum(sq_norms(c_loc), feature_axis)           # (k,) full norms
 
-    pad = (-n_loc) % chunk_size
-    xp = jnp.concatenate([x_loc, jnp.zeros((pad, d_loc), x_loc.dtype)]) if pad else x_loc
-    wp = jnp.concatenate([w_loc, jnp.zeros((pad,), f32)]) if pad else w_loc
-    n_chunks = xp.shape[0] // chunk_size
-    xs = xp.reshape(n_chunks, chunk_size, d_loc)
-    ws = wp.reshape(n_chunks, chunk_size)
+    xs, ws, n_loc = _chunk_tiles(x_loc, w_loc, chunk_size)
+    # Full row norms once per pass (x is loop-invariant): one psum here
+    # instead of one per chunk inside the scan.
+    xs_sq = lax.psum(sq_norms(xs), feature_axis)             # (n_chunks, chunk)
 
     def body(carry, tile):
         sums, counts, inertia = carry
-        xb, wb = tile
+        xb, wb, xb_sq = tile
         xb_c = xb.astype(cd)
         prod = lax.psum(
             jnp.matmul(xb_c, c_t, preferred_element_type=f32,
                        precision=matmul_precision(cd)),
             feature_axis,
         )                                                    # (chunk, k) full
-        x_sq = lax.psum(sq_norms(xb), feature_axis)          # (chunk,)
         part = c_sq[None, :] - 2.0 * prod
         lab = jnp.argmin(part, axis=1).astype(jnp.int32)     # same on all fp
-        mind = jnp.maximum(jnp.min(part, axis=1) + x_sq, 0.0)
+        mind = jnp.maximum(jnp.min(part, axis=1) + xb_sq, 0.0)
         inertia = inertia + jnp.sum(mind * wb)
-        if update == "matmul":
-            onehot = lab[:, None] == jnp.arange(k)[None, :]
-            wt = (onehot * wb[:, None]).astype(cd)
-            sums = sums + jnp.matmul(wt.T, xb_c, preferred_element_type=f32,
-                                     precision=matmul_precision(cd))
-            counts = counts + jnp.sum(onehot.astype(f32) * wb[:, None], axis=0)
-        else:  # "segment"
-            sums = sums + jax.ops.segment_sum(
-                xb.astype(f32) * wb[:, None], lab, num_segments=k
-            )
-            counts = counts + jax.ops.segment_sum(wb, lab, num_segments=k)
+        sums, counts = _accumulate_full_k(
+            sums, counts, lab, xb, xb_c, wb, k=k, update=update, cd=cd
+        )
         return (sums, counts, inertia), (lab, mind)
 
     init = (jnp.zeros((k, d_loc), f32), jnp.zeros((k,), f32),
             jnp.zeros((), f32))
-    (sums, counts, inertia), (labs, minds) = lax.scan(body, init, (xs, ws))
+    (sums, counts, inertia), (labs, minds) = lax.scan(
+        body, init, (xs, ws, xs_sq)
+    )
 
     sums = lax.psum(sums, data_axis)                         # (k, d_loc) slice
     counts = lax.psum(counts, data_axis)

@@ -1,0 +1,129 @@
+"""Fuzzy c-means vs a NumPy oracle; membership properties; estimator."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import (
+    FuzzyCMeans,
+    fit_fuzzy,
+    fit_lloyd,
+    fuzzy_memberships,
+)
+
+
+def _oracle_fcm(x, c0, m=2.0, max_iter=50, tol=1e-10):
+    """Textbook FCM in float64 NumPy."""
+    x = np.asarray(x, np.float64)
+    c = np.asarray(c0, np.float64).copy()
+    inv_exp = 1.0 / (m - 1.0)
+    for it in range(max_iter):
+        d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        u = _oracle_memberships(d2, inv_exp)
+        um = u ** m
+        new_c = (um.T @ x) / np.maximum(um.sum(0)[:, None], 1e-300)
+        shift = ((new_c - c) ** 2).sum()
+        c = new_c
+        if shift <= tol:
+            break
+    d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    u = _oracle_memberships(d2, inv_exp)
+    obj = ((u ** m) * d2).sum()
+    return c, u, obj
+
+
+def _oracle_memberships(d2, inv_exp):
+    n, k = d2.shape
+    u = np.zeros((n, k))
+    for i in range(n):
+        zeros = d2[i] <= 0
+        if zeros.any():
+            u[i, np.argmax(zeros)] = 1.0
+        else:
+            t = (d2[i] / d2[i].min()) ** (-inv_exp)
+            u[i] = t / t.sum()
+    return u
+
+
+def test_fuzzy_matches_numpy_oracle(rng):
+    x = rng.normal(size=(150, 4)).astype(np.float32)
+    c0 = x[:4].copy()
+    from kmeans_tpu.config import KMeansConfig
+
+    state = fit_fuzzy(jnp.asarray(x), 4, init=jnp.asarray(c0), tol=1e-10,
+                      max_iter=50,
+                      config=KMeansConfig(k=4, init="given", chunk_size=64))
+    want_c, want_u, want_obj = _oracle_fcm(x, c0)
+    np.testing.assert_allclose(np.asarray(state.centroids), want_c,
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(float(state.objective), want_obj, rtol=1e-3)
+    u = fuzzy_memberships(jnp.asarray(x), state.centroids, chunk_size=64)
+    np.testing.assert_allclose(np.asarray(u), want_u, rtol=1e-2, atol=1e-3)
+
+
+def test_fuzzy_memberships_rows_sum_to_one_and_handle_coincident():
+    x = jnp.asarray(np.array([[0.0, 0.0], [1.0, 1.0], [5.0, 5.0]],
+                             np.float32))
+    c = jnp.asarray(np.array([[0.0, 0.0], [5.0, 5.0]], np.float32))
+    u = fuzzy_memberships(x, c, chunk_size=2)
+    np.testing.assert_allclose(np.asarray(u).sum(1), 1.0, rtol=1e-5)
+    # coincident points get exact one-hot memberships
+    np.testing.assert_allclose(np.asarray(u[0]), [1.0, 0.0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(u[2]), [0.0, 1.0], atol=1e-6)
+    assert bool(jnp.all(jnp.isfinite(u)))
+
+
+def test_fuzzy_tiny_distances_stay_finite():
+    # A point 1e-25 away from a centroid: naive d^(-2/(m-1)) overflows f32.
+    c0 = np.array([[0.0], [1.0]], np.float32)
+    x = jnp.asarray(np.array([[1e-25], [1.0], [0.5]], np.float32))
+    u = fuzzy_memberships(x, jnp.asarray(c0))
+    assert bool(jnp.all(jnp.isfinite(u)))
+    np.testing.assert_allclose(np.asarray(u).sum(1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(u[0]), [1.0, 0.0], atol=1e-5)
+
+
+def test_fuzzy_sharpens_toward_hard_kmeans_as_m_to_one():
+    x, _, _ = make_blobs(jax.random.key(0), 600, 4, 3, cluster_std=0.3)
+    hard = fit_lloyd(x, 3, key=jax.random.key(1), max_iter=50)
+    soft = fit_fuzzy(x, 3, m=1.05, key=jax.random.key(1), max_iter=50)
+    # With m near 1 on separated blobs, FCM recovers the hard clustering
+    # (ARI is label-permutation-invariant).
+    from kmeans_tpu.metrics import adjusted_rand_index
+
+    ari = float(adjusted_rand_index(hard.labels, soft.labels))
+    assert ari > 0.95
+    np.testing.assert_allclose(float(soft.objective), float(hard.inertia),
+                               rtol=0.05)
+
+
+def test_fuzzy_rejects_bad_m():
+    x, _, _ = make_blobs(jax.random.key(2), 50, 2, 2)
+    with pytest.raises(ValueError, match="fuzziness"):
+        fit_fuzzy(x, 2, m=1.0)
+
+
+def test_fuzzy_estimator_surface(rng):
+    x = rng.normal(size=(300, 5)).astype(np.float32)
+    fc = FuzzyCMeans(n_clusters=4, seed=0).fit(x)
+    assert fc.cluster_centers_.shape == (4, 5)
+    assert fc.labels_.shape == (300,)
+    assert fc.objective_ > 0
+    assert fc.n_iter_ >= 1
+    u = fc.soft_predict(x[:11])
+    assert u.shape == (11, 4)
+    np.testing.assert_allclose(np.asarray(u).sum(1), 1.0, rtol=1e-5)
+    pred = fc.predict(x)
+    np.testing.assert_array_equal(np.asarray(pred), np.asarray(fc.labels_))
+
+
+def test_fuzzy_weighted_zero_weight_rows_have_no_pull():
+    x, _, _ = make_blobs(jax.random.key(3), 300, 3, 3, cluster_std=0.3)
+    out = jnp.full((1, 3), 1e4, jnp.float32)
+    xo = jnp.concatenate([x, out])
+    w = jnp.concatenate([jnp.ones((300,), jnp.float32),
+                         jnp.zeros((1,), jnp.float32)])
+    state = fit_fuzzy(xo, 3, key=jax.random.key(4), weights=w)
+    assert float(jnp.max(jnp.abs(state.centroids))) < 1e3

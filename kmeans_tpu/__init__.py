@@ -32,6 +32,8 @@ from kmeans_tpu.models import (
     fit_lloyd_accelerated,
     fit_minibatch,
     fit_spherical,
+    suggest_k,
+    sweep_k,
 )
 
 __all__ = [
@@ -51,5 +53,7 @@ __all__ = [
     "fit_lloyd_accelerated",
     "fit_minibatch",
     "fit_spherical",
+    "suggest_k",
+    "sweep_k",
     "__version__",
 ]

@@ -17,18 +17,37 @@ def _cmd_train(args) -> int:
     import jax
     import numpy as np
 
+    import kmeans_tpu.models as models
     from kmeans_tpu.config import KMeansConfig
     from kmeans_tpu.data import bench_config, make_blobs
-    from kmeans_tpu.models import fit_lloyd, fit_minibatch
     from kmeans_tpu.session import dataset_to_document, export_json
 
     if args.config:
         cfg = bench_config(args.config)
         n, d, k = cfg["n"], cfg["d"], cfg["k"]
-        minibatch = cfg["minibatch"] if args.minibatch is None else args.minibatch
+        cfg_minibatch = cfg["minibatch"]
     else:
         n, d, k = args.n, args.d, args.k
-        minibatch = bool(args.minibatch)
+        cfg_minibatch = False
+    # Precedence: explicit --model > explicit --minibatch/--no-minibatch >
+    # the named config's minibatch default.  Contradictory explicit flags
+    # are an error, not a silent override.
+    if args.model is not None and args.minibatch is not None and (
+        (args.minibatch and args.model != "minibatch")
+        or (not args.minibatch and args.model == "minibatch")
+    ):
+        print(
+            f"error: --model {args.model} contradicts "
+            f"--{'minibatch' if args.minibatch else 'no-minibatch'}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.model is not None:
+        model = args.model
+    else:
+        use_mb = args.minibatch if args.minibatch is not None else cfg_minibatch
+        model = "minibatch" if use_mb else "lloyd"
+    minibatch = model == "minibatch"
 
     if args.input:
         x = np.load(args.input)
@@ -42,8 +61,8 @@ def _cmd_train(args) -> int:
         )
 
     kcfg = KMeansConfig(
-        k=k, max_iter=args.max_iter, tol=args.tol, seed=args.seed,
-        compute_dtype=args.dtype,
+        k=k, init=args.init, max_iter=args.max_iter, tol=args.tol,
+        seed=args.seed, compute_dtype=args.dtype,
     )
 
     mesh = None
@@ -55,11 +74,17 @@ def _cmd_train(args) -> int:
     want_runner = bool(
         args.progress or args.checkpoint or args.resume or args.profile
     )
-    if want_runner and minibatch:
+    if want_runner and model != "lloyd":
         print(
             "error: --progress/--checkpoint/--resume/--profile require the "
-            "full-batch Lloyd path (they would be silently ignored in "
-            "minibatch mode); drop --minibatch or those flags",
+            "full-batch Lloyd path (they would be silently ignored "
+            f"with --model {model}); use --model lloyd or drop those flags",
+            file=sys.stderr,
+        )
+        return 2
+    if mesh is not None and model not in ("lloyd", "minibatch"):
+        print(
+            f"error: --mesh supports --model lloyd/minibatch, not {model}",
             file=sys.stderr,
         )
         return 2
@@ -96,19 +121,25 @@ def _cmd_train(args) -> int:
 
         fit = fit_minibatch_sharded if minibatch else fit_lloyd_sharded
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
-    elif minibatch:
-        state = fit_minibatch(x, k, config=kcfg)
     else:
-        state = fit_lloyd(x, k, config=kcfg)
+        fit = {
+            "lloyd": models.fit_lloyd,
+            "accelerated": models.fit_lloyd_accelerated,
+            "minibatch": models.fit_minibatch,
+            "spherical": models.fit_spherical,
+            "bisecting": models.fit_bisecting,
+            "fuzzy": models.fit_fuzzy,
+        }[model]
+        state = fit(x, k, config=kcfg)
     jax_done = time.perf_counter() - t0
 
     result = {
         "n": int(n), "d": int(d), "k": int(k),
-        "inertia": float(state.inertia),
+        "inertia": float(getattr(state, "inertia", getattr(state, "objective", 0.0))),
         "n_iter": int(state.n_iter),
         "converged": bool(state.converged),
         "wall_s": round(jax_done, 4),
-        "mode": "minibatch" if minibatch else "lloyd",
+        "mode": model,
     }
     print(json.dumps(result))
 
@@ -121,6 +152,41 @@ def _cmd_train(args) -> int:
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(export_json(doc))
         print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+def _cmd_sweep(args) -> int:
+    """Sweep k and print one scored JSON line per k, then a suggestion."""
+    import jax
+    import numpy as np
+
+    from kmeans_tpu.data import make_blobs
+    from kmeans_tpu.models import suggest_k, sweep_k
+
+    if args.input:
+        x = np.load(args.input)
+        if x.ndim != 2:
+            print(f"error: {args.input} must be a 2-D array", file=sys.stderr)
+            return 2
+    else:
+        x, _, _ = make_blobs(
+            jax.random.key(args.seed), args.n, args.d, args.true_k,
+            cluster_std=args.cluster_std,
+        )
+
+    ks = list(range(args.k_min, args.k_max + 1, args.k_step))
+    try:
+        rows = sweep_k(
+            np.asarray(x), ks, model=args.model, max_iter=args.max_iter,
+            compute_dtype=args.dtype, init=args.init, seed=args.seed,
+            silhouette_sample=args.silhouette_sample,
+        )
+        for row in rows:
+            print(json.dumps(row))
+        print(json.dumps({"suggested_k": suggest_k(rows)}))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     return 0
 
 
@@ -157,7 +223,14 @@ def main(argv=None) -> int:
     t.add_argument("--d", type=int, default=2)
     t.add_argument("--k", type=int, default=3)
     t.add_argument("--minibatch", action=argparse.BooleanOptionalAction,
-                   default=None)
+                   default=None, help="alias for --model minibatch "
+                   "(named configs set it from BASELINE)")
+    t.add_argument("--model", default=None, choices=[
+        "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
+        "fuzzy",
+    ], help="model family (default: lloyd, or the config's minibatch choice)")
+    t.add_argument("--init", default="k-means++",
+                   choices=["k-means++", "k-means||", "random"])
     t.add_argument("--mesh", type=int, default=0,
                    help="data-parallel mesh size (0/1 = single device)")
     t.add_argument("--max-iter", type=int, default=100)
@@ -175,6 +248,28 @@ def main(argv=None) -> int:
     t.add_argument("--resume", help="resume from this checkpoint directory")
     t.add_argument("--profile", help="write a jax.profiler trace to this dir")
     t.set_defaults(fn=_cmd_train)
+
+    w = sub.add_parser("sweep", help="sweep k, score fits, suggest a k")
+    w.add_argument("--input", help="path to a .npy (n, d) feature matrix")
+    w.add_argument("--n", type=int, default=2000)
+    w.add_argument("--d", type=int, default=8)
+    w.add_argument("--true-k", type=int, default=4,
+                   help="generating k for the synthetic fallback data")
+    w.add_argument("--k-min", type=int, default=2)
+    w.add_argument("--k-max", type=int, default=8)
+    w.add_argument("--k-step", type=int, default=1)
+    w.add_argument("--model", default="lloyd", choices=[
+        "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
+    ])
+    w.add_argument("--init", default="k-means++",
+                   choices=["k-means++", "k-means||", "random"])
+    w.add_argument("--max-iter", type=int, default=100)
+    w.add_argument("--seed", type=int, default=0)
+    w.add_argument("--dtype", default=None,
+                   choices=[None, "bfloat16", "float32"])
+    w.add_argument("--cluster-std", type=float, default=0.4)
+    w.add_argument("--silhouette-sample", type=int, default=10_000)
+    w.set_defaults(fn=_cmd_sweep)
 
     s = sub.add_parser("serve", help="run the HTTP/SSE visualizer server")
     s.add_argument("--host", default="127.0.0.1")

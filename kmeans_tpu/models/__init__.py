@@ -18,6 +18,7 @@ from kmeans_tpu.models.init import (
 from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.runner import IterInfo, LloydRunner
+from kmeans_tpu.models.selection import suggest_k, sweep_k
 from kmeans_tpu.models.spherical import (
     SphericalKMeans,
     fit_spherical,
@@ -46,4 +47,6 @@ __all__ = [
     "SphericalKMeans",
     "fit_spherical",
     "normalize_rows",
+    "suggest_k",
+    "sweep_k",
 ]

@@ -30,7 +30,7 @@ from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_inputs
-from kmeans_tpu.ops.distance import matmul_precision, sq_norms
+from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
 
 __all__ = ["FuzzyState", "fit_fuzzy", "fuzzy_memberships", "FuzzyCMeans"]
 
@@ -73,14 +73,8 @@ def _fcm_loop(x, centroids0, weights, tol, *, m, max_iter, chunk_size,
     n, d = x.shape
     k = centroids0.shape[0]
     inv_exp = 1.0 / (m - 1.0)
-    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
-
-    pad = (-n) % chunk_size
-    xp = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)]) if pad else x
-    wp = jnp.concatenate([w, jnp.zeros((pad,), f32)]) if pad else w
-    xs = xp.reshape(-1, chunk_size, d)
-    ws = wp.reshape(-1, chunk_size)
-    x_sq = sq_norms(xp).reshape(-1, chunk_size)
+    xs, ws, _ = chunk_tiles(x, weights, chunk_size)
+    x_sq = sq_norms(xs)
 
     def pass_once(c, with_labels):
         c_t = c.astype(cd).T
@@ -177,11 +171,9 @@ def fuzzy_memberships(
     """(n, k) membership matrix for given centroids (rows sum to 1)."""
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
-    n, d = x.shape
+    n = x.shape[0]
     inv_exp = 1.0 / (float(m) - 1.0)
-    pad = (-n) % chunk_size
-    xp = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)]) if pad else x
-    xs = xp.reshape(-1, chunk_size, d)
+    xs, _, _ = chunk_tiles(x, None, chunk_size)
     c_t = centroids.astype(cd).T
     c_sq = sq_norms(centroids)
 

@@ -24,7 +24,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sq_norms", "pairwise_sq_dists", "assign"]
+__all__ = ["chunk_tiles", "sq_norms", "pairwise_sq_dists", "assign"]
 
 
 def _as_dtype(compute_dtype, fallback):
@@ -42,6 +42,25 @@ def matmul_precision(cd):
         jax.lax.Precision.HIGHEST
         if jnp.dtype(cd) == jnp.float32 else None
     )
+
+
+def chunk_tiles(x, w, chunk_size):
+    """Pad rows to a chunk multiple and reshape into scan tiles.
+
+    Returns ``(xs (n_chunks, chunk, d), ws (n_chunks, chunk), n)`` with
+    padding rows carrying weight 0.  ``w`` may be None (all-ones weights).
+    The one shared copy of the pad/reshape idiom used by the scan-tiled
+    passes (engine shard bodies, fuzzy c-means).
+    """
+    f32 = jnp.float32
+    n, d = x.shape
+    w = jnp.ones((n,), f32) if w is None else w.astype(f32)
+    pad = (-n) % chunk_size
+    xp = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)]) if pad else x
+    wp = jnp.concatenate([w, jnp.zeros((pad,), f32)]) if pad else w
+    n_chunks = xp.shape[0] // chunk_size
+    return (xp.reshape(n_chunks, chunk_size, d),
+            wp.reshape(n_chunks, chunk_size), n)
 
 
 def sq_norms(x: jax.Array) -> jax.Array:

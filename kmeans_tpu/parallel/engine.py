@@ -36,7 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids, resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
-from kmeans_tpu.ops.distance import matmul_precision, sq_norms
+from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 from kmeans_tpu.ops.update import apply_update
 
@@ -86,22 +86,6 @@ def _reseed_empty_farthest_dp(new_c, counts, x_loc, min_d2, data_axis):
     empty = counts <= 0
     rank = jnp.where(empty, jnp.cumsum(empty.astype(jnp.int32)) - 1, 0)
     return jnp.where(empty[:, None], repl[rank], new_c)
-
-
-def _chunk_tiles(x_loc, w_loc, chunk_size):
-    """Pad local rows to a chunk multiple and reshape into scan tiles.
-
-    Returns ``(xs (n_chunks, chunk, d), ws (n_chunks, chunk), n_loc)`` with
-    padding rows carrying weight 0.
-    """
-    f32 = jnp.float32
-    n_loc, d = x_loc.shape
-    pad = (-n_loc) % chunk_size
-    xp = jnp.concatenate([x_loc, jnp.zeros((pad, d), x_loc.dtype)]) if pad else x_loc
-    wp = jnp.concatenate([w_loc, jnp.zeros((pad,), f32)]) if pad else w_loc
-    n_chunks = xp.shape[0] // chunk_size
-    return (xp.reshape(n_chunks, chunk_size, d),
-            wp.reshape(n_chunks, chunk_size), n_loc)
 
 
 def _accumulate_full_k(sums, counts, lab, xb, xb_c, wb, *, k, update, cd):
@@ -166,7 +150,7 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
     c_t = c_loc.astype(cd).T
     c_sq = sq_norms(c_loc)
 
-    xs, ws, _ = _chunk_tiles(x_loc, w_loc, chunk_size)
+    xs, ws, _ = chunk_tiles(x_loc, w_loc, chunk_size)
 
     def body(carry, tile):
         sums, counts, inertia = carry
@@ -244,7 +228,7 @@ def _fp_local_pass(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
     c_t = c_loc.astype(cd).T                                 # (d_loc, k)
     c_sq = lax.psum(sq_norms(c_loc), feature_axis)           # (k,) full norms
 
-    xs, ws, n_loc = _chunk_tiles(x_loc, w_loc, chunk_size)
+    xs, ws, n_loc = chunk_tiles(x_loc, w_loc, chunk_size)
     # Full row norms once per pass (x is loop-invariant): one psum here
     # instead of one per chunk inside the scan.
     xs_sq = lax.psum(sq_norms(xs), feature_axis)             # (n_chunks, chunk)

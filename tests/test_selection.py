@@ -1,0 +1,143 @@
+"""sweep_k / suggest_k and the CLI surfaces that expose them."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import suggest_k, sweep_k
+
+
+def test_sweep_k_finds_true_k_on_blobs():
+    x, _, _ = make_blobs(jax.random.key(0), 1500, 6, 4, cluster_std=0.25)
+    rows = sweep_k(np.asarray(x), [2, 3, 4, 5, 6], seed=0,
+                   silhouette_sample=1000)
+    assert [r["k"] for r in rows] == [2, 3, 4, 5, 6]
+    # inertia decreases in k; every row converged and carries the metrics
+    inertias = [r["inertia"] for r in rows]
+    assert all(a >= b - 1e-3 for a, b in zip(inertias, inertias[1:]))
+    for r in rows:
+        assert {"silhouette", "davies_bouldin", "calinski_harabasz"} <= set(r)
+    assert suggest_k(rows) == 4
+
+
+def test_sweep_k_k1_row_has_no_silhouette():
+    x, _, _ = make_blobs(jax.random.key(1), 200, 3, 2)
+    rows = sweep_k(np.asarray(x), [1, 2], silhouette_sample=200)
+    assert "silhouette" not in rows[0]
+    assert "silhouette" in rows[1]
+    assert suggest_k(rows) == 2
+    with pytest.raises(ValueError, match="no rows"):
+        suggest_k([rows[0]])
+
+
+def test_sweep_k_validates_model_and_k():
+    x, _, _ = make_blobs(jax.random.key(2), 50, 2, 2)
+    with pytest.raises(ValueError, match="unknown model"):
+        sweep_k(np.asarray(x), [2], model="dbscan")
+    with pytest.raises(ValueError, match="out of range"):
+        sweep_k(np.asarray(x), [0])
+
+
+def test_sweep_k_other_models_run():
+    x, _, _ = make_blobs(jax.random.key(3), 400, 4, 3, cluster_std=0.3)
+    for model in ("bisecting", "spherical"):
+        rows = sweep_k(np.asarray(x), [2, 3], model=model, max_iter=20,
+                       silhouette_sample=200)
+        assert len(rows) == 2
+
+
+def test_cli_sweep_prints_rows_and_suggestion(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main([
+        "sweep", "--n", "600", "--d", "4", "--true-k", "3",
+        "--k-min", "2", "--k-max", "4", "--silhouette-sample", "300",
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert [l["k"] for l in lines[:-1]] == [2, 3, 4]
+    assert lines[-1] == {"suggested_k": 3}
+
+
+@pytest.mark.parametrize("model", ["bisecting", "fuzzy", "spherical"])
+def test_cli_train_model_flag(model, capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main([
+        "train", "--n", "300", "--d", "3", "--k", "3", "--model", model,
+        "--max-iter", "20",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["mode"] == model
+    assert out["converged"] in (True, False)
+
+
+def test_cli_train_rejects_runner_flags_for_non_lloyd(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main([
+        "train", "--n", "100", "--d", "2", "--k", "2", "--model", "fuzzy",
+        "--progress",
+    ])
+    assert rc == 2
+
+
+def test_cli_train_kmeans_parallel_init(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main([
+        "train", "--n", "3000", "--d", "4", "--k", "4",
+        "--init", "k-means||", "--max-iter", "20",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["mode"] == "lloyd" and out["converged"]
+
+
+def test_cli_contradictory_model_and_minibatch_flags_error(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main([
+        "train", "--n", "200", "--d", "2", "--k", "2", "--model", "lloyd",
+        "--minibatch",
+    ])
+    assert rc == 2  # contradictory explicit flags error out
+    err = capsys.readouterr().err
+    assert "contradicts" in err
+
+
+def test_cli_explicit_model_beats_config_minibatch_default(capsys):
+    # A tiny --input overrides the cifar10 shapes, so the named config only
+    # contributes its minibatch default — which an explicit --model lloyd
+    # must win over (previously it was silently overridden).
+    import numpy as np
+
+    from kmeans_tpu.cli import main
+
+    path = "/tmp/_model_precedence.npy"
+    np.save(path, np.random.default_rng(0).normal(size=(300, 4)).astype("f4"))
+    rc = main([
+        "train", "--config", "cifar10", "--model", "lloyd", "--input", path,
+        "--max-iter", "10",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["mode"] == "lloyd"
+
+
+def test_cli_sweep_out_of_range_k_is_clean_error(capsys):
+    import numpy as np
+
+    from kmeans_tpu.cli import main
+
+    path = "/tmp/_sweep_small.npy"
+    np.save(path, np.random.default_rng(0).normal(size=(5, 3)).astype("f4"))
+    rc = main(["sweep", "--input", path, "--k-min", "2", "--k-max", "8"])
+    assert rc == 2
+    captured = capsys.readouterr()
+    assert "out of range" in captured.err
+    assert captured.out == ""  # nothing half-printed

@@ -42,15 +42,38 @@ def _cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.stream and args.minibatch is False:
+        print("error: --stream is the out-of-core minibatch path; it "
+              "contradicts --no-minibatch", file=sys.stderr)
+        return 2
     if args.model is not None:
         model = args.model
+    elif args.stream:
+        model = "minibatch"  # --stream IS the out-of-core minibatch path
     else:
         use_mb = args.minibatch if args.minibatch is not None else cfg_minibatch
         model = "minibatch" if use_mb else "lloyd"
     minibatch = model == "minibatch"
+    if args.stream and not minibatch:
+        print("error: --stream is the out-of-core minibatch path; it "
+              f"supports --model minibatch, not {model}", file=sys.stderr)
+        return 2
 
+    if args.stream and not args.input:
+        print("error: --stream requires --input (a .npy to memory-map)",
+              file=sys.stderr)
+        return 2
     if args.input:
-        x = np.load(args.input)
+        if args.stream:
+            from kmeans_tpu.data.stream import load_mmap
+
+            try:
+                x = load_mmap(args.input)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        else:
+            x = np.load(args.input)
         if x.ndim != 2:
             print(f"error: {args.input} must be a 2-D array", file=sys.stderr)
             return 2
@@ -88,6 +111,10 @@ def _cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.stream and mesh is not None:
+        print("error: --stream and --mesh are mutually exclusive "
+              "(streaming feeds one chip)", file=sys.stderr)
+        return 2
 
     t0 = time.perf_counter()
     if want_runner and not minibatch:
@@ -121,6 +148,8 @@ def _cmd_train(args) -> int:
 
         fit = fit_minibatch_sharded if minibatch else fit_lloyd_sharded
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
+    elif args.stream:
+        state = models.fit_minibatch_stream(x, k, config=kcfg)
     else:
         fit = {
             "lloyd": models.fit_lloyd,
@@ -141,11 +170,16 @@ def _cmd_train(args) -> int:
         "wall_s": round(jax_done, 4),
         "mode": model,
     }
+    if args.stream:
+        result["stream"] = True
     print(json.dumps(result))
 
     if args.out:
+        # Only the first max_cards rows are exported — slice before
+        # np.asarray so a --stream memmap never fully materializes.
         doc = dataset_to_document(
-            np.asarray(x), np.asarray(state.labels),
+            np.asarray(x[:args.max_cards]),
+            np.asarray(state.labels[:args.max_cards]),
             max_cards=args.max_cards,
             enforce_limit=k <= 3,
         )
@@ -181,12 +215,13 @@ def _cmd_sweep(args) -> int:
             compute_dtype=args.dtype, init=args.init, seed=args.seed,
             silhouette_sample=args.silhouette_sample,
         )
-        for row in rows:
-            print(json.dumps(row))
-        print(json.dumps({"suggested_k": suggest_k(rows)}))
+        suggestion = suggest_k(rows)  # may raise — before any output
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    for row in rows:
+        print(json.dumps(row))
+    print(json.dumps({"suggested_k": suggestion}))
     return 0
 
 
@@ -219,6 +254,9 @@ def main(argv=None) -> int:
         "blobs2d", "mnist", "glove", "cifar10", "imagenet"
     ], help="named BASELINE config (synthetic data at its shape)")
     t.add_argument("--input", help="path to a .npy (n, d) feature matrix")
+    t.add_argument("--stream", action="store_true",
+                   help="memory-map --input and stream batches to the chip "
+                        "(out-of-core minibatch; data never fully loads)")
     t.add_argument("--n", type=int, default=500)
     t.add_argument("--d", type=int, default=2)
     t.add_argument("--k", type=int, default=3)

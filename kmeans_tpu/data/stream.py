@@ -1,0 +1,81 @@
+"""Host→device streaming for datasets larger than HBM.
+
+The feature-matrix configs top out at ~10 GB (BASELINE.md config 5 in f32) —
+near the HBM of one chip.  Anything bigger must stay on host (or disk, via
+``np.memmap``) and stream: this module samples batches on the host and keeps
+a small number of them in flight with ``jax.device_put``, relying on JAX's
+async dispatch so host indexing, PCIe transfer, and TPU compute overlap.
+
+The reference has no loader at all (its "dataset" is ≤ a dozen cards typed
+into a browser, /root/reference/app.mjs:202-224); this subsystem exists for
+the north-star scale.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["load_mmap", "sample_batches", "prefetch_to_device"]
+
+
+def load_mmap(path: str) -> np.ndarray:
+    """Memory-map an ``.npy`` feature matrix (rows never fully materialize)."""
+    x = np.load(path, mmap_mode="r")
+    if x.ndim != 2:
+        raise ValueError(f"{path}: expected a 2-D array, got shape {x.shape}")
+    return x
+
+
+def sample_batches(
+    data,
+    batch_size: int,
+    steps: int,
+    *,
+    seed: int = 0,
+) -> Iterator[np.ndarray]:
+    """Yield ``steps`` with-replacement sampled batches from host ``data``.
+
+    Indices are sorted within each batch: on a memmap this turns the gather
+    into a forward disk scan (page-cache friendly) and is distribution-free
+    for the minibatch update, which never looks at intra-batch order.
+    """
+    n = data.shape[0]
+    if batch_size < 1 or steps < 0:
+        raise ValueError(f"bad batch_size={batch_size} / steps={steps}")
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        idx = np.sort(rng.integers(0, n, size=batch_size))
+        yield np.ascontiguousarray(data[idx])
+
+
+def prefetch_to_device(
+    batches: Iterable[np.ndarray],
+    *,
+    depth: int = 2,
+    device: Optional[jax.Device] = None,
+) -> Iterator[jax.Array]:
+    """Keep ``depth`` batches in flight on the device ahead of the consumer.
+
+    ``jax.device_put`` returns immediately (async dispatch), so while the
+    consumer computes on batch t, batches t+1..t+depth are already crossing
+    PCIe — the standard double-buffering recipe.
+    """
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    it = iter(batches)
+    queue = []
+    try:
+        for _ in range(depth):
+            queue.append(jax.device_put(next(it), device))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.pop(0)
+        try:
+            queue.append(jax.device_put(next(it), device))
+        except StopIteration:
+            pass
+        yield out

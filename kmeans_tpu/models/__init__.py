@@ -19,6 +19,7 @@ from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.runner import IterInfo, LloydRunner
 from kmeans_tpu.models.selection import suggest_k, sweep_k
+from kmeans_tpu.models.streaming import assign_stream, fit_minibatch_stream
 from kmeans_tpu.models.spherical import (
     SphericalKMeans,
     fit_spherical,
@@ -49,4 +50,6 @@ __all__ = [
     "normalize_rows",
     "suggest_k",
     "sweep_k",
+    "assign_stream",
+    "fit_minibatch_stream",
 ]

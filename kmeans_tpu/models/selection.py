@@ -6,9 +6,9 @@ machinery instead: fit a range of k, report inertia (elbow curve) plus the
 internal quality metrics from :mod:`kmeans_tpu.metrics`, and suggest the k
 with the best silhouette.
 
-All fits in a sweep reuse the same compiled executables whenever shapes and
-static config agree (jit caching), so a sweep costs the sum of the fits and
-nothing more.
+Each k compiles its own executables (centroid shapes differ), so a sweep
+costs the sum of the fits plus one compile per distinct k — subsequent
+sweeps over the same shapes hit the jit cache.
 """
 
 from __future__ import annotations

@@ -1,0 +1,174 @@
+"""Out-of-core minibatch k-means: fit data that never fits in HBM.
+
+Same streaming-average update as :mod:`kmeans_tpu.models.minibatch`
+(Sculley-style, per-center learning rate 1/n_seen), but the batch source is
+the host (numpy array or ``np.memmap``): batches are sampled on host,
+double-buffered onto the device (:mod:`kmeans_tpu.data.stream`), and only
+the (batch, d) tile plus the (k, d) centroids ever occupy HBM.
+
+The in-memory ``fit_minibatch`` runs its whole scan as one XLA program and
+should be preferred whenever x fits on-chip; this path trades that for
+unbounded n.  Sampling uses a host RNG (the data is host-resident anyway),
+so draws differ from ``fit_minibatch``'s folded jax keys — both are
+with-replacement uniform, and neither is deterministic w.r.t. the other.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.data.stream import prefetch_to_device, sample_batches
+from kmeans_tpu.models.init import init_centroids, resolve_fit_config
+from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.ops.distance import matmul_precision, sq_norms
+
+__all__ = ["fit_minibatch_stream", "assign_stream"]
+
+
+@functools.partial(jax.jit, static_argnames=("compute_dtype",))
+def _stream_step(centroids, n_seen, xb, *, compute_dtype):
+    """One streamed minibatch update — the update rule of
+    kmeans_tpu.models.minibatch._minibatch_loop's step, with the batch as an
+    argument instead of an on-device gather."""
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
+    k = centroids.shape[0]
+    prod = jnp.matmul(
+        xb.astype(cd), centroids.astype(cd).T,
+        preferred_element_type=f32, precision=matmul_precision(cd),
+    )
+    part = sq_norms(centroids)[None, :] - 2.0 * prod
+    labels = jnp.argmin(part, axis=1).astype(jnp.int32)
+    bc = jax.ops.segment_sum(jnp.ones((xb.shape[0],), f32), labels, k)
+    bs = jax.ops.segment_sum(xb.astype(f32), labels, k)
+    n_after = n_seen + bc
+    delta = (bs - bc[:, None] * centroids) / jnp.maximum(n_after, 1.0)[:, None]
+    centroids = centroids + jnp.where((bc > 0)[:, None], delta, 0.0)
+    return centroids, n_after
+
+
+@functools.partial(jax.jit, static_argnames=("compute_dtype",))
+def _assign_tile(xb, centroids, *, compute_dtype):
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
+    prod = jnp.matmul(
+        xb.astype(cd), centroids.astype(cd).T,
+        preferred_element_type=f32, precision=matmul_precision(cd),
+    )
+    part = sq_norms(centroids)[None, :] - 2.0 * prod
+    labels = jnp.argmin(part, axis=1).astype(jnp.int32)
+    mind = jnp.maximum(jnp.min(part, axis=1) + sq_norms(xb), 0.0)
+    return labels, mind
+
+
+def assign_stream(
+    data,
+    centroids,
+    *,
+    chunk_size: int = 65536,
+    compute_dtype=None,
+) -> Tuple[np.ndarray, float]:
+    """Labels + inertia for host-resident ``data`` in one streamed pass.
+
+    Chunks stream through the device with the same double-buffering as the
+    fit; labels come back to host per chunk.  Returns
+    ``(labels (n,) int32 np.ndarray, inertia float)``.
+    """
+    n = data.shape[0]
+    c = jnp.asarray(centroids, jnp.float32)
+
+    def chunks():
+        for lo in range(0, n, chunk_size):
+            yield np.ascontiguousarray(data[lo:lo + chunk_size])
+
+    labels = np.empty((n,), np.int32)
+    inertia = 0.0
+    lo = 0
+    for xb in prefetch_to_device(chunks()):
+        lab, mind = _assign_tile(xb, c, compute_dtype=compute_dtype)
+        m = int(lab.shape[0])
+        labels[lo:lo + m] = np.asarray(lab)
+        inertia += float(jnp.sum(mind))
+        lo += m
+    return labels, inertia
+
+
+def fit_minibatch_stream(
+    data,
+    k: int,
+    *,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init: Union[str, jax.Array, None] = None,
+    batch_size: Optional[int] = None,
+    steps: Optional[int] = None,
+    seed: Optional[int] = None,
+    prefetch_depth: int = 2,
+    final_pass: bool = True,
+) -> KMeansState:
+    """Minibatch k-means over host/disk data of unbounded size.
+
+    ``data`` is any 2-D array-like with numpy fancy indexing (``np.ndarray``,
+    ``np.memmap`` from :func:`kmeans_tpu.data.stream.load_mmap`, h5py-style
+    datasets).  With ``final_pass`` a streamed labeling sweep fills
+    labels/inertia/counts; otherwise those fields are empty (cheaper when
+    only centroids matter).
+    """
+    cfg, key = resolve_fit_config(k, key, config)
+    n, d = data.shape
+    bs = batch_size if batch_size is not None else cfg.batch_size
+    n_steps = steps if steps is not None else cfg.steps
+    host_seed = seed if seed is not None else cfg.seed
+
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, d):
+            raise ValueError(f"init centroids shape {c0.shape} != {(k, d)}")
+    else:
+        # Seed on a host subsample (mirrors fit_minibatch's recipe).
+        method = init if isinstance(init, str) else cfg.init
+        sub = min(n, max(4 * k * 16, 65536))
+        rng = np.random.default_rng(host_seed)
+        sidx = np.sort(rng.choice(n, size=sub, replace=False))
+        xs = jnp.asarray(np.ascontiguousarray(data[sidx]))
+        c0 = init_centroids(
+            key, xs, k, method=method, compute_dtype=cfg.compute_dtype,
+            chunk_size=cfg.chunk_size,
+        )
+
+    c = c0.astype(jnp.float32)
+    n_seen = jnp.zeros((k,), jnp.float32)
+    batches = sample_batches(data, bs, n_steps, seed=host_seed)
+    for xb in prefetch_to_device(batches, depth=prefetch_depth):
+        c, n_seen = _stream_step(c, n_seen, xb,
+                                 compute_dtype=cfg.compute_dtype)
+
+    if final_pass:
+        labels_np, inertia = assign_stream(
+            data, c, chunk_size=max(cfg.chunk_size, 8192),
+            compute_dtype=cfg.compute_dtype,
+        )
+        labels = jnp.asarray(labels_np)
+        counts = jnp.asarray(
+            np.bincount(labels_np, minlength=k).astype(np.float32)
+        )
+        inertia_v = jnp.asarray(inertia, jnp.float32)
+    else:
+        labels = jnp.zeros((0,), jnp.int32)
+        counts = jnp.zeros((k,), jnp.float32)
+        inertia_v = jnp.zeros((), jnp.float32)
+
+    return KMeansState(
+        centroids=c,
+        labels=labels,
+        inertia=inertia_v,
+        n_iter=jnp.asarray(n_steps, jnp.int32),
+        converged=jnp.asarray(False),
+        counts=counts,
+    )

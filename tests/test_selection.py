@@ -141,3 +141,14 @@ def test_cli_sweep_out_of_range_k_is_clean_error(capsys):
     captured = capsys.readouterr()
     assert "out of range" in captured.err
     assert captured.out == ""  # nothing half-printed
+
+
+def test_cli_sweep_k1_only_prints_nothing_on_error(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main(["sweep", "--n", "50", "--d", "2", "--k-min", "1",
+               "--k-max", "1"])
+    assert rc == 2
+    captured = capsys.readouterr()
+    assert captured.out == ""
+    assert "no rows" in captured.err

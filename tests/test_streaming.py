@@ -1,0 +1,154 @@
+"""Out-of-core streaming: memmap fit, streamed assign, prefetch."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracles
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.data.stream import load_mmap, prefetch_to_device, sample_batches
+from kmeans_tpu.models import assign_stream, fit_minibatch, fit_minibatch_stream
+
+
+@pytest.fixture(scope="module")
+def mmap_blobs(tmp_path_factory):
+    x, labels, _ = make_blobs(jax.random.key(0), 6000, 16, 8, cluster_std=0.4)
+    path = str(tmp_path_factory.mktemp("stream") / "x.npy")
+    np.save(path, np.asarray(x))
+    return path, np.asarray(x)
+
+
+def test_load_mmap_rejects_non_2d(tmp_path):
+    path = str(tmp_path / "bad.npy")
+    np.save(path, np.zeros((3, 2, 2), np.float32))
+    with pytest.raises(ValueError, match="2-D"):
+        load_mmap(path)
+
+
+def test_sample_batches_shapes_and_determinism(mmap_blobs):
+    path, _ = mmap_blobs
+    data = load_mmap(path)
+    b1 = list(sample_batches(data, 128, 5, seed=7))
+    b2 = list(sample_batches(data, 128, 5, seed=7))
+    assert len(b1) == 5
+    for a, b in zip(b1, b2):
+        assert a.shape == (128, 16)
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="batch_size"):
+        list(sample_batches(data, 0, 1))
+
+
+def test_prefetch_preserves_order_and_count():
+    batches = [np.full((4, 2), i, np.float32) for i in range(7)]
+    out = list(prefetch_to_device(batches, depth=3))
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        assert float(b[0, 0]) == i
+    assert list(prefetch_to_device([], depth=2)) == []
+    with pytest.raises(ValueError, match="depth"):
+        list(prefetch_to_device(batches, depth=0))
+
+
+def test_fit_minibatch_stream_clusters_memmap(mmap_blobs):
+    path, x = mmap_blobs
+    data = load_mmap(path)
+    state = fit_minibatch_stream(data, 8, batch_size=512, steps=100, seed=0)
+    assert state.centroids.shape == (8, 16)
+    assert state.labels.shape == (6000,)
+    # Quality: on easy blobs the streamed fit must land within 2x of the
+    # in-memory minibatch fit's inertia (both are stochastic).
+    ref = fit_minibatch(jnp.asarray(x), 8, key=jax.random.key(1),
+                        batch_size=512, steps=100)
+    assert float(state.inertia) < max(2.0 * float(ref.inertia), 1e3)
+    # labels/inertia/counts consistent with the returned centroids
+    want_lab, want_mind = oracles.assign(x, np.asarray(state.centroids))
+    np.testing.assert_array_equal(np.asarray(state.labels), want_lab)
+    np.testing.assert_allclose(float(state.inertia), want_mind.sum(),
+                               rtol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(state.counts), np.bincount(want_lab, minlength=8)
+    )
+
+
+def test_fit_minibatch_stream_final_pass_false_skips_labels(mmap_blobs):
+    path, _ = mmap_blobs
+    state = fit_minibatch_stream(load_mmap(path), 4, batch_size=256, steps=10,
+                                 final_pass=False)
+    assert state.labels.shape == (0,)
+    assert float(state.inertia) == 0.0
+
+
+def test_fit_minibatch_stream_explicit_init_and_bad_shape(mmap_blobs):
+    path, x = mmap_blobs
+    data = load_mmap(path)
+    c0 = x[:4]
+    state = fit_minibatch_stream(data, 4, init=c0, batch_size=256, steps=20)
+    assert state.centroids.shape == (4, 16)
+    with pytest.raises(ValueError, match="init centroids shape"):
+        fit_minibatch_stream(data, 4, init=x[:3], steps=5)
+
+
+def test_assign_stream_matches_oracle(mmap_blobs):
+    path, x = mmap_blobs
+    data = load_mmap(path)
+    rng = np.random.default_rng(0)
+    c = rng.normal(size=(5, 16)).astype(np.float32)
+    labels, inertia = assign_stream(data, c, chunk_size=1000)
+    want_lab, want_mind = oracles.assign(x, c)
+    np.testing.assert_array_equal(labels, want_lab)
+    np.testing.assert_allclose(inertia, want_mind.sum(), rtol=1e-4)
+
+
+def test_cli_train_stream(tmp_path, capsys):
+    import json
+
+    from kmeans_tpu.cli import main
+
+    x, _, _ = make_blobs(jax.random.key(5), 2000, 8, 4, cluster_std=0.4)
+    path = str(tmp_path / "x.npy")
+    np.save(path, np.asarray(x))
+
+    rc = main(["train", "--input", path, "--stream", "--k", "4"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["mode"] == "minibatch" and out["stream"] is True
+    assert out["n"] == 2000
+
+    # --stream without --input, or with a non-minibatch model, errors
+    assert main(["train", "--stream", "--k", "4"]) == 2
+    assert main(["train", "--input", path, "--stream", "--model",
+                 "lloyd"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_stream_error_paths_are_clean(tmp_path, capsys):
+    import json
+
+    from kmeans_tpu.cli import main
+
+    bad = str(tmp_path / "bad.npy")
+    np.save(bad, np.zeros((3, 2, 2), np.float32))
+    assert main(["train", "--input", bad, "--stream", "--k", "2"]) == 2
+    assert "2-D" in capsys.readouterr().err
+
+    good = str(tmp_path / "good.npy")
+    np.save(good, np.zeros((50, 2), np.float32))
+    assert main(["train", "--input", good, "--stream",
+                 "--no-minibatch"]) == 2
+    assert "no-minibatch" in capsys.readouterr().err
+
+    # --stream --out must slice, not materialize: a fit with export works
+    # and the document holds at most max-cards cards.
+    x, _, _ = make_blobs(jax.random.key(6), 1000, 2, 3, cluster_std=0.3)
+    path = str(tmp_path / "x2.npy")
+    np.save(path, np.asarray(x))
+    out_json = str(tmp_path / "doc.json")
+    rc = main(["train", "--input", path, "--stream", "--k", "3",
+               "--out", out_json, "--max-cards", "40"])
+    assert rc == 0
+    capsys.readouterr()
+    doc = json.loads(open(out_json).read())
+    assert len(doc["cards"]) <= 40

@@ -136,6 +136,8 @@ class BisectingKMeans(KMeans):
     strategy: str = "biggest_inertia"
 
     def fit(self, x, weights=None) -> "BisectingKMeans":
+        from kmeans_tpu.models.lloyd import best_of_n_init
+
         x = jnp.asarray(x)
         init = None if isinstance(self.init, str) else self.init
         if init is not None:
@@ -143,11 +145,16 @@ class BisectingKMeans(KMeans):
                 "BisectingKMeans derives every centroid from splits; "
                 "an init array is not accepted"
             )
-        self.state = fit_bisecting(
-            x,
-            self.n_clusters,
-            config=self._config(),
-            strategy=self.strategy,
-            weights=weights,
+        self.state = best_of_n_init(
+            lambda key: fit_bisecting(
+                x,
+                self.n_clusters,
+                key=key,
+                config=self._config(),
+                strategy=self.strategy,
+                weights=weights,
+            ),
+            jax.random.key(self.seed),
+            self.n_init,
         )
         return self

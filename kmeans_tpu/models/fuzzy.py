@@ -200,6 +200,7 @@ class FuzzyCMeans:
     max_iter: int = 100
     tol: float = 1e-4
     seed: int = 0
+    n_init: int = 1
     chunk_size: int = 4096
     compute_dtype: Optional[str] = None
 
@@ -208,6 +209,8 @@ class FuzzyCMeans:
     )
 
     def fit(self, x, weights=None) -> "FuzzyCMeans":
+        from kmeans_tpu.models.lloyd import best_of_n_init
+
         x = jnp.asarray(x)
         init = None if isinstance(self.init, str) else self.init
         cfg = KMeansConfig(
@@ -216,9 +219,14 @@ class FuzzyCMeans:
             max_iter=self.max_iter, tol=self.tol, seed=self.seed,
             chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
         )
-        self.state = fit_fuzzy(
-            x, self.n_clusters, m=self.m, config=cfg, init=init,
-            weights=weights,
+        self.state = best_of_n_init(
+            lambda key: fit_fuzzy(
+                x, self.n_clusters, m=self.m, key=key, config=cfg, init=init,
+                weights=weights,
+            ),
+            jax.random.key(self.seed),
+            1 if init is not None else self.n_init,
+            score=lambda s: float(s.objective),
         )
         return self
 

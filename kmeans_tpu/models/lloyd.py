@@ -26,7 +26,7 @@ from kmeans_tpu.models.init import resolve_fit_inputs
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
-__all__ = ["KMeansState", "fit_lloyd", "KMeans"]
+__all__ = ["KMeansState", "fit_lloyd", "KMeans", "best_of_n_init"]
 
 
 class KMeansState(NamedTuple):
@@ -130,9 +130,30 @@ def fit_lloyd(
     )
 
 
+def best_of_n_init(fit_one, key, n_init, *, score=lambda s: float(s.inertia)):
+    """Run ``fit_one(key_i)`` for ``n_init`` independent keys, keep the
+    lowest-``score`` state (sklearn's n_init restarts).  Every restart hits
+    the same compiled executable — shapes and static config are identical —
+    so restarts cost pure runtime, no recompiles."""
+    if n_init < 1:
+        raise ValueError(f"n_init must be >= 1, got {n_init}")
+    best = None
+    best_score = None
+    for i in range(n_init):
+        state = fit_one(jax.random.fold_in(key, i))
+        s = score(state)
+        if best is None or s < best_score:
+            best, best_score = state, s
+    return best
+
+
 @dataclasses.dataclass
 class KMeans:
     """Estimator-style wrapper (sklearn-like surface) over :func:`fit_lloyd`.
+
+    ``n_init`` > 1 runs that many independently-seeded fits and keeps the
+    lowest-inertia one (default 1: a single fit at TPU scale is usually
+    deliberate).
 
     >>> km = KMeans(n_clusters=3, seed=0).fit(x)
     >>> km.labels_, km.cluster_centers_, km.inertia_
@@ -143,6 +164,7 @@ class KMeans:
     max_iter: int = 100
     tol: float = 1e-4
     seed: int = 0
+    n_init: int = 1
     chunk_size: int = 4096
     compute_dtype: Optional[str] = None
     update: str = "matmul"
@@ -170,14 +192,27 @@ class KMeans:
     def fit(self, x, weights=None) -> "KMeans":
         x = jnp.asarray(x)
         init = None if isinstance(self.init, str) else self.init
-        self.state = fit_lloyd(
-            x,
-            self.n_clusters,
-            config=self._config(),
-            init=init,
-            weights=weights,
+        # An explicit centroid array makes restarts identical — run once.
+        n_init = 1 if init is not None else self.n_init
+        self.state = best_of_n_init(
+            lambda key: fit_lloyd(
+                x,
+                self.n_clusters,
+                key=key,
+                config=self._config(),
+                init=init,
+                weights=weights,
+            ),
+            jax.random.key(self.seed),
+            n_init,
         )
         return self
+
+    def fit_predict(self, x, weights=None):
+        return self.fit(x, weights=weights).labels_
+
+    def fit_transform(self, x, weights=None):
+        return self.fit(x, weights=weights).transform(x)
 
     # sklearn-flavored accessors -------------------------------------------
     @property

@@ -27,7 +27,35 @@ from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 
-__all__ = ["fit_minibatch", "MiniBatchKMeans"]
+__all__ = ["fit_minibatch", "MiniBatchKMeans", "batch_update"]
+
+
+def batch_update(centroids, n_seen, xb, *, compute_dtype):
+    """One Sculley streaming-average minibatch update.
+
+    Assigns the batch, then moves each touched centroid toward the batch
+    mean with per-center rate 1/n_seen_total.  THE one copy of the update
+    rule — traced both inside ``_minibatch_loop``'s scan and as the jitted
+    streamed step in :mod:`kmeans_tpu.models.streaming`.
+
+    Returns ``(new_centroids, n_seen_after, shift_sq)``.
+    """
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
+    k = centroids.shape[0]
+    prod = jnp.matmul(
+        xb.astype(cd), centroids.astype(cd).T,
+        preferred_element_type=f32, precision=matmul_precision(cd),
+    )
+    part = sq_norms(centroids)[None, :] - 2.0 * prod
+    labels = jnp.argmin(part, axis=1).astype(jnp.int32)
+    bc = jax.ops.segment_sum(jnp.ones((xb.shape[0],), f32), labels, k)
+    bs = jax.ops.segment_sum(xb.astype(f32), labels, k)
+    n_after = n_seen + bc
+    # Streaming mean: c += (batch_sum - batch_count·c) / n_seen_total.
+    delta = (bs - bc[:, None] * centroids) / jnp.maximum(n_after, 1.0)[:, None]
+    step = jnp.where((bc > 0)[:, None], delta, 0.0)
+    return centroids + step, n_after, jnp.sum(step ** 2)
 
 
 @functools.partial(
@@ -52,30 +80,16 @@ def _minibatch_loop(
 ):
     # n_valid < n means trailing rows are shard padding: never sample them.
     n = n_valid if n_valid is not None else x.shape[0]
-    d = x.shape[1]
     k = centroids0.shape[0]
     f32 = jnp.float32
-    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
 
     def step(carry, i):
         centroids, n_seen = carry
         bkey = jax.random.fold_in(key, i)
         idx = jax.random.randint(bkey, (batch_size,), 0, n)
-        xb = x[idx]
-        # Assign the batch (batch_size × k fits on-chip for our configs).
-        prod = jnp.matmul(
-            xb.astype(cd), centroids.astype(cd).T,
-            preferred_element_type=f32, precision=matmul_precision(cd),
+        centroids, n_after, shift_sq = batch_update(
+            centroids, n_seen, x[idx], compute_dtype=compute_dtype
         )
-        part = sq_norms(centroids)[None, :] - 2.0 * prod
-        labels = jnp.argmin(part, axis=1).astype(jnp.int32)
-        bc = jax.ops.segment_sum(jnp.ones((batch_size,), f32), labels, k)
-        bs = jax.ops.segment_sum(xb.astype(f32), labels, k)
-        n_after = n_seen + bc
-        # Streaming mean: c += (batch_sum - batch_count·c) / n_seen_total.
-        delta = (bs - bc[:, None] * centroids) / jnp.maximum(n_after, 1.0)[:, None]
-        centroids = centroids + jnp.where((bc > 0)[:, None], delta, 0.0)
-        shift_sq = jnp.sum(jnp.where((bc > 0)[:, None], delta, 0.0) ** 2)
         return (centroids, n_after), shift_sq
 
     (centroids, _), shifts = lax.scan(
@@ -176,6 +190,7 @@ class MiniBatchKMeans:
     batch_size: int = 8192
     steps: int = 200
     seed: int = 0
+    n_init: int = 1
     chunk_size: int = 4096
     compute_dtype: Optional[str] = None
 
@@ -184,6 +199,8 @@ class MiniBatchKMeans:
     )
 
     def fit(self, x) -> "MiniBatchKMeans":
+        from kmeans_tpu.models.lloyd import best_of_n_init
+
         x = jnp.asarray(x)
         cfg = KMeansConfig(
             k=self.n_clusters,
@@ -195,7 +212,13 @@ class MiniBatchKMeans:
             steps=self.steps,
         )
         init = None if isinstance(self.init, str) else self.init
-        self.state = fit_minibatch(x, self.n_clusters, config=cfg, init=init)
+        self.state = best_of_n_init(
+            lambda key: fit_minibatch(
+                x, self.n_clusters, key=key, config=cfg, init=init
+            ),
+            jax.random.key(self.seed),
+            1 if init is not None else self.n_init,
+        )
         return self
 
     @property

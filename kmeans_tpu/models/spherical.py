@@ -134,6 +134,7 @@ class SphericalKMeans:
     max_iter: int = 100
     tol: float = 1e-6
     seed: int = 0
+    n_init: int = 1
     chunk_size: int = 4096
     compute_dtype: Optional[str] = None
     backend: str = "auto"
@@ -143,17 +144,23 @@ class SphericalKMeans:
     )
 
     def fit(self, x, weights=None) -> "SphericalKMeans":
+        from kmeans_tpu.models.lloyd import best_of_n_init
+
         init = None if isinstance(self.init, str) else self.init
-        self.state = fit_spherical(
-            x, self.n_clusters,
-            config=KMeansConfig(
-                k=self.n_clusters,
-                init=self.init if isinstance(self.init, str) else "given",
-                max_iter=self.max_iter, tol=self.tol, seed=self.seed,
-                chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
-                backend=self.backend,
+        cfg = KMeansConfig(
+            k=self.n_clusters,
+            init=self.init if isinstance(self.init, str) else "given",
+            max_iter=self.max_iter, tol=self.tol, seed=self.seed,
+            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
+            backend=self.backend,
+        )
+        self.state = best_of_n_init(
+            lambda key: fit_spherical(
+                x, self.n_clusters, key=key, config=cfg,
+                init=init, weights=weights,
             ),
-            init=init, weights=weights,
+            jax.random.key(self.seed),
+            1 if init is not None else self.n_init,
         )
         return self
 

@@ -26,45 +26,21 @@ from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.data.stream import prefetch_to_device, sample_batches
 from kmeans_tpu.models.init import init_centroids, resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
-from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
 __all__ = ["fit_minibatch_stream", "assign_stream"]
 
 
 @functools.partial(jax.jit, static_argnames=("compute_dtype",))
 def _stream_step(centroids, n_seen, xb, *, compute_dtype):
-    """One streamed minibatch update — the update rule of
-    kmeans_tpu.models.minibatch._minibatch_loop's step, with the batch as an
-    argument instead of an on-device gather."""
-    f32 = jnp.float32
-    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
-    k = centroids.shape[0]
-    prod = jnp.matmul(
-        xb.astype(cd), centroids.astype(cd).T,
-        preferred_element_type=f32, precision=matmul_precision(cd),
+    """One streamed update: :func:`kmeans_tpu.models.minibatch.batch_update`
+    (the single copy of the rule) with the batch as a fed argument instead
+    of an on-device gather."""
+    from kmeans_tpu.models.minibatch import batch_update
+
+    centroids, n_after, _ = batch_update(
+        centroids, n_seen, xb, compute_dtype=compute_dtype
     )
-    part = sq_norms(centroids)[None, :] - 2.0 * prod
-    labels = jnp.argmin(part, axis=1).astype(jnp.int32)
-    bc = jax.ops.segment_sum(jnp.ones((xb.shape[0],), f32), labels, k)
-    bs = jax.ops.segment_sum(xb.astype(f32), labels, k)
-    n_after = n_seen + bc
-    delta = (bs - bc[:, None] * centroids) / jnp.maximum(n_after, 1.0)[:, None]
-    centroids = centroids + jnp.where((bc > 0)[:, None], delta, 0.0)
     return centroids, n_after
-
-
-@functools.partial(jax.jit, static_argnames=("compute_dtype",))
-def _assign_tile(xb, centroids, *, compute_dtype):
-    f32 = jnp.float32
-    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
-    prod = jnp.matmul(
-        xb.astype(cd), centroids.astype(cd).T,
-        preferred_element_type=f32, precision=matmul_precision(cd),
-    )
-    part = sq_norms(centroids)[None, :] - 2.0 * prod
-    labels = jnp.argmin(part, axis=1).astype(jnp.int32)
-    mind = jnp.maximum(jnp.min(part, axis=1) + sq_norms(xb), 0.0)
-    return labels, mind
 
 
 def assign_stream(
@@ -87,11 +63,14 @@ def assign_stream(
         for lo in range(0, n, chunk_size):
             yield np.ascontiguousarray(data[lo:lo + chunk_size])
 
+    from kmeans_tpu.ops.distance import assign
+
     labels = np.empty((n,), np.int32)
     inertia = 0.0
     lo = 0
     for xb in prefetch_to_device(chunks()):
-        lab, mind = _assign_tile(xb, c, compute_dtype=compute_dtype)
+        lab, mind = assign(xb, c, chunk_size=chunk_size,
+                           compute_dtype=compute_dtype)
         m = int(lab.shape[0])
         labels[lo:lo + m] = np.asarray(lab)
         inertia += float(jnp.sum(mind))

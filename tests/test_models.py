@@ -235,3 +235,73 @@ def test_kmeans_parallel_exhausted_pool_never_seeds_zero_weight_rows():
     c = kmeans_parallel(jax.random.key(2), x, 4, weights=w, rounds=2,
                         oversampling=16, chunk_size=512)
     assert bool(jnp.all(jnp.linalg.norm(c, axis=1) > 50.0))
+
+
+def test_n_init_restarts_pick_the_best():
+    from kmeans_tpu.models.lloyd import best_of_n_init
+
+    # Tight blobs where single seeds sometimes merge two clusters: the
+    # best-of-5 inertia must be <= every single-restart inertia.
+    x, _, _ = make_blobs(jax.random.key(5), 2000, 8, 10, cluster_std=0.4)
+    km = KMeans(n_clusters=10, seed=3, n_init=5).fit(x)
+    singles = [
+        float(fit_lloyd(x, 10, key=jax.random.fold_in(jax.random.key(3), i),
+                        max_iter=100).inertia)
+        for i in range(5)
+    ]
+    assert km.inertia_ == pytest.approx(min(singles), rel=1e-5)
+
+    with pytest.raises(ValueError, match="n_init"):
+        best_of_n_init(lambda key: None, jax.random.key(0), 0)
+
+
+def test_n_init_with_array_init_runs_once():
+    x, _, _ = make_blobs(jax.random.key(6), 300, 4, 3)
+    c0 = np.asarray(x[:3])
+    km1 = KMeans(n_clusters=3, init=c0, n_init=7).fit(x)
+    km2 = KMeans(n_clusters=3, init=c0, n_init=1).fit(x)
+    np.testing.assert_array_equal(np.asarray(km1.cluster_centers_),
+                                  np.asarray(km2.cluster_centers_))
+
+
+def test_fit_predict_and_fit_transform():
+    x, _, _ = make_blobs(jax.random.key(7), 200, 3, 3)
+    km = KMeans(n_clusters=3, seed=0)
+    labels = km.fit_predict(x)
+    np.testing.assert_array_equal(np.asarray(labels), np.asarray(km.labels_))
+    t = KMeans(n_clusters=3, seed=0).fit_transform(x)
+    assert t.shape == (200, 3)
+    assert bool(jnp.all(t >= 0))
+
+
+def test_n_init_wiring_across_families():
+    # Each family's n_init must (a) accept >1 restarts and (b) pick a state
+    # no worse than its own single-restart fit with the same seed.
+    from kmeans_tpu.models import (
+        BisectingKMeans,
+        FuzzyCMeans,
+        SphericalKMeans,
+    )
+
+    x, _, _ = make_blobs(jax.random.key(9), 1200, 6, 6, cluster_std=0.5)
+    xn = np.asarray(x)
+    for cls, score in (
+        (MiniBatchKMeans, lambda e: e.inertia_),
+        (SphericalKMeans, lambda e: e.inertia_),
+        (BisectingKMeans, lambda e: e.inertia_),
+        (FuzzyCMeans, lambda e: e.objective_),
+    ):
+        one = cls(n_clusters=6, seed=2).fit(xn)
+        best = cls(n_clusters=6, seed=2, n_init=3).fit(xn)
+        assert score(best) <= score(one) * 1.0001, cls.__name__
+
+
+def test_n_init_array_init_runs_once_for_fuzzy():
+    from kmeans_tpu.models import FuzzyCMeans
+
+    x, _, _ = make_blobs(jax.random.key(10), 300, 4, 3)
+    c0 = np.asarray(x[:3])
+    f1 = FuzzyCMeans(n_clusters=3, init=c0, n_init=5).fit(np.asarray(x))
+    f2 = FuzzyCMeans(n_clusters=3, init=c0, n_init=1).fit(np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(f1.cluster_centers_),
+                                  np.asarray(f2.cluster_centers_))

@@ -134,15 +134,23 @@ def best_of_n_init(fit_one, key, n_init, *, score=lambda s: float(s.inertia)):
     """Run ``fit_one(key_i)`` for ``n_init`` independent keys, keep the
     lowest-``score`` state (sklearn's n_init restarts).  Every restart hits
     the same compiled executable — shapes and static config are identical —
-    so restarts cost pure runtime, no recompiles."""
+    so restarts cost pure runtime, no recompiles.
+
+    Restart 0 uses ``key`` itself, so ``n_init=1`` reproduces a plain
+    single-keyed fit bit-for-bit (seed parity with the functional front
+    doors and the CLI); restarts i >= 1 use ``fold_in(key, i)``.
+    """
+    import math
+
     if n_init < 1:
         raise ValueError(f"n_init must be >= 1, got {n_init}")
     best = None
     best_score = None
     for i in range(n_init):
-        state = fit_one(jax.random.fold_in(key, i))
+        state = fit_one(key if i == 0 else jax.random.fold_in(key, i))
         s = score(state)
-        if best is None or s < best_score:
+        # A NaN score (e.g. bf16 overflow) must never shadow a finite one.
+        if best is None or math.isnan(best_score) or s < best_score:
             best, best_score = state, s
     return best
 

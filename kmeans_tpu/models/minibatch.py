@@ -38,7 +38,9 @@ def batch_update(centroids, n_seen, xb, *, compute_dtype):
     rule — traced both inside ``_minibatch_loop``'s scan and as the jitted
     streamed step in :mod:`kmeans_tpu.models.streaming`.
 
-    Returns ``(new_centroids, n_seen_after, shift_sq)``.
+    Returns ``(new_centroids, n_seen_after, shift_sq, batch_inertia)``
+    (batch inertia measured at the pre-update centroids — free from the
+    distance tile, and the signal the early-stopping EWA tracks).
     """
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
@@ -49,20 +51,21 @@ def batch_update(centroids, n_seen, xb, *, compute_dtype):
     )
     part = sq_norms(centroids)[None, :] - 2.0 * prod
     labels = jnp.argmin(part, axis=1).astype(jnp.int32)
+    b_inertia = jnp.sum(jnp.maximum(jnp.min(part, axis=1) + sq_norms(xb), 0.0))
     bc = jax.ops.segment_sum(jnp.ones((xb.shape[0],), f32), labels, k)
     bs = jax.ops.segment_sum(xb.astype(f32), labels, k)
     n_after = n_seen + bc
     # Streaming mean: c += (batch_sum - batch_count·c) / n_seen_total.
     delta = (bs - bc[:, None] * centroids) / jnp.maximum(n_after, 1.0)[:, None]
     step = jnp.where((bc > 0)[:, None], delta, 0.0)
-    return centroids + step, n_after, jnp.sum(step ** 2)
+    return centroids + step, n_after, jnp.sum(step ** 2), b_inertia
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "batch_size", "steps", "chunk_size", "compute_dtype", "n_valid",
-        "with_final", "backend",
+        "with_final", "backend", "max_no_improvement",
     ),
 )
 def _minibatch_loop(
@@ -77,28 +80,71 @@ def _minibatch_loop(
     n_valid=None,
     with_final=True,
     backend="xla",
+    tol=None,
+    max_no_improvement=None,
 ):
     # n_valid < n means trailing rows are shard padding: never sample them.
     n = n_valid if n_valid is not None else x.shape[0]
     k = centroids0.shape[0]
     f32 = jnp.float32
+    early = tol is not None or max_no_improvement is not None
 
-    def step(carry, i):
-        centroids, n_seen = carry
+    def one_batch(centroids, n_seen, i):
         bkey = jax.random.fold_in(key, i)
         idx = jax.random.randint(bkey, (batch_size,), 0, n)
-        centroids, n_after, shift_sq = batch_update(
+        return batch_update(
             centroids, n_seen, x[idx], compute_dtype=compute_dtype
         )
-        return (centroids, n_after), shift_sq
 
-    (centroids, _), shifts = lax.scan(
-        step, (centroids0.astype(f32), jnp.zeros((k,), f32)),
-        jnp.arange(steps),
-    )
-    # Minibatch has no tol-based stop; "converged" is only True in the
-    # degenerate no-movement case (steps is static, so guard in Python).
-    converged = (shifts[-1] <= 0.0) if steps > 0 else jnp.asarray(False)
+    if not early:
+        def step(carry, i):
+            centroids, n_seen = carry
+            centroids, n_after, shift_sq, _ = one_batch(centroids, n_seen, i)
+            return (centroids, n_after), shift_sq
+
+        (centroids, _), shifts = lax.scan(
+            step, (centroids0.astype(f32), jnp.zeros((k,), f32)),
+            jnp.arange(steps),
+        )
+        # Without early stopping "converged" is only True in the degenerate
+        # no-movement case (steps is static, so guard in Python).
+        converged = (shifts[-1] <= 0.0) if steps > 0 else jnp.asarray(False)
+        n_steps = jnp.asarray(steps, jnp.int32)
+    else:
+        # Early stopping (sklearn MiniBatchKMeans semantics): stop when the
+        # centroid shift drops to ``tol``, or when the exponentially-weighted
+        # average of batch inertia fails to improve ``max_no_improvement``
+        # batches in a row.  ``steps`` remains the hard cap.
+        tol_v = jnp.asarray(-1.0 if tol is None else tol, f32)
+        mni = 0 if max_no_improvement is None else int(max_no_improvement)
+        alpha = jnp.asarray(min(1.0, batch_size * 2.0 / (n + 1)), f32)
+
+        def cond(s):
+            return (s[2] < steps) & ~s[6]
+
+        def body(s):
+            centroids, n_seen, it, ewa, best, stale, _ = s
+            centroids, n_after, shift_sq, b_inertia = one_batch(
+                centroids, n_seen, it
+            )
+            ewa = jnp.where(
+                it == 0, b_inertia, ewa * (1.0 - alpha) + b_inertia * alpha
+            )
+            improved = ewa < best
+            best = jnp.minimum(best, ewa)
+            stale = jnp.where(improved, 0, stale + 1)
+            done = (shift_sq <= tol_v)
+            if mni > 0:
+                done = done | (stale >= mni)
+            return (centroids, n_after, it + 1, ewa, best, stale, done)
+
+        init = (centroids0.astype(f32), jnp.zeros((k,), f32),
+                jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, f32),
+                jnp.asarray(jnp.inf, f32), jnp.zeros((), jnp.int32),
+                jnp.zeros((), bool))
+        centroids, _, n_steps, _, _, _, converged = lax.while_loop(
+            cond, body, init
+        )
     if not with_final:
         # Caller does its own (e.g. sharded) labeling pass — skip the full
         # O(n·d·k) sweep here.
@@ -107,7 +153,7 @@ def _minibatch_loop(
             centroids,
             jnp.zeros((0,), jnp.int32),
             zero,
-            jnp.asarray(steps, jnp.int32),
+            n_steps,
             converged,
             jnp.zeros((k,), f32),
         )
@@ -119,7 +165,7 @@ def _minibatch_loop(
         centroids,
         labels,
         inertia,
-        jnp.asarray(steps, jnp.int32),
+        n_steps,
         converged,
         counts,
     )
@@ -134,8 +180,16 @@ def fit_minibatch(
     init: Union[str, jax.Array, None] = None,
     batch_size: Optional[int] = None,
     steps: Optional[int] = None,
+    tol: Optional[float] = None,
+    max_no_improvement: Optional[int] = None,
 ) -> KMeansState:
-    """Fit minibatch k-means; see module docstring for the update rule."""
+    """Fit minibatch k-means; see module docstring for the update rule.
+
+    ``tol`` (centroid-shift threshold) and ``max_no_improvement`` (stop when
+    the EWA of batch inertia fails to improve that many batches running)
+    enable sklearn-style early stopping; both default to off — ``steps`` is
+    exact — because at TPU scale a fixed step budget is usually the point.
+    """
     cfg = (config or KMeansConfig(k=k)).validate()
     if config is not None and config.k != k:
         raise ValueError(
@@ -178,6 +232,8 @@ def fit_minibatch(
         backend=resolve_backend(
             cfg.backend, x, k, compute_dtype=cfg.compute_dtype,
         ),
+        tol=tol,
+        max_no_improvement=max_no_improvement,
     )
 
 
@@ -191,6 +247,8 @@ class MiniBatchKMeans:
     steps: int = 200
     seed: int = 0
     n_init: int = 1
+    tol: Optional[float] = None
+    max_no_improvement: Optional[int] = None
     chunk_size: int = 4096
     compute_dtype: Optional[str] = None
 
@@ -214,7 +272,8 @@ class MiniBatchKMeans:
         init = None if isinstance(self.init, str) else self.init
         self.state = best_of_n_init(
             lambda key: fit_minibatch(
-                x, self.n_clusters, key=key, config=cfg, init=init
+                x, self.n_clusters, key=key, config=cfg, init=init,
+                tol=self.tol, max_no_improvement=self.max_no_improvement,
             ),
             jax.random.key(self.seed),
             1 if init is not None else self.n_init,

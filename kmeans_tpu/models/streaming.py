@@ -37,7 +37,7 @@ def _stream_step(centroids, n_seen, xb, *, compute_dtype):
     of an on-device gather."""
     from kmeans_tpu.models.minibatch import batch_update
 
-    centroids, n_after, _ = batch_update(
+    centroids, n_after, _, _ = batch_update(
         centroids, n_seen, xb, compute_dtype=compute_dtype
     )
     return centroids, n_after

@@ -305,3 +305,57 @@ def test_n_init_array_init_runs_once_for_fuzzy():
     f2 = FuzzyCMeans(n_clusters=3, init=c0, n_init=1).fit(np.asarray(x))
     np.testing.assert_array_equal(np.asarray(f1.cluster_centers_),
                                   np.asarray(f2.cluster_centers_))
+
+
+def test_minibatch_early_stopping():
+    # Well-separated blobs converge fast: with max_no_improvement the fit
+    # must stop well before the step cap, report converged, and match the
+    # quality of the full-budget run.
+    x, _, _ = make_blobs(jax.random.key(11), 4000, 8, 5, cluster_std=0.3)
+    full = fit_minibatch(x, 5, key=jax.random.key(0), batch_size=512,
+                         steps=300)
+    early = fit_minibatch(x, 5, key=jax.random.key(0), batch_size=512,
+                          steps=300, max_no_improvement=10)
+    assert bool(early.converged)
+    assert int(early.n_iter) < 300
+    assert float(early.inertia) <= float(full.inertia) * 1.2
+
+    # tol-based stop: an enormous tol stops after the first batch.
+    t = fit_minibatch(x, 5, key=jax.random.key(0), batch_size=512,
+                      steps=300, tol=1e12)
+    assert int(t.n_iter) == 1 and bool(t.converged)
+
+    # without early stopping, steps is exact (unchanged behavior)
+    assert int(full.n_iter) == 300
+
+
+def test_minibatch_estimator_early_stop_fields():
+    x, _, _ = make_blobs(jax.random.key(12), 2000, 4, 4, cluster_std=0.3)
+    mb = MiniBatchKMeans(n_clusters=4, batch_size=256, steps=300,
+                         max_no_improvement=10, seed=0).fit(np.asarray(x))
+    assert int(mb.state.n_iter) < 300
+    assert bool(mb.state.converged)
+
+
+def test_n_init_one_is_seed_compatible_with_functional_front_door():
+    from kmeans_tpu.config import KMeansConfig
+
+    x, _, _ = make_blobs(jax.random.key(13), 500, 4, 3)
+    km = KMeans(n_clusters=3, seed=42).fit(x)
+    st = fit_lloyd(x, 3, config=KMeansConfig(k=3, seed=42))
+    np.testing.assert_array_equal(np.asarray(km.cluster_centers_),
+                                  np.asarray(st.centroids))
+
+
+def test_best_of_n_init_never_keeps_nan_over_finite():
+    from types import SimpleNamespace
+
+    from kmeans_tpu.models.lloyd import best_of_n_init
+
+    states = iter([
+        SimpleNamespace(inertia=float("nan")),
+        SimpleNamespace(inertia=5.0),
+        SimpleNamespace(inertia=7.0),
+    ])
+    best = best_of_n_init(lambda key: next(states), jax.random.key(0), 3)
+    assert best.inertia == 5.0

@@ -307,6 +307,13 @@ class KMeansServer:
         k = min(int(args.get("k", 3)), 100)
         max_iter = min(int(args.get("max_iter", 30)), 100)
         seed = int(args.get("seed", 0))
+        model = str(args.get("model", "lloyd"))
+        init = str(args.get("init", "k-means++"))
+        if model not in ("lloyd", "accelerated", "minibatch", "spherical",
+                         "bisecting", "fuzzy"):
+            raise ValueError(f"unknown train model {model!r}")
+        if init not in ("k-means++", "k-means||", "random"):
+            raise ValueError(f"unknown train init {init!r}")
         if n < k or n < 1 or d < 1 or k < 1:
             raise ValueError("invalid train shape")
         # Bound the data volume a single unauthenticated request can demand
@@ -325,23 +332,47 @@ class KMeansServer:
             try:
                 import jax
 
-                from kmeans_tpu.data import make_blobs
+                import kmeans_tpu.models as models
+                from kmeans_tpu.config import KMeansConfig
                 from kmeans_tpu.models.runner import LloydRunner
+
+                from kmeans_tpu.data import make_blobs
 
                 x, _, _ = make_blobs(
                     jax.random.key(seed), n, d, k, cluster_std=0.6
                 )
-                runner = LloydRunner(
-                    np.asarray(x), k, key=jax.random.key(seed + 1)
-                )
-                runner.init()
+                # steps=max_iter keeps the request's work cap meaningful for
+                # the minibatch family, which reads steps, not max_iter.
+                kcfg = KMeansConfig(k=k, init=init, max_iter=max_iter,
+                                    steps=max_iter)
+                if model == "lloyd":
+                    # Step-wise runner: one SSE event per iteration.
+                    runner = LloydRunner(
+                        np.asarray(x), k, key=jax.random.key(seed + 1),
+                        config=kcfg,
+                    )
+                    runner.init()
 
-                def cb(info):
-                    room.broadcast_event({
-                        "type": "train", **info.as_dict(),
-                    })
+                    def cb(info):
+                        room.broadcast_event({
+                            "type": "train", **info.as_dict(),
+                        })
 
-                state = runner.run(max_iter=max_iter, callback=cb)
+                    state = runner.run(max_iter=max_iter, callback=cb)
+                else:
+                    # Other families fit as one compiled program — stream a
+                    # start marker, then the result.
+                    room.broadcast_event({"type": "train", "model": model,
+                                          "iteration": 0})
+                    fit = {
+                        "accelerated": models.fit_lloyd_accelerated,
+                        "minibatch": models.fit_minibatch,
+                        "spherical": models.fit_spherical,
+                        "bisecting": models.fit_bisecting,
+                        "fuzzy": models.fit_fuzzy,
+                    }[model]
+                    state = fit(x, k, key=jax.random.key(seed + 1),
+                                config=kcfg)
                 if d >= 2 and k <= MAX_CENTROIDS:
                     from kmeans_tpu.session.schema import to_plain
 
@@ -351,9 +382,12 @@ class KMeansServer:
                         max_cards=self.config.max_render_cards,
                     )
                     import_json(room.doc, to_plain(viz))
+                objective = getattr(state, "inertia",
+                                    getattr(state, "objective", 0.0))
                 room.broadcast_event({
                     "type": "train_done",
-                    "inertia": float(state.inertia),
+                    "model": model,
+                    "inertia": float(objective),
                     "n_iter": int(state.n_iter),
                     "converged": bool(state.converged),
                 })

@@ -72,7 +72,10 @@ function connectEvents() {
       const t = $id("trainStatus");
       t.style.display = "";
       if (msg.type === "train")
-        t.textContent = `iter ${msg.iteration}: inertia ${msg.inertia.toFixed(1)} (${(msg.seconds * 1000).toFixed(0)}ms)`;
+        // Non-lloyd families send a start marker without inertia/seconds.
+        t.textContent = msg.inertia === undefined
+          ? `training ${msg.model || ""}…`
+          : `iter ${msg.iteration}: inertia ${msg.inertia.toFixed(1)} (${(msg.seconds * 1000).toFixed(0)}ms)`;
       else if (msg.type === "train_done")
         t.textContent = `done: ${msg.n_iter} iters, inertia ${msg.inertia.toFixed(1)}${msg.converged ? " ✓" : ""}`;
       else t.textContent = `train failed: ${msg.error}`;
@@ -372,7 +375,7 @@ $id("shuffleUnassigned").addEventListener("click", () => mutate("shuffleUnassign
 $id("restartAll").addEventListener("click", () => mutate("restartAll"));
 $id("tpuAssign").addEventListener("click", () => mutate("autoAssign"));
 $id("tpuTrain").addEventListener("click", () =>
-  mutate("train", { n: 500, d: 2, k: 3 }));
+  mutate("train", { n: 500, d: 2, k: 3, model: $id("trainModel").value }));
 $id("saveName").addEventListener("click", () => {
   myName = $id("name").value.trim() || myName;
   localStorage.setItem(LS_NAME, myName);

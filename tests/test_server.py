@@ -294,3 +294,82 @@ def test_train_op_streams_and_updates_board(server):
 def test_train_op_rejects_bad_shapes(server):
     st, out = _mutate(server, "OOOO", "train", {"n": 2, "k": 10})
     assert st == 400
+
+
+def test_train_op_model_families(server):
+    import socket
+    import time as _time
+
+    room = "MMMM"
+    host, port = server.httpd.server_address
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.sendall(
+        f"GET /api/events?room={room} HTTP/1.1\r\n"
+        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
+    )
+    hello_buf = b""
+    while b'"type": "hello"' not in hello_buf:
+        hello_buf += sock.recv(4096)
+    st, out = _mutate(server, room, "train",
+                      {"n": 200, "d": 2, "k": 3, "max_iter": 10,
+                       "model": "bisecting"})
+    assert st == 200 and out["started"]
+    deadline = _time.time() + 30
+    buf = b""
+    while b"train_done" not in buf and _time.time() < deadline:
+        sock.settimeout(max(0.1, deadline - _time.time()))
+        try:
+            chunk = sock.recv(8192)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    sock.close()
+    assert b'"model": "bisecting"' in buf, buf[:500]
+    assert b"train_done" in buf
+
+
+def test_train_op_rejects_bad_model_and_init(server):
+    st, _ = _mutate(server, "PPPP", "train", {"n": 100, "k": 3,
+                                              "model": "dbscan"})
+    assert st == 400
+    st, _ = _mutate(server, "PPPP", "train", {"n": 100, "k": 3,
+                                              "init": "spectral"})
+    assert st == 400
+
+
+def test_train_op_minibatch_respects_step_cap(server):
+    import socket
+    import time as _time
+
+    room = "QQQQ"
+    host, port = server.httpd.server_address
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.sendall(
+        f"GET /api/events?room={room} HTTP/1.1\r\n"
+        f"Host: x\r\nAccept: text/event-stream\r\n\r\n".encode()
+    )
+    buf = b""
+    while b'"type": "hello"' not in buf:
+        buf += sock.recv(4096)
+    st, out = _mutate(server, room, "train",
+                      {"n": 300, "d": 2, "k": 3, "max_iter": 7,
+                       "model": "minibatch"})
+    assert st == 200
+    deadline = _time.time() + 30
+    buf = b""
+    while b"train_done" not in buf and _time.time() < deadline:
+        sock.settimeout(max(0.1, deadline - _time.time()))
+        try:
+            chunk = sock.recv(8192)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    sock.close()
+    done = [l for l in buf.decode().splitlines() if "train_done" in l]
+    assert done, buf[:500]
+    payload = json.loads(done[-1].split("data: ", 1)[1])
+    assert payload["n_iter"] == 7

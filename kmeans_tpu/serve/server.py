@@ -54,6 +54,17 @@ __all__ = ["KMeansServer", "serve"]
 
 _STATIC = Path(__file__).parent / "static"
 
+#: One-shot model families the train op can run (lloyd streams per-iteration
+#: via LloydRunner instead).  The one source of truth for validation AND
+#: dispatch — names resolve on kmeans_tpu.models at run time.
+_TRAIN_FITS = {
+    "accelerated": "fit_lloyd_accelerated",
+    "minibatch": "fit_minibatch",
+    "spherical": "fit_spherical",
+    "bisecting": "fit_bisecting",
+    "fuzzy": "fit_fuzzy",
+}
+
 #: _headers:1-21 adapted to same-origin serving (no CDNs, no trackers).
 _SECURITY_HEADERS = {
     "Content-Security-Policy": (
@@ -309,8 +320,7 @@ class KMeansServer:
         seed = int(args.get("seed", 0))
         model = str(args.get("model", "lloyd"))
         init = str(args.get("init", "k-means++"))
-        if model not in ("lloyd", "accelerated", "minibatch", "spherical",
-                         "bisecting", "fuzzy"):
+        if model != "lloyd" and model not in _TRAIN_FITS:
             raise ValueError(f"unknown train model {model!r}")
         if init not in ("k-means++", "k-means||", "random"):
             raise ValueError(f"unknown train init {init!r}")
@@ -364,13 +374,7 @@ class KMeansServer:
                     # start marker, then the result.
                     room.broadcast_event({"type": "train", "model": model,
                                           "iteration": 0})
-                    fit = {
-                        "accelerated": models.fit_lloyd_accelerated,
-                        "minibatch": models.fit_minibatch,
-                        "spherical": models.fit_spherical,
-                        "bisecting": models.fit_bisecting,
-                        "fuzzy": models.fit_fuzzy,
-                    }[model]
+                    fit = getattr(models, _TRAIN_FITS[model])
                     state = fit(x, k, key=jax.random.key(seed + 1),
                                 config=kcfg)
                 if d >= 2 and k <= MAX_CENTROIDS:

@@ -271,7 +271,8 @@ def test_train_op_streams_and_updates_board(server):
 
     deadline = _time.time() + 30
     buf = b""
-    while b"train_done" not in buf and _time.time() < deadline:
+    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
+           and _time.time() < deadline):
         sock.settimeout(max(0.1, deadline - _time.time()))
         try:
             chunk = sock.recv(8192)
@@ -316,7 +317,8 @@ def test_train_op_model_families(server):
     assert st == 200 and out["started"]
     deadline = _time.time() + 30
     buf = b""
-    while b"train_done" not in buf and _time.time() < deadline:
+    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
+           and _time.time() < deadline):
         sock.settimeout(max(0.1, deadline - _time.time()))
         try:
             chunk = sock.recv(8192)
@@ -359,7 +361,8 @@ def test_train_op_minibatch_respects_step_cap(server):
     assert st == 200
     deadline = _time.time() + 30
     buf = b""
-    while b"train_done" not in buf and _time.time() < deadline:
+    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
+           and _time.time() < deadline):
         sock.settimeout(max(0.1, deadline - _time.time()))
         try:
             chunk = sock.recv(8192)

@@ -211,7 +211,8 @@ def main():
     ap.add_argument("--all", action="store_true", help="run all 5 configs")
     ap.add_argument("--converge", action="store_true",
                     help="headline metric = wall-clock of a full fit "
-                         "(k-means++ init + Lloyd to tol) instead of iter/s")
+                         "(k-means|| seeding + Lloyd to tol) instead of "
+                         "iter/s")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--backend", default="auto",
                     choices=("auto", "xla", "pallas"),

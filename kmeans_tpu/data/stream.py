@@ -35,18 +35,26 @@ def sample_batches(
     steps: int,
     *,
     seed: int = 0,
+    start_step: int = 0,
 ) -> Iterator[np.ndarray]:
-    """Yield ``steps`` with-replacement sampled batches from host ``data``.
+    """Yield batches ``start_step..steps-1``, with-replacement sampled from
+    host ``data``.
 
+    Each step draws from its own ``default_rng((seed, step))``, so batch t
+    is a pure function of (seed, t) — resuming from a checkpoint at step t
+    replays exactly the sequence an uninterrupted run would have seen.
     Indices are sorted within each batch: on a memmap this turns the gather
     into a forward disk scan (page-cache friendly) and is distribution-free
     for the minibatch update, which never looks at intra-batch order.
     """
     n = data.shape[0]
-    if batch_size < 1 or steps < 0:
-        raise ValueError(f"bad batch_size={batch_size} / steps={steps}")
-    rng = np.random.default_rng(seed)
-    for _ in range(steps):
+    if batch_size < 1 or steps < 0 or not 0 <= start_step <= steps:
+        raise ValueError(
+            f"bad batch_size={batch_size} / steps={steps} / "
+            f"start_step={start_step}"
+        )
+    for step in range(start_step, steps):
+        rng = np.random.default_rng((seed, step))
         idx = np.sort(rng.integers(0, n, size=batch_size))
         yield np.ascontiguousarray(data[idx])
 

@@ -90,6 +90,9 @@ def fit_minibatch_stream(
     seed: Optional[int] = None,
     prefetch_depth: int = 2,
     final_pass: bool = True,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 100,
+    resume: bool = False,
 ) -> KMeansState:
     """Minibatch k-means over host/disk data of unbounded size.
 
@@ -98,6 +101,13 @@ def fit_minibatch_stream(
     datasets).  With ``final_pass`` a streamed labeling sweep fills
     labels/inertia/counts; otherwise those fields are empty (cheaper when
     only centroids matter).
+
+    With ``checkpoint_path``, (centroids, per-center counts, step) are saved
+    atomically every ``checkpoint_every`` steps and at the end; with
+    ``resume`` an existing checkpoint continues from its step, and because
+    batches are a pure function of (seed, step) the resumed run replays the
+    exact sequence an uninterrupted run would have seen (long streams
+    survive preemption losing at most ``checkpoint_every`` steps).
     """
     cfg, key = resolve_fit_config(k, key, config)
     n, d = data.shape
@@ -105,28 +115,104 @@ def fit_minibatch_stream(
     n_steps = steps if steps is not None else cfg.steps
     host_seed = seed if seed is not None else cfg.seed
 
-    if init is not None and not isinstance(init, str):
-        c0 = jnp.asarray(init, jnp.float32)
-        if c0.shape != (k, d):
-            raise ValueError(f"init centroids shape {c0.shape} != {(k, d)}")
-    else:
-        # Seed on a host subsample (mirrors fit_minibatch's recipe).
-        method = init if isinstance(init, str) else cfg.init
-        sub = min(n, max(4 * k * 16, 65536))
-        rng = np.random.default_rng(host_seed)
-        sidx = np.sort(rng.choice(n, size=sub, replace=False))
-        xs = jnp.asarray(np.ascontiguousarray(data[sidx]))
-        c0 = init_centroids(
-            key, xs, k, method=method, compute_dtype=cfg.compute_dtype,
-            chunk_size=cfg.chunk_size,
+    start_step = 0
+    c0 = None
+    if resume:
+        if not checkpoint_path:
+            raise ValueError("resume=True requires checkpoint_path")
+        import os
+
+        from kmeans_tpu.utils.checkpoint import load_checkpoint
+
+        if os.path.isdir(checkpoint_path):
+            st, meta = load_checkpoint(checkpoint_path)
+            c0 = jnp.asarray(st.centroids, jnp.float32)
+            if c0.shape != (k, d):
+                raise ValueError(
+                    f"checkpoint centroids {c0.shape} != {(k, d)}"
+                )
+            n_seen = jnp.asarray(st.counts, jnp.float32)
+            start_step = int(st.n_iter)
+            # The exact-replay guarantee needs the original sampling params:
+            # adopt them when the caller didn't pass explicit values, and
+            # refuse an explicit mismatch rather than silently diverging.
+            ck = (meta or {}).get("extra", {})
+            for name, ck_key, explicit, current in (
+                ("seed", "host_seed", seed, host_seed),
+                ("batch_size", "batch_size", batch_size, bs),
+            ):
+                if ck_key not in ck:
+                    continue
+                if explicit is not None and int(ck[ck_key]) != int(current):
+                    raise ValueError(
+                        f"resume {name}={current} contradicts the "
+                        f"checkpoint's {name}={ck[ck_key]}; drop the "
+                        f"argument or restart without resume"
+                    )
+            host_seed = int(ck.get("host_seed", host_seed))
+            bs = int(ck.get("batch_size", bs))
+            if start_step > n_steps:
+                raise ValueError(
+                    f"checkpoint is at step {start_step} > requested "
+                    f"steps={n_steps}; raise steps to continue this stream"
+                )
+
+    if c0 is None:
+        n_seen = jnp.zeros((k,), jnp.float32)
+        if init is not None and not isinstance(init, str):
+            c0 = jnp.asarray(init, jnp.float32)
+            if c0.shape != (k, d):
+                raise ValueError(
+                    f"init centroids shape {c0.shape} != {(k, d)}"
+                )
+        else:
+            # Seed on a host subsample (mirrors fit_minibatch's recipe).
+            method = init if isinstance(init, str) else cfg.init
+            sub = min(n, max(4 * k * 16, 65536))
+            rng = np.random.default_rng(host_seed)
+            sidx = np.sort(rng.choice(n, size=sub, replace=False))
+            xs = jnp.asarray(np.ascontiguousarray(data[sidx]))
+            c0 = init_centroids(
+                key, xs, k, method=method, compute_dtype=cfg.compute_dtype,
+                chunk_size=cfg.chunk_size,
+            )
+
+    last_saved = [-1]
+
+    def maybe_checkpoint(c, n_seen, step, force=False):
+        if not checkpoint_path or step == last_saved[0]:
+            return
+        if not force and (checkpoint_every < 1
+                          or step % checkpoint_every != 0):
+            return
+        last_saved[0] = step
+        from kmeans_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            checkpoint_path,
+            KMeansState(
+                centroids=c,
+                labels=jnp.zeros((0,), jnp.int32),
+                inertia=jnp.zeros((), jnp.float32),
+                n_iter=jnp.asarray(step, jnp.int32),
+                converged=jnp.asarray(False),
+                counts=n_seen,
+            ),
+            step=step, config=cfg,
+            extra={"stream": True, "host_seed": int(host_seed),
+                   "batch_size": int(bs), "total_steps": int(n_steps)},
         )
 
     c = c0.astype(jnp.float32)
-    n_seen = jnp.zeros((k,), jnp.float32)
-    batches = sample_batches(data, bs, n_steps, seed=host_seed)
+    batches = sample_batches(data, bs, n_steps, seed=host_seed,
+                             start_step=start_step)
+    step = start_step
     for xb in prefetch_to_device(batches, depth=prefetch_depth):
         c, n_seen = _stream_step(c, n_seen, xb,
                                  compute_dtype=cfg.compute_dtype)
+        step += 1
+        maybe_checkpoint(c, n_seen, step)
+    maybe_checkpoint(c, n_seen, step, force=True)
 
     if final_pass:
         labels_np, inertia = assign_stream(
@@ -147,7 +233,7 @@ def fit_minibatch_stream(
         centroids=c,
         labels=labels,
         inertia=inertia_v,
-        n_iter=jnp.asarray(n_steps, jnp.int32),
+        n_iter=jnp.asarray(step, jnp.int32),
         converged=jnp.asarray(False),
         counts=counts,
     )

@@ -1,0 +1,85 @@
+"""Property tests (SURVEY.md §4): invariances the estimators must respect.
+
+Complements the oracle tests: these check structural properties —
+permutation/translation/scale equivariance and weight-vs-duplication
+equivalence — that hold for exact k-means regardless of data.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import fit_lloyd, fit_spherical
+
+
+def _fit(x, c0, **kw):
+    return fit_lloyd(jnp.asarray(x), c0.shape[0], init=jnp.asarray(c0),
+                     tol=1e-10, max_iter=40, **kw)
+
+
+def test_permutation_equivariance():
+    x, _, _ = make_blobs(jax.random.key(0), 400, 5, 4, cluster_std=0.5)
+    x = np.asarray(x)
+    c0 = x[:4].copy()
+    perm = np.random.default_rng(0).permutation(len(x))
+
+    a = _fit(x, c0)
+    b = _fit(x[perm], c0)
+    # Same init => identical centroids (up to fp reduction order) and the
+    # permuted labels.
+    np.testing.assert_allclose(np.asarray(a.centroids),
+                               np.asarray(b.centroids), rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(a.labels)[perm],
+                                  np.asarray(b.labels))
+    np.testing.assert_allclose(float(a.inertia), float(b.inertia), rtol=1e-4)
+
+
+def test_translation_and_scale_equivariance():
+    x, _, _ = make_blobs(jax.random.key(1), 300, 4, 3, cluster_std=0.5)
+    x = np.asarray(x)
+    c0 = x[:3].copy()
+    a = _fit(x, c0)
+
+    shift = np.asarray([10.0, -5.0, 3.0, 0.5], np.float32)
+    t = _fit(x + shift, c0 + shift)
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(t.labels))
+    np.testing.assert_allclose(np.asarray(t.centroids),
+                               np.asarray(a.centroids) + shift,
+                               rtol=1e-3, atol=1e-3)
+
+    s = _fit(x * 3.0, c0 * 3.0)
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(s.labels))
+    np.testing.assert_allclose(float(s.inertia), 9.0 * float(a.inertia),
+                               rtol=1e-3)
+
+
+def test_weight_two_equals_row_duplication():
+    x, _, _ = make_blobs(jax.random.key(2), 200, 3, 3, cluster_std=0.5)
+    x = np.asarray(x)
+    c0 = x[:3].copy()
+    w = np.ones(len(x), np.float32)
+    w[:50] = 2.0
+
+    weighted = fit_lloyd(jnp.asarray(x), 3, init=jnp.asarray(c0),
+                         weights=jnp.asarray(w), tol=1e-10, max_iter=40)
+    dup = np.concatenate([x, x[:50]])
+    duplicated = _fit(dup, c0)
+    np.testing.assert_allclose(np.asarray(weighted.centroids),
+                               np.asarray(duplicated.centroids),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(float(weighted.inertia),
+                               float(duplicated.inertia), rtol=1e-3)
+
+
+def test_spherical_labels_invariant_to_row_scaling():
+    # Cosine distance ignores row norms: scaling any row must not change
+    # its cluster.
+    x, _, _ = make_blobs(jax.random.key(3), 300, 6, 4, cluster_std=0.3)
+    x = np.asarray(x)
+    scales = np.random.default_rng(1).uniform(0.1, 10.0,
+                                              size=(len(x), 1)).astype("f4")
+    a = fit_spherical(jnp.asarray(x), 4, key=jax.random.key(4), max_iter=40)
+    b = fit_spherical(jnp.asarray(x * scales), 4,
+                      init=jnp.asarray(np.asarray(a.centroids)), max_iter=40)
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))

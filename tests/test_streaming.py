@@ -152,3 +152,72 @@ def test_cli_stream_error_paths_are_clean(tmp_path, capsys):
     capsys.readouterr()
     doc = json.loads(open(out_json).read())
     assert len(doc["cards"]) <= 40
+
+
+def test_stream_checkpoint_resume_matches_uninterrupted_run(tmp_path,
+                                                            mmap_blobs):
+    path, _ = mmap_blobs
+    data = load_mmap(path)
+    ckpt = str(tmp_path / "ckpt")
+
+    full = fit_minibatch_stream(data, 6, batch_size=256, steps=60, seed=3)
+
+    # Interrupted run: 30 steps with a checkpoint, then resume to 60.
+    fit_minibatch_stream(data, 6, batch_size=256, steps=30, seed=3,
+                         checkpoint_path=ckpt, checkpoint_every=10,
+                         final_pass=False)
+    resumed = fit_minibatch_stream(data, 6, batch_size=256, steps=60, seed=3,
+                                   checkpoint_path=ckpt, resume=True)
+    assert int(resumed.n_iter) == 60
+    np.testing.assert_allclose(np.asarray(resumed.centroids),
+                               np.asarray(full.centroids), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(resumed.labels),
+                                  np.asarray(full.labels))
+
+
+def test_stream_resume_requires_checkpoint_path(mmap_blobs):
+    path, _ = mmap_blobs
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        fit_minibatch_stream(load_mmap(path), 4, steps=5, resume=True)
+
+
+def test_stream_resume_with_missing_checkpoint_starts_fresh(tmp_path,
+                                                            mmap_blobs):
+    path, _ = mmap_blobs
+    data = load_mmap(path)
+    ckpt = str(tmp_path / "never_written")
+    st = fit_minibatch_stream(data, 4, batch_size=256, steps=10, seed=1,
+                              checkpoint_path=ckpt, resume=True,
+                              checkpoint_every=0)
+    assert int(st.n_iter) == 10
+    import os
+    assert os.path.isdir(ckpt)  # final forced save still lands
+
+
+def test_stream_resume_adopts_and_validates_checkpoint_params(tmp_path,
+                                                              mmap_blobs):
+    path, _ = mmap_blobs
+    data = load_mmap(path)
+    ckpt = str(tmp_path / "ck2")
+    fit_minibatch_stream(data, 4, batch_size=256, steps=20, seed=7,
+                         checkpoint_path=ckpt, final_pass=False)
+    # Resume without repeating seed/batch_size: adopted from the checkpoint,
+    # so the result still equals the uninterrupted run.
+    full = fit_minibatch_stream(data, 4, batch_size=256, steps=40, seed=7)
+    resumed = fit_minibatch_stream(data, 4, steps=40,
+                                   checkpoint_path=ckpt, resume=True)
+    np.testing.assert_allclose(np.asarray(resumed.centroids),
+                               np.asarray(full.centroids), rtol=1e-5,
+                               atol=1e-5)
+    # Explicit contradictions are refused.
+    with pytest.raises(ValueError, match="contradicts"):
+        fit_minibatch_stream(data, 4, steps=40, seed=8,
+                             checkpoint_path=ckpt, resume=True)
+    with pytest.raises(ValueError, match="contradicts"):
+        fit_minibatch_stream(data, 4, batch_size=128, steps=40,
+                             checkpoint_path=ckpt, resume=True)
+    # A checkpoint past the requested budget is an error, not a no-op.
+    with pytest.raises(ValueError, match="raise steps"):
+        fit_minibatch_stream(data, 4, steps=10,
+                             checkpoint_path=ckpt, resume=True)

@@ -24,10 +24,12 @@ from kmeans_tpu.models import (
     FuzzyCMeans,
     KMeans,
     KMeansState,
+    KMedoids,
     MiniBatchKMeans,
     SphericalKMeans,
     fit_bisecting,
     fit_fuzzy,
+    fit_kmedoids,
     fit_lloyd,
     fit_lloyd_accelerated,
     fit_minibatch,
@@ -45,10 +47,12 @@ __all__ = [
     "FuzzyCMeans",
     "KMeans",
     "KMeansState",
+    "KMedoids",
     "MiniBatchKMeans",
     "SphericalKMeans",
     "fit_bisecting",
     "fit_fuzzy",
+    "fit_kmedoids",
     "fit_lloyd",
     "fit_lloyd_accelerated",
     "fit_minibatch",

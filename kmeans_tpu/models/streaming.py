@@ -120,11 +120,17 @@ def fit_minibatch_stream(
     if resume:
         if not checkpoint_path:
             raise ValueError("resume=True requires checkpoint_path")
-        import os
+        from kmeans_tpu.utils.checkpoint import latest_step, load_checkpoint
 
-        from kmeans_tpu.utils.checkpoint import load_checkpoint
-
-        if os.path.isdir(checkpoint_path):
+        # latest_step resolves the <path>.old kept during a crashed save
+        # swap — exactly the case the atomic checkpoints exist for.
+        if latest_step(checkpoint_path) is not None:
+            if init is not None and not isinstance(init, str):
+                raise ValueError(
+                    "resume found an existing checkpoint; an explicit init "
+                    "centroid array contradicts it — drop init or the "
+                    "checkpoint"
+                )
             st, meta = load_checkpoint(checkpoint_path)
             c0 = jnp.asarray(st.centroids, jnp.float32)
             if c0.shape != (k, d):

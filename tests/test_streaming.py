@@ -221,3 +221,32 @@ def test_stream_resume_adopts_and_validates_checkpoint_params(tmp_path,
     with pytest.raises(ValueError, match="raise steps"):
         fit_minibatch_stream(data, 4, steps=10,
                              checkpoint_path=ckpt, resume=True)
+
+
+def test_stream_resume_recovers_from_crashed_save_swap(tmp_path, mmap_blobs):
+    # Simulate a crash between save_checkpoint's two renames: only
+    # <ckpt>.old survives. Resume must pick it up, not restart at step 0.
+    import os
+    import shutil
+
+    path, _ = mmap_blobs
+    data = load_mmap(path)
+    ckpt = str(tmp_path / "ck3")
+    fit_minibatch_stream(data, 4, batch_size=256, steps=20, seed=5,
+                         checkpoint_path=ckpt, final_pass=False)
+    os.rename(ckpt, ckpt + ".old")
+    st = fit_minibatch_stream(data, 4, steps=30, checkpoint_path=ckpt,
+                              resume=True)
+    assert int(st.n_iter) == 30  # continued from 20, not restarted
+    shutil.rmtree(ckpt + ".old", ignore_errors=True)
+
+
+def test_stream_resume_rejects_explicit_init_array(tmp_path, mmap_blobs):
+    path, x = mmap_blobs
+    data = load_mmap(path)
+    ckpt = str(tmp_path / "ck4")
+    fit_minibatch_stream(data, 4, batch_size=256, steps=10, seed=5,
+                         checkpoint_path=ckpt, final_pass=False)
+    with pytest.raises(ValueError, match="init"):
+        fit_minibatch_stream(data, 4, steps=20, init=x[:4],
+                             checkpoint_path=ckpt, resume=True)

@@ -158,6 +158,7 @@ def _cmd_train(args) -> int:
             "spherical": models.fit_spherical,
             "bisecting": models.fit_bisecting,
             "fuzzy": models.fit_fuzzy,
+            "kmedoids": models.fit_kmedoids,
         }[model]
         state = fit(x, k, config=kcfg)
     jax_done = time.perf_counter() - t0
@@ -265,7 +266,7 @@ def main(argv=None) -> int:
                    "(named configs set it from BASELINE)")
     t.add_argument("--model", default=None, choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy",
+        "fuzzy", "kmedoids",
     ], help="model family (default: lloyd, or the config's minibatch choice)")
     t.add_argument("--init", default="k-means++",
                    choices=["k-means++", "k-means||", "random"])
@@ -298,6 +299,7 @@ def main(argv=None) -> int:
     w.add_argument("--k-step", type=int, default=1)
     w.add_argument("--model", default="lloyd", choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
+        "kmedoids",
     ])
     w.add_argument("--init", default="k-means++",
                    choices=["k-means++", "k-means||", "random"])

@@ -28,6 +28,7 @@ _FITTERS = {
     "minibatch": "fit_minibatch",
     "spherical": "fit_spherical",
     "bisecting": "fit_bisecting",
+    "kmedoids": "fit_kmedoids",
 }
 
 
@@ -87,8 +88,11 @@ def sweep_k(
                 key=jax.random.fold_in(key, 10_000 + i),
                 chunk_size=chunk_size,
             ))
+            centers = getattr(state, "centroids", None)
+            if centers is None:  # KMedoidsState names them medoids
+                centers = state.medoids
             db, ch = dispersion_scores(
-                x, state.labels, state.centroids, chunk_size=chunk_size
+                x, state.labels, centers, chunk_size=chunk_size
             )
             row["davies_bouldin"] = float(db)
             row["calinski_harabasz"] = float(ch)

@@ -63,7 +63,12 @@ _TRAIN_FITS = {
     "spherical": "fit_spherical",
     "bisecting": "fit_bisecting",
     "fuzzy": "fit_fuzzy",
+    "kmedoids": "fit_kmedoids",
 }
+
+#: k-medoids' medoid update is O(n²·d) — cap what one unauthenticated
+#: request can demand of the demo server.
+_KMEDOIDS_MAX_N = 20_000
 
 #: _headers:1-21 adapted to same-origin serving (no CDNs, no trackers).
 _SECURITY_HEADERS = {
@@ -326,6 +331,10 @@ class KMeansServer:
             raise ValueError(f"unknown train init {init!r}")
         if n < k or n < 1 or d < 1 or k < 1:
             raise ValueError("invalid train shape")
+        if model == "kmedoids" and n > _KMEDOIDS_MAX_N:
+            raise ValueError(
+                f"kmedoids is O(n²); n must be <= {_KMEDOIDS_MAX_N} here"
+            )
         # Bound the data volume a single unauthenticated request can demand
         # (the endpoint exists for the teaching-game scale, n=500 d=2 k=3).
         if n * d > 8_000_000:

@@ -152,3 +152,18 @@ def test_cli_sweep_k1_only_prints_nothing_on_error(capsys):
     captured = capsys.readouterr()
     assert captured.out == ""
     assert "no rows" in captured.err
+
+
+def test_sweep_k_and_cli_support_kmedoids(capsys):
+    x, _, _ = make_blobs(jax.random.key(20), 300, 3, 3, cluster_std=0.3)
+    rows = sweep_k(np.asarray(x), [2, 3], model="kmedoids", max_iter=20,
+                   silhouette_sample=200)
+    assert len(rows) == 2 and all("silhouette" in r for r in rows)
+
+    from kmeans_tpu.cli import main
+
+    rc = main(["train", "--n", "200", "--d", "2", "--k", "3",
+               "--model", "kmedoids", "--max-iter", "20"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["mode"] == "kmedoids"

@@ -376,3 +376,9 @@ def test_train_op_minibatch_respects_step_cap(server):
     assert done, buf[:500]
     payload = json.loads(done[-1].split("data: ", 1)[1])
     assert payload["n_iter"] == 7
+
+
+def test_train_op_kmedoids_n_cap(server):
+    st, _ = _mutate(server, "RRRR", "train",
+                    {"n": 50_000, "k": 3, "model": "kmedoids"})
+    assert st == 400

@@ -1,0 +1,83 @@
+"""Driver-contract tests for __graft_entry__ (VERDICT.md round-1 item 1).
+
+The round-1 multi-chip dryrun failed because data generation ran on the
+process-default backend, which happened to be a TPU with a broken runtime
+(libtpu mismatch).  These tests pin the hermeticity contract:
+
+* the dryrun must pass on the virtual CPU mesh (the driver's environment);
+* the dryrun must pass even when the default backend is actively BROKEN —
+  simulated by replacing the default backend client with a proxy that raises
+  on any attribute access, the closest in-process analog of round 1's
+  "backend initialises but every compile/execute fails" failure mode.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import jax
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_entry_compiles_and_runs():
+    sys.path.insert(0, _REPO) if _REPO not in sys.path else None
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    # labels, min-dists, sums, counts, inertia — exact shape contract aside,
+    # the driver only needs this to compile and produce arrays.
+    assert all(hasattr(o, "shape") or isinstance(o, (int, float)) for o in out)
+
+
+def test_dryrun_multichip_on_cpu_mesh():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def test_dryrun_hermetic_with_poisoned_default_backend():
+    """dryrun_multichip(8) must succeed when every touch of the default
+    backend raises — proving data gen / RNG / reference fit are all pinned
+    to the mesh devices (VERDICT.md round-1 'Next round' item 1)."""
+    script = r"""
+import os
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+import jax._src.xla_bridge as xb
+
+# Initialise backends, then poison the default one (whatever it is) unless it
+# is the CPU backend the mesh itself needs.
+devs = jax.devices()
+default_platform = devs[0].platform
+
+class _PoisonedBackend:
+    def __getattr__(self, name):
+        raise RuntimeError(f"hermeticity violation: default backend touched (.{name})")
+
+if default_platform != "cpu":
+    with xb._backend_lock:
+        for name in list(xb._backends):
+            if name != "cpu":
+                xb._backends[name] = _PoisonedBackend()
+
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+print("HERMETIC_OK")
+"""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the default backend be whatever it is
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=_REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "HERMETIC_OK" in proc.stdout

@@ -104,8 +104,9 @@ class KMeansConfig:
     #: (reseed to the currently-worst-fit points).
     empty: str = "keep"
     #: Fused-pass backend: "auto" (hand-written Pallas kernel on TPU when its
-    #: alignment/VMEM/exactness gates pass, else the XLA scan), "xla", or
-    #: "pallas" (forced; raises when unsupported).
+    #: alignment/VMEM/exactness gates pass, else the XLA scan), "xla",
+    #: "pallas" (forced; raises when unsupported), or "pallas_interpret"
+    #: (the kernel in interpreter mode — CPU-mesh tests only, slow).
     backend: str = "auto"
 
     # Minibatch engine.
@@ -121,7 +122,7 @@ class KMeansConfig:
             raise ValueError(f"unknown update {self.update!r}")
         if self.empty not in ("keep", "farthest"):
             raise ValueError(f"unknown empty-cluster policy {self.empty!r}")
-        if self.backend not in ("auto", "xla", "pallas"):
+        if self.backend not in ("auto", "xla", "pallas", "pallas_interpret"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.chunk_size < 1:
             raise ValueError("chunk_size must be positive")

@@ -38,15 +38,37 @@ from jax.experimental.pallas import tpu as pltpu
 
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
-__all__ = ["lloyd_pass_pallas", "pallas_supported"]
+__all__ = ["lloyd_pass_pallas", "accumulate_pallas", "pallas_supported"]
 
-# Resident VMEM operands must fit comfortably; leave headroom for the
-# streamed x/label tiles and compiler temporaries.  Calibrated empirically on
-# a v5e chip: the north-star shape (d=2048, k=1000) compiles and runs at
-# block_rows=512 (estimate ~22 MiB) and overflows at 1024 (~31 MiB).
-_VMEM_BUDGET = 23 * 1024 * 1024
+# Fallback VMEM budget when the device can't be queried (non-TPU default
+# backend, e.g. interpret-mode tests on the CPU mesh).  Calibrated
+# empirically on a v5e chip in round 1: the north-star shape (d=2048,
+# k=1000) compiles and runs at block_rows=512 (estimate ~22 MiB).
+_VMEM_FALLBACK = 23 * 1024 * 1024
 
 _LANE = 128
+
+
+def _vmem_budget() -> int:
+    """Usable VMEM budget for the kernel's resident + streamed operands.
+
+    Derived from the device-reported per-core VMEM capacity
+    (``pl.tpu.get_tpu_info()``; v5e reports 128 MiB) instead of a
+    single-generation constant, so the gate doesn't silently mis-size on
+    other TPU generations (VERDICT.md round-1 item 3).  Plans to 3/4 of
+    physical VMEM — the rest is headroom for compiler temporaries and the
+    double-buffered pipeline.  Falls back to the v5e-calibrated constant
+    when the query fails (non-TPU default backend).
+    """
+    try:
+        from jax.experimental.pallas.tpu import get_tpu_info
+
+        cap = get_tpu_info().vmem_capacity_bytes
+    except Exception:
+        return _VMEM_FALLBACK
+    # No floor at the fallback: on 16 MiB-VMEM generations (v2-v4) the
+    # v5e-calibrated constant would exceed physical VMEM.
+    return (3 * cap) // 4
 
 
 def _round_up(v: int, m: int) -> int:
@@ -76,12 +98,38 @@ def pallas_supported(n: int, d: int, k: int, *, block_rows: int = 512,
         return False
     k_pad = _round_up(k, _LANE)
     est = _vmem_estimate(block_rows, d, k_pad, x_itemsize, cd_itemsize)
-    return est <= _VMEM_BUDGET
+    return est <= _vmem_budget()
+
+
+def _fold_tile(sums_ref, counts_ref, labels, w, xb_c, cols, *, cd):
+    """Fold one tile into the (sums, counts) accumulators: one-hot from
+    ``labels`` (any value outside the column range matches nothing), counts
+    on the VPU, the update numerator as a (k, T) @ (T, d) MXU matmul.
+
+    The ``cd`` cast of the one-hot tile is exact for the 0/1 weights the
+    dispatchers gate this to, or when ``cd`` is f32 — the single place this
+    exactness caveat lives for BOTH the fused pass and the labeled
+    accumulation (they must never diverge).
+    """
+    onehot = labels[:, None] == cols
+    wt = onehot * w[:, None]                       # (T, k_pad) f32
+    counts_ref[:] += jnp.sum(wt, axis=0, keepdims=True)
+    sums_ref[:] += jax.lax.dot_general(
+        wt.astype(cd), xb_c,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=matmul_precision(cd),
+    )
+
+
+def _row_sq(xb):
+    xf = xb.astype(jnp.float32)
+    return jnp.sum(xf * xf, axis=1)
 
 
 def _kernel(x_ref, w_ref, ct_ref, csq_ref,
             labels_ref, mind_ref, sums_ref, counts_ref,
-            *, cd, with_update):
+            *, cd, with_update, raw_scores=False):
     """One row tile: distances on the MXU, argmin on the VPU, accumulate."""
     i = pl.program_id(0)
 
@@ -110,9 +158,14 @@ def _kernel(x_ref, w_ref, ct_ref, csq_ref,
     labels = jnp.min(
         jnp.where(part <= part_min[:, None], cols, k_pad), axis=1
     ).astype(jnp.int32)
-    xf = xb.astype(jnp.float32)
-    row_sq = jnp.sum(xf * xf, axis=1)
-    mind = jnp.maximum(part_min + row_sq, 0.0)
+    if raw_scores:
+        # The un-normalised, un-clamped score min_k(||c||² - 2x·c): what a
+        # sharded caller needs for an exact cross-shard argmin tie-break
+        # (adding the row norm or clamping at 0 would merge near-ties that
+        # jnp.argmin on the full distance matrix still distinguishes).
+        mind = part_min
+    else:
+        mind = jnp.maximum(part_min + _row_sq(xb), 0.0)
 
     labels_ref[:] = labels[:, None]
     mind_ref[:] = mind[:, None]
@@ -121,33 +174,24 @@ def _kernel(x_ref, w_ref, ct_ref, csq_ref,
     # 1-sublane vectors, and the XLA epilogue costs one O(n) fused read.
 
     if with_update:
-        onehot = (labels[:, None] == cols)
-        wt = onehot * w[:, None]                   # (T, k_pad) f32
-        counts_ref[:] += jnp.sum(wt, axis=0, keepdims=True)
-        # Update numerator on the MXU: wtᵀ (k, T) @ x (T, d).  The cd cast is
-        # exact for the 0/1 weights this path is gated to (see lloyd_pass
-        # dispatch) or when cd is f32.
-        sums_ref[:] += jax.lax.dot_general(
-            wt.astype(cd), xb_c,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=matmul_precision(cd),
-        )
+        _fold_tile(sums_ref, counts_ref, labels, w, xb_c, cols, cd=cd)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("block_rows", "compute_dtype", "with_update",
-                     "interpret"),
+                     "raw_scores", "interpret"),
 )
 def lloyd_pass_pallas(
     x: jax.Array,
     centroids: jax.Array,
     *,
     weights: Optional[jax.Array] = None,
+    valid_cols: Optional[jax.Array] = None,
     block_rows: int = 512,
     compute_dtype=None,
     with_update: bool = True,
+    raw_scores: bool = False,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fused assign(+reduce) sweep as a single Pallas kernel.
@@ -159,6 +203,15 @@ def lloyd_pass_pallas(
     Fractional weights: the one-hot tile is cast to ``compute_dtype`` for the
     MXU, so non-binary weights need ``compute_dtype=float32`` for exactness —
     the auto dispatcher enforces this.
+
+    Sharded-caller hooks (the TP/FP engine bodies, VERDICT round-1 item 4):
+
+    * ``valid_cols`` — optional (k,) bool; False columns are masked to +inf
+      before the argmin, so a k-sliced caller can exclude padded centroid
+      slots that belong past the real k.
+    * ``raw_scores`` — return ``min_k(||c||² - 2x·c)`` (no row norm, no
+      clamp) in the ``min_d2`` slot, for exact cross-shard tie-breaking.
+      The ``inertia`` output is meaningless in this mode.
     """
     n, d = x.shape
     k = centroids.shape[0]
@@ -179,6 +232,8 @@ def lloyd_pass_pallas(
 
     c_t = centroids.astype(cd).T                   # (d, k)
     c_sq = sq_norms(centroids)                     # (k,) f32
+    if valid_cols is not None:
+        c_sq = jnp.where(valid_cols, c_sq, jnp.inf)
     if k_pad != k:
         c_t = jnp.concatenate([c_t, jnp.zeros((d, k_pad - k), cd)], axis=1)
         c_sq = jnp.concatenate(
@@ -186,7 +241,8 @@ def lloyd_pass_pallas(
         )
 
     grid = (n_chunks,)
-    kernel = functools.partial(_kernel, cd=cd, with_update=with_update)
+    kernel = functools.partial(_kernel, cd=cd, with_update=with_update,
+                               raw_scores=raw_scores)
     labels, min_d2, sums, counts = pl.pallas_call(
         kernel,
         grid=grid,
@@ -216,7 +272,7 @@ def lloyd_pass_pallas(
         # larger program, e.g. the whole-fit while_loop) is below the budget
         # this kernel is gated on; raise it to budget + headroom explicitly.
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_BUDGET + 8 * 1024 * 1024,
+            vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
         ),
         interpret=interpret,
     )(x, w[:, None], c_t, c_sq[None, :])
@@ -225,3 +281,114 @@ def lloyd_pass_pallas(
     min_d2 = min_d2[:n, 0]
     inertia = jnp.sum(min_d2 * w[:n])
     return labels, min_d2, sums[:k], counts[0, :k], inertia
+
+
+def _acc_kernel(x_ref, w_ref, lab_ref, g_ref,
+                sums_ref, counts_ref, mind_ref, *, cd):
+    """One row tile of the labeled-accumulation sweep: one-hot from the
+    *provided* labels, update matmul on the MXU, row norms on the VPU."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    xb = x_ref[:]                                  # (T, d)
+    xb_c = xb.astype(cd)
+    w = w_ref[:][:, 0]                             # (T,) f32
+    lab = lab_ref[:][:, 0]                         # (T,) int32, rel or sentinel
+    g = g_ref[:][:, 0]                             # (T,) f32 raw scores
+    t = xb.shape[0]
+    k_pad = sums_ref.shape[0]
+
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, k_pad), 1)
+    # Sentinel labels (rows won by another shard) match no column.
+    _fold_tile(sums_ref, counts_ref, lab, w, xb_c, cols, cd=cd)
+    mind_ref[:] = jnp.maximum(g + _row_sq(xb), 0.0)[:, None]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "block_rows", "compute_dtype", "interpret"),
+)
+def accumulate_pallas(
+    x: jax.Array,
+    labels: jax.Array,
+    k: int,
+    *,
+    scores: Optional[jax.Array] = None,
+    weights: Optional[jax.Array] = None,
+    block_rows: int = 512,
+    compute_dtype=None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused update-reduction for rows whose labels are already known.
+
+    The second sweep of the 3-phase sharded TP pass (score locally → resolve
+    the global argmin with two ``pmin`` collectives → accumulate): given
+    per-row ``labels`` (int32; any value outside ``[0, k)`` acts as a
+    sentinel and contributes nothing — a k-sliced caller passes
+    shard-relative labels, so rows won by another shard drop out here) and
+    optional raw ``scores`` (``min(||c||²-2x·c)`` from the scoring phase),
+    returns ``(sums f32 [k, d], counts f32 [k], min_d2 f32 [n])`` where
+    ``min_d2 = max(scores + ||x||², 0)``, in one HBM read of ``x``.
+
+    Same exactness caveat as :func:`lloyd_pass_pallas`: the one-hot tile is
+    cast to ``compute_dtype``, exact for binary weights or f32 compute.
+    Requires ``d % 128 == 0``.
+    """
+    n, d = x.shape
+    if d % _LANE:
+        raise ValueError(f"pallas accumulate needs d % {_LANE} == 0, got {d}")
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    t = block_rows
+    n_pad = _round_up(max(n, 1), t)
+    k_pad = _round_up(k, _LANE)
+
+    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    g = jnp.zeros((n,), f32) if scores is None else scores.astype(f32)
+    # Out-of-range labels (other shard's rows) -> the k_pad sentinel column,
+    # which the iota comparison can never produce.
+    lab = jnp.where((labels >= 0) & (labels < k), labels, k_pad)
+    lab = lab.astype(jnp.int32)
+    if n_pad != n:
+        x = jnp.concatenate([x, jnp.zeros((n_pad - n, d), x.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((n_pad - n,), f32)])
+        g = jnp.concatenate([g, jnp.zeros((n_pad - n,), f32)])
+        lab = jnp.concatenate(
+            [lab, jnp.full((n_pad - n,), k_pad, jnp.int32)]
+        )
+    n_chunks = n_pad // t
+
+    kernel = functools.partial(_acc_kernel, cd=cd)
+    sums, counts, mind = pl.pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_pad, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d), f32),
+            jax.ShapeDtypeStruct((1, k_pad), f32),
+            jax.ShapeDtypeStruct((n_pad, 1), f32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(x, w[:, None], lab[:, None], g[:, None])
+
+    return sums[:k], counts[0, :k], mind[:n, 0]

@@ -1,0 +1,139 @@
+"""Fused-kernel (Pallas) sharded bodies vs the single-device engine.
+
+Round 1 pinned the TP/FP shard bodies to XLA ("no Pallas variant",
+engine.py); these tests cover the round-2 kernel bodies — the 3-phase TP
+pass (score → two pmins → labeled accumulation) and the Ulysses-style FP
+pass (all_to_all axis swap + fused DP kernel) — in interpreter mode on the
+8-device CPU mesh (VERDICT.md round-1 item 4).  The compiled Mosaic lowering
+of the same kernels is exercised on the real chip by ``bench.py``.
+
+Same invariant as tests/test_parallel.py: labels match the single-device
+engine EXACTLY (tie-break preserved) across mesh shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models import fit_lloyd
+from kmeans_tpu.parallel import cpu_mesh, fit_lloyd_sharded
+from kmeans_tpu.parallel.engine import _resolve_sharded_backend
+
+
+@pytest.fixture(scope="module")
+def problem():
+    # d=128: the kernel's lane-alignment requirement.
+    rng = np.random.default_rng(0)
+    k, n, d = 5, 257, 128
+    centers = rng.uniform(-10, 10, size=(k, d)).astype(np.float32)
+    lab = rng.integers(0, k, size=(n,))
+    x = (centers[lab] + 0.5 * rng.normal(size=(n, d))).astype(np.float32)
+    return x, x[:k].copy()
+
+
+def _single(problem, **kw):
+    x, c0 = problem
+    return fit_lloyd(jnp.asarray(x), 5, init=jnp.asarray(c0), tol=1e-10,
+                     max_iter=10, **kw)
+
+
+def _cfg(**kw):
+    return KMeansConfig(k=5, backend="pallas_interpret", tol=1e-10,
+                        max_iter=10, **kw)
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+def test_pallas_tp_matches_single_device(problem, cpu_devices, shape):
+    x, c0 = problem
+    want = _single(problem)
+    mesh = cpu_mesh(shape)
+    # k=5 divides neither 2 nor 4: exercises valid_cols masking of the
+    # padded k-slots.
+    got = fit_lloyd_sharded(
+        x, 5, mesh=mesh, init=c0, config=_cfg(), model_axis="model"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+def test_pallas_fp_matches_single_device(problem, cpu_devices, shape):
+    x, c0 = problem
+    want = _single(problem)
+    mesh = cpu_mesh(shape, ("data", "feature"))
+    got = fit_lloyd_sharded(
+        x, 5, mesh=mesh, init=c0, config=_cfg(), feature_axis="feature"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_pallas_dp_matches_single_device(problem, cpu_devices):
+    x, c0 = problem
+    want = _single(problem)
+    got = fit_lloyd_sharded(
+        x, 5, mesh=cpu_mesh((8, 1)), init=c0, config=_cfg()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+
+
+def test_pallas_fp_farthest_reseed_matches_single_device(cpu_devices):
+    # Force empties: k=4 but only 2 real blobs, far-apart init.
+    rng = np.random.default_rng(3)
+    centers = rng.uniform(-10, 10, size=(2, 128)).astype(np.float32)
+    lab = rng.integers(0, 2, size=(200,))
+    x = (centers[lab] + 0.3 * rng.normal(size=(200, 128))).astype(np.float32)
+    c0 = np.concatenate([centers, centers + 40.0]).astype(np.float32)
+
+    cfg = KMeansConfig(k=4, backend="pallas_interpret", empty="farthest",
+                       tol=1e-10, max_iter=8)
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0),
+                     config=KMeansConfig(k=4, empty="farthest", tol=1e-10,
+                                         max_iter=8))
+    got = fit_lloyd_sharded(
+        x, 4, mesh=cpu_mesh((2, 4), ("data", "feature")), init=c0,
+        config=cfg, feature_axis="feature",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_resolve_sharded_backend_gates():
+    # auto on CPU -> xla even when shapes are kernel-friendly.
+    assert _resolve_sharded_backend(
+        "auto", "cpu", d=128, k_slice=4, x_itemsize=4, compute_dtype=None
+    ) == "xla"
+    # auto on TPU with lane-aligned d and small slice -> pallas.
+    assert _resolve_sharded_backend(
+        "auto", "tpu", d=128, k_slice=4, x_itemsize=4, compute_dtype=None
+    ) == "pallas"
+    # misaligned d -> xla on auto, error when forced.
+    assert _resolve_sharded_backend(
+        "auto", "tpu", d=100, k_slice=4, x_itemsize=4, compute_dtype=None
+    ) == "xla"
+    with pytest.raises(ValueError, match="pallas backend unsupported"):
+        _resolve_sharded_backend(
+            "pallas", "tpu", d=100, k_slice=4, x_itemsize=4,
+            compute_dtype=None,
+        )

@@ -104,14 +104,12 @@ class LloydRunner:
 
             from jax.sharding import NamedSharding, PartitionSpec as P
             from kmeans_tpu.parallel.engine import (
-                _dp_local_pass, _pad_rows, _tp_local_pass,
+                _dp_local_pass,
+                _make_tp_local,
+                _pad_rows,
+                _resolve_sharded_backend,
             )
 
-            if self.cfg.empty == "farthest" and model_axis is not None:
-                raise NotImplementedError(
-                    "empty='farthest' is not supported on DP×TP meshes yet "
-                    "(matches fit_lloyd_sharded); use a DP-only mesh"
-                )
             axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
             xp, w_host, self._n = _pad_rows(np.asarray(x), axis_sizes[data_axis])
             self.x = jax.device_put(xp, NamedSharding(mesh, P(data_axis)))
@@ -141,14 +139,19 @@ class LloydRunner:
                         f"(k={k}, model={axis_sizes[model_axis]}); use "
                         "fit_lloyd_sharded for automatic k padding"
                     )
-                # No Pallas variant of the TP local pass yet — XLA only.
-                self._backend = "xla"
-                local = functools.partial(
-                    _tp_local_pass, data_axis=data_axis,
+                self._backend = _resolve_sharded_backend(
+                    self.cfg.backend, mesh.devices.flat[0].platform,
+                    d=xp.shape[1], k_slice=k // axis_sizes[model_axis],
+                    x_itemsize=np.dtype(xp.dtype).itemsize,
+                    compute_dtype=self.cfg.compute_dtype,
+                )
+                local = _make_tp_local(
+                    self._backend, data_axis=data_axis,
                     model_axis=model_axis, k_real=k,
                     chunk_size=self.cfg.chunk_size,
                     compute_dtype=self.cfg.compute_dtype,
                     update=self.cfg.update, with_labels=False,
+                    empty=self.cfg.empty,
                 )
                 in_specs = (P(data_axis), P(model_axis), P(data_axis))
                 out_specs = (P(model_axis), P(), P(model_axis))

@@ -171,15 +171,54 @@ def test_dp_empty_farthest_mesh_shape_independent(cpu_devices):
     np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
 
 
-def test_tp_empty_farthest_raises(cpu_devices):
+def _farthest_problem():
+    """k=4 with only 2 real blobs and far-away init: forces empty slots."""
+    rng = np.random.default_rng(3)
+    centers = rng.uniform(-10, 10, size=(2, 16)).astype(np.float32)
+    lab = rng.integers(0, 2, size=(200,))
+    x = (centers[lab] + 0.3 * rng.normal(size=(200, 16))).astype(np.float32)
+    c0 = np.concatenate([centers, centers + 40.0]).astype(np.float32)
+    return x, c0
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+def test_tp_empty_farthest_matches_single_device(cpu_devices, shape):
     from kmeans_tpu.config import KMeansConfig
 
-    x = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
-    with pytest.raises(NotImplementedError):
-        fit_lloyd_sharded(
-            x, 4, mesh=cpu_mesh((4, 2)), model_axis="model",
-            config=KMeansConfig(k=4, empty="farthest"),
-        )
+    x, c0 = _farthest_problem()
+    cfg = KMeansConfig(k=4, empty="farthest", tol=1e-10, max_iter=8)
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0), config=cfg)
+    got = fit_lloyd_sharded(
+        x, 4, mesh=cpu_mesh(shape), model_axis="model", init=c0, config=cfg
+    )
+    # k=4 on model=2 divides evenly; on model=4 every slice owns one slot.
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_tp_empty_farthest_with_k_padding(cpu_devices):
+    """k=5 on a model axis of 4: the padded slot must never be reseeded."""
+    from kmeans_tpu.config import KMeansConfig
+
+    x, c0 = _farthest_problem()
+    c0 = np.concatenate([c0, c0[:1] + 80.0])          # 5th far-away slot
+    cfg = KMeansConfig(k=5, empty="farthest", tol=1e-10, max_iter=8)
+    want = fit_lloyd(jnp.asarray(x), 5, init=jnp.asarray(c0), config=cfg)
+    got = fit_lloyd_sharded(
+        x, 5, mesh=cpu_mesh((2, 4)), model_axis="model", init=c0, config=cfg
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
 
 
 def test_dp_empty_farthest_small_shards(cpu_devices):

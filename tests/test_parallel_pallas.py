@@ -119,6 +119,31 @@ def test_pallas_fp_farthest_reseed_matches_single_device(cpu_devices):
     )
 
 
+def test_pallas_tp_farthest_reseed_matches_single_device(cpu_devices):
+    rng = np.random.default_rng(3)
+    centers = rng.uniform(-10, 10, size=(2, 128)).astype(np.float32)
+    lab = rng.integers(0, 2, size=(200,))
+    x = (centers[lab] + 0.3 * rng.normal(size=(200, 128))).astype(np.float32)
+    c0 = np.concatenate([centers, centers + 40.0]).astype(np.float32)
+
+    cfg = KMeansConfig(k=4, backend="pallas_interpret", empty="farthest",
+                       tol=1e-10, max_iter=8)
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0),
+                     config=KMeansConfig(k=4, empty="farthest", tol=1e-10,
+                                         max_iter=8))
+    got = fit_lloyd_sharded(
+        x, 4, mesh=cpu_mesh((2, 4)), init=c0, config=cfg,
+        model_axis="model",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
 def test_resolve_sharded_backend_gates():
     # auto on CPU -> xla even when shapes are kernel-friendly.
     assert _resolve_sharded_backend(

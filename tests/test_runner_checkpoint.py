@@ -1,6 +1,7 @@
 """LloydRunner observability + checkpoint/resume (SURVEY.md §5.1, §5.4)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -101,6 +102,36 @@ def test_runner_on_mesh_matches_single(blobs, cpu_devices):
     want = fit_lloyd(blobs, 4, init=blobs[:4], max_iter=15, tol=1e-10)
     np.testing.assert_array_equal(
         np.asarray(state.labels), np.asarray(want.labels)
+    )
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas_interpret"])
+def test_runner_tp_farthest_matches_single(cpu_devices, backend):
+    """The runner's TP branch with empty='farthest' and both backends —
+    the wiring shared with fit_lloyd_sharded via _make_tp_local."""
+    from kmeans_tpu.parallel import cpu_mesh
+
+    rng = np.random.default_rng(3)
+    centers = rng.uniform(-10, 10, size=(2, 128)).astype(np.float32)
+    lab = rng.integers(0, 2, size=(200,))
+    x = (centers[lab] + 0.3 * rng.normal(size=(200, 128))).astype(np.float32)
+    c0 = np.concatenate([centers, centers + 40.0]).astype(np.float32)
+
+    cfg = KMeansConfig(k=4, empty="farthest", backend=backend)
+    r = LloydRunner(x, 4, mesh=cpu_mesh((4, 2)), model_axis="model",
+                    config=cfg)
+    r.init(c0)
+    state = r.run(max_iter=8, tol=1e-10)
+    want = fit_lloyd(
+        jnp.asarray(x), 4, init=jnp.asarray(c0),
+        config=KMeansConfig(k=4, empty="farthest", tol=1e-10, max_iter=8),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(state.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
     )
 
 

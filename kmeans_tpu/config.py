@@ -163,6 +163,11 @@ class ServeConfig:
     #: Server-wide bound on concurrent `train` worker threads (the per-room
     #: train_lock alone would let many rooms stack unbounded jobs).
     max_concurrent_train: int = 2
+    #: Request-body byte cap for /api/import (and the general POST body
+    #: guard): one unauthenticated POST must not be able to stuff an
+    #: unbounded board into memory — metrics snapshots are O(n²) per
+    #: cluster, so card count is bounded by max_render_cards on import too.
+    max_import_bytes: int = 4 * 1024 * 1024
 
 
 @dataclasses.dataclass(frozen=True)

@@ -98,6 +98,10 @@ class RoomTableFullError(RuntimeError):
     pass
 
 
+class PayloadTooLargeError(ValueError):
+    """Request body (or imported board) exceeds a configured cap -> 413."""
+
+
 class _Room:
     def __init__(self, code: str):
         self.code = code
@@ -331,10 +335,20 @@ class KMeansServer:
             raise ValueError(f"unknown train init {init!r}")
         if n < k or n < 1 or d < 1 or k < 1:
             raise ValueError("invalid train shape")
-        if model == "kmedoids" and n > _KMEDOIDS_MAX_N:
-            raise ValueError(
-                f"kmedoids is O(n²); n must be <= {_KMEDOIDS_MAX_N} here"
-            )
+        if model == "kmedoids":
+            if n > _KMEDOIDS_MAX_N:
+                raise ValueError(
+                    f"kmedoids is O(n²); n must be <= {_KMEDOIDS_MAX_N} here"
+                )
+            # Bound the actual work, not just n: the medoid update is
+            # O(n²·d·max_iter), so a flat n cap still admits ~260x the
+            # worst case the n·d gate below was sized for (advisor r1).
+            # 8e10 equals the other families' worst-case work units
+            # (n·d=8e6 × k=100 × max_iter=100).
+            if n * n * d * max_iter > 8e10:
+                raise ValueError(
+                    "kmedoids work too large: n²·d·max_iter must be <= 8e10"
+                )
         # Bound the data volume a single unauthenticated request can demand
         # (the endpoint exists for the teaching-game scale, n=500 d=2 k=3).
         if n * d > 8_000_000:
@@ -454,9 +468,23 @@ class KMeansServer:
                     urllib.parse.urlparse(self.path).query
                 ))
 
-            def _body(self):
+            def _read_bounded(self):
+                """Read the request body, 413 via PayloadTooLarge when it
+                exceeds the configured cap (the train ops are carefully
+                bounded server-side; the body itself must be too)."""
                 length = int(self.headers.get("Content-Length") or 0)
-                raw = self.rfile.read(length) if length else b""
+                if length < 0:
+                    # read(-1) would read to EOF — an unbounded stream.
+                    raise ValueError("invalid Content-Length")
+                if length > server.config.max_import_bytes:
+                    raise PayloadTooLargeError(
+                        f"request body {length} bytes exceeds the "
+                        f"{server.config.max_import_bytes}-byte cap"
+                    )
+                return self.rfile.read(length) if length else b""
+
+            def _body(self):
+                raw = self._read_bounded()
                 if not raw:
                     return {}
                 return json.loads(raw)
@@ -558,11 +586,30 @@ class KMeansServer:
                         return self._json({"roster": room.roster()})
                     if path == "/api/import":
                         room = server.room(q.get("room"))
-                        import_json(room.doc, self.rfile.read(
-                            int(self.headers.get("Content-Length") or 0)
-                        ))
+                        raw = self._read_bounded()
+                        try:
+                            obj = json.loads(raw or b"{}")
+                        except json.JSONDecodeError as e:
+                            raise ValueError(f"Import failed: {e}") from e
+                        # Non-dict top level falls through to import_json's
+                        # clean "must be an object" ValueError -> 400.
+                        cards = (obj.get("cards") or []
+                                 if isinstance(obj, dict) else [])
+                        if (isinstance(cards, list)
+                                and len(cards) > server.config.max_render_cards):
+                            raise PayloadTooLargeError(
+                                f"import has {len(cards)} cards; the board "
+                                f"cap is {server.config.max_render_cards}"
+                            )
+                        import_json(room.doc, obj)
                         return self._json({"ok": True})
                     self._error("not found", HTTPStatus.NOT_FOUND)
+                except PayloadTooLargeError as e:
+                    # The body is deliberately left unread: drop the
+                    # connection after responding rather than draining an
+                    # attacker-sized stream to keep it alive.
+                    self.close_connection = True
+                    self._error(e, HTTPStatus.REQUEST_ENTITY_TOO_LARGE)
                 except CentroidLimitError as e:
                     self._error(str(e), HTTPStatus.CONFLICT)
                 except RoomTableFullError as e:

@@ -35,23 +35,53 @@ const initials = (n) => {
 
 // ---------- server API ----------
 const api = (path) => `${path}?room=${encodeURIComponent(room)}`;
+const LS_STATE = `icekmeans:state:${room}`;
 let state = null;
 let peers = 0;
+// Degraded/solo mode (reference parity: the P2P app keeps a usable board
+// when every tracker is down — app.mjs initP2P's try/catch). Here: when the
+// server is unreachable, the last-known board renders read-only from a
+// localStorage cache and recovers on SSE reconnect.
+let degraded = false;
 
 async function fetchState() {
-  const r = await fetch(api("/api/state"));
-  state = await r.json();
+  try {
+    const r = await fetch(api("/api/state"));
+    if (!r.ok) { renderAll(); return; }   // server up but erroring: keep
+    state = await r.json();               // the last good board + cache
+    degraded = false;
+    try { localStorage.setItem(LS_STATE, JSON.stringify(state)); } catch {}
+  } catch {
+    if (!state) {
+      try { state = JSON.parse(localStorage.getItem(LS_STATE)); } catch {}
+    }
+    degraded = true;
+  }
   renderAll();
 }
 async function mutate(op, args = {}) {
-  const r = await fetch(api("/api/mutate"), {
-    method: "POST",
-    headers: { "Content-Type": "application/json" },
-    body: JSON.stringify({ op, args }),
-  });
+  if (degraded) {
+    alert("Server unreachable — showing the cached board read-only.");
+    return null;
+  }
+  let r;
+  try {
+    r = await fetch(api("/api/mutate"), {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ op, args }),
+    });
+  } catch {
+    degraded = true;
+    renderAll();
+    return null;
+  }
   const out = await r.json();
   if (!r.ok) { alert(out.error || "Request failed"); return null; }
-  await fetchState();
+  // The versioned SSE "change" event triggers exactly one state fetch per
+  // version bump — but only while the stream is open; during a reconnect
+  // window a successful mutation must still render.
+  if (!es || es.readyState !== EventSource.OPEN) fetchState();
   return out;
 }
 async function hello() {
@@ -62,11 +92,19 @@ async function hello() {
   });
 }
 
+let es = null;
+
 function connectEvents() {
-  const es = new EventSource(api("/api/events"));
+  es = new EventSource(api("/api/events"));
   es.onmessage = (ev) => {
     const msg = JSON.parse(ev.data);
     if (typeof msg.peers === "number") { peers = msg.peers; setStatusChip(); }
+    if (msg.type === "hello") {
+      // (Re)connected: replay presence and resync if the server's version
+      // moved while we were away (or the server restarted).
+      hello().catch(() => {});
+      if (degraded || !state || msg.version !== state.version) fetchState();
+    }
     if (msg.type === "change" && (!state || msg.version !== state.version)) fetchState();
     if (msg.type === "train" || msg.type === "train_done" || msg.type === "train_error") {
       const t = $id("trainStatus");
@@ -81,16 +119,24 @@ function connectEvents() {
       else t.textContent = `train failed: ${msg.error}`;
     }
   };
-  es.onerror = () => { setStatusChip(true); };
+  es.onerror = () => {
+    // EventSource auto-reconnects; meanwhile flip to the cached read-only
+    // board so the room stays usable (fetchState flips degraded on/off by
+    // actually probing the server).
+    fetchState();
+    setStatusChip(true);
+  };
   return es;
 }
 
 // ---------- status / presence ----------
 function setStatusChip(err) {
   const s = $id("status");
-  s.textContent = err ? "reconnecting…" : `Peers: ${peers} | Server: 1/1`;
-  s.classList.toggle("ok", !err && peers > 0);
-  s.classList.toggle("warn", !!err || peers === 0);
+  s.textContent = degraded
+    ? "offline — cached board (read-only)"
+    : err ? "reconnecting…" : `Peers: ${peers} | Server: 1/1`;
+  s.classList.toggle("ok", !degraded && !err && peers > 0);
+  s.classList.toggle("warn", degraded || !!err || peers === 0);
 }
 function renderPresence() {
   const box = $id("presence");
@@ -109,7 +155,8 @@ function renderPresence() {
 const dragCtx = { id: null, dx: 0, dy: 0 };
 
 function renderAll() {
-  if (!state) return;
+  document.body.classList.toggle("degraded", degraded);
+  if (!state) { setStatusChip(); return; }
   setStatusChip();
   renderPresence();
   renderCanvas();
@@ -379,7 +426,7 @@ $id("tpuTrain").addEventListener("click", () =>
 $id("saveName").addEventListener("click", () => {
   myName = $id("name").value.trim() || myName;
   localStorage.setItem(LS_NAME, myName);
-  hello().then(fetchState);
+  hello().then(fetchState).catch(() => {});
 });
 $id("mode").addEventListener("change", () =>
   mutate("setMode", { mode: $id("mode").value }));
@@ -404,6 +451,9 @@ $id("reset").addEventListener("click", () => {
 });
 
 // ---------- boot ----------
-hello().then(fetchState);
+(async () => {
+  try { await hello(); } catch {}   // server may be down: boot from cache
+  await fetchState();
+})();
 connectEvents();
-setInterval(hello, 10_000);
+setInterval(() => hello().catch(() => {}), 10_000);

@@ -123,6 +123,82 @@ def test_export_import_round_trip(server):
     assert st2["centroids"][0]["name"] == "Zesty"
 
 
+def _post_oversized(server, path, big):
+    """Client that tolerates the server refusing mid-upload (the bounded
+    server answers 413 from the headers alone and closes the connection;
+    a still-sending client sees EPIPE on write but can read the reply)."""
+    import http.client
+
+    host, port = server.base.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    try:
+        conn.request("POST", path, body=big,
+                     headers={"Content-Type": "application/json"})
+    except (BrokenPipeError, ConnectionResetError):
+        pass
+    try:
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def test_import_rejects_oversized_body_with_413(server):
+    """/api/import is bounded like the train ops (VERDICT round-1 item 6):
+    body bytes over the cap -> 413 before anything is read into a board."""
+    big = b'{"cards": [' + b" " * (server.config.max_import_bytes + 1) + b"]}"
+    code, body = _post_oversized(server, "/api/import?room=AAAA", big)
+    assert code == 413
+    assert "cap" in body["error"]
+    # The room is untouched.
+    _, _, raw = _get(server, "/api/state?room=AAAA")
+    assert [c["id"] for c in json.loads(raw)["cards"]] == ["seed:jessica"]
+
+
+def test_import_rejects_too_many_cards_with_413(server):
+    n = server.config.max_render_cards + 1
+    cards = [
+        {"id": f"card:{i}", "title": f"c{i}", "traits": ["a", "b"],
+         "assignedTo": None, "createdBy": "t"}
+        for i in range(n)
+    ]
+    code, body = _post(
+        server, "/api/import?room=AAAA",
+        {"cards": cards, "centroids": [], "meta": {}},
+    )
+    assert code == 413
+    assert str(server.config.max_render_cards) in body["error"]
+
+
+def test_negative_content_length_is_rejected(server):
+    """Content-Length: -1 must not reach read(-1) (unbounded stream)."""
+    import http.client
+
+    host, port = server.base.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    try:
+        conn.putrequest("POST", "/api/mutate?room=AAAA")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", "-1")
+        conn.endheaders()
+        r = conn.getresponse()
+        assert r.status == 400
+    finally:
+        conn.close()
+
+
+def test_import_non_dict_top_level_is_clean_400(server):
+    code, body = _post(server, "/api/import?room=AAAA", raw=b"[1, 2]")
+    assert code == 400
+    assert "must be an object" in body["error"]
+
+
+def test_mutate_body_is_bounded_too(server):
+    big = b'{"op": "' + b"x" * (server.config.max_import_bytes + 1) + b'"}'
+    code, _ = _post_oversized(server, "/api/mutate?room=AAAA", big)
+    assert code == 413
+
+
 def test_presence_hello_roster(server):
     room = "HHHH"
     _post(server, f"/api/hello?room={room}", {"name": "Ada"})
@@ -382,3 +458,14 @@ def test_train_op_kmedoids_n_cap(server):
     st, _ = _mutate(server, "RRRR", "train",
                     {"n": 50_000, "k": 3, "model": "kmedoids"})
     assert st == 400
+
+
+def test_train_op_kmedoids_work_cap(server):
+    """n under the flat cap but n²·d·max_iter over the work budget: the
+    O(n²·d) medoid update must be bounded by actual work (advisor r1)."""
+    st, body = _mutate(
+        server, "RRRR", "train",
+        {"n": 20_000, "d": 400, "k": 3, "max_iter": 100, "model": "kmedoids"},
+    )
+    assert st == 400
+    assert "work too large" in body["error"]

@@ -83,9 +83,36 @@ def _cmd_train(args) -> int:
             jax.random.key(args.seed), n, d, k, cluster_std=args.cluster_std
         )
 
+    # --max-iter governs the Lloyd-family loop; the minibatch/stream path is
+    # step-based.  Flags that would be silently ignored are rejected instead
+    # (matching the CLI's other contradictory-flag guards; advisor r1).
+    if minibatch and args.max_iter is not None:
+        print("error: --max-iter has no effect with --model minibatch/"
+              "--stream (step-based); use --steps/--batch-size",
+              file=sys.stderr)
+        return 2
+    if not minibatch and (args.steps is not None
+                          or args.batch_size is not None):
+        print(f"error: --steps/--batch-size are minibatch/stream flags; "
+              f"--model {model} runs to --max-iter/--tol", file=sys.stderr)
+        return 2
+
+    if args.steps is not None and args.steps < 1:
+        print("error: --steps must be positive", file=sys.stderr)
+        return 2
+    if args.batch_size is not None and args.batch_size < 1:
+        print("error: --batch-size must be positive", file=sys.stderr)
+        return 2
+
+    cfg_kw = {}
+    if args.steps is not None:
+        cfg_kw["steps"] = args.steps
+    if args.batch_size is not None:
+        cfg_kw["batch_size"] = args.batch_size
     kcfg = KMeansConfig(
-        k=k, init=args.init, max_iter=args.max_iter, tol=args.tol,
-        seed=args.seed, compute_dtype=args.dtype,
+        k=k, init=args.init,
+        max_iter=args.max_iter if args.max_iter is not None else 100,
+        tol=args.tol, seed=args.seed, compute_dtype=args.dtype, **cfg_kw,
     )
 
     mesh = None
@@ -272,7 +299,13 @@ def main(argv=None) -> int:
                    choices=["k-means++", "k-means||", "random"])
     t.add_argument("--mesh", type=int, default=0,
                    help="data-parallel mesh size (0/1 = single device)")
-    t.add_argument("--max-iter", type=int, default=100)
+    t.add_argument("--max-iter", type=int, default=None,
+                   help="Lloyd-family iteration cap (default 100); the "
+                        "minibatch/stream path is step-based — use --steps")
+    t.add_argument("--steps", type=int, default=None,
+                   help="minibatch/stream SGD steps (default 200)")
+    t.add_argument("--batch-size", type=int, default=None,
+                   help="minibatch/stream batch size (default 8192)")
     t.add_argument("--tol", type=float, default=1e-4)
     t.add_argument("--seed", type=int, default=0)
     t.add_argument("--dtype", default=None,

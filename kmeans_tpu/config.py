@@ -126,6 +126,10 @@ class KMeansConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.chunk_size < 1:
             raise ValueError("chunk_size must be positive")
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be positive")
+        if self.steps < 1:
+            raise ValueError("steps must be positive")
         return self
 
 

@@ -111,9 +111,14 @@ def fit_bisecting(
             jnp.sum(wb > 0))
         n_splits += 1
 
-    if n_splits < k - 1:  # degenerate early stop: fill unused slots
-        used = jnp.arange(k) <= n_splits
-        centroids = jnp.where(used[:, None], centroids, centroids[0])
+    # Zero-count slots — never-used (early stop) OR consumed by a split
+    # whose second child came out empty — hold stale locations no label
+    # points to, yet nearest-centroid predict() could still select them.
+    # Overwrite all of them with centroid 0 (ties resolve to the lower
+    # index, so the duplicates are unreachable).  Keyed on counts, not
+    # n_splits, so failed splits are covered too (advisor r1).
+    stale = (counts <= 0) & (jnp.arange(k) > 0)
+    centroids = jnp.where(stale[:, None], centroids[0], centroids)
 
     return KMeansState(
         centroids=centroids,

@@ -208,6 +208,13 @@ def fit_kmedoids(
             )
     else:
         method = init if isinstance(init, str) else cfg.init
+        if method == "given":
+            # config said 'given' but no index array arrived — silently
+            # falling into the ++-style branch would ignore the caller's
+            # stated intent (mirrors fit_bisecting's guard; advisor r1).
+            raise ValueError(
+                "init='given' requires an explicit medoid index array"
+            )
         if method == "random":
             idx0 = jax.random.choice(key, n, shape=(k,), replace=False
                                      ).astype(jnp.int32)

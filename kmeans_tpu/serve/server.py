@@ -557,7 +557,10 @@ class KMeansServer:
                         try:
                             ev = q.get(timeout=15.0)
                         except queue.Empty:
+                            # version rides the ping so a change event
+                            # dropped on a full queue self-heals client-side.
                             ev = {"type": "ping",
+                                  "version": room.doc.version,
                                   "peers": max(0, room.peer_count() - 1)}
                         self.wfile.write(
                             f"data: {json.dumps(ev)}\n\n".encode()
@@ -586,11 +589,9 @@ class KMeansServer:
                         return self._json({"roster": room.roster()})
                     if path == "/api/import":
                         room = server.room(q.get("room"))
-                        raw = self._read_bounded()
-                        try:
-                            obj = json.loads(raw or b"{}")
-                        except json.JSONDecodeError as e:
-                            raise ValueError(f"Import failed: {e}") from e
+                        from kmeans_tpu.session.schema import parse_import
+
+                        obj = parse_import(self._read_bounded() or b"{}")
                         # Non-dict top level falls through to import_json's
                         # clean "must be an object" ValueError -> 400.
                         cards = (obj.get("cards") or []

@@ -72,8 +72,9 @@ async function mutate(op, args = {}) {
       body: JSON.stringify({ op, args }),
     });
   } catch {
-    degraded = true;
-    renderAll();
+    // Probe the server rather than assuming it's down: fetchState is the
+    // one place degraded flips on/off, so a transient blip self-heals.
+    fetchState();
     return null;
   }
   const out = await r.json();
@@ -105,7 +106,11 @@ function connectEvents() {
       hello().catch(() => {});
       if (degraded || !state || msg.version !== state.version) fetchState();
     }
-    if (msg.type === "change" && (!state || msg.version !== state.version)) fetchState();
+    // change events AND pings carry the version: a change event dropped on
+    // a full server queue self-heals at the next 15s ping.
+    if ((msg.type === "change" || msg.type === "ping")
+        && typeof msg.version === "number"
+        && (!state || msg.version !== state.version)) fetchState();
     if (msg.type === "train" || msg.type === "train_done" || msg.type === "train_error") {
       const t = $id("trainStatus");
       t.style.display = "";

@@ -23,7 +23,22 @@ from typing import Any, Optional
 from kmeans_tpu.session.document import Document
 from kmeans_tpu.session.seeds import dedupe_seeds
 
-__all__ = ["export_json", "export_filename", "import_json", "to_plain"]
+__all__ = [
+    "export_json", "export_filename", "import_json", "parse_import",
+    "to_plain",
+]
+
+
+def parse_import(text_or_obj):
+    """Decode an import payload to its parsed object (the one place the
+    reference's "Import failed" JSON-decode wrapping lives — the HTTP
+    handler reuses it to pre-check the card cap before importing)."""
+    if isinstance(text_or_obj, (str, bytes)):
+        try:
+            return json.loads(text_or_obj)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"Import failed: {e}") from e
+    return text_or_obj
 
 
 def export_filename(room: str) -> str:
@@ -115,13 +130,7 @@ def import_json(doc: Document, text_or_obj) -> None:
     Accepts a JSON string or an already-parsed object.  Malformed input
     raises ``ValueError`` (the reference alerts "Import failed").
     """
-    if isinstance(text_or_obj, (str, bytes)):
-        try:
-            obj = json.loads(text_or_obj)
-        except json.JSONDecodeError as e:
-            raise ValueError(f"Import failed: {e}") from e
-    else:
-        obj = text_or_obj
+    obj = parse_import(text_or_obj)
     if not isinstance(obj, dict):
         raise ValueError("Import failed: top-level JSON must be an object")
 

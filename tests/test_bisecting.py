@@ -122,3 +122,23 @@ def test_bisecting_honors_init_method_and_rejects_given():
     assert bool(st.converged)
     with pytest.raises(ValueError, match="given"):
         fit_bisecting(x, 4, config=KMeansConfig(k=4, init="given"))
+
+
+def test_bisecting_zero_count_slots_duplicate_centroid_zero():
+    """Failed splits (identical-point clusters can't bisect) and early
+    stops must not leave stale predict-reachable centroids: every
+    zero-count slot duplicates centroid 0 exactly (advisor r1)."""
+    x = jnp.asarray(np.array(
+        [[0.0, 0.0]] * 3 + [[10.0, 10.0]] * 2, dtype=np.float32))
+    st = fit_bisecting(x, 4, key=jax.random.key(0),
+                       strategy="largest_cluster")
+    counts = np.asarray(st.counts)
+    cents = np.asarray(st.centroids)
+    assert counts.sum() == 5
+    for i in np.flatnonzero(counts <= 0):
+        np.testing.assert_array_equal(cents[i], cents[0])
+    # predict never selects a zero-count slot (lower-index tie wins).
+    est = BisectingKMeans(n_clusters=4, strategy="largest_cluster", seed=0)
+    est.state = st
+    pred = np.asarray(est.predict(x))
+    assert set(pred.tolist()) <= set(np.flatnonzero(counts > 0).tolist())

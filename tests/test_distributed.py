@@ -1,0 +1,60 @@
+"""Two-process DCN smoke test (VERDICT round-1 item 7).
+
+Round 1 left ``parallel/distributed.py`` as the one untested subsystem.
+This spawns TWO real OS processes that join a ``jax.distributed``
+coordinator on localhost (CPU backend, 4 virtual devices each) and run a
+full sharded fit over the joint 8-device mesh — exercising
+``ensure_initialized`` + the engine across a process boundary, the way the
+reference's join flow connects browsers (/root/reference/app.mjs:70-118).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "dcn_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dcn_fit():
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coord, "2", str(pid)],
+            cwd=_REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"DCN_OK pid={pid} procs=2 devices=8" in out, out
+
+
+def test_ensure_initialized_noop_without_config():
+    from kmeans_tpu.parallel.distributed import ensure_initialized
+
+    # No coordinator configured: must be a harmless no-op (and idempotent).
+    ensure_initialized()
+    ensure_initialized()

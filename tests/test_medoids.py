@@ -121,3 +121,13 @@ def test_kmedoids_rejects_out_of_range_init_indices():
     x, _, _ = make_blobs(jax.random.key(10), 50, 2, 2)
     with pytest.raises(ValueError, match="lie in"):
         fit_kmedoids(x, 2, init=jnp.asarray(np.array([0, 999], np.int32)))
+
+
+def test_kmedoids_init_given_without_array_raises():
+    """config init='given' with no index array must error, not silently
+    fall into the ++-style sampling branch (advisor r1)."""
+    from kmeans_tpu.config import KMeansConfig
+
+    x, _, _ = make_blobs(jax.random.key(0), 60, 4, 3, cluster_std=0.3)
+    with pytest.raises(ValueError, match="medoid index array"):
+        fit_kmedoids(x, 3, config=KMeansConfig(k=3, init="given"))

@@ -330,8 +330,15 @@ def main():
         print(json.dumps(converge_line()))
         return
 
-    conv = None if args.iters_only else converge_line()
-    if conv is not None:
+    conv = None
+    if not args.iters_only:
+        try:
+            conv = converge_line()
+        except Exception as e:  # never let the converge half kill the
+            print(f"  converge bench errored: {e}", file=sys.stderr)
+            conv = {"value": None, "vs_baseline": None,  # headline line
+                    "error": f"{type(e).__name__}: {e}"}
+    if conv is not None and conv.get("value") is not None:
         print(json.dumps(conv))
 
     # On-chip kernel correctness (driver-visible): compiled Mosaic kernel
@@ -376,6 +383,8 @@ def main():
         # parse-last-line driver records both metrics in one record.
         line["wallclock_to_converge_s"] = conv["value"]
         line["converge_vs_baseline"] = conv["vs_baseline"]
+        if conv.get("error"):
+            line["converge_error"] = conv["error"]
     if pallas_check is not None:
         line["pallas_vs_xla"] = pallas_check
     print(json.dumps(line))

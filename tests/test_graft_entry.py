@@ -39,6 +39,30 @@ def test_dryrun_multichip_on_cpu_mesh():
     g.dryrun_multichip(8)
 
 
+def test_dryrun_never_initializes_accelerator_plugin():
+    """In a fresh process (the driver's invocation shape), the dryrun must
+    restrict jax to CPU BEFORE any backend initializes — a wedged
+    accelerator runtime can hang forever at client init, which no
+    post-init pinning survives (observed with a dead tunnel relay)."""
+    script = r"""
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+import jax._src.xla_bridge as xb
+platforms = sorted(xb._backends)
+assert platforms == ["cpu"], f"non-cpu backend initialized: {platforms}"
+print("CPU_ONLY_OK")
+"""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "CPU_ONLY_OK" in proc.stdout
+
+
 def test_dryrun_hermetic_with_poisoned_default_backend():
     """dryrun_multichip(8) must succeed when every touch of the default
     backend raises — proving data gen / RNG / reference fit are all pinned

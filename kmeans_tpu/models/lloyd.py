@@ -155,8 +155,48 @@ def best_of_n_init(fit_one, key, n_init, *, score=lambda s: float(s.inertia)):
     return best
 
 
+class NearestCentroidMixin:
+    """``predict``/``transform``/``score`` for any estimator carrying
+    ``state.centroids``, ``chunk_size`` and ``compute_dtype`` — the ONE
+    copy shared by :class:`KMeans` (and its subclasses) and
+    :class:`~kmeans_tpu.models.minibatch.MiniBatchKMeans`."""
+
+    def predict(self, x):
+        from kmeans_tpu.ops.distance import assign
+
+        labels, _ = assign(
+            jnp.asarray(x),
+            self.state.centroids,
+            chunk_size=self.chunk_size,
+            compute_dtype=self.compute_dtype,
+        )
+        return labels
+
+    def transform(self, x):
+        from kmeans_tpu.ops.distance import pairwise_sq_dists
+
+        return jnp.sqrt(
+            pairwise_sq_dists(
+                jnp.asarray(x),
+                self.state.centroids,
+                compute_dtype=self.compute_dtype,
+            )
+        )
+
+    def score(self, x):
+        from kmeans_tpu.ops.distance import assign
+
+        _, mind = assign(
+            jnp.asarray(x),
+            self.state.centroids,
+            chunk_size=self.chunk_size,
+            compute_dtype=self.compute_dtype,
+        )
+        return -float(jnp.sum(mind))
+
+
 @dataclasses.dataclass
-class KMeans:
+class KMeans(NearestCentroidMixin):
     """Estimator-style wrapper (sklearn-like surface) over :func:`fit_lloyd`.
 
     ``n_init`` > 1 runs that many independently-seeded fits and keeps the
@@ -238,36 +278,3 @@ class KMeans:
     @property
     def n_iter_(self):
         return int(self.state.n_iter)
-
-    def predict(self, x):
-        from kmeans_tpu.ops.distance import assign
-
-        labels, _ = assign(
-            jnp.asarray(x),
-            self.state.centroids,
-            chunk_size=self.chunk_size,
-            compute_dtype=self.compute_dtype,
-        )
-        return labels
-
-    def transform(self, x):
-        from kmeans_tpu.ops.distance import pairwise_sq_dists
-
-        return jnp.sqrt(
-            pairwise_sq_dists(
-                jnp.asarray(x),
-                self.state.centroids,
-                compute_dtype=self.compute_dtype,
-            )
-        )
-
-    def score(self, x):
-        from kmeans_tpu.ops.distance import assign
-
-        _, mind = assign(
-            jnp.asarray(x),
-            self.state.centroids,
-            chunk_size=self.chunk_size,
-            compute_dtype=self.compute_dtype,
-        )
-        return -float(jnp.sum(mind))

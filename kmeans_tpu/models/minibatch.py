@@ -23,7 +23,7 @@ from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
-from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.models.lloyd import KMeansState, NearestCentroidMixin
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 
@@ -59,6 +59,11 @@ def batch_update(centroids, n_seen, xb, *, compute_dtype):
     delta = (bs - bc[:, None] * centroids) / jnp.maximum(n_after, 1.0)[:, None]
     step = jnp.where((bc > 0)[:, None], delta, 0.0)
     return centroids + step, n_after, jnp.sum(step ** 2), b_inertia
+
+
+#: Jitted entry for eager per-batch callers (partial_fit); the scan-based
+#: loop below traces the same batch_update inline.
+_batch_update_jit = jax.jit(batch_update, static_argnames=("compute_dtype",))
 
 
 @functools.partial(
@@ -238,7 +243,7 @@ def fit_minibatch(
 
 
 @dataclasses.dataclass
-class MiniBatchKMeans:
+class MiniBatchKMeans(NearestCentroidMixin):
     """Estimator-style wrapper over :func:`fit_minibatch`."""
 
     n_clusters: int = 8
@@ -253,6 +258,13 @@ class MiniBatchKMeans:
     compute_dtype: Optional[str] = None
 
     state: Optional[KMeansState] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    #: Lifetime per-center sample counts driving partial_fit's 1/n rates —
+    #: sklearn's ``_counts``.  Distinct from ``state.counts`` (full-data
+    #: cluster sizes after ``fit``; last-batch lifetime view after
+    #: ``partial_fit``).
+    _n_seen: Optional[jax.Array] = dataclasses.field(
         default=None, repr=False, compare=False
     )
 
@@ -277,6 +289,70 @@ class MiniBatchKMeans:
             ),
             jax.random.key(self.seed),
             1 if init is not None else self.n_init,
+        )
+        return self
+
+    def partial_fit(self, x) -> "MiniBatchKMeans":
+        """One incremental streaming-average update on ONE batch
+        (``sklearn.cluster.MiniBatchKMeans.partial_fit`` semantics).
+
+        The first call seeds the centroids from this batch (the configured
+        init method, or the given array); every later call applies exactly
+        one :func:`batch_update`.  After each call ``labels_``/``inertia_``
+        reflect THIS batch at the post-update centroids (sklearn's
+        convention); use ``predict``/``score`` for whole-dataset views.
+
+        Continuing after ``fit``: the lifetime rates resume from the
+        number of samples the minibatch run actually processed
+        (``steps × batch_size``, apportioned by cluster mass — sklearn's
+        ``_counts``), NOT the full-data cluster sizes, so streaming
+        updates keep their ~1/(samples-seen) step size.
+        """
+        xb = jnp.asarray(x)
+        k = self.n_clusters
+        if self.state is None:
+            if isinstance(self.init, str):
+                c = init_centroids(
+                    jax.random.key(self.seed), xb, k, method=self.init,
+                    compute_dtype=self.compute_dtype,
+                    chunk_size=self.chunk_size,
+                )
+            else:
+                c = jnp.asarray(self.init, jnp.float32)
+                if c.shape != (k, xb.shape[1]):
+                    raise ValueError(
+                        f"init centroids shape {c.shape} != {(k, xb.shape[1])}"
+                    )
+            n_seen = jnp.zeros((k,), jnp.float32)
+            n_steps = 0
+        else:
+            c = self.state.centroids
+            n_steps = int(self.state.n_iter)
+            if self._n_seen is not None:
+                n_seen = self._n_seen
+            else:
+                # First partial_fit after fit(): state.counts are FULL-data
+                # cluster sizes; rescale to the minibatch-stream total so
+                # the 1/n rate doesn't collapse (advisor-reviewed).
+                total = jnp.maximum(jnp.sum(self.state.counts), 1.0)
+                processed = float(n_steps) * float(self.batch_size)
+                n_seen = self.state.counts * (processed / total)
+
+        new_c, n_after, _, _ = _batch_update_jit(
+            c, n_seen, xb, compute_dtype=self.compute_dtype
+        )
+        from kmeans_tpu.ops.distance import assign
+
+        labels, mind = assign(xb, new_c, chunk_size=self.chunk_size,
+                              compute_dtype=self.compute_dtype)
+        self._n_seen = n_after
+        self.state = KMeansState(
+            centroids=new_c,
+            labels=labels,
+            inertia=jnp.sum(mind),
+            n_iter=jnp.asarray(n_steps + 1, jnp.int32),
+            converged=jnp.asarray(False),
+            counts=n_after,
         )
         return self
 

@@ -359,3 +359,74 @@ def test_best_of_n_init_never_keeps_nan_over_finite():
     ])
     best = best_of_n_init(lambda key: next(states), jax.random.key(0), 3)
     assert best.inertia == 5.0
+
+
+def test_minibatch_partial_fit_incremental():
+    """sklearn-style partial_fit: first call seeds from the batch, later
+    calls apply one streaming update each; n_seen accumulates and quality
+    approaches the batched fit on the same data."""
+    import numpy as np
+    from kmeans_tpu.models import MiniBatchKMeans
+
+    rng = np.random.default_rng(0)
+    k, d = 4, 16
+    centers = rng.uniform(-8, 8, size=(k, d)).astype(np.float32)
+    lab = rng.integers(0, k, size=(4096,))
+    x = (centers[lab] + 0.4 * rng.normal(size=(4096, d))).astype(np.float32)
+
+    est = MiniBatchKMeans(n_clusters=k, seed=0)
+    order = rng.permutation(4096)
+    for i in range(16):
+        est.partial_fit(x[order[i * 256:(i + 1) * 256]])
+
+    assert int(est.state.n_iter) == 16
+    assert float(est.state.counts.sum()) == 16 * 256   # lifetime n_seen
+    assert est.labels_.shape == (256,)                 # last batch's labels
+    # Whole-dataset quality: within 2x of the batched fit (same data).
+    batched = MiniBatchKMeans(n_clusters=k, seed=0, steps=16,
+                              batch_size=256).fit(x)
+    assert -est.score(x) < -2.0 * batched.score(x)
+    assert est.predict(x).shape == (4096,)
+    assert est.transform(x[:8]).shape == (8, k)
+
+
+def test_minibatch_partial_fit_given_init_and_bad_shape():
+    import numpy as np
+    from kmeans_tpu.models import MiniBatchKMeans
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    c0 = x[:3].copy()
+    est = MiniBatchKMeans(n_clusters=3, init=jnp.asarray(c0))
+    est.partial_fit(x)
+    assert est.cluster_centers_.shape == (3, 8)
+
+    bad = MiniBatchKMeans(n_clusters=3, init=jnp.zeros((4, 8)))
+    with pytest.raises(ValueError, match="init centroids shape"):
+        bad.partial_fit(x)
+
+
+def test_minibatch_partial_fit_after_fit_keeps_adapting():
+    """Continuation after fit() must resume with minibatch-stream-scale
+    n_seen (sklearn's _counts), not full-data cluster sizes — otherwise
+    the 1/n rate collapses and streaming updates freeze."""
+    import numpy as np
+    from kmeans_tpu.models import MiniBatchKMeans
+
+    rng = np.random.default_rng(2)
+    # Large fit set vs a small stream budget: with the bug (n_seen resumed
+    # from full-data counts, ~50k) the stream's ~10k samples could move a
+    # center at most ~1/6 of the way; resumed from the stream-scale ~1.3k
+    # it travels most of the distance.
+    a = rng.normal(size=(50_000, 8)).astype(np.float32)          # around 0
+    b = (rng.normal(size=(2000, 8)) + 30.0).astype(np.float32)   # around 30
+
+    est = MiniBatchKMeans(n_clusters=2, seed=0, steps=10, batch_size=128)
+    est.fit(a)
+    # Stream pure-B batches: at least one center must migrate to B.
+    for i in range(40):
+        est.partial_fit(b[(i * 50) % 1500:(i * 50) % 1500 + 256])
+    d_to_b = np.linalg.norm(
+        np.asarray(est.cluster_centers_) - 30.0, axis=1
+    ).min()
+    assert d_to_b < 12.0, f"centers never adapted to the new mode: {d_to_b}"

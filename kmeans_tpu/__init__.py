@@ -30,6 +30,8 @@ from kmeans_tpu.models import (
     fit_bisecting,
     fit_fuzzy,
     fit_kmedoids,
+    fit_xmeans,
+    XMeans,
     fit_lloyd,
     fit_lloyd_accelerated,
     fit_minibatch,
@@ -53,6 +55,8 @@ __all__ = [
     "fit_bisecting",
     "fit_fuzzy",
     "fit_kmedoids",
+    "fit_xmeans",
+    "XMeans",
     "fit_lloyd",
     "fit_lloyd_accelerated",
     "fit_minibatch",

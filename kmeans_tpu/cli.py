@@ -186,8 +186,11 @@ def _cmd_train(args) -> int:
             "bisecting": models.fit_bisecting,
             "fuzzy": models.fit_fuzzy,
             "kmedoids": models.fit_kmedoids,
+            "xmeans": models.fit_xmeans,   # --k is k_max; k is discovered
         }[model]
         state = fit(x, k, config=kcfg)
+        if model == "xmeans":
+            k = int(state.centroids.shape[0])
     jax_done = time.perf_counter() - t0
 
     result = {
@@ -293,8 +296,9 @@ def main(argv=None) -> int:
                    "(named configs set it from BASELINE)")
     t.add_argument("--model", default=None, choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "kmedoids",
-    ], help="model family (default: lloyd, or the config's minibatch choice)")
+        "fuzzy", "kmedoids", "xmeans",
+    ], help="model family (default: lloyd, or the config's minibatch "
+            "choice); for xmeans, --k is k_max and k is discovered by BIC")
     t.add_argument("--init", default="k-means++",
                    choices=["k-means++", "k-means||", "random"])
     t.add_argument("--mesh", type=int, default=0,

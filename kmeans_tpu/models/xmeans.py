@@ -1,0 +1,245 @@
+"""X-means: automatic k via BIC-scored splitting (Pelleg & Moore 2000).
+
+The reference leaves choosing k (≤3) to humans (/root/reference/app.mjs:127);
+``sweep_k``/``suggest_k`` already automate that by scoring a sweep.  X-means
+is the *model-based* alternative the north-star scope calls for at scale: it
+grows k only where the data demands it, so there is no k-sweep of full fits.
+
+Algorithm (improve-params / improve-structure alternation):
+
+1. Fit k-means at the current k.
+2. For every cluster, fit a local 2-means and compare the BIC of the
+   1-cluster parent vs the 2-cluster split on that cluster's points alone
+   (spherical-Gaussian MLE likelihood, ``p = K(d+1)`` free parameters).
+3. Accept all BIC-improving splits (until ``k_max``), re-fit globally from
+   the survivor+children centers, repeat until no split is accepted.
+
+TPU-first shape discipline, same trick as :mod:`kmeans_tpu.models.bisecting`
+(its docstring has the rationale): a split never gathers member rows — each
+local 2-means is a *weighted* fit over the full (n, d) array with the
+membership mask folded into the sample weights, so shapes stay static and
+every split reuses the same compiled k=2 executable.  Per-round control flow
+(which splits to accept) is host-side Python over scalars, exactly like
+bisecting's target selection; each distinct k compiles one global-fit
+executable, reused across rounds at that k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import resolve_fit_config
+from kmeans_tpu.models.lloyd import (
+    KMeansState,
+    NearestCentroidMixin,
+    fit_lloyd,
+)
+from kmeans_tpu.ops.distance import assign
+
+__all__ = ["fit_xmeans", "bic_score", "XMeans"]
+
+
+def bic_score(n: float, d: int, k: int, sse: float, counts) -> float:
+    """BIC of a spherical-Gaussian k-means model on ``n`` points.
+
+    ``ll - (p/2)·log n`` with the Pelleg-Moore MLE log-likelihood: shared
+    spherical variance ``σ² = sse / (d·(n-k))`` and ``p = k·(d+1)`` free
+    parameters.  Higher is better.  Structurally degenerate inputs (n ≤ k,
+    an empty cluster) score ``-inf`` so callers never accept a split into
+    emptiness.  A zero-variance model with all clusters populated scores
+    ``+inf`` — the likelihood is unbounded there, and this makes the
+    comparisons come out right at both point-mass extremes: splitting two
+    point masses IS accepted (finite parent < +inf child), while a cluster
+    that is already a single point mass (parent +inf) can never be beaten
+    by a split (+inf > +inf is false).
+    """
+    counts = [float(c) for c in counts]
+    if n <= k or any(c <= 0 for c in counts):
+        return -math.inf
+    var = sse / (d * (n - k))
+    if var <= 1e-12:
+        return math.inf
+    ll = sum(c * math.log(c / n) for c in counts)
+    ll -= (n * d / 2.0) * math.log(2.0 * math.pi * var)
+    ll -= (d * (n - k)) / 2.0
+    p = k * (d + 1)
+    return ll - (p / 2.0) * math.log(n)
+
+
+def fit_xmeans(
+    x: jax.Array,
+    k_max: int,
+    *,
+    k_min: int = 1,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    max_rounds: int = 16,
+) -> KMeansState:
+    """Fit X-means: grow k from ``k_min`` toward ``k_max`` by accepting
+    BIC-improving cluster splits.
+
+    Returns a :class:`KMeansState` whose centroids array has exactly the
+    discovered k rows; ``n_iter`` counts improve-structure rounds and
+    ``converged`` means "stopped because no split improved BIC" (rather
+    than by hitting ``k_max`` or ``max_rounds``).
+
+    ``config.k`` is ignored — k is this model's OUTPUT (``k_min``/``k_max``
+    bound it); every other knob (init method, max_iter, tol, chunk_size,
+    compute_dtype, seed, backend) applies to the inner fits.
+    """
+    if not 1 <= k_min <= k_max:
+        raise ValueError(f"need 1 <= k_min <= k_max, got {k_min}..{k_max}")
+    if config is not None:
+        config = dataclasses.replace(config, k=k_min)
+    cfg, key = resolve_fit_config(k_min, key, config)
+    if cfg.init == "given":
+        raise ValueError("x-means derives k; init='given' is not supported")
+
+    x = jnp.asarray(x)
+    d = x.shape[1]
+    f32 = jnp.float32
+    cfg2 = dataclasses.replace(cfg, k=2, empty="keep")
+
+    key, fkey = jax.random.split(key)
+    state = fit_lloyd(x, k_min, key=fkey,
+                      config=dataclasses.replace(cfg, k=k_min))
+    k = k_min
+    converged = False
+    rounds = 0
+
+    def drop_empty_slots(state, k):
+        """A refinement fit (empty='keep') can strand a child centroid with
+        zero members when adjacent splits compete; k is this model's OUTPUT,
+        so dead slots are removed (not duplicate-filled as in bisecting) and
+        the survivors re-fit once."""
+        cnts = np.asarray(state.counts)
+        if not (cnts <= 0).any():
+            return state, k
+        keep = np.flatnonzero(cnts > 0)
+        k2 = max(1, len(keep))
+        init2 = np.asarray(state.centroids)[keep[:k2]].astype(np.float32)
+        state = fit_lloyd(x, k2, config=dataclasses.replace(cfg, k=k2),
+                          init=init2)
+        return state, k2
+
+    for _ in range(max_rounds):
+        if k >= k_max:
+            break
+        rounds += 1
+        labels = state.labels
+        _, mind = assign(x, state.centroids, chunk_size=cfg.chunk_size,
+                         compute_dtype=cfg.compute_dtype)
+        # All per-cluster stats in ONE segment reduction + one transfer
+        # (not k masked full-array sums with 2k host syncs).
+        n_js = np.asarray(state.counts)
+        sse_js = np.asarray(
+            jax.ops.segment_sum(mind, labels, num_segments=k)
+        )
+        splits: dict[int, np.ndarray] = {}   # j -> (2, d) children
+        for j in range(k):
+            if k + len(splits) >= k_max:
+                break
+            n_j = float(n_js[j])
+            if n_j < 4:  # nothing statistically splittable
+                continue
+            mask = labels == j
+            sse_j = float(sse_js[j])
+            parent = bic_score(n_j, d, 1, sse_j, [n_j])
+            key, skey = jax.random.split(key)
+            st2 = fit_lloyd(x, 2, key=skey, config=cfg2,
+                            weights=mask.astype(f32))
+            lab2, mind2 = assign(x, st2.centroids,
+                                 chunk_size=cfg.chunk_size,
+                                 compute_dtype=cfg.compute_dtype)
+            n_a = float(jnp.sum(mask & (lab2 == 0)))
+            n_b = float(jnp.sum(mask & (lab2 == 1)))
+            sse2 = float(jnp.sum(jnp.where(mask, mind2, 0.0)))
+            child = bic_score(n_j, d, 2, sse2, [n_a, n_b])
+            if child > parent:
+                splits[j] = np.asarray(st2.centroids)
+        if not splits:
+            converged = True
+            break
+        # Survivors keep their center; accepted splits contribute both
+        # children.  One global refinement fit from these k_new centers.
+        cents = np.asarray(state.centroids)
+        new_centers = []
+        for j in range(k):
+            if j in splits:
+                new_centers.extend(splits[j])
+            else:
+                new_centers.append(cents[j])
+        init = np.stack(new_centers).astype(np.float32)
+        k = init.shape[0]
+        state = fit_lloyd(x, k, config=dataclasses.replace(cfg, k=k),
+                          init=init)
+        state, k = drop_empty_slots(state, k)
+
+    state, k = drop_empty_slots(state, k)
+    return KMeansState(
+        centroids=state.centroids,
+        labels=state.labels,
+        inertia=state.inertia,
+        n_iter=jnp.asarray(rounds, jnp.int32),
+        converged=jnp.asarray(converged, bool),
+        counts=state.counts,
+    )
+
+
+@dataclasses.dataclass
+class XMeans(NearestCentroidMixin):
+    """Estimator wrapper over :func:`fit_xmeans`.
+
+    ``n_clusters_`` is the DISCOVERED k (sklearn's trailing-underscore
+    convention for learned attributes); ``k_max`` bounds it.
+    """
+
+    k_max: int = 16
+    k_min: int = 1
+    seed: int = 0
+    max_rounds: int = 16
+    chunk_size: int = 4096
+    compute_dtype: Optional[str] = None
+    init: Union[str, jax.Array] = "k-means++"
+
+    state: Optional[KMeansState] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def fit(self, x) -> "XMeans":
+        if not isinstance(self.init, str):
+            raise ValueError("x-means derives k; an init array is not "
+                             "accepted")
+        cfg = KMeansConfig(
+            k=self.k_min, init=self.init, seed=self.seed,
+            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
+        )
+        self.state = fit_xmeans(
+            jnp.asarray(x), self.k_max, k_min=self.k_min,
+            key=jax.random.key(self.seed), config=cfg,
+            max_rounds=self.max_rounds,
+        )
+        return self
+
+    @property
+    def n_clusters_(self):
+        return int(self.state.centroids.shape[0])
+
+    @property
+    def cluster_centers_(self):
+        return self.state.centroids
+
+    @property
+    def labels_(self):
+        return self.state.labels
+
+    @property
+    def inertia_(self):
+        return float(self.state.inertia)

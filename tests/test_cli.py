@@ -83,3 +83,15 @@ def test_train_minibatch_steps_take_effect(capsys):
     ])
     assert rc in (0, None)
     assert json.loads(out.splitlines()[0])["n_iter"] == 7
+
+
+def test_train_xmeans_discovers_k(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--model", "xmeans", "--n", "600", "--d", "8", "--k", "8",
+        "--cluster-std", "0.3", "--seed", "0",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    # --k was the k_max bound; the reported k is the BIC-discovered one.
+    assert 1 <= res["k"] <= 8
+    assert res["mode"] == "xmeans"

@@ -469,3 +469,51 @@ def test_train_op_kmedoids_work_cap(server):
     )
     assert st == 400
     assert "work too large" in body["error"]
+
+
+def test_train_op_xmeans(server):
+    """xmeans over the train op: k acts as k_max, the fit streams a start
+    marker and a train_done event like the other one-shot families."""
+    import socket
+    import time as _time
+
+    room = "XMRM"
+    host, port = server.httpd.server_address
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.sendall(
+        f"GET /api/events?room={room} HTTP/1.1\r\n"
+        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
+    )
+    hello_buf = b""
+    while b'"type": "hello"' not in hello_buf:
+        hello_buf += sock.recv(4096)
+    st, out = _mutate(server, room, "train",
+                      {"n": 200, "d": 4, "k": 3, "max_iter": 10,
+                       "model": "xmeans"})
+    assert st == 200 and out["started"]
+    deadline = _time.time() + 30
+    buf = b""
+    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
+           and _time.time() < deadline):
+        sock.settimeout(max(0.1, deadline - _time.time()))
+        try:
+            chunk = sock.recv(8192)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    sock.close()
+    assert b'"model": "xmeans"' in buf, buf[:500]
+    assert b"train_done" in buf
+    assert b"train_error" not in buf
+
+
+def test_train_op_xmeans_work_cap(server):
+    """xmeans is bounded by its actual worst-case work, like kmedoids."""
+    st, body = _mutate(
+        server, "RRRR", "train",
+        {"n": 80_000, "d": 100, "k": 100, "max_iter": 100, "model": "xmeans"},
+    )
+    assert st == 400
+    assert "work too large" in body["error"]

@@ -1,0 +1,119 @@
+"""X-means (BIC auto-k) tests: k recovery, BIC sanity, estimator surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.models import XMeans, bic_score, fit_xmeans
+
+
+def _blobs(seed, n_per, centers, std=0.4):
+    rng = np.random.default_rng(seed)
+    cs = np.asarray(centers, np.float32)
+    xs = [c + std * rng.normal(size=(n_per, cs.shape[1])) for c in cs]
+    return np.concatenate(xs).astype(np.float32)
+
+
+def test_xmeans_recovers_true_k():
+    # 4 well-separated blobs in 8-d; start from k_min=1, allow up to 10.
+    centers = np.stack([
+        np.r_[np.full(4, s1 * 8.0), np.full(4, s2 * 8.0)]
+        for s1, s2 in [(-1, -1), (-1, 1), (1, -1), (1, 1)]
+    ])
+    x = _blobs(0, 300, centers)
+    st = fit_xmeans(x, 10, key=jax.random.key(0))
+    assert st.centroids.shape[0] == 4
+    assert bool(st.converged)           # stopped by BIC, not by k_max
+    assert float(jnp.sum(st.counts)) == x.shape[0]
+
+
+def test_xmeans_single_gaussian_stays_one():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(800, 6)).astype(np.float32)
+    st = fit_xmeans(x, 8, key=jax.random.key(1))
+    assert st.centroids.shape[0] == 1
+    assert bool(st.converged)
+
+
+def test_xmeans_respects_k_max():
+    centers = np.eye(6, dtype=np.float32) * 12.0    # 6 distinguishable blobs
+    x = _blobs(2, 200, centers)
+    st = fit_xmeans(x, 3, key=jax.random.key(2))
+    assert st.centroids.shape[0] <= 3
+
+
+def test_bic_prefers_two_for_separated_and_one_for_single():
+    # Hand-computed comparison on 1-d data via the public scorer.
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=500) - 10.0
+    b = rng.normal(size=500) + 10.0
+    x = np.concatenate([a, b])
+    n = float(x.size)
+    sse1 = float(((x - x.mean()) ** 2).sum())
+    sse2 = float(((a - a.mean()) ** 2).sum() + ((b - b.mean()) ** 2).sum())
+    assert bic_score(n, 1, 2, sse2, [500, 500]) > bic_score(n, 1, 1, sse1, [n])
+
+    y = rng.normal(size=1000)           # one Gaussian: split must lose
+    ys = np.sort(y)
+    lo, hi = ys[:500], ys[500:]         # best-case split by position
+    sse1 = float(((y - y.mean()) ** 2).sum())
+    sse2 = float(((lo - lo.mean()) ** 2).sum() + ((hi - hi.mean()) ** 2).sum())
+    assert bic_score(1000.0, 1, 1, sse1, [1000.0]) > bic_score(
+        1000.0, 1, 2, sse2, [500, 500])
+
+
+def test_bic_degenerate_inputs():
+    import math
+    assert bic_score(2.0, 4, 2, 1.0, [1, 1]) == -math.inf   # n == k
+    assert bic_score(10.0, 4, 2, 1.0, [10, 0]) == -math.inf # empty child
+    # Zero variance with populated clusters = unbounded likelihood: +inf,
+    # so point-mass splits beat finite parents but can't beat each other.
+    assert bic_score(10.0, 4, 2, 0.0, [5, 5]) == math.inf
+
+
+def test_xmeans_splits_two_point_masses():
+    """Perfectly separable data (two exact point masses) must split — a
+    zero-variance child model is unboundedly good, not degenerate."""
+    x = np.concatenate([
+        np.zeros((300, 4), np.float32),
+        np.full((300, 4), 10.0, np.float32),
+    ])
+    st = fit_xmeans(x, 4, key=jax.random.key(0))
+    assert st.centroids.shape[0] == 2
+    assert float(st.inertia) < 1e-3
+
+
+def test_xmeans_identical_points_stay_one_cluster():
+    x = np.ones((200, 4), np.float32)
+    st = fit_xmeans(x, 4, key=jax.random.key(0))
+    assert st.centroids.shape[0] == 1
+
+
+def test_xmeans_counts_all_positive():
+    """Discovered k never includes an empty (stale) centroid."""
+    centers = np.stack([np.full(6, v) for v in (-9.0, 0.0, 9.0)])
+    x = _blobs(5, 150, centers, std=0.5)
+    st = fit_xmeans(x, 8, key=jax.random.key(5))
+    assert (np.asarray(st.counts) > 0).all()
+    assert st.centroids.shape[0] == 3
+
+
+def test_xmeans_estimator_surface():
+    centers = np.stack([np.full(5, -6.0), np.full(5, 6.0)])
+    x = _blobs(4, 250, centers)
+    est = XMeans(k_max=6, seed=0).fit(x)
+    assert est.n_clusters_ == 2
+    assert est.cluster_centers_.shape == (2, 5)
+    assert est.labels_.shape == (500,)
+    assert est.predict(x[:7]).shape == (7,)
+    assert est.transform(x[:7]).shape == (7, 2)
+    assert est.score(x) <= 0.0
+    with pytest.raises(ValueError, match="init array"):
+        XMeans(k_max=4, init=jnp.zeros((2, 5))).fit(x)
+
+
+def test_xmeans_rejects_bad_bounds():
+    x = np.zeros((10, 2), np.float32)
+    with pytest.raises(ValueError, match="k_min <= k_max"):
+        fit_xmeans(x, 2, k_min=5)

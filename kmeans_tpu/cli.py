@@ -143,7 +143,31 @@ def _cmd_train(args) -> int:
               "(streaming feeds one chip)", file=sys.stderr)
         return 2
 
+    coreset_ok = ("lloyd", "accelerated", "spherical", "bisecting", "fuzzy",
+                  "kmedoids")
+    fit_weights = None
+    if args.coreset is not None:
+        if args.coreset < 1:
+            print("error: --coreset must be positive", file=sys.stderr)
+            return 2
+        if model not in coreset_ok or args.stream or mesh is not None \
+                or want_runner:
+            print(
+                "error: --coreset runs a weighted single-device fit; it "
+                f"supports --model {'/'.join(coreset_ok)} without "
+                "--stream/--mesh/runner flags",
+                file=sys.stderr,
+            )
+            return 2
+
     t0 = time.perf_counter()
+    if args.coreset is not None:
+        from kmeans_tpu.data import lightweight_coreset
+
+        x, fit_weights = lightweight_coreset(
+            jax.random.key(args.seed + 1), x, args.coreset,
+            chunk_size=kcfg.chunk_size, compute_dtype=kcfg.compute_dtype,
+        )
     if want_runner and not minibatch:
         from kmeans_tpu.models import LloydRunner
         import contextlib
@@ -188,7 +212,10 @@ def _cmd_train(args) -> int:
             "kmedoids": models.fit_kmedoids,
             "xmeans": models.fit_xmeans,   # --k is k_max; k is discovered
         }[model]
-        state = fit(x, k, config=kcfg)
+        if fit_weights is not None:
+            state = fit(x, k, config=kcfg, weights=fit_weights)
+        else:
+            state = fit(x, k, config=kcfg)
         if model == "xmeans":
             k = int(state.centroids.shape[0])
     jax_done = time.perf_counter() - t0
@@ -203,6 +230,8 @@ def _cmd_train(args) -> int:
     }
     if args.stream:
         result["stream"] = True
+    if args.coreset is not None:
+        result["coreset"] = args.coreset
     print(json.dumps(result))
 
     if args.out:
@@ -308,6 +337,9 @@ def main(argv=None) -> int:
                         "minibatch/stream path is step-based — use --steps")
     t.add_argument("--steps", type=int, default=None,
                    help="minibatch/stream SGD steps (default 200)")
+    t.add_argument("--coreset", type=int, default=None,
+                   help="reduce the data to an M-point lightweight coreset "
+                        "(Bachem et al. 2018) and run the fit weighted")
     t.add_argument("--batch-size", type=int, default=None,
                    help="minibatch/stream batch size (default 8192)")
     t.add_argument("--tol", type=float, default=1e-4)

@@ -1,5 +1,12 @@
-"""Datasets: synthetic generators matching the BASELINE evaluation configs."""
+"""Datasets: synthetic generators matching the BASELINE evaluation configs,
+plus data-reduction tools (lightweight coresets)."""
 
+from kmeans_tpu.data.coreset import lightweight_coreset
 from kmeans_tpu.data.synthetic import BENCH_CONFIGS, bench_config, make_blobs
 
-__all__ = ["BENCH_CONFIGS", "bench_config", "make_blobs"]
+__all__ = [
+    "BENCH_CONFIGS",
+    "bench_config",
+    "lightweight_coreset",
+    "make_blobs",
+]

@@ -1,0 +1,83 @@
+"""Lightweight coresets (Bachem, Lucic & Krause, KDD 2018).
+
+A scale tool for the north-star regime (SURVEY.md §5.7 — scale in N): one
+cheap pass over the data produces a small *weighted* subset whose weighted
+k-means cost approximates the full-data cost, so any of the framework's
+weighted fits (``fit_lloyd``, ``fit_lloyd_accelerated``, ``fit_spherical``,
+``fit_bisecting``, ``fit_fuzzy``, ...) runs on m ≪ n points.
+
+The lightweight sensitivity of a point is
+
+    q(x) = 1/(2n) + d(x, μ)² / (2 Σᵢ d(xᵢ, μ)²)
+
+(μ = the data mean): half uniform mass, half squared-distance mass.  Points
+are sampled i.i.d. with probability q and weighted 1/(m·q), giving an
+unbiased cost estimator with (ε, k)-lightweight-coreset guarantees.
+
+TPU-first: the whole construction is two chunked passes (mean, then
+distances-to-mean via the fused assign kernel with a single centroid) plus
+one categorical draw — everything static-shaped, nothing n×k ever exists.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kmeans_tpu.ops.distance import assign
+
+__all__ = ["lightweight_coreset"]
+
+
+def lightweight_coreset(
+    key: jax.Array,
+    x: jax.Array,
+    m: int,
+    *,
+    weights: Optional[jax.Array] = None,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Draw an m-point lightweight coreset of ``x``.
+
+    Args:
+      key: PRNG key (the construction is deterministic given it).
+      x: (n, d) points.
+      m: coreset size (sampling is with replacement; ``m > n`` is legal).
+      weights: optional (n,) nonnegative input weights — the coreset of an
+        already-weighted set (e.g. composing coresets) uses the weighted
+        mean/masses and multiplies the input weight into the sensitivity.
+      chunk_size / compute_dtype: forwarded to the distance pass.
+
+    Returns:
+      ``(points (m, d), weights (m,) f32)`` with
+      ``Σ weights == Σ input weights`` in expectation (exactly n for
+      unweighted input in the no-sampling-noise limit; the estimator is
+      unbiased per point).
+    """
+    if m < 1:
+        raise ValueError(f"coreset size must be >= 1, got {m}")
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    f32 = jnp.float32
+    w = jnp.ones((n,), f32) if weights is None else jnp.asarray(weights, f32)
+    w_total = jnp.maximum(jnp.sum(w), 1e-30)
+
+    mu = (w[:, None] * x.astype(f32)).sum(0) / w_total
+    # d(x, μ)² for every row, chunked (the fused pass with one centroid).
+    _, d2 = assign(x, mu[None], chunk_size=chunk_size,
+                   compute_dtype=compute_dtype)
+    mass = jnp.maximum(jnp.sum(w * d2), 1e-30)
+    # Sampling probability: input weight times lightweight sensitivity.
+    # Σ w·(1/(2·w_total) + d2/(2·mass)) = 1/2 + 1/2 = 1 analytically; the
+    # renormalization only mops up float rounding.
+    q = w * (0.5 / w_total + 0.5 * d2 / mass)
+    q = q / jnp.sum(q)
+
+    idx = jax.random.choice(key, n, shape=(m,), replace=True, p=q)
+    # Importance-sampling estimator of Σᵢ wᵢ·cost(xᵢ): each draw carries
+    # w/(m·q), so E[Σₛ cwₛ·cost(xₛ)] equals the full weighted cost.
+    cw = w[idx] / (m * jnp.maximum(q[idx], 1e-30))
+    return x[idx], cw.astype(f32)

@@ -64,7 +64,11 @@ def bic_score(n: float, d: int, k: int, sse: float, counts) -> float:
     if n <= k or any(c <= 0 for c in counts):
         return -math.inf
     var = sse / (d * (n - k))
-    if var <= 1e-12:
+    if var <= 0.0:
+        # Exactly-zero SSE only (point masses); any positive variance, no
+        # matter how small in absolute units, goes through the regular
+        # formula — an absolute floor would misread small-scale data as
+        # degenerate and block every split.
         return math.inf
     ll = sum(c * math.log(c / n) for c in counts)
     ll -= (n * d / 2.0) * math.log(2.0 * math.pi * var)

@@ -428,8 +428,11 @@ class KMeansServer:
                     "n_iter": int(state.n_iter),
                     "converged": bool(state.converged),
                     # For xmeans this is the model's actual output (the
-                    # BIC-discovered k ≤ the requested k_max).
-                    "k": int(state.centroids.shape[0]),
+                    # BIC-discovered k ≤ the requested k_max).  KMedoidsState
+                    # calls its centers "medoids".
+                    "k": int(getattr(state, "centroids",
+                                     getattr(state, "medoids", None)
+                                     ).shape[0]),
                 })
             except Exception as e:   # stream the failure, don't kill the room
                 room.broadcast_event({"type": "train_error", "error": str(e)})

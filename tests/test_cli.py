@@ -95,3 +95,26 @@ def test_train_xmeans_discovers_k(capsys):
     # --k was the k_max bound; the reported k is the BIC-discovered one.
     assert 1 <= res["k"] <= 8
     assert res["mode"] == "xmeans"
+
+
+def test_train_coreset_weighted_fit(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "20000", "--d", "8", "--k", "4",
+        "--coreset", "800", "--cluster-std", "0.4",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["coreset"] == 800
+    assert res["n"] == 20000          # reported n is the original data
+    assert res["converged"] is True
+
+
+def test_train_coreset_rejects_incompatible_modes(capsys):
+    rc, _, err = _run(capsys, [
+        "train", "--model", "minibatch", "--coreset", "100",
+    ])
+    assert rc == 2 and "--coreset" in err
+    rc, _, err = _run(capsys, [
+        "train", "--coreset", "100", "--mesh", "4",
+    ])
+    assert rc == 2

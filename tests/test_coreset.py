@@ -1,0 +1,69 @@
+"""Lightweight-coreset tests: unbiasedness, quality, composition, edges."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.data import lightweight_coreset, make_blobs
+from kmeans_tpu.models import fit_lloyd
+from kmeans_tpu.ops.distance import assign
+
+
+def test_coreset_total_mass_estimates_n():
+    x, _, _ = make_blobs(jax.random.key(0), 20_000, 16, 8, cluster_std=0.8)
+    pts, w = lightweight_coreset(jax.random.key(1), x, 2000)
+    assert pts.shape == (2000, 16) and w.shape == (2000,)
+    assert (np.asarray(w) > 0).all()
+    # Σw is an unbiased estimator of n; at m=2000 it concentrates tightly.
+    assert abs(float(jnp.sum(w)) - 20_000) / 20_000 < 0.15
+
+
+def test_coreset_weighted_fit_approximates_full_fit():
+    """k-means on a 25x-reduced coreset lands within a modest factor of
+    the full-data fit, evaluated on the FULL data (the paper's use case)."""
+    x, _, _ = make_blobs(jax.random.key(2), 25_000, 8, 5, cluster_std=0.6)
+    full = fit_lloyd(x, 5, key=jax.random.key(3))
+
+    pts, w = lightweight_coreset(jax.random.key(4), x, 1000)
+    small = fit_lloyd(pts, 5, key=jax.random.key(3), weights=w)
+    _, mind = assign(x, small.centroids)
+    coreset_cost_on_full = float(jnp.sum(mind))
+    assert coreset_cost_on_full < 1.5 * float(full.inertia)
+
+
+def test_coreset_cost_estimator_is_calibrated():
+    """The coreset's weighted cost of FIXED centroids tracks the true
+    full-data cost (the unbiasedness the weights exist for)."""
+    x, _, centers = make_blobs(jax.random.key(5), 30_000, 8, 4,
+                               cluster_std=0.7)
+    _, mind_full = assign(x, centers)
+    true_cost = float(jnp.sum(mind_full))
+
+    ests = []
+    for s in range(5):
+        pts, w = lightweight_coreset(jax.random.key(10 + s), x, 1500)
+        _, mind_c = assign(pts, centers)
+        ests.append(float(jnp.sum(w * mind_c)))
+    assert abs(np.mean(ests) - true_cost) / true_cost < 0.1
+
+
+def test_coreset_of_weighted_input_composes():
+    x, _, _ = make_blobs(jax.random.key(6), 8000, 4, 3, cluster_std=0.5)
+    pts1, w1 = lightweight_coreset(jax.random.key(7), x, 2000)
+    pts2, w2 = lightweight_coreset(jax.random.key(8), pts1, 500, weights=w1)
+    assert pts2.shape == (500, 4)
+    # Mass flows through the composition: still estimates the original n.
+    assert abs(float(jnp.sum(w2)) - 8000) / 8000 < 0.3
+
+
+def test_coreset_edges():
+    x = np.random.default_rng(0).normal(size=(50, 4)).astype(np.float32)
+    pts, w = lightweight_coreset(jax.random.key(0), x, 200)  # m > n is legal
+    assert pts.shape == (200, 4)
+    with pytest.raises(ValueError, match=">= 1"):
+        lightweight_coreset(jax.random.key(0), x, 0)
+    # Identical points: uniform half keeps q valid (no NaN/zero division).
+    same = np.ones((64, 4), np.float32)
+    pts, w = lightweight_coreset(jax.random.key(1), same, 16)
+    np.testing.assert_allclose(np.asarray(w), 64.0 / 16.0, rtol=1e-5)

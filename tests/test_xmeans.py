@@ -117,3 +117,12 @@ def test_xmeans_rejects_bad_bounds():
     x = np.zeros((10, 2), np.float32)
     with pytest.raises(ValueError, match="k_min <= k_max"):
         fit_xmeans(x, 2, k_min=5)
+
+
+def test_xmeans_small_scale_data_still_splits():
+    """Tiny absolute units (1e-6 coordinates) must not read as degenerate:
+    the zero-variance check is exact-zero only, not an absolute floor."""
+    centers = np.stack([np.full(4, -5e-6), np.full(4, 5e-6)])
+    x = _blobs(9, 300, centers, std=5e-7)
+    st = fit_xmeans(x, 6, key=jax.random.key(9))
+    assert st.centroids.shape[0] == 2

@@ -36,7 +36,18 @@ from jax import lax
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.pallas_lloyd import lloyd_pass_pallas, pallas_supported
 
-__all__ = ["lloyd_pass", "resolve_backend"]
+__all__ = ["lloyd_pass", "resolve_backend", "weights_exact"]
+
+
+def weights_exact(compute_dtype, *, weights=None,
+                  weights_are_binary=False) -> bool:
+    """Whether sample weights survive the one-hot MXU update exactly in
+    ``compute_dtype`` — THE one copy of the policy (binary weights, or a
+    dtype that represents them exactly).  Callers that fail this demote to
+    the segment reduction and/or gate off the Pallas kernels."""
+    if weights is None or weights_are_binary:
+        return True
+    return jnp.dtype(compute_dtype) == jnp.float32
 
 
 def _platform_of(x, platform=None) -> str:
@@ -56,12 +67,11 @@ def _platform_of(x, platform=None) -> str:
 def _pallas_ok(x, k, *, weights, weights_are_binary, compute_dtype,
                platform=None) -> bool:
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
-    # The kernel's one-hot tile is cast to cd for the MXU — exact only for
-    # binary weights or f32 compute (mirrors the XLA path's eff_update
-    # demotion).
-    weights_ok = weights is None or weights_are_binary or cd == jnp.float32
+    # The kernel's one-hot tile is cast to cd for the MXU — exact only per
+    # the shared weights_exact policy (mirrors the XLA eff_update demotion).
     return (
-        weights_ok
+        weights_exact(cd, weights=weights,
+                      weights_are_binary=weights_are_binary)
         and _platform_of(x, platform) == "tpu"
         and pallas_supported(
             x.shape[0], x.shape[1], k,
@@ -214,11 +224,8 @@ def _lloyd_pass_xla(
             # through the exact f32 segment reduction instead of silently
             # quantizing.
             eff_update = update
-            if (
-                update == "matmul"
-                and weights is not None
-                and not weights_are_binary
-                and cd != f32
+            if update == "matmul" and not weights_exact(
+                cd, weights=weights, weights_are_binary=weights_are_binary
             ):
                 eff_update = "segment"
             if eff_update == "matmul":

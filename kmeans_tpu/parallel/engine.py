@@ -37,7 +37,11 @@ from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids, resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
-from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
+from kmeans_tpu.ops.lloyd import (
+    lloyd_pass,
+    resolve_backend,
+    weights_exact as _weights_exact,
+)
 from kmeans_tpu.ops.pallas_lloyd import (
     accumulate_pallas,
     lloyd_pass_pallas,
@@ -153,7 +157,8 @@ def _accumulate_full_k(sums, counts, lab, xb, xb_c, wb, *, k, update, cd):
 
 
 def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
-                   update, with_labels, backend="xla", empty="keep"):
+                   update, with_labels, backend="xla", empty="keep",
+                   weights_binary=True):
     """DP shard body: fused local pass + psum merge; centroids replicated."""
     if backend == "pallas_interpret":   # CPU-mesh test hook
         labels, min_d2, sums, counts, inertia = lloyd_pass_pallas(
@@ -167,7 +172,7 @@ def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
             chunk_size=chunk_size,
             compute_dtype=compute_dtype,
             update=update,
-            weights_are_binary=True,
+            weights_are_binary=weights_binary,
             backend=backend,
         )
     sums = lax.psum(sums, data_axis)
@@ -456,10 +461,14 @@ def _fp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
 # Global-view fit
 # ---------------------------------------------------------------------------
 
-def _pad_rows(x: jax.Array, multiple: int):
+def _pad_rows(x: jax.Array, multiple: int, weights=None):
+    """Pad rows to ``multiple``; returns (x, w, n) where w carries the
+    caller's sample weights (default 1) with 0 on the padding rows."""
     n = x.shape[0]
     pad = (-n) % multiple
     w = np.ones(n + pad, np.float32)
+    if weights is not None:
+        w[:n] = np.asarray(weights, np.float32)
     if pad:
         x = np.concatenate(
             [np.asarray(x), np.zeros((pad,) + x.shape[1:], x.dtype)]
@@ -500,25 +509,30 @@ def _make_tp_local(backend, *, data_axis, model_axis, k_real, chunk_size,
 
 
 def _resolve_sharded_backend(req, platform, *, d, k_slice, x_itemsize,
-                             compute_dtype):
+                             compute_dtype, weights_exact=True):
     """Backend for the TP/FP shard bodies.
 
     ``auto`` picks the fused Mosaic body when the mesh is TPU and the
-    kernel's gates (lane-aligned d, VMEM-resident per-shard operands) hold
-    for the shard's kernel shapes; ``pallas_interpret`` is the CPU-mesh test
-    hook (interpreter-mode kernel, same semantics).
+    kernel's gates (lane-aligned d, VMEM-resident per-shard operands,
+    weight exactness — the kernels cast the one-hot tile to the compute
+    dtype) hold for the shard's kernel shapes; ``pallas_interpret`` is the
+    CPU-mesh test hook (interpreter-mode kernel, same semantics).
     """
     cd_size = (jnp.dtype(compute_dtype).itemsize
                if compute_dtype is not None else x_itemsize)
-    ok = pallas_supported(
+    ok = weights_exact and pallas_supported(
         0, d, k_slice, x_itemsize=x_itemsize, cd_itemsize=cd_size
     )
     if req == "auto":
         return "pallas" if (platform == "tpu" and ok) else "xla"
     if req in ("pallas", "pallas_interpret") and not ok:
+        reason = ("fractional weights need float32 compute (the kernels "
+                  "cast the one-hot tile to the compute dtype)"
+                  if not weights_exact
+                  else f"needs d % 128 == 0 and VMEM-resident "
+                       f"(k_slice={k_slice}, d={d})")
         raise ValueError(
-            f"pallas backend unsupported for this sharded fit (needs "
-            f"d % 128 == 0 and VMEM-resident (k_slice={k_slice}, d={d}))"
+            f"pallas backend unsupported for this sharded fit: {reason}"
         )
     return req
 
@@ -531,6 +545,7 @@ def fit_lloyd_sharded(
     key: Optional[jax.Array] = None,
     config: Optional[KMeansConfig] = None,
     init=None,
+    weights=None,
     data_axis: str = "data",
     model_axis: Optional[str] = None,
     feature_axis: Optional[str] = None,
@@ -544,6 +559,12 @@ def fit_lloyd_sharded(
     over k (padded up to a multiple of the axis size).  With ``feature_axis``
     set, BOTH x and centroids shard over d (padded likewise) — the
     long-context analog of SURVEY.md §5.7, for d too large per chip.
+
+    ``weights`` (optional (n,) nonnegative) ride the same per-shard weight
+    vector the engine already uses for row padding — e.g. a lightweight
+    coreset fits sharded at no extra cost.  Fractional weights demote the
+    one-hot MXU update to the exact segment reduction (and gate off the
+    bf16 kernel bodies) exactly as the single-device pass does.
     """
     cfg, key = resolve_fit_config(k, key, config)
     if model_axis is not None and feature_axis is not None:
@@ -564,10 +585,15 @@ def fit_lloyd_sharded(
                 (x.shape[0], d_pad), x.dtype)], axis=1,
         )
 
+    if weights is not None and np.asarray(weights).shape != (x.shape[0],):
+        raise ValueError(
+            f"weights shape {np.asarray(weights).shape} != ({x.shape[0]},)"
+        )
     # Rows pad to dp·fp with feature sharding so the Ulysses body's
     # all_to_all can split each shard's rows evenly over the fp group
     # (harmless for the XLA body: the extra rows carry weight 0).
-    x, w_host, n = _pad_rows(x, dp * fp)
+    x, w_host, n = _pad_rows(x, dp * fp, weights=weights)
+    weights_binary = bool(np.all((w_host == 0.0) | (w_host == 1.0)))
     x_spec = P(data_axis, feature_axis) if feature_axis else P(data_axis)
     x = jax.device_put(x, NamedSharding(mesh, x_spec))
     w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
@@ -607,21 +633,35 @@ def fit_lloyd_sharded(
     # shapes: TP's kernel sees the local k-slice; FP's Ulysses body needs
     # the FULL (k, d) centroids VMEM-resident.
     plat = mesh.devices.flat[0].platform
+    cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
+          else jnp.dtype(x.dtype))
+    w_exact = _weights_exact(cd, weights=w_host,
+                             weights_are_binary=weights_binary)
+    # Fractional weights in a sub-f32 compute dtype: the one-hot MXU update
+    # would quantize them — demote to the exact segment reduction (the
+    # shared single-device policy, ops.lloyd.weights_exact).
+    update = cfg.update
+    if update == "matmul" and not w_exact:
+        update = "segment"
     if model_axis or feature_axis:
         k_gate = (k + k_pad) // mp if model_axis else k
         backend = _resolve_sharded_backend(
             cfg.backend, plat, d=x.shape[1], k_slice=k_gate,
             x_itemsize=np.dtype(x.dtype).itemsize,
             compute_dtype=cfg.compute_dtype,
+            weights_exact=w_exact,
         )
     else:
         backend = resolve_backend(
-            cfg.backend, x, k, weights_are_binary=True, weights=w_host,
-            compute_dtype=cfg.compute_dtype, platform=plat,
+            cfg.backend, x, k, weights_are_binary=weights_binary,
+            weights=w_host, compute_dtype=cfg.compute_dtype, platform=plat,
         )
     run = _build_lloyd_run(
         mesh, data_axis, model_axis, k, cfg.chunk_size, cfg.compute_dtype,
-        cfg.update, max_it, backend, cfg.empty, feature_axis,
+        update, max_it, backend, cfg.empty, feature_axis,
+        # Only the DP body reads the flag; normalize it for TP/FP so weight
+        # type doesn't force a spurious recompile of an identical program.
+        weights_binary if not (model_axis or feature_axis) else True,
     )
     c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
     return KMeansState(
@@ -632,7 +672,7 @@ def fit_lloyd_sharded(
 @functools.lru_cache(maxsize=64)
 def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
                      compute_dtype, update, max_it, backend="xla",
-                     empty="keep", feature_axis=None):
+                     empty="keep", feature_axis=None, weights_binary=True):
     """Jitted whole-fit program, cached so repeated same-shaped fits reuse
     the compiled executable (jax.jit caches by function identity)."""
     use_pallas = backend in ("pallas", "pallas_interpret")
@@ -670,6 +710,7 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
             update=update,
             backend=backend,
             empty=empty,
+            weights_binary=weights_binary,
         )
         in_specs = (P(data_axis), P(), P(data_axis))
         out_step = (P(), P(), P())

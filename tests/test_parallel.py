@@ -337,3 +337,77 @@ def test_fp_and_tp_mutually_exclusive(problem, cpu_devices):
     with pytest.raises(ValueError, match="mutually exclusive"):
         fit_lloyd_sharded(x, 5, mesh=mesh, init=c0, model_axis="model",
                           feature_axis="feature")
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),                          # pure DP
+    dict(model_axis="model"),        # DP x TP
+])
+def test_weighted_sharded_matches_single_device(cpu_devices, kw):
+    """User sample weights (e.g. a lightweight coreset) ride the engine's
+    per-shard weight vector; results must equal the weighted single-device
+    fit — binary and fractional weights."""
+    from kmeans_tpu.config import KMeansConfig
+
+    rng = np.random.default_rng(7)
+    x, _, _ = make_blobs(jax.random.key(7), 600, 16, 4, cluster_std=0.8)
+    x = np.asarray(x)
+    c0 = x[:4].copy()
+    for w in [
+        (rng.random(600) > 0.3).astype(np.float32),        # binary
+        rng.uniform(0.1, 3.0, 600).astype(np.float32),     # fractional
+    ]:
+        want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0),
+                         weights=jnp.asarray(w), tol=1e-10, max_iter=15)
+        got = fit_lloyd_sharded(
+            x, 4, mesh=cpu_mesh((4, 2)), init=c0, weights=w,
+            tol=1e-10, max_iter=15, **kw,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.labels), np.asarray(want.labels)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.centroids), np.asarray(want.centroids),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            float(got.inertia), float(want.inertia), rtol=1e-4
+        )
+
+
+def test_weighted_sharded_fp_matches_single_device(cpu_devices):
+    rng = np.random.default_rng(8)
+    x, _, _ = make_blobs(jax.random.key(8), 400, 16, 4, cluster_std=0.8)
+    x = np.asarray(x)
+    c0 = x[:4].copy()
+    w = rng.uniform(0.1, 3.0, 400).astype(np.float32)
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0),
+                     weights=jnp.asarray(w), tol=1e-10, max_iter=15)
+    got = fit_lloyd_sharded(
+        x, 4, mesh=cpu_mesh((2, 4), ("data", "feature")), init=c0,
+        weights=w, feature_axis="feature", tol=1e-10, max_iter=15,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+
+
+def test_weighted_sharded_rejects_bad_shape(cpu_devices):
+    x = np.zeros((64, 8), np.float32)
+    with pytest.raises(ValueError, match="weights shape"):
+        fit_lloyd_sharded(x, 2, mesh=cpu_mesh((8, 1)),
+                          weights=np.ones(63, np.float32))
+
+
+def test_coreset_fit_on_mesh(cpu_devices):
+    """The lightweight-coreset -> sharded-weighted-fit pipeline."""
+    from kmeans_tpu.data import lightweight_coreset
+
+    x, _, _ = make_blobs(jax.random.key(9), 20_000, 8, 4, cluster_std=0.5)
+    pts, w = lightweight_coreset(jax.random.key(10), x, 1000)
+    st = fit_lloyd_sharded(np.asarray(pts), 4, mesh=cpu_mesh((8, 1)),
+                           weights=np.asarray(w))
+    from kmeans_tpu.ops.distance import assign
+    _, mind = assign(x, st.centroids)
+    full = fit_lloyd(x, 4, key=jax.random.key(11))
+    assert float(jnp.sum(mind)) < 1.5 * float(full.inertia)

@@ -4,6 +4,7 @@ from kmeans_tpu.parallel.distributed import ensure_initialized, process_info
 from kmeans_tpu.parallel.engine import (
     fit_lloyd_sharded,
     fit_minibatch_sharded,
+    fit_spherical_sharded,
     sharded_assign,
 )
 from kmeans_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_from_config
@@ -13,6 +14,7 @@ __all__ = [
     "process_info",
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",
+    "fit_spherical_sharded",
     "sharded_assign",
     "cpu_mesh",
     "make_mesh",

@@ -49,7 +49,32 @@ from kmeans_tpu.ops.pallas_lloyd import (
 )
 from kmeans_tpu.ops.update import apply_update
 
-__all__ = ["fit_lloyd_sharded", "fit_minibatch_sharded", "sharded_assign"]
+__all__ = [
+    "fit_lloyd_sharded",
+    "fit_minibatch_sharded",
+    "fit_spherical_sharded",
+    "sharded_assign",
+]
+
+
+def _apply_center_update(c, sums, counts, *, center_update,
+                         feature_axis=None):
+    """The one post-reduce centroid rule for every shard body: "mean" is
+    Lloyd (sums/counts, empties keep), "sphere" is spherical k-means (the
+    renormalized direction sum; degenerate clusters keep).  For "sphere"
+    with feature-sharded sums (the FP XLA body), the norm needs one extra
+    ``psum`` of the per-slice squared norms over ``feature_axis``."""
+    if center_update == "mean":
+        return apply_update(c, sums, counts)
+    assert center_update == "sphere", center_update
+    eps = 1e-8
+    norm_sq = jnp.sum(sums * sums, axis=-1, keepdims=True)
+    if feature_axis is not None:
+        norm_sq = lax.psum(norm_sq, feature_axis)
+    norms = jnp.sqrt(norm_sq)
+    ok = (counts > 0)[:, None] & (norms > eps)
+    return jnp.where(ok, sums / jnp.maximum(norms, eps),
+                     c.astype(jnp.float32))
 
 
 # ---------------------------------------------------------------------------
@@ -158,7 +183,7 @@ def _accumulate_full_k(sums, counts, lab, xb, xb_c, wb, *, k, update, cd):
 
 def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
                    update, with_labels, backend="xla", empty="keep",
-                   weights_binary=True):
+                   weights_binary=True, center_update="mean"):
     """DP shard body: fused local pass + psum merge; centroids replicated."""
     if backend == "pallas_interpret":   # CPU-mesh test hook
         labels, min_d2, sums, counts, inertia = lloyd_pass_pallas(
@@ -178,7 +203,7 @@ def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
     sums = lax.psum(sums, data_axis)
     counts = lax.psum(counts, data_axis)
     inertia = lax.psum(inertia, data_axis)
-    new_c = apply_update(c, sums, counts)
+    new_c = _apply_center_update(c, sums, counts, center_update=center_update)
     if empty == "farthest":
         # Padding rows (weight 0) must never be nominated as reseed targets.
         masked = jnp.where(w_loc > 0, min_d2, -jnp.inf)
@@ -192,7 +217,7 @@ def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
 
 def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
                    chunk_size, compute_dtype, update, with_labels,
-                   empty="keep"):
+                   empty="keep", center_update="mean"):
     """DP×TP shard body: centroids sharded over k on ``model_axis``.
 
     Padded centroid slots (global column >= k_real) are masked to +inf before
@@ -260,7 +285,9 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
     sums = lax.psum(sums, data_axis)
     counts = lax.psum(counts, data_axis)
     inertia = lax.psum(inertia, data_axis)
-    new_c_loc = apply_update(c_loc, sums, counts)
+    # k-slices hold full feature rows, so the sphere renorm is slice-local.
+    new_c_loc = _apply_center_update(c_loc, sums, counts,
+                                     center_update=center_update)
     if empty == "farthest":
         mind_rows = minds.reshape(-1)[:n_loc]
         masked = jnp.where(w_loc > 0, mind_rows, -jnp.inf)
@@ -276,7 +303,7 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
 
 def _fp_local_pass(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
                    chunk_size, compute_dtype, update, with_labels,
-                   empty="keep"):
+                   empty="keep", center_update="mean"):
     """DP×FP shard body: the *feature* axis of both x and centroids is
     sharded over ``feature_axis`` (SURVEY.md §5.7 — the long-context analog:
     scale in d instead of sequence length).
@@ -329,7 +356,9 @@ def _fp_local_pass(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
     sums = lax.psum(sums, data_axis)                         # (k, d_loc) slice
     counts = lax.psum(counts, data_axis)
     inertia = lax.psum(inertia, data_axis)
-    new_c_loc = apply_update(c_loc, sums, counts)
+    new_c_loc = _apply_center_update(c_loc, sums, counts,
+                                     center_update=center_update,
+                                     feature_axis=feature_axis)
     if empty == "farthest":
         # min_d2 is identical on every feature shard, and x_loc carries this
         # shard's d-slice — the DP reseed assembles each winner's local
@@ -347,7 +376,7 @@ def _fp_local_pass(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
 
 def _tp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, model_axis,
                           k_real, compute_dtype, with_labels, empty="keep",
-                          interpret=False):
+                          center_update="mean", interpret=False):
     """DP×TP shard body on the fused Mosaic kernel (VERDICT round-1 item 4).
 
     3-phase restructure of :func:`_tp_local_pass`: (1) score the local
@@ -385,7 +414,8 @@ def _tp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, model_axis,
     sums = lax.psum(sums, data_axis)
     counts = lax.psum(counts, data_axis)
     inertia = lax.psum(inertia, data_axis)
-    new_c_loc = apply_update(c_loc, sums, counts)
+    new_c_loc = _apply_center_update(c_loc, sums, counts,
+                                     center_update=center_update)
     if empty == "farthest":
         masked = jnp.where(w_loc > 0, mind, -jnp.inf)
         new_c_loc = _reseed_empty_farthest_tp(
@@ -399,7 +429,7 @@ def _tp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, model_axis,
 
 def _fp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
                           compute_dtype, with_labels, empty="keep",
-                          interpret=False):
+                          center_update="mean", interpret=False):
     """DP×FP shard body on the fused Mosaic kernel (VERDICT round-1 item 4).
 
     Ulysses-style axis swap (the sequence-parallel trick from long-context
@@ -437,7 +467,8 @@ def _fp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
     sums = lax.psum(sums, both)                             # (k, d) full
     counts = lax.psum(counts, both)
     inertia = lax.psum(jnp.sum(mind_blk * w_rows), both)
-    new_c_full = apply_update(c_full, sums, counts)
+    new_c_full = _apply_center_update(c_full, sums, counts,
+                                      center_update=center_update)
     if empty == "farthest":
         # Rows are now sharded over (data, feature) jointly; the tuple-axis
         # reseed sees them in global row order (fp blocks subdivide each
@@ -480,7 +511,8 @@ def _pad_rows(x: jax.Array, multiple: int, weights=None):
 
 
 def _make_tp_local(backend, *, data_axis, model_axis, k_real, chunk_size,
-                   compute_dtype, update, with_labels, empty):
+                   compute_dtype, update, with_labels, empty,
+                   center_update="mean"):
     """The TP shard body for ``backend`` — the ONE place the kernel/XLA
     choice and kwargs are wired, shared by :func:`_build_lloyd_run` and
     ``LloydRunner`` so the two can't drift."""
@@ -493,6 +525,7 @@ def _make_tp_local(backend, *, data_axis, model_axis, k_real, chunk_size,
             compute_dtype=compute_dtype,
             with_labels=with_labels,
             empty=empty,
+            center_update=center_update,
             interpret=backend == "pallas_interpret",
         )
     return functools.partial(
@@ -505,6 +538,7 @@ def _make_tp_local(backend, *, data_axis, model_axis, k_real, chunk_size,
         update=update,
         with_labels=with_labels,
         empty=empty,
+        center_update=center_update,
     )
 
 
@@ -551,6 +585,7 @@ def fit_lloyd_sharded(
     feature_axis: Optional[str] = None,
     tol: Optional[float] = None,
     max_iter: Optional[int] = None,
+    center_update: str = "mean",
 ) -> KMeansState:
     """Full-batch Lloyd on a device mesh (DP, optionally DP×TP or DP×FP).
 
@@ -567,6 +602,13 @@ def fit_lloyd_sharded(
     bf16 kernel bodies) exactly as the single-device pass does.
     """
     cfg, key = resolve_fit_config(k, key, config)
+    if center_update not in ("mean", "sphere"):
+        raise ValueError(f"unknown center_update {center_update!r}")
+    if center_update == "sphere" and cfg.empty == "farthest":
+        raise ValueError(
+            "spherical fits keep degenerate clusters (matching "
+            "fit_spherical); empty='farthest' is a Lloyd policy"
+        )
     if model_axis is not None and feature_axis is not None:
         raise ValueError(
             "model_axis (TP over k) and feature_axis (FP over d) are "
@@ -614,6 +656,13 @@ def fit_lloyd_sharded(
             compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
         )
 
+    if center_update == "sphere":
+        # Every init route (array, ++, ||, random) must land ON the sphere
+        # (matching fit_spherical's c0 = normalize_rows(c0)): k-means||'s
+        # refine step returns means of unit vectors, whose norm is < 1.
+        from kmeans_tpu.models.spherical import normalize_rows
+
+        c0 = normalize_rows(c0)
     k_pad = (-k) % mp
     if k_pad:
         c0 = jnp.concatenate([c0, jnp.zeros((k_pad, x.shape[1]), jnp.float32)])
@@ -662,6 +711,7 @@ def fit_lloyd_sharded(
         # Only the DP body reads the flag; normalize it for TP/FP so weight
         # type doesn't force a spurious recompile of an identical program.
         weights_binary if not (model_axis or feature_axis) else True,
+        center_update,
     )
     c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
     return KMeansState(
@@ -672,7 +722,8 @@ def fit_lloyd_sharded(
 @functools.lru_cache(maxsize=64)
 def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
                      compute_dtype, update, max_it, backend="xla",
-                     empty="keep", feature_axis=None, weights_binary=True):
+                     empty="keep", feature_axis=None, weights_binary=True,
+                     center_update="mean"):
     """Jitted whole-fit program, cached so repeated same-shaped fits reuse
     the compiled executable (jax.jit caches by function identity)."""
     use_pallas = backend in ("pallas", "pallas_interpret")
@@ -685,6 +736,7 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
                 feature_axis=feature_axis,
                 compute_dtype=compute_dtype,
                 empty=empty,
+                center_update=center_update,
                 interpret=interpret,
             )
         else:
@@ -696,6 +748,7 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
                 compute_dtype=compute_dtype,
                 update=update,
                 empty=empty,
+                center_update=center_update,
             )
         in_specs = (P(data_axis, feature_axis), P(None, feature_axis),
                     P(data_axis))
@@ -711,6 +764,7 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
             backend=backend,
             empty=empty,
             weights_binary=weights_binary,
+            center_update=center_update,
         )
         in_specs = (P(data_axis), P(), P(data_axis))
         out_step = (P(), P(), P())
@@ -726,6 +780,7 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
             update=update,
             with_labels=False,
             empty=empty,
+            center_update=center_update,
         )
         in_specs = (P(data_axis), P(model_axis), P(data_axis))
         out_step = (P(model_axis), P(), P(model_axis))
@@ -764,6 +819,50 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
         return c, labels, inertia, n_iter, converged, counts
 
     return run
+
+
+def fit_spherical_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    weights=None,
+    data_axis: str = "data",
+    model_axis: Optional[str] = None,
+    feature_axis: Optional[str] = None,
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+    pre_normalized: bool = False,
+) -> KMeansState:
+    """Spherical k-means on a device mesh — same layouts as
+    :func:`fit_lloyd_sharded`, with the renormalized-direction centroid
+    update of :func:`kmeans_tpu.models.spherical.fit_spherical`.
+
+    Rows are unit-normalized host-side unless ``pre_normalized=True`` (the
+    assignment then IS the cosine argmax; see models/spherical.py for the
+    identity).  Returned centroids are unit-norm; ``inertia`` is
+    Σ w·2(1−cos).  The natural scale-out for the GloVe-300d eval config.
+    """
+    from kmeans_tpu.models.spherical import normalize_rows
+
+    if not pre_normalized:
+        if isinstance(x, np.ndarray):
+            xf = x.astype(np.float32, copy=False)
+            norms = np.sqrt((xf * xf).sum(axis=1, keepdims=True))
+            x = xf / np.maximum(norms, 1e-12)
+        else:
+            x = normalize_rows(x)
+    # (init normalization happens inside fit_lloyd_sharded for ALL init
+    # routes once center_update == "sphere".)
+    return fit_lloyd_sharded(
+        x, k, mesh=mesh, key=key, config=config, init=init, weights=weights,
+        data_axis=data_axis, model_axis=model_axis,
+        feature_axis=feature_axis, tol=tol, max_iter=max_iter,
+        center_update="sphere",
+    )
 
 
 def sharded_assign(

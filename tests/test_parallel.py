@@ -411,3 +411,84 @@ def test_coreset_fit_on_mesh(cpu_devices):
     _, mind = assign(x, st.centroids)
     full = fit_lloyd(x, 4, key=jax.random.key(11))
     assert float(jnp.sum(mind)) < 1.5 * float(full.inertia)
+
+
+@pytest.mark.parametrize("kw,shape,names", [
+    (dict(), (8, 1), ("data", "model")),
+    (dict(model_axis="model"), (4, 2), ("data", "model")),
+    (dict(feature_axis="feature"), (2, 4), ("data", "feature")),
+])
+def test_spherical_sharded_matches_single_device(cpu_devices, kw, shape,
+                                                 names):
+    """Sharded spherical k-means (renormalized-direction update) equals the
+    single-device fit_spherical on DP, DP x TP and DP x FP layouts."""
+    from kmeans_tpu.models import fit_spherical
+    from kmeans_tpu.parallel import fit_spherical_sharded
+
+    rng = np.random.default_rng(11)
+    # Directional blobs: random directions per cluster, magnitudes vary.
+    dirs = rng.normal(size=(4, 16)).astype(np.float32)
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    lab = rng.integers(0, 4, size=(400,))
+    x = (dirs[lab] + 0.15 * rng.normal(size=(400, 16))).astype(np.float32)
+    x *= rng.uniform(0.5, 3.0, size=(400, 1)).astype(np.float32)
+    c0 = x[:4].copy()
+
+    want = fit_spherical(jnp.asarray(x), 4, init=jnp.asarray(c0),
+                         tol=1e-12, max_iter=15)
+    got = fit_spherical_sharded(
+        x, 4, mesh=cpu_mesh(shape, names), init=c0,
+        tol=1e-12, max_iter=15, **kw,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(got.inertia), float(want.inertia), rtol=1e-4
+    )
+    # Centroids live on the unit sphere.
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(got.centroids), axis=1), 1.0, rtol=1e-5
+    )
+
+
+def test_spherical_sharded_rejects_farthest(cpu_devices):
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.parallel import fit_spherical_sharded
+
+    x = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
+    with pytest.raises(ValueError, match="farthest"):
+        fit_spherical_sharded(
+            x, 2, mesh=cpu_mesh((8, 1)),
+            config=KMeansConfig(k=2, empty="farthest"),
+        )
+
+
+def test_spherical_sharded_seeded_inits_land_on_sphere(cpu_devices):
+    """String inits (k-means|| returns means of unit vectors, norm < 1)
+    must be renormalized before the first assignment."""
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import fit_spherical
+    from kmeans_tpu.parallel import fit_spherical_sharded
+
+    rng = np.random.default_rng(13)
+    dirs = rng.normal(size=(3, 8)).astype(np.float32)
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    lab = rng.integers(0, 3, size=(300,))
+    x = (dirs[lab] + 0.1 * rng.normal(size=(300, 8))).astype(np.float32)
+
+    cfg = KMeansConfig(k=3, init="k-means||", tol=1e-12, max_iter=15, seed=4)
+    want = fit_spherical(jnp.asarray(x), 3, key=jax.random.key(4),
+                         config=cfg)
+    got = fit_spherical_sharded(x, 3, mesh=cpu_mesh((8, 1)),
+                                key=jax.random.key(4), config=cfg)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(got.centroids), axis=1), 1.0, rtol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )

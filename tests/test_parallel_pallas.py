@@ -162,3 +162,37 @@ def test_resolve_sharded_backend_gates():
             "pallas", "tpu", d=100, k_slice=4, x_itemsize=4,
             compute_dtype=None,
         )
+
+
+@pytest.mark.parametrize("kw,names", [
+    (dict(model_axis="model"), ("data", "model")),
+    (dict(feature_axis="feature"), ("data", "feature")),
+])
+def test_pallas_spherical_sharded_matches_single_device(cpu_devices, kw,
+                                                        names):
+    """The kernel bodies honor the sphere center update too."""
+    from kmeans_tpu.models import fit_spherical
+    from kmeans_tpu.parallel import fit_spherical_sharded
+
+    rng = np.random.default_rng(12)
+    dirs = rng.normal(size=(4, 128)).astype(np.float32)
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    lab = rng.integers(0, 4, size=(300,))
+    x = (dirs[lab] + 0.1 * rng.normal(size=(300, 128))).astype(np.float32)
+    c0 = x[:4].copy()
+
+    want = fit_spherical(jnp.asarray(x), 4, init=jnp.asarray(c0),
+                         tol=1e-12, max_iter=10)
+    got = fit_spherical_sharded(
+        x, 4, mesh=cpu_mesh((2, 4), names), init=c0,
+        config=KMeansConfig(k=4, backend="pallas_interpret", tol=1e-12,
+                            max_iter=10),
+        **kw,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )

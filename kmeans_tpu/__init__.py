@@ -30,7 +30,9 @@ from kmeans_tpu.models import (
     fit_bisecting,
     fit_fuzzy,
     fit_kmedoids,
+    fit_gmeans,
     fit_xmeans,
+    GMeans,
     XMeans,
     fit_lloyd,
     fit_lloyd_accelerated,
@@ -55,7 +57,9 @@ __all__ = [
     "fit_bisecting",
     "fit_fuzzy",
     "fit_kmedoids",
+    "fit_gmeans",
     "fit_xmeans",
+    "GMeans",
     "XMeans",
     "fit_lloyd",
     "fit_lloyd_accelerated",

@@ -211,12 +211,13 @@ def _cmd_train(args) -> int:
             "fuzzy": models.fit_fuzzy,
             "kmedoids": models.fit_kmedoids,
             "xmeans": models.fit_xmeans,   # --k is k_max; k is discovered
+            "gmeans": models.fit_gmeans,   # likewise (Anderson-Darling)
         }[model]
         if fit_weights is not None:
             state = fit(x, k, config=kcfg, weights=fit_weights)
         else:
             state = fit(x, k, config=kcfg)
-        if model == "xmeans":
+        if model in ("xmeans", "gmeans"):
             k = int(state.centroids.shape[0])
     jax_done = time.perf_counter() - t0
 
@@ -325,9 +326,9 @@ def main(argv=None) -> int:
                    "(named configs set it from BASELINE)")
     t.add_argument("--model", default=None, choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "kmedoids", "xmeans",
+        "fuzzy", "kmedoids", "xmeans", "gmeans",
     ], help="model family (default: lloyd, or the config's minibatch "
-            "choice); for xmeans, --k is k_max and k is discovered by BIC")
+            "choice); for xmeans/gmeans, --k is k_max and k is discovered")
     t.add_argument("--init", default="k-means++",
                    choices=["k-means++", "k-means||", "random"])
     t.add_argument("--mesh", type=int, default=0,

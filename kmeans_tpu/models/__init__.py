@@ -18,6 +18,7 @@ from kmeans_tpu.models.init import (
 from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.medoids import KMedoids, KMedoidsState, fit_kmedoids
+from kmeans_tpu.models.gmeans import GMeans, anderson_darling_normal, fit_gmeans
 from kmeans_tpu.models.xmeans import XMeans, bic_score, fit_xmeans
 from kmeans_tpu.models.runner import IterInfo, LloydRunner
 from kmeans_tpu.models.selection import suggest_k, sweep_k
@@ -36,6 +37,9 @@ __all__ = [
     "KMedoids",
     "KMedoidsState",
     "fit_kmedoids",
+    "GMeans",
+    "anderson_darling_normal",
+    "fit_gmeans",
     "XMeans",
     "bic_score",
     "fit_xmeans",

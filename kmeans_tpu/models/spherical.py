@@ -46,13 +46,19 @@ def normalize_rows(x: jax.Array, *, eps: float = 1e-12) -> jax.Array:
 
 
 def _renormalize_update(centroids: jax.Array, sums: jax.Array,
-                        counts: jax.Array, *, eps: float = 1e-8) -> jax.Array:
+                        counts: jax.Array, *, eps: float = 1e-8,
+                        norm_sq: Optional[jax.Array] = None) -> jax.Array:
     """New centroid = unit-normalized sum of member directions.
 
     Degenerate clusters — empty, or members cancelling to ~zero sum — keep
-    the old centroid (which is already unit-norm).
+    the old centroid (which is already unit-norm).  THE one copy of the
+    spherical update rule: the sharded engine calls it too, passing a
+    precomputed ``norm_sq`` when ``sums`` is a feature-axis slice (the norm
+    then needs a psum the caller owns).
     """
-    norms = jnp.sqrt(jnp.sum(sums * sums, axis=-1, keepdims=True))
+    if norm_sq is None:
+        norm_sq = jnp.sum(sums * sums, axis=-1, keepdims=True)
+    norms = jnp.sqrt(norm_sq)
     ok = (counts > 0)[:, None] & (norms > eps)
     return jnp.where(ok, sums / jnp.maximum(norms, eps),
                      centroids.astype(jnp.float32))

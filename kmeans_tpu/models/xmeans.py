@@ -77,34 +77,33 @@ def bic_score(n: float, d: int, k: int, sse: float, counts) -> float:
     return ll - (p / 2.0) * math.log(n)
 
 
-def fit_xmeans(
+def _grow_k(
     x: jax.Array,
     k_max: int,
     *,
-    k_min: int = 1,
-    key: Optional[jax.Array] = None,
-    config: Optional[KMeansConfig] = None,
-    max_rounds: int = 16,
+    k_min: int,
+    key: Optional[jax.Array],
+    config: Optional[KMeansConfig],
+    max_rounds: int,
+    accept,
+    family: str,
+    min_split_size: int = 4,
 ) -> KMeansState:
-    """Fit X-means: grow k from ``k_min`` toward ``k_max`` by accepting
-    BIC-improving cluster splits.
-
-    Returns a :class:`KMeansState` whose centroids array has exactly the
-    discovered k rows; ``n_iter`` counts improve-structure rounds and
-    ``converged`` means "stopped because no split improved BIC" (rather
-    than by hitting ``k_max`` or ``max_rounds``).
-
-    ``config.k`` is ignored — k is this model's OUTPUT (``k_min``/``k_max``
-    bound it); every other knob (init method, max_iter, tol, chunk_size,
-    compute_dtype, seed, backend) applies to the inner fits.
-    """
+    """The shared improve-params / improve-structure loop of the auto-k
+    family (x-means, g-means): fit at the current k, offer every cluster's
+    local 2-means split to ``accept(...)``, rebuild from survivors +
+    accepted children, repeat.  ``accept`` receives host-side floats
+    (n_j, sse_j, n_a, n_b, sse2, d) plus device-side (mask, st2, lab2,
+    mind2, x) and returns whether to take the split."""
     if not 1 <= k_min <= k_max:
         raise ValueError(f"need 1 <= k_min <= k_max, got {k_min}..{k_max}")
     if config is not None:
         config = dataclasses.replace(config, k=k_min)
     cfg, key = resolve_fit_config(k_min, key, config)
     if cfg.init == "given":
-        raise ValueError("x-means derives k; init='given' is not supported")
+        raise ValueError(
+            f"{family} derives k; init='given' is not supported"
+        )
 
     x = jnp.asarray(x)
     d = x.shape[1]
@@ -151,11 +150,12 @@ def fit_xmeans(
             if k + len(splits) >= k_max:
                 break
             n_j = float(n_js[j])
-            if n_j < 4:  # nothing statistically splittable
+            # Family-specific gate: don't pay a full 2-means fit for a
+            # cluster the accept criterion statically cannot split.
+            if n_j < min_split_size:
                 continue
             mask = labels == j
             sse_j = float(sse_js[j])
-            parent = bic_score(n_j, d, 1, sse_j, [n_j])
             key, skey = jax.random.split(key)
             st2 = fit_lloyd(x, 2, key=skey, config=cfg2,
                             weights=mask.astype(f32))
@@ -164,9 +164,12 @@ def fit_xmeans(
                                  compute_dtype=cfg.compute_dtype)
             n_a = float(jnp.sum(mask & (lab2 == 0)))
             n_b = float(jnp.sum(mask & (lab2 == 1)))
+            if n_a < 1 or n_b < 1:
+                continue           # the 2-means failed to form two children
             sse2 = float(jnp.sum(jnp.where(mask, mind2, 0.0)))
-            child = bic_score(n_j, d, 2, sse2, [n_a, n_b])
-            if child > parent:
+            if accept(n_j=n_j, sse_j=sse_j, n_a=n_a, n_b=n_b, sse2=sse2,
+                      d=d, mask=mask, st2=st2, lab2=lab2, mind2=mind2,
+                      x=x):
                 splits[j] = np.asarray(st2.centroids)
         if not splits:
             converged = True
@@ -195,6 +198,37 @@ def fit_xmeans(
         converged=jnp.asarray(converged, bool),
         counts=state.counts,
     )
+
+
+def fit_xmeans(
+    x: jax.Array,
+    k_max: int,
+    *,
+    k_min: int = 1,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    max_rounds: int = 16,
+) -> KMeansState:
+    """Fit X-means: grow k from ``k_min`` toward ``k_max`` by accepting
+    BIC-improving cluster splits.
+
+    Returns a :class:`KMeansState` whose centroids array has exactly the
+    discovered k rows; ``n_iter`` counts improve-structure rounds and
+    ``converged`` means "stopped because no split improved BIC" (rather
+    than by hitting ``k_max`` or ``max_rounds``).
+
+    ``config.k`` is ignored — k is this model's OUTPUT (``k_min``/``k_max``
+    bound it); every other knob (init method, max_iter, tol, chunk_size,
+    compute_dtype, seed, backend) applies to the inner fits.
+    """
+    def accept(*, n_j, sse_j, n_a, n_b, sse2, d, **_):
+        parent = bic_score(n_j, d, 1, sse_j, [n_j])
+        child = bic_score(n_j, d, 2, sse2, [n_a, n_b])
+        return child > parent
+
+    return _grow_k(x, k_max, k_min=k_min, key=key, config=config,
+                   max_rounds=max_rounds, accept=accept, family="x-means",
+                   min_split_size=4)
 
 
 @dataclasses.dataclass

@@ -67,14 +67,12 @@ def _apply_center_update(c, sums, counts, *, center_update,
     if center_update == "mean":
         return apply_update(c, sums, counts)
     assert center_update == "sphere", center_update
-    eps = 1e-8
+    from kmeans_tpu.models.spherical import _renormalize_update
+
     norm_sq = jnp.sum(sums * sums, axis=-1, keepdims=True)
     if feature_axis is not None:
         norm_sq = lax.psum(norm_sq, feature_axis)
-    norms = jnp.sqrt(norm_sq)
-    ok = (counts > 0)[:, None] & (norms > eps)
-    return jnp.where(ok, sums / jnp.maximum(norms, eps),
-                     c.astype(jnp.float32))
+    return _renormalize_update(c, sums, counts, norm_sq=norm_sq)
 
 
 # ---------------------------------------------------------------------------

@@ -65,6 +65,7 @@ _TRAIN_FITS = {
     "fuzzy": "fit_fuzzy",
     "kmedoids": "fit_kmedoids",
     "xmeans": "fit_xmeans",     # k acts as k_max; BIC discovers the k
+    "gmeans": "fit_gmeans",     # k_max likewise; Anderson-Darling test
 }
 
 #: k-medoids' medoid update is O(n²·d) — cap what one unauthenticated
@@ -354,14 +355,14 @@ class KMeansServer:
         # (the endpoint exists for the teaching-game scale, n=500 d=2 k=3).
         if n * d > 8_000_000:
             raise ValueError("train shape too large: n*d must be <= 8e6")
-        if model == "xmeans":
+        if model in ("xmeans", "gmeans"):
             # Worst case ~max_rounds·(2k split fits + 1 global fit) full-
             # array passes: ≈ 48·k·n·d·max_iter work units at the fit's
             # default max_rounds=16.  Budget matches the other families'
             # worst case (n·d=8e6 × k=100 × max_iter=100 = 8e10).
             if 48 * n * d * k * max_iter > 8e10:
                 raise ValueError(
-                    "xmeans work too large: 48·n·d·k·max_iter must be <= 8e10"
+                    f"{model} work too large: 48·n·d·k·max_iter must be <= 8e10"
                 )
         # One training per room AND a server-wide concurrency bound, so many
         # rooms can't stack unbounded worker threads.

@@ -118,3 +118,14 @@ def test_train_coreset_rejects_incompatible_modes(capsys):
         "train", "--coreset", "100", "--mesh", "4",
     ])
     assert rc == 2
+
+
+def test_train_gmeans_discovers_k(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--model", "gmeans", "--n", "600", "--d", "8", "--k", "8",
+        "--cluster-std", "0.3", "--seed", "0",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert 1 <= res["k"] <= 8
+    assert res["mode"] == "gmeans"

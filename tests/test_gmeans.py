@@ -1,0 +1,58 @@
+"""G-means (Anderson-Darling auto-k) tests."""
+
+import jax
+import numpy as np
+import pytest
+
+from kmeans_tpu.models import GMeans, anderson_darling_normal, fit_gmeans
+
+
+def _blobs(seed, n_per, centers, std=0.4):
+    rng = np.random.default_rng(seed)
+    cs = np.asarray(centers, np.float32)
+    xs = [c + std * rng.normal(size=(n_per, cs.shape[1])) for c in cs]
+    return np.concatenate(xs).astype(np.float32)
+
+
+def test_ad_statistic_behaves():
+    rng = np.random.default_rng(0)
+    normal = rng.normal(size=2000)
+    bimodal = np.concatenate([rng.normal(size=1000) - 4,
+                              rng.normal(size=1000) + 4])
+    uniform = rng.uniform(-1, 1, size=2000)
+    a_norm = anderson_darling_normal(normal)
+    assert a_norm < 1.035              # normal passes at alpha=0.01
+    assert anderson_darling_normal(bimodal) > 10.0
+    assert anderson_darling_normal(uniform) > 1.035
+    # Degenerate samples read as normal (never split on them).
+    assert anderson_darling_normal(np.ones(100)) == 0.0
+    assert anderson_darling_normal(np.arange(5)) == 0.0
+
+
+def test_gmeans_recovers_true_k():
+    centers = np.stack([
+        np.r_[np.full(4, s1 * 8.0), np.full(4, s2 * 8.0)]
+        for s1, s2 in [(-1, -1), (-1, 1), (1, -1), (1, 1)]
+    ])
+    x = _blobs(1, 300, centers)
+    st = fit_gmeans(x, 10, key=jax.random.key(1))
+    assert st.centroids.shape[0] == 4
+    assert bool(st.converged)
+
+
+def test_gmeans_single_gaussian_stays_one():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(1500, 6)).astype(np.float32)
+    st = fit_gmeans(x, 8, key=jax.random.key(2))
+    assert st.centroids.shape[0] == 1
+
+
+def test_gmeans_alpha_validation_and_estimator():
+    centers = np.stack([np.full(5, -6.0), np.full(5, 6.0)])
+    x = _blobs(3, 250, centers)
+    with pytest.raises(ValueError, match="alpha"):
+        fit_gmeans(x, 4, alpha=0.33)
+    est = GMeans(k_max=6, seed=0).fit(x)
+    assert est.n_clusters_ == 2
+    assert est.predict(x[:5]).shape == (5,)
+    assert est.score(x) <= 0.0

@@ -2,6 +2,7 @@
 
 from kmeans_tpu.parallel.distributed import ensure_initialized, process_info
 from kmeans_tpu.parallel.engine import (
+    fit_fuzzy_sharded,
     fit_lloyd_sharded,
     fit_minibatch_sharded,
     fit_spherical_sharded,
@@ -12,6 +13,7 @@ from kmeans_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_from_config
 __all__ = [
     "ensure_initialized",
     "process_info",
+    "fit_fuzzy_sharded",
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",
     "fit_spherical_sharded",

@@ -50,6 +50,7 @@ from kmeans_tpu.ops.pallas_lloyd import (
 from kmeans_tpu.ops.update import apply_update
 
 __all__ = [
+    "fit_fuzzy_sharded",
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",
     "fit_spherical_sharded",
@@ -861,6 +862,157 @@ def fit_spherical_sharded(
         feature_axis=feature_axis, tol=tol, max_iter=max_iter,
         center_update="sphere",
     )
+
+
+def _fcm_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size,
+                    compute_dtype, m, with_labels):
+    """DP shard body for fuzzy c-means: memberships are row-local given
+    replicated centroids, so one ``psum`` of the soft (sums, counts,
+    objective) per pass is the whole collective story."""
+    from kmeans_tpu.models.fuzzy import _memberships_tile
+
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
+    k, d = c.shape
+    inv_exp = 1.0 / (m - 1.0)
+    xs, ws, n_loc = chunk_tiles(x_loc, w_loc, chunk_size)
+    x_sq = sq_norms(xs)
+    c_t = c.astype(cd).T
+    c_sq = sq_norms(c)
+
+    def body(carry, tile):
+        sums, counts, obj = carry
+        xb, wb, xb_sq = tile
+        xb_c = xb.astype(cd)
+        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32,
+                          precision=matmul_precision(cd))
+        d2 = jnp.maximum(xb_sq[:, None] - 2.0 * prod + c_sq[None, :], 0.0)
+        u = _memberships_tile(d2, inv_exp)
+        um = (u ** m) * wb[:, None]
+        obj = obj + jnp.sum(um * d2)
+        sums = sums + jnp.matmul(
+            um.astype(cd).T, xb_c, preferred_element_type=f32,
+            precision=matmul_precision(cd),
+        )
+        counts = counts + jnp.sum(um, axis=0)
+        lab = (jnp.argmax(u, axis=1).astype(jnp.int32)
+               if with_labels else 0)
+        return (sums, counts, obj), lab
+
+    init = (jnp.zeros((k, d), f32), jnp.zeros((k,), f32), jnp.zeros((), f32))
+    (sums, counts, obj), labs = lax.scan(body, init, (xs, ws, x_sq))
+
+    sums = lax.psum(sums, data_axis)
+    counts = lax.psum(counts, data_axis)
+    obj = lax.psum(obj, data_axis)
+    denom = jnp.where(counts > 0, counts, 1.0)
+    new_c = jnp.where((counts > 0)[:, None], sums / denom[:, None],
+                      c.astype(f32))
+    if with_labels:
+        return new_c, obj, counts, labs.reshape(-1)[:n_loc]
+    return new_c, obj, counts
+
+
+@functools.lru_cache(maxsize=32)
+def _build_fcm_run(mesh, data_axis, chunk_size, compute_dtype, m, max_it):
+    local = functools.partial(
+        _fcm_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, m=m,
+    )
+    step = jax.shard_map(
+        functools.partial(local, with_labels=False), mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P()), check_vma=False,
+    )
+    final = jax.shard_map(
+        functools.partial(local, with_labels=True), mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P(), P(data_axis)), check_vma=False,
+    )
+
+    @jax.jit
+    def run(x, w, c0, tol_v):
+        def cond(s):
+            c, it, shift_sq, done = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            c, it, _, _ = s
+            new_c, _, _ = step(x, c, w)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v)
+
+        c, n_iter, _, converged = lax.while_loop(
+            cond, body, (c0, jnp.zeros((), jnp.int32),
+                         jnp.asarray(jnp.inf, jnp.float32),
+                         jnp.zeros((), bool)),
+        )
+        _, obj, counts, labels = final(x, c, w)
+        return c, labels, obj, n_iter, converged, counts
+
+    return run
+
+
+def fit_fuzzy_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    m: float = 2.0,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    weights=None,
+    data_axis: str = "data",
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+):
+    """Fuzzy c-means on a device mesh (DP over points).
+
+    Memberships depend only on a row's distances to the replicated
+    centroids, so the sharding story is exactly Lloyd's: local soft
+    reductions, one ``psum`` per pass.  Returns a
+    :class:`kmeans_tpu.models.fuzzy.FuzzyState` equal to the single-device
+    :func:`fit_fuzzy` (labels exactly; floats to tolerance).  TP/FP
+    layouts are not offered — fuzzy is used at moderate k where DP covers
+    the scale story.
+    """
+    from kmeans_tpu.models.fuzzy import FuzzyState
+
+    if not m > 1.0:
+        raise ValueError(f"fuzziness m must be > 1, got {m}")
+    cfg, key = resolve_fit_config(k, key, config)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes[data_axis]
+
+    if weights is not None and np.asarray(weights).shape != (x.shape[0],):
+        raise ValueError(
+            f"weights shape {np.asarray(weights).shape} != ({x.shape[0]},)"
+        )
+    x, w_host, n = _pad_rows(x, dp, weights=weights)
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, x.shape[1]):
+            raise ValueError(f"init centroids shape {c0.shape} != "
+                             f"{(k, x.shape[1])}")
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        c0 = init_centroids(
+            key, x, k, method=method, weights=w,
+            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        )
+    c0 = jax.device_put(c0, NamedSharding(mesh, P()))
+
+    run = _build_fcm_run(
+        mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, float(m),
+        max_iter if max_iter is not None else cfg.max_iter,
+    )
+    tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
+    c, labels, obj, n_iter, converged, counts = run(x, w, c0, tol_v)
+    return FuzzyState(c, labels[:n], obj, n_iter, converged, counts)
 
 
 def sharded_assign(

@@ -492,3 +492,42 @@ def test_spherical_sharded_seeded_inits_land_on_sphere(cpu_devices):
     np.testing.assert_array_equal(
         np.asarray(got.labels), np.asarray(want.labels)
     )
+
+
+@pytest.mark.parametrize("shape", [(2, 1), (8, 1)])
+def test_fuzzy_sharded_matches_single_device(cpu_devices, shape):
+    """Sharded FCM (soft psum reductions) equals single-device fit_fuzzy."""
+    from kmeans_tpu.models import fit_fuzzy
+    from kmeans_tpu.parallel import fit_fuzzy_sharded
+
+    rng = np.random.default_rng(14)
+    x, _, _ = make_blobs(jax.random.key(14), 403, 8, 3, cluster_std=0.6)
+    x = np.asarray(x)
+    c0 = x[:3].copy()
+    w = rng.uniform(0.2, 2.0, 403).astype(np.float32)
+
+    want = fit_fuzzy(jnp.asarray(x), 3, init=jnp.asarray(c0),
+                     weights=jnp.asarray(w), tol=1e-12, max_iter=20)
+    got = fit_fuzzy_sharded(
+        x, 3, mesh=cpu_mesh(shape), init=c0, weights=w,
+        tol=1e-12, max_iter=20,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(got.objective), float(want.objective), rtol=1e-4
+    )
+    assert int(got.n_iter) == int(want.n_iter)
+
+
+def test_fuzzy_sharded_validation(cpu_devices):
+    from kmeans_tpu.parallel import fit_fuzzy_sharded
+
+    x = np.zeros((64, 8), np.float32)
+    with pytest.raises(ValueError, match="m must be > 1"):
+        fit_fuzzy_sharded(x, 2, mesh=cpu_mesh((8, 1)), m=1.0)

@@ -62,6 +62,52 @@ def _memberships_tile(d2, inv_exp):
     return jnp.where(any_zero, u_sing, u_reg)
 
 
+def fcm_scan_tiles(xs, ws, x_sq, c, *, m, compute_dtype, with_labels):
+    """The FCM tile scan — distance tile, memberships, u^m-weighted soft
+    reductions — WITHOUT the final normalization: returns local
+    ``(sums, counts, objective, labels-per-tile)``.  THE one copy of the
+    pass body: the single-device loop finishes it directly and the sharded
+    engine psums the three reductions first (sharded == single-device
+    equality rests on both calling this)."""
+    f32 = jnp.float32
+    cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+          else xs.dtype)
+    k, d = c.shape
+    inv_exp = 1.0 / (m - 1.0)
+    c_t = c.astype(cd).T
+    c_sq = sq_norms(c)
+
+    def body(carry, tile):
+        sums, counts, obj = carry
+        xb, wb, xb_sq = tile
+        xb_c = xb.astype(cd)
+        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32,
+                          precision=matmul_precision(cd))
+        d2 = jnp.maximum(xb_sq[:, None] - 2.0 * prod + c_sq[None, :], 0.0)
+        u = _memberships_tile(d2, inv_exp)
+        um = (u ** m) * wb[:, None]                    # (chunk, k)
+        obj = obj + jnp.sum(um * d2)
+        sums = sums + jnp.matmul(
+            um.astype(cd).T, xb_c, preferred_element_type=f32,
+            precision=matmul_precision(cd),
+        )
+        counts = counts + jnp.sum(um, axis=0)
+        lab = (jnp.argmax(u, axis=1).astype(jnp.int32)
+               if with_labels else 0)
+        return (sums, counts, obj), lab
+
+    init = (jnp.zeros((k, d), f32), jnp.zeros((k,), f32), jnp.zeros((), f32))
+    (sums, counts, obj), labs = lax.scan(body, init, (xs, ws, x_sq))
+    return sums, counts, obj, labs
+
+
+def fcm_center_update(c, sums, counts):
+    """Soft-count mean; empty (zero-soft-mass) clusters keep their center."""
+    denom = jnp.where(counts > 0, counts, 1.0)
+    return jnp.where((counts > 0)[:, None], sums / denom[:, None],
+                     c.astype(jnp.float32))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("max_iter", "chunk_size", "compute_dtype", "m"),
@@ -77,34 +123,10 @@ def _fcm_loop(x, centroids0, weights, tol, *, m, max_iter, chunk_size,
     x_sq = sq_norms(xs)
 
     def pass_once(c, with_labels):
-        c_t = c.astype(cd).T
-        c_sq = sq_norms(c)
-
-        def body(carry, tile):
-            sums, counts, obj = carry
-            xb, wb, xb_sq = tile
-            xb_c = xb.astype(cd)
-            prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32,
-                              precision=matmul_precision(cd))
-            d2 = jnp.maximum(xb_sq[:, None] - 2.0 * prod + c_sq[None, :], 0.0)
-            u = _memberships_tile(d2, inv_exp)
-            um = (u ** m) * wb[:, None]                    # (chunk, k)
-            obj = obj + jnp.sum(um * d2)
-            sums = sums + jnp.matmul(
-                um.astype(cd).T, xb_c, preferred_element_type=f32,
-                precision=matmul_precision(cd),
-            )
-            counts = counts + jnp.sum(um, axis=0)
-            lab = (jnp.argmax(u, axis=1).astype(jnp.int32)
-                   if with_labels else 0)
-            return (sums, counts, obj), lab
-
-        init = (jnp.zeros((k, d), f32), jnp.zeros((k,), f32),
-                jnp.zeros((), f32))
-        (sums, counts, obj), labs = lax.scan(body, init, (xs, ws, x_sq))
-        denom = jnp.where(counts > 0, counts, 1.0)
-        new_c = jnp.where((counts > 0)[:, None], sums / denom[:, None],
-                          c.astype(f32))
+        sums, counts, obj, labs = fcm_scan_tiles(
+            xs, ws, x_sq, c, m=m, compute_dtype=cd, with_labels=with_labels
+        )
+        new_c = fcm_center_update(c, sums, counts)
         return new_c, obj, counts, labs
 
     def cond(s):

@@ -869,45 +869,18 @@ def _fcm_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size,
     """DP shard body for fuzzy c-means: memberships are row-local given
     replicated centroids, so one ``psum`` of the soft (sums, counts,
     objective) per pass is the whole collective story."""
-    from kmeans_tpu.models.fuzzy import _memberships_tile
+    from kmeans_tpu.models.fuzzy import fcm_center_update, fcm_scan_tiles
 
-    f32 = jnp.float32
-    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
-    k, d = c.shape
-    inv_exp = 1.0 / (m - 1.0)
     xs, ws, n_loc = chunk_tiles(x_loc, w_loc, chunk_size)
     x_sq = sq_norms(xs)
-    c_t = c.astype(cd).T
-    c_sq = sq_norms(c)
-
-    def body(carry, tile):
-        sums, counts, obj = carry
-        xb, wb, xb_sq = tile
-        xb_c = xb.astype(cd)
-        prod = jnp.matmul(xb_c, c_t, preferred_element_type=f32,
-                          precision=matmul_precision(cd))
-        d2 = jnp.maximum(xb_sq[:, None] - 2.0 * prod + c_sq[None, :], 0.0)
-        u = _memberships_tile(d2, inv_exp)
-        um = (u ** m) * wb[:, None]
-        obj = obj + jnp.sum(um * d2)
-        sums = sums + jnp.matmul(
-            um.astype(cd).T, xb_c, preferred_element_type=f32,
-            precision=matmul_precision(cd),
-        )
-        counts = counts + jnp.sum(um, axis=0)
-        lab = (jnp.argmax(u, axis=1).astype(jnp.int32)
-               if with_labels else 0)
-        return (sums, counts, obj), lab
-
-    init = (jnp.zeros((k, d), f32), jnp.zeros((k,), f32), jnp.zeros((), f32))
-    (sums, counts, obj), labs = lax.scan(body, init, (xs, ws, x_sq))
-
+    sums, counts, obj, labs = fcm_scan_tiles(
+        xs, ws, x_sq, c, m=m, compute_dtype=compute_dtype,
+        with_labels=with_labels,
+    )
     sums = lax.psum(sums, data_axis)
     counts = lax.psum(counts, data_axis)
     obj = lax.psum(obj, data_axis)
-    denom = jnp.where(counts > 0, counts, 1.0)
-    new_c = jnp.where((counts > 0)[:, None], sums / denom[:, None],
-                      c.astype(f32))
+    new_c = fcm_center_update(c, sums, counts)
     if with_labels:
         return new_c, obj, counts, labs.reshape(-1)[:n_loc]
     return new_c, obj, counts

@@ -116,9 +116,7 @@ def _fcm_loop(x, centroids0, weights, tol, *, m, max_iter, chunk_size,
               compute_dtype):
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
-    n, d = x.shape
-    k = centroids0.shape[0]
-    inv_exp = 1.0 / (m - 1.0)
+    n = x.shape[0]
     xs, ws, _ = chunk_tiles(x, weights, chunk_size)
     x_sq = sq_norms(xs)
 

@@ -33,7 +33,7 @@ from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_config
 from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
 
-__all__ = ["KMedoidsState", "fit_kmedoids", "KMedoids"]
+__all__ = ["KMedoidsState", "fit_kmedoids", "resolve_medoid_init", "KMedoids"]
 
 
 class KMedoidsState(NamedTuple):
@@ -178,6 +178,41 @@ def _init_medoid_indices(key, x, k, *, weights, metric, chunk_size,
     return idx
 
 
+def resolve_medoid_init(key, x, k, *, init, cfg, weights, metric):
+    """Starting medoid indices for any ``init`` route — explicit (k,) index
+    array (validated), "random" (uniform, weight-agnostic — sklearn-extra's
+    convention), or ++-family D-sampling.  THE one copy, shared by the
+    single-device fit and the sharded ring fit so seeded runs of the two
+    pick identical rows."""
+    n = x.shape[0]
+    if init is not None and not isinstance(init, str):
+        idx0 = jnp.asarray(init, jnp.int32)
+        if idx0.shape != (k,):
+            raise ValueError(f"init medoid indices shape {idx0.shape} != ({k},)")
+        if bool(jnp.any((idx0 < 0) | (idx0 >= n))):
+            raise ValueError(
+                f"init medoid indices must lie in [0, {n}); got "
+                f"min={int(jnp.min(idx0))}, max={int(jnp.max(idx0))}"
+            )
+        return idx0
+    method = init if isinstance(init, str) else cfg.init
+    if method == "given":
+        # config said 'given' but no index array arrived — silently
+        # falling into the ++-style branch would ignore the caller's
+        # stated intent (mirrors fit_bisecting's guard; advisor r1).
+        raise ValueError(
+            "init='given' requires an explicit medoid index array"
+        )
+    if method == "random":
+        return jax.random.choice(key, n, shape=(k,), replace=False
+                                 ).astype(jnp.int32)
+    # Any ++-family method: D-sampled indices.
+    return _init_medoid_indices(
+        key, x, k, weights=weights, metric=metric,
+        chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
+    )
+
+
 def fit_kmedoids(
     x: jax.Array,
     k: int,
@@ -196,33 +231,8 @@ def fit_kmedoids(
         raise ValueError(f"unknown metric {metric!r}")
     cfg, key = resolve_fit_config(k, key, config)
     x = jnp.asarray(x)
-    n = x.shape[0]
-    if init is not None and not isinstance(init, str):
-        idx0 = jnp.asarray(init, jnp.int32)
-        if idx0.shape != (k,):
-            raise ValueError(f"init medoid indices shape {idx0.shape} != ({k},)")
-        if bool(jnp.any((idx0 < 0) | (idx0 >= n))):
-            raise ValueError(
-                f"init medoid indices must lie in [0, {n}); got "
-                f"min={int(jnp.min(idx0))}, max={int(jnp.max(idx0))}"
-            )
-    else:
-        method = init if isinstance(init, str) else cfg.init
-        if method == "given":
-            # config said 'given' but no index array arrived — silently
-            # falling into the ++-style branch would ignore the caller's
-            # stated intent (mirrors fit_bisecting's guard; advisor r1).
-            raise ValueError(
-                "init='given' requires an explicit medoid index array"
-            )
-        if method == "random":
-            idx0 = jax.random.choice(key, n, shape=(k,), replace=False
-                                     ).astype(jnp.int32)
-        else:  # any ++-family method: D-sampled indices
-            idx0 = _init_medoid_indices(
-                key, x, k, weights=weights, metric=metric,
-                chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
-            )
+    idx0 = resolve_medoid_init(key, x, k, init=init, cfg=cfg,
+                               weights=weights, metric=metric)
     return _kmedoids_loop(
         x, idx0, weights,
         max_iter=max_iter if max_iter is not None else cfg.max_iter,

@@ -1,6 +1,7 @@
 """Parallelism: mesh construction, sharded engine, multi-host bootstrap."""
 
 from kmeans_tpu.parallel.distributed import ensure_initialized, process_info
+from kmeans_tpu.parallel.medoids import fit_kmedoids_sharded
 from kmeans_tpu.parallel.engine import (
     fit_fuzzy_sharded,
     fit_lloyd_sharded,
@@ -14,6 +15,7 @@ __all__ = [
     "ensure_initialized",
     "process_info",
     "fit_fuzzy_sharded",
+    "fit_kmedoids_sharded",
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",
     "fit_spherical_sharded",

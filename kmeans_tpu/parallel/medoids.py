@@ -1,0 +1,260 @@
+"""Sharded k-medoids: the O(n²) pairwise cost sweep as a RING pass.
+
+The medoid update needs, for every candidate row, its summed distance to
+every same-cluster row — all-pairs work that single-device
+:mod:`kmeans_tpu.models.medoids` does as chunked (tile × n) matmuls.  On a
+mesh, materializing the full x on every device would defeat the sharding;
+instead the point blocks ROTATE: each of the dp ring steps, every device
+computes its local rows' partial costs against the currently-visiting
+block ((chunk, n/dp) MXU matmuls), then ``ppermute``s the block to its
+neighbor.  After dp steps every device holds exact full costs for its own
+rows while only ever storing two blocks — the same neighbor-exchange
+schedule ring attention uses for K/V blocks (SURVEY.md §2.6's
+"communication backend" made first-class), with all traffic on the ICI
+ring.
+
+Medoid selection then reproduces the single-device lowest-index tie-break
+with two ``pmin`` collectives per fit step (min cost per cluster, then min
+global row index among achievers), exactly like the TP argmin combine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import resolve_fit_config
+from kmeans_tpu.models.medoids import KMedoidsState, _dist_tile
+from kmeans_tpu.ops.distance import chunk_tiles, sq_norms
+from kmeans_tpu.parallel.engine import _pad_rows
+
+__all__ = ["fit_kmedoids_sharded"]
+
+
+def _gather_rows(x_loc, idx_global, data_axis):
+    """Replicate k globally-indexed rows from their contiguous-shard owners:
+    each owner contributes, everyone else zeros, one psum assembles."""
+    n_loc = x_loc.shape[0]
+    me = lax.axis_index(data_axis)
+    owner = (idx_global // n_loc) == me
+    local = jnp.clip(idx_global - me * n_loc, 0, n_loc - 1)
+    contrib = jnp.where(owner[:, None], x_loc[local].astype(jnp.float32), 0.0)
+    return lax.psum(contrib, data_axis)
+
+
+def _kmedoids_assign(x_loc, w_loc, med_idx, *, data_axis, chunk_size,
+                     compute_dtype, metric):
+    """Assignment to the k replicated medoid rows: (inertia, local labels).
+    Also the whole final pass — after convergence the ring sweep would
+    only recompute medoids we already have."""
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
+    n_loc = x_loc.shape[0]
+    xs, ws, _ = chunk_tiles(x_loc, w_loc, chunk_size)
+    xs_sq = sq_norms(xs)
+
+    med = _gather_rows(x_loc, med_idx, data_axis)           # (k, d) f32
+    m_t = med.astype(cd).T
+    m_sq = sq_norms(med)
+
+    def assign_body(inertia, tile):
+        xb, wb, xb_sq = tile
+        dist = _dist_tile(xb, m_t, xb_sq, m_sq, metric=metric, cd=cd)
+        lab = jnp.argmin(dist, axis=1).astype(jnp.int32)
+        return inertia + jnp.sum(jnp.min(dist, axis=1) * wb), lab
+
+    inertia_loc, labs = lax.scan(assign_body, jnp.zeros((), f32),
+                                 (xs, ws, xs_sq))
+    lab_loc = labs.reshape(-1)[:n_loc]
+    return lax.psum(inertia_loc, data_axis), lab_loc
+
+
+def _kmedoids_sharded_body(x_loc, w_loc, med_idx, *, data_axis, k, chunk_size,
+                           compute_dtype, metric):
+    """One fit step on a shard: assign to replicated medoids, ring-sweep
+    candidate costs, select new medoids with two pmins.
+
+    Parity caveat: candidate costs accumulate over the dp ring steps in a
+    different f32 summation order than the single-device full-axis
+    reduction; on a sub-ulp cost tie the two can select a
+    different-but-equally-optimal medoid.  Everything else (masking,
+    sentinels, lowest-index tie-break at equal floats) is exact.
+    """
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
+    n_loc, d = x_loc.shape
+    dp = lax.psum(1, data_axis)
+    me = lax.axis_index(data_axis)
+    n_total = n_loc * dp
+    row_ids = me * n_loc + jnp.arange(n_loc, dtype=jnp.int32)
+
+    xs, ws, _ = chunk_tiles(x_loc, w_loc, chunk_size)
+    xs_sq = sq_norms(xs)
+
+    inertia, lab_loc = _kmedoids_assign(
+        x_loc, w_loc, med_idx, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, metric=metric,
+    )
+
+    # --- ring cost sweep ------------------------------------------------
+    x_sq_loc = sq_norms(x_loc)
+
+    def ring_step(i, carry):
+        blk_x, blk_w, blk_lab, blk_sq, cost = carry
+
+        def tile_body(_, tile):
+            xb, wb, xb_sq, lab_b = tile
+            dist = _dist_tile(xb, blk_x.astype(cd).T, xb_sq, blk_sq,
+                              metric=metric, cd=cd)
+            same = lab_b[:, None] == blk_lab[None, :]       # (chunk, n_loc)
+            return 0, jnp.sum(jnp.where(same, dist, 0.0) * blk_w[None, :],
+                              axis=1)
+        lab_tiles = jnp.pad(
+            lab_loc, (0, xs.shape[0] * xs.shape[1] - n_loc),
+            constant_values=-1,
+        ).reshape(xs.shape[0], xs.shape[1])
+        _, partial = lax.scan(tile_body, 0, (xs, ws, xs_sq, lab_tiles))
+        cost = cost + partial.reshape(-1)[:n_loc]
+        # Rotate the visiting block to the next ring neighbor.
+        perm = [(s, (s + 1) % dp) for s in range(dp)]
+        blk_x = lax.ppermute(blk_x, data_axis, perm)
+        blk_w = lax.ppermute(blk_w, data_axis, perm)
+        blk_lab = lax.ppermute(blk_lab, data_axis, perm)
+        blk_sq = lax.ppermute(blk_sq, data_axis, perm)
+        return blk_x, blk_w, blk_lab, blk_sq, cost
+
+    _, _, _, _, cost = lax.fori_loop(
+        0, dp, ring_step,
+        (x_loc, w_loc, lab_loc, x_sq_loc, jnp.zeros((n_loc,), f32)),
+    )
+    # Candidate rows must be real data (w > 0); others cost inf.
+    cost = jnp.where(w_loc > 0, cost, jnp.inf)
+
+    # --- medoid selection: min cost, lowest-global-index tie-break ------
+    seg_min_loc = jax.ops.segment_min(cost, lab_loc, num_segments=k)
+    gmin = lax.pmin(seg_min_loc, data_axis)                 # (k,)
+    is_min = (cost <= gmin[lab_loc]) & jnp.isfinite(cost)
+    cand = jnp.where(is_min, row_ids, n_total)
+    cand_min_loc = jax.ops.segment_min(cand, lab_loc, num_segments=k)
+    new_idx = lax.pmin(cand_min_loc, data_axis)             # (k,) global rows
+    # Empty clusters (segment_min sentinel) keep their old medoid.
+    new_idx = jnp.where(new_idx >= n_total, med_idx, new_idx).astype(
+        jnp.int32)
+    return new_idx, inertia
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kmedoids_run(mesh, data_axis, k, chunk_size, compute_dtype,
+                        metric, max_it):
+    step = jax.shard_map(
+        functools.partial(
+            _kmedoids_sharded_body, data_axis=data_axis, k=k,
+            chunk_size=chunk_size, compute_dtype=compute_dtype,
+            metric=metric,
+        ),
+        mesh=mesh,
+        in_specs=(P(data_axis), P(data_axis), P()),
+        out_specs=(P(), P()), check_vma=False,
+    )
+    # Final pass = assignment only: no ring sweep, no selection.
+    final = jax.shard_map(
+        functools.partial(
+            _kmedoids_assign, data_axis=data_axis, chunk_size=chunk_size,
+            compute_dtype=compute_dtype, metric=metric,
+        ),
+        mesh=mesh,
+        in_specs=(P(data_axis), P(data_axis), P()),
+        out_specs=(P(), P(data_axis)), check_vma=False,
+    )
+
+    @jax.jit
+    def run(x, w, idx0):
+        def cond(s):
+            _, it, done = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            med_idx, it, _ = s
+            new_idx, _ = step(x, w, med_idx)
+            return (new_idx, it + 1, jnp.all(new_idx == med_idx))
+
+        med_idx, n_iter, converged = lax.while_loop(
+            cond, body, (idx0, jnp.zeros((), jnp.int32),
+                         jnp.zeros((), bool)),
+        )
+        inertia, labels = final(x, w, med_idx)
+        return med_idx, labels, inertia, n_iter, converged
+
+    return run
+
+
+def fit_kmedoids_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    weights=None,
+    data_axis: str = "data",
+    metric: str = "euclidean",
+    max_iter: Optional[int] = None,
+) -> KMedoidsState:
+    """k-medoids (alternate/Voronoi iteration) on a device mesh.
+
+    Same contract as :func:`kmeans_tpu.models.medoids.fit_kmedoids` — real
+    data rows as centers, euclidean/sqeuclidean metrics, lowest-index
+    tie-breaks — with the O(n²·d) pairwise cost computed by the ring pass
+    (module docstring).  ``init`` may be a (k,) array of global row
+    indices or an init-method name.
+    """
+    if metric not in ("euclidean", "sqeuclidean"):
+        raise ValueError(f"unknown metric {metric!r}")
+    cfg, key = resolve_fit_config(k, key, config)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes[data_axis]
+
+    from kmeans_tpu.models.medoids import resolve_medoid_init
+
+    n_real = x.shape[0]
+    if weights is not None and np.asarray(weights).shape != (n_real,):
+        raise ValueError(
+            f"weights shape {np.asarray(weights).shape} != ({n_real},)"
+        )
+    # Init resolves on the UNPADDED view via the shared helper, so every
+    # route (array / random / ++-sampling) picks the exact rows the
+    # single-device fit would for the same key (indices stay valid after
+    # padding — pads append at the end).
+    idx0 = resolve_medoid_init(
+        key, jnp.asarray(x), k, init=init, cfg=cfg,
+        weights=None if weights is None else jnp.asarray(weights),
+        metric=metric,
+    )
+
+    x, w_host, n = _pad_rows(x, dp, weights=weights)
+    xg = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+    idx0 = jax.device_put(idx0, NamedSharding(mesh, P()))
+
+    run = _build_kmedoids_run(
+        mesh, data_axis, k, cfg.chunk_size, cfg.compute_dtype, metric,
+        max_iter if max_iter is not None else cfg.max_iter,
+    )
+    med_idx, labels, inertia, n_iter, converged = run(xg, w, idx0)
+    return KMedoidsState(
+        # GSPMD gather of k rows across the shards — never materializes x.
+        medoids=jnp.asarray(xg[med_idx], jnp.float32),
+        medoid_indices=med_idx,
+        labels=labels[:n],
+        inertia=inertia,
+        n_iter=n_iter,
+        converged=converged,
+    )

@@ -531,3 +531,64 @@ def test_fuzzy_sharded_validation(cpu_devices):
     x = np.zeros((64, 8), np.float32)
     with pytest.raises(ValueError, match="m must be > 1"):
         fit_fuzzy_sharded(x, 2, mesh=cpu_mesh((8, 1)), m=1.0)
+
+
+@pytest.mark.parametrize("shape,metric", [
+    ((2, 1), "euclidean"),
+    ((8, 1), "euclidean"),
+    ((4, 1), "sqeuclidean"),
+])
+def test_kmedoids_sharded_matches_single_device(cpu_devices, shape, metric):
+    """The ring-pass pairwise cost sweep reproduces the single-device
+    alternate iteration exactly: same medoid rows, labels, inertia."""
+    from kmeans_tpu.models import fit_kmedoids
+    from kmeans_tpu.parallel import fit_kmedoids_sharded
+
+    rng = np.random.default_rng(15)
+    x, _, _ = make_blobs(jax.random.key(15), 203, 6, 4, cluster_std=0.5)
+    x = np.asarray(x)                       # 203: uneven over every mesh
+    idx0 = np.asarray([0, 50, 100, 150], np.int32)
+
+    want = fit_kmedoids(jnp.asarray(x), 4, init=jnp.asarray(idx0),
+                        metric=metric, max_iter=20)
+    got = fit_kmedoids_sharded(
+        x, 4, mesh=cpu_mesh(shape), init=idx0, metric=metric, max_iter=20,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.medoid_indices), np.asarray(want.medoid_indices)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.medoids), np.asarray(want.medoids), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(got.inertia), float(want.inertia), rtol=1e-4
+    )
+    assert int(got.n_iter) == int(want.n_iter)
+    assert bool(got.converged) == bool(want.converged)
+
+
+def test_kmedoids_sharded_weighted_and_seeded(cpu_devices):
+    from kmeans_tpu.models import fit_kmedoids
+    from kmeans_tpu.parallel import fit_kmedoids_sharded
+
+    rng = np.random.default_rng(16)
+    x, _, _ = make_blobs(jax.random.key(16), 160, 4, 3, cluster_std=0.4)
+    x = np.asarray(x)
+    w = rng.uniform(0.2, 2.0, 160).astype(np.float32)
+
+    want = fit_kmedoids(jnp.asarray(x), 3, key=jax.random.key(5),
+                        weights=jnp.asarray(w), max_iter=15)
+    got = fit_kmedoids_sharded(
+        x, 3, mesh=cpu_mesh((8, 1)), key=jax.random.key(5), weights=w,
+        max_iter=15,
+    )
+    # Seeding runs on the same (padded-weights) view; rows match exactly.
+    np.testing.assert_array_equal(
+        np.asarray(got.medoid_indices), np.asarray(want.medoid_indices)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )

@@ -18,6 +18,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -63,10 +64,35 @@ print("CPU_ONLY_OK")
     assert "CPU_ONLY_OK" in proc.stdout
 
 
+def _accelerator_init_completes(timeout_s: float = 60.0) -> bool:
+    """Whether default-backend init finishes at all: with a dead tunnel
+    relay, PJRT client init HANGS (not errors), which would stall any test
+    whose subprocess touches jax.devices() before pinning."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            cwd=_REPO, env=env, capture_output=True, timeout=timeout_s,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def test_dryrun_hermetic_with_poisoned_default_backend():
     """dryrun_multichip(8) must succeed when every touch of the default
     backend raises — proving data gen / RNG / reference fit are all pinned
-    to the mesh devices (VERDICT.md round-1 'Next round' item 1)."""
+    to the mesh devices (VERDICT.md round-1 'Next round' item 1).
+
+    This simulates round 1's failure mode (backend initializes, every USE
+    fails), which requires initializing the backend first — impossible when
+    the accelerator runtime can't even init (a dead relay hangs there; that
+    mode is covered by test_dryrun_never_initializes_accelerator_plugin).
+    """
+    if not _accelerator_init_completes():
+        pytest.skip("default-backend init hangs/fails (dead accelerator "
+                    "tunnel) — the no-init hermeticity test covers this mode")
     script = r"""
 import os
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")

@@ -22,6 +22,7 @@ from kmeans_tpu.config import KMeansConfig, MeshConfig, RunConfig, ServeConfig
 from kmeans_tpu.models import (
     BisectingKMeans,
     FuzzyCMeans,
+    GaussianMixture,
     KMeans,
     KMeansState,
     KMedoids,
@@ -29,6 +30,7 @@ from kmeans_tpu.models import (
     SphericalKMeans,
     fit_bisecting,
     fit_fuzzy,
+    fit_gmm,
     fit_kmedoids,
     fit_gmeans,
     fit_xmeans,
@@ -49,6 +51,7 @@ __all__ = [
     "ServeConfig",
     "BisectingKMeans",
     "FuzzyCMeans",
+    "GaussianMixture",
     "KMeans",
     "KMeansState",
     "KMedoids",
@@ -56,6 +59,7 @@ __all__ = [
     "SphericalKMeans",
     "fit_bisecting",
     "fit_fuzzy",
+    "fit_gmm",
     "fit_kmedoids",
     "fit_gmeans",
     "fit_xmeans",

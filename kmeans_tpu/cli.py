@@ -144,7 +144,7 @@ def _cmd_train(args) -> int:
         return 2
 
     coreset_ok = ("lloyd", "accelerated", "spherical", "bisecting", "fuzzy",
-                  "kmedoids")
+                  "gmm", "kmedoids")
     fit_weights = None
     if args.coreset is not None:
         if args.coreset < 1:
@@ -209,6 +209,7 @@ def _cmd_train(args) -> int:
             "spherical": models.fit_spherical,
             "bisecting": models.fit_bisecting,
             "fuzzy": models.fit_fuzzy,
+            "gmm": models.fit_gmm,
             "kmedoids": models.fit_kmedoids,
             "xmeans": models.fit_xmeans,   # --k is k_max; k is discovered
             "gmeans": models.fit_gmeans,   # likewise (Anderson-Darling)
@@ -221,9 +222,18 @@ def _cmd_train(args) -> int:
             k = int(state.centroids.shape[0])
     jax_done = time.perf_counter() - t0
 
+    # Objective key: hard families report inertia, fuzzy reports its J, the
+    # GMM reports (negated) log-likelihood — one "inertia" field, lower =
+    # better for all of them, so sweep tooling can compare runs uniformly.
+    if hasattr(state, "inertia"):
+        objective = float(state.inertia)
+    elif hasattr(state, "objective"):
+        objective = float(state.objective)
+    else:
+        objective = -float(state.log_likelihood)
     result = {
         "n": int(n), "d": int(d), "k": int(k),
-        "inertia": float(getattr(state, "inertia", getattr(state, "objective", 0.0))),
+        "inertia": objective,
         "n_iter": int(state.n_iter),
         "converged": bool(state.converged),
         "wall_s": round(jax_done, 4),
@@ -326,7 +336,7 @@ def main(argv=None) -> int:
                    "(named configs set it from BASELINE)")
     t.add_argument("--model", default=None, choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "kmedoids", "xmeans", "gmeans",
+        "fuzzy", "gmm", "kmedoids", "xmeans", "gmeans",
     ], help="model family (default: lloyd, or the config's minibatch "
             "choice); for xmeans/gmeans, --k is k_max and k is discovered")
     t.add_argument("--init", default="k-means++",

@@ -15,6 +15,14 @@ from kmeans_tpu.models.init import (
     kmeans_plus_plus,
     random_init,
 )
+from kmeans_tpu.models.gmm import (
+    GaussianMixture,
+    GMMParams,
+    GMMState,
+    fit_gmm,
+    gmm_log_resp,
+    gmm_predict,
+)
 from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.medoids import KMedoids, KMedoidsState, fit_kmedoids
@@ -44,6 +52,12 @@ __all__ = [
     "bic_score",
     "fit_xmeans",
     "LloydRunner",
+    "GaussianMixture",
+    "GMMParams",
+    "GMMState",
+    "fit_gmm",
+    "gmm_log_resp",
+    "gmm_predict",
     "fit_bisecting",
     "fit_fuzzy",
     "fuzzy_memberships",

@@ -129,3 +129,16 @@ def test_train_gmeans_discovers_k(capsys):
     res = json.loads(out.splitlines()[0])
     assert 1 <= res["k"] <= 8
     assert res["mode"] == "gmeans"
+
+
+def test_train_gmm_family(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "400", "--d", "4", "--k", "3", "--model", "gmm",
+        "--max-iter", "20",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "gmm"
+    # "inertia" carries the negated log-likelihood for the GMM family.
+    assert np.isfinite(res["inertia"])
+    assert res["n_iter"] >= 1

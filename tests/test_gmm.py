@@ -1,0 +1,205 @@
+"""Gaussian mixture (EM) vs a NumPy oracle; properties; estimator surface."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import GaussianMixture, fit_gmm, gmm_log_resp
+from kmeans_tpu.models.gmm import GMMParams
+
+
+def _oracle_em(x, c0, *, covariance_type="diag", reg_covar=1e-6,
+               max_iter=50, tol=1e-10, weights=None):
+    """Textbook diag/spherical-covariance EM in float64 NumPy, with the same
+    init policy as fit_gmm (global feature variance, uniform pi)."""
+    x = np.asarray(x, np.float64)
+    n, d = x.shape
+    w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
+    k = c0.shape[0]
+    mu = np.asarray(c0, np.float64).copy()
+    gmean = (w @ x) / w.sum()
+    gvar = np.maximum((w @ (x * x)) / w.sum() - gmean * gmean, 0.0)
+    if covariance_type == "spherical":
+        gvar = np.full(d, gvar.mean())
+    var = np.tile(gvar + reg_covar, (k, 1))
+    pi = np.full(k, 1.0 / k)
+    prev = -np.inf
+    it = 0
+    for it in range(1, max_iter + 1):
+        diff = x[:, None, :] - mu[None, :, :]
+        logp = (
+            np.log(pi)[None, :]
+            - 0.5 * (d * math.log(2 * math.pi)
+                     + np.log(var).sum(1)[None, :]
+                     + (diff * diff / var[None, :, :]).sum(-1))
+        )
+        row_max = logp.max(1, keepdims=True)
+        lse = row_max[:, 0] + np.log(np.exp(logp - row_max).sum(1))
+        r = np.exp(logp - lse[:, None]) * w[:, None]
+        ll = float(w @ lse)
+        N = r.sum(0)
+        alive = N > 1e-12
+        denom = np.where(alive, N, 1.0)
+        mu = np.where(alive[:, None], (r.T @ x) / denom[:, None], mu)
+        v = (r.T @ (x * x)) / denom[:, None] - mu * mu
+        if covariance_type == "spherical":
+            v = np.tile(v.mean(1, keepdims=True), (1, d))
+        v = np.maximum(v, 0.0) + reg_covar
+        var = np.where(alive[:, None], v, var)
+        pi = N / N.sum()
+        mean_ll = ll / w.sum()
+        if abs(mean_ll - prev) <= tol:
+            break
+        prev = mean_ll
+    # final evaluation at the converged parameters
+    diff = x[:, None, :] - mu[None, :, :]
+    logp = (
+        np.log(np.maximum(pi, 1e-300))[None, :]
+        - 0.5 * (d * math.log(2 * math.pi)
+                 + np.log(var).sum(1)[None, :]
+                 + (diff * diff / var[None, :, :]).sum(-1))
+    )
+    row_max = logp.max(1, keepdims=True)
+    lse = row_max[:, 0] + np.log(np.exp(logp - row_max).sum(1))
+    return mu, var, pi, float(w @ lse), logp.argmax(1)
+
+
+@pytest.mark.parametrize("covariance_type", ["diag", "spherical"])
+def test_gmm_matches_numpy_oracle(rng, covariance_type):
+    x = rng.normal(size=(200, 3)).astype(np.float32)
+    x[:100] += 4.0
+    c0 = np.stack([x[:100].mean(0) + 0.3, x[100:].mean(0) - 0.3])
+    state = fit_gmm(
+        jnp.asarray(x), 2, covariance_type=covariance_type,
+        init=jnp.asarray(c0), tol=1e-8, max_iter=60,
+        config=KMeansConfig(k=2, init="given", chunk_size=64),
+    )
+    mu, var, pi, ll, labels = _oracle_em(
+        x, c0, covariance_type=covariance_type, tol=1e-8, max_iter=60
+    )
+    np.testing.assert_allclose(np.asarray(state.means), mu,
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(state.covariances), var,
+                               rtol=1e-2, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(state.mix_weights), pi, atol=1e-3)
+    np.testing.assert_allclose(float(state.log_likelihood), ll, rtol=1e-4)
+    np.testing.assert_array_equal(np.asarray(state.labels), labels)
+
+
+def test_gmm_weighted_equals_replicated(rng):
+    """Integer sample weights must equal physically replicating the rows."""
+    x = rng.normal(size=(60, 2)).astype(np.float32)
+    w = rng.integers(1, 4, size=60).astype(np.float32)
+    c0 = x[:3].copy()
+    rep = np.repeat(x, w.astype(int), axis=0)
+    cfg = KMeansConfig(k=3, init="given", chunk_size=32)
+    sw = fit_gmm(jnp.asarray(x), 3, init=jnp.asarray(c0), tol=1e-9,
+                 max_iter=30, weights=jnp.asarray(w), config=cfg)
+    sr = fit_gmm(jnp.asarray(rep), 3, init=jnp.asarray(c0), tol=1e-9,
+                 max_iter=30, config=cfg)
+    np.testing.assert_allclose(np.asarray(sw.means), np.asarray(sr.means),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        float(sw.log_likelihood), float(sr.log_likelihood), rtol=1e-4
+    )
+
+
+def test_gmm_loglik_monotone_nondecreasing(rng):
+    """EM's defining property: the log-likelihood never decreases."""
+    key = jax.random.key(3)
+    x, _, _ = make_blobs(key, n=300, d=4, k=3, cluster_std=2.0)
+    c0 = np.asarray(x[:3])
+    lls = []
+    for it in range(1, 8):
+        s = fit_gmm(x, 3, init=jnp.asarray(c0), tol=0.0, max_iter=it,
+                    config=KMeansConfig(k=3, init="given", chunk_size=128))
+        lls.append(float(s.log_likelihood))
+    diffs = np.diff(np.array(lls))
+    assert np.all(diffs >= -1e-2 * np.abs(np.array(lls[1:]))), lls
+
+
+def test_gmm_recovers_separated_blobs():
+    key = jax.random.key(0)
+    x, true_labels, _ = make_blobs(key, n=600, d=8, k=4)
+    gm = GaussianMixture(n_components=4, seed=0, chunk_size=256).fit(x)
+    # agreement up to permutation: each true cluster maps to one component
+    from kmeans_tpu.metrics import adjusted_rand_index
+
+    ari = float(adjusted_rand_index(jnp.asarray(true_labels), gm.labels_))
+    assert ari > 0.99, ari
+    assert gm.converged_
+    np.testing.assert_allclose(np.asarray(gm.weights_).sum(), 1.0, rtol=1e-5)
+
+
+def test_gmm_resp_rows_sum_to_one_and_score(rng):
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    gm = GaussianMixture(n_components=3, seed=1, chunk_size=32,
+                         max_iter=10).fit(jnp.asarray(x))
+    proba = np.asarray(gm.predict_proba(jnp.asarray(x)))
+    np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+    labels = np.asarray(gm.predict(jnp.asarray(x)))
+    np.testing.assert_array_equal(labels, proba.argmax(1))
+    # score is the mean of score_samples
+    np.testing.assert_allclose(
+        gm.score(jnp.asarray(x)),
+        float(np.mean(np.asarray(gm.score_samples(jnp.asarray(x))))),
+        rtol=1e-6,
+    )
+
+
+def test_gmm_bic_aic_formulas(rng):
+    x = rng.normal(size=(80, 2)).astype(np.float32)
+    gm = GaussianMixture(n_components=2, seed=0, chunk_size=64,
+                         max_iter=5).fit(jnp.asarray(x))
+    n = 80
+    p = 2 * 2 + 2 * 2 + 1   # means + diag covs + (k-1) weights
+    ll = gm.score(jnp.asarray(x)) * n
+    np.testing.assert_allclose(gm.bic(jnp.asarray(x)),
+                               -2 * ll + p * math.log(n), rtol=1e-6)
+    np.testing.assert_allclose(gm.aic(jnp.asarray(x)),
+                               -2 * ll + 2 * p, rtol=1e-6)
+    # spherical has fewer covariance parameters -> different penalty
+    gs = GaussianMixture(n_components=2, covariance_type="spherical", seed=0,
+                         chunk_size=64, max_iter=5).fit(jnp.asarray(x))
+    assert gs._n_parameters() == 2 * 2 + 2 + 1
+    assert gs.covariances_.shape == (2,)
+
+
+def test_gmm_spherical_variances_constant_per_component(rng):
+    x = rng.normal(size=(100, 5)).astype(np.float32)
+    s = fit_gmm(jnp.asarray(x), 3, covariance_type="spherical",
+                init=jnp.asarray(x[:3]), max_iter=8,
+                config=KMeansConfig(k=3, init="given", chunk_size=64))
+    cov = np.asarray(s.covariances)
+    np.testing.assert_allclose(
+        cov, np.broadcast_to(cov[:, :1], cov.shape), rtol=1e-6
+    )
+
+
+def test_gmm_input_validation(rng):
+    x = jnp.asarray(rng.normal(size=(20, 2)).astype(np.float32))
+    with pytest.raises(ValueError, match="covariance_type"):
+        fit_gmm(x, 2, covariance_type="full")
+    with pytest.raises(ValueError, match="reg_covar"):
+        fit_gmm(x, 2, reg_covar=-1.0)
+    with pytest.raises(ValueError, match="shape"):
+        fit_gmm(x, 2, init=jnp.zeros((3, 2)))
+
+
+def test_gmm_log_resp_matches_state_labels(rng):
+    x = jnp.asarray(rng.normal(size=(40, 3)).astype(np.float32))
+    s = fit_gmm(x, 2, init=x[:2], max_iter=6,
+                config=KMeansConfig(k=2, init="given", chunk_size=16))
+    params = GMMParams(
+        s.means, s.covariances, jnp.log(jnp.maximum(s.mix_weights, 1e-37))
+    )
+    log_resp, log_prob = gmm_log_resp(x, params, chunk_size=16)
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(log_resp, axis=1)), np.asarray(s.labels)
+    )
+    assert log_prob.shape == (40,)

@@ -132,9 +132,11 @@ def _cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if mesh is not None and model not in ("lloyd", "minibatch"):
+    mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kmedoids")
+    if mesh is not None and model not in mesh_ok:
         print(
-            f"error: --mesh supports --model lloyd/minibatch, not {model}",
+            f"error: --mesh supports --model {'/'.join(mesh_ok)}, "
+            f"not {model}",
             file=sys.stderr,
         )
         return 2
@@ -195,9 +197,16 @@ def _cmd_train(args) -> int:
                 checkpoint_every=args.checkpoint_every,
             )
     elif mesh is not None:
-        from kmeans_tpu.parallel import fit_lloyd_sharded, fit_minibatch_sharded
+        from kmeans_tpu import parallel
 
-        fit = fit_minibatch_sharded if minibatch else fit_lloyd_sharded
+        fit = {
+            "lloyd": parallel.fit_lloyd_sharded,
+            "minibatch": parallel.fit_minibatch_sharded,
+            "spherical": parallel.fit_spherical_sharded,
+            "fuzzy": parallel.fit_fuzzy_sharded,
+            "gmm": parallel.fit_gmm_sharded,
+            "kmedoids": parallel.fit_kmedoids_sharded,
+        }[model]
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
     elif args.stream:
         state = models.fit_minibatch_stream(x, k, config=kcfg)

@@ -87,6 +87,22 @@ def _logp_terms(params: GMMParams):
     return inv_var, lin, const
 
 
+def _logp_tile(xb, inv_var_t, lin_t, const, cd):
+    """(chunk, k) component log-densities for one row tile — THE one copy
+    of the E-step matmul pair, shared by the training scan, predict, and
+    log_resp so they can't drift.  Also returns the f32 ``xb²`` the
+    M-step moment matmul reuses."""
+    f32 = jnp.float32
+    xb_f = xb.astype(f32)
+    xb_sq = xb_f * xb_f
+    quad = jnp.matmul(xb_sq.astype(cd), inv_var_t,
+                      preferred_element_type=f32,
+                      precision=matmul_precision(cd))
+    cross = jnp.matmul(xb.astype(cd), lin_t, preferred_element_type=f32,
+                       precision=matmul_precision(cd))
+    return const[None, :] + cross - 0.5 * quad, xb_sq
+
+
 def gmm_scan_tiles(xs, ws, params: GMMParams, *, compute_dtype, with_labels,
                    with_moments=True):
     """The EM tile scan — log-density tile, responsibilities, weighted soft
@@ -111,22 +127,15 @@ def gmm_scan_tiles(xs, ws, params: GMMParams, *, compute_dtype, with_labels,
     def body(carry, tile):
         N, S, Q, ll = carry
         xb, wb = tile
-        xb_f = xb.astype(f32)
-        xb_c = xb.astype(cd)
-        xb_sq = xb_f * xb_f                                # (chunk, d) f32
-        quad = jnp.matmul(xb_sq.astype(cd), inv_var_t,
-                          preferred_element_type=f32,
-                          precision=matmul_precision(cd))
-        cross = jnp.matmul(xb_c, lin_t, preferred_element_type=f32,
-                           precision=matmul_precision(cd))
-        logp = const[None, :] + cross - 0.5 * quad         # (chunk, k)
+        logp, xb_sq = _logp_tile(xb, inv_var_t, lin_t, const, cd)
         row_ll = jax.nn.logsumexp(logp, axis=1)            # (chunk,)
         r = jnp.exp(logp - row_ll[:, None]) * wb[:, None]  # weighted resp
         ll = ll + jnp.sum(wb * row_ll)
         N = N + jnp.sum(r, axis=0)
         if with_moments:
             r_c = r.astype(cd)
-            S = S + jnp.matmul(r_c.T, xb_c, preferred_element_type=f32,
+            S = S + jnp.matmul(r_c.T, xb.astype(cd),
+                               preferred_element_type=f32,
                                precision=matmul_precision(cd))
             Q = Q + jnp.matmul(r_c.T, xb_sq.astype(cd),
                                preferred_element_type=f32,
@@ -315,7 +324,6 @@ def gmm_log_resp(
     ``exp(log_resp)`` rows sum to 1 (predict_proba); ``log_prob`` is the
     per-sample mixture log-density (score_samples).
     """
-    f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
     n = x.shape[0]
     xs, _, _ = chunk_tiles(x, None, chunk_size)
@@ -324,13 +332,7 @@ def gmm_log_resp(
     lin_t = lin.astype(cd).T
 
     def body(_, xb):
-        xb_f = xb.astype(f32)
-        quad = jnp.matmul((xb_f * xb_f).astype(cd), inv_var_t,
-                          preferred_element_type=f32,
-                          precision=matmul_precision(cd))
-        cross = jnp.matmul(xb.astype(cd), lin_t, preferred_element_type=f32,
-                           precision=matmul_precision(cd))
-        logp = const[None, :] + cross - 0.5 * quad
+        logp, _ = _logp_tile(xb, inv_var_t, lin_t, const, cd)
         row_ll = jax.nn.logsumexp(logp, axis=1)
         return 0, (logp - row_ll[:, None], row_ll)
 
@@ -350,7 +352,6 @@ def gmm_predict(
     """Component labels (argmax responsibility), tiled — never materializes
     the (n, k) responsibility matrix (``gmm_log_resp`` does; at k=1000 and
     n=10M that buffer alone is 40 GB)."""
-    f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
     n = x.shape[0]
     xs, _, _ = chunk_tiles(x, None, chunk_size)
@@ -359,13 +360,7 @@ def gmm_predict(
     lin_t = lin.astype(cd).T
 
     def body(_, xb):
-        xb_f = xb.astype(f32)
-        quad = jnp.matmul((xb_f * xb_f).astype(cd), inv_var_t,
-                          preferred_element_type=f32,
-                          precision=matmul_precision(cd))
-        cross = jnp.matmul(xb.astype(cd), lin_t, preferred_element_type=f32,
-                           precision=matmul_precision(cd))
-        logp = const[None, :] + cross - 0.5 * quad
+        logp, _ = _logp_tile(xb, inv_var_t, lin_t, const, cd)
         return 0, jnp.argmax(logp, axis=1).astype(jnp.int32)
 
     _, labs = lax.scan(body, 0, xs)

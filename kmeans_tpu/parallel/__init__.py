@@ -4,6 +4,7 @@ from kmeans_tpu.parallel.distributed import ensure_initialized, process_info
 from kmeans_tpu.parallel.medoids import fit_kmedoids_sharded
 from kmeans_tpu.parallel.engine import (
     fit_fuzzy_sharded,
+    fit_gmm_sharded,
     fit_lloyd_sharded,
     fit_minibatch_sharded,
     fit_spherical_sharded,
@@ -15,6 +16,7 @@ __all__ = [
     "ensure_initialized",
     "process_info",
     "fit_fuzzy_sharded",
+    "fit_gmm_sharded",
     "fit_kmedoids_sharded",
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",

@@ -51,6 +51,7 @@ from kmeans_tpu.ops.update import apply_update
 
 __all__ = [
     "fit_fuzzy_sharded",
+    "fit_gmm_sharded",
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",
     "fit_spherical_sharded",
@@ -986,6 +987,188 @@ def fit_fuzzy_sharded(
     tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
     c, labels, obj, n_iter, converged, counts = run(x, w, c0, tol_v)
     return FuzzyState(c, labels[:n], obj, n_iter, converged, counts)
+
+
+@functools.partial(jax.jit, static_argnames=("covariance_type",))
+def _gmm_init_params(x, w, c0, reg_covar, *, covariance_type):
+    """Module-level (so the jit cache persists across fits) sharded analog
+    of :func:`kmeans_tpu.models.gmm.init_gmm_params`: global weighted
+    per-feature variance via auto-sharded reductions, uniform mixing."""
+    from kmeans_tpu.models.gmm import GMMParams
+
+    f32 = jnp.float32
+    k = c0.shape[0]
+    xf = x.astype(f32)
+    tw = jnp.sum(w)
+    mean = (w @ xf) / tw
+    var = jnp.maximum((w @ (xf * xf)) / tw - mean * mean, 0.0)
+    if covariance_type == "spherical":
+        var = jnp.mean(var) * jnp.ones_like(var)
+    var = var + reg_covar
+    return GMMParams(
+        c0.astype(f32),
+        jnp.broadcast_to(var, c0.shape).astype(f32),
+        jnp.full((k,), -jnp.log(float(k)), f32),
+    )
+
+
+def _gmm_local_pass(x_loc, params, w_loc, *, data_axis, chunk_size,
+                    compute_dtype, covariance_type, reg_covar, with_labels):
+    """DP shard body for GMM EM: responsibilities are row-local given
+    replicated parameters, so one ``psum`` of the soft moments
+    (N, S, Q, log-likelihood) per pass is the whole collective story —
+    the M-step then runs replicated on every device."""
+    from kmeans_tpu.models.gmm import gmm_m_step, gmm_scan_tiles
+
+    xs, ws, n_loc = chunk_tiles(x_loc, w_loc, chunk_size)
+    N, S, Q, ll, labs = gmm_scan_tiles(
+        xs, ws, params, compute_dtype=compute_dtype,
+        with_labels=with_labels, with_moments=not with_labels,
+    )
+    N = lax.psum(N, data_axis)
+    ll = lax.psum(ll, data_axis)
+    if with_labels:
+        # Final pass: no M-step follows (moments were skipped above).
+        return N, ll, labs.reshape(-1)[:n_loc]
+    S = lax.psum(S, data_axis)
+    Q = lax.psum(Q, data_axis)
+    new_params = gmm_m_step(
+        params, N, S, Q, covariance_type=covariance_type,
+        reg_covar=reg_covar,
+    )
+    return new_params, N, ll
+
+
+@functools.lru_cache(maxsize=32)
+def _build_gmm_run(mesh, data_axis, chunk_size, compute_dtype,
+                   covariance_type, reg_covar, max_it):
+    from kmeans_tpu.models.gmm import GMMParams, GMMState
+
+    local = functools.partial(
+        _gmm_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, covariance_type=covariance_type,
+        reg_covar=reg_covar,
+    )
+    params_spec = GMMParams(P(), P(), P())
+    step = jax.shard_map(
+        functools.partial(local, with_labels=False), mesh=mesh,
+        in_specs=(P(data_axis), params_spec, P(data_axis)),
+        out_specs=(params_spec, P(), P()), check_vma=False,
+    )
+    final = jax.shard_map(
+        functools.partial(local, with_labels=True), mesh=mesh,
+        in_specs=(P(data_axis), params_spec, P(data_axis)),
+        out_specs=(P(), P(), P(data_axis)), check_vma=False,
+    )
+
+    @jax.jit
+    def run(x, w, params0, tol_v):
+        total_w = jnp.sum(w)
+
+        def cond(s):
+            params, it, prev_ll, done = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            params, it, prev_ll, _ = s
+            new_params, _, ll = step(x, params, w)
+            mean_ll = ll / total_w
+            done = jnp.abs(mean_ll - prev_ll) <= tol_v
+            return (new_params, it + 1, mean_ll, done)
+
+        params, n_iter, _, converged = lax.while_loop(
+            cond, body,
+            (params0, jnp.zeros((), jnp.int32),
+             jnp.asarray(-jnp.inf, jnp.float32), jnp.zeros((), bool)),
+        )
+        N, ll, labels = final(x, params, w)
+        return GMMState(
+            params.means, params.variances, jnp.exp(params.log_pi), labels,
+            ll, n_iter, converged, N,
+        )
+
+    return run
+
+
+def fit_gmm_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    covariance_type: str = "diag",
+    reg_covar: float = 1e-6,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    weights=None,
+    data_axis: str = "data",
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+):
+    """Gaussian mixture EM on a device mesh (DP over points).
+
+    Responsibilities depend only on a row's log-densities under the
+    replicated parameters, so the sharding story is exactly Lloyd's: local
+    soft moments, one ``psum`` per pass.  Returns a
+    :class:`kmeans_tpu.models.gmm.GMMState` equal to the single-device
+    :func:`kmeans_tpu.models.gmm.fit_gmm` (labels exactly; floats to
+    tolerance).  TP/FP layouts are not offered — like fuzzy, the GMM is
+    used at moderate k where DP covers the scale story.
+    """
+    from kmeans_tpu.models.gmm import GMMParams, GMMState
+
+    if covariance_type not in ("diag", "spherical"):
+        raise ValueError(
+            f"covariance_type must be 'diag' or 'spherical', "
+            f"got {covariance_type!r}"
+        )
+    if not reg_covar >= 0.0:
+        raise ValueError(f"reg_covar must be >= 0, got {reg_covar}")
+    cfg, key = resolve_fit_config(k, key, config)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes[data_axis]
+
+    if weights is not None and np.asarray(weights).shape != (x.shape[0],):
+        raise ValueError(
+            f"weights shape {np.asarray(weights).shape} != ({x.shape[0]},)"
+        )
+    x, w_host, n = _pad_rows(x, dp, weights=weights)
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, x.shape[1]):
+            raise ValueError(f"init centroids shape {c0.shape} != "
+                             f"{(k, x.shape[1])}")
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        c0 = init_centroids(
+            key, x, k, method=method, weights=w,
+            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        )
+
+    # Global weighted feature moments on the sharded array (auto-sharded
+    # reductions; padding rows carry weight 0) -> same init params as the
+    # single-device fit_gmm.
+    params0 = jax.device_put(
+        _gmm_init_params(x, w, c0, jnp.asarray(reg_covar, jnp.float32),
+                         covariance_type=covariance_type),
+        GMMParams(*(NamedSharding(mesh, P()),) * 3),
+    )
+
+    run = _build_gmm_run(
+        mesh, data_axis, cfg.chunk_size, cfg.compute_dtype,
+        covariance_type, float(reg_covar),
+        max_iter if max_iter is not None else cfg.max_iter,
+    )
+    tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
+    state = run(x, w, params0, tol_v)
+    return GMMState(
+        state.means, state.covariances, state.mix_weights,
+        state.labels[:n], state.log_likelihood, state.n_iter,
+        state.converged, state.resp_counts,
+    )
 
 
 def sharded_assign(

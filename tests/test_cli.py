@@ -142,3 +142,16 @@ def test_train_gmm_family(capsys):
     # "inertia" carries the negated log-likelihood for the GMM family.
     assert np.isfinite(res["inertia"])
     assert res["n_iter"] >= 1
+
+
+def test_train_mesh_soft_families(capsys):
+    # The sharded soft/alternate families are reachable from the CLI.
+    for model in ("gmm", "fuzzy"):
+        rc, out, _ = _run(capsys, [
+            "train", "--n", "300", "--d", "4", "--k", "3",
+            "--model", model, "--mesh", "4", "--max-iter", "10",
+        ])
+        assert rc in (0, None), model
+        res = json.loads(out.splitlines()[0])
+        assert res["mode"] == model
+        assert np.isfinite(res["inertia"])

@@ -592,3 +592,60 @@ def test_kmedoids_sharded_weighted_and_seeded(cpu_devices):
     np.testing.assert_array_equal(
         np.asarray(got.labels), np.asarray(want.labels)
     )
+
+
+@pytest.mark.parametrize("shape", [(2, 1), (8, 1)])
+def test_gmm_sharded_matches_single_device(cpu_devices, shape):
+    """Sharded GMM EM (soft-moment psums) equals single-device fit_gmm."""
+    from kmeans_tpu.models import fit_gmm
+    from kmeans_tpu.parallel import fit_gmm_sharded
+
+    rng = np.random.default_rng(21)
+    x, _, _ = make_blobs(jax.random.key(21), 403, 6, 3, cluster_std=0.8)
+    x = np.asarray(x)
+    c0 = x[:3].copy()
+    w = rng.uniform(0.2, 2.0, 403).astype(np.float32)
+
+    want = fit_gmm(jnp.asarray(x), 3, init=jnp.asarray(c0),
+                   weights=jnp.asarray(w), tol=1e-9, max_iter=20)
+    got = fit_gmm_sharded(
+        x, 3, mesh=cpu_mesh(shape), init=c0, weights=w,
+        tol=1e-9, max_iter=20,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.means), np.asarray(want.means), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.covariances), np.asarray(want.covariances),
+        rtol=1e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(got.log_likelihood), float(want.log_likelihood), rtol=1e-4
+    )
+    assert int(got.n_iter) == int(want.n_iter)
+    np.testing.assert_allclose(np.asarray(got.mix_weights).sum(), 1.0,
+                               rtol=1e-5)
+
+
+def test_gmm_sharded_spherical_and_validation(cpu_devices):
+    from kmeans_tpu.models import fit_gmm
+    from kmeans_tpu.parallel import fit_gmm_sharded
+
+    x, _, _ = make_blobs(jax.random.key(5), 200, 4, 2, cluster_std=0.5)
+    x = np.asarray(x)
+    c0 = x[:2].copy()
+    want = fit_gmm(jnp.asarray(x), 2, covariance_type="spherical",
+                   init=jnp.asarray(c0), tol=1e-9, max_iter=15)
+    got = fit_gmm_sharded(x, 2, mesh=cpu_mesh((4, 1)),
+                          covariance_type="spherical", init=c0,
+                          tol=1e-9, max_iter=15)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    cov = np.asarray(got.covariances)
+    np.testing.assert_allclose(cov, np.broadcast_to(cov[:, :1], cov.shape),
+                               rtol=1e-6)
+    with pytest.raises(ValueError, match="covariance_type"):
+        fit_gmm_sharded(x, 2, mesh=cpu_mesh((4, 1)), covariance_type="full")

@@ -89,6 +89,8 @@ def fit_minibatch_stream(
     steps: Optional[int] = None,
     seed: Optional[int] = None,
     prefetch_depth: int = 2,
+    background_prefetch: bool = True,
+    transfer_dtype: Optional[str] = None,
     final_pass: bool = True,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 100,
@@ -108,6 +110,17 @@ def fit_minibatch_stream(
     batches are a pure function of (seed, step) the resumed run replays the
     exact sequence an uninterrupted run would have seen (long streams
     survive preemption losing at most ``checkpoint_every`` steps).
+
+    Host-side pipeline knobs: ``background_prefetch`` moves gather +
+    device_put onto a producer thread (the native loader releases the GIL,
+    so it overlaps device compute); ``transfer_dtype="auto"`` ships batches
+    as bf16 when ``config.compute_dtype`` is bfloat16, halving PCIe bytes.
+    The assignment matmul already bf16-rounds rows in that regime, but the
+    M-step centroid accumulation then sums the rounded values instead of
+    full-precision f32 — results shift at bf16 resolution, so half-width
+    transfer is opt-in (default ``None`` = full-width) and a checkpoint
+    stream replays identically only under the transfer_dtype it was
+    started with.
     """
     cfg, key = resolve_fit_config(k, key, config)
     n, d = data.shape
@@ -209,11 +222,32 @@ def fit_minibatch_stream(
                    "batch_size": int(bs), "total_steps": int(n_steps)},
         )
 
+    if transfer_dtype not in (None, "auto", "float32", "bfloat16"):
+        raise ValueError(
+            f"transfer_dtype must be auto/float32/bfloat16/None, "
+            f"got {transfer_dtype!r}"
+        )
+    data_is_f32 = np.dtype(data.dtype) == np.float32
+    if transfer_dtype == "bfloat16" and not data_is_f32:
+        # Fail here, not inside the producer thread mid-stream.
+        raise ValueError(
+            f"transfer_dtype='bfloat16' requires float32 data, "
+            f"got {np.dtype(data.dtype)}"
+        )
+    to_bf16 = (
+        transfer_dtype == "bfloat16"
+        or (transfer_dtype == "auto"
+            and cfg.compute_dtype is not None
+            and jnp.dtype(cfg.compute_dtype) == jnp.bfloat16
+            and data_is_f32)
+    )
+
     c = c0.astype(jnp.float32)
     batches = sample_batches(data, bs, n_steps, seed=host_seed,
-                             start_step=start_step)
+                             start_step=start_step, to_bf16=to_bf16)
     step = start_step
-    for xb in prefetch_to_device(batches, depth=prefetch_depth):
+    for xb in prefetch_to_device(batches, depth=prefetch_depth,
+                                 background=background_prefetch):
         c, n_seen = _stream_step(c, n_seen, xb,
                                  compute_dtype=cfg.compute_dtype)
         step += 1

@@ -1,0 +1,17 @@
+"""Native (C++) host-runtime components, bound via ctypes.
+
+The TPU compute path is JAX/XLA/Pallas; the host runtime around it — here
+the streaming batch loader — is native where it is genuinely hot.  The
+library builds itself from the bundled source on first use (g++, cached by
+source hash under ``~/.cache/kmeans_tpu``) and every entry point has a
+bit-identical numpy fallback, so machines without a toolchain lose speed,
+never behavior.
+"""
+
+from kmeans_tpu.native.loader import (
+    gather_rows,
+    native_available,
+    to_bfloat16,
+)
+
+__all__ = ["gather_rows", "native_available", "to_bfloat16"]

@@ -1,0 +1,172 @@
+"""Native C++ loader: exactness vs numpy, bf16 RNE semantics, fallbacks,
+and the background prefetch pipeline."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from kmeans_tpu.native import gather_rows, native_available, to_bfloat16
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(5000, 97)).astype(np.float32)
+
+
+def test_native_builds_on_this_image():
+    # The image bakes g++; the loader must actually compile here, so the
+    # fallback path is a portability escape hatch, not the silent default.
+    assert native_available()
+
+
+def test_gather_exact_vs_numpy(data):
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, data.shape[0], size=1234)
+    np.testing.assert_array_equal(gather_rows(data, idx), data[idx])
+    # non-f32 dtypes ride the same memcpy path
+    d64 = data.astype(np.float64)
+    np.testing.assert_array_equal(gather_rows(d64, idx), d64[idx])
+    i32 = (data * 100).astype(np.int32)
+    np.testing.assert_array_equal(gather_rows(i32, idx), i32[idx])
+
+
+def test_gather_bf16_matches_ml_dtypes_rne(data):
+    rng = np.random.default_rng(2)
+    idx = rng.integers(0, data.shape[0], size=777)
+    got = gather_rows(data, idx, to_bf16=True)
+    want = data[idx].astype(ml_dtypes.bfloat16)
+    assert got.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(got.view(np.uint16), want.view(np.uint16))
+
+
+def test_bf16_special_values():
+    x = np.array([[0.0, -0.0, np.inf, -np.inf, np.nan, 1e-40, 3.0e38]],
+                 np.float32)
+    got = to_bfloat16(x)
+    want = x.astype(ml_dtypes.bfloat16)
+    # NaN payloads may differ; compare NaN-ness then exact bits elsewhere
+    nan = np.isnan(x[0])
+    assert np.isnan(np.asarray(got, np.float32)[0][nan]).all()
+    np.testing.assert_array_equal(
+        got.view(np.uint16)[0][~nan], want.view(np.uint16)[0][~nan]
+    )
+
+
+def test_gather_memmap(tmp_path, data):
+    p = tmp_path / "x.npy"
+    np.save(p, data)
+    mm = np.load(p, mmap_mode="r")
+    idx = np.sort(np.random.default_rng(3).integers(0, data.shape[0], 500))
+    np.testing.assert_array_equal(gather_rows(mm, idx), data[idx])
+
+
+def test_gather_validation(data):
+    with pytest.raises(IndexError):
+        gather_rows(data, np.array([0, data.shape[0]]))
+    with pytest.raises(IndexError):
+        gather_rows(data, np.array([-1]))
+    with pytest.raises(ValueError, match="1-D"):
+        gather_rows(data, np.zeros((2, 2), np.int64))
+    with pytest.raises(ValueError, match="float32"):
+        gather_rows(data.astype(np.float64), np.array([0]), to_bf16=True)
+    # non-row-contiguous input silently takes the numpy path
+    strided = data[:, ::2]
+    idx = np.array([1, 3, 5])
+    np.testing.assert_array_equal(gather_rows(strided, idx), strided[idx])
+
+
+def test_env_kill_switch_falls_back():
+    code = (
+        "import os; os.environ['KMEANS_TPU_NO_NATIVE']='1';\n"
+        "import numpy as np\n"
+        "from kmeans_tpu.native import gather_rows, native_available\n"
+        "assert not native_available()\n"
+        "x = np.arange(12, dtype=np.float32).reshape(4, 3)\n"
+        "np.testing.assert_array_equal(gather_rows(x, np.array([2, 0])), "
+        "x[[2, 0]])\n"
+        "print('fallback ok')\n"
+    )
+    res = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=120,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert res.returncode == 0, res.stderr
+    assert "fallback ok" in res.stdout
+
+
+def test_sample_batches_bf16_and_background_prefetch(data):
+    from kmeans_tpu.data.stream import prefetch_to_device, sample_batches
+
+    ref = list(sample_batches(data, 64, 5, seed=9))
+    b16 = list(sample_batches(data, 64, 5, seed=9, to_bf16=True))
+    assert all(b.dtype == np.dtype(ml_dtypes.bfloat16) for b in b16)
+    for r, b in zip(ref, b16):
+        np.testing.assert_array_equal(
+            b.view(np.uint16), r.astype(ml_dtypes.bfloat16).view(np.uint16)
+        )
+    # background prefetch: same batches, same order
+    fg = [np.asarray(a) for a in prefetch_to_device(
+        sample_batches(data, 64, 5, seed=9))]
+    bg = [np.asarray(a) for a in prefetch_to_device(
+        sample_batches(data, 64, 5, seed=9), background=True)]
+    assert len(fg) == len(bg) == 5
+    for a, b in zip(fg, bg):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_background_prefetch_propagates_errors():
+    from kmeans_tpu.data.stream import prefetch_to_device
+
+    def bad():
+        yield np.zeros((2, 2), np.float32)
+        raise RuntimeError("boom in producer")
+
+    it = prefetch_to_device(bad(), background=True)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom in producer"):
+        list(it)
+
+
+def test_stream_fit_bf16_transfer_close_to_f32(data):
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import fit_minibatch_stream
+
+    # Apples to apples: with compute_dtype=bf16 the assignment matmul
+    # bf16-rounds xb either way (device-side cast vs host-side fused
+    # conversion are both RNE); on *separated* blobs assignments are then
+    # stable, so centroids differ only by the f32 segment-sum seeing pre-
+    # vs post-rounded row values (unstructured data would be chaotic:
+    # near-tie labels flip on rounding deltas and the trajectories fork).
+    x, _, _ = __import__("kmeans_tpu.data", fromlist=["make_blobs"]) \
+        .make_blobs(jax.random.key(7), 4000, 16, 4, cluster_std=0.5)
+    x = np.asarray(x)
+    cfg = KMeansConfig(k=4, compute_dtype="bfloat16")
+    f32 = fit_minibatch_stream(
+        x, 4, steps=20, batch_size=128, seed=5, config=cfg,
+        transfer_dtype="float32",
+    )
+    b16 = fit_minibatch_stream(
+        x, 4, steps=20, batch_size=128, seed=5, config=cfg,
+        transfer_dtype="auto",   # auto + bf16 compute -> bf16 transfer
+    )
+    np.testing.assert_allclose(
+        np.asarray(b16.centroids), np.asarray(f32.centroids),
+        rtol=2e-2, atol=2e-2,
+    )
+    with pytest.raises(ValueError, match="transfer_dtype"):
+        fit_minibatch_stream(data, 4, steps=1, transfer_dtype="float16")
+
+
+def test_stream_bf16_transfer_requires_f32_upfront():
+    from kmeans_tpu.models import fit_minibatch_stream
+
+    x64 = np.zeros((64, 4), np.float64)
+    with pytest.raises(ValueError, match="requires float32"):
+        fit_minibatch_stream(x64, 2, steps=1, transfer_dtype="bfloat16")

@@ -128,6 +128,29 @@ def fit_minibatch_stream(
     n_steps = steps if steps is not None else cfg.steps
     host_seed = seed if seed is not None else cfg.seed
 
+    # Resolve the transfer width up front: the resume check below compares
+    # it against the checkpoint's, and validation failures should surface
+    # here, not inside the producer thread mid-stream.
+    if transfer_dtype not in (None, "auto", "float32", "bfloat16"):
+        raise ValueError(
+            f"transfer_dtype must be auto/float32/bfloat16/None, "
+            f"got {transfer_dtype!r}"
+        )
+    data_is_f32 = np.dtype(data.dtype) == np.float32
+    if transfer_dtype == "bfloat16" and not data_is_f32:
+        raise ValueError(
+            f"transfer_dtype='bfloat16' requires float32 data, "
+            f"got {np.dtype(data.dtype)}"
+        )
+    to_bf16 = (
+        transfer_dtype == "bfloat16"
+        or (transfer_dtype == "auto"
+            and cfg.compute_dtype is not None
+            and jnp.dtype(cfg.compute_dtype) == jnp.bfloat16
+            and data_is_f32)
+    )
+    transfer_width = "bfloat16" if to_bf16 else "float32"
+
     start_step = 0
     c0 = None
     if resume:
@@ -170,6 +193,18 @@ def fit_minibatch_stream(
                     )
             host_seed = int(ck.get("host_seed", host_seed))
             bs = int(ck.get("batch_size", bs))
+            # Transfer width changes the values the update sums (bf16
+            # rounding), so a mismatched resume silently forks the
+            # trajectory — refuse it outright ("auto" resolves before
+            # this check, so the comparison is width vs width).
+            if "transfer_width" in ck and ck["transfer_width"] != \
+                    transfer_width:
+                raise ValueError(
+                    f"resume transfer width {transfer_width!r} contradicts "
+                    f"the checkpoint's {ck['transfer_width']!r}; pass "
+                    f"transfer_dtype={ck['transfer_width']!r} (or matching "
+                    "auto/compute_dtype) to continue this stream"
+                )
             if start_step > n_steps:
                 raise ValueError(
                     f"checkpoint is at step {start_step} > requested "
@@ -219,28 +254,9 @@ def fit_minibatch_stream(
             ),
             step=step, config=cfg,
             extra={"stream": True, "host_seed": int(host_seed),
-                   "batch_size": int(bs), "total_steps": int(n_steps)},
+                   "batch_size": int(bs), "total_steps": int(n_steps),
+                   "transfer_width": transfer_width},
         )
-
-    if transfer_dtype not in (None, "auto", "float32", "bfloat16"):
-        raise ValueError(
-            f"transfer_dtype must be auto/float32/bfloat16/None, "
-            f"got {transfer_dtype!r}"
-        )
-    data_is_f32 = np.dtype(data.dtype) == np.float32
-    if transfer_dtype == "bfloat16" and not data_is_f32:
-        # Fail here, not inside the producer thread mid-stream.
-        raise ValueError(
-            f"transfer_dtype='bfloat16' requires float32 data, "
-            f"got {np.dtype(data.dtype)}"
-        )
-    to_bf16 = (
-        transfer_dtype == "bfloat16"
-        or (transfer_dtype == "auto"
-            and cfg.compute_dtype is not None
-            and jnp.dtype(cfg.compute_dtype) == jnp.bfloat16
-            and data_is_f32)
-    )
 
     c = c0.astype(jnp.float32)
     batches = sample_batches(data, bs, n_steps, seed=host_seed,

@@ -35,6 +35,15 @@ _tried = False
 # Leave a core for the main thread / XLA host callbacks.
 _DEFAULT_THREADS = max(1, min(16, (os.cpu_count() or 2) - 1))
 
+#: Spawn one worker per this many bytes of copy work — std::thread
+#: create+join costs ~100 µs, so small gathers run single-threaded rather
+#: than paying more in spawns than the memcpy itself.
+_BYTES_PER_THREAD = 4 << 20
+
+
+def _auto_threads(nbytes: int) -> int:
+    return max(1, min(_DEFAULT_THREADS, int(nbytes // _BYTES_PER_THREAD)))
+
 
 def _cache_dir() -> str:
     base = os.environ.get("XDG_CACHE_HOME",
@@ -154,16 +163,17 @@ def gather_rows(
 
     lib = _load()
     m = idx.shape[0]
-    d = data.shape[1]
     usable = (
         lib is not None and isinstance(data, np.ndarray)
-        and _row_contiguous(data) and m > 0
+        and _row_contiguous(data) and m > 0     # implies data.ndim == 2
     )
-    nt = n_threads if n_threads is not None else _DEFAULT_THREADS
 
     if to_bf16:
         if usable:
+            d = data.shape[1]
             out = np.empty((m, d), dtype=np.uint16)
+            nt = (n_threads if n_threads is not None
+                  else _auto_threads(m * d * 4))
             lib.kt_gather_rows_f32_to_bf16(
                 data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                 idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -175,16 +185,20 @@ def gather_rows(
         return np.asarray(data[idx]).astype(_bf16_dtype())
 
     if usable:
+        d = data.shape[1]
+        row_bytes = d * data.itemsize
         out = np.empty((m, d), dtype=data.dtype)
+        nt = (n_threads if n_threads is not None
+              else _auto_threads(m * row_bytes))
         lib.kt_gather_rows(
             data.ctypes.data_as(ctypes.c_char_p),
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            m, d * data.itemsize,
+            m, row_bytes,
             out.ctypes.data_as(ctypes.c_char_p),
             nt,
         )
         return out
-    return np.ascontiguousarray(data[idx])
+    return np.ascontiguousarray(np.asarray(data)[idx])
 
 
 def to_bfloat16(x: np.ndarray, *, n_threads: Optional[int] = None):
@@ -194,7 +208,7 @@ def to_bfloat16(x: np.ndarray, *, n_threads: Optional[int] = None):
     if lib is None or x.size == 0:
         return x.astype(_bf16_dtype())
     out = np.empty(x.shape, dtype=np.uint16)
-    nt = n_threads if n_threads is not None else _DEFAULT_THREADS
+    nt = n_threads if n_threads is not None else _auto_threads(x.nbytes)
     lib.kt_f32_to_bf16(
         x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         x.size,

@@ -63,6 +63,7 @@ _TRAIN_FITS = {
     "spherical": "fit_spherical",
     "bisecting": "fit_bisecting",
     "fuzzy": "fit_fuzzy",
+    "gmm": "fit_gmm",
     "kmedoids": "fit_kmedoids",
     "xmeans": "fit_xmeans",     # k acts as k_max; BIC discovers the k
     "gmeans": "fit_gmeans",     # k_max likewise; Anderson-Darling test
@@ -420,8 +421,14 @@ class KMeansServer:
                         max_cards=self.config.max_render_cards,
                     )
                     import_json(room.doc, to_plain(viz))
-                objective = getattr(state, "inertia",
-                                    getattr(state, "objective", 0.0))
+                # Hard families report inertia, fuzzy its J, the GMM its
+                # negated log-likelihood — one lower-is-better number.
+                if hasattr(state, "inertia"):
+                    objective = state.inertia
+                elif hasattr(state, "objective"):
+                    objective = state.objective
+                else:
+                    objective = -state.log_likelihood
                 room.broadcast_event({
                     "type": "train_done",
                     "model": model,
@@ -430,10 +437,12 @@ class KMeansServer:
                     "converged": bool(state.converged),
                     # For xmeans this is the model's actual output (the
                     # BIC-discovered k ≤ the requested k_max).  KMedoidsState
-                    # calls its centers "medoids".
-                    "k": int(getattr(state, "centroids",
-                                     getattr(state, "medoids", None)
-                                     ).shape[0]),
+                    # calls its centers "medoids", the GMM "means".
+                    "k": int(getattr(
+                        state, "centroids",
+                        getattr(state, "medoids",
+                                getattr(state, "means", None))
+                    ).shape[0]),
                 })
             except Exception as e:   # stream the failure, don't kill the room
                 room.broadcast_event({"type": "train_error", "error": str(e)})

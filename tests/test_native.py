@@ -170,3 +170,28 @@ def test_stream_bf16_transfer_requires_f32_upfront():
     x64 = np.zeros((64, 4), np.float64)
     with pytest.raises(ValueError, match="requires float32"):
         fit_minibatch_stream(x64, 2, steps=1, transfer_dtype="bfloat16")
+
+
+def test_gather_1d_falls_back():
+    x = np.arange(10, dtype=np.float32)
+    np.testing.assert_array_equal(gather_rows(x, np.array([3, 1])), x[[3, 1]])
+    got = gather_rows(x, np.array([3, 1]), to_bf16=True)
+    assert got.dtype == np.dtype(ml_dtypes.bfloat16)
+
+
+def test_stream_resume_refuses_transfer_width_mismatch(tmp_path):
+    from kmeans_tpu.models import fit_minibatch_stream
+
+    x = np.random.default_rng(0).normal(size=(500, 8)).astype(np.float32)
+    ckpt = str(tmp_path / "ck")
+    fit_minibatch_stream(x, 3, steps=6, batch_size=64, seed=2,
+                         transfer_dtype="bfloat16", checkpoint_path=ckpt,
+                         checkpoint_every=2)
+    with pytest.raises(ValueError, match="transfer width"):
+        fit_minibatch_stream(x, 3, steps=10, batch_size=64, seed=2,
+                             checkpoint_path=ckpt, resume=True)
+    # matching width resumes fine
+    st = fit_minibatch_stream(x, 3, steps=10, batch_size=64, seed=2,
+                              transfer_dtype="bfloat16",
+                              checkpoint_path=ckpt, resume=True)
+    assert int(st.n_iter) == 10

@@ -555,3 +555,48 @@ def test_train_op_kmedoids_streams_train_done(server):
     assert b"train_done" in buf, buf[:500]
     assert b"train_error" not in buf
     assert b'"k": 3' in buf
+
+
+def test_train_op_gmm_family(server):
+    import socket
+    import time as _time
+
+    room = "GMGM"
+    host, port = server.httpd.server_address
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.sendall(
+        f"GET /api/events?room={room} HTTP/1.1\r\n"
+        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
+    )
+    hello_buf = b""
+    while b'"type": "hello"' not in hello_buf:
+        hello_buf += sock.recv(4096)
+    st, out = _mutate(server, room, "train",
+                      {"n": 200, "d": 2, "k": 3, "max_iter": 10,
+                       "model": "gmm"})
+    assert st == 200 and out["started"]
+    deadline = _time.time() + 30
+    buf = b""
+    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
+           and _time.time() < deadline):
+        sock.settimeout(max(0.1, deadline - _time.time()))
+        try:
+            chunk = sock.recv(8192)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    sock.close()
+    assert b'"model": "gmm"' in buf, buf[:500]
+    assert b"train_done" in buf
+    # the train_done carries a finite objective (negated log-likelihood)
+    import json as _json
+
+    done = next(_json.loads(line[len(b"data: "):])
+                for line in buf.split(b"\n")
+                if line.startswith(b"data: ") and b"train_done" in line)
+    assert done["k"] == 3
+    import math
+
+    assert math.isfinite(done["inertia"])

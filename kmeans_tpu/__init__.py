@@ -23,6 +23,7 @@ from kmeans_tpu.models import (
     BisectingKMeans,
     FuzzyCMeans,
     GaussianMixture,
+    KernelKMeans,
     KMeans,
     KMeansState,
     KMedoids,
@@ -31,6 +32,7 @@ from kmeans_tpu.models import (
     fit_bisecting,
     fit_fuzzy,
     fit_gmm,
+    fit_kernel_kmeans,
     fit_kmedoids,
     fit_gmeans,
     fit_xmeans,
@@ -52,6 +54,7 @@ __all__ = [
     "BisectingKMeans",
     "FuzzyCMeans",
     "GaussianMixture",
+    "KernelKMeans",
     "KMeans",
     "KMeansState",
     "KMedoids",
@@ -60,6 +63,7 @@ __all__ = [
     "fit_bisecting",
     "fit_fuzzy",
     "fit_gmm",
+    "fit_kernel_kmeans",
     "fit_kmedoids",
     "fit_gmeans",
     "fit_xmeans",

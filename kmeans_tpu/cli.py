@@ -132,7 +132,8 @@ def _cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
-    mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kmedoids")
+    mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kernel",
+               "kmedoids")
     if mesh is not None and model not in mesh_ok:
         print(
             f"error: --mesh supports --model {'/'.join(mesh_ok)}, "
@@ -146,7 +147,7 @@ def _cmd_train(args) -> int:
         return 2
 
     coreset_ok = ("lloyd", "accelerated", "spherical", "bisecting", "fuzzy",
-                  "gmm", "kmedoids")
+                  "gmm", "kernel", "kmedoids")
     fit_weights = None
     if args.coreset is not None:
         if args.coreset < 1:
@@ -205,6 +206,7 @@ def _cmd_train(args) -> int:
             "spherical": parallel.fit_spherical_sharded,
             "fuzzy": parallel.fit_fuzzy_sharded,
             "gmm": parallel.fit_gmm_sharded,
+            "kernel": parallel.fit_kernel_kmeans_sharded,
             "kmedoids": parallel.fit_kmedoids_sharded,
         }[model]
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
@@ -219,6 +221,7 @@ def _cmd_train(args) -> int:
             "bisecting": models.fit_bisecting,
             "fuzzy": models.fit_fuzzy,
             "gmm": models.fit_gmm,
+            "kernel": models.fit_kernel_kmeans,
             "kmedoids": models.fit_kmedoids,
             "xmeans": models.fit_xmeans,   # --k is k_max; k is discovered
             "gmeans": models.fit_gmeans,   # likewise (Anderson-Darling)
@@ -231,15 +234,10 @@ def _cmd_train(args) -> int:
             k = int(state.centroids.shape[0])
     jax_done = time.perf_counter() - t0
 
-    # Objective key: hard families report inertia, fuzzy reports its J, the
-    # GMM reports (negated) log-likelihood — one "inertia" field, lower =
-    # better for all of them, so sweep tooling can compare runs uniformly.
-    if hasattr(state, "inertia"):
-        objective = float(state.inertia)
-    elif hasattr(state, "objective"):
-        objective = float(state.objective)
-    else:
-        objective = -float(state.log_likelihood)
+    # One "inertia" field, lower = better for every family, so sweep
+    # tooling can compare runs uniformly (shared mapping with the serve
+    # train_done event).
+    objective = models.state_objective(state)
     result = {
         "n": int(n), "d": int(d), "k": int(k),
         "inertia": objective,
@@ -345,7 +343,7 @@ def main(argv=None) -> int:
                    "(named configs set it from BASELINE)")
     t.add_argument("--model", default=None, choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "gmm", "kmedoids", "xmeans", "gmeans",
+        "fuzzy", "gmm", "kernel", "kmedoids", "xmeans", "gmeans",
     ], help="model family (default: lloyd, or the config's minibatch "
             "choice); for xmeans/gmeans, --k is k_max and k is discovered")
     t.add_argument("--init", default="k-means++",

@@ -23,6 +23,12 @@ from kmeans_tpu.models.gmm import (
     gmm_log_resp,
     gmm_predict,
 )
+from kmeans_tpu.models.kernel import (
+    KernelKMeans,
+    KernelKMeansState,
+    fit_kernel_kmeans,
+    kernel_assign,
+)
 from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.medoids import KMedoids, KMedoidsState, fit_kmedoids
@@ -36,6 +42,19 @@ from kmeans_tpu.models.spherical import (
     fit_spherical,
     normalize_rows,
 )
+
+
+def state_objective(state) -> float:
+    """One lower-is-better scalar for any family's fit state: hard
+    families report inertia, fuzzy/kernel their objective J, the GMM its
+    negated log-likelihood.  THE one copy of the mapping — the CLI result
+    line and the serve train_done event both call this, so a new family's
+    state shape only has to be taught here."""
+    if hasattr(state, "inertia"):
+        return float(state.inertia)
+    if hasattr(state, "objective"):
+        return float(state.objective)
+    return -float(state.log_likelihood)
 
 __all__ = [
     "BisectingKMeans",
@@ -58,6 +77,10 @@ __all__ = [
     "fit_gmm",
     "gmm_log_resp",
     "gmm_predict",
+    "KernelKMeans",
+    "KernelKMeansState",
+    "fit_kernel_kmeans",
+    "kernel_assign",
     "fit_bisecting",
     "fit_fuzzy",
     "fuzzy_memberships",
@@ -74,6 +97,7 @@ __all__ = [
     "SphericalKMeans",
     "fit_spherical",
     "normalize_rows",
+    "state_objective",
     "suggest_k",
     "sweep_k",
     "assign_stream",

@@ -1,0 +1,407 @@
+"""Kernel k-means: non-linear clustering in an implicit feature space.
+
+The family for cluster shapes Lloyd can't express (concentric rings,
+moons): points are clustered by the k-means objective in the feature space
+of a kernel function, without ever materializing that space (Dhillon, Guan
+& Kulis 2004 — kernel k-means/spectral clustering equivalence; PAPERS.md).
+The reference computes nothing (/root/reference/app.mjs leaves assignment
+to humans); numeric scope comes from the north star.
+
+The feature-space distance needs only kernel sums:
+
+  d²(φ(x_i), μ_c) = K_ii − 2·S_ic/N_c + T_c/N_c²
+  S_ic = Σ_{j: l_j=c} w_j K_ij       (per-point per-cluster kernel mass)
+  N_c  = Σ_{j: l_j=c} w_j            (weighted cluster size)
+  T_c  = Σ_{j: l_j=c} w_j S_jc       (within-cluster kernel mass)
+
+TPU-first: S is computed in row tiles as TWO matmuls — the kernel tile
+``K(xb, x)`` (itself a matmul for linear/poly, a matmul plus elementwise
+for rbf) times the weighted one-hot label matrix — so the whole iteration
+rides the MXU and only a (chunk, n) tile is ever live.  K_ii is constant
+per row and excluded from the argmin (added back for the objective).
+Labels are integer state; convergence is "no label changed", so the fit is
+exact in finitely many steps (the objective strictly decreases).
+
+Empty clusters keep N_c = 0 and are masked to +inf distance (they stay
+empty — in feature space there is no centroid to relocate; use more
+restarts or fewer clusters instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
+
+__all__ = [
+    "KernelKMeansState", "fit_kernel_kmeans", "kernel_assign", "KernelKMeans",
+]
+
+_KERNELS = ("linear", "rbf", "poly")
+
+
+class KernelKMeansState(NamedTuple):
+    labels: jax.Array       # (n,) int32
+    objective: jax.Array    # scalar f32 — Σ w_i d²(φ(x_i), μ_{l_i}),
+    #                         always evaluated AT these labels (converged
+    #                         or max_iter-stopped alike)
+    n_iter: jax.Array       # scalar int32
+    converged: jax.Array    # scalar bool (labels reached a fixed point)
+    counts: jax.Array       # (k,) f32 — weighted cluster sizes N_c
+    within_mass: jax.Array  # (k,) f32 — T_c, cached so predict is O(m·n·d)
+
+
+def resolve_kernel_params(kernel, gamma, degree, coef0, d):
+    if kernel not in _KERNELS:
+        raise ValueError(f"kernel must be one of {_KERNELS}, got {kernel!r}")
+    if gamma is None:
+        gamma = 1.0 / d            # sklearn pairwise default
+    if not gamma > 0:
+        raise ValueError(f"gamma must be > 0, got {gamma}")
+    return float(gamma), int(degree), float(coef0)
+
+
+def kernel_tile(xb, yb_t, xb_sq, yb_sq, *, kernel, gamma, degree, coef0, cd):
+    """(chunk_x, chunk_y) kernel values; ``yb_t`` is (d, chunk_y), already
+    in compute dtype.  One matmul + elementwise — THE one copy of the
+    kernel math, shared by the fit scan, prediction, and the ring pass."""
+    f32 = jnp.float32
+    prod = jnp.matmul(xb.astype(cd), yb_t, preferred_element_type=f32,
+                      precision=matmul_precision(cd))
+    if kernel == "linear":
+        return prod
+    if kernel == "rbf":
+        d2 = jnp.maximum(xb_sq[:, None] - 2.0 * prod + yb_sq[None, :], 0.0)
+        return jnp.exp(-gamma * d2)
+    return (gamma * prod + coef0) ** degree          # poly
+
+
+def kernel_diag(x_sq, *, kernel, gamma, degree, coef0):
+    """K_ii for each row, from the squared norms (f32)."""
+    if kernel == "linear":
+        return x_sq
+    if kernel == "rbf":
+        return jnp.ones_like(x_sq)
+    return (gamma * x_sq + coef0) ** degree
+
+
+def kernel_mass_scan(xs, xs_sq, y, y_sq, wl_onehot, *, kernel, gamma,
+                     degree, coef0, cd):
+    """S rows for the tiles in ``xs`` against labeled points ``y``:
+    per tile, kernel(xb, y) @ (w·onehot(labels_y)) — (chunk, k) out.
+    ``wl_onehot`` is (n_y, k) = w_j · 1[l_j = c], precomputed once per
+    pass."""
+    y_t = y.astype(cd).T
+
+    def body(_, tile):
+        xb, xb_sq = tile
+        kt = kernel_tile(xb, y_t, xb_sq, y_sq, kernel=kernel, gamma=gamma,
+                         degree=degree, coef0=coef0, cd=cd)
+        s = jnp.matmul(kt.astype(cd), wl_onehot.astype(cd),
+                       preferred_element_type=jnp.float32,
+                       precision=matmul_precision(cd))
+        return 0, s
+
+    _, s_tiles = lax.scan(body, 0, (xs, xs_sq))
+    return s_tiles                                    # (tiles, chunk, k)
+
+
+def _labels_from_mass(S, N, T):
+    """argmin_c(−2·S/N + T/N²) with empty clusters masked to +inf; also
+    returns each row's min value (for the objective)."""
+    safe_N = jnp.where(N > 0, N, 1.0)
+    val = -2.0 * S / safe_N[None, :] + (T / (safe_N * safe_N))[None, :]
+    val = jnp.where((N > 0)[None, :], val, jnp.inf)
+    return (jnp.argmin(val, axis=1).astype(jnp.int32),
+            jnp.min(val, axis=1))
+
+
+def _partition_value(S, N, T, labels, w):
+    """Each row's −2·S/N + T/N² AT its own label (not the argmin), zeroed
+    where w == 0 — the per-row term of the partition objective.  A real
+    (w > 0) row's own cluster always has N > 0 (it contains the row), so
+    the masked safe-division never leaks an inf into the sum."""
+    n = S.shape[0]
+    Nl = N[labels]
+    safe = jnp.where(Nl > 0, Nl, 1.0)
+    val = -2.0 * S[jnp.arange(n), labels] / safe + T[labels] / (safe * safe)
+    return jnp.where(w > 0, val, 0.0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "max_iter", "chunk_size", "compute_dtype",
+                     "kernel", "degree"),
+)
+def _kernel_loop(x, labels0, weights, *, k, max_iter, chunk_size,
+                 compute_dtype, kernel, gamma, degree, coef0):
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    n = x.shape[0]
+    xs, ws, _ = chunk_tiles(x, weights, chunk_size)
+    xs_sq = sq_norms(xs)
+    x_sq = xs_sq.reshape(-1)[:n]
+    w = ws.reshape(-1)[:n]
+    diag = kernel_diag(x_sq, kernel=kernel, gamma=gamma, degree=degree,
+                       coef0=coef0)
+
+    def masses(labels):
+        wl = jax.nn.one_hot(labels, k, dtype=f32) * w[:, None]   # (n, k)
+        s_tiles = kernel_mass_scan(
+            xs, xs_sq, x, x_sq, wl, kernel=kernel, gamma=gamma,
+            degree=degree, coef0=coef0, cd=cd,
+        )
+        S = s_tiles.reshape(-1, k)[:n]                           # (n, k)
+        N = jnp.sum(wl, axis=0)                                  # (k,)
+        T = jax.ops.segment_sum(w * S[jnp.arange(n), labels], labels, k)
+        return S, N, T
+
+    def cond(s):
+        _, it, done = s
+        return (it < max_iter) & ~done
+
+    def body(s):
+        labels, it, _ = s
+        S, N, T = masses(labels)
+        new_labels, _ = _labels_from_mass(S, N, T)
+        done = jnp.all(new_labels == labels)
+        return (new_labels, it + 1, done)
+
+    labels, n_iter, converged = lax.while_loop(
+        cond, body,
+        (labels0.astype(jnp.int32), jnp.zeros((), jnp.int32),
+         jnp.zeros((), bool)),
+    )
+    # Evaluate the objective AT the returned labels (converged or
+    # max_iter-stopped alike), so state.objective always matches
+    # state.labels.
+    S, N, T = masses(labels)
+    obj = jnp.sum(w * diag + _partition_value(S, N, T, labels, w) * w)
+    return KernelKMeansState(labels, obj, n_iter, converged, N, T)
+
+
+def _resolve_labels0(x, k, key, cfg, init, weights):
+    """Initial labels: an (n,) int array, or an input-space k-means init
+    (centroid seeding + one nearest-centroid assignment) — the standard
+    practical warm start for kernel k-means."""
+    import numpy as np
+
+    if init is not None and not isinstance(init, str):
+        arr = jnp.asarray(init)
+        if arr.ndim == 1:
+            if arr.shape[0] != x.shape[0]:
+                raise ValueError(
+                    f"init labels shape {arr.shape} != ({x.shape[0]},)"
+                )
+            if arr.dtype not in (jnp.int32, jnp.int64):
+                raise ValueError(
+                    f"1-D init must be integer labels, got {arr.dtype}"
+                )
+            return arr.astype(jnp.int32)
+        if arr.shape != (k, x.shape[1]):
+            raise ValueError(
+                f"init must be (n,) labels or (k, d) centroids; got "
+                f"{arr.shape}"
+            )
+        centroids = arr.astype(jnp.float32)
+    else:
+        from kmeans_tpu.models.init import init_centroids
+
+        method = init if isinstance(init, str) else cfg.init
+        centroids = init_centroids(
+            key, x, k, method=method, weights=weights,
+            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        )
+    from kmeans_tpu.ops.distance import assign
+
+    labels, _ = assign(x, centroids, chunk_size=cfg.chunk_size,
+                       compute_dtype=cfg.compute_dtype)
+    return labels
+
+
+def fit_kernel_kmeans(
+    x: jax.Array,
+    k: int,
+    *,
+    kernel: str = "rbf",
+    gamma: Optional[float] = None,
+    degree: int = 3,
+    coef0: float = 1.0,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init: Union[str, jax.Array, None] = None,
+    weights: Optional[jax.Array] = None,
+    max_iter: Optional[int] = None,
+) -> KernelKMeansState:
+    """Fit kernel k-means (linear / rbf / poly kernels).
+
+    ``init`` may be an (n,) integer label array, a (k, d) centroid array,
+    or an init-method name (seeded in input space, then one nearest-
+    centroid assignment).  With ``kernel='linear'`` the objective equals
+    plain k-means' inertia at the same partition — the oracle the tests
+    exploit.  O(n²·d) per iteration: meant for the moderate-n regime (use
+    :func:`kmeans_tpu.parallel.fit_kernel_kmeans_sharded` to spread the
+    quadratic work over a mesh).
+    """
+    from kmeans_tpu.models.init import resolve_fit_config
+
+    cfg, key = resolve_fit_config(k, key, config)
+    gamma, degree, coef0 = resolve_kernel_params(
+        kernel, gamma, degree, coef0, x.shape[1]
+    )
+    labels0 = _resolve_labels0(x, k, key, cfg, init, weights)
+    return _kernel_loop(
+        x, labels0, weights, k=k,
+        max_iter=max_iter if max_iter is not None else cfg.max_iter,
+        chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
+        kernel=kernel, gamma=gamma, degree=degree, coef0=coef0,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "chunk_size", "compute_dtype", "kernel", "degree"),
+)
+def kernel_assign(
+    x_new: jax.Array,
+    x_fit: jax.Array,
+    labels_fit: jax.Array,
+    *,
+    k: int,
+    kernel: str = "rbf",
+    gamma: float = 0.1,
+    degree: int = 3,
+    coef0: float = 1.0,
+    weights_fit: Optional[jax.Array] = None,
+    within_mass: Optional[jax.Array] = None,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+) -> jax.Array:
+    """Assign new points to the fitted feature-space clusters.
+
+    Kernel k-means has no input-space centroids; prediction computes the
+    kernel mass of each new point against the training set — O(m·n·d)
+    when ``within_mass`` (the fit's cached T_c,
+    ``state.within_mass``) is supplied.  Without it, T is rebuilt from
+    the training set, which costs an extra O(n²·d) sweep per call.
+    """
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else \
+        x_new.dtype
+    n = x_fit.shape[0]
+    m = x_new.shape[0]
+    w = (jnp.ones((n,), f32) if weights_fit is None
+         else weights_fit.astype(f32))
+    wl = jax.nn.one_hot(labels_fit, k, dtype=f32) * w[:, None]
+    x_fit_sq = sq_norms(x_fit)
+
+    xs, _, _ = chunk_tiles(x_new, None, chunk_size)
+    xs_sq = sq_norms(xs)
+    s_tiles = kernel_mass_scan(
+        xs, xs_sq, x_fit, x_fit_sq, wl, kernel=kernel, gamma=gamma,
+        degree=degree, coef0=coef0, cd=cd,
+    )
+    S = s_tiles.reshape(-1, k)[:m]
+    N = jnp.sum(wl, axis=0)
+    if within_mass is not None:
+        T = within_mass
+    else:
+        # T from the fitted partition (same formula as the training pass).
+        xs_fit, _, _ = chunk_tiles(x_fit, None, chunk_size)
+        s_fit_tiles = kernel_mass_scan(
+            xs_fit, sq_norms(xs_fit), x_fit, x_fit_sq, wl, kernel=kernel,
+            gamma=gamma, degree=degree, coef0=coef0, cd=cd,
+        )
+        S_fit = s_fit_tiles.reshape(-1, k)[:n]
+        T = jax.ops.segment_sum(
+            w * S_fit[jnp.arange(n), labels_fit], labels_fit, k
+        )
+    labels, _ = _labels_from_mass(S, N, T)
+    return labels
+
+
+@dataclasses.dataclass
+class KernelKMeans:
+    """Estimator wrapper over :func:`fit_kernel_kmeans` (sklearn-ish)."""
+
+    n_clusters: int = 3
+    kernel: str = "rbf"
+    gamma: Optional[float] = None
+    degree: int = 3
+    coef0: float = 1.0
+    init: Union[str, jax.Array] = "k-means++"
+    max_iter: int = 100
+    seed: int = 0
+    n_init: int = 1
+    chunk_size: int = 4096
+    compute_dtype: Optional[str] = None
+
+    state: Optional[KernelKMeansState] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _x_fit: Optional[jax.Array] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _w_fit: Optional[jax.Array] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def fit(self, x, weights=None) -> "KernelKMeans":
+        from kmeans_tpu.models.lloyd import best_of_n_init
+
+        x = jnp.asarray(x)
+        init = None if isinstance(self.init, str) else self.init
+        cfg = KMeansConfig(
+            k=self.n_clusters,
+            init=self.init if isinstance(self.init, str) else "given",
+            max_iter=self.max_iter, seed=self.seed,
+            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
+        )
+        self.state = best_of_n_init(
+            lambda key: fit_kernel_kmeans(
+                x, self.n_clusters, kernel=self.kernel, gamma=self.gamma,
+                degree=self.degree, coef0=self.coef0, key=key, config=cfg,
+                init=init, weights=weights,
+            ),
+            jax.random.key(self.seed),
+            1 if init is not None else self.n_init,
+            score=lambda s: float(s.objective),
+        )
+        self._x_fit = x
+        self._w_fit = None if weights is None else jnp.asarray(weights)
+        return self
+
+    @property
+    def labels_(self):
+        return self.state.labels
+
+    @property
+    def objective_(self):
+        return float(self.state.objective)
+
+    @property
+    def n_iter_(self):
+        return int(self.state.n_iter)
+
+    def predict(self, x):
+        gamma, degree, coef0 = resolve_kernel_params(
+            self.kernel, self.gamma, self.degree, self.coef0,
+            self._x_fit.shape[1],
+        )
+        return kernel_assign(
+            jnp.asarray(x), self._x_fit, self.state.labels,
+            k=self.n_clusters, kernel=self.kernel, gamma=gamma,
+            degree=degree, coef0=coef0, weights_fit=self._w_fit,
+            within_mass=self.state.within_mass,
+            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
+        )
+
+    def fit_predict(self, x, weights=None):
+        return self.fit(x, weights=weights).labels_

@@ -1,6 +1,7 @@
 """Parallelism: mesh construction, sharded engine, multi-host bootstrap."""
 
 from kmeans_tpu.parallel.distributed import ensure_initialized, process_info
+from kmeans_tpu.parallel.kernel import fit_kernel_kmeans_sharded
 from kmeans_tpu.parallel.medoids import fit_kmedoids_sharded
 from kmeans_tpu.parallel.engine import (
     fit_fuzzy_sharded,
@@ -17,6 +18,7 @@ __all__ = [
     "process_info",
     "fit_fuzzy_sharded",
     "fit_gmm_sharded",
+    "fit_kernel_kmeans_sharded",
     "fit_kmedoids_sharded",
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",

@@ -1,0 +1,208 @@
+"""Sharded kernel k-means: the O(n²) kernel-mass sweep as a RING pass.
+
+Same neighbor-exchange schedule as :mod:`kmeans_tpu.parallel.medoids` (the
+ring-attention block rotation, SURVEY.md §2.6): every device keeps its row
+block and label block resident, and the *visiting* block rotates around the
+ring via ``ppermute``.  Each of the dp ring steps contributes one
+``kernel(x_loc_tile, blk) @ (w·onehot(blk_labels))`` matmul pair to the
+local rows' kernel-mass matrix S — after dp steps S is exact while no
+device ever held more than two blocks.  The label update is then row-local
+given the psummed (N, T); convergence is a psummed changed-label count
+hitting zero.
+
+Parity caveat (same as the medoids ring): S accumulates over ring steps in
+a different f32 summation order than the single-device full-row matmul, so
+a sub-ulp argmin tie can resolve differently; everything else is exact.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import resolve_fit_config
+from kmeans_tpu.models.kernel import (
+    KernelKMeansState,
+    _labels_from_mass,
+    _partition_value,
+    kernel_diag,
+    kernel_mass_scan,
+    resolve_kernel_params,
+)
+from kmeans_tpu.ops.distance import chunk_tiles, sq_norms
+from kmeans_tpu.parallel.engine import _pad_rows
+
+__all__ = ["fit_kernel_kmeans_sharded"]
+
+
+def _kernel_sharded_pass(x_loc, w_loc, lab_loc, *, data_axis, k, chunk_size,
+                         compute_dtype, kernel, gamma, degree, coef0):
+    """One labeling pass on a shard: ring-sweep S, psum (N, T), update the
+    local labels.  Returns (new_lab_loc, objective, N, n_changed)."""
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else \
+        x_loc.dtype
+    n_loc = x_loc.shape[0]
+    dp = lax.psum(1, data_axis)
+
+    xs, ws, _ = chunk_tiles(x_loc, w_loc, chunk_size)
+    xs_sq = sq_norms(xs)
+    x_sq_loc = sq_norms(x_loc)
+
+    # --- ring kernel-mass sweep ----------------------------------------
+    def ring_step(i, carry):
+        blk_x, blk_w, blk_lab, blk_sq, S = carry
+        wl_blk = jax.nn.one_hot(blk_lab, k, dtype=f32) * blk_w[:, None]
+        # The shared kernel_mass_scan keeps matmul precision identical to
+        # the single-device pass (TPU f32 needs the HIGHEST hint, or XLA
+        # silently downcasts to bf16 and the claimed parity breaks).
+        partial = kernel_mass_scan(
+            xs, xs_sq, blk_x, blk_sq, wl_blk, kernel=kernel, gamma=gamma,
+            degree=degree, coef0=coef0, cd=cd,
+        )
+        S = S + partial.reshape(-1, k)[:n_loc]
+        perm = [(s, (s + 1) % dp) for s in range(dp)]
+        blk_x = lax.ppermute(blk_x, data_axis, perm)
+        blk_w = lax.ppermute(blk_w, data_axis, perm)
+        blk_lab = lax.ppermute(blk_lab, data_axis, perm)
+        blk_sq = lax.ppermute(blk_sq, data_axis, perm)
+        return blk_x, blk_w, blk_lab, blk_sq, S
+
+    _, _, _, _, S = lax.fori_loop(
+        0, dp, ring_step,
+        (x_loc, w_loc, lab_loc, x_sq_loc, jnp.zeros((n_loc, k), f32)),
+    )
+
+    # --- psummed cluster masses, row-local update ----------------------
+    wl_loc = jax.nn.one_hot(lab_loc, k, dtype=f32) * w_loc[:, None]
+    N = lax.psum(jnp.sum(wl_loc, axis=0), data_axis)
+    T = lax.psum(
+        jax.ops.segment_sum(
+            w_loc * S[jnp.arange(n_loc), lab_loc], lab_loc, k
+        ),
+        data_axis,
+    )
+    new_lab, _ = _labels_from_mass(S, N, T)
+    diag = kernel_diag(x_sq_loc, kernel=kernel, gamma=gamma, degree=degree,
+                       coef0=coef0)
+    # Objective evaluated AT the incoming labels (the partition the masses
+    # describe), matching the single-device convention.
+    obj = lax.psum(
+        jnp.sum(w_loc * diag
+                + _partition_value(S, N, T, lab_loc, w_loc) * w_loc),
+        data_axis,
+    )
+    # Padding rows (w == 0) are pinned to label 0 so they can never add to
+    # the changed count (their argmin may drift as real clusters move).
+    new_lab = jnp.where(w_loc > 0, new_lab, 0)
+    changed = lax.psum(
+        jnp.sum(jnp.where(w_loc > 0, new_lab != lab_loc, False)), data_axis
+    )
+    return new_lab, obj, N, T, changed
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kernel_run(mesh, data_axis, k, chunk_size, compute_dtype,
+                      kernel, gamma, degree, coef0, max_it):
+    step = jax.shard_map(
+        functools.partial(
+            _kernel_sharded_pass, data_axis=data_axis, k=k,
+            chunk_size=chunk_size, compute_dtype=compute_dtype,
+            kernel=kernel, gamma=gamma, degree=degree, coef0=coef0,
+        ),
+        mesh=mesh,
+        in_specs=(P(data_axis), P(data_axis), P(data_axis)),
+        out_specs=(P(data_axis), P(), P(), P(), P()), check_vma=False,
+    )
+
+    @jax.jit
+    def run(x, w, lab0):
+        def cond(s):
+            _, it, done = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            lab, it, _ = s
+            new_lab, _, _, _, changed = step(x, w, lab)
+            return (new_lab, it + 1, changed == 0)
+
+        lab, n_iter, converged = lax.while_loop(
+            cond, body, (lab0, jnp.zeros((), jnp.int32),
+                         jnp.zeros((), bool)),
+        )
+        # Evaluate the objective AT the returned labels (converged or
+        # max_iter-stopped alike) — single-device convention.
+        _, obj, N, T, _ = step(x, w, lab)
+        return lab, obj, N, T, n_iter, converged
+
+    return run
+
+
+def fit_kernel_kmeans_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    kernel: str = "rbf",
+    gamma: Optional[float] = None,
+    degree: int = 3,
+    coef0: float = 1.0,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init: Union[str, jax.Array, None] = None,
+    weights=None,
+    data_axis: str = "data",
+    max_iter: Optional[int] = None,
+) -> KernelKMeansState:
+    """Kernel k-means on a device mesh (ring pass over row blocks).
+
+    Same contract as :func:`kmeans_tpu.models.kernel.fit_kernel_kmeans`;
+    the quadratic kernel-mass work is spread over the ``data_axis`` ring
+    so each device does n·n_loc of it.  ``init`` may be (n,) labels, a
+    (k, d) centroid array, or an init-method name.
+    """
+    cfg, key = resolve_fit_config(k, key, config)
+    gamma, degree, coef0 = resolve_kernel_params(
+        kernel, gamma, degree, coef0, x.shape[1]
+    )
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes[data_axis]
+    n_real = x.shape[0]
+    if weights is not None and np.asarray(weights).shape != (n_real,):
+        raise ValueError(
+            f"weights shape {np.asarray(weights).shape} != ({n_real},)"
+        )
+
+    # Initial labels resolve on the UNPADDED view via the shared helper,
+    # so every init route matches the single-device fit for the same key.
+    from kmeans_tpu.models.kernel import _resolve_labels0
+
+    lab0 = _resolve_labels0(
+        jnp.asarray(x), k, key, cfg, init,
+        None if weights is None else jnp.asarray(weights),
+    )
+
+    x, w_host, n = _pad_rows(x, dp, weights=weights)
+    lab0 = np.concatenate([
+        np.asarray(lab0, np.int32),
+        np.zeros((x.shape[0] - n,), np.int32),   # pads pinned to label 0
+    ])
+    xg = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+    lab0 = jax.device_put(jnp.asarray(lab0),
+                          NamedSharding(mesh, P(data_axis)))
+
+    run = _build_kernel_run(
+        mesh, data_axis, k, cfg.chunk_size, cfg.compute_dtype,
+        kernel, gamma, degree, coef0,
+        max_iter if max_iter is not None else cfg.max_iter,
+    )
+    lab, obj, N, T, n_iter, converged = run(xg, w, lab0)
+    return KernelKMeansState(lab[:n], obj, n_iter, converged, N, T)

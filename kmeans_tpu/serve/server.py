@@ -421,14 +421,7 @@ class KMeansServer:
                         max_cards=self.config.max_render_cards,
                     )
                     import_json(room.doc, to_plain(viz))
-                # Hard families report inertia, fuzzy its J, the GMM its
-                # negated log-likelihood — one lower-is-better number.
-                if hasattr(state, "inertia"):
-                    objective = state.inertia
-                elif hasattr(state, "objective"):
-                    objective = state.objective
-                else:
-                    objective = -state.log_likelihood
+                objective = models.state_objective(state)
                 room.broadcast_event({
                     "type": "train_done",
                     "model": model,

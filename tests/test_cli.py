@@ -155,3 +155,20 @@ def test_train_mesh_soft_families(capsys):
         res = json.loads(out.splitlines()[0])
         assert res["mode"] == model
         assert np.isfinite(res["inertia"])
+
+
+def test_train_kernel_family(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "300", "--d", "4", "--k", "3", "--model", "kernel",
+        "--max-iter", "20",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "kernel"
+    assert np.isfinite(res["inertia"])
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "300", "--d", "4", "--k", "3", "--model", "kernel",
+        "--mesh", "4", "--max-iter", "20",
+    ])
+    assert rc in (0, None)
+    assert json.loads(out.splitlines()[0])["mode"] == "kernel"

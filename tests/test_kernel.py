@@ -1,0 +1,182 @@
+"""Kernel k-means: linear-kernel oracle vs Lloyd's inertia, the classic
+rings case RBF must solve, properties, predict, estimator."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import (
+    KernelKMeans,
+    fit_kernel_kmeans,
+    kernel_assign,
+)
+
+
+def _partition_inertia(x, labels, k):
+    """Σ_i ||x_i − mean of x_i's cluster||² in float64."""
+    x = np.asarray(x, np.float64)
+    labels = np.asarray(labels)
+    total = 0.0
+    for c in range(k):
+        rows = x[labels == c]
+        if len(rows):
+            total += ((rows - rows.mean(0)) ** 2).sum()
+    return total
+
+
+def _rings(n_per, r_inner=1.0, r_outer=6.0, noise=0.05, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for r in (r_inner, r_outer):
+        theta = rng.uniform(0, 2 * np.pi, n_per)
+        pts = np.stack([r * np.cos(theta), r * np.sin(theta)], 1)
+        out.append(pts + noise * rng.normal(size=pts.shape))
+    labels = np.repeat([0, 1], n_per)
+    return np.concatenate(out).astype(np.float32), labels
+
+
+def test_linear_kernel_objective_is_partition_inertia(rng):
+    x, _, _ = make_blobs(jax.random.key(3), 300, 5, 3)
+    x = np.asarray(x)
+    state = fit_kernel_kmeans(
+        jnp.asarray(x), 3, kernel="linear", key=jax.random.key(0),
+        config=KMeansConfig(k=3, chunk_size=64),
+    )
+    want = _partition_inertia(x, state.labels, 3)
+    np.testing.assert_allclose(float(state.objective), want, rtol=1e-3)
+    assert bool(state.converged)
+
+
+def test_rbf_separates_concentric_rings():
+    # Plain kernel k-means (unlike spectral clustering) can stall in
+    # arc-split local optima from an arbitrary init, so the honest check
+    # is fixed-point recovery: start from the true ring partition with 5%
+    # of labels flipped.  RBF must clean it up; the linear kernel (==
+    # Lloyd geometry, which cannot express a ring partition) must NOT
+    # hold it — that contrast is the non-linearity doing real work.
+    x, true = _rings(150, r_outer=4.0)
+    rng = np.random.default_rng(1)
+    init = np.where(rng.random(300) < 0.05, 1 - true, true).astype(np.int32)
+    state = fit_kernel_kmeans(
+        jnp.asarray(x), 2, kernel="rbf", gamma=1.0,
+        init=jnp.asarray(init), config=KMeansConfig(k=2, chunk_size=64),
+    )
+    lab = np.asarray(state.labels)
+    agree = max(np.mean(lab == true), np.mean(lab == 1 - true))
+    assert agree > 0.99, agree
+    assert bool(state.converged)
+
+    lin = fit_kernel_kmeans(
+        jnp.asarray(x), 2, kernel="linear",
+        init=jnp.asarray(init), config=KMeansConfig(k=2, chunk_size=64),
+    )
+    lab_lin = np.asarray(lin.labels)
+    agree_lin = max(np.mean(lab_lin == true), np.mean(lab_lin == 1 - true))
+    assert agree_lin < 0.9, agree_lin
+
+
+def test_objective_monotone_nonincreasing():
+    x, _ = _rings(100, seed=4)
+    objs = []
+    for it in range(1, 6):
+        s = fit_kernel_kmeans(
+            jnp.asarray(x), 2, kernel="rbf", gamma=1.0,
+            key=jax.random.key(2), max_iter=it,
+            config=KMeansConfig(k=2, chunk_size=64),
+        )
+        objs.append(float(s.objective))
+    diffs = np.diff(objs)
+    assert np.all(diffs <= 1e-5 * np.abs(np.array(objs[1:]))), objs
+
+
+def test_weighted_equals_replicated(rng):
+    x = rng.normal(size=(80, 3)).astype(np.float32)
+    w = rng.integers(1, 4, size=80).astype(np.float32)
+    rep = np.repeat(x, w.astype(int), axis=0)
+    labels0 = (np.arange(80) % 3).astype(np.int32)
+    labels0_rep = np.repeat(labels0, w.astype(int))
+    cfg = KMeansConfig(k=3, chunk_size=32)
+    sw = fit_kernel_kmeans(jnp.asarray(x), 3, kernel="rbf", gamma=0.5,
+                           init=jnp.asarray(labels0), weights=jnp.asarray(w),
+                           config=cfg)
+    sr = fit_kernel_kmeans(jnp.asarray(rep), 3, kernel="rbf", gamma=0.5,
+                           init=jnp.asarray(labels0_rep), config=cfg)
+    np.testing.assert_allclose(float(sw.objective), float(sr.objective),
+                               rtol=1e-4)
+    np.testing.assert_array_equal(
+        np.asarray(sw.labels), np.asarray(sr.labels)[np.cumsum(
+            w.astype(int)) - 1]
+    )
+
+
+def test_predict_reproduces_training_labels():
+    x, _ = _rings(120, seed=6)
+    km = KernelKMeans(n_clusters=2, kernel="rbf", gamma=2.0, seed=0,
+                      chunk_size=64).fit(jnp.asarray(x))
+    pred = np.asarray(km.predict(jnp.asarray(x)))
+    np.testing.assert_array_equal(pred, np.asarray(km.labels_))
+
+
+def test_poly_kernel_and_counts(rng):
+    x, _, _ = make_blobs(jax.random.key(9), 200, 4, 3)
+    s = fit_kernel_kmeans(x, 3, kernel="poly", degree=2, coef0=1.0,
+                          key=jax.random.key(0),
+                          config=KMeansConfig(k=3, chunk_size=64))
+    assert float(jnp.sum(s.counts)) == pytest.approx(200.0)
+    assert s.labels.shape == (200,)
+
+
+def test_kernel_validation(rng):
+    x = jnp.asarray(rng.normal(size=(30, 2)).astype(np.float32))
+    with pytest.raises(ValueError, match="kernel"):
+        fit_kernel_kmeans(x, 2, kernel="sigmoid")
+    with pytest.raises(ValueError, match="gamma"):
+        fit_kernel_kmeans(x, 2, gamma=-1.0)
+    with pytest.raises(ValueError, match="labels shape"):
+        fit_kernel_kmeans(x, 2, init=jnp.zeros((7,), jnp.int32))
+    with pytest.raises(ValueError, match="integer labels"):
+        fit_kernel_kmeans(x, 2, init=jnp.zeros((30,), jnp.float32))
+    with pytest.raises(ValueError, match="init must be"):
+        fit_kernel_kmeans(x, 2, init=jnp.zeros((3, 3), jnp.float32))
+
+
+def test_centroid_array_init_accepted(rng):
+    x = jnp.asarray(rng.normal(size=(50, 2)).astype(np.float32))
+    c0 = x[:2]
+    s = fit_kernel_kmeans(x, 2, kernel="linear", init=c0,
+                          config=KMeansConfig(k=2, init="given",
+                                              chunk_size=16))
+    assert bool(s.converged)
+
+
+def test_kernel_assign_new_points():
+    x, true = _rings(100, r_outer=4.0, seed=8)
+    s = fit_kernel_kmeans(jnp.asarray(x), 2, kernel="rbf", gamma=1.0,
+                          init=jnp.asarray(true.astype(np.int32)),
+                          config=KMeansConfig(k=2, chunk_size=64))
+    # fit holds the ring partition; new points land with their ring
+    lab_fit = np.asarray(s.labels)
+    assert max(np.mean(lab_fit == true), np.mean(lab_fit == 1 - true)) == 1.0
+    new = np.array([[1.05, 0.0], [0.0, 4.1]], np.float32)
+    lab = np.asarray(kernel_assign(
+        jnp.asarray(new), jnp.asarray(x), s.labels, k=2, kernel="rbf",
+        gamma=1.0, chunk_size=64,
+    ))
+    inner_lab = lab_fit[np.argmin(np.abs(np.linalg.norm(x, axis=1) - 1.0))]
+    assert lab[0] == inner_lab and lab[1] == 1 - inner_lab
+
+
+def test_objective_matches_returned_labels_when_max_iter_hit():
+    # Stop after 1 iteration (unconverged): state.objective must be the
+    # partition objective OF state.labels, recomputable from them.
+    x, _ = _rings(80, seed=11)
+    s = fit_kernel_kmeans(
+        jnp.asarray(x), 2, kernel="linear", key=jax.random.key(4),
+        max_iter=1, config=KMeansConfig(k=2, chunk_size=32),
+    )
+    assert not bool(s.converged)
+    want = _partition_inertia(x, s.labels, 2)
+    np.testing.assert_allclose(float(s.objective), want, rtol=1e-3)

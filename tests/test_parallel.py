@@ -649,3 +649,48 @@ def test_gmm_sharded_spherical_and_validation(cpu_devices):
                                rtol=1e-6)
     with pytest.raises(ValueError, match="covariance_type"):
         fit_gmm_sharded(x, 2, mesh=cpu_mesh((4, 1)), covariance_type="full")
+
+
+@pytest.mark.parametrize("shape", [(2, 1), (8, 1)])
+def test_kernel_sharded_matches_single_device(cpu_devices, shape):
+    """Ring kernel-mass sweep equals the single-device fit."""
+    from kmeans_tpu.models import fit_kernel_kmeans
+    from kmeans_tpu.parallel import fit_kernel_kmeans_sharded
+
+    rng = np.random.default_rng(31)
+    x, _, _ = make_blobs(jax.random.key(31), 203, 5, 3, cluster_std=0.8)
+    x = np.asarray(x)                       # 203: uneven over both meshes
+    w = rng.uniform(0.2, 2.0, 203).astype(np.float32)
+    lab0 = (np.arange(203) % 3).astype(np.int32)
+
+    want = fit_kernel_kmeans(jnp.asarray(x), 3, kernel="rbf", gamma=0.3,
+                             init=jnp.asarray(lab0), weights=jnp.asarray(w),
+                             max_iter=25)
+    got = fit_kernel_kmeans_sharded(
+        x, 3, mesh=cpu_mesh(shape), kernel="rbf", gamma=0.3,
+        init=lab0, weights=w, max_iter=25,
+    )
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(float(got.objective), float(want.objective),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(got.counts),
+                               np.asarray(want.counts), rtol=1e-5)
+    assert int(got.n_iter) == int(want.n_iter)
+    assert bool(got.converged) == bool(want.converged)
+
+
+def test_kernel_sharded_linear_and_init_methods(cpu_devices):
+    from kmeans_tpu.models import fit_kernel_kmeans
+    from kmeans_tpu.parallel import fit_kernel_kmeans_sharded
+
+    x, _, _ = make_blobs(jax.random.key(12), 160, 4, 3, cluster_std=0.5)
+    x = np.asarray(x)
+    want = fit_kernel_kmeans(jnp.asarray(x), 3, kernel="linear",
+                             key=jax.random.key(5), max_iter=20)
+    got = fit_kernel_kmeans_sharded(
+        x, 3, mesh=cpu_mesh((4, 1)), kernel="linear",
+        key=jax.random.key(5), max_iter=20,
+    )
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))

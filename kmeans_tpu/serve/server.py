@@ -64,6 +64,7 @@ _TRAIN_FITS = {
     "bisecting": "fit_bisecting",
     "fuzzy": "fit_fuzzy",
     "gmm": "fit_gmm",
+    "kernel": "fit_kernel_kmeans",
     "kmedoids": "fit_kmedoids",
     "xmeans": "fit_xmeans",     # k acts as k_max; BIC discovers the k
     "gmeans": "fit_gmeans",     # k_max likewise; Anderson-Darling test
@@ -72,6 +73,17 @@ _TRAIN_FITS = {
 #: k-medoids' medoid update is O(n²·d) — cap what one unauthenticated
 #: request can demand of the demo server.
 _KMEDOIDS_MAX_N = 20_000
+
+
+def _state_k(state) -> int:
+    """The fitted k from any family's state: center array if it has one
+    (xmeans/gmeans return fewer centers than k_max), else the per-cluster
+    counts length (kernel k-means has no input-space centers)."""
+    for attr in ("centroids", "medoids", "means", "counts"):
+        arr = getattr(state, attr, None)
+        if arr is not None:
+            return arr.shape[0]
+    raise AttributeError(f"no center/count field on {type(state).__name__}")
 
 #: _headers:1-21 adapted to same-origin serving (no CDNs, no trackers).
 _SECURITY_HEADERS = {
@@ -338,19 +350,19 @@ class KMeansServer:
             raise ValueError(f"unknown train init {init!r}")
         if n < k or n < 1 or d < 1 or k < 1:
             raise ValueError("invalid train shape")
-        if model == "kmedoids":
+        if model in ("kmedoids", "kernel"):
             if n > _KMEDOIDS_MAX_N:
                 raise ValueError(
-                    f"kmedoids is O(n²); n must be <= {_KMEDOIDS_MAX_N} here"
+                    f"{model} is O(n²); n must be <= {_KMEDOIDS_MAX_N} here"
                 )
-            # Bound the actual work, not just n: the medoid update is
-            # O(n²·d·max_iter), so a flat n cap still admits ~260x the
-            # worst case the n·d gate below was sized for (advisor r1).
-            # 8e10 equals the other families' worst-case work units
-            # (n·d=8e6 × k=100 × max_iter=100).
+            # Bound the actual work, not just n: the medoid update and the
+            # kernel-mass sweep are O(n²·d·max_iter), so a flat n cap
+            # still admits ~260x the worst case the n·d gate below was
+            # sized for (advisor r1).  8e10 equals the other families'
+            # worst-case work units (n·d=8e6 × k=100 × max_iter=100).
             if n * n * d * max_iter > 8e10:
                 raise ValueError(
-                    "kmedoids work too large: n²·d·max_iter must be <= 8e10"
+                    f"{model} work too large: n²·d·max_iter must be <= 8e10"
                 )
         # Bound the data volume a single unauthenticated request can demand
         # (the endpoint exists for the teaching-game scale, n=500 d=2 k=3).
@@ -430,12 +442,10 @@ class KMeansServer:
                     "converged": bool(state.converged),
                     # For xmeans this is the model's actual output (the
                     # BIC-discovered k ≤ the requested k_max).  KMedoidsState
-                    # calls its centers "medoids", the GMM "means".
-                    "k": int(getattr(
-                        state, "centroids",
-                        getattr(state, "medoids",
-                                getattr(state, "means", None))
-                    ).shape[0]),
+                    # calls its centers "medoids", the GMM "means"; kernel
+                    # k-means has no input-space centers at all, so the
+                    # per-cluster counts carry its k.
+                    "k": int(_state_k(state)),
                 })
             except Exception as e:   # stream the failure, don't kill the room
                 room.broadcast_event({"type": "train_error", "error": str(e)})

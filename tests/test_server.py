@@ -325,41 +325,55 @@ def test_room_table_is_bounded():
     assert "FRESH" in s.rooms and "R0" not in s.rooms
 
 
-def test_train_op_streams_and_updates_board(server):
+
+def _train_and_collect(server, room, params, *, timeout_s=30):
+    """Subscribe a raw SSE socket, wait for hello (bounded), start a train
+    op, and collect the stream until train_done or the deadline.  Returns
+    the collected bytes.  THE one copy of the train-op SSE harness."""
     import socket
     import time as _time
 
-    room = "NNNN"
     host, port = server.httpd.server_address
     sock = socket.create_connection((host, port), timeout=30)
-    sock.sendall(
-        f"GET /api/events?room={room} HTTP/1.1\r\n"
-        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
-    )
-    # Wait for the subscription's hello frame before mutating, else early
-    # train events can be broadcast before the subscriber is registered.
-    hello_buf = b""
-    while b'"type": "hello"' not in hello_buf:
-        hello_buf += sock.recv(4096)
-    st, out = _mutate(server, room, "train",
-                      {"n": 200, "d": 2, "k": 3, "max_iter": 10})
-    assert st == 200 and out["started"]
+    try:
+        sock.sendall(
+            f"GET /api/events?room={room} HTTP/1.1\r\n"
+            f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
+        )
+        # Wait for the subscription's hello frame before mutating, else
+        # early train events can be broadcast before the subscriber is
+        # registered.  Bounded: a closed connection (recv -> b"") or the
+        # socket timeout fails the test instead of spinning forever.
+        hello_buf = b""
+        while b'"type": "hello"' not in hello_buf:
+            chunk = sock.recv(4096)
+            assert chunk, "SSE stream closed before hello"
+            hello_buf += chunk
+        st, out = _mutate(server, room, "train", params)
+        assert st == 200 and out["started"], (st, out)
+        deadline = _time.time() + timeout_s
+        buf = b""
+        while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
+               and _time.time() < deadline):
+            sock.settimeout(max(0.1, deadline - _time.time()))
+            try:
+                chunk = sock.recv(8192)
+            except socket.timeout:
+                break
+            if not chunk:
+                break
+            buf += chunk
+        return buf
+    finally:
+        sock.close()
 
-    deadline = _time.time() + 30
-    buf = b""
-    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
-           and _time.time() < deadline):
-        sock.settimeout(max(0.1, deadline - _time.time()))
-        try:
-            chunk = sock.recv(8192)
-        except socket.timeout:
-            break
-        if not chunk:
-            break
-        buf += chunk
-    sock.close()
+
+def test_train_op_streams_and_updates_board(server):
+    buf = _train_and_collect(server, "NNNN", {"n": 200, "d": 2, "k": 3,
+                                              "max_iter": 10})
     assert b'"type": "train"' in buf, buf[:500]
     assert b"train_done" in buf
+    room = "NNNN"
     # 2-D k=3 result was imported into the room board
     _, _, body = _get(server, f"/api/state?room={room}")
     state = json.loads(body)
@@ -374,36 +388,9 @@ def test_train_op_rejects_bad_shapes(server):
 
 
 def test_train_op_model_families(server):
-    import socket
-    import time as _time
-
-    room = "MMMM"
-    host, port = server.httpd.server_address
-    sock = socket.create_connection((host, port), timeout=30)
-    sock.sendall(
-        f"GET /api/events?room={room} HTTP/1.1\r\n"
-        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
-    )
-    hello_buf = b""
-    while b'"type": "hello"' not in hello_buf:
-        hello_buf += sock.recv(4096)
-    st, out = _mutate(server, room, "train",
-                      {"n": 200, "d": 2, "k": 3, "max_iter": 10,
-                       "model": "bisecting"})
-    assert st == 200 and out["started"]
-    deadline = _time.time() + 30
-    buf = b""
-    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
-           and _time.time() < deadline):
-        sock.settimeout(max(0.1, deadline - _time.time()))
-        try:
-            chunk = sock.recv(8192)
-        except socket.timeout:
-            break
-        if not chunk:
-            break
-        buf += chunk
-    sock.close()
+    buf = _train_and_collect(server, "MMMM",
+                             {"n": 200, "d": 2, "k": 3, "max_iter": 10,
+                              "model": "bisecting"})
     assert b'"model": "bisecting"' in buf, buf[:500]
     assert b"train_done" in buf
 
@@ -418,36 +405,9 @@ def test_train_op_rejects_bad_model_and_init(server):
 
 
 def test_train_op_minibatch_respects_step_cap(server):
-    import socket
-    import time as _time
-
-    room = "QQQQ"
-    host, port = server.httpd.server_address
-    sock = socket.create_connection((host, port), timeout=30)
-    sock.sendall(
-        f"GET /api/events?room={room} HTTP/1.1\r\n"
-        f"Host: x\r\nAccept: text/event-stream\r\n\r\n".encode()
-    )
-    buf = b""
-    while b'"type": "hello"' not in buf:
-        buf += sock.recv(4096)
-    st, out = _mutate(server, room, "train",
-                      {"n": 300, "d": 2, "k": 3, "max_iter": 7,
-                       "model": "minibatch"})
-    assert st == 200
-    deadline = _time.time() + 30
-    buf = b""
-    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
-           and _time.time() < deadline):
-        sock.settimeout(max(0.1, deadline - _time.time()))
-        try:
-            chunk = sock.recv(8192)
-        except socket.timeout:
-            break
-        if not chunk:
-            break
-        buf += chunk
-    sock.close()
+    buf = _train_and_collect(server, "QQQQ",
+                             {"n": 300, "d": 2, "k": 3, "max_iter": 7,
+                              "model": "minibatch"})
     done = [l for l in buf.decode().splitlines() if "train_done" in l]
     assert done, buf[:500]
     payload = json.loads(done[-1].split("data: ", 1)[1])
@@ -522,72 +482,18 @@ def test_train_op_xmeans_work_cap(server):
 def test_train_op_kmedoids_streams_train_done(server):
     """KMedoidsState names its centers 'medoids' — the train_done k field
     must not regress this family into train_error."""
-    import socket
-    import time as _time
-
-    room = "KMED"
-    host, port = server.httpd.server_address
-    sock = socket.create_connection((host, port), timeout=30)
-    sock.sendall(
-        f"GET /api/events?room={room} HTTP/1.1\r\n"
-        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
-    )
-    hello_buf = b""
-    while b'"type": "hello"' not in hello_buf:
-        hello_buf += sock.recv(4096)
-    st, out = _mutate(server, room, "train",
-                      {"n": 120, "d": 2, "k": 3, "max_iter": 5,
-                       "model": "kmedoids"})
-    assert st == 200 and out["started"]
-    deadline = _time.time() + 30
-    buf = b""
-    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
-           and _time.time() < deadline):
-        sock.settimeout(max(0.1, deadline - _time.time()))
-        try:
-            chunk = sock.recv(8192)
-        except socket.timeout:
-            break
-        if not chunk:
-            break
-        buf += chunk
-    sock.close()
+    buf = _train_and_collect(server, "KMED",
+                             {"n": 120, "d": 2, "k": 3, "max_iter": 5,
+                              "model": "kmedoids"})
     assert b"train_done" in buf, buf[:500]
     assert b"train_error" not in buf
     assert b'"k": 3' in buf
 
 
 def test_train_op_gmm_family(server):
-    import socket
-    import time as _time
-
-    room = "GMGM"
-    host, port = server.httpd.server_address
-    sock = socket.create_connection((host, port), timeout=30)
-    sock.sendall(
-        f"GET /api/events?room={room} HTTP/1.1\r\n"
-        f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
-    )
-    hello_buf = b""
-    while b'"type": "hello"' not in hello_buf:
-        hello_buf += sock.recv(4096)
-    st, out = _mutate(server, room, "train",
-                      {"n": 200, "d": 2, "k": 3, "max_iter": 10,
-                       "model": "gmm"})
-    assert st == 200 and out["started"]
-    deadline = _time.time() + 30
-    buf = b""
-    while (not (b"train_done" in buf and buf.endswith(b"\n\n"))
-           and _time.time() < deadline):
-        sock.settimeout(max(0.1, deadline - _time.time()))
-        try:
-            chunk = sock.recv(8192)
-        except socket.timeout:
-            break
-        if not chunk:
-            break
-        buf += chunk
-    sock.close()
+    buf = _train_and_collect(server, "GMGM",
+                             {"n": 200, "d": 2, "k": 3, "max_iter": 10,
+                              "model": "gmm"})
     assert b'"model": "gmm"' in buf, buf[:500]
     assert b"train_done" in buf
     # the train_done carries a finite objective (negated log-likelihood)
@@ -600,3 +506,23 @@ def test_train_op_gmm_family(server):
     import math
 
     assert math.isfinite(done["inertia"])
+
+
+def test_train_op_kernel_family_and_work_cap(server):
+    # flat n cap applies to kernel like kmedoids (O(n^2))
+    st, out = _mutate(server, "KNLX", "train",
+                      {"n": 30000, "d": 2, "k": 3, "model": "kernel"})
+    assert st == 400
+    # the WORK formula too: n under the flat cap, n²·d·max_iter over
+    # budget (mirrors test_train_op_kmedoids_work_cap exactly)
+    st, body = _mutate(
+        server, "KNLX", "train",
+        {"n": 20_000, "d": 400, "k": 3, "max_iter": 100, "model": "kernel"},
+    )
+    assert st == 400
+    assert "work too large" in body["error"]
+    buf = _train_and_collect(server, "KNLR",
+                             {"n": 150, "d": 2, "k": 3, "max_iter": 10,
+                              "model": "kernel"})
+    assert b'"model": "kernel"' in buf, buf[:500]
+    assert b"train_done" in buf

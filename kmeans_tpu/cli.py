@@ -286,6 +286,11 @@ def _cmd_sweep(args) -> int:
             cluster_std=args.cluster_std,
         )
 
+    if args.criterion in ("bic", "aic") and args.model != "gmm":
+        # Statically knowable mismatch: fail before any fit burns compute.
+        print(f"error: --criterion {args.criterion} requires --model gmm",
+              file=sys.stderr)
+        return 2
     ks = list(range(args.k_min, args.k_max + 1, args.k_step))
     try:
         rows = sweep_k(
@@ -293,7 +298,7 @@ def _cmd_sweep(args) -> int:
             compute_dtype=args.dtype, init=args.init, seed=args.seed,
             silhouette_sample=args.silhouette_sample,
         )
-        suggestion = suggest_k(rows)  # may raise — before any output
+        suggestion = suggest_k(rows, criterion=args.criterion)  # may raise
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -386,8 +391,11 @@ def main(argv=None) -> int:
     w.add_argument("--k-step", type=int, default=1)
     w.add_argument("--model", default="lloyd", choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "kmedoids",
+        "fuzzy", "gmm", "kmedoids",
     ])
+    w.add_argument("--criterion", default="silhouette",
+                   choices=["silhouette", "bic", "aic"],
+                   help="suggestion rule; bic/aic need --model gmm")
     w.add_argument("--init", default="k-means++",
                    choices=["k-means++", "k-means||", "random"])
     w.add_argument("--max-iter", type=int, default=100)

@@ -19,7 +19,7 @@ Policy constants preserved from the reference (behavioral contract):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 # ---------------------------------------------------------------------------
 # Reference policy constants (session / UI behavioral contract)

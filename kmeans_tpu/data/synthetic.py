@@ -9,7 +9,7 @@ synthetic data of matching statistics; see BASELINE.md).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -44,6 +44,19 @@ from kmeans_tpu.models.spherical import (
 )
 
 
+def state_centers(state):
+    """The (k, d) center array of any family's fit state, or ``None`` for
+    center-free families (kernel k-means lives in feature space).  THE one
+    copy of the field-name mapping (centroids / medoids / means) — the
+    serve train op's k field and the sweep's dispersion scores both call
+    this, so a new family's state shape only has to be taught here."""
+    for attr in ("centroids", "medoids", "means"):
+        arr = getattr(state, attr, None)
+        if arr is not None:
+            return arr
+    return None
+
+
 def state_objective(state) -> float:
     """One lower-is-better scalar for any family's fit state: hard
     families report inertia, fuzzy/kernel their objective J, the GMM its
@@ -97,6 +110,7 @@ __all__ = [
     "SphericalKMeans",
     "fit_spherical",
     "normalize_rows",
+    "state_centers",
     "state_objective",
     "suggest_k",
     "sweep_k",

@@ -17,7 +17,7 @@ per couple of splits, and every FLOP lands on the MXU.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Union
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -191,8 +191,6 @@ def _resolve_labels0(x, k, key, cfg, init, weights):
     """Initial labels: an (n,) int array, or an input-space k-means init
     (centroid seeding + one nearest-centroid assignment) — the standard
     practical warm start for kernel k-means."""
-    import numpy as np
-
     if init is not None and not isinstance(init, str):
         arr = jnp.asarray(init)
         if arr.ndim == 1:

@@ -28,6 +28,8 @@ _FITTERS = {
     "minibatch": "fit_minibatch",
     "spherical": "fit_spherical",
     "bisecting": "fit_bisecting",
+    "fuzzy": "fit_fuzzy",
+    "gmm": "fit_gmm",
     "kmedoids": "fit_kmedoids",
 }
 
@@ -49,9 +51,16 @@ def sweep_k(
     """Fit ``model`` for every k in ``ks``; return one scored row per k.
 
     Rows carry ``{k, inertia, n_iter, converged, silhouette,
-    davies_bouldin, calinski_harabasz}``.  Silhouette is the chunked/sampled
+    davies_bouldin, calinski_harabasz}`` ("inertia" is each family's
+    lower-is-better objective via
+    :func:`kmeans_tpu.models.state_objective`).  GMM rows additionally
+    carry ``bic``/``aic`` (diag-covariance parameter count), enabling
+    ``suggest_k(rows, criterion="bic")`` — the model-based complement to
+    the silhouette pick.  Silhouette is the chunked/sampled
     implementation, so sweeps stay affordable at large n.
     """
+    import math
+
     import kmeans_tpu.models as models
     from kmeans_tpu.metrics import dispersion_scores, silhouette_score
 
@@ -78,19 +87,25 @@ def sweep_k(
         state = fit(x, int(k), key=jax.random.fold_in(key, i), config=cfg)
         row = {
             "k": int(k),
-            "inertia": float(state.inertia),
+            "inertia": models.state_objective(state),
             "n_iter": int(state.n_iter),
             "converged": bool(state.converged),
         }
+        if model == "gmm":
+            # Diag covariance (the fit default): k·d means + k·d variances
+            # + (k-1) mixing weights.
+            n, d = x.shape
+            p = 2 * int(k) * d + (int(k) - 1)
+            ll = float(state.log_likelihood)
+            row["bic"] = -2.0 * ll + p * math.log(n)
+            row["aic"] = -2.0 * ll + 2 * p
         if k >= 2:
             row["silhouette"] = float(silhouette_score(
                 x, state.labels, k=int(k), sample_size=silhouette_sample,
                 key=jax.random.fold_in(key, 10_000 + i),
                 chunk_size=chunk_size,
             ))
-            centers = getattr(state, "centroids", None)
-            if centers is None:  # KMedoidsState names them medoids
-                centers = state.medoids
+            centers = models.state_centers(state)
             db, ch = dispersion_scores(
                 x, state.labels, centers, chunk_size=chunk_size
             )
@@ -100,14 +115,26 @@ def sweep_k(
     return rows
 
 
-def suggest_k(rows: List[Dict]) -> int:
-    """The k with the best (highest) silhouette among scored rows.
+def suggest_k(rows: List[Dict], *, criterion: str = "silhouette") -> int:
+    """The best k among scored rows.
 
-    Silhouette is bounded, scale-free, and peaks at the natural cluster
-    count on separable data — unlike raw inertia, which always decreases
-    in k and needs a subjective elbow read.
+    ``criterion="silhouette"`` (default) picks the highest silhouette —
+    bounded, scale-free, peaks at the natural cluster count on separable
+    data, unlike raw inertia which always decreases in k and needs a
+    subjective elbow read.  ``criterion="bic"``/``"aic"`` pick the lowest
+    information criterion (GMM sweeps), trading fit against parameter
+    count model-theoretically instead of geometrically.
     """
-    scored = [r for r in rows if "silhouette" in r]
-    if not scored:
-        raise ValueError("no rows with k >= 2 to choose among")
-    return max(scored, key=lambda r: r["silhouette"])["k"]
+    if criterion == "silhouette":
+        scored = [r for r in rows if "silhouette" in r]
+        if not scored:
+            raise ValueError("no rows with k >= 2 to choose among")
+        return max(scored, key=lambda r: r["silhouette"])["k"]
+    if criterion in ("bic", "aic"):
+        scored = [r for r in rows if criterion in r]
+        if not scored:
+            raise ValueError(
+                f"no rows carry {criterion!r} — sweep with model='gmm'"
+            )
+        return min(scored, key=lambda r: r[criterion])["k"]
+    raise ValueError(f"unknown criterion {criterion!r}")

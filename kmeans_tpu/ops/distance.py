@@ -19,7 +19,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

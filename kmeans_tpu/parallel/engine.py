@@ -25,7 +25,7 @@ over the stepped ``shard_map`` — one compiled program for the whole fit.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

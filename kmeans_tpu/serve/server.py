@@ -79,11 +79,12 @@ def _state_k(state) -> int:
     """The fitted k from any family's state: center array if it has one
     (xmeans/gmeans return fewer centers than k_max), else the per-cluster
     counts length (kernel k-means has no input-space centers)."""
-    for attr in ("centroids", "medoids", "means", "counts"):
-        arr = getattr(state, attr, None)
-        if arr is not None:
-            return arr.shape[0]
-    raise AttributeError(f"no center/count field on {type(state).__name__}")
+    from kmeans_tpu.models import state_centers
+
+    centers = state_centers(state)
+    if centers is not None:
+        return centers.shape[0]
+    return state.counts.shape[0]
 
 #: _headers:1-21 adapted to same-origin serving (no CDNs, no trackers).
 _SECURITY_HEADERS = {

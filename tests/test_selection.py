@@ -167,3 +167,26 @@ def test_sweep_k_and_cli_support_kmedoids(capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip())
     assert out["mode"] == "kmedoids"
+
+
+def test_sweep_gmm_bic_recovers_k():
+    key = jax.random.key(7)
+    x, _, _ = make_blobs(key, 600, 4, 3, cluster_std=0.4)
+    rows = sweep_k(np.asarray(x), [1, 2, 3, 4, 5], model="gmm", seed=3,
+                   max_iter=40)
+    for r in rows:
+        assert "bic" in r and "aic" in r and np.isfinite(r["bic"])
+    assert suggest_k(rows, criterion="bic") == 3
+    # bic exists even for k=1 (no silhouette there)
+    assert "silhouette" not in rows[0] and "bic" in rows[0]
+    with pytest.raises(ValueError, match="criterion"):
+        suggest_k(rows, criterion="elbow")
+
+
+def test_sweep_fuzzy_and_bic_requires_gmm():
+    key = jax.random.key(8)
+    x, _, _ = make_blobs(key, 200, 3, 3, cluster_std=0.4)
+    rows = sweep_k(np.asarray(x), [2, 3], model="fuzzy", seed=0, max_iter=20)
+    assert all("silhouette" in r for r in rows)
+    with pytest.raises(ValueError, match="model='gmm'"):
+        suggest_k(rows, criterion="bic")

@@ -23,6 +23,7 @@ from kmeans_tpu.models.gmm import (
     gmm_log_resp,
     gmm_predict,
 )
+from kmeans_tpu.models.gmm_stream import fit_gmm_stream, gmm_assign_stream
 from kmeans_tpu.models.kernel import (
     KernelKMeans,
     KernelKMeansState,
@@ -88,6 +89,8 @@ __all__ = [
     "GMMParams",
     "GMMState",
     "fit_gmm",
+    "fit_gmm_stream",
+    "gmm_assign_stream",
     "gmm_log_resp",
     "gmm_predict",
     "KernelKMeans",

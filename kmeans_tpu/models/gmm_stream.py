@@ -1,0 +1,209 @@
+"""Out-of-core Gaussian mixture: online (stepwise) EM on streamed batches.
+
+The soft-clustering member of the streaming family: where
+:mod:`kmeans_tpu.models.streaming` streams Sculley minibatch k-means,
+this streams Cappé–Moulines stepwise EM — the running per-unit-mass
+sufficient statistics s = (N̄, S̄, Q̄) are blended toward each batch's
+statistics with a decaying rate
+
+  s ← (1 − ρ_t)·s + ρ_t·ŝ_batch,     ρ_t = (t + t₀)^(−κ),  κ ∈ (0.5, 1]
+
+and the M-step (closed form, shared with the full-batch fit via
+``gmm_m_step``) runs after every batch.  ρ₀ = 1 when t₀ = 1 (the default),
+so the first batch initializes the statistics outright.  The batch E-step
+is the same two-matmul ``gmm_scan_tiles`` tile the full-batch fit runs —
+only a (batch, d) tile plus the (k, d) parameters ever occupy HBM.
+
+Batches ride the same host loader as the streamed k-means (native
+threaded gather, background prefetch), and are a pure function of
+(seed, step) so runs are reproducible.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.data.stream import prefetch_to_device, sample_batches
+from kmeans_tpu.models.gmm import (
+    GMMParams,
+    GMMState,
+    gmm_log_resp,
+    gmm_m_step,
+    gmm_scan_tiles,
+    init_gmm_params,
+)
+from kmeans_tpu.models.init import resolve_fit_config
+from kmeans_tpu.ops.distance import chunk_tiles
+
+__all__ = ["fit_gmm_stream", "gmm_assign_stream"]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("covariance_type", "compute_dtype")
+)
+def _gmm_stream_step(params: GMMParams, stats, xb, rho, reg_covar, *,
+                     covariance_type, compute_dtype):
+    """One stepwise-EM update from one (b, d) batch.
+
+    Returns ``(new_params, new_stats, mean_batch_ll)`` where stats are the
+    per-unit-mass running (N̄, S̄, Q̄).  The M-step is scale-free in the
+    statistics (it normalizes by N), so feeding the per-unit averages
+    directly is exact.
+    """
+    b = xb.shape[0]
+    xs = xb[None]                                    # one tile
+    ws = jnp.ones((1, b), jnp.float32)
+    N, S, Q, ll, _ = gmm_scan_tiles(
+        xs, ws, params, compute_dtype=compute_dtype, with_labels=False
+    )
+    batch = (N / b, S / b, Q / b)
+    stats = jax.tree.map(
+        lambda s, bn: (1.0 - rho) * s + rho * bn, stats, batch
+    )
+    new_params = gmm_m_step(
+        params, stats[0], stats[1], stats[2],
+        covariance_type=covariance_type, reg_covar=reg_covar,
+    )
+    return new_params, stats, ll / b
+
+
+def gmm_assign_stream(
+    data,
+    params: GMMParams,
+    *,
+    chunk_size: int = 65536,
+    compute_dtype=None,
+):
+    """Labels + total log-likelihood for host-resident ``data`` in one
+    streamed pass (chunks double-buffered through the device).  Returns
+    ``(labels (n,) int32 np.ndarray, log_likelihood float,
+    soft_counts (k,) np.ndarray)``."""
+    n = data.shape[0]
+    k = params.means.shape[0]
+    labels = np.empty((n,), np.int32)
+    ll = 0.0
+    soft = np.zeros((k,), np.float64)
+
+    def chunks():
+        for lo in range(0, n, chunk_size):
+            yield np.ascontiguousarray(data[lo:lo + chunk_size])
+
+    lo = 0
+    for xb in prefetch_to_device(chunks()):
+        log_resp, log_prob = gmm_log_resp(
+            xb, params, chunk_size=chunk_size, compute_dtype=compute_dtype
+        )
+        m = int(log_prob.shape[0])
+        labels[lo:lo + m] = np.asarray(jnp.argmax(log_resp, axis=1))
+        ll += float(jnp.sum(log_prob))
+        soft += np.asarray(jnp.sum(jnp.exp(log_resp), axis=0), np.float64)
+        lo += m
+    return labels, ll, soft.astype(np.float32)
+
+
+def fit_gmm_stream(
+    data,
+    k: int,
+    *,
+    covariance_type: str = "diag",
+    reg_covar: float = 1e-6,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init: Union[str, jax.Array, None] = None,
+    batch_size: Optional[int] = None,
+    steps: Optional[int] = None,
+    seed: Optional[int] = None,
+    kappa: float = 0.7,
+    t0: float = 1.0,
+    prefetch_depth: int = 2,
+    background_prefetch: bool = True,
+    final_pass: bool = True,
+) -> GMMState:
+    """Online EM over host/disk data of unbounded size.
+
+    ``data`` is any 2-D array-like with numpy indexing (``np.ndarray``,
+    ``np.memmap``).  ``kappa`` is the Robbins–Monro decay exponent
+    (must lie in (0.5, 1] for convergence; 0.7 is the standard stepwise-EM
+    choice) and ``t0 >= 1`` offsets the schedule (t₀ = 1 makes the first
+    batch initialize the statistics outright).  With ``final_pass`` a
+    streamed evaluation fills labels / total log-likelihood / soft counts
+    at the final parameters; otherwise those fields are empty.
+    """
+    if covariance_type not in ("diag", "spherical"):
+        raise ValueError(
+            f"covariance_type must be 'diag' or 'spherical', "
+            f"got {covariance_type!r}"
+        )
+    if not 0.5 < kappa <= 1.0:
+        raise ValueError(f"kappa must be in (0.5, 1], got {kappa}")
+    if not t0 >= 1.0:
+        raise ValueError(f"t0 must be >= 1, got {t0}")
+    if not reg_covar >= 0.0:
+        raise ValueError(f"reg_covar must be >= 0, got {reg_covar}")
+    cfg, key = resolve_fit_config(k, key, config)
+    n, d = data.shape
+    bs = batch_size if batch_size is not None else cfg.batch_size
+    n_steps = steps if steps is not None else cfg.steps
+    host_seed = seed if seed is not None else cfg.seed
+
+    # Seed parameters on a host subsample (the shared streamed-family
+    # recipe): means from the configured init method, variances from the
+    # subsample's per-feature variance, uniform mixing weights.  An
+    # explicit init array is shape-validated inside the helper before any
+    # disk I/O happens.
+    from kmeans_tpu.models.init import host_subsample_seed
+
+    c0, xs_host = host_subsample_seed(
+        data, k, key, cfg, init, host_seed=host_seed, return_sample=True
+    )
+    tiles, tile_w, _ = chunk_tiles(xs_host, None, cfg.chunk_size)
+    params = init_gmm_params(
+        c0, tiles, tile_w, covariance_type=covariance_type,
+        reg_covar=jnp.asarray(reg_covar, jnp.float32),
+    )
+    stats = (jnp.zeros((k,), jnp.float32),
+             jnp.zeros((k, d), jnp.float32),
+             jnp.zeros((k, d), jnp.float32))
+
+    reg = jnp.asarray(reg_covar, jnp.float32)
+    batches = sample_batches(data, bs, n_steps, seed=host_seed)
+    step = 0
+    for xb in prefetch_to_device(batches, depth=prefetch_depth,
+                                 background=background_prefetch):
+        rho = jnp.asarray((step + t0) ** (-kappa), jnp.float32)
+        params, stats, _ = _gmm_stream_step(
+            params, stats, xb, rho, reg,
+            covariance_type=covariance_type,
+            compute_dtype=cfg.compute_dtype,
+        )
+        step += 1
+
+    if final_pass:
+        labels_np, ll, soft = gmm_assign_stream(
+            data, params, chunk_size=max(cfg.chunk_size, 8192),
+            compute_dtype=cfg.compute_dtype,
+        )
+        labels = jnp.asarray(labels_np)
+        ll_v = jnp.asarray(ll, jnp.float32)
+        counts = jnp.asarray(soft)
+    else:
+        labels = jnp.zeros((0,), jnp.int32)
+        ll_v = jnp.zeros((), jnp.float32)
+        counts = jnp.zeros((k,), jnp.float32)
+
+    return GMMState(
+        means=params.means,
+        covariances=params.variances,
+        mix_weights=jnp.exp(params.log_pi),
+        labels=labels,
+        log_likelihood=ll_v,
+        n_iter=jnp.asarray(step, jnp.int32),
+        converged=jnp.asarray(False),
+        resp_counts=counts,
+    )

@@ -25,7 +25,7 @@ from jax import lax
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
 __all__ = ["random_init", "kmeans_plus_plus", "kmeans_parallel",
-           "init_centroids", "resolve_fit_inputs"]
+           "init_centroids", "resolve_fit_inputs", "host_subsample_seed"]
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -295,3 +295,44 @@ def resolve_fit_inputs(x, k, key, config, init, weights):
             compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
         )
     return cfg, key, c0
+
+
+def host_subsample_seed(data, k, key, cfg, init, *, host_seed,
+                        return_sample=False):
+    """Streamed-family seeding: resolve ``init`` against host-resident data.
+
+    An explicit (k, d) array is shape-validated FIRST (before any disk
+    I/O); otherwise the configured init method runs on a host-gathered
+    random subsample (``min(n, max(64·k, 65536))`` rows via
+    ``default_rng(host_seed)`` — deterministic, sorted for memmap-friendly
+    access).  THE one copy of the recipe shared by the streamed k-means
+    and the streamed GMM, so their seeding can't drift.
+
+    Returns ``c0`` (k, d) float32, or ``(c0, subsample)`` with
+    ``return_sample`` (the streamed GMM inits variances from the sample).
+    """
+    import numpy as np
+
+    n, d = data.shape
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, d):
+            raise ValueError(f"init centroids shape {c0.shape} != {(k, d)}")
+        if not return_sample:
+            return c0
+        xs = None
+    else:
+        c0 = None
+        xs = None
+    if c0 is None or return_sample:
+        sub = min(n, max(4 * k * 16, 65536))
+        rng = np.random.default_rng(host_seed)
+        sidx = np.sort(rng.choice(n, size=sub, replace=False))
+        xs = jnp.asarray(np.ascontiguousarray(data[sidx]))
+    if c0 is None:
+        method = init if isinstance(init, str) else cfg.init
+        c0 = init_centroids(
+            key, xs, k, method=method, compute_dtype=cfg.compute_dtype,
+            chunk_size=cfg.chunk_size,
+        )
+    return (c0, xs) if return_sample else c0

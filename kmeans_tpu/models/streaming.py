@@ -24,7 +24,7 @@ import numpy as np
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.data.stream import prefetch_to_device, sample_batches
-from kmeans_tpu.models.init import init_centroids, resolve_fit_config
+from kmeans_tpu.models.init import resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
 
 __all__ = ["fit_minibatch_stream", "assign_stream"]
@@ -213,23 +213,10 @@ def fit_minibatch_stream(
 
     if c0 is None:
         n_seen = jnp.zeros((k,), jnp.float32)
-        if init is not None and not isinstance(init, str):
-            c0 = jnp.asarray(init, jnp.float32)
-            if c0.shape != (k, d):
-                raise ValueError(
-                    f"init centroids shape {c0.shape} != {(k, d)}"
-                )
-        else:
-            # Seed on a host subsample (mirrors fit_minibatch's recipe).
-            method = init if isinstance(init, str) else cfg.init
-            sub = min(n, max(4 * k * 16, 65536))
-            rng = np.random.default_rng(host_seed)
-            sidx = np.sort(rng.choice(n, size=sub, replace=False))
-            xs = jnp.asarray(np.ascontiguousarray(data[sidx]))
-            c0 = init_centroids(
-                key, xs, k, method=method, compute_dtype=cfg.compute_dtype,
-                chunk_size=cfg.chunk_size,
-            )
+        from kmeans_tpu.models.init import host_subsample_seed
+
+        c0 = host_subsample_seed(data, k, key, cfg, init,
+                                 host_seed=host_seed)
 
     last_saved = [-1]
 

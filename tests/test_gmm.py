@@ -203,3 +203,61 @@ def test_gmm_log_resp_matches_state_labels(rng):
         np.asarray(jnp.argmax(log_resp, axis=1)), np.asarray(s.labels)
     )
     assert log_prob.shape == (40,)
+
+
+def test_gmm_stream_recovers_blobs():
+    """Online EM on streamed batches lands near the full-batch EM fit."""
+    from kmeans_tpu.metrics import adjusted_rand_index
+    from kmeans_tpu.models import fit_gmm_stream
+
+    key = jax.random.key(17)
+    x, true_labels, _ = make_blobs(key, 4000, 6, 4)
+    xh = np.asarray(x)
+    st = fit_gmm_stream(xh, 4, batch_size=256, steps=60, seed=2)
+    ari = float(adjusted_rand_index(jnp.asarray(true_labels), st.labels))
+    assert ari > 0.99, ari
+    np.testing.assert_allclose(float(jnp.sum(st.mix_weights)), 1.0,
+                               rtol=1e-5)
+    assert int(st.n_iter) == 60
+    # soft counts roughly partition the data
+    np.testing.assert_allclose(float(jnp.sum(st.resp_counts)), 4000.0,
+                               rtol=1e-3)
+    # full EM at the same k: streamed means land near some full-EM mean
+    full = fit_gmm(jnp.asarray(xh), 4, tol=1e-7, max_iter=60,
+                   key=jax.random.key(3))
+    d = np.linalg.norm(
+        np.asarray(st.means)[:, None, :] - np.asarray(full.means)[None],
+        axis=-1,
+    )
+    assert d.min(axis=1).max() < 0.5, d.min(axis=1)
+
+
+def test_gmm_stream_deterministic_and_memmap(tmp_path):
+    from kmeans_tpu.models import fit_gmm_stream
+
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(size=(300, 4)) + 5,
+                        rng.normal(size=(300, 4))]).astype(np.float32)
+    p = tmp_path / "x.npy"
+    np.save(p, x)
+    mm = np.load(p, mmap_mode="r")
+    a = fit_gmm_stream(x, 2, batch_size=128, steps=20, seed=1)
+    b = fit_gmm_stream(mm, 2, batch_size=128, steps=20, seed=1)
+    np.testing.assert_allclose(np.asarray(a.means), np.asarray(b.means),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(a.labels),
+                                  np.asarray(b.labels))
+
+
+def test_gmm_stream_validation():
+    from kmeans_tpu.models import fit_gmm_stream
+
+    x = np.zeros((64, 3), np.float32)
+    with pytest.raises(ValueError, match="kappa"):
+        fit_gmm_stream(x, 2, kappa=0.4, steps=1)
+    with pytest.raises(ValueError, match="t0"):
+        fit_gmm_stream(x, 2, t0=0.5, steps=1)
+    with pytest.raises(ValueError, match="covariance_type"):
+        fit_gmm_stream(x, 2, covariance_type="full", steps=1)
+    with pytest.raises(ValueError, match="shape"):
+        fit_gmm_stream(x, 2, init=jnp.zeros((3, 3)), steps=1)

@@ -42,21 +42,26 @@ def _cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.stream and args.minibatch is False:
-        print("error: --stream is the out-of-core minibatch path; it "
-              "contradicts --no-minibatch", file=sys.stderr)
+    if args.stream and args.minibatch is False and args.model is None:
+        # --stream defaults to the minibatch path, which --no-minibatch
+        # contradicts (an explicit --model gmm stream is fine).
+        print("error: --stream defaults to the out-of-core minibatch "
+              "path; --no-minibatch contradicts it (pass --model gmm for "
+              "the streamed mixture)", file=sys.stderr)
         return 2
     if args.model is not None:
         model = args.model
     elif args.stream:
-        model = "minibatch"  # --stream IS the out-of-core minibatch path
+        model = "minibatch"  # --stream defaults to out-of-core minibatch
     else:
         use_mb = args.minibatch if args.minibatch is not None else cfg_minibatch
         model = "minibatch" if use_mb else "lloyd"
     minibatch = model == "minibatch"
-    if args.stream and not minibatch:
-        print("error: --stream is the out-of-core minibatch path; it "
-              f"supports --model minibatch, not {model}", file=sys.stderr)
+    stream_ok = ("minibatch", "gmm")
+    if args.stream and model not in stream_ok:
+        print("error: --stream is the out-of-core path; it supports "
+              f"--model {'/'.join(stream_ok)}, not {model}",
+              file=sys.stderr)
         return 2
 
     if args.stream and not args.input:
@@ -86,13 +91,14 @@ def _cmd_train(args) -> int:
     # --max-iter governs the Lloyd-family loop; the minibatch/stream path is
     # step-based.  Flags that would be silently ignored are rejected instead
     # (matching the CLI's other contradictory-flag guards; advisor r1).
-    if minibatch and args.max_iter is not None:
-        print("error: --max-iter has no effect with --model minibatch/"
-              "--stream (step-based); use --steps/--batch-size",
+    step_based = minibatch or (args.stream and model == "gmm")
+    if step_based and args.max_iter is not None:
+        print("error: --max-iter has no effect with the step-based "
+              "minibatch/stream paths; use --steps/--batch-size",
               file=sys.stderr)
         return 2
-    if not minibatch and (args.steps is not None
-                          or args.batch_size is not None):
+    if not step_based and (args.steps is not None
+                           or args.batch_size is not None):
         print(f"error: --steps/--batch-size are minibatch/stream flags; "
               f"--model {model} runs to --max-iter/--tol", file=sys.stderr)
         return 2
@@ -211,7 +217,10 @@ def _cmd_train(args) -> int:
         }[model]
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
     elif args.stream:
-        state = models.fit_minibatch_stream(x, k, config=kcfg)
+        if model == "gmm":
+            state = models.fit_gmm_stream(x, k, config=kcfg)
+        else:
+            state = models.fit_minibatch_stream(x, k, config=kcfg)
     else:
         fit = {
             "lloyd": models.fit_lloyd,
@@ -339,7 +348,8 @@ def main(argv=None) -> int:
     t.add_argument("--input", help="path to a .npy (n, d) feature matrix")
     t.add_argument("--stream", action="store_true",
                    help="memory-map --input and stream batches to the chip "
-                        "(out-of-core minibatch; data never fully loads)")
+                        "(out-of-core; data never fully loads — minibatch "
+                        "k-means by default, online EM with --model gmm)")
     t.add_argument("--n", type=int, default=500)
     t.add_argument("--d", type=int, default=2)
     t.add_argument("--k", type=int, default=3)

@@ -27,7 +27,8 @@ from typing import Iterable, Iterator, Optional
 import jax
 import numpy as np
 
-__all__ = ["load_mmap", "sample_batches", "prefetch_to_device"]
+__all__ = ["load_mmap", "sample_batches", "prefetch_to_device",
+           "foreach_chunk"]
 
 
 def load_mmap(path: str) -> np.ndarray:
@@ -114,6 +115,23 @@ def prefetch_to_device(
         except StopIteration:
             pass
         yield out
+
+
+def foreach_chunk(data, chunk_size: int, fn) -> None:
+    """Run ``fn(xb, lo)`` over sequential row chunks of host ``data``,
+    double-buffered through the device.  THE one copy of the streamed
+    full-pass skeleton (chunk generator, prefetch, row-offset bookkeeping)
+    shared by the k-means and GMM labeling passes."""
+    n = data.shape[0]
+
+    def chunks():
+        for lo in range(0, n, chunk_size):
+            yield np.ascontiguousarray(data[lo:lo + chunk_size])
+
+    lo = 0
+    for xb in prefetch_to_device(chunks()):
+        fn(xb, lo)
+        lo += int(xb.shape[0])
 
 
 def _prefetch_background(batches, depth, device):

@@ -29,7 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kmeans_tpu.config import KMeansConfig
-from kmeans_tpu.data.stream import prefetch_to_device, sample_batches
+from kmeans_tpu.data.stream import (
+    foreach_chunk,
+    prefetch_to_device,
+    sample_batches,
+)
 from kmeans_tpu.models.gmm import (
     GMMParams,
     GMMState,
@@ -38,7 +42,7 @@ from kmeans_tpu.models.gmm import (
     gmm_scan_tiles,
     init_gmm_params,
 )
-from kmeans_tpu.models.init import resolve_fit_config
+from kmeans_tpu.models.init import host_subsample_seed, resolve_fit_config
 from kmeans_tpu.ops.distance import chunk_tiles
 
 __all__ = ["fit_gmm_stream", "gmm_assign_stream"]
@@ -87,24 +91,20 @@ def gmm_assign_stream(
     n = data.shape[0]
     k = params.means.shape[0]
     labels = np.empty((n,), np.int32)
-    ll = 0.0
+    ll = [0.0]
     soft = np.zeros((k,), np.float64)
 
-    def chunks():
-        for lo in range(0, n, chunk_size):
-            yield np.ascontiguousarray(data[lo:lo + chunk_size])
-
-    lo = 0
-    for xb in prefetch_to_device(chunks()):
+    def one_chunk(xb, lo):
         log_resp, log_prob = gmm_log_resp(
             xb, params, chunk_size=chunk_size, compute_dtype=compute_dtype
         )
         m = int(log_prob.shape[0])
         labels[lo:lo + m] = np.asarray(jnp.argmax(log_resp, axis=1))
-        ll += float(jnp.sum(log_prob))
-        soft += np.asarray(jnp.sum(jnp.exp(log_resp), axis=0), np.float64)
-        lo += m
-    return labels, ll, soft.astype(np.float32)
+        ll[0] += float(jnp.sum(log_prob))
+        soft[:] += np.asarray(jnp.sum(jnp.exp(log_resp), axis=0), np.float64)
+
+    foreach_chunk(data, chunk_size, one_chunk)
+    return labels, ll[0], soft.astype(np.float32)
 
 
 def fit_gmm_stream(
@@ -157,8 +157,6 @@ def fit_gmm_stream(
     # subsample's per-feature variance, uniform mixing weights.  An
     # explicit init array is shape-validated inside the helper before any
     # disk I/O happens.
-    from kmeans_tpu.models.init import host_subsample_seed
-
     c0, xs_host = host_subsample_seed(
         data, k, key, cfg, init, host_seed=host_seed, return_sample=True
     )

@@ -23,8 +23,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from kmeans_tpu.config import KMeansConfig
-from kmeans_tpu.data.stream import prefetch_to_device, sample_batches
-from kmeans_tpu.models.init import resolve_fit_config
+from kmeans_tpu.data.stream import (
+    foreach_chunk,
+    prefetch_to_device,
+    sample_batches,
+)
+from kmeans_tpu.models.init import host_subsample_seed, resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
 
 __all__ = ["fit_minibatch_stream", "assign_stream"]
@@ -56,26 +60,21 @@ def assign_stream(
     fit; labels come back to host per chunk.  Returns
     ``(labels (n,) int32 np.ndarray, inertia float)``.
     """
-    n = data.shape[0]
-    c = jnp.asarray(centroids, jnp.float32)
-
-    def chunks():
-        for lo in range(0, n, chunk_size):
-            yield np.ascontiguousarray(data[lo:lo + chunk_size])
-
     from kmeans_tpu.ops.distance import assign
 
+    n = data.shape[0]
+    c = jnp.asarray(centroids, jnp.float32)
     labels = np.empty((n,), np.int32)
-    inertia = 0.0
-    lo = 0
-    for xb in prefetch_to_device(chunks()):
+    inertia = [0.0]
+
+    def one_chunk(xb, lo):
         lab, mind = assign(xb, c, chunk_size=chunk_size,
                            compute_dtype=compute_dtype)
-        m = int(lab.shape[0])
-        labels[lo:lo + m] = np.asarray(lab)
-        inertia += float(jnp.sum(mind))
-        lo += m
-    return labels, inertia
+        labels[lo:lo + int(lab.shape[0])] = np.asarray(lab)
+        inertia[0] += float(jnp.sum(mind))
+
+    foreach_chunk(data, chunk_size, one_chunk)
+    return labels, inertia[0]
 
 
 def fit_minibatch_stream(
@@ -213,8 +212,6 @@ def fit_minibatch_stream(
 
     if c0 is None:
         n_seen = jnp.zeros((k,), jnp.float32)
-        from kmeans_tpu.models.init import host_subsample_seed
-
         c0 = host_subsample_seed(data, k, key, cfg, init,
                                  host_seed=host_seed)
 

@@ -172,3 +172,27 @@ def test_train_kernel_family(capsys):
     ])
     assert rc in (0, None)
     assert json.loads(out.splitlines()[0])["mode"] == "kernel"
+
+
+def test_train_stream_gmm(cifar_like_npy, capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--model", "gmm",
+        "--k", "4", "--steps", "25", "--batch-size", "256",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "gmm" and res["stream"] is True
+    assert res["n_iter"] == 25
+    assert np.isfinite(res["inertia"])
+    # streamed gmm is step-based: --max-iter is rejected like minibatch
+    rc, _, err = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--model", "gmm",
+        "--k", "4", "--max-iter", "10",
+    ])
+    assert rc == 2 and "step-based" in err
+    # non-streamable family rejected
+    rc, _, err = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--model", "kernel",
+        "--k", "4",
+    ])
+    assert rc == 2 and "supports --model" in err

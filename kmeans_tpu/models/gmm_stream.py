@@ -119,31 +119,41 @@ def fit_gmm_stream(
     batch_size: Optional[int] = None,
     steps: Optional[int] = None,
     seed: Optional[int] = None,
-    kappa: float = 0.7,
-    t0: float = 1.0,
+    kappa: Optional[float] = None,
+    t0: Optional[float] = None,
     prefetch_depth: int = 2,
     background_prefetch: bool = True,
     final_pass: bool = True,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 100,
+    resume: bool = False,
 ) -> GMMState:
     """Online EM over host/disk data of unbounded size.
 
     ``data`` is any 2-D array-like with numpy indexing (``np.ndarray``,
     ``np.memmap``).  ``kappa`` is the Robbins–Monro decay exponent
-    (must lie in (0.5, 1] for convergence; 0.7 is the standard stepwise-EM
-    choice) and ``t0 >= 1`` offsets the schedule (t₀ = 1 makes the first
-    batch initialize the statistics outright).  With ``final_pass`` a
-    streamed evaluation fills labels / total log-likelihood / soft counts
-    at the final parameters; otherwise those fields are empty.
+    (must lie in (0.5, 1] for convergence; the default 0.7 is the standard
+    stepwise-EM choice) and ``t0 >= 1`` offsets the schedule (the default
+    t₀ = 1 makes the first batch initialize the statistics outright).
+    With ``final_pass`` a streamed evaluation fills labels / total
+    log-likelihood / soft counts at the final parameters; otherwise those
+    fields are empty.
+
+    With ``checkpoint_path``, (parameters, running statistics, step) are
+    saved atomically every ``checkpoint_every`` steps and at the end; with
+    ``resume`` an existing checkpoint continues from its step, and because
+    batches are a pure function of (seed, step) the resumed run replays
+    exactly the sequence an uninterrupted run would have seen.  Sampling
+    and schedule parameters (seed, batch size, kappa, t0) are adopted from
+    the checkpoint when not passed explicitly; an explicit contradiction —
+    including a different ``reg_covar`` or ``covariance_type`` — is
+    refused rather than silently diverging.
     """
     if covariance_type not in ("diag", "spherical"):
         raise ValueError(
             f"covariance_type must be 'diag' or 'spherical', "
             f"got {covariance_type!r}"
         )
-    if not 0.5 < kappa <= 1.0:
-        raise ValueError(f"kappa must be in (0.5, 1], got {kappa}")
-    if not t0 >= 1.0:
-        raise ValueError(f"t0 must be >= 1, got {t0}")
     if not reg_covar >= 0.0:
         raise ValueError(f"reg_covar must be >= 0, got {reg_covar}")
     cfg, key = resolve_fit_config(k, key, config)
@@ -152,26 +162,112 @@ def fit_gmm_stream(
     n_steps = steps if steps is not None else cfg.steps
     host_seed = seed if seed is not None else cfg.seed
 
-    # Seed parameters on a host subsample (the shared streamed-family
-    # recipe): means from the configured init method, variances from the
-    # subsample's per-feature variance, uniform mixing weights.  An
-    # explicit init array is shape-validated inside the helper before any
-    # disk I/O happens.
-    c0, xs_host = host_subsample_seed(
-        data, k, key, cfg, init, host_seed=host_seed, return_sample=True
-    )
-    tiles, tile_w, _ = chunk_tiles(xs_host, None, cfg.chunk_size)
-    params = init_gmm_params(
-        c0, tiles, tile_w, covariance_type=covariance_type,
-        reg_covar=jnp.asarray(reg_covar, jnp.float32),
-    )
-    stats = (jnp.zeros((k,), jnp.float32),
-             jnp.zeros((k, d), jnp.float32),
-             jnp.zeros((k, d), jnp.float32))
+    start_step = 0
+    params = None
+    if resume:
+        if not checkpoint_path:
+            raise ValueError("resume=True requires checkpoint_path")
+        from kmeans_tpu.utils.checkpoint import (
+            latest_step,
+            load_array_checkpoint,
+            resolve_resume_params,
+        )
+
+        if latest_step(checkpoint_path) is not None:
+            if init is not None and not isinstance(init, str):
+                raise ValueError(
+                    "resume found an existing checkpoint; an explicit init "
+                    "array contradicts it — drop init or the checkpoint"
+                )
+            arrays, meta = load_array_checkpoint(checkpoint_path)
+            ck = (meta or {}).get("extra", {})
+            if ck.get("stream") != "gmm":
+                raise ValueError(
+                    f"checkpoint at {checkpoint_path!r} is not a streamed-"
+                    f"GMM checkpoint (stream tag {ck.get('stream')!r}) — "
+                    "resume it with the family that wrote it"
+                )
+            if arrays["means"].shape != (k, d):
+                raise ValueError(
+                    f"checkpoint means {arrays['means'].shape} != {(k, d)}"
+                )
+            # Exact-replay guarantee: refuse explicit contradictions, adopt
+            # the checkpoint's sampling/schedule params otherwise (shared
+            # rule: utils.checkpoint.resolve_resume_params).
+            r = resolve_resume_params(ck, [
+                ("seed", "host_seed", seed, host_seed),
+                ("batch_size", "batch_size", batch_size, bs),
+                ("kappa", "kappa", kappa, 0.7),
+                ("t0", "t0", t0, 1.0),
+            ])
+            host_seed, bs = r["seed"], r["batch_size"]
+            kappa, t0 = r["kappa"], r["t0"]
+            for name, current in (("covariance_type", covariance_type),
+                                  ("reg_covar", reg_covar)):
+                if name in ck and ck[name] != current:
+                    raise ValueError(
+                        f"resume {name}={current!r} contradicts the "
+                        f"checkpoint's {name}={ck[name]!r}"
+                    )
+            params = GMMParams(arrays["means"], arrays["variances"],
+                               arrays["log_pi"])
+            stats = (arrays["stat_n"], arrays["stat_s"], arrays["stat_q"])
+            start_step = int(meta["step"])
+            if start_step > n_steps:
+                raise ValueError(
+                    f"checkpoint is at step {start_step} > requested "
+                    f"steps={n_steps}; raise steps to continue this stream"
+                )
+
+    kappa = 0.7 if kappa is None else float(kappa)
+    t0 = 1.0 if t0 is None else float(t0)
+    if not 0.5 < kappa <= 1.0:
+        raise ValueError(f"kappa must be in (0.5, 1], got {kappa}")
+    if not t0 >= 1.0:
+        raise ValueError(f"t0 must be >= 1, got {t0}")
+
+    if params is None:
+        # Seed parameters on a host subsample (the shared streamed-family
+        # recipe): means from the configured init method, variances from
+        # the subsample's per-feature variance, uniform mixing weights.
+        # An explicit init array is shape-validated inside the helper
+        # before any disk I/O happens.
+        c0, xs_host = host_subsample_seed(
+            data, k, key, cfg, init, host_seed=host_seed, return_sample=True
+        )
+        tiles, tile_w, _ = chunk_tiles(xs_host, None, cfg.chunk_size)
+        params = init_gmm_params(
+            c0, tiles, tile_w, covariance_type=covariance_type,
+            reg_covar=jnp.asarray(reg_covar, jnp.float32),
+        )
+        stats = (jnp.zeros((k,), jnp.float32),
+                 jnp.zeros((k, d), jnp.float32),
+                 jnp.zeros((k, d), jnp.float32))
+
+    from kmeans_tpu.utils.checkpoint import PeriodicSaver
+
+    saver = PeriodicSaver(checkpoint_path, checkpoint_every)
+
+    def save(params, stats, step):
+        from kmeans_tpu.utils.checkpoint import save_array_checkpoint
+
+        save_array_checkpoint(
+            checkpoint_path,
+            {"means": params.means, "variances": params.variances,
+             "log_pi": params.log_pi, "stat_n": stats[0],
+             "stat_s": stats[1], "stat_q": stats[2]},
+            step=step, config=cfg,
+            extra={"stream": "gmm", "host_seed": int(host_seed),
+                   "batch_size": int(bs), "kappa": float(kappa),
+                   "t0": float(t0), "covariance_type": covariance_type,
+                   "reg_covar": float(reg_covar),
+                   "total_steps": int(n_steps)},
+        )
 
     reg = jnp.asarray(reg_covar, jnp.float32)
-    batches = sample_batches(data, bs, n_steps, seed=host_seed)
-    step = 0
+    batches = sample_batches(data, bs, n_steps, seed=host_seed,
+                             start_step=start_step)
+    step = start_step
     for xb in prefetch_to_device(batches, depth=prefetch_depth,
                                  background=background_prefetch):
         rho = jnp.asarray((step + t0) ** (-kappa), jnp.float32)
@@ -181,6 +277,8 @@ def fit_gmm_stream(
             compute_dtype=cfg.compute_dtype,
         )
         step += 1
+        saver.maybe(step, lambda p=params, s=stats, t=step: save(p, s, t))
+    saver.maybe(step, lambda: save(params, stats, step), force=True)
 
     if final_pass:
         labels_np, ll, soft = gmm_assign_stream(

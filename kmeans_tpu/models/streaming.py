@@ -155,7 +155,11 @@ def fit_minibatch_stream(
     if resume:
         if not checkpoint_path:
             raise ValueError("resume=True requires checkpoint_path")
-        from kmeans_tpu.utils.checkpoint import latest_step, load_checkpoint
+        from kmeans_tpu.utils.checkpoint import (
+            latest_step,
+            load_array_checkpoint,
+            resolve_resume_params,
+        )
 
         # latest_step resolves the <path>.old kept during a crashed save
         # swap — exactly the case the atomic checkpoints exist for.
@@ -166,32 +170,31 @@ def fit_minibatch_stream(
                     "centroid array contradicts it — drop init or the "
                     "checkpoint"
                 )
-            st, meta = load_checkpoint(checkpoint_path)
-            c0 = jnp.asarray(st.centroids, jnp.float32)
+            # Array-level load: the family tag must be checked BEFORE any
+            # state-shape assumptions touch the arrays.
+            arrays, meta = load_array_checkpoint(checkpoint_path)
+            ck = (meta or {}).get("extra", {})
+            if ck.get("stream") == "gmm":
+                raise ValueError(
+                    f"checkpoint at {checkpoint_path!r} is a streamed-GMM "
+                    "checkpoint — resume it with fit_gmm_stream"
+                )
+            c0 = jnp.asarray(arrays["centroids"], jnp.float32)
             if c0.shape != (k, d):
                 raise ValueError(
                     f"checkpoint centroids {c0.shape} != {(k, d)}"
                 )
-            n_seen = jnp.asarray(st.counts, jnp.float32)
-            start_step = int(st.n_iter)
+            n_seen = jnp.asarray(arrays["counts"], jnp.float32)
+            start_step = int(arrays["n_iter"])
             # The exact-replay guarantee needs the original sampling params:
-            # adopt them when the caller didn't pass explicit values, and
-            # refuse an explicit mismatch rather than silently diverging.
-            ck = (meta or {}).get("extra", {})
-            for name, ck_key, explicit, current in (
+            # adopt them when the caller didn't pass explicit values, refuse
+            # an explicit mismatch (shared rule:
+            # utils.checkpoint.resolve_resume_params).
+            r = resolve_resume_params(ck, [
                 ("seed", "host_seed", seed, host_seed),
                 ("batch_size", "batch_size", batch_size, bs),
-            ):
-                if ck_key not in ck:
-                    continue
-                if explicit is not None and int(ck[ck_key]) != int(current):
-                    raise ValueError(
-                        f"resume {name}={current} contradicts the "
-                        f"checkpoint's {name}={ck[ck_key]}; drop the "
-                        f"argument or restart without resume"
-                    )
-            host_seed = int(ck.get("host_seed", host_seed))
-            bs = int(ck.get("batch_size", bs))
+            ])
+            host_seed, bs = r["seed"], r["batch_size"]
             # Transfer width changes the values the update sums (bf16
             # rounding), so a mismatched resume silently forks the
             # trajectory — refuse it outright ("auto" resolves before
@@ -215,15 +218,11 @@ def fit_minibatch_stream(
         c0 = host_subsample_seed(data, k, key, cfg, init,
                                  host_seed=host_seed)
 
-    last_saved = [-1]
+    from kmeans_tpu.utils.checkpoint import PeriodicSaver
 
-    def maybe_checkpoint(c, n_seen, step, force=False):
-        if not checkpoint_path or step == last_saved[0]:
-            return
-        if not force and (checkpoint_every < 1
-                          or step % checkpoint_every != 0):
-            return
-        last_saved[0] = step
+    saver = PeriodicSaver(checkpoint_path, checkpoint_every)
+
+    def checkpoint_now(c, n_seen, step):
         from kmeans_tpu.utils.checkpoint import save_checkpoint
 
         save_checkpoint(
@@ -251,8 +250,9 @@ def fit_minibatch_stream(
         c, n_seen = _stream_step(c, n_seen, xb,
                                  compute_dtype=cfg.compute_dtype)
         step += 1
-        maybe_checkpoint(c, n_seen, step)
-    maybe_checkpoint(c, n_seen, step, force=True)
+        saver.maybe(step, lambda c=c, ns=n_seen, t=step:
+                    checkpoint_now(c, ns, t))
+    saver.maybe(step, lambda: checkpoint_now(c, n_seen, step), force=True)
 
     if final_pass:
         labels_np, inertia = assign_stream(

@@ -22,7 +22,55 @@ import numpy as np
 
 from kmeans_tpu.config import KMeansConfig
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_step"]
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
+           "save_array_checkpoint", "load_array_checkpoint",
+           "resolve_resume_params", "PeriodicSaver"]
+
+
+def resolve_resume_params(ck: dict, specs) -> dict:
+    """Shared resume-parameter reconciliation for the streamed fits.
+
+    ``specs`` is a list of ``(name, ck_key, explicit, default)``: an
+    explicitly-passed value (``explicit is not None``) that contradicts the
+    checkpoint raises; otherwise the checkpoint's value is adopted (or the
+    default when the checkpoint predates the key).  Returns
+    ``{name: resolved_value}`` with each value cast to the default's type.
+    THE one copy of the refuse-or-adopt rule, so the streamed families
+    can't drift in their replay guarantees.
+    """
+    resolved = {}
+    for name, ck_key, explicit, default in specs:
+        current = explicit if explicit is not None else default
+        if ck_key in ck:
+            if explicit is not None and float(ck[ck_key]) != float(explicit):
+                raise ValueError(
+                    f"resume {name}={explicit} contradicts the "
+                    f"checkpoint's {name}={ck[ck_key]}; drop the argument "
+                    "or restart without resume"
+                )
+            resolved[name] = type(default)(ck[ck_key])
+        else:
+            resolved[name] = current
+    return resolved
+
+
+class PeriodicSaver:
+    """Cadence + dedup for periodic checkpoint saves: fires every
+    ``every`` steps (and on ``force=True``), never twice for one step.
+    Shared by the streamed fits."""
+
+    def __init__(self, path: Optional[str], every: int):
+        self.path = path
+        self.every = every
+        self._last = -1
+
+    def maybe(self, step: int, save, *, force: bool = False) -> None:
+        if not self.path or step == self._last:
+            return
+        if not force and (self.every < 1 or step % self.every != 0):
+            return
+        self._last = step
+        save()
 
 _META = "meta.json"
 
@@ -47,7 +95,27 @@ def save_checkpoint(
     key=None,
     extra: Optional[dict] = None,
 ) -> str:
-    """Write a resumable checkpoint; returns ``path``.
+    """Write a resumable KMeansState checkpoint; returns ``path``.
+
+    Thin wrapper over :func:`save_array_checkpoint` with the KMeansState
+    field layout (format on disk is identical).
+    """
+    return save_array_checkpoint(
+        path, _state_arrays(state), step=step, config=config, key=key,
+        extra=extra,
+    )
+
+
+def save_array_checkpoint(
+    path: str,
+    arrays: dict,
+    *,
+    step: int = 0,
+    config: Optional[KMeansConfig] = None,
+    key=None,
+    extra: Optional[dict] = None,
+) -> str:
+    """Write a resumable checkpoint of an arbitrary flat array dict.
 
     Atomic against crashes: everything is written into ``<path>.tmp`` first,
     then swapped into place, so ``<path>`` always holds a complete,
@@ -59,7 +127,7 @@ def save_checkpoint(
 
     shutil.rmtree(path, ignore_errors=True)
     os.makedirs(path, exist_ok=True)
-    arrays = _state_arrays(state)
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
     # Orbax refuses zero-size arrays (e.g. the runner's empty labels in
     # periodic checkpoints) — record their shapes/dtypes in the metadata and
     # rebuild them at load instead.
@@ -122,12 +190,10 @@ def _resolve_dir(path: str) -> str:
     return path
 
 
-def load_checkpoint(path: str) -> Tuple[Any, dict]:
-    """Returns ``(KMeansState, meta)``; ``meta['key']`` is a rebuilt PRNG key
-    when one was saved.  Falls back to ``<path>.old`` when a crash during a
-    save swap left no directory at ``<path>``."""
-    from kmeans_tpu.models.lloyd import KMeansState
-
+def load_array_checkpoint(path: str) -> Tuple[dict, dict]:
+    """Returns ``(arrays, meta)`` — arrays as jnp arrays; ``meta['key']``
+    is a rebuilt PRNG key when one was saved.  Falls back to ``<path>.old``
+    when a crash during a save swap left no directory at ``<path>``."""
     path = _resolve_dir(path)
     with open(os.path.join(path, _META), "r", encoding="utf-8") as f:
         meta = json.load(f)
@@ -145,14 +211,7 @@ def load_checkpoint(path: str) -> Tuple[Any, dict]:
 
     import jax.numpy as jnp
 
-    state = KMeansState(
-        jnp.asarray(arrays["centroids"]),
-        jnp.asarray(arrays["labels"]),
-        jnp.asarray(arrays["inertia"]),
-        jnp.asarray(arrays["n_iter"]),
-        jnp.asarray(arrays["converged"]),
-        jnp.asarray(arrays["counts"]),
-    )
+    arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
     if meta.get("key_data") is not None:
         import jax
 
@@ -161,6 +220,23 @@ def load_checkpoint(path: str) -> Tuple[Any, dict]:
         )
     if meta.get("config"):
         meta["config_obj"] = KMeansConfig(**meta["config"])
+    return arrays, meta
+
+
+def load_checkpoint(path: str) -> Tuple[Any, dict]:
+    """Returns ``(KMeansState, meta)`` — the KMeansState view of
+    :func:`load_array_checkpoint`."""
+    from kmeans_tpu.models.lloyd import KMeansState
+
+    arrays, meta = load_array_checkpoint(path)
+    state = KMeansState(
+        arrays["centroids"],
+        arrays["labels"],
+        arrays["inertia"],
+        arrays["n_iter"],
+        arrays["converged"],
+        arrays["counts"],
+    )
     return state, meta
 
 

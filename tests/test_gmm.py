@@ -261,3 +261,79 @@ def test_gmm_stream_validation():
         fit_gmm_stream(x, 2, covariance_type="full", steps=1)
     with pytest.raises(ValueError, match="shape"):
         fit_gmm_stream(x, 2, init=jnp.zeros((3, 3)), steps=1)
+
+
+def test_gmm_stream_checkpoint_resume_replays_exactly(tmp_path):
+    """Preempted + resumed stream == uninterrupted stream, bit-for-bit on
+    the parameters (batches are a pure function of (seed, step))."""
+    from kmeans_tpu.models import fit_gmm_stream
+
+    rng = np.random.default_rng(4)
+    x = np.concatenate([rng.normal(size=(400, 5)) + 6,
+                        rng.normal(size=(400, 5))]).astype(np.float32)
+    ckpt = str(tmp_path / "ck")
+
+    straight = fit_gmm_stream(x, 2, batch_size=128, steps=40, seed=9)
+    fit_gmm_stream(x, 2, batch_size=128, steps=20, seed=9,
+                   checkpoint_path=ckpt, checkpoint_every=10,
+                   final_pass=False)
+    resumed = fit_gmm_stream(x, 2, batch_size=128, steps=40, seed=9,
+                             checkpoint_path=ckpt, resume=True)
+    np.testing.assert_array_equal(np.asarray(straight.means),
+                                  np.asarray(resumed.means))
+    np.testing.assert_array_equal(np.asarray(straight.covariances),
+                                  np.asarray(resumed.covariances))
+    np.testing.assert_array_equal(np.asarray(straight.labels),
+                                  np.asarray(resumed.labels))
+    assert int(resumed.n_iter) == 40
+
+
+def test_gmm_stream_resume_refuses_contradictions(tmp_path):
+    from kmeans_tpu.models import fit_gmm_stream
+
+    x = np.random.default_rng(0).normal(size=(300, 4)).astype(np.float32)
+    ckpt = str(tmp_path / "ck")
+    fit_gmm_stream(x, 2, batch_size=64, steps=10, seed=3, kappa=0.8,
+                   checkpoint_path=ckpt, checkpoint_every=5,
+                   final_pass=False)
+    with pytest.raises(ValueError, match="seed"):
+        fit_gmm_stream(x, 2, batch_size=64, steps=20, seed=4,
+                       checkpoint_path=ckpt, resume=True)
+    with pytest.raises(ValueError, match="kappa"):
+        fit_gmm_stream(x, 2, batch_size=64, steps=20, seed=3, kappa=0.6,
+                       checkpoint_path=ckpt, resume=True)
+    with pytest.raises(ValueError, match="covariance_type"):
+        fit_gmm_stream(x, 2, batch_size=64, steps=20, seed=3, kappa=0.8,
+                       covariance_type="spherical",
+                       checkpoint_path=ckpt, resume=True)
+    with pytest.raises(ValueError, match="requires checkpoint_path"):
+        fit_gmm_stream(x, 2, steps=5, resume=True)
+
+
+def test_gmm_stream_resume_adopts_schedule_and_refuses_cross_family(
+        tmp_path):
+    from kmeans_tpu.models import fit_gmm_stream, fit_minibatch_stream
+
+    x = np.random.default_rng(2).normal(size=(300, 4)).astype(np.float32)
+    ckpt = str(tmp_path / "ck")
+    straight = fit_gmm_stream(x, 2, batch_size=64, steps=20, seed=6,
+                              kappa=0.8, final_pass=False)
+    fit_gmm_stream(x, 2, batch_size=64, steps=10, seed=6, kappa=0.8,
+                   checkpoint_path=ckpt, checkpoint_every=5,
+                   final_pass=False)
+    # kappa NOT re-passed: adopted from the checkpoint, replay exact
+    resumed = fit_gmm_stream(x, 2, batch_size=64, steps=20, seed=6,
+                             checkpoint_path=ckpt, resume=True,
+                             final_pass=False)
+    np.testing.assert_array_equal(np.asarray(straight.means),
+                                  np.asarray(resumed.means))
+    # cross-family resume is refused with a clear error both ways
+    with pytest.raises(ValueError, match="streamed-GMM"):
+        fit_minibatch_stream(x, 2, steps=20, checkpoint_path=ckpt,
+                             resume=True)
+    km_ckpt = str(tmp_path / "km")
+    fit_minibatch_stream(x, 2, batch_size=64, steps=10, seed=6,
+                         checkpoint_path=km_ckpt, checkpoint_every=5,
+                         final_pass=False)
+    with pytest.raises(ValueError, match="not a streamed-GMM"):
+        fit_gmm_stream(x, 2, steps=20, checkpoint_path=km_ckpt, resume=True)

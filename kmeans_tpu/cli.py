@@ -8,6 +8,7 @@ browser front-end (ours, or the untouched reference app) can Import.
 from __future__ import annotations
 
 import argparse
+import os
 import json
 import sys
 import time
@@ -29,6 +30,7 @@ def _cmd_train(args) -> int:
     else:
         n, d, k = args.n, args.d, args.k
         cfg_minibatch = False
+    seed_v = args.seed if args.seed is not None else 0
     # Precedence: explicit --model > explicit --minibatch/--no-minibatch >
     # the named config's minibatch default.  Contradictory explicit flags
     # are an error, not a silent override.
@@ -85,7 +87,7 @@ def _cmd_train(args) -> int:
         n, d = x.shape
     else:
         x, _, _ = make_blobs(
-            jax.random.key(args.seed), n, d, k, cluster_std=args.cluster_std
+            jax.random.key(seed_v), n, d, k, cluster_std=args.cluster_std
         )
 
     # --max-iter governs the Lloyd-family loop; the minibatch/stream path is
@@ -118,7 +120,7 @@ def _cmd_train(args) -> int:
     kcfg = KMeansConfig(
         k=k, init=args.init,
         max_iter=args.max_iter if args.max_iter is not None else 100,
-        tol=args.tol, seed=args.seed, compute_dtype=args.dtype, **cfg_kw,
+        tol=args.tol, seed=seed_v, compute_dtype=args.dtype, **cfg_kw,
     )
 
     mesh = None
@@ -127,9 +129,18 @@ def _cmd_train(args) -> int:
 
         mesh = make_mesh((args.mesh, 1), ("data", "model"))
 
-    want_runner = bool(
+    # --checkpoint/--resume ride the step-wise Lloyd runner OR the streamed
+    # fits (both checkpoint natively); --progress/--profile are
+    # runner-only.
+    stream_ckpt = args.stream and (args.checkpoint or args.resume)
+    want_runner = not args.stream and bool(
         args.progress or args.checkpoint or args.resume or args.profile
     )
+    if args.stream and (args.progress or args.profile):
+        print("error: --progress/--profile require the full-batch Lloyd "
+              "runner; the streamed paths support --checkpoint/--resume",
+              file=sys.stderr)
+        return 2
     if want_runner and model != "lloyd":
         print(
             "error: --progress/--checkpoint/--resume/--profile require the "
@@ -137,6 +148,14 @@ def _cmd_train(args) -> int:
             f"with --model {model}); use --model lloyd or drop those flags",
             file=sys.stderr,
         )
+        return 2
+    if args.stream and args.resume and args.checkpoint \
+            and os.path.realpath(args.resume) != \
+            os.path.realpath(args.checkpoint):
+        # The streamed fits use ONE directory for both resume and saves.
+        print("error: a streamed --resume continues from (and keeps "
+              "saving into) one directory; --checkpoint must match "
+              "--resume or be dropped", file=sys.stderr)
         return 2
     mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kernel",
                "kmedoids")
@@ -174,7 +193,7 @@ def _cmd_train(args) -> int:
         from kmeans_tpu.data import lightweight_coreset
 
         x, fit_weights = lightweight_coreset(
-            jax.random.key(args.seed + 1), x, args.coreset,
+            jax.random.key(seed_v + 1), x, args.coreset,
             chunk_size=kcfg.chunk_size, compute_dtype=kcfg.compute_dtype,
         )
     if want_runner and not minibatch:
@@ -217,10 +236,26 @@ def _cmd_train(args) -> int:
         }[model]
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
     elif args.stream:
-        if model == "gmm":
-            state = models.fit_gmm_stream(x, k, config=kcfg)
-        else:
-            state = models.fit_minibatch_stream(x, k, config=kcfg)
+        ckpt_kw = {}
+        if stream_ckpt:
+            ckpt_kw = {"checkpoint_path": args.resume or args.checkpoint,
+                       "checkpoint_every": args.checkpoint_every,
+                       "resume": bool(args.resume)}
+        # Explicit flags pass through as explicit arguments (None when the
+        # user typed nothing), so the library's refuse-explicit-
+        # contradiction resume guarantee actually fires for CLI flags.
+        stream_kw = dict(steps=args.steps, batch_size=args.batch_size,
+                         seed=args.seed, **ckpt_kw)
+        fit_stream = (models.fit_gmm_stream if model == "gmm"
+                      else models.fit_minibatch_stream)
+        try:
+            state = fit_stream(x, k, config=kcfg, **stream_kw)
+        except ValueError as e:
+            # Predictable user errors (cross-family resume, contradicted
+            # sampling params, step mismatch) report like every other CLI
+            # validation failure instead of a traceback.
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     else:
         fit = {
             "lloyd": models.fit_lloyd,
@@ -376,7 +411,9 @@ def main(argv=None) -> int:
     t.add_argument("--batch-size", type=int, default=None,
                    help="minibatch/stream batch size (default 8192)")
     t.add_argument("--tol", type=float, default=1e-4)
-    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--seed", type=int, default=None,
+                   help="RNG seed (default 0; leaving it unset lets a "
+                        "streamed --resume adopt the checkpoint's seed)")
     t.add_argument("--dtype", default=None,
                    choices=[None, "bfloat16", "float32"])
     t.add_argument("--cluster-std", type=float, default=0.6)
@@ -384,9 +421,11 @@ def main(argv=None) -> int:
     t.add_argument("--max-cards", type=int, default=500)
     t.add_argument("--progress", action="store_true",
                    help="print one JSON line per Lloyd iteration to stderr")
-    t.add_argument("--checkpoint", help="checkpoint directory (periodic saves)")
+    t.add_argument("--checkpoint", help="checkpoint directory (periodic "
+                   "saves; Lloyd runner or --stream paths)")
     t.add_argument("--checkpoint-every", type=int, default=10)
-    t.add_argument("--resume", help="resume from this checkpoint directory")
+    t.add_argument("--resume", help="resume from this checkpoint directory "
+                   "(a streamed resume keeps saving into the same dir)")
     t.add_argument("--profile", help="write a jax.profiler trace to this dir")
     t.set_defaults(fn=_cmd_train)
 

@@ -111,8 +111,8 @@ def fit_gmm_stream(
     data,
     k: int,
     *,
-    covariance_type: str = "diag",
-    reg_covar: float = 1e-6,
+    covariance_type: Optional[str] = None,
+    reg_covar: Optional[float] = None,
     key: Optional[jax.Array] = None,
     config: Optional[KMeansConfig] = None,
     init: Union[str, jax.Array, None] = None,
@@ -149,12 +149,12 @@ def fit_gmm_stream(
     including a different ``reg_covar`` or ``covariance_type`` — is
     refused rather than silently diverging.
     """
-    if covariance_type not in ("diag", "spherical"):
+    if covariance_type not in (None, "diag", "spherical"):
         raise ValueError(
             f"covariance_type must be 'diag' or 'spherical', "
             f"got {covariance_type!r}"
         )
-    if not reg_covar >= 0.0:
+    if reg_covar is not None and not reg_covar >= 0.0:
         raise ValueError(f"reg_covar must be >= 0, got {reg_covar}")
     cfg, key = resolve_fit_config(k, key, config)
     n, d = data.shape
@@ -202,13 +202,19 @@ def fit_gmm_stream(
             ])
             host_seed, bs = r["seed"], r["batch_size"]
             kappa, t0 = r["kappa"], r["t0"]
-            for name, current in (("covariance_type", covariance_type),
-                                  ("reg_covar", reg_covar)):
-                if name in ck and ck[name] != current:
-                    raise ValueError(
-                        f"resume {name}={current!r} contradicts the "
-                        f"checkpoint's {name}={ck[name]!r}"
-                    )
+            # Same None-sentinel rule for the model-shape params: adopt
+            # the checkpoint's value when not passed, refuse an explicit
+            # contradiction.
+            for name, explicit in (("covariance_type", covariance_type),
+                                   ("reg_covar", reg_covar)):
+                if name in ck:
+                    if explicit is not None and ck[name] != explicit:
+                        raise ValueError(
+                            f"resume {name}={explicit!r} contradicts the "
+                            f"checkpoint's {name}={ck[name]!r}"
+                        )
+            covariance_type = ck.get("covariance_type", covariance_type)
+            reg_covar = ck.get("reg_covar", reg_covar)
             params = GMMParams(arrays["means"], arrays["variances"],
                                arrays["log_pi"])
             stats = (arrays["stat_n"], arrays["stat_s"], arrays["stat_q"])
@@ -219,6 +225,8 @@ def fit_gmm_stream(
                     f"steps={n_steps}; raise steps to continue this stream"
                 )
 
+    covariance_type = covariance_type or "diag"
+    reg_covar = 1e-6 if reg_covar is None else float(reg_covar)
     kappa = 0.7 if kappa is None else float(kappa)
     t0 = 1.0 if t0 is None else float(t0)
     if not 0.5 < kappa <= 1.0:

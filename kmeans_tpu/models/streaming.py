@@ -174,10 +174,21 @@ def fit_minibatch_stream(
             # state-shape assumptions touch the arrays.
             arrays, meta = load_array_checkpoint(checkpoint_path)
             ck = (meta or {}).get("extra", {})
-            if ck.get("stream") == "gmm":
+            tag = ck.get("stream")
+            if tag == "gmm":
                 raise ValueError(
                     f"checkpoint at {checkpoint_path!r} is a streamed-GMM "
                     "checkpoint — resume it with fit_gmm_stream"
+                )
+            if not tag:
+                # Untagged = not written by a streamed fit (e.g. a
+                # LloydRunner checkpoint): its n_iter/counts mean
+                # different things, so resuming it here would silently
+                # produce a trajectory with no replay guarantee.
+                raise ValueError(
+                    f"checkpoint at {checkpoint_path!r} has no stream tag "
+                    "— it was not written by fit_minibatch_stream (runner "
+                    "checkpoints resume via LloydRunner.resume)"
                 )
             c0 = jnp.asarray(arrays["centroids"], jnp.float32)
             if c0.shape != (k, d):

@@ -196,3 +196,47 @@ def test_train_stream_gmm(cifar_like_npy, capsys):
         "--k", "4",
     ])
     assert rc == 2 and "supports --model" in err
+
+
+def test_train_stream_checkpoint_resume(cifar_like_npy, tmp_path, capsys):
+    ckpt = str(tmp_path / "ck")
+    rc, out, _ = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--k", "8",
+        "--steps", "10", "--batch-size", "128",
+        "--checkpoint", ckpt, "--checkpoint-every", "5",
+    ])
+    assert rc in (0, None)
+    rc, out, _ = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--k", "8",
+        "--steps", "20", "--batch-size", "128", "--resume", ckpt,
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["n_iter"] == 20
+    # streamed gmm checkpointing from argv too
+    gck = str(tmp_path / "gck")
+    rc, _, _ = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--model", "gmm",
+        "--k", "4", "--steps", "10", "--batch-size", "128",
+        "--checkpoint", gck, "--checkpoint-every", "5",
+    ])
+    assert rc in (0, None)
+    rc, out, _ = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--model", "gmm",
+        "--k", "4", "--steps", "20", "--batch-size", "128",
+        "--resume", gck,
+    ])
+    assert rc in (0, None)
+    assert json.loads(out.splitlines()[0])["n_iter"] == 20
+    # --progress still demands the runner
+    rc, _, err = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--k", "4",
+        "--steps", "5", "--progress",
+    ])
+    assert rc == 2 and "runner" in err
+    # mismatched --checkpoint/--resume dirs on a stream are ambiguous
+    rc, _, err = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--k", "8",
+        "--steps", "20", "--resume", ckpt, "--checkpoint", str(tmp_path / "x"),
+    ])
+    assert rc == 2 and "must match" in err

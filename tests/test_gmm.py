@@ -337,3 +337,50 @@ def test_gmm_stream_resume_adopts_schedule_and_refuses_cross_family(
                          final_pass=False)
     with pytest.raises(ValueError, match="not a streamed-GMM"):
         fit_gmm_stream(x, 2, steps=20, checkpoint_path=km_ckpt, resume=True)
+
+
+def test_gmm_stream_resume_adopts_covariance_type(tmp_path):
+    from kmeans_tpu.models import fit_gmm_stream
+
+    x = np.random.default_rng(1).normal(size=(300, 4)).astype(np.float32)
+    ckpt = str(tmp_path / "ck")
+    fit_gmm_stream(x, 2, batch_size=64, steps=10, seed=7,
+                   covariance_type="spherical", reg_covar=1e-3,
+                   checkpoint_path=ckpt, checkpoint_every=5,
+                   final_pass=False)
+    # minimal resume (no covariance_type/reg_covar passed): adopted
+    st = fit_gmm_stream(x, 2, batch_size=64, steps=20, seed=7,
+                        checkpoint_path=ckpt, resume=True)
+    cov = np.asarray(st.covariances)
+    np.testing.assert_allclose(cov, np.broadcast_to(cov[:, :1], cov.shape),
+                               rtol=1e-6)
+    # explicit contradiction still refused
+    with pytest.raises(ValueError, match="reg_covar"):
+        fit_gmm_stream(x, 2, batch_size=64, steps=20, seed=7,
+                       reg_covar=1e-6, checkpoint_path=ckpt, resume=True)
+
+
+def test_stream_resume_refuses_untagged_checkpoint(tmp_path):
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import fit_minibatch_stream
+    from kmeans_tpu.models.lloyd import KMeansState
+    from kmeans_tpu.utils.checkpoint import save_checkpoint
+
+    # a runner-style checkpoint: right shapes, no stream tag
+    ckpt = str(tmp_path / "runner_ck")
+    save_checkpoint(
+        ckpt,
+        KMeansState(
+            centroids=jnp.zeros((2, 4), jnp.float32),
+            labels=jnp.zeros((0,), jnp.int32),
+            inertia=jnp.zeros((), jnp.float32),
+            n_iter=jnp.asarray(3, jnp.int32),
+            converged=jnp.asarray(False),
+            counts=jnp.zeros((2,), jnp.float32),
+        ),
+        step=3, config=KMeansConfig(k=2),
+    )
+    x = np.zeros((100, 4), np.float32)
+    with pytest.raises(ValueError, match="no stream tag"):
+        fit_minibatch_stream(x, 2, steps=10, checkpoint_path=ckpt,
+                             resume=True)

@@ -238,6 +238,15 @@ def _cmd_train(args) -> int:
     elif args.stream:
         ckpt_kw = {}
         if stream_ckpt:
+            if args.resume:
+                # A mistyped --resume dir must not silently train from
+                # scratch (and overwrite it) with exit 0.
+                from kmeans_tpu.utils.checkpoint import latest_step
+
+                if latest_step(args.resume) is None:
+                    print(f"error: no checkpoint found at {args.resume!r} "
+                          "to resume from", file=sys.stderr)
+                    return 2
             ckpt_kw = {"checkpoint_path": args.resume or args.checkpoint,
                        "checkpoint_every": args.checkpoint_every,
                        "resume": bool(args.resume)}

@@ -199,22 +199,14 @@ def fit_gmm_stream(
                 ("batch_size", "batch_size", batch_size, bs),
                 ("kappa", "kappa", kappa, 0.7),
                 ("t0", "t0", t0, 1.0),
+                ("covariance_type", "covariance_type", covariance_type,
+                 "diag"),
+                ("reg_covar", "reg_covar", reg_covar, 1e-6),
             ])
             host_seed, bs = r["seed"], r["batch_size"]
             kappa, t0 = r["kappa"], r["t0"]
-            # Same None-sentinel rule for the model-shape params: adopt
-            # the checkpoint's value when not passed, refuse an explicit
-            # contradiction.
-            for name, explicit in (("covariance_type", covariance_type),
-                                   ("reg_covar", reg_covar)):
-                if name in ck:
-                    if explicit is not None and ck[name] != explicit:
-                        raise ValueError(
-                            f"resume {name}={explicit!r} contradicts the "
-                            f"checkpoint's {name}={ck[name]!r}"
-                        )
-            covariance_type = ck.get("covariance_type", covariance_type)
-            reg_covar = ck.get("reg_covar", reg_covar)
+            covariance_type = r["covariance_type"]
+            reg_covar = r["reg_covar"]
             params = GMMParams(arrays["means"], arrays["variances"],
                                arrays["log_pi"])
             stats = (arrays["stat_n"], arrays["stat_s"], arrays["stat_q"])

@@ -41,14 +41,15 @@ def resolve_resume_params(ck: dict, specs) -> dict:
     resolved = {}
     for name, ck_key, explicit, default in specs:
         current = explicit if explicit is not None else default
+        cast = type(default)          # str for names, int/float for numbers
         if ck_key in ck:
-            if explicit is not None and float(ck[ck_key]) != float(explicit):
+            if explicit is not None and cast(ck[ck_key]) != cast(explicit):
                 raise ValueError(
                     f"resume {name}={explicit} contradicts the "
                     f"checkpoint's {name}={ck[ck_key]}; drop the argument "
                     "or restart without resume"
                 )
-            resolved[name] = type(default)(ck[ck_key])
+            resolved[name] = cast(ck[ck_key])
         else:
             resolved[name] = current
     return resolved

@@ -60,8 +60,22 @@ def main():
         float(got.inertia), float(want.inertia), rtol=1e-5
     )
     assert int(got.n_iter) == int(want.n_iter)
+
+    # A round-2 soft family over the same cross-process mesh: the GMM's
+    # four-way soft-moment psum rides DCN exactly as Lloyd's psum does.
+    from kmeans_tpu.models import fit_gmm
+    from kmeans_tpu.parallel import fit_gmm_sharded
+
+    gm = fit_gmm_sharded(x, k, mesh=mesh, init=c0, tol=1e-8, max_iter=8)
+    gm_want = fit_gmm(x, k, init=c0, tol=1e-8, max_iter=8)
+    np.testing.assert_allclose(
+        float(gm.log_likelihood), float(gm_want.log_likelihood), rtol=1e-5
+    )
+    assert int(gm.n_iter) == int(gm_want.n_iter)
+
     print(f"DCN_OK pid={pid} procs={info['process_count']} "
-          f"devices={info['device_count']} inertia={float(got.inertia):.4f}",
+          f"devices={info['device_count']} inertia={float(got.inertia):.4f} "
+          f"gmm_ll={float(gm.log_likelihood):.4f}",
           flush=True)
 
 

@@ -240,3 +240,12 @@ def test_train_stream_checkpoint_resume(cifar_like_npy, tmp_path, capsys):
         "--steps", "20", "--resume", ckpt, "--checkpoint", str(tmp_path / "x"),
     ])
     assert rc == 2 and "must match" in err
+
+
+def test_train_stream_resume_missing_checkpoint_errors(cifar_like_npy,
+                                                       tmp_path, capsys):
+    rc, _, err = _run(capsys, [
+        "train", "--input", cifar_like_npy, "--stream", "--k", "4",
+        "--steps", "5", "--resume", str(tmp_path / "nope"),
+    ])
+    assert rc == 2 and "no checkpoint found" in err

@@ -36,6 +36,7 @@ __all__ = [
     "calinski_harabasz_score",
     "adjusted_rand_index",
     "normalized_mutual_info",
+    "homogeneity_completeness_v",
 ]
 
 
@@ -302,3 +303,39 @@ def normalized_mutual_info(labels_a, labels_b) -> jax.Array:
     ha, hb = ent(pa), ent(pb)
     denom = 0.5 * (ha + hb)
     return jnp.where(denom <= 0, 1.0, mi / denom)
+
+
+def homogeneity_completeness_v(labels_true, labels_pred):
+    """Entropy-based external metrics (Rosenberg & Hirschberg 2007).
+
+    homogeneity = 1 − H(true|pred)/H(true): each cluster holds members of
+    a single class.  completeness = 1 − H(pred|true)/H(pred): each class
+    lands in a single cluster.  v_measure is their harmonic mean.  A zero
+    entropy (single class / single cluster) scores 1 by convention, as in
+    sklearn.  Returns a dict ``{homogeneity, completeness, v_measure}`` of
+    scalars.
+    """
+    lt = jnp.asarray(labels_true, jnp.int32)
+    lp = jnp.asarray(labels_pred, jnp.int32)
+    ka = int(jnp.max(lt)) + 1
+    kb = int(jnp.max(lp)) + 1
+    c = _contingency(lt, lp, ka=ka, kb=kb)
+    n = jnp.sum(c)
+    p = c / n
+    pa = jnp.sum(p, axis=1)          # class marginals
+    pb = jnp.sum(p, axis=0)          # cluster marginals
+
+    def ent(q):
+        return -jnp.sum(jnp.where(q > 0, q * jnp.log(q), 0.0))
+
+    h_a, h_b = ent(pa), ent(pb)
+    # One MI sum (the NMI expression) derives both conditionals:
+    # H(A|B) = H(A) − MI  ⇒  homogeneity = MI / H(A); likewise for B.
+    outer = pa[:, None] * pb[None, :]
+    mi = jnp.sum(jnp.where(
+        p > 0, p * jnp.log(p / jnp.maximum(outer, 1e-300)), 0.0
+    ))
+    hom = jnp.where(h_a <= 0, 1.0, mi / h_a)
+    com = jnp.where(h_b <= 0, 1.0, mi / h_b)
+    v = jnp.where(hom + com <= 0, 0.0, 2.0 * hom * com / (hom + com))
+    return {"homogeneity": hom, "completeness": com, "v_measure": v}

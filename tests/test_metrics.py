@@ -129,3 +129,54 @@ def test_metrics_prefer_true_clustering(blobs, rng):
     assert float(M.calinski_harabasz_score(x, labels, c)) > float(
         M.calinski_harabasz_score(x, rand_labels, rand_c)
     )
+
+
+def _oracle_hcv(lt, lp):
+    """Entropy-based metrics in float64 NumPy."""
+    lt, lp = np.asarray(lt), np.asarray(lp)
+    n = len(lt)
+    ka, kb = lt.max() + 1, lp.max() + 1
+    c = np.zeros((ka, kb))
+    for a, b in zip(lt, lp):
+        c[a, b] += 1
+    p = c / n
+    pa, pb = p.sum(1), p.sum(0)
+    ent = lambda q: -sum(x * np.log(x) for x in q if x > 0)
+    h_ab = -sum(p[i, j] * np.log(p[i, j] / pb[j])
+                for i in range(ka) for j in range(kb) if p[i, j] > 0)
+    h_ba = -sum(p[i, j] * np.log(p[i, j] / pa[i])
+                for i in range(ka) for j in range(kb) if p[i, j] > 0)
+    hom = 1.0 if ent(pa) <= 0 else 1 - h_ab / ent(pa)
+    com = 1.0 if ent(pb) <= 0 else 1 - h_ba / ent(pb)
+    v = 0.0 if hom + com == 0 else 2 * hom * com / (hom + com)
+    return hom, com, v
+
+
+def test_homogeneity_completeness_v_matches_oracle(rng):
+    lt = rng.integers(0, 4, size=300).astype(np.int32)
+    lp = rng.integers(0, 3, size=300).astype(np.int32)
+    got = M.homogeneity_completeness_v(lt, lp)
+    hom, com, v = _oracle_hcv(lt, lp)
+    np.testing.assert_allclose(float(got["homogeneity"]), hom,
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(got["completeness"]), com,
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(got["v_measure"]), v,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_hcv_perfect_and_degenerate():
+    lt = np.array([0, 0, 1, 1, 2, 2], np.int32)
+    got = M.homogeneity_completeness_v(lt, lt)
+    assert float(got["homogeneity"]) == pytest.approx(1.0)
+    assert float(got["completeness"]) == pytest.approx(1.0)
+    assert float(got["v_measure"]) == pytest.approx(1.0)
+    # over-split clustering: homogeneous but not complete
+    lp = np.arange(6, dtype=np.int32)
+    got = M.homogeneity_completeness_v(lt, lp)
+    assert float(got["homogeneity"]) == pytest.approx(1.0)
+    assert float(got["completeness"]) < 0.7
+    # single predicted cluster: complete but not homogeneous
+    got = M.homogeneity_completeness_v(lt, np.zeros(6, np.int32))
+    assert float(got["completeness"]) == pytest.approx(1.0)
+    assert float(got["homogeneity"]) == pytest.approx(0.0)

@@ -328,6 +328,17 @@ def _cmd_sweep(args) -> int:
     from kmeans_tpu.data import make_blobs
     from kmeans_tpu.models import suggest_k, sweep_k
 
+    # Statically-knowable flag mismatches fail before the data is even
+    # loaded, let alone fitted.
+    if args.criterion in ("bic", "aic") and args.model != "gmm":
+        print(f"error: --criterion {args.criterion} requires --model gmm",
+              file=sys.stderr)
+        return 2
+    if args.criterion == "gap" and args.model != "lloyd":
+        print("error: --criterion gap runs Lloyd fits against uniform "
+              "reference data; it requires --model lloyd", file=sys.stderr)
+        return 2
+
     if args.input:
         x = np.load(args.input)
         if x.ndim != 2:
@@ -339,12 +350,24 @@ def _cmd_sweep(args) -> int:
             cluster_std=args.cluster_std,
         )
 
-    if args.criterion in ("bic", "aic") and args.model != "gmm":
-        # Statically knowable mismatch: fail before any fit burns compute.
-        print(f"error: --criterion {args.criterion} requires --model gmm",
-              file=sys.stderr)
-        return 2
     ks = list(range(args.k_min, args.k_max + 1, args.k_step))
+    if args.criterion == "gap":
+        from kmeans_tpu.models import gap_statistic, suggest_k_gap
+
+        try:
+            rows = gap_statistic(
+                np.asarray(x), ks, n_refs=args.gap_refs,
+                max_iter=args.max_iter, compute_dtype=args.dtype,
+                init=args.init, seed=args.seed,
+            )
+            suggestion = suggest_k_gap(rows)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        for row in rows:
+            print(json.dumps(row))
+        print(json.dumps({"suggested_k": suggestion}))
+        return 0
     try:
         rows = sweep_k(
             np.asarray(x), ks, model=args.model, max_iter=args.max_iter,
@@ -452,8 +475,11 @@ def main(argv=None) -> int:
         "fuzzy", "gmm", "kmedoids",
     ])
     w.add_argument("--criterion", default="silhouette",
-                   choices=["silhouette", "bic", "aic"],
-                   help="suggestion rule; bic/aic need --model gmm")
+                   choices=["silhouette", "bic", "aic", "gap"],
+                   help="suggestion rule; bic/aic need --model gmm, gap "
+                        "runs the Tibshirani gap statistic (--model lloyd)")
+    w.add_argument("--gap-refs", type=int, default=10,
+                   help="reference datasets per k for --criterion gap")
     w.add_argument("--init", default="k-means++",
                    choices=["k-means++", "k-means||", "random"])
     w.add_argument("--max-iter", type=int, default=100)

@@ -36,7 +36,12 @@ from kmeans_tpu.models.medoids import KMedoids, KMedoidsState, fit_kmedoids
 from kmeans_tpu.models.gmeans import GMeans, anderson_darling_normal, fit_gmeans
 from kmeans_tpu.models.xmeans import XMeans, bic_score, fit_xmeans
 from kmeans_tpu.models.runner import IterInfo, LloydRunner
-from kmeans_tpu.models.selection import suggest_k, sweep_k
+from kmeans_tpu.models.selection import (
+    gap_statistic,
+    suggest_k,
+    suggest_k_gap,
+    sweep_k,
+)
 from kmeans_tpu.models.streaming import assign_stream, fit_minibatch_stream
 from kmeans_tpu.models.spherical import (
     SphericalKMeans,
@@ -113,6 +118,8 @@ __all__ = [
     "SphericalKMeans",
     "fit_spherical",
     "normalize_rows",
+    "gap_statistic",
+    "suggest_k_gap",
     "state_centers",
     "state_objective",
     "suggest_k",

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from kmeans_tpu.config import KMeansConfig
 
-__all__ = ["sweep_k", "suggest_k"]
+__all__ = ["sweep_k", "suggest_k", "gap_statistic", "suggest_k_gap"]
 
 _FITTERS = {
     "lloyd": "fit_lloyd",
@@ -32,6 +32,24 @@ _FITTERS = {
     "gmm": "fit_gmm",
     "kmedoids": "fit_kmedoids",
 }
+
+
+
+def _check_k_range(ks, n):
+    """Validate the whole range up front: a bad k must fail before any fit
+    burns compute (shared by sweep_k and gap_statistic)."""
+    for k in ks:
+        if k < 1 or k > n:
+            raise ValueError(f"k={k} out of range for n={n}")
+
+
+def _sweep_config(k, *, init, max_iter, tol, seed, chunk_size,
+                  compute_dtype):
+    """One KMeansConfig recipe for every selection fit."""
+    return KMeansConfig(
+        k=int(k), init=init, max_iter=max_iter, tol=tol, seed=seed,
+        chunk_size=chunk_size, compute_dtype=compute_dtype,
+    )
 
 
 def sweep_k(
@@ -73,17 +91,12 @@ def sweep_k(
         key = jax.random.key(seed)
 
     x = jnp.asarray(x)
-    # Validate the whole range up front: a bad k must fail before any fit
-    # burns compute.
-    for k in ks:
-        if k < 1 or k > x.shape[0]:
-            raise ValueError(f"k={k} out of range for n={x.shape[0]}")
+    _check_k_range(ks, x.shape[0])
     rows: List[Dict] = []
     for i, k in enumerate(ks):
-        cfg = KMeansConfig(
-            k=int(k), init=init, max_iter=max_iter, tol=tol, seed=seed,
-            chunk_size=chunk_size, compute_dtype=compute_dtype,
-        )
+        cfg = _sweep_config(k, init=init, max_iter=max_iter, tol=tol,
+                            seed=seed, chunk_size=chunk_size,
+                            compute_dtype=compute_dtype)
         state = fit(x, int(k), key=jax.random.fold_in(key, i), config=cfg)
         row = {
             "k": int(k),
@@ -138,3 +151,89 @@ def suggest_k(rows: List[Dict], *, criterion: str = "silhouette") -> int:
             )
         return min(scored, key=lambda r: r[criterion])["k"]
     raise ValueError(f"unknown criterion {criterion!r}")
+
+
+def gap_statistic(
+    x: jax.Array,
+    ks: Sequence[int],
+    *,
+    n_refs: int = 10,
+    key: Optional[jax.Array] = None,
+    max_iter: int = 50,
+    tol: float = 1e-4,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+    init: str = "k-means++",
+    seed: int = 0,
+) -> List[Dict]:
+    """Gap statistic (Tibshirani, Walther & Hastie 2001) for choosing k.
+
+    For each k: Gap(k) = E*[log W_k] − log W_k, where W_k is the fit's
+    within-cluster dispersion (inertia) and the expectation is over
+    ``n_refs`` reference datasets drawn uniformly from x's bounding box —
+    the null of "no cluster structure".  Rows carry
+    ``{k, log_w, ref_log_w, gap, s}`` with s the standard error of the
+    reference draws (the √(1+1/B) correction included).  Pick with
+    :func:`suggest_k_gap`: the smallest k with Gap(k) ≥ Gap(k+1) − s_{k+1}.
+
+    Cost: (n_refs + 1) fits per k — the reference fits reuse one compiled
+    executable per k (same shapes).
+    """
+    import numpy as np
+
+    import kmeans_tpu.models as models
+
+    if n_refs < 1:
+        raise ValueError(f"n_refs must be >= 1, got {n_refs}")
+    if key is None:
+        key = jax.random.key(seed)
+    x = jnp.asarray(x)
+    n, d = x.shape
+    _check_k_range(ks, n)
+    lo = jnp.min(x, axis=0)
+    hi = jnp.max(x, axis=0)
+
+    def fit_log_w(data, k, fkey):
+        cfg = _sweep_config(k, init=init, max_iter=max_iter, tol=tol,
+                            seed=seed, chunk_size=chunk_size,
+                            compute_dtype=compute_dtype)
+        st = models.fit_lloyd(data, int(k), key=fkey, config=cfg)
+        return float(jnp.log(jnp.maximum(st.inertia, 1e-30)))
+
+    rows: List[Dict] = []
+    for i, k in enumerate(ks):
+        log_w = fit_log_w(x, k, jax.random.fold_in(key, i))
+        ref_log_ws = []
+        for b in range(n_refs):
+            rkey = jax.random.fold_in(key, 10_000 + i * n_refs + b)
+            ref = lo + (hi - lo) * jax.random.uniform(
+                rkey, (n, d), dtype=jnp.float32
+            )
+            ref_log_ws.append(
+                fit_log_w(ref.astype(x.dtype), k,
+                          jax.random.fold_in(rkey, 1))
+            )
+        ref_mean = float(np.mean(ref_log_ws))
+        sd = float(np.std(ref_log_ws))
+        rows.append({
+            "k": int(k),
+            "log_w": log_w,
+            "ref_log_w": ref_mean,
+            "gap": ref_mean - log_w,
+            "s": sd * float(np.sqrt(1.0 + 1.0 / n_refs)),
+        })
+    return rows
+
+
+def suggest_k_gap(rows: List[Dict]) -> int:
+    """Tibshirani's selection rule: the smallest k whose gap is within one
+    (corrected) standard error of the next k's gap —
+    Gap(k) ≥ Gap(k+1) − s_{k+1}.  Falls back to the max-gap k when no k
+    satisfies the rule (monotone-increasing gaps)."""
+    rows = sorted(rows, key=lambda r: r["k"])
+    if not rows:
+        raise ValueError("no rows")
+    for cur, nxt in zip(rows, rows[1:]):
+        if cur["gap"] >= nxt["gap"] - nxt["s"]:
+            return cur["k"]
+    return max(rows, key=lambda r: r["gap"])["k"]

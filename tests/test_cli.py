@@ -249,3 +249,19 @@ def test_train_stream_resume_missing_checkpoint_errors(cifar_like_npy,
         "--steps", "5", "--resume", str(tmp_path / "nope"),
     ])
     assert rc == 2 and "no checkpoint found" in err
+
+
+def test_sweep_gap_criterion(capsys):
+    rc, out, _ = _run(capsys, [
+        "sweep", "--n", "400", "--d", "3", "--true-k", "3",
+        "--k-min", "1", "--k-max", "4", "--criterion", "gap",
+        "--gap-refs", "4",
+    ])
+    assert rc in (0, None)
+    lines = [json.loads(l) for l in out.splitlines()]
+    assert lines[-1]["suggested_k"] == 3
+    assert all("gap" in r for r in lines[:-1])
+    rc, _, err = _run(capsys, [
+        "sweep", "--criterion", "gap", "--model", "gmm",
+    ])
+    assert rc == 2 and "requires --model lloyd" in err

@@ -7,7 +7,12 @@ import numpy as np
 import pytest
 
 from kmeans_tpu.data import make_blobs
-from kmeans_tpu.models import suggest_k, sweep_k
+from kmeans_tpu.models import (
+    gap_statistic,
+    suggest_k,
+    suggest_k_gap,
+    sweep_k,
+)
 
 
 def test_sweep_k_finds_true_k_on_blobs():
@@ -190,3 +195,27 @@ def test_sweep_fuzzy_and_bic_requires_gmm():
     assert all("silhouette" in r for r in rows)
     with pytest.raises(ValueError, match="model='gmm'"):
         suggest_k(rows, criterion="bic")
+
+
+def test_gap_statistic_recovers_k():
+    key = jax.random.key(11)
+    x, _, _ = make_blobs(key, 500, 3, 3, cluster_std=0.4)
+    rows = gap_statistic(np.asarray(x), [1, 2, 3, 4, 5], n_refs=5, seed=2)
+    assert [r["k"] for r in rows] == [1, 2, 3, 4, 5]
+    for r in rows:
+        assert np.isfinite(r["gap"]) and r["s"] >= 0
+    assert suggest_k_gap(rows) == 3
+    # on the null itself (uniform data) the rule picks small k
+    u = np.random.default_rng(0).uniform(size=(400, 3)).astype(np.float32)
+    urows = gap_statistic(u, [1, 2, 3, 4], n_refs=5, seed=1)
+    assert suggest_k_gap(urows) <= 2
+
+
+def test_gap_statistic_validation():
+    x = np.zeros((30, 2), np.float32)
+    with pytest.raises(ValueError, match="n_refs"):
+        gap_statistic(x, [2], n_refs=0)
+    with pytest.raises(ValueError, match="out of range"):
+        gap_statistic(x, [40])
+    with pytest.raises(ValueError, match="no rows"):
+        suggest_k_gap([])

@@ -29,6 +29,7 @@ from kmeans_tpu.models.kernel import (
     KernelKMeansState,
     fit_kernel_kmeans,
     kernel_assign,
+    nystrom_features,
 )
 from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
@@ -102,6 +103,7 @@ __all__ = [
     "KernelKMeansState",
     "fit_kernel_kmeans",
     "kernel_assign",
+    "nystrom_features",
     "fit_bisecting",
     "fit_fuzzy",
     "fuzzy_memberships",

@@ -42,6 +42,7 @@ from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
 
 __all__ = [
     "KernelKMeansState", "fit_kernel_kmeans", "kernel_assign", "KernelKMeans",
+    "nystrom_features",
 ]
 
 _KERNELS = ("linear", "rbf", "poly")
@@ -403,3 +404,85 @@ class KernelKMeans:
 
     def fit_predict(self, x, weights=None):
         return self.fit(x, weights=weights).labels_
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk_size", "compute_dtype", "kernel", "degree"),
+)
+def _nystrom_map(x, landmarks, transform, *, kernel, gamma, degree, coef0,
+                 chunk_size, compute_dtype):
+    # kernel_mass_scan IS the tiled kernel(x, L) @ M body — the "labels"
+    # matrix here is the (m, m) inverse square root instead of a one-hot.
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    n = x.shape[0]
+    m = landmarks.shape[0]
+    xs, _, _ = chunk_tiles(x, None, chunk_size)
+    z_tiles = kernel_mass_scan(
+        xs, sq_norms(xs), landmarks, sq_norms(landmarks), transform,
+        kernel=kernel, gamma=gamma, degree=degree, coef0=coef0, cd=cd,
+    )
+    return z_tiles.reshape(-1, m)[:n]
+
+
+def nystrom_features(
+    x: jax.Array,
+    m: int,
+    *,
+    kernel: str = "rbf",
+    gamma: Optional[float] = None,
+    degree: int = 3,
+    coef0: float = 1.0,
+    landmarks: Optional[jax.Array] = None,
+    key: Optional[jax.Array] = None,
+    reg: float = 1e-6,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+) -> jax.Array:
+    """(n, m) Nyström feature map: kernel k-means at O(n·m·d) scale.
+
+    Williams & Seeger 2001: with m landmark rows L, the map
+    ``z(x) = K(x, L) · K(L, L)^{−1/2}`` satisfies ``z(x)·z(y) ≈ K(x, y)``,
+    so *plain Euclidean k-means on z approximates kernel k-means* — and
+    the features feed the entire existing engine: ``fit_lloyd``,
+    ``fit_lloyd_sharded`` (DP/TP/FP meshes, Pallas kernels), minibatch,
+    streaming.  The exact O(n²) path (:func:`fit_kernel_kmeans`) remains
+    the reference; this is the scale-out.
+
+    ``landmarks`` defaults to m uniformly-sampled rows of x (pass an
+    (m, d) array to choose your own, e.g. k-means++ picks).  ``reg``
+    floors the eigenvalues of K(L, L) for the inverse square root.
+    """
+    gamma, degree, coef0 = resolve_kernel_params(
+        kernel, gamma, degree, coef0, x.shape[1]
+    )
+    if landmarks is None:
+        if m < 1 or m > x.shape[0]:
+            raise ValueError(f"m={m} out of range for n={x.shape[0]}")
+        if key is None:
+            key = jax.random.key(0)
+        idx = jax.random.choice(key, x.shape[0], shape=(m,), replace=False)
+        landmarks = x[idx]
+    else:
+        landmarks = jnp.asarray(landmarks)
+        if landmarks.ndim != 2 or landmarks.shape[1] != x.shape[1]:
+            raise ValueError(
+                f"landmarks must be (m, {x.shape[1]}), got "
+                f"{landmarks.shape}"
+            )
+        m = landmarks.shape[0]
+    f32 = jnp.float32
+    lf = landmarks.astype(f32)
+    l_sq = sq_norms(lf)
+    k_mm = kernel_tile(lf, lf.T, l_sq, l_sq, kernel=kernel, gamma=gamma,
+                       degree=degree, coef0=coef0, cd=f32)
+    # Symmetrize (tile math is exact-symmetric up to f32 rounding), then
+    # the inverse square root via eigh with floored eigenvalues.
+    k_mm = 0.5 * (k_mm + k_mm.T)
+    s, u = jnp.linalg.eigh(k_mm)
+    inv_sqrt = u * (1.0 / jnp.sqrt(jnp.maximum(s, reg)))[None, :]
+    transform = jnp.matmul(inv_sqrt, u.T)            # K_mm^{-1/2}, (m, m)
+    return _nystrom_map(
+        x, lf, transform, kernel=kernel, gamma=gamma, degree=degree,
+        coef0=coef0, chunk_size=chunk_size, compute_dtype=compute_dtype,
+    )

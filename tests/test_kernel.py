@@ -180,3 +180,71 @@ def test_objective_matches_returned_labels_when_max_iter_hit():
     assert not bool(s.converged)
     want = _partition_inertia(x, s.labels, 2)
     np.testing.assert_allclose(float(s.objective), want, rtol=1e-3)
+
+
+def test_nystrom_linear_full_rank_preserves_kmeans(rng):
+    """Linear kernel, landmarks spanning the data: z·zᵀ == x·xᵀ, so Lloyd
+    on z reproduces Lloyd on x exactly (labels)."""
+    from kmeans_tpu.models import fit_lloyd, nystrom_features
+
+    x = rng.normal(size=(200, 5)).astype(np.float32)
+    z = nystrom_features(jnp.asarray(x), 40, kernel="linear",
+                         key=jax.random.key(0), chunk_size=64)
+    assert z.shape == (200, 40)
+    # Gram matrices agree (full rank: 40 landmarks >> d=5)
+    g_z = np.asarray(z) @ np.asarray(z).T
+    g_x = x @ x.T
+    np.testing.assert_allclose(g_z, g_x, rtol=1e-2, atol=1e-2)
+    want = fit_lloyd(jnp.asarray(x), 3, init=jnp.asarray(x[:3]), tol=1e-8,
+                     max_iter=30)
+    # feature-space init = the mapped same rows
+    got = fit_lloyd(z, 3, init=z[:3], tol=1e-8, max_iter=30)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+
+
+def test_nystrom_rbf_rings_through_plain_lloyd():
+    """Rings become linearly separable in the Nyström RBF feature space:
+    plain Lloyd on z holds the ring partition the input space cannot."""
+    from kmeans_tpu.models import fit_lloyd, nystrom_features
+
+    x, true = _rings(150, r_outer=4.0)
+    z = nystrom_features(jnp.asarray(x), 80, kernel="rbf", gamma=1.0,
+                         key=jax.random.key(1), chunk_size=64)
+    # init at the mapped true-partition means
+    z_np = np.asarray(z)
+    c0 = np.stack([z_np[true == 0].mean(0), z_np[true == 1].mean(0)])
+    st = fit_lloyd(z, 2, init=jnp.asarray(c0), tol=1e-8, max_iter=50)
+    lab = np.asarray(st.labels)
+    agree = max(np.mean(lab == true), np.mean(lab == 1 - true))
+    assert agree > 0.99, agree
+
+
+def test_nystrom_rides_the_sharded_engine(cpu_devices):
+    from kmeans_tpu.models import nystrom_features
+    from kmeans_tpu.parallel import fit_lloyd_sharded, make_mesh
+
+    x, true = _rings(128, r_outer=4.0, seed=3)
+    z = np.asarray(nystrom_features(jnp.asarray(x), 64, kernel="rbf",
+                                    gamma=1.0, key=jax.random.key(2),
+                                    chunk_size=64))
+    c0 = np.stack([z[true == 0].mean(0), z[true == 1].mean(0)])
+    mesh = make_mesh((4, 1), ("data", "model"),
+                     devices=jax.devices("cpu")[:4])
+    st = fit_lloyd_sharded(z, 2, mesh=mesh, init=c0, tol=1e-8, max_iter=50)
+    lab = np.asarray(st.labels)
+    agree = max(np.mean(lab == true), np.mean(lab == 1 - true))
+    assert agree > 0.99, agree
+
+
+def test_nystrom_validation(rng):
+    from kmeans_tpu.models import nystrom_features
+
+    x = jnp.asarray(rng.normal(size=(30, 2)).astype(np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        nystrom_features(x, 0)
+    with pytest.raises(ValueError, match="landmarks"):
+        nystrom_features(x, 5, landmarks=jnp.zeros((5, 3)))
+    # explicit landmarks override m
+    z = nystrom_features(x, 999, landmarks=x[:7], kernel="rbf")
+    assert z.shape == (30, 7)

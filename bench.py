@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -272,6 +273,38 @@ def bench_wallclock_to_converge(n=1_280_000, d=2048, k=1000, *, tol=1e-4,
     return out
 
 
+def _arm_init_watchdog(metric: str, unit: str, timeout_s: float = 180.0):
+    """Bound the time a wedged accelerator runtime can stall the bench.
+
+    A dead TPU tunnel relay hangs ``jax.devices()`` at client init forever
+    (observed live twice this round) — no exception, no timeout.  The
+    watchdog disarms as soon as backend init returns; if it fires instead,
+    it prints one parseable JSON line recording the failure and exits, so
+    the driver always gets a bench artifact in bounded time.
+    """
+    import threading
+
+    disarm = threading.Event()
+
+    def fire():
+        if disarm.wait(timeout_s):
+            return
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": unit,
+            "vs_baseline": None,
+            "error": f"accelerator runtime wedged: jax backend init did "
+                     f"not return within {timeout_s:.0f}s (dead tunnel "
+                     "relay?); no measurement possible",
+        }), flush=True)
+        os._exit(0)
+
+    threading.Thread(target=fire, name="bench-init-watchdog",
+                     daemon=True).start()
+    return disarm
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--all", action="store_true", help="run all 5 configs")
@@ -287,10 +320,21 @@ def main():
                          "supported)")
     args = ap.parse_args()
 
+    # The failure line carries the metric name this invocation was asked
+    # to produce, so a parse-last-line driver records the artifact in the
+    # right series.
+    if args.converge:
+        watchdog = _arm_init_watchdog(
+            "wallclock_to_converge_s@N=1.28M,d=2048,k=1000", "s")
+    else:
+        watchdog = _arm_init_watchdog(
+            "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000",
+            "iter/s/chip")
     import jax
 
     dev = jax.devices()[0]
     n_chips = len(jax.devices())
+    watchdog.set()          # backend is alive — disarm
     print(f"platform={dev.platform} devices={n_chips}", file=sys.stderr)
 
     if args.all:

@@ -472,7 +472,7 @@ def main(argv=None) -> int:
     w.add_argument("--k-step", type=int, default=1)
     w.add_argument("--model", default="lloyd", choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "gmm", "kmedoids",
+        "fuzzy", "gmm", "kernel", "kmedoids",
     ])
     w.add_argument("--criterion", default="silhouette",
                    choices=["silhouette", "bic", "aic", "gap"],

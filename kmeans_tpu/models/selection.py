@@ -30,6 +30,7 @@ _FITTERS = {
     "bisecting": "fit_bisecting",
     "fuzzy": "fit_fuzzy",
     "gmm": "fit_gmm",
+    "kernel": "fit_kernel_kmeans",
     "kmedoids": "fit_kmedoids",
 }
 
@@ -71,7 +72,9 @@ def sweep_k(
     Rows carry ``{k, inertia, n_iter, converged, silhouette,
     davies_bouldin, calinski_harabasz}`` ("inertia" is each family's
     lower-is-better objective via
-    :func:`kmeans_tpu.models.state_objective`).  GMM rows additionally
+    :func:`kmeans_tpu.models.state_objective`; the two center-based
+    scores are absent for center-free families — ``model="kernel"``
+    rows carry silhouette only).  GMM rows additionally
     carry ``bic``/``aic`` (diag-covariance parameter count), enabling
     ``suggest_k(rows, criterion="bic")`` — the model-based complement to
     the silhouette pick.  Silhouette is the chunked/sampled
@@ -119,11 +122,15 @@ def sweep_k(
                 chunk_size=chunk_size,
             ))
             centers = models.state_centers(state)
-            db, ch = dispersion_scores(
-                x, state.labels, centers, chunk_size=chunk_size
-            )
-            row["davies_bouldin"] = float(db)
-            row["calinski_harabasz"] = float(ch)
+            if centers is not None:
+                # Kernel k-means has no input-space centers: silhouette
+                # (label-only) still scores it; DB/CH are center-based
+                # and are skipped.
+                db, ch = dispersion_scores(
+                    x, state.labels, centers, chunk_size=chunk_size
+                )
+                row["davies_bouldin"] = float(db)
+                row["calinski_harabasz"] = float(ch)
         rows.append(row)
     return rows
 

@@ -219,3 +219,14 @@ def test_gap_statistic_validation():
         gap_statistic(x, [40])
     with pytest.raises(ValueError, match="no rows"):
         suggest_k_gap([])
+
+
+def test_sweep_kernel_family_silhouette_only():
+    key = jax.random.key(13)
+    x, _, _ = make_blobs(key, 250, 4, 3, cluster_std=0.4)
+    rows = sweep_k(np.asarray(x), [2, 3, 4], model="kernel", seed=0,
+                   max_iter=20)
+    for r in rows:
+        assert "silhouette" in r
+        assert "davies_bouldin" not in r   # center-based, skipped
+    assert suggest_k(rows) == 3

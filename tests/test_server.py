@@ -526,3 +526,35 @@ def test_train_op_kernel_family_and_work_cap(server):
                               "model": "kernel"})
     assert b'"model": "kernel"' in buf, buf[:500]
     assert b"train_done" in buf
+
+
+def test_static_js_contract():
+    """The defect class the reference actually shipped (SURVEY.md §0: an
+    unbalanced peerconnect block that made app.mjs a SyntaxError): our
+    app.js must have balanced delimiters outside strings/comments, and
+    every $id() target must exist in the served index.html."""
+    import re
+    from pathlib import Path
+
+    static = Path(__file__).parent.parent / "kmeans_tpu" / "serve" / "static"
+    src = (static / "app.js").read_text()
+    html = (static / "index.html").read_text()
+
+    # One alternation pass: strings and comments are consumed in source
+    # order, so a "//" inside a string (a URL) can't corrupt the parse
+    # the way sequential stripping would.
+    tok = (r'"(?:[^"\\\n]|\\.)*"'
+           r"|'(?:[^'\\\n]|\\.)*'"
+           r'|`(?:[^`\\]|\\.)*`'
+           r'|//[^\n]*'
+           r'|/\*.*?\*/')
+    clean = re.sub(tok, lambda m: '""' if m.group(0)[0] in '"\'`' else '',
+                   src, flags=re.S)
+    for o, c in (("(", ")"), ("{", "}"), ("[", "]")):
+        assert clean.count(o) == clean.count(c), \
+            f"unbalanced {o}{c}: {clean.count(o)} vs {clean.count(c)}"
+
+    ids = set(re.findall(r'\$id\("([\w-]+)"\)', src))
+    assert len(ids) >= 25, f"contract unexpectedly small: {len(ids)}"
+    missing = [i for i in sorted(ids) if f'id="{i}"' not in html]
+    assert not missing, f"app.js references missing element ids: {missing}"

@@ -22,6 +22,7 @@ from kmeans_tpu.models.gmm import (
     fit_gmm,
     gmm_log_resp,
     gmm_predict,
+    gmm_sample,
 )
 from kmeans_tpu.models.gmm_stream import fit_gmm_stream, gmm_assign_stream
 from kmeans_tpu.models.kernel import (
@@ -99,6 +100,7 @@ __all__ = [
     "gmm_assign_stream",
     "gmm_log_resp",
     "gmm_predict",
+    "gmm_sample",
     "KernelKMeans",
     "KernelKMeansState",
     "fit_kernel_kmeans",

@@ -384,3 +384,33 @@ def test_stream_resume_refuses_untagged_checkpoint(tmp_path):
     with pytest.raises(ValueError, match="no stream tag"):
         fit_minibatch_stream(x, 2, steps=10, checkpoint_path=ckpt,
                              resume=True)
+
+
+def test_gmm_sample_statistics():
+    from kmeans_tpu.models.gmm import GMMParams, gmm_sample
+
+    means = jnp.asarray([[0.0, 0.0], [10.0, 10.0]], jnp.float32)
+    variances = jnp.asarray([[1.0, 4.0], [0.25, 0.25]], jnp.float32)
+    log_pi = jnp.log(jnp.asarray([0.3, 0.7], jnp.float32))
+    params = GMMParams(means, variances, log_pi)
+    x, comp = gmm_sample(jax.random.key(0), params, 20_000)
+    assert x.shape == (20_000, 2) and comp.shape == (20_000,)
+    frac1 = float(jnp.mean(comp == 1))
+    assert abs(frac1 - 0.7) < 0.02, frac1
+    x0 = np.asarray(x)[np.asarray(comp) == 0]
+    np.testing.assert_allclose(x0.mean(0), [0.0, 0.0], atol=0.1)
+    np.testing.assert_allclose(x0.var(0), [1.0, 4.0], rtol=0.1)
+
+
+def test_gmm_estimator_sample_roundtrip(rng):
+    x = np.concatenate([rng.normal(size=(200, 3)) + 6,
+                        rng.normal(size=(200, 3))]).astype(np.float32)
+    gm = GaussianMixture(n_components=2, seed=0, chunk_size=128) \
+        .fit(jnp.asarray(x))
+    xs, comp = gm.sample(5000)
+    # samples from the fit score higher under the model than uniform noise
+    s_fit = float(jnp.mean(gm.score_samples(xs)))
+    noise = jnp.asarray(rng.uniform(-20, 20, size=(5000, 3)),
+                        jnp.float32)
+    s_noise = float(jnp.mean(gm.score_samples(noise)))
+    assert s_fit > s_noise + 1.0

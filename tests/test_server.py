@@ -540,15 +540,25 @@ def test_static_js_contract():
     src = (static / "app.js").read_text()
     html = (static / "index.html").read_text()
 
-    # One alternation pass: strings and comments are consumed in source
-    # order, so a "//" inside a string (a URL) can't corrupt the parse
-    # the way sequential stripping would.
+    # One alternation pass: strings, comments, AND regex literals are
+    # consumed in source order, so a "//" inside a string (a URL) or
+    # brackets/quotes inside a regex can't corrupt the parse the way
+    # sequential stripping would.  The regex-literal alternative is
+    # restricted to the delimiters-after-punctuation positions JS allows
+    # (following ( , = : [ ! & | ? { } ; or line start), which covers
+    # every literal app.js can legally contain without misreading
+    # division.
     tok = (r'"(?:[^"\\\n]|\\.)*"'
            r"|'(?:[^'\\\n]|\\.)*'"
            r'|`(?:[^`\\]|\\.)*`'
            r'|//[^\n]*'
-           r'|/\*.*?\*/')
-    clean = re.sub(tok, lambda m: '""' if m.group(0)[0] in '"\'`' else '',
+           r'|/\*.*?\*/'
+           r'|(?<=[(,=:\[!&|?{};\n])\s*/(?:[^/\\\n\[]|\\.'
+           r'|\[(?:[^\]\\\n]|\\.)*\])+/')
+    clean = re.sub(tok,
+                   lambda m: '""' if m.group(0).lstrip()[:1] in '"\'`/'
+                   and not m.group(0).lstrip().startswith('//')
+                   and not m.group(0).lstrip().startswith('/*') else '',
                    src, flags=re.S)
     for o, c in (("(", ")"), ("{", "}"), ("[", "]")):
         assert clean.count(o) == clean.count(c), \

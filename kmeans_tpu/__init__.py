@@ -16,7 +16,7 @@ Layout:
   utils/     room codes, ids, small helpers
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from kmeans_tpu.config import KMeansConfig, MeshConfig, RunConfig, ServeConfig
 from kmeans_tpu.models import (

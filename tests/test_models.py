@@ -430,3 +430,44 @@ def test_minibatch_partial_fit_after_fit_keeps_adapting():
         np.asarray(est.cluster_centers_) - 30.0, axis=1
     ).min()
     assert d_to_b < 12.0, f"centers never adapted to the new mode: {d_to_b}"
+
+
+def test_state_objective_and_centers_cover_every_family():
+    """The shared mappings must resolve every state shape the framework
+    returns (new families get added here when their shape is novel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.data import make_blobs
+    from kmeans_tpu.models import (
+        fit_fuzzy,
+        fit_gmm,
+        fit_kernel_kmeans,
+        fit_kmedoids,
+        fit_lloyd,
+        state_centers,
+        state_objective,
+    )
+
+    x, _, _ = make_blobs(jax.random.key(0), 120, 3, 2, cluster_std=0.5)
+    cfg = KMeansConfig(k=2, chunk_size=64, max_iter=5)
+    states = {
+        "lloyd": fit_lloyd(x, 2, config=cfg),
+        "fuzzy": fit_fuzzy(x, 2, config=cfg),
+        "gmm": fit_gmm(x, 2, config=cfg),
+        "kernel": fit_kernel_kmeans(x, 2, config=cfg),
+        "kmedoids": fit_kmedoids(x, 2, config=cfg),
+    }
+    for name, st in states.items():
+        obj = state_objective(st)
+        assert np.isfinite(obj), name
+        centers = state_centers(st)
+        if name == "kernel":
+            assert centers is None
+        else:
+            assert centers is not None and centers.shape == (2, 3), name
+    # lower-is-better orientation: the GMM's value is the NEGATED ll
+    assert state_objective(states["gmm"]) == -float(
+        states["gmm"].log_likelihood
+    )

@@ -282,15 +282,16 @@ def adjusted_rand_index(labels_a, labels_b) -> jax.Array:
                      (sum_ij - expected) / denom)
 
 
-def normalized_mutual_info(labels_a, labels_b) -> jax.Array:
-    """NMI with arithmetic-mean normalization (sklearn's default)."""
+def _mi_terms(labels_a, labels_b):
+    """``(mi, H(a), H(b))`` from the contingency table — THE one copy of
+    the mutual-information math, shared by NMI and the
+    homogeneity/completeness family."""
     la = jnp.asarray(labels_a, jnp.int32)
     lb = jnp.asarray(labels_b, jnp.int32)
     ka = int(jnp.max(la)) + 1
     kb = int(jnp.max(lb)) + 1
     c = _contingency(la, lb, ka=ka, kb=kb)
-    n = jnp.sum(c)
-    p = c / n
+    p = c / jnp.sum(c)
     pa = jnp.sum(p, axis=1)
     pb = jnp.sum(p, axis=0)
 
@@ -300,7 +301,12 @@ def normalized_mutual_info(labels_a, labels_b) -> jax.Array:
     outer = pa[:, None] * pb[None, :]
     mi = jnp.sum(jnp.where(p > 0, p * jnp.log(p / jnp.maximum(outer, 1e-300)),
                            0.0))
-    ha, hb = ent(pa), ent(pb)
+    return mi, ent(pa), ent(pb)
+
+
+def normalized_mutual_info(labels_a, labels_b) -> jax.Array:
+    """NMI with arithmetic-mean normalization (sklearn's default)."""
+    mi, ha, hb = _mi_terms(labels_a, labels_b)
     denom = 0.5 * (ha + hb)
     return jnp.where(denom <= 0, 1.0, mi / denom)
 
@@ -310,31 +316,12 @@ def homogeneity_completeness_v(labels_true, labels_pred):
 
     homogeneity = 1 − H(true|pred)/H(true): each cluster holds members of
     a single class.  completeness = 1 − H(pred|true)/H(pred): each class
-    lands in a single cluster.  v_measure is their harmonic mean.  A zero
-    entropy (single class / single cluster) scores 1 by convention, as in
-    sklearn.  Returns a dict ``{homogeneity, completeness, v_measure}`` of
-    scalars.
+    lands in a single cluster.  v_measure is their harmonic mean.  Both
+    derive from the one shared MI computation (H(A|B) = H(A) − MI).  A
+    zero entropy (single class / single cluster) scores 1 by convention,
+    as in sklearn.  Returns ``{homogeneity, completeness, v_measure}``.
     """
-    lt = jnp.asarray(labels_true, jnp.int32)
-    lp = jnp.asarray(labels_pred, jnp.int32)
-    ka = int(jnp.max(lt)) + 1
-    kb = int(jnp.max(lp)) + 1
-    c = _contingency(lt, lp, ka=ka, kb=kb)
-    n = jnp.sum(c)
-    p = c / n
-    pa = jnp.sum(p, axis=1)          # class marginals
-    pb = jnp.sum(p, axis=0)          # cluster marginals
-
-    def ent(q):
-        return -jnp.sum(jnp.where(q > 0, q * jnp.log(q), 0.0))
-
-    h_a, h_b = ent(pa), ent(pb)
-    # One MI sum (the NMI expression) derives both conditionals:
-    # H(A|B) = H(A) − MI  ⇒  homogeneity = MI / H(A); likewise for B.
-    outer = pa[:, None] * pb[None, :]
-    mi = jnp.sum(jnp.where(
-        p > 0, p * jnp.log(p / jnp.maximum(outer, 1e-300)), 0.0
-    ))
+    mi, h_a, h_b = _mi_terms(labels_true, labels_pred)
     hom = jnp.where(h_a <= 0, 1.0, mi / h_a)
     com = jnp.where(h_b <= 0, 1.0, mi / h_b)
     v = jnp.where(hom + com <= 0, 0.0, 2.0 * hom * com / (hom + com))

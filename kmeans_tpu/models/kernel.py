@@ -264,10 +264,6 @@ def fit_kernel_kmeans(
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "chunk_size", "compute_dtype", "kernel", "degree"),
-)
 def kernel_assign(
     x_new: jax.Array,
     x_fit: jax.Array,
@@ -275,7 +271,7 @@ def kernel_assign(
     *,
     k: int,
     kernel: str = "rbf",
-    gamma: float = 0.1,
+    gamma: Optional[float] = None,
     degree: int = 3,
     coef0: float = 1.0,
     weights_fit: Optional[jax.Array] = None,
@@ -290,7 +286,30 @@ def kernel_assign(
     when ``within_mass`` (the fit's cached T_c,
     ``state.within_mass``) is supplied.  Without it, T is rebuilt from
     the training set, which costs an extra O(n²·d) sweep per call.
+
+    Kernel parameters default exactly like :func:`fit_kernel_kmeans`
+    (``gamma=None`` resolves to 1/d), so default-gamma fits predict with
+    the same kernel they trained with.
     """
+    gamma, degree, coef0 = resolve_kernel_params(
+        kernel, gamma, degree, coef0, x_fit.shape[1]
+    )
+    return _kernel_assign(
+        x_new, x_fit, labels_fit, k=k, kernel=kernel, gamma=gamma,
+        degree=degree, coef0=coef0, weights_fit=weights_fit,
+        within_mass=within_mass, chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "chunk_size", "compute_dtype", "kernel", "degree"),
+)
+def _kernel_assign(
+    x_new, x_fit, labels_fit, *, k, kernel, gamma, degree, coef0,
+    weights_fit, within_mass, chunk_size, compute_dtype,
+):
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else \
         x_new.dtype

@@ -42,8 +42,9 @@ from kmeans_tpu.parallel.engine import _pad_rows
 __all__ = ["fit_kernel_kmeans_sharded"]
 
 
-def _kernel_sharded_pass(x_loc, w_loc, lab_loc, *, data_axis, k, chunk_size,
-                         compute_dtype, kernel, gamma, degree, coef0):
+def _kernel_sharded_pass(x_loc, w_loc, lab_loc, *, data_axis, k, n_real,
+                         chunk_size, compute_dtype, kernel, gamma, degree,
+                         coef0):
     """One labeling pass on a shard: ring-sweep S, psum (N, T), update the
     local labels.  Returns (new_lab_loc, objective, N, n_changed)."""
     f32 = jnp.float32
@@ -51,6 +52,11 @@ def _kernel_sharded_pass(x_loc, w_loc, lab_loc, *, data_axis, k, chunk_size,
         x_loc.dtype
     n_loc = x_loc.shape[0]
     dp = lax.psum(1, data_axis)
+    # Rows are sharded contiguously, so a global index < n_real is a REAL
+    # row (possibly user-weighted 0) and >= n_real is shard padding — the
+    # weight alone can't distinguish them, and only padding may be pinned.
+    me = lax.axis_index(data_axis)
+    real = (me * n_loc + jnp.arange(n_loc, dtype=jnp.int32)) < n_real
 
     xs, ws, _ = chunk_tiles(x_loc, w_loc, chunk_size)
     xs_sq = sq_norms(xs)
@@ -99,21 +105,23 @@ def _kernel_sharded_pass(x_loc, w_loc, lab_loc, *, data_axis, k, chunk_size,
                 + _partition_value(S, N, T, lab_loc, w_loc) * w_loc),
         data_axis,
     )
-    # Padding rows (w == 0) are pinned to label 0 so they can never add to
-    # the changed count (their argmin may drift as real clusters move).
-    new_lab = jnp.where(w_loc > 0, new_lab, 0)
+    # Padding rows are pinned to label 0 so they can never add to the
+    # changed count (their argmin may drift as real clusters move).  Real
+    # rows — including user-weighted-0 ones — take their true argmin,
+    # matching the single-device fit's labels exactly.
+    new_lab = jnp.where(real, new_lab, 0)
     changed = lax.psum(
-        jnp.sum(jnp.where(w_loc > 0, new_lab != lab_loc, False)), data_axis
+        jnp.sum(jnp.where(real, new_lab != lab_loc, False)), data_axis
     )
     return new_lab, obj, N, T, changed
 
 
 @functools.lru_cache(maxsize=32)
-def _build_kernel_run(mesh, data_axis, k, chunk_size, compute_dtype,
+def _build_kernel_run(mesh, data_axis, k, n_real, chunk_size, compute_dtype,
                       kernel, gamma, degree, coef0, max_it):
     step = jax.shard_map(
         functools.partial(
-            _kernel_sharded_pass, data_axis=data_axis, k=k,
+            _kernel_sharded_pass, data_axis=data_axis, k=k, n_real=n_real,
             chunk_size=chunk_size, compute_dtype=compute_dtype,
             kernel=kernel, gamma=gamma, degree=degree, coef0=coef0,
         ),
@@ -200,7 +208,7 @@ def fit_kernel_kmeans_sharded(
                           NamedSharding(mesh, P(data_axis)))
 
     run = _build_kernel_run(
-        mesh, data_axis, k, cfg.chunk_size, cfg.compute_dtype,
+        mesh, data_axis, k, n, cfg.chunk_size, cfg.compute_dtype,
         kernel, gamma, degree, coef0,
         max_iter if max_iter is not None else cfg.max_iter,
     )

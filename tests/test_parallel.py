@@ -694,3 +694,27 @@ def test_kernel_sharded_linear_and_init_methods(cpu_devices):
     )
     np.testing.assert_array_equal(np.asarray(got.labels),
                                   np.asarray(want.labels))
+
+
+def test_kernel_sharded_zero_weight_rows_get_true_labels(cpu_devices):
+    """User-weighted-0 rows are REAL rows: the sharded fit must give them
+    their true argmin label like the single-device fit, not pin them to 0
+    (only shard padding is pinned)."""
+    from kmeans_tpu.models import fit_kernel_kmeans
+    from kmeans_tpu.parallel import fit_kernel_kmeans_sharded
+
+    x, _, _ = make_blobs(jax.random.key(41), 201, 4, 3, cluster_std=0.5)
+    x = np.asarray(x)
+    w = np.ones(201, np.float32)
+    w[::7] = 0.0                       # real rows with zero weight
+    lab0 = (np.arange(201) % 3).astype(np.int32)
+    want = fit_kernel_kmeans(jnp.asarray(x), 3, kernel="rbf", gamma=0.3,
+                             init=jnp.asarray(lab0), weights=jnp.asarray(w),
+                             max_iter=20)
+    got = fit_kernel_kmeans_sharded(
+        x, 3, mesh=cpu_mesh((4, 1)), kernel="rbf", gamma=0.3,
+        init=lab0, weights=w, max_iter=20,
+    )
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    assert int(got.n_iter) == int(want.n_iter)

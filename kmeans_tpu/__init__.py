@@ -7,13 +7,23 @@ session layer round-trips the reference's document schema, and the serve
 layer feeds a browser visualizer.
 
 Layout:
-  ops/       fused assign+reduce kernels, centroid update
-  models/    Lloyd + minibatch estimators, k-means++/k-means||/random init
-  parallel/  mesh construction, shard_map engine (DP over points, TP over k)
+  ops/       fused assign+reduce pass (XLA scan + Pallas/Mosaic TPU
+             kernel), distance kernels, centroid update + empty policies
+  models/    model families (Lloyd plain/accelerated, minibatch,
+             spherical, bisecting, fuzzy, Gaussian mixture, kernel
+             k-means + Nyström, k-medoids, x-means/g-means auto-k),
+             seeding (k-means++/k-means||/random), selection (sweep,
+             BIC/AIC, gap statistic), streaming fits, LloydRunner
+  parallel/  mesh construction, shard_map engine (DP psum, TP pmin-argmin,
+             FP Ulysses all_to_all, ppermute ring passes for the O(n²)
+             families), jax.distributed multi-host init
+  native/    C++ host runtime (threaded batch gather + fused f32→bf16),
+             ctypes-bound with a numpy fallback
+  metrics.py numeric cluster quality (silhouette, DB/CH, ARI, NMI, HCV)
   session/   document model, metrics, export/import JSON (reference schema)
   serve/     HTTP/SSE shim + browser front-end
-  data/      synthetic datasets for the BASELINE configs
-  utils/     room codes, ids, small helpers
+  data/      synthetic datasets, lightweight coresets, host→device streaming
+  utils/     checkpointing, profiling, room codes
 """
 
 __version__ = "0.2.0"

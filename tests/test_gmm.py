@@ -414,3 +414,20 @@ def test_gmm_estimator_sample_roundtrip(rng):
                         jnp.float32)
     s_noise = float(jnp.mean(gm.score_samples(noise)))
     assert s_fit > s_noise + 1.0
+
+
+def test_gmm_predict_matches_log_resp_argmax(rng):
+    """The tile-wise predict (no (n, k) materialization) must agree with
+    argmax of the full responsibility matrix."""
+    from kmeans_tpu.models import gmm_predict
+
+    x = jnp.asarray(rng.normal(size=(150, 5)).astype(np.float32))
+    s = fit_gmm(x, 3, init=x[:3], max_iter=8)
+    params = GMMParams(
+        s.means, s.covariances, jnp.log(jnp.maximum(s.mix_weights, 1e-37))
+    )
+    lab = gmm_predict(x, params, chunk_size=32)
+    log_resp, _ = gmm_log_resp(x, params, chunk_size=32)
+    np.testing.assert_array_equal(
+        np.asarray(lab), np.asarray(jnp.argmax(log_resp, axis=1))
+    )

@@ -718,3 +718,19 @@ def test_kernel_sharded_zero_weight_rows_get_true_labels(cpu_devices):
     np.testing.assert_array_equal(np.asarray(got.labels),
                                   np.asarray(want.labels))
     assert int(got.n_iter) == int(want.n_iter)
+
+
+def test_mesh_from_config_and_make_mesh_validation(cpu_devices):
+    from kmeans_tpu.config import MeshConfig
+    from kmeans_tpu.parallel import make_mesh, mesh_from_config
+
+    mesh = mesh_from_config(MeshConfig(data=4, model=2, platform="cpu"))
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("data", "model")
+    # default shape: all devices on the first axis
+    m2 = make_mesh(axis_names=("data", "model"),
+                   devices=jax.devices("cpu"))
+    assert m2.devices.shape == (len(jax.devices("cpu")), 1)
+    with pytest.raises(ValueError, match="needs"):
+        make_mesh((64, 2), devices=jax.devices("cpu"))
+

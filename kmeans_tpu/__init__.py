@@ -39,6 +39,7 @@ from kmeans_tpu.models import (
     KMedoids,
     MiniBatchKMeans,
     SphericalKMeans,
+    TrimmedKMeans,
     fit_bisecting,
     fit_fuzzy,
     fit_gmm,
@@ -52,6 +53,7 @@ from kmeans_tpu.models import (
     fit_lloyd_accelerated,
     fit_minibatch,
     fit_spherical,
+    fit_trimmed,
     suggest_k,
     sweep_k,
 )
@@ -70,6 +72,7 @@ __all__ = [
     "KMedoids",
     "MiniBatchKMeans",
     "SphericalKMeans",
+    "TrimmedKMeans",
     "fit_bisecting",
     "fit_fuzzy",
     "fit_gmm",
@@ -83,6 +86,7 @@ __all__ = [
     "fit_lloyd_accelerated",
     "fit_minibatch",
     "fit_spherical",
+    "fit_trimmed",
     "suggest_k",
     "sweep_k",
     "__version__",

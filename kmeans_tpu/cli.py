@@ -157,8 +157,19 @@ def _cmd_train(args) -> int:
               "saving into) one directory; --checkpoint must match "
               "--resume or be dropped", file=sys.stderr)
         return 2
+    if args.trim_fraction is not None:
+        if model != "trimmed":
+            print("error: --trim-fraction requires --model trimmed",
+                  file=sys.stderr)
+            return 2
+        if not 0.0 <= args.trim_fraction < 1.0:
+            print("error: --trim-fraction must be in [0, 1)", file=sys.stderr)
+            return 2
+    trim_fraction = (args.trim_fraction if args.trim_fraction is not None
+                     else 0.05)
+
     mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kernel",
-               "kmedoids")
+               "kmedoids", "trimmed")
     if mesh is not None and model not in mesh_ok:
         print(
             f"error: --mesh supports --model {'/'.join(mesh_ok)}, "
@@ -172,7 +183,7 @@ def _cmd_train(args) -> int:
         return 2
 
     coreset_ok = ("lloyd", "accelerated", "spherical", "bisecting", "fuzzy",
-                  "gmm", "kernel", "kmedoids")
+                  "gmm", "kernel", "kmedoids", "trimmed")
     fit_weights = None
     if args.coreset is not None:
         if args.coreset < 1:
@@ -233,8 +244,11 @@ def _cmd_train(args) -> int:
             "gmm": parallel.fit_gmm_sharded,
             "kernel": parallel.fit_kernel_kmeans_sharded,
             "kmedoids": parallel.fit_kmedoids_sharded,
+            "trimmed": parallel.fit_trimmed_sharded,
         }[model]
-        state = fit(np.asarray(x), k, mesh=mesh, config=kcfg)
+        fit_kw = ({"trim_fraction": trim_fraction}
+                  if model == "trimmed" else {})
+        state = fit(np.asarray(x), k, mesh=mesh, config=kcfg, **fit_kw)
     elif args.stream:
         ckpt_kw = {}
         if stream_ckpt:
@@ -276,13 +290,16 @@ def _cmd_train(args) -> int:
             "gmm": models.fit_gmm,
             "kernel": models.fit_kernel_kmeans,
             "kmedoids": models.fit_kmedoids,
+            "trimmed": models.fit_trimmed,
             "xmeans": models.fit_xmeans,   # --k is k_max; k is discovered
             "gmeans": models.fit_gmeans,   # likewise (Anderson-Darling)
         }[model]
+        fit_kw = ({"trim_fraction": trim_fraction}
+                  if model == "trimmed" else {})
         if fit_weights is not None:
-            state = fit(x, k, config=kcfg, weights=fit_weights)
+            state = fit(x, k, config=kcfg, weights=fit_weights, **fit_kw)
         else:
-            state = fit(x, k, config=kcfg)
+            state = fit(x, k, config=kcfg, **fit_kw)
         if model in ("xmeans", "gmeans"):
             k = int(state.centroids.shape[0])
     jax_done = time.perf_counter() - t0
@@ -425,9 +442,13 @@ def main(argv=None) -> int:
                    "(named configs set it from BASELINE)")
     t.add_argument("--model", default=None, choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "gmm", "kernel", "kmedoids", "xmeans", "gmeans",
+        "fuzzy", "gmm", "kernel", "kmedoids", "trimmed", "xmeans", "gmeans",
     ], help="model family (default: lloyd, or the config's minibatch "
             "choice); for xmeans/gmeans, --k is k_max and k is discovered")
+    t.add_argument("--trim-fraction", type=float, default=None,
+                   help="--model trimmed: fraction of points excluded as "
+                        "outliers each iteration (default 0.05); trimmed "
+                        "points export as unassigned cards")
     t.add_argument("--init", default="k-means++",
                    choices=["k-means++", "k-means||", "random"])
     t.add_argument("--mesh", type=int, default=0,

@@ -45,6 +45,7 @@ from kmeans_tpu.models.selection import (
     sweep_k,
 )
 from kmeans_tpu.models.streaming import assign_stream, fit_minibatch_stream
+from kmeans_tpu.models.trimmed import TrimmedKMeans, TrimmedState, fit_trimmed
 from kmeans_tpu.models.spherical import (
     SphericalKMeans,
     fit_spherical,
@@ -121,6 +122,9 @@ __all__ = [
     "fit_minibatch",
     "SphericalKMeans",
     "fit_spherical",
+    "TrimmedKMeans",
+    "TrimmedState",
+    "fit_trimmed",
     "normalize_rows",
     "gap_statistic",
     "suggest_k_gap",

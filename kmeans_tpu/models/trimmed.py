@@ -1,0 +1,280 @@
+"""Trimmed k-means (k-means--): outlier-robust Lloyd.
+
+Chawla & Gionis's "k-means--" (SDM 2012): each iteration assigns every
+point, marks the ``m`` points FARTHEST from their nearest centroid as
+outliers, and updates centroids from the inliers only.  The fit therefore
+solves k-means and outlier detection jointly — the classic cure for the
+reference dataset's designated outliers (``seed:t10``/``seed:t11``,
+/root/reference/app.mjs:214-215, which the teaching app expects humans to
+notice and leave unassigned).
+
+TPU-first design — trimming costs ONE fused pass plus O(m) extra work,
+not a second sweep:
+
+* the fused pass (:func:`kmeans_tpu.ops.lloyd.lloyd_pass` — XLA scan or
+  the Pallas/Mosaic kernel, unchanged) produces labels, min-distances,
+  and the FULL sums/counts/inertia in a single HBM read of ``x``;
+* ``lax.top_k`` selects the ``m`` largest min-distances (static ``m``,
+  lowest-index tie-break — deterministic);
+* the outliers' contributions are *subtracted*: gather the m rows,
+  ``segment_sum`` them per cluster, and remove from sums/counts/inertia.
+  m ≪ n, so the correction is noise next to the distance matmul.
+
+Zero-weight rows (padding, zero-weight samples) are never nominated as
+outliers — trimming ranks only rows that could influence the update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.init import resolve_fit_inputs
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
+from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
+
+__all__ = ["TrimmedState", "fit_trimmed", "TrimmedKMeans", "resolve_n_trim"]
+
+
+class TrimmedState(NamedTuple):
+    """Result of a trimmed fit.
+
+    ``labels`` is -1 for the points trimmed as outliers at the final
+    centroids; ``outlier_mask`` is the same information as a boolean
+    (n,) array.  ``inertia``/``counts`` cover inliers only.
+    """
+
+    centroids: jax.Array      # (k, d) float32
+    labels: jax.Array         # (n,) int32, -1 = outlier
+    inertia: jax.Array        # scalar float32, inliers only
+    n_iter: jax.Array         # scalar int32
+    converged: jax.Array      # scalar bool
+    counts: jax.Array         # (k,) float32 inlier cluster sizes
+    outlier_mask: jax.Array   # (n,) bool
+
+
+def resolve_n_trim(n: int, *, trim_fraction: Optional[float],
+                   n_trim: Optional[int]) -> int:
+    """THE one copy of the trim-budget rule (front door, estimator,
+    sharded engine, CLI): exactly one of the two knobs, 0 <= m < n."""
+    if (trim_fraction is None) == (n_trim is None):
+        raise ValueError("pass exactly one of trim_fraction / n_trim")
+    if n_trim is None:
+        if not 0.0 <= trim_fraction < 1.0:
+            raise ValueError(
+                f"trim_fraction must be in [0, 1), got {trim_fraction}"
+            )
+        n_trim = int(round(trim_fraction * n))
+    if not 0 <= n_trim < n:
+        raise ValueError(f"n_trim must be in [0, {n}), got {n_trim}")
+    return n_trim
+
+
+def trim_subtract(x, labels, idx, wt, vals, k: int):
+    """The (sums, counts, inertia) contribution of candidate rows ``idx``
+    with effective weights ``wt`` and min-distances ``vals`` — THE one
+    copy of the correction math, shared by the single-device loop (via
+    :func:`trim_correction`) and the sharded engine's local pass."""
+    f32 = jnp.float32
+    xt = x[idx].astype(f32)
+    lt = labels[idx]
+    sums_corr = jax.ops.segment_sum(xt * wt[:, None], lt, num_segments=k)
+    counts_corr = jax.ops.segment_sum(wt, lt, num_segments=k)
+    # vals can be -inf where every remaining candidate had weight 0;
+    # those rows contribute nothing (wt == 0), so guard the product.
+    inertia_corr = jnp.sum(jnp.where(wt > 0, wt * vals, 0.0))
+    return sums_corr, counts_corr, inertia_corr
+
+
+def trim_correction(x, labels, min_d2, weights, k: int, m: int):
+    """Single-device outlier selection + the reduction correction.
+
+    Returns ``(idx, sums_corr, counts_corr, inertia_corr)`` where ``idx``
+    are the m trimmed row indices and the corrections are what the
+    trimmed rows contributed to the full-pass reductions.
+    """
+    d2m = min_d2 if weights is None else jnp.where(
+        weights > 0, min_d2, -jnp.inf
+    )
+    vals, idx = lax.top_k(d2m, m)
+    wt = (jnp.ones((m,), jnp.float32) if weights is None
+          else weights[idx].astype(jnp.float32))
+    return (idx, *trim_subtract(x, labels, idx, wt, vals, k))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("m", "max_iter", "chunk_size", "compute_dtype",
+                     "update", "empty", "backend"),
+)
+def _trimmed_loop(x, centroids0, weights, tol, *, m, max_iter, chunk_size,
+                  compute_dtype, update, empty, backend="xla"):
+    n, _ = x.shape
+    k = centroids0.shape[0]
+    kw = dict(weights=weights, chunk_size=chunk_size,
+              compute_dtype=compute_dtype, update=update, backend=backend)
+
+    def cond(s):
+        c, it, shift_sq, done = s
+        return (it < max_iter) & ~done
+
+    def body(s):
+        c, it, _, _ = s
+        labels, min_d2, sums, counts, _ = lloyd_pass(x, c, **kw)
+        idx, s_corr, n_corr, _ = trim_correction(
+            x, labels, min_d2, weights, k, m
+        )
+        sums = sums - s_corr
+        counts = counts - n_corr
+        new_c = apply_update(c, sums, counts)
+        if empty == "farthest":
+            # Reseed targets must be inliers: an empty cluster grabbing a
+            # trimmed outlier would re-admit exactly the point trimming
+            # exists to exclude.
+            mind = min_d2 if weights is None else jnp.where(
+                weights > 0, min_d2, -jnp.inf
+            )
+            mind = mind.at[idx].set(-jnp.inf)
+            new_c = reseed_empty_farthest(new_c, counts, x, mind)
+        shift_sq = jnp.sum((new_c - c) ** 2)
+        return (new_c, it + 1, shift_sq, shift_sq <= tol)
+
+    init = (centroids0.astype(jnp.float32), jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, jnp.float32), jnp.zeros((), bool))
+    centroids, n_iter, _, converged = lax.while_loop(cond, body, init)
+
+    # Final consistent view at the final centroids: one more pass + trim.
+    labels, min_d2, sums, counts, inertia = lloyd_pass(x, centroids, **kw)
+    idx, _, n_corr, i_corr = trim_correction(
+        x, labels, min_d2, weights, k, m
+    )
+    outlier_mask = jnp.zeros((n,), bool).at[idx].set(True)
+    labels = jnp.where(outlier_mask, -1, labels)
+    return TrimmedState(
+        centroids, labels, inertia - i_corr, n_iter, converged,
+        counts - n_corr, outlier_mask,
+    )
+
+
+def fit_trimmed(
+    x: jax.Array,
+    k: int,
+    *,
+    trim_fraction: Optional[float] = None,
+    n_trim: Optional[int] = None,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init: Union[str, jax.Array, None] = None,
+    weights: Optional[jax.Array] = None,
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+) -> TrimmedState:
+    """Fit trimmed k-means (k-means--), excluding the ``m`` farthest
+    points from every centroid update and from the final labeling.
+
+    Exactly one of ``trim_fraction`` (fraction of n) / ``n_trim`` (count)
+    selects the outlier budget.  ``trim_fraction=0.0`` reproduces plain
+    Lloyd with an all-false outlier mask.
+    """
+    x = jnp.asarray(x)
+    m = resolve_n_trim(x.shape[0], trim_fraction=trim_fraction,
+                       n_trim=n_trim)
+    cfg, key, c0 = resolve_fit_inputs(x, k, key, config, init, weights)
+    backend = resolve_backend(
+        cfg.backend, x, k, weights=weights, compute_dtype=cfg.compute_dtype,
+    )
+    return _trimmed_loop(
+        x, c0, weights,
+        jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32),
+        m=m,
+        max_iter=max_iter if max_iter is not None else cfg.max_iter,
+        chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
+        update=cfg.update, empty=cfg.empty, backend=backend,
+    )
+
+
+@dataclasses.dataclass
+class TrimmedKMeans:
+    """Estimator wrapper over :func:`fit_trimmed` (sklearn-like surface).
+
+    >>> tk = TrimmedKMeans(n_clusters=3, trim_fraction=0.05, seed=0).fit(x)
+    >>> tk.labels_          # -1 marks the trimmed outliers
+    >>> tk.outlier_mask_
+    """
+
+    n_clusters: int = 3
+    trim_fraction: float = 0.05
+    init: Union[str, jax.Array] = "k-means++"
+    max_iter: int = 100
+    tol: float = 1e-4
+    seed: int = 0
+    n_init: int = 1
+    chunk_size: int = 4096
+    compute_dtype: Optional[str] = None
+    empty: str = "keep"
+    backend: str = "auto"
+
+    state: Optional[TrimmedState] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def fit(self, x, weights=None) -> "TrimmedKMeans":
+        from kmeans_tpu.models.lloyd import best_of_n_init
+
+        x = jnp.asarray(x)
+        init = None if isinstance(self.init, str) else self.init
+        cfg = KMeansConfig(
+            k=self.n_clusters,
+            init=self.init if isinstance(self.init, str) else "given",
+            max_iter=self.max_iter, tol=self.tol, seed=self.seed,
+            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
+            empty=self.empty, backend=self.backend,
+        )
+        self.state = best_of_n_init(
+            lambda key: fit_trimmed(
+                x, self.n_clusters, trim_fraction=self.trim_fraction,
+                key=key, config=cfg, init=init, weights=weights,
+            ),
+            jax.random.key(self.seed),
+            1 if init is not None else self.n_init,
+        )
+        return self
+
+    def fit_predict(self, x, weights=None):
+        return self.fit(x, weights=weights).labels_
+
+    def predict(self, x):
+        """Nearest-centroid labels for new data (no trimming on predict)."""
+        from kmeans_tpu.ops.distance import assign
+
+        labels, _ = assign(
+            jnp.asarray(x), self.state.centroids,
+            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
+        )
+        return labels
+
+    @property
+    def cluster_centers_(self):
+        return self.state.centroids
+
+    @property
+    def labels_(self):
+        return self.state.labels
+
+    @property
+    def outlier_mask_(self):
+        return self.state.outlier_mask
+
+    @property
+    def inertia_(self):
+        return float(self.state.inertia)
+
+    @property
+    def n_iter_(self):
+        return int(self.state.n_iter)

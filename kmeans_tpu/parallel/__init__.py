@@ -9,6 +9,7 @@ from kmeans_tpu.parallel.engine import (
     fit_lloyd_sharded,
     fit_minibatch_sharded,
     fit_spherical_sharded,
+    fit_trimmed_sharded,
     sharded_assign,
 )
 from kmeans_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_from_config
@@ -23,6 +24,7 @@ __all__ = [
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",
     "fit_spherical_sharded",
+    "fit_trimmed_sharded",
     "sharded_assign",
     "cpu_mesh",
     "make_mesh",

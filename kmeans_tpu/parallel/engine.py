@@ -927,6 +927,198 @@ def _build_fcm_run(mesh, data_axis, chunk_size, compute_dtype, m, max_it):
     return run
 
 
+def _trim_select_dp(d2m, *, m_loc, m, data_axis):
+    """Global top-``m`` outlier selection across DP shards, reproducing
+    single-device ``lax.top_k`` semantics (largest value first, lowest
+    GLOBAL index on ties) without ever gathering the per-row distances:
+
+    1. each shard nominates its local top ``m_loc = min(m, n_loc)``
+       candidate values (any global winner is a local winner);
+    2. one ``all_gather`` of the (dp, m_loc) candidate VALUES gives every
+       shard the global m-th largest value τ;
+    3. every row with value > τ is trimmed; the remaining quota
+       ``m − #(>τ)`` is allocated to rows == τ in global index order —
+       shards are contiguous row blocks, so "lower shard first, lower
+       local index first" IS global index order.
+
+    Returns ``(idx_loc, sel, vals_loc)``: the local candidate row indices,
+    a boolean mask over them (True = trimmed), and their values.
+    """
+    vals_loc, idx_loc = lax.top_k(d2m, m_loc)
+    vals_all = lax.all_gather(vals_loc, data_axis)        # (dp, m_loc)
+    tau = lax.top_k(vals_all.reshape(-1), m)[0][m - 1]
+    total_gt = lax.psum(jnp.sum(d2m > tau), data_axis)
+    t_all = lax.all_gather(jnp.sum(d2m == tau), data_axis)   # (dp,)
+    i = lax.axis_index(data_axis)
+    ties_before = jnp.sum(
+        jnp.where(jnp.arange(t_all.shape[0]) < i, t_all, 0)
+    )
+    take = jnp.clip(m - total_gt - ties_before, 0, t_all[i])
+    eq = vals_loc == tau
+    # top_k orders equal values by ascending index, so position-among-eq
+    # in the candidate list is exactly the local tie rank.
+    tie_rank = jnp.cumsum(eq.astype(jnp.int32)) - 1
+    sel = (vals_loc > tau) | (eq & (tie_rank < take))
+    return idx_loc, sel, vals_loc
+
+
+def _trimmed_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size,
+                        compute_dtype, update, m, m_loc, with_labels,
+                        backend="xla", empty="keep"):
+    """DP shard body for trimmed k-means: the Lloyd local pass, then the
+    distributed top-m selection and an O(m_loc) SUBTRACTION of the trimmed
+    rows' contributions before the psum — trimming never costs a second
+    sweep of the shard (mirrors models/trimmed.py single-device)."""
+    labels, min_d2, sums, counts, inertia = lloyd_pass(
+        x_loc, c, weights=w_loc, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update=update, backend=backend,
+    )
+    from kmeans_tpu.models.trimmed import trim_subtract
+
+    d2m = jnp.where(w_loc > 0, min_d2, -jnp.inf)
+    idx, sel, vals = _trim_select_dp(d2m, m_loc=m_loc, m=m,
+                                     data_axis=data_axis)
+    k = c.shape[0]
+    wt = jnp.where(sel, w_loc[idx].astype(jnp.float32), 0.0)
+    s_corr, c_corr, i_corr = trim_subtract(x_loc, labels, idx, wt, vals, k)
+    sums = sums - s_corr
+    counts = counts - c_corr
+    inertia = inertia - i_corr
+    sums = lax.psum(sums, data_axis)
+    counts = lax.psum(counts, data_axis)
+    inertia = lax.psum(inertia, data_axis)
+    if with_labels:
+        out_mask = jnp.zeros(w_loc.shape, bool).at[idx].set(sel)
+        labels = jnp.where(out_mask, -1, labels)
+        return inertia, counts, labels, out_mask
+    new_c = _apply_center_update(c, sums, counts, center_update="mean")
+    if empty == "farthest":
+        # Inliers only: a trimmed outlier must never seed an empty slot.
+        mind = d2m.at[idx].set(jnp.where(sel, -jnp.inf, vals))
+        new_c = _reseed_empty_farthest_dp(new_c, counts, x_loc, mind,
+                                          data_axis)
+    return new_c, inertia, counts
+
+
+@functools.lru_cache(maxsize=32)
+def _build_trimmed_run(mesh, data_axis, chunk_size, compute_dtype, update,
+                       m, m_loc, empty, backend, max_it):
+    local = functools.partial(
+        _trimmed_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update=update, m=m, m_loc=m_loc,
+        empty=empty, backend=backend,
+    )
+    step = jax.shard_map(
+        functools.partial(local, with_labels=False), mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P()), check_vma=False,
+    )
+    final = jax.shard_map(
+        functools.partial(local, with_labels=True), mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P(data_axis), P(data_axis)), check_vma=False,
+    )
+
+    @jax.jit
+    def run(x, w, c0, tol_v):
+        def cond(s):
+            c, it, shift_sq, done = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            c, it, _, _ = s
+            new_c, _, _ = step(x, c, w)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v)
+
+        c, n_iter, _, converged = lax.while_loop(
+            cond, body,
+            (c0.astype(jnp.float32), jnp.zeros((), jnp.int32),
+             jnp.asarray(jnp.inf, jnp.float32), jnp.zeros((), bool)),
+        )
+        inertia, counts, labels, out_mask = final(x, c, w)
+        return c, labels, inertia, n_iter, converged, counts, out_mask
+
+    return run
+
+
+def fit_trimmed_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    trim_fraction: Optional[float] = None,
+    n_trim: Optional[int] = None,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    weights=None,
+    data_axis: str = "data",
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+):
+    """Trimmed k-means (k-means--) on a device mesh (DP over points).
+
+    Exact parity with the single-device :func:`kmeans_tpu.models.fit_trimmed`
+    — including the top-k tie-break — via the distributed selection in
+    :func:`_trim_select_dp`.  Returns a
+    :class:`kmeans_tpu.models.trimmed.TrimmedState`.
+    """
+    from kmeans_tpu.models.trimmed import TrimmedState, resolve_n_trim
+
+    m = resolve_n_trim(x.shape[0], trim_fraction=trim_fraction,
+                       n_trim=n_trim)
+    cfg, key = resolve_fit_config(k, key, config)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes[data_axis]
+
+    if weights is not None and np.asarray(weights).shape != (x.shape[0],):
+        raise ValueError(
+            f"weights shape {np.asarray(weights).shape} != ({x.shape[0]},)"
+        )
+    x, w_host, n = _pad_rows(x, dp, weights=weights)
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, x.shape[1]):
+            raise ValueError(f"init centroids shape {c0.shape} != "
+                             f"{(k, x.shape[1])}")
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        c0 = init_centroids(
+            key, x, k, method=method, weights=w,
+            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        )
+    c0 = jax.device_put(c0, NamedSharding(mesh, P()))
+
+    if m == 0:
+        # Degenerate budget: plain sharded Lloyd + an all-false mask.
+        st = fit_lloyd_sharded(
+            x[:n], k, mesh=mesh, key=key, config=config, init=c0,
+            weights=None if weights is None else w_host[:n],
+            data_axis=data_axis, tol=tol, max_iter=max_iter,
+        )
+        return TrimmedState(
+            st.centroids, st.labels, st.inertia, st.n_iter, st.converged,
+            st.counts, jnp.zeros((n,), bool),
+        )
+
+    m_loc = min(m, x.shape[0] // dp)
+    run = _build_trimmed_run(
+        mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, cfg.update,
+        m, m_loc, cfg.empty, "xla",
+        max_iter if max_iter is not None else cfg.max_iter,
+    )
+    tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
+    c, labels, inertia, n_iter, converged, counts, out_mask = run(
+        x, w, c0, tol_v
+    )
+    return TrimmedState(c, labels[:n], inertia, n_iter, converged, counts,
+                        out_mask[:n])
+
+
 def fit_fuzzy_sharded(
     x,
     k: int,

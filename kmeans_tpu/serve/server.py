@@ -66,6 +66,7 @@ _TRAIN_FITS = {
     "gmm": "fit_gmm",
     "kernel": "fit_kernel_kmeans",
     "kmedoids": "fit_kmedoids",
+    "trimmed": "fit_trimmed",   # outliers come back as unassigned cards
     "xmeans": "fit_xmeans",     # k acts as k_max; BIC discovers the k
     "gmeans": "fit_gmeans",     # k_max likewise; Anderson-Darling test
 }
@@ -349,6 +350,13 @@ class KMeansServer:
             raise ValueError(f"unknown train model {model!r}")
         if init not in ("k-means++", "k-means||", "random"):
             raise ValueError(f"unknown train init {init!r}")
+        if "trim_fraction" in args and model != "trimmed":
+            # Knobs that would be silently ignored are rejected instead
+            # (the CLI's convention, cli.py: contradictory-flag guards).
+            raise ValueError("trim_fraction requires model 'trimmed'")
+        trim_fraction = float(args.get("trim_fraction", 0.05))
+        if not 0.0 <= trim_fraction < 1.0:
+            raise ValueError("trim_fraction must be in [0, 1)")
         if n < k or n < 1 or d < 1 or k < 1:
             raise ValueError("invalid train shape")
         if model in ("kmedoids", "kernel"):
@@ -423,8 +431,10 @@ class KMeansServer:
                     room.broadcast_event({"type": "train", "model": model,
                                           "iteration": 0})
                     fit = getattr(models, _TRAIN_FITS[model])
+                    fit_kw = ({"trim_fraction": trim_fraction}
+                              if model == "trimmed" else {})
                     state = fit(x, k, key=jax.random.key(seed + 1),
-                                config=kcfg)
+                                config=kcfg, **fit_kw)
                 if d >= 2 and k <= MAX_CENTROIDS:
                     from kmeans_tpu.session.schema import to_plain
 

@@ -67,7 +67,11 @@ def dataset_to_document(
     x = np.asarray(x)
     labels = np.asarray(labels)
     n = min(len(x), max_cards)
-    used = sorted(set(labels[:n].tolist()))
+    # Negative labels mean "not a member of any cluster" (the trimmed
+    # family's outliers) and map to the reference's unassigned state —
+    # exactly how the teaching app expects its designated outliers to end
+    # up (/root/reference/app.mjs:214-215: left off every centroid zone).
+    used = sorted(l for l in set(labels[:n].tolist()) if l >= 0)
     if enforce_limit and len(used) > MAX_CENTROIDS:
         raise ValueError(
             f"{len(used)} clusters exceed the reference's cap of "
@@ -91,16 +95,20 @@ def dataset_to_document(
         pos = _normalize_positions(x[:n, :2].astype(np.float64))
         for i in range(n):
             cid = f"card:tpu-{i}"
+            lab = int(labels[i])
             doc.cards.append({
                 "id": cid,
                 "title": f"p{i}",
                 "traits": ["", ""],
-                "assignedTo": cent_ids[int(labels[i])],
+                "assignedTo": cent_ids[lab] if lab >= 0 else None,
                 "createdBy": "tpu",
             })
-            doc.meta[f"pos:{cid}"] = {
-                "x": float(pos[i, 0]), "y": float(pos[i, 1])
-            }
+            if lab >= 0:
+                # Unassigned cards carry no board position, matching the
+                # reference's unassign path (app.mjs:151-155: pos cleared).
+                doc.meta[f"pos:{cid}"] = {
+                    "x": float(pos[i, 0]), "y": float(pos[i, 1])
+                }
         doc.meta.setdefault("mode", "custom")
         doc.meta.setdefault("iteration", 0)
         doc._mutate()

@@ -265,3 +265,43 @@ def test_sweep_gap_criterion(capsys):
         "sweep", "--criterion", "gap", "--model", "gmm",
     ])
     assert rc == 2 and "requires --model lloyd" in err
+
+
+def test_train_trimmed_family(tmp_path, capsys):
+    out_json = str(tmp_path / "trimmed.json")
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "200", "--d", "2", "--k", "3", "--model", "trimmed",
+        "--trim-fraction", "0.05", "--max-iter", "20", "--out", out_json,
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "trimmed"
+    assert np.isfinite(res["inertia"])
+    doc = json.loads(open(out_json).read())
+    unassigned = [c for c in doc["cards"] if c["assignedTo"] is None]
+    assert len(unassigned) == 10  # 5% of 200: outliers export unassigned
+    # Unassigned cards carry no board position (reference unassign parity).
+    for c in unassigned:
+        assert f"pos:{c['id']}" not in doc["meta"]
+
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "200", "--d", "2", "--k", "3", "--model", "trimmed",
+        "--mesh", "4", "--max-iter", "20",
+    ])
+    assert rc in (0, None)
+    assert json.loads(out.splitlines()[0])["mode"] == "trimmed"
+
+
+def test_train_trim_fraction_requires_trimmed(capsys):
+    rc, _, err = _run(capsys, [
+        "train", "--n", "100", "--d", "2", "--k", "3",
+        "--trim-fraction", "0.1",
+    ])
+    assert rc == 2
+    assert "--model trimmed" in err
+    rc, _, err = _run(capsys, [
+        "train", "--n", "100", "--d", "2", "--k", "3", "--model", "trimmed",
+        "--trim-fraction", "1.5",
+    ])
+    assert rc == 2
+    assert "[0, 1)" in err

@@ -568,3 +568,33 @@ def test_static_js_contract():
     assert len(ids) >= 25, f"contract unexpectedly small: {len(ids)}"
     missing = [i for i in sorted(ids) if f'id="{i}"' not in html]
     assert not missing, f"app.js references missing element ids: {missing}"
+
+
+def test_train_op_trimmed_family(server):
+    """Trimmed fit via the train op: outliers land on the board as
+    UNASSIGNED cards (the reference's designated-outlier semantics)."""
+    buf = _train_and_collect(server, "TRIM",
+                             {"n": 200, "d": 2, "k": 3, "max_iter": 10,
+                              "model": "trimmed", "trim_fraction": 0.05})
+    assert b'"model": "trimmed"' in buf, buf[:500]
+    assert b"train_done" in buf
+    assert b"train_error" not in buf
+    _, _, body = _get(server, "/api/state?room=TRIM")
+    state = json.loads(body)
+    assert len(state["cards"]) == 200
+    assert state["unassigned"] == 10  # 5% of 200 trimmed as outliers
+
+    # knob validation: bad fraction is a clean 400
+    st, body = _mutate(server, "TRIM", "train",
+                       {"n": 100, "d": 2, "k": 3, "model": "trimmed",
+                        "trim_fraction": 1.5})
+    assert st == 400
+    assert "trim_fraction" in body["error"]
+
+
+def test_train_op_trim_fraction_requires_trimmed(server):
+    st, body = _mutate(server, "TRM2", "train",
+                       {"n": 100, "d": 2, "k": 3, "model": "lloyd",
+                        "trim_fraction": 0.3})
+    assert st == 400
+    assert "trimmed" in body["error"]

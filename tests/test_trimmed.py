@@ -1,0 +1,219 @@
+"""Trimmed k-means (k-means--) vs a NumPy oracle; robustness properties."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import TrimmedKMeans, fit_lloyd, fit_trimmed
+from kmeans_tpu.models.trimmed import resolve_n_trim
+
+
+def _oracle_trimmed(x, c0, m, max_iter=50, tol=1e-10):
+    """Textbook k-means-- in float64 NumPy: assign, drop the m farthest,
+    update from the rest (Chawla & Gionis 2012, alg. 1)."""
+    x = np.asarray(x, np.float64)
+    c = np.asarray(c0, np.float64).copy()
+    k = c.shape[0]
+    for _ in range(max_iter):
+        d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        labels = d2.argmin(1)
+        mind = d2.min(1)
+        # m largest, lowest-index tie-break (mergesort = stable on -mind).
+        order = np.argsort(-mind, kind="stable")
+        out = np.zeros(len(x), bool)
+        out[order[:m]] = True
+        new_c = c.copy()
+        for j in range(k):
+            sel = (labels == j) & ~out
+            if sel.any():
+                new_c[j] = x[sel].mean(0)
+        shift = ((new_c - c) ** 2).sum()
+        c = new_c
+        if shift <= tol:
+            break
+    d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    labels = d2.argmin(1)
+    mind = d2.min(1)
+    order = np.argsort(-mind, kind="stable")
+    out = np.zeros(len(x), bool)
+    out[order[:m]] = True
+    inertia = mind[~out].sum()
+    labels = np.where(out, -1, labels)
+    return c, labels, out, inertia
+
+
+CFG = KMeansConfig(k=3, init="given", chunk_size=64)
+
+
+def test_trimmed_matches_numpy_oracle(rng):
+    x = rng.normal(size=(200, 5)).astype(np.float32)
+    c0 = x[:3].copy()
+    state = fit_trimmed(jnp.asarray(x), 3, n_trim=10, init=jnp.asarray(c0),
+                        tol=1e-10, max_iter=50, config=CFG)
+    want_c, want_l, want_out, want_inertia = _oracle_trimmed(x, c0, 10)
+    np.testing.assert_allclose(np.asarray(state.centroids), want_c,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(state.labels), want_l)
+    np.testing.assert_array_equal(np.asarray(state.outlier_mask), want_out)
+    np.testing.assert_allclose(float(state.inertia), want_inertia,
+                               rtol=1e-4)
+    assert int(np.asarray(state.outlier_mask).sum()) == 10
+
+
+def test_zero_trim_is_plain_lloyd(rng):
+    x = rng.normal(size=(120, 4)).astype(np.float32)
+    c0 = x[:3].copy()
+    got = fit_trimmed(jnp.asarray(x), 3, n_trim=0, init=jnp.asarray(c0),
+                      tol=1e-10, max_iter=30, config=CFG)
+    want = fit_lloyd(jnp.asarray(x), 3, init=jnp.asarray(c0), tol=1e-10,
+                     max_iter=30, config=CFG)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids), rtol=1e-6)
+    assert not bool(np.asarray(got.outlier_mask).any())
+
+
+def test_outliers_do_not_drag_centroids():
+    """The defining property: far-away junk points land in the trim set
+    and leave the centroids where the clean blobs are."""
+    key = jax.random.key(0)
+    x, true_labels, _ = make_blobs(key, n=300, d=4, k=3, cluster_std=0.3)
+    x = np.asarray(x)
+    junk = np.full((6, 4), 500.0, np.float32) * np.sign(
+        np.random.default_rng(1).normal(size=(6, 4))
+    ).astype(np.float32)
+    xj = np.concatenate([x, junk])
+    c0 = x[:3].copy()
+
+    clean = fit_lloyd(jnp.asarray(x), 3, init=jnp.asarray(c0), config=CFG,
+                      max_iter=50)
+    robust = fit_trimmed(jnp.asarray(xj), 3, n_trim=6,
+                         init=jnp.asarray(c0), config=CFG, max_iter=50)
+    # Every junk row was trimmed…
+    mask = np.asarray(robust.outlier_mask)
+    assert mask[-6:].all()
+    assert mask.sum() == 6
+    # …and the centroids match a fit that never saw the junk.
+    got = np.sort(np.asarray(robust.centroids), axis=0)
+    want = np.sort(np.asarray(clean.centroids), axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_trim_fraction_resolution():
+    assert resolve_n_trim(200, trim_fraction=0.05, n_trim=None) == 10
+    assert resolve_n_trim(200, trim_fraction=None, n_trim=7) == 7
+    with pytest.raises(ValueError):
+        resolve_n_trim(200, trim_fraction=0.05, n_trim=7)
+    with pytest.raises(ValueError):
+        resolve_n_trim(200, trim_fraction=None, n_trim=None)
+    with pytest.raises(ValueError):
+        resolve_n_trim(200, trim_fraction=1.0, n_trim=None)
+    with pytest.raises(ValueError):
+        resolve_n_trim(200, trim_fraction=None, n_trim=200)
+
+
+def test_zero_weight_rows_never_trimmed(rng):
+    """Weight-0 rows (the padding idiom) must not eat the trim budget."""
+    x = rng.normal(size=(100, 3)).astype(np.float32)
+    x[:5] = 1e6  # would top any distance ranking
+    w = np.ones(100, np.float32)
+    w[:5] = 0.0
+    state = fit_trimmed(jnp.asarray(x), 3, n_trim=4, init="k-means++",
+                        key=jax.random.key(0), weights=jnp.asarray(w),
+                        config=KMeansConfig(k=3, chunk_size=64), max_iter=20)
+    assert not bool(np.asarray(state.outlier_mask)[:5].any())
+    assert int(np.asarray(state.outlier_mask).sum()) == 4
+
+
+def test_estimator_surface(rng):
+    x = rng.normal(size=(90, 4)).astype(np.float32)
+    tk = TrimmedKMeans(n_clusters=3, trim_fraction=0.1, seed=0,
+                       chunk_size=64).fit(x)
+    labels = np.asarray(tk.labels_)
+    assert (labels == -1).sum() == 9
+    assert np.asarray(tk.outlier_mask_).sum() == 9
+    assert tk.cluster_centers_.shape == (3, 4)
+    assert tk.inertia_ > 0
+    # predict never emits -1 (trimming is a fit-time concept).
+    pred = np.asarray(tk.predict(x))
+    assert pred.min() >= 0 and pred.max() < 3
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (4, 1), (2, 1)])
+def test_trimmed_sharded_matches_single_device(shape):
+    """DP-sharded trimmed fit equals single-device fit_trimmed exactly
+    (labels, outlier mask incl. tie-break, floats to tolerance)."""
+    from kmeans_tpu.parallel import cpu_mesh, fit_trimmed_sharded
+
+    x, _, _ = make_blobs(jax.random.key(21), 331, 6, 4, cluster_std=0.5)
+    x = np.array(x)
+    # Plant exact-duplicate far rows so the trim threshold has real TIES.
+    x[7] = x[130] = x[260] = 300.0
+    c0 = x[:4].copy()
+
+    want = fit_trimmed(jnp.asarray(x), 4, n_trim=2, init=jnp.asarray(c0),
+                       tol=1e-10, max_iter=25,
+                       config=KMeansConfig(k=4, init="given", chunk_size=64))
+    got = fit_trimmed_sharded(
+        x, 4, mesh=cpu_mesh(shape), n_trim=2, init=c0,
+        tol=1e-10, max_iter=25,
+        config=KMeansConfig(k=4, init="given", chunk_size=64),
+    )
+    np.testing.assert_array_equal(np.asarray(got.outlier_mask),
+                                  np.asarray(want.outlier_mask))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)
+    assert int(got.n_iter) == int(want.n_iter)
+    # The planted ties: only the 2 lowest-index duplicates are trimmed.
+    mask = np.asarray(got.outlier_mask)
+    assert mask[7] and mask[130] and not mask[260]
+
+
+def test_trimmed_sharded_big_m_weights():
+    """m larger than a shard's row count (m_loc capping) + sample weights."""
+    from kmeans_tpu.parallel import cpu_mesh, fit_trimmed_sharded
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(97, 4)).astype(np.float32)
+    w = rng.uniform(0.5, 1.5, 97).astype(np.float32)
+    c0 = x[:3].copy()
+    cfg = KMeansConfig(k=3, init="given", chunk_size=32)
+
+    want = fit_trimmed(jnp.asarray(x), 3, n_trim=40, init=jnp.asarray(c0),
+                       weights=jnp.asarray(w), tol=1e-10, max_iter=15,
+                       config=cfg)
+    got = fit_trimmed_sharded(
+        x, 3, mesh=cpu_mesh((8, 1)), n_trim=40, init=c0, weights=w,
+        tol=1e-10, max_iter=15, config=cfg,
+    )
+    np.testing.assert_array_equal(np.asarray(got.outlier_mask),
+                                  np.asarray(want.outlier_mask))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)
+
+
+def test_trimmed_sharded_zero_trim():
+    from kmeans_tpu.parallel import cpu_mesh, fit_trimmed_sharded
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    c0 = x[:3].copy()
+    cfg = KMeansConfig(k=3, init="given", chunk_size=32)
+    got = fit_trimmed_sharded(x, 3, mesh=cpu_mesh((4, 1)), n_trim=0,
+                              init=c0, tol=1e-10, max_iter=10, config=cfg)
+    want = fit_lloyd(jnp.asarray(x), 3, init=jnp.asarray(c0), tol=1e-10,
+                     max_iter=10, config=cfg)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    assert not bool(np.asarray(got.outlier_mask).any())

@@ -30,6 +30,7 @@ __version__ = "0.2.0"
 
 from kmeans_tpu.config import KMeansConfig, MeshConfig, RunConfig, ServeConfig
 from kmeans_tpu.models import (
+    BalancedKMeans,
     BisectingKMeans,
     FuzzyCMeans,
     GaussianMixture,
@@ -40,6 +41,7 @@ from kmeans_tpu.models import (
     MiniBatchKMeans,
     SphericalKMeans,
     TrimmedKMeans,
+    fit_balanced,
     fit_bisecting,
     fit_fuzzy,
     fit_gmm,
@@ -63,6 +65,7 @@ __all__ = [
     "MeshConfig",
     "RunConfig",
     "ServeConfig",
+    "BalancedKMeans",
     "BisectingKMeans",
     "FuzzyCMeans",
     "GaussianMixture",
@@ -73,6 +76,7 @@ __all__ = [
     "MiniBatchKMeans",
     "SphericalKMeans",
     "TrimmedKMeans",
+    "fit_balanced",
     "fit_bisecting",
     "fit_fuzzy",
     "fit_gmm",

@@ -169,7 +169,7 @@ def _cmd_train(args) -> int:
                      else 0.05)
 
     mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kernel",
-               "kmedoids", "trimmed")
+               "kmedoids", "trimmed", "balanced")
     if mesh is not None and model not in mesh_ok:
         print(
             f"error: --mesh supports --model {'/'.join(mesh_ok)}, "
@@ -183,7 +183,7 @@ def _cmd_train(args) -> int:
         return 2
 
     coreset_ok = ("lloyd", "accelerated", "spherical", "bisecting", "fuzzy",
-                  "gmm", "kernel", "kmedoids", "trimmed")
+                  "gmm", "kernel", "kmedoids", "trimmed", "balanced")
     fit_weights = None
     if args.coreset is not None:
         if args.coreset < 1:
@@ -245,6 +245,7 @@ def _cmd_train(args) -> int:
             "kernel": parallel.fit_kernel_kmeans_sharded,
             "kmedoids": parallel.fit_kmedoids_sharded,
             "trimmed": parallel.fit_trimmed_sharded,
+            "balanced": parallel.fit_balanced_sharded,
         }[model]
         fit_kw = ({"trim_fraction": trim_fraction}
                   if model == "trimmed" else {})
@@ -291,6 +292,7 @@ def _cmd_train(args) -> int:
             "kernel": models.fit_kernel_kmeans,
             "kmedoids": models.fit_kmedoids,
             "trimmed": models.fit_trimmed,
+            "balanced": models.fit_balanced,
             "xmeans": models.fit_xmeans,   # --k is k_max; k is discovered
             "gmeans": models.fit_gmeans,   # likewise (Anderson-Darling)
         }[model]
@@ -442,9 +444,11 @@ def main(argv=None) -> int:
                    "(named configs set it from BASELINE)")
     t.add_argument("--model", default=None, choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "gmm", "kernel", "kmedoids", "trimmed", "xmeans", "gmeans",
+        "fuzzy", "gmm", "kernel", "kmedoids", "trimmed", "balanced",
+        "xmeans", "gmeans",
     ], help="model family (default: lloyd, or the config's minibatch "
-            "choice); for xmeans/gmeans, --k is k_max and k is discovered")
+            "choice); for xmeans/gmeans, --k is k_max and k is discovered; "
+            "balanced enforces same-size clusters via Sinkhorn OT")
     t.add_argument("--trim-fraction", type=float, default=None,
                    help="--model trimmed: fraction of points excluded as "
                         "outliers each iteration (default 0.05); trimmed "
@@ -493,7 +497,7 @@ def main(argv=None) -> int:
     w.add_argument("--k-step", type=int, default=1)
     w.add_argument("--model", default="lloyd", choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "gmm", "kernel", "kmedoids",
+        "fuzzy", "gmm", "kernel", "kmedoids", "balanced",
     ])
     w.add_argument("--criterion", default="silhouette",
                    choices=["silhouette", "bic", "aic", "gap"],

@@ -2,6 +2,11 @@
 spherical (cosine), and initialization."""
 
 from kmeans_tpu.models.accelerated import fit_lloyd_accelerated
+from kmeans_tpu.models.balanced import (
+    BalancedKMeans,
+    BalancedState,
+    fit_balanced,
+)
 from kmeans_tpu.models.bisecting import BisectingKMeans, fit_bisecting
 from kmeans_tpu.models.fuzzy import (
     FuzzyCMeans,
@@ -79,6 +84,9 @@ def state_objective(state) -> float:
     return -float(state.log_likelihood)
 
 __all__ = [
+    "BalancedKMeans",
+    "BalancedState",
+    "fit_balanced",
     "BisectingKMeans",
     "FuzzyCMeans",
     "FuzzyState",

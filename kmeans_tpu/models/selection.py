@@ -32,6 +32,9 @@ _FITTERS = {
     "gmm": "fit_gmm",
     "kernel": "fit_kernel_kmeans",
     "kmedoids": "fit_kmedoids",
+    "balanced": "fit_balanced",
+    # trimmed is deliberately absent: its -1 outlier labels would poison
+    # the label-based scores, and the trim budget changes meaning with k.
 }
 
 

@@ -4,6 +4,7 @@ from kmeans_tpu.parallel.distributed import ensure_initialized, process_info
 from kmeans_tpu.parallel.kernel import fit_kernel_kmeans_sharded
 from kmeans_tpu.parallel.medoids import fit_kmedoids_sharded
 from kmeans_tpu.parallel.engine import (
+    fit_balanced_sharded,
     fit_fuzzy_sharded,
     fit_gmm_sharded,
     fit_lloyd_sharded,
@@ -17,6 +18,7 @@ from kmeans_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_from_config
 __all__ = [
     "ensure_initialized",
     "process_info",
+    "fit_balanced_sharded",
     "fit_fuzzy_sharded",
     "fit_gmm_sharded",
     "fit_kernel_kmeans_sharded",

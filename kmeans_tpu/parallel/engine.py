@@ -1119,6 +1119,208 @@ def fit_trimmed_sharded(
                         out_mask[:n])
 
 
+def _balanced_local_pass(x_loc, c, w_loc, log_a_loc, cap, epsilon, *,
+                         data_axis, compute_dtype, sweeps, with_labels):
+    """DP shard body for balanced (Sinkhorn-OT) k-means.
+
+    The row scaling is embarrassingly row-parallel; the column scaling
+    needs one global logsumexp over all rows per sweep, which shards
+    compose as a ``pmax`` (stabilizer) + ``psum`` (of shifted exps) pair —
+    the canonical distributed-logsumexp, and the whole collective story
+    of this family.  The centroid update is a local πᵀ@x matmul + psum.
+    """
+    from kmeans_tpu.ops.distance import pairwise_sq_dists
+
+    f32 = jnp.float32
+    k = c.shape[0]
+    log_b = jnp.log(cap)
+    inv_eps = 1.0 / epsilon
+    d2 = pairwise_sq_dists(x_loc, c, compute_dtype=compute_dtype).astype(f32)
+
+    def sweep(carry, _):
+        f, g = carry
+        f = epsilon * (
+            log_a_loc
+            - jax.nn.logsumexp((g[None, :] - d2) * inv_eps, axis=1)
+        )
+        col = (f[:, None] - d2) * inv_eps            # (n_loc, k)
+        m_loc = jnp.max(col, axis=0)
+        m = lax.pmax(m_loc, data_axis)
+        s = lax.psum(jnp.sum(jnp.exp(col - m[None, :]), axis=0), data_axis)
+        g = epsilon * (log_b - (m + jnp.log(s)))
+        return (f, g), None
+
+    (f, g), _ = lax.scan(
+        sweep,
+        (jnp.zeros(x_loc.shape[:1], f32), jnp.zeros((k,), f32)),
+        None, length=sweeps,
+    )
+    log_pi = (f[:, None] + g[None, :] - d2) * inv_eps
+    if with_labels:
+        labels = jnp.argmin(d2 - g[None, :], axis=1).astype(jnp.int32)
+        mind = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
+        inertia = lax.psum(jnp.sum(w_loc * mind), data_axis)
+        counts = lax.psum(
+            jnp.zeros((k,), f32).at[labels].add(w_loc), data_axis
+        )
+        col_masses = lax.psum(jnp.sum(jnp.exp(log_pi), axis=0), data_axis)
+        return inertia, counts, labels, col_masses
+    num = lax.psum(jnp.exp(log_pi).T @ x_loc.astype(f32), data_axis)
+    new_c = num / jnp.maximum(cap[:, None], 1e-38)
+    return (new_c,)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_balanced_run(mesh, data_axis, compute_dtype, sweeps, max_it):
+    local = functools.partial(
+        _balanced_local_pass, data_axis=data_axis,
+        compute_dtype=compute_dtype, sweeps=sweeps,
+    )
+    dspec = P(data_axis)
+    step = jax.shard_map(
+        functools.partial(local, with_labels=False), mesh=mesh,
+        in_specs=(dspec, P(), dspec, dspec, P(), P()),
+        out_specs=(P(),), check_vma=False,
+    )
+    final = jax.shard_map(
+        functools.partial(local, with_labels=True), mesh=mesh,
+        in_specs=(dspec, P(), dspec, dspec, P(), P()),
+        out_specs=(P(), P(), dspec, P()), check_vma=False,
+    )
+
+    @jax.jit
+    def run(x, w, log_a, c0, cap, eps, tol_v):
+        def cond(s):
+            c, it, shift_sq, done = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            c, it, _, _ = s
+            (new_c,) = step(x, c, w, log_a, cap, eps)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v)
+
+        c, n_iter, _, converged = lax.while_loop(
+            cond, body,
+            (c0.astype(jnp.float32), jnp.zeros((), jnp.int32),
+             jnp.asarray(jnp.inf, jnp.float32), jnp.zeros((), bool)),
+        )
+        inertia, counts, labels, col_masses = final(x, c, w, log_a, cap, eps)
+        return c, labels, inertia, n_iter, converged, counts, col_masses
+
+    return run
+
+
+@functools.partial(jax.jit, static_argnames=("compute_dtype",))
+def _mean_min_sq_dist(x, c0, w, *, compute_dtype):
+    """Same epsilon scale rule as models/balanced.py: mean NEAREST-seed
+    squared distance, padding rows excluded via the weight mask.
+    Module-level so the jit cache persists across fits (restart loops and
+    k-sweeps must not retrace it)."""
+    from kmeans_tpu.ops.distance import pairwise_sq_dists
+
+    d2 = pairwise_sq_dists(x, c0, compute_dtype=compute_dtype)
+    real = (w > 0).astype(jnp.float32)
+    return jnp.sum(jnp.min(d2, axis=1) * real) / jnp.sum(real)
+
+
+def fit_balanced_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    capacities=None,
+    epsilon: float = 0.5,
+    sinkhorn_sweeps: int = 200,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    weights=None,
+    data_axis: str = "data",
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+    normalize_epsilon: bool = True,
+):
+    """Balanced (Sinkhorn-OT) k-means on a device mesh (DP over points).
+
+    Splits the (n, k) transport plan across shards — the scale escape
+    hatch for :func:`kmeans_tpu.models.fit_balanced`'s materialization
+    gate.  Centroids, inertia and column masses match the single-device
+    fit to float tolerance; labels agree except on near-tie rows, where
+    ``argmin(d² − g)`` can flip because the distributed logsumexp
+    accumulates ``g`` in a different order (unlike the exact-reduction
+    families, OT label parity is to-tolerance, not bitwise).  Returns a
+    :class:`kmeans_tpu.models.balanced.BalancedState`.
+    """
+    from kmeans_tpu.models.balanced import (
+        BalancedState,
+        resolve_capacities,
+    )
+    from kmeans_tpu.ops.distance import pairwise_sq_dists
+
+    if epsilon <= 0:
+        raise ValueError(f"epsilon must be positive, got {epsilon}")
+    if sinkhorn_sweeps < 1:
+        raise ValueError(
+            f"sinkhorn_sweeps must be >= 1, got {sinkhorn_sweeps}"
+        )
+    cap = resolve_capacities(k, capacities)
+    cfg, key = resolve_fit_config(k, key, config)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes[data_axis]
+
+    if weights is not None and np.asarray(weights).shape != (x.shape[0],):
+        raise ValueError(
+            f"weights shape {np.asarray(weights).shape} != ({x.shape[0]},)"
+        )
+    x, w_host, n = _pad_rows(x, dp, weights=weights)
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+
+    # Normalized log row-masses on the host (padding rows get -inf and
+    # contribute to nothing), sharded alongside the rows.
+    wa = np.asarray(w_host, np.float64)
+    with np.errstate(divide="ignore"):
+        log_a_host = np.where(wa > 0, np.log(np.maximum(wa, 1e-300)),
+                              -np.inf)
+    log_a_host = log_a_host - np.log(wa.sum())
+    log_a = jax.device_put(jnp.asarray(log_a_host, jnp.float32),
+                           NamedSharding(mesh, P(data_axis)))
+
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, x.shape[1]):
+            raise ValueError(f"init centroids shape {c0.shape} != "
+                             f"{(k, x.shape[1])}")
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        c0 = init_centroids(
+            key, x, k, method=method, weights=w,
+            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        )
+    c0 = jax.device_put(c0, NamedSharding(mesh, P()))
+
+    eps_v = float(epsilon)
+    if normalize_epsilon:
+        eps_v = max(
+            eps_v * float(_mean_min_sq_dist(
+                x, c0, w, compute_dtype=cfg.compute_dtype,
+            )),
+            1e-12,
+        )
+
+    run = _build_balanced_run(
+        mesh, data_axis, cfg.compute_dtype, sinkhorn_sweeps,
+        max_iter if max_iter is not None else cfg.max_iter,
+    )
+    tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
+    c, labels, inertia, n_iter, converged, counts, col_masses = run(
+        x, w, log_a, c0, cap, jnp.asarray(eps_v, jnp.float32), tol_v
+    )
+    return BalancedState(c, labels[:n], inertia, n_iter, converged, counts,
+                         col_masses)
+
+
 def fit_fuzzy_sharded(
     x,
     k: int,

@@ -67,6 +67,7 @@ _TRAIN_FITS = {
     "kernel": "fit_kernel_kmeans",
     "kmedoids": "fit_kmedoids",
     "trimmed": "fit_trimmed",   # outliers come back as unassigned cards
+    "balanced": "fit_balanced",  # same-size clusters via Sinkhorn OT
     "xmeans": "fit_xmeans",     # k acts as k_max; BIC discovers the k
     "gmeans": "fit_gmeans",     # k_max likewise; Anderson-Darling test
 }
@@ -377,6 +378,16 @@ class KMeansServer:
         # (the endpoint exists for the teaching-game scale, n=500 d=2 k=3).
         if n * d > 8_000_000:
             raise ValueError("train shape too large: n*d must be <= 8e6")
+        if model == "balanced":
+            # Each outer iteration runs sinkhorn_sweeps (=200 default)
+            # O(n·k) log-domain sweeps (2 logsumexps each) on top of the
+            # distance matmul; hold it to the same 8e10 work budget the
+            # other heavy families are capped at.
+            if n * k * max_iter * 400 > 8e10:
+                raise ValueError(
+                    "balanced work too large: n·k·max_iter·400 must be "
+                    "<= 8e10"
+                )
         if model in ("xmeans", "gmeans"):
             # Worst case ~max_rounds·(2k split fits + 1 global fit) full-
             # array passes: ≈ 48·k·n·d·max_iter work units at the fit's

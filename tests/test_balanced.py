@@ -1,0 +1,209 @@
+"""Balanced (OT/Sinkhorn) k-means: oracle, balance properties, estimator."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import BalancedKMeans, fit_balanced, fit_lloyd
+from kmeans_tpu.models.balanced import (
+    resolve_capacities,
+    sinkhorn_potentials,
+)
+
+
+def _oracle_sinkhorn(d2, log_a, log_b, eps, sweeps):
+    """Log-domain Sinkhorn in float64 NumPy, row sweep then column sweep."""
+    d2 = np.asarray(d2, np.float64)
+    f = np.zeros(d2.shape[0])
+    g = np.zeros(d2.shape[1])
+
+    def lse(a, axis):
+        m = a.max(axis=axis, keepdims=True)
+        return (m + np.log(np.exp(a - m).sum(axis=axis, keepdims=True))
+                ).squeeze(axis)
+
+    for _ in range(sweeps):
+        f = eps * (log_a - lse((g[None, :] - d2) / eps, 1))
+        g = eps * (log_b - lse((f[:, None] - d2) / eps, 0))
+    return f, g
+
+
+def test_sinkhorn_potentials_match_numpy_oracle(rng):
+    d2 = rng.uniform(0, 4, size=(40, 5)).astype(np.float32)
+    log_a = np.full(40, -np.log(40.0), np.float32)
+    log_b = np.full(5, -np.log(5.0), np.float32)
+    f, g = sinkhorn_potentials(jnp.asarray(d2), jnp.asarray(log_a),
+                               jnp.asarray(log_b), epsilon=0.1, sweeps=50)
+    fw, gw = _oracle_sinkhorn(d2, log_a.astype(np.float64),
+                              log_b.astype(np.float64), 0.1, 50)
+    # Potentials are unique up to a constant shift; compare centered.
+    np.testing.assert_allclose(np.asarray(f) - np.mean(np.asarray(f)),
+                               fw - fw.mean(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g) - np.mean(np.asarray(g)),
+                               gw - gw.mean(), rtol=1e-4, atol=1e-4)
+    # Ending on the column sweep: column sums equal b exactly.
+    plan = np.exp((np.asarray(f)[:, None] + np.asarray(g)[None, :] - d2) / 0.1)
+    np.testing.assert_allclose(plan.sum(0), np.exp(log_b), rtol=1e-5)
+
+
+def test_balanced_equalizes_unequal_blobs():
+    """Three blobs with 300/80/20 points: Lloyd tracks the imbalance
+    (hard counts 300/80/20); the balanced fit spends two centroids on the
+    big blob and shrinks the reference's "balance gap" metric
+    (app.mjs:481-496: max−min cluster counts) by an order of magnitude."""
+    key = jax.random.key(3)
+    k1, k2, k3 = jax.random.split(key, 3)
+    blobs = [
+        np.asarray(jax.random.normal(k1, (300, 4))) * 0.4 + 0.0,
+        np.asarray(jax.random.normal(k2, (80, 4))) * 0.4 + 6.0,
+        np.asarray(jax.random.normal(k3, (20, 4))) * 0.4 - 6.0,
+    ]
+    x = np.concatenate(blobs).astype(np.float32)
+    cfg = KMeansConfig(k=3, chunk_size=128)
+
+    lloyd = fit_lloyd(jnp.asarray(x), 3, key=jax.random.key(0), config=cfg)
+    bal = fit_balanced(jnp.asarray(x), 3, key=jax.random.key(0), config=cfg)
+    lc = np.sort(np.asarray(lloyd.counts))
+    bc = np.sort(np.asarray(bal.counts))
+    assert lc[0] <= 30          # Lloyd keeps the tiny blob tiny
+    assert bc[0] >= 100         # balanced pulls every cluster toward n/k
+    assert bc[2] <= 160
+    # The reference's balance-gap metric improves by >3x.
+    assert (bc[2] - bc[0]) < (lc[2] - lc[0]) / 3
+    # Soft masses match the capacities exactly.
+    np.testing.assert_allclose(np.asarray(bal.col_masses),
+                               np.full(3, 1 / 3), rtol=1e-4)
+
+
+def test_capacities_respected():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 3)).astype(np.float32)
+    cap = [0.5, 0.3, 0.2]
+    st = fit_balanced(jnp.asarray(x), 3, capacities=cap,
+                      key=jax.random.key(1), epsilon=0.3,
+                      sinkhorn_sweeps=100,
+                      config=KMeansConfig(k=3, chunk_size=64))
+    np.testing.assert_allclose(np.asarray(st.col_masses), cap, rtol=1e-3)
+    # Hard counts approximate the capacities at small epsilon.
+    counts = np.asarray(st.counts)
+    np.testing.assert_allclose(counts / counts.sum(), cap, atol=0.06)
+
+
+def test_capacity_validation():
+    with pytest.raises(ValueError):
+        resolve_capacities(3, [0.5, 0.5])             # wrong shape
+    with pytest.raises(ValueError):
+        resolve_capacities(2, [1.0, 0.0])             # non-positive
+    got = resolve_capacities(2, [2.0, 6.0])
+    np.testing.assert_allclose(np.asarray(got), [0.25, 0.75])
+    got = resolve_capacities(4, None)
+    np.testing.assert_allclose(np.asarray(got), [0.25] * 4)
+
+
+def test_plan_gate_and_param_validation(rng):
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    with pytest.raises(ValueError):
+        fit_balanced(jnp.asarray(x), 3, epsilon=0.0)
+    with pytest.raises(ValueError):
+        fit_balanced(jnp.asarray(x), 3, sinkhorn_sweeps=0)
+    import kmeans_tpu.models.balanced as mod
+
+    old = mod._MAX_PLAN_ELEMENTS
+    try:
+        mod._MAX_PLAN_ELEMENTS = 100
+        with pytest.raises(ValueError, match="sharded"):
+            fit_balanced(jnp.asarray(x), 3)
+    finally:
+        mod._MAX_PLAN_ELEMENTS = old
+
+
+def test_weighted_balanced(rng):
+    """Mass balance is weighted: one heavy point counts as many light."""
+    x = rng.normal(size=(120, 3)).astype(np.float32)
+    w = np.ones(120, np.float32)
+    w[:10] = 5.0
+    st = fit_balanced(jnp.asarray(x), 3, weights=jnp.asarray(w),
+                      key=jax.random.key(2),
+                      sinkhorn_sweeps=50,
+                      config=KMeansConfig(k=3, chunk_size=64))
+    # Soft col masses stay the uniform capacities (of total MASS).
+    np.testing.assert_allclose(np.asarray(st.col_masses),
+                               np.full(3, 1 / 3), rtol=1e-3)
+    assert st.labels.shape == (120,)
+    assert float(st.inertia) > 0
+
+
+def test_estimator_surface(rng):
+    x = rng.normal(size=(90, 4)).astype(np.float32)
+    bk = BalancedKMeans(n_clusters=3, seed=0, chunk_size=64,
+                        sinkhorn_sweeps=60).fit(x)
+    counts = np.bincount(np.asarray(bk.labels_), minlength=3)
+    assert counts.min() >= 20 and counts.max() <= 40   # ~30 each
+    assert bk.cluster_centers_.shape == (3, 4)
+    assert np.isfinite(bk.inertia_)
+    pred = np.asarray(bk.predict(x[:7]))
+    assert pred.shape == (7,)
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (4, 1)])
+def test_balanced_sharded_matches_single_device(shape):
+    """DP-sharded balanced fit equals single-device fit_balanced (floats
+    to tolerance; labels agree here because this data has no near-ties —
+    in general OT labels can flip on ties, see fit_balanced_sharded)."""
+    from kmeans_tpu.parallel import cpu_mesh, fit_balanced_sharded
+
+    x, _, _ = make_blobs(jax.random.key(9), 203, 5, 3, cluster_std=0.8)
+    x = np.array(x)
+    c0 = x[:3].copy()
+    cfg = KMeansConfig(k=3, init="given", chunk_size=64)
+
+    want = fit_balanced(jnp.asarray(x), 3, init=jnp.asarray(c0),
+                        epsilon=1.0, sinkhorn_sweeps=40, tol=1e-10,
+                        max_iter=15, config=cfg)
+    got = fit_balanced_sharded(
+        x, 3, mesh=cpu_mesh(shape), init=c0, epsilon=1.0,
+        sinkhorn_sweeps=40, tol=1e-10, max_iter=15, config=cfg,
+    )
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(got.col_masses),
+                               np.asarray(want.col_masses),
+                               rtol=1e-3, atol=1e-4)
+    # n_iter is NOT asserted: at tol=1e-10 the shift² hovers at the
+    # stopping threshold and cross-shard accumulation order legitimately
+    # stops the loop a couple of steps apart; the fixed points agree.
+
+
+def test_balanced_sharded_weighted_and_capacities():
+    from kmeans_tpu.parallel import cpu_mesh, fit_balanced_sharded
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(150, 4)).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, 150).astype(np.float32)
+    cap = [0.5, 0.25, 0.25]
+    c0 = x[:3].copy()
+    cfg = KMeansConfig(k=3, init="given", chunk_size=64)
+
+    want = fit_balanced(jnp.asarray(x), 3, init=jnp.asarray(c0),
+                        weights=jnp.asarray(w), capacities=cap,
+                        epsilon=1.0, sinkhorn_sweeps=40, tol=1e-10,
+                        max_iter=10, config=cfg)
+    got = fit_balanced_sharded(
+        x, 3, mesh=cpu_mesh((8, 1)), init=c0, weights=w, capacities=cap,
+        epsilon=1.0, sinkhorn_sweeps=40, tol=1e-10, max_iter=10,
+        config=cfg,
+    )
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.col_masses), cap,
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)

@@ -305,3 +305,21 @@ def test_train_trim_fraction_requires_trimmed(capsys):
     ])
     assert rc == 2
     assert "[0, 1)" in err
+
+
+def test_train_balanced_family(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "200", "--d", "2", "--k", "4", "--model", "balanced",
+        "--max-iter", "20",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "balanced"
+    assert np.isfinite(res["inertia"])
+
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "200", "--d", "2", "--k", "4", "--model", "balanced",
+        "--mesh", "4", "--max-iter", "20",
+    ])
+    assert rc in (0, None)
+    assert json.loads(out.splitlines()[0])["mode"] == "balanced"

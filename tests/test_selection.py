@@ -230,3 +230,16 @@ def test_sweep_kernel_family_silhouette_only():
         assert "silhouette" in r
         assert "davies_bouldin" not in r   # center-based, skipped
     assert suggest_k(rows) == 3
+
+
+def test_sweep_balanced_family(rng):
+    import jax
+
+    from kmeans_tpu.data import make_blobs
+    from kmeans_tpu.models import suggest_k, sweep_k
+
+    x, _, _ = make_blobs(jax.random.key(11), 240, 4, 3, cluster_std=0.3)
+    rows = sweep_k(x, [2, 3, 4], model="balanced", max_iter=15)
+    assert [r["k"] for r in rows] == [2, 3, 4]
+    assert all("silhouette" in r for r in rows)
+    assert suggest_k(rows) == 3

@@ -598,3 +598,21 @@ def test_train_op_trim_fraction_requires_trimmed(server):
                         "trim_fraction": 0.3})
     assert st == 400
     assert "trimmed" in body["error"]
+
+
+def test_train_op_balanced_family(server):
+    buf = _train_and_collect(server, "BALA",
+                             {"n": 200, "d": 2, "k": 4, "max_iter": 10,
+                              "model": "balanced"})
+    assert b'"model": "balanced"' in buf, buf[:500]
+    assert b"train_done" in buf
+    assert b"train_error" not in buf
+
+
+def test_train_op_balanced_work_cap(server):
+    # n under the generic gates but n·k·max_iter·400 over the work budget.
+    st, body = _mutate(server, "BALW", "train",
+                       {"n": 80_000, "d": 2, "k": 100, "max_iter": 100,
+                        "model": "balanced"})
+    assert st == 400
+    assert "work too large" in body["error"]

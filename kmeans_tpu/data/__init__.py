@@ -1,12 +1,24 @@
 """Datasets: synthetic generators matching the BASELINE evaluation configs,
-plus data-reduction tools (lightweight coresets)."""
+plus data-reduction tools (lightweight coresets, PCA/whitening)."""
 
 from kmeans_tpu.data.coreset import lightweight_coreset
+from kmeans_tpu.data.preprocess import (
+    PCAState,
+    pca_fit,
+    pca_fit_stream,
+    pca_inverse_transform,
+    pca_transform,
+)
 from kmeans_tpu.data.synthetic import BENCH_CONFIGS, bench_config, make_blobs
 
 __all__ = [
     "BENCH_CONFIGS",
+    "PCAState",
     "bench_config",
     "lightweight_coreset",
     "make_blobs",
+    "pca_fit",
+    "pca_fit_stream",
+    "pca_inverse_transform",
+    "pca_transform",
 ]

@@ -1,0 +1,191 @@
+"""PCA / whitening preprocessing for the clustering engine.
+
+Standard practice for the high-d evaluation configs (CIFAR-10 raw pixels at
+d=3072, ImageNet features at d=2048 — BASELINE.md): project onto the top
+principal components, optionally whiten, then cluster in the reduced space.
+The reference app has no numeric analog (its "features" are trait tokens);
+this belongs to the numeric engine the north star adds.
+
+TPU-first design: the covariance is one xᵀ@x MXU matmul over chunked row
+tiles in ``compute_dtype`` with float32 accumulation (no (n, d) float32
+copy ever materializes); the eigendecomposition runs on the (d, d)
+covariance — d is a few thousand at most, so ``jnp.linalg.eigh`` (which
+XLA lowers well for symmetric matrices) is the whole cost.  The transform
+is one more matmul.  Everything is jit-compiled with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["PCAState", "pca_fit", "pca_fit_stream", "pca_transform",
+           "pca_inverse_transform"]
+
+
+class PCAState(NamedTuple):
+    """Fitted projection.  ``components`` rows are unit eigenvectors of
+    the covariance, sorted by decreasing ``explained_variance``."""
+
+    mean: jax.Array                 # (d,) float32
+    components: jax.Array           # (m, d) float32
+    explained_variance: jax.Array   # (m,) float32 (eigenvalues)
+    whiten: bool
+
+
+def _top_eigs(cov, n_components):
+    """Top-``n_components`` eigenpairs of a symmetric matrix, descending —
+    THE one copy shared by the in-memory and streamed fits."""
+    evals, evecs = jnp.linalg.eigh(cov)   # ascending
+    top = jnp.flip(evals[-n_components:])
+    comps = jnp.flip(evecs[:, -n_components:], axis=1).T
+    return comps, jnp.maximum(top, 0.0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_components", "chunk_size", "compute_dtype"),
+)
+def _pca_moments(x, *, n_components, chunk_size, compute_dtype):
+    from kmeans_tpu.ops.distance import chunk_tiles
+
+    n, d = x.shape
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    tiles, _, _ = chunk_tiles(x, None, chunk_size)
+
+    def body(carry, tile):
+        s, ss = carry
+        t = tile.astype(cd)
+        s = s + jnp.sum(tile.astype(f32), axis=0)
+        ss = ss + jnp.matmul(t.T, t, preferred_element_type=f32)
+        return (s, ss), None
+
+    (s, ss), _ = lax.scan(
+        body, (jnp.zeros((d,), f32), jnp.zeros((d, d), f32)), tiles
+    )
+    mean = s / n
+    cov = ss / n - jnp.outer(mean, mean)
+    comps, top = _top_eigs(cov, n_components)
+    return mean, comps, top
+
+
+def pca_fit(
+    x: jax.Array,
+    n_components: int,
+    *,
+    whiten: bool = False,
+    chunk_size: int = 8192,
+    compute_dtype: Optional[str] = None,
+) -> PCAState:
+    """Fit PCA on rows of ``x``: top ``n_components`` eigenvectors of the
+    covariance (computed as one chunked MXU matmul).
+
+    ``whiten=True`` rescales projected coordinates to unit variance —
+    equalizing feature importance before k-means, the usual recipe for
+    raw-pixel inputs.
+    """
+    x = jnp.asarray(x)
+    n, d = x.shape
+    if not 1 <= n_components <= min(n, d):
+        raise ValueError(
+            f"n_components must be in [1, {min(n, d)}], got {n_components}"
+        )
+    mean, comps, var = _pca_moments(
+        x, n_components=n_components, chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
+    )
+    return PCAState(mean, comps, var, whiten)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def _project(x, mean, comps, scale, *, chunk_size):
+    from kmeans_tpu.ops.distance import chunk_tiles
+
+    n, _ = x.shape
+    m = comps.shape[0]
+    tiles, _, _ = chunk_tiles(x, None, chunk_size)
+
+    def body(_, tile):
+        z = jnp.matmul(
+            tile.astype(jnp.float32) - mean, comps.T,
+            preferred_element_type=jnp.float32,
+        )
+        return None, z * scale
+
+    _, zs = lax.scan(body, None, tiles)
+    return zs.reshape(-1, m)[:n]
+
+
+def pca_transform(state: PCAState, x: jax.Array,
+                  *, chunk_size: int = 8192) -> jax.Array:
+    """Project rows onto the fitted components (whitening if fitted so).
+    Returns float32 (n, n_components)."""
+    x = jnp.asarray(x)
+    scale = (
+        1.0 / jnp.sqrt(jnp.maximum(state.explained_variance, 1e-12))
+        if state.whiten else jnp.ones((), jnp.float32)
+    )
+    return _project(x, state.mean, state.components, scale,
+                    chunk_size=chunk_size)
+
+
+def pca_inverse_transform(state: PCAState, z: jax.Array) -> jax.Array:
+    """Map projected coordinates back to the input space (the closest
+    rank-m reconstruction; exact when m == d).  Accepts (n, m) or a
+    single (m,) row — e.g. fitted centroids back into pixel space."""
+    z = jnp.asarray(z, jnp.float32)
+    if state.whiten:
+        z = z * jnp.sqrt(jnp.maximum(state.explained_variance, 1e-12))
+    return jnp.matmul(z, state.components,
+                      preferred_element_type=jnp.float32) + state.mean
+
+
+def pca_fit_stream(
+    data,
+    n_components: int,
+    *,
+    whiten: bool = False,
+    chunk_size: int = 65536,
+    compute_dtype: Optional[str] = None,
+) -> PCAState:
+    """Out-of-core :func:`pca_fit` over host/disk-resident rows (e.g. a
+    memory-mapped ``.npy``): one streamed pass accumulates the (d,) sum
+    and (d, d) second moment on device, then the same eigh as the
+    in-memory path.  Rows never fully materialize in RAM."""
+    from kmeans_tpu.data.stream import foreach_chunk
+
+    n, d = data.shape
+    if not 1 <= n_components <= min(n, d):
+        raise ValueError(
+            f"n_components must be in [1, {min(n, d)}], got {n_components}"
+        )
+    f32 = jnp.float32
+    carry = [jnp.zeros((d,), f32), jnp.zeros((d, d), f32)]
+
+    def step(xb, lo):
+        carry[0], carry[1] = _accumulate_moments(
+            carry[0], carry[1], xb, compute_dtype=compute_dtype,
+        )
+
+    foreach_chunk(data, chunk_size, step)
+    mean = carry[0] / n
+    cov = carry[1] / n - jnp.outer(mean, mean)
+    comps, top = _top_eigs(cov, n_components)
+    return PCAState(mean, comps, top, whiten)
+
+
+@functools.partial(jax.jit, static_argnames=("compute_dtype",))
+def _accumulate_moments(s, ss, xb, *, compute_dtype):
+    """One chunk's contribution to the streamed (sum, second-moment)
+    accumulators.  Module-level so the jit cache persists across calls."""
+    f32 = jnp.float32
+    t = (xb.astype(jnp.dtype(compute_dtype))
+         if compute_dtype is not None else xb)
+    return (
+        s + jnp.sum(xb.astype(f32), axis=0),
+        ss + jnp.matmul(t.T, t, preferred_element_type=f32),
+    )

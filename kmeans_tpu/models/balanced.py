@@ -221,9 +221,16 @@ def fit_balanced(
         # mean over all k seeds is dominated by cross-cluster distances
         # on separated data; an epsilon proportional to it blurs the plan
         # into the global mean and every centroid collapses there.)
+        # Zero-weight rows are excluded, matching the sharded front
+        # door's _mean_min_sq_dist so the two fits see the same epsilon.
         d2_0 = pairwise_sq_dists(x, c0, compute_dtype=cfg.compute_dtype)
-        eps_v = eps_v * float(jnp.mean(jnp.min(d2_0, axis=1)))
-        eps_v = max(eps_v, 1e-12)
+        mind = jnp.min(d2_0, axis=1)
+        if weights is not None:
+            real = (jnp.asarray(weights) > 0).astype(jnp.float32)
+            scale = float(jnp.sum(mind * real) / jnp.sum(real))
+        else:
+            scale = float(jnp.mean(mind))
+        eps_v = max(eps_v * scale, 1e-12)
     return _balanced_loop(
         x, c0, weights, log_b, cap,
         jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32),

@@ -323,3 +323,33 @@ def test_train_balanced_family(capsys):
     ])
     assert rc in (0, None)
     assert json.loads(out.splitlines()[0])["mode"] == "balanced"
+
+
+def test_train_pca_pipeline(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "400", "--d", "16", "--k", "3", "--pca", "4",
+        "--whiten", "--max-iter", "20",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["d"] == 4           # fitted in the projected space
+    assert res["mode"] == "lloyd"
+
+    # composes with --mesh and --coreset
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "400", "--d", "16", "--k", "3", "--pca", "4",
+        "--mesh", "4", "--max-iter", "10",
+    ])
+    assert rc in (0, None)
+    assert json.loads(out.splitlines()[0])["d"] == 4
+
+
+def test_train_pca_flag_validation(capsys):
+    rc, _, err = _run(capsys, [
+        "train", "--n", "100", "--d", "8", "--k", "3", "--whiten",
+    ])
+    assert rc == 2 and "--pca" in err
+    rc, _, err = _run(capsys, [
+        "train", "--n", "100", "--d", "8", "--k", "3", "--pca", "8",
+    ])
+    assert rc == 2 and "[1, 7]" in err

@@ -1,0 +1,128 @@
+"""PCA/whitening vs NumPy oracles; reconstruction and pipeline properties."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.data import (
+    make_blobs,
+    pca_fit,
+    pca_inverse_transform,
+    pca_transform,
+)
+
+
+def _oracle_pca(x, m):
+    x = np.asarray(x, np.float64)
+    mean = x.mean(0)
+    xc = x - mean
+    cov = xc.T @ xc / len(x)
+    evals, evecs = np.linalg.eigh(cov)
+    top = evals[::-1][:m]
+    comps = evecs[:, ::-1][:, :m].T
+    return mean, comps, top
+
+
+def test_pca_matches_numpy_oracle(rng):
+    x = rng.normal(size=(300, 12)).astype(np.float32)
+    x[:, 3] *= 5.0                      # one dominant direction
+    st = pca_fit(jnp.asarray(x), 4, chunk_size=64)
+    mean_w, comps_w, var_w = _oracle_pca(x, 4)
+    np.testing.assert_allclose(np.asarray(st.mean), mean_w,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(st.explained_variance), var_w,
+                               rtol=1e-3)
+    # Eigenvectors are sign-ambiguous: compare |dot| = 1 per component.
+    dots = np.abs(np.sum(np.asarray(st.components) * comps_w, axis=1))
+    np.testing.assert_allclose(dots, 1.0, atol=1e-3)
+
+
+def test_transform_matches_oracle_projection(rng):
+    x = rng.normal(size=(200, 8)).astype(np.float32)
+    st = pca_fit(jnp.asarray(x), 3, chunk_size=64)
+    z = np.asarray(pca_transform(st, jnp.asarray(x), chunk_size=64))
+    mean_w, comps_w, _ = _oracle_pca(x, 3)
+    want = (np.asarray(x, np.float64) - mean_w) @ comps_w.T
+    # Match up to per-component sign.
+    sign = np.sign(np.sum(z * want, axis=0))
+    np.testing.assert_allclose(z * sign, want, rtol=1e-3, atol=1e-3)
+    assert z.shape == (200, 3)
+
+
+def test_whiten_unit_variance(rng):
+    x = (rng.normal(size=(500, 10)) * rng.uniform(0.1, 8, 10)).astype(
+        np.float32
+    )
+    st = pca_fit(jnp.asarray(x), 5, whiten=True, chunk_size=128)
+    z = np.asarray(pca_transform(st, jnp.asarray(x), chunk_size=128))
+    np.testing.assert_allclose(z.var(axis=0), 1.0, rtol=5e-2)
+
+
+def test_full_rank_roundtrip(rng):
+    """m == d: inverse_transform reconstructs exactly (rank-d identity)."""
+    x = rng.normal(size=(100, 6)).astype(np.float32)
+    for whiten in (False, True):
+        st = pca_fit(jnp.asarray(x), 6, whiten=whiten, chunk_size=32)
+        z = pca_transform(st, jnp.asarray(x), chunk_size=32)
+        back = np.asarray(pca_inverse_transform(st, z))
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3)
+
+
+def test_truncated_reconstruction_error_is_residual_variance(rng):
+    x = rng.normal(size=(400, 10)).astype(np.float32)
+    st = pca_fit(jnp.asarray(x), 4, chunk_size=128)
+    z = pca_transform(st, jnp.asarray(x), chunk_size=128)
+    back = np.asarray(pca_inverse_transform(st, z))
+    mse = np.mean(np.sum((x - back) ** 2, axis=1))
+    _, _, all_var = _oracle_pca(x, 10)
+    np.testing.assert_allclose(mse, all_var[4:].sum(), rtol=1e-2)
+
+
+def test_pca_then_kmeans_pipeline():
+    """The intended use: project 64-d blobs to 4-d, cluster there, and
+    recover the true partition."""
+    from kmeans_tpu.models import fit_lloyd
+    from kmeans_tpu import metrics
+
+    x, true_labels, _ = make_blobs(jax.random.key(5), 600, 64, 4,
+                                   cluster_std=0.5)
+    st = pca_fit(x, 4, whiten=False)
+    z = pca_transform(st, x)
+    fit = fit_lloyd(z, 4, key=jax.random.key(0))
+    ari = metrics.adjusted_rand_index(np.asarray(true_labels),
+                                      np.asarray(fit.labels))
+    assert ari > 0.99
+    # Centroids map back to input space at the blob scale.
+    back = np.asarray(pca_inverse_transform(st, fit.centroids))
+    assert back.shape == (4, 64)
+
+
+def test_n_components_validation(rng):
+    x = rng.normal(size=(50, 8)).astype(np.float32)
+    with pytest.raises(ValueError):
+        pca_fit(jnp.asarray(x), 0)
+    with pytest.raises(ValueError):
+        pca_fit(jnp.asarray(x), 9)
+
+
+def test_pca_fit_stream_matches_in_memory(tmp_path, rng):
+    """Streamed moments over a memmap equal the in-memory fit."""
+    from kmeans_tpu.data import pca_fit_stream
+    from kmeans_tpu.data.stream import load_mmap
+
+    x = rng.normal(size=(700, 9)).astype(np.float32)
+    path = str(tmp_path / "x.npy")
+    np.save(path, x)
+    mm = load_mmap(path)
+
+    want = pca_fit(jnp.asarray(x), 3, chunk_size=128)
+    got = pca_fit_stream(mm, 3, chunk_size=128)
+    np.testing.assert_allclose(np.asarray(got.mean), np.asarray(want.mean),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.explained_variance),
+                               np.asarray(want.explained_variance),
+                               rtol=1e-4)
+    dots = np.abs(np.sum(np.asarray(got.components)
+                         * np.asarray(want.components), axis=1))
+    np.testing.assert_allclose(dots, 1.0, atol=1e-4)

@@ -73,9 +73,36 @@ def main():
     )
     assert int(gm.n_iter) == int(gm_want.n_iter)
 
+    # The round-2 robust family: the distributed top-m outlier selection
+    # (all_gather of candidate values + tie allocation) crosses the
+    # process boundary here.
+    from kmeans_tpu.models import fit_trimmed
+    from kmeans_tpu.parallel import fit_trimmed_sharded
+
+    tr = fit_trimmed_sharded(x, k, mesh=mesh, n_trim=6, init=c0,
+                             tol=1e-10, max_iter=6)
+    tr_want = fit_trimmed(x, k, n_trim=6, init=c0, tol=1e-10, max_iter=6)
+    np.testing.assert_allclose(
+        np.asarray(tr.counts), np.asarray(tr_want.counts), rtol=0, atol=0
+    )
+    np.testing.assert_allclose(
+        float(tr.inertia), float(tr_want.inertia), rtol=1e-5
+    )
+
+    # The balanced family: the Sinkhorn column scaling's pmax+psum
+    # (distributed logsumexp) rides the same cross-process collectives.
+    from kmeans_tpu.parallel import fit_balanced_sharded
+
+    bal = fit_balanced_sharded(x, k, mesh=mesh, init=c0, epsilon=0.5,
+                               sinkhorn_sweeps=30, max_iter=5)
+    np.testing.assert_allclose(
+        np.asarray(bal.col_masses), 1.0 / k, rtol=1e-3
+    )
+
     print(f"DCN_OK pid={pid} procs={info['process_count']} "
           f"devices={info['device_count']} inertia={float(got.inertia):.4f} "
-          f"gmm_ll={float(gm.log_likelihood):.4f}",
+          f"gmm_ll={float(gm.log_likelihood):.4f} "
+          f"trim_inertia={float(tr.inertia):.4f}",
           flush=True)
 
 

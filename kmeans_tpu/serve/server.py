@@ -325,8 +325,12 @@ class KMeansServer:
         if op == "autoAssign":
             from kmeans_tpu.session.schema import _js_safe
 
+            outliers = int(args.get("outliers", 0))
+            if not 0 <= outliers <= self.config.max_render_cards:
+                raise ValueError("outliers out of range")
             snap = auto_assign(doc, seed=int(args.get("seed", 0)),
-                               features=str(args.get("features", "traits")))
+                               features=str(args.get("features", "traits")),
+                               outliers=outliers)
             return {"metrics": _js_safe(snap)}
         if op == "train":
             return self._start_training(room, args)

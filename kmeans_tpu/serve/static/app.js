@@ -425,7 +425,9 @@ $id("shuffle").addEventListener("click", () => {
 });
 $id("shuffleUnassigned").addEventListener("click", () => mutate("shuffleUnassigned"));
 $id("restartAll").addEventListener("click", () => mutate("restartAll"));
-$id("tpuAssign").addEventListener("click", () => mutate("autoAssign"));
+$id("tpuAssign").addEventListener("click", () => mutate("autoAssign", {
+  outliers: Math.max(0, parseInt($id("trimOutliers").value, 10) || 0),
+}));
 $id("tpuTrain").addEventListener("click", () =>
   mutate("train", { n: 500, d: 2, k: 3, model: $id("trainModel").value }));
 $id("saveName").addEventListener("click", () => {

@@ -138,6 +138,7 @@ def auto_assign(
     *,
     seed: int = 0,
     features: str = "traits",
+    outliers: int = 0,
 ) -> dict:
     """Run the TPU assign step for the humans: fit k = len(centroids) on the
     document's cards and write assignments back.
@@ -147,10 +148,16 @@ def auto_assign(
     app.mjs:360 semantics in both directions: their cards keep their
     assignment AND no card is moved into them — clustering runs with
     k = number of *unlocked* centroids.  Returns the new metrics snapshot.
+
+    ``outliers`` > 0 runs the trimmed family (k-means--) instead of plain
+    Lloyd and leaves the ``outliers`` least-fitting cards UNASSIGNED —
+    automating what the teaching game expects humans to do with the
+    fixture's designated outliers (``seed:t10``/``t11``, app.mjs:214-215:
+    left off every centroid zone).
     """
     import jax
 
-    from kmeans_tpu.models import fit_lloyd
+    from kmeans_tpu.models import fit_lloyd, fit_trimmed
 
     unlocked = [c for c in doc.centroids if not c.get("locked")]
     k = len(unlocked)
@@ -168,15 +175,30 @@ def auto_assign(
 
     from kmeans_tpu.config import KMeansConfig
 
+    locked_ids = {c["id"] for c in doc.centroids if c.get("locked")}
     cfg = KMeansConfig(k=k, max_iter=50, chunk_size=max(64, len(doc.cards)))
-    state = fit_lloyd(x, k, key=jax.random.key(seed), config=cfg)
+    if outliers > 0:
+        # Locked-zone cards keep their assignment (the write-back below
+        # skips them), so they must not eat the outlier budget either:
+        # weight-0 rows are never nominated as outliers (trimmed.py).
+        w = np.array(
+            [0.0 if c.get("assignedTo") in locked_ids else 1.0
+             for c in doc.cards], np.float32,
+        )
+        m = min(int(outliers), max(int(w.sum()) - 1, 0))
+        state = fit_trimmed(x, k, n_trim=m, key=jax.random.key(seed),
+                            config=cfg, weights=w)
+    else:
+        state = fit_lloyd(x, k, key=jax.random.key(seed), config=cfg)
     labels = np.asarray(state.labels)
 
-    locked_ids = {c["id"] for c in doc.centroids if c.get("locked")}
     order = [c["id"] for c in unlocked]
     with doc.txn():
         for i, card in enumerate(doc.cards):
             if card.get("assignedTo") in locked_ids:
                 continue
-            doc.update_card_assign(card["id"], order[int(labels[i]) % k])
+            lab = int(labels[i])
+            doc.update_card_assign(
+                card["id"], None if lab < 0 else order[lab % k]
+            )
     return doc.snapshot()

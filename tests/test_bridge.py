@@ -87,3 +87,59 @@ def test_auto_assign_no_centroids_is_noop():
     snap = auto_assign(doc)
     assert snap["counts"] == {}
     assert doc.unassigned_count == 11
+
+
+def test_auto_assign_outliers_leaves_cards_unassigned():
+    """autoAssign with an outlier budget runs the trimmed family: the
+    least-fitting cards end UNASSIGNED (with pos cleared), the rest get
+    real assignments."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from kmeans_tpu.session.bridge import auto_assign
+    from kmeans_tpu.session.document import Document
+    from kmeans_tpu.session.seeds import populate_test_data
+
+    doc = Document(room="TRIM")
+    populate_test_data(doc)
+    for name in ("A", "B", "C"):
+        doc.add_centroid(name)
+    auto_assign(doc, seed=0, outliers=2)
+    unassigned = [c for c in doc.cards if c.get("assignedTo") is None]
+    assert len(unassigned) == 2
+    for c in unassigned:
+        assert f"pos:{c['id']}" not in doc.meta
+    assigned = [c for c in doc.cards if c.get("assignedTo") is not None]
+    cids = {c["id"] for c in doc.centroids}
+    assert all(c["assignedTo"] in cids for c in assigned)
+
+    # outliers=0 keeps the plain path: everything assigned.
+    auto_assign(doc, seed=0, outliers=0)
+    assert all(c.get("assignedTo") for c in doc.cards)
+
+
+def test_auto_assign_outliers_respects_locked_zone():
+    """A locked zone's cards keep their assignment even when the trimmed
+    fit would have marked them outliers (app.mjs:360 semantics)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from kmeans_tpu.session.bridge import auto_assign
+    from kmeans_tpu.session.document import Document
+    from kmeans_tpu.session.seeds import populate_test_data
+
+    doc = Document(room="TRML")
+    populate_test_data(doc)
+    locked = doc.add_centroid("Keep")
+    doc.add_centroid("A")
+    doc.add_centroid("B")
+    first = doc.cards[0]["id"]
+    doc.assign_card(first, locked["id"])
+    doc.set_locked(locked["id"], True)
+    auto_assign(doc, seed=0, outliers=3)
+    assert doc.get_card(first)["assignedTo"] == locked["id"]
+    # The locked card must not eat the outlier budget: exactly 3 of the
+    # UNLOCKED cards end unassigned.
+    unassigned = [c for c in doc.cards if c.get("assignedTo") is None]
+    assert len(unassigned) == 3
+    assert first not in {c["id"] for c in unassigned}

@@ -183,3 +183,92 @@ def test_streamed_families_layout_independence():
     g1 = fit_gmm_stream(x, 3, steps=15, batch_size=64, seed=4)
     g2 = fit_gmm_stream(xf, 3, steps=15, batch_size=64, seed=4)
     np.testing.assert_array_equal(np.asarray(g1.means), np.asarray(g2.means))
+
+
+def test_trimmed_translation_equivariance():
+    """Translating the data translates the trimmed fit: same labels, same
+    outlier set, shifted centroids."""
+    from kmeans_tpu.models import fit_trimmed
+
+    x, _, _ = make_blobs(jax.random.key(7), 300, 4, 3, cluster_std=0.5)
+    x = np.asarray(x)
+    c0 = x[:3].copy()
+    shift = np.asarray([7.0, -2.0, 1.5, 0.25], np.float32)
+
+    a = fit_trimmed(jnp.asarray(x), 3, n_trim=9, init=jnp.asarray(c0),
+                    tol=1e-10, max_iter=40)
+    t = fit_trimmed(jnp.asarray(x + shift), 3, n_trim=9,
+                    init=jnp.asarray(c0 + shift), tol=1e-10, max_iter=40)
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(t.labels))
+    np.testing.assert_array_equal(np.asarray(a.outlier_mask),
+                                  np.asarray(t.outlier_mask))
+    np.testing.assert_allclose(np.asarray(t.centroids),
+                               np.asarray(a.centroids) + shift,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_trimmed_inertia_monotone_in_budget():
+    """A larger trim budget can only lower the inlier inertia."""
+    from kmeans_tpu.models import fit_trimmed
+
+    x, _, _ = make_blobs(jax.random.key(8), 250, 4, 3, cluster_std=0.8)
+    x = np.asarray(x)
+    c0 = x[:3].copy()
+    prev = np.inf
+    for m in (0, 5, 15, 40):
+        st = fit_trimmed(jnp.asarray(x), 3, n_trim=m, init=jnp.asarray(c0),
+                         tol=1e-10, max_iter=40)
+        cur = float(st.inertia)
+        assert cur <= prev + 1e-4, (m, cur, prev)
+        prev = cur
+
+
+def test_balanced_permutation_equivariance():
+    """Permuting the rows permutes the balanced fit's labels and outputs
+    identical centroids/capacity masses (same init)."""
+    from kmeans_tpu.models import fit_balanced
+
+    x, _, _ = make_blobs(jax.random.key(9), 240, 5, 3, cluster_std=0.6)
+    x = np.asarray(x)
+    c0 = x[:3].copy()
+    perm = np.random.default_rng(1).permutation(len(x))
+
+    a = fit_balanced(jnp.asarray(x), 3, init=jnp.asarray(c0),
+                     sinkhorn_sweeps=60, tol=1e-10, max_iter=15)
+    b = fit_balanced(jnp.asarray(x[perm]), 3, init=jnp.asarray(c0),
+                     sinkhorn_sweeps=60, tol=1e-10, max_iter=15)
+    np.testing.assert_allclose(np.asarray(a.centroids),
+                               np.asarray(b.centroids),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(a.labels)[perm],
+                                  np.asarray(b.labels))
+    np.testing.assert_allclose(np.asarray(a.col_masses),
+                               np.asarray(b.col_masses), rtol=1e-4)
+
+
+def test_balanced_weight_vs_duplication():
+    """A row with weight 2 behaves like the row appearing twice (the OT
+    mass formulation makes this exact up to fp tolerance)."""
+    from kmeans_tpu.models import fit_balanced
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(80, 3)).astype(np.float32)
+    c0 = x[:3].copy()
+    w = np.ones(80, np.float32)
+    w[:8] = 2.0
+    xd = np.concatenate([x, x[:8]])
+
+    # Fixed absolute epsilon: the scale-free normalization averages the
+    # nearest-seed distance over ROWS, and the duplicated dataset has
+    # more rows — same mass, different mean — so only an absolute
+    # temperature makes the two formulations identical.
+    kw = dict(sinkhorn_sweeps=80, tol=1e-10, max_iter=10,
+              epsilon=1.0, normalize_epsilon=False)
+    a = fit_balanced(jnp.asarray(x), 3, init=jnp.asarray(c0),
+                     weights=jnp.asarray(w), **kw)
+    b = fit_balanced(jnp.asarray(xd), 3, init=jnp.asarray(c0), **kw)
+    np.testing.assert_allclose(np.asarray(a.centroids),
+                               np.asarray(b.centroids),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.col_masses),
+                               np.asarray(b.col_masses), rtol=1e-3)

@@ -11,7 +11,8 @@ Layout:
              kernel), distance kernels, centroid update + empty policies
   models/    model families (Lloyd plain/accelerated, minibatch,
              spherical, bisecting, fuzzy, Gaussian mixture, kernel
-             k-means + Nyström, k-medoids, x-means/g-means auto-k),
+             k-means + Nyström, k-medoids, trimmed/k-means--,
+             balanced/Sinkhorn-OT, x-means/g-means auto-k),
              seeding (k-means++/k-means||/random), selection (sweep,
              BIC/AIC, gap statistic), streaming fits, LloydRunner
   parallel/  mesh construction, shard_map engine (DP psum, TP pmin-argmin,
@@ -22,7 +23,8 @@ Layout:
   metrics.py numeric cluster quality (silhouette, DB/CH, ARI, NMI, HCV)
   session/   document model, metrics, export/import JSON (reference schema)
   serve/     HTTP/SSE shim + browser front-end
-  data/      synthetic datasets, lightweight coresets, host→device streaming
+  data/      synthetic datasets, lightweight coresets, PCA/whitening,
+             host→device streaming
   utils/     checkpointing, profiling, room codes
 """
 

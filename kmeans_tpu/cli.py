@@ -376,6 +376,11 @@ def _cmd_sweep(args) -> int:
         print("error: --criterion gap runs Lloyd fits against uniform "
               "reference data; it requires --model lloyd", file=sys.stderr)
         return 2
+    if args.criterion == "elbow" and \
+            len(range(args.k_min, args.k_max + 1, args.k_step)) < 3:
+        print("error: --criterion elbow needs at least 3 swept k values",
+              file=sys.stderr)
+        return 2
 
     if args.input:
         x = np.load(args.input)
@@ -524,9 +529,11 @@ def main(argv=None) -> int:
         "fuzzy", "gmm", "kernel", "kmedoids", "balanced",
     ])
     w.add_argument("--criterion", default="silhouette",
-                   choices=["silhouette", "bic", "aic", "gap"],
+                   choices=["silhouette", "bic", "aic", "gap", "elbow"],
                    help="suggestion rule; bic/aic need --model gmm, gap "
-                        "runs the Tibshirani gap statistic (--model lloyd)")
+                        "runs the Tibshirani gap statistic (--model "
+                        "lloyd), elbow is the objective kneedle read of "
+                        "the inertia curve (any model)")
     w.add_argument("--gap-refs", type=int, default=10,
                    help="reference datasets per k for --criterion gap")
     w.add_argument("--init", default="k-means++",

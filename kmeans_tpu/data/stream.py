@@ -174,4 +174,10 @@ def _prefetch_background(batches, depth, device):
             raise err[0]
     finally:
         stop.set()
+        # Bounded join: an abandoned producer must not keep running
+        # native code (device_put / the GIL-free gather) while the caller
+        # unwinds — a thread still inside native code at interpreter or
+        # test teardown is a use-after-free waiting to happen.  stop is
+        # polled every 0.1 s, so 2 s covers any exit path.
+        t.join(timeout=2.0)
 

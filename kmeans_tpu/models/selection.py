@@ -147,6 +147,10 @@ def suggest_k(rows: List[Dict], *, criterion: str = "silhouette") -> int:
     subjective elbow read.  ``criterion="bic"``/``"aic"`` pick the lowest
     information criterion (GMM sweeps), trading fit against parameter
     count model-theoretically instead of geometrically.
+    ``criterion="elbow"`` makes the subjective inertia-elbow read
+    objective instead (max distance below the normalized chord — the
+    kneedle construction); it works on any family's rows since every
+    state reports a lower-is-better objective.
     """
     if criterion == "silhouette":
         scored = [r for r in rows if "silhouette" in r]
@@ -160,7 +164,44 @@ def suggest_k(rows: List[Dict], *, criterion: str = "silhouette") -> int:
                 f"no rows carry {criterion!r} — sweep with model='gmm'"
             )
         return min(scored, key=lambda r: r[criterion])["k"]
+    if criterion == "elbow":
+        return _elbow_k(rows)
     raise ValueError(f"unknown criterion {criterion!r}")
+
+
+def _elbow_k(rows: List[Dict]) -> int:
+    """The classic elbow read, made objective (the kneedle idea,
+    Satopää et al. 2011): normalize the (k, objective) curve to the
+    unit square and pick the k farthest below the chord from the first
+    to the last point — the maximum-curvature point of a convex
+    decreasing curve.  The curve is read on a log axis when every
+    objective is positive (see inline comment), linearly otherwise.
+    Needs ≥ 3 rows; the endpoints can never win."""
+    import numpy as np
+
+    rows = sorted(rows, key=lambda r: r["k"])
+    if len(rows) < 3:
+        raise ValueError("criterion='elbow' needs at least 3 swept k values")
+    ks = np.asarray([r["k"] for r in rows], np.float64)
+    inert = np.asarray([r["inertia"] for r in rows], np.float64)
+    if (inert > 0).all():
+        # Log scale for inertia-like positive objectives: under-k fits
+        # leave cross-cluster variance that dwarfs later values, and on a
+        # linear axis the k past the biggest drop would always win.  A
+        # family whose objective can go non-positive (the GMM's negated
+        # log-likelihood) keeps the linear axis — log is undefined there
+        # and its curve is not multiplicative anyway.
+        inert = np.log(inert)
+    span = inert[0] - inert[-1]
+    if span <= 0:
+        # Flat or increasing objective: no elbow exists; smallest k wins
+        # (adding clusters buys nothing).
+        return int(ks[0])
+    t = (ks - ks[0]) / (ks[-1] - ks[0])
+    y = (inert - inert[-1]) / span          # 1 at k_min .. 0 at k_max
+    chord = 1.0 - t                          # straight line in the square
+    below = chord - y                        # >0 where the curve undercuts
+    return int(ks[int(np.argmax(below))])
 
 
 def gap_statistic(

@@ -28,8 +28,31 @@ try:
 except Exception:  # already initialized with cpu available — fall through
     pass
 
+# NOTE: do NOT enable jax's persistent compilation cache for this suite.
+# XLA:CPU's cached AOT executables round-trip with mismatched machine
+# features on this host ("Target machine feature +prefer-no-gather is not
+# supported...  could lead to execution errors such as SIGILL") — enabling
+# it produced deterministic wrong-result failures and a segfault at cache
+# load.  CPU persistent caching is experimental upstream; leave it off.
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Free compiled executables between test modules.
+
+    The full suite compiles many hundreds of XLA:CPU programs in one
+    process; past a certain accumulation the CPU JIT segfaults
+    intermittently inside backend_compile (observed repeatedly at a
+    near-fixed point in process lifetime — the crashing TEST shifted as
+    tests were added, the crash position didn't).  Dropping the caches
+    at module boundaries keeps the live-executable count bounded; the
+    per-module recompiles cost far less than the suite's fit runtime.
+    """
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="session")

@@ -184,8 +184,11 @@ def test_sweep_gmm_bic_recovers_k():
     assert suggest_k(rows, criterion="bic") == 3
     # bic exists even for k=1 (no silhouette there)
     assert "silhouette" not in rows[0] and "bic" in rows[0]
+    # elbow is a real criterion now (kneedle on the objective curve) and
+    # works on any family's rows; unknown names still raise.
+    assert suggest_k(rows, criterion="elbow") in (1, 2, 3, 4, 5)
     with pytest.raises(ValueError, match="criterion"):
-        suggest_k(rows, criterion="elbow")
+        suggest_k(rows, criterion="knee-jerk")
 
 
 def test_sweep_fuzzy_and_bic_requires_gmm():
@@ -243,3 +246,45 @@ def test_sweep_balanced_family(rng):
     assert [r["k"] for r in rows] == [2, 3, 4]
     assert all("silhouette" in r for r in rows)
     assert suggest_k(rows) == 3
+
+
+def test_suggest_k_elbow():
+    from kmeans_tpu.models.selection import _elbow_k
+    from kmeans_tpu.models import suggest_k
+
+    # Synthetic convex decreasing curve with a sharp elbow at k=4.
+    rows = [{"k": k, "inertia": v} for k, v in
+            [(2, 1000.0), (3, 600.0), (4, 200.0), (5, 180.0), (6, 165.0),
+             (7, 155.0)]]
+    assert suggest_k(rows, criterion="elbow") == 4
+    # Order-independent.
+    assert _elbow_k(list(reversed(rows))) == 4
+    # Straight line: no undercut anywhere beats the interior ties; the
+    # argmax lands on an interior point but a FLAT curve returns k_min.
+    flat = [{"k": k, "inertia": 10.0} for k in (2, 3, 4)]
+    assert _elbow_k(flat) == 2
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        _elbow_k(rows[:2])
+
+
+def test_suggest_k_elbow_on_real_sweep(rng):
+    import jax
+
+    from kmeans_tpu.data import make_blobs
+    from kmeans_tpu.models import suggest_k, sweep_k
+
+    x, _, _ = make_blobs(jax.random.key(12), 400, 6, 4, cluster_std=0.3)
+    rows = sweep_k(x, [2, 3, 4, 5, 6, 7], max_iter=30)
+    assert suggest_k(rows, criterion="elbow") == 4
+
+
+def test_suggest_k_elbow_negative_objectives():
+    """Families whose objective can go negative (GMM: −log-likelihood)
+    use the linear axis: no crash, and the knee is still found."""
+    from kmeans_tpu.models.selection import _elbow_k
+
+    rows = [{"k": k, "inertia": v} for k, v in
+            [(2, -10.0), (3, -50.0), (4, -70.0), (5, -75.0), (6, -78.0)]]
+    assert _elbow_k(rows) == 4

@@ -41,6 +41,7 @@ from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.medoids import KMedoids, KMedoidsState, fit_kmedoids
 from kmeans_tpu.models.gmeans import GMeans, anderson_darling_normal, fit_gmeans
+from kmeans_tpu.models.hierarchy import centroid_linkage, cut_linkage, merge_to_k
 from kmeans_tpu.models.xmeans import XMeans, bic_score, fit_xmeans
 from kmeans_tpu.models.runner import IterInfo, LloydRunner
 from kmeans_tpu.models.selection import (
@@ -115,6 +116,9 @@ __all__ = [
     "fit_kernel_kmeans",
     "kernel_assign",
     "nystrom_features",
+    "centroid_linkage",
+    "cut_linkage",
+    "merge_to_k",
     "fit_bisecting",
     "fit_fuzzy",
     "fuzzy_memberships",

@@ -1,0 +1,136 @@
+"""Centroid dendrogram vs the scipy oracle; weighted merges; drill-down."""
+
+import jax
+import numpy as np
+import pytest
+
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import (
+    centroid_linkage,
+    cut_linkage,
+    fit_lloyd,
+    merge_to_k,
+)
+
+
+def _partitions_equal(a, b):
+    """Same set partition regardless of label numbering."""
+    a, b = np.asarray(a), np.asarray(b)
+    return len(set(zip(a.tolist(), b.tolist()))) == len(set(a.tolist())) \
+        == len(set(b.tolist()))
+
+
+@pytest.mark.parametrize("method", ["ward", "average", "single", "complete"])
+def test_unit_weight_linkage_matches_scipy(rng, method):
+    """On raw points with unit weights, every linkage method reproduces
+    scipy.cluster.hierarchy exactly: same heights, same partitions at
+    every cut level."""
+    from scipy.cluster.hierarchy import fcluster, linkage
+
+    x = rng.normal(size=(40, 5))
+    got = centroid_linkage(x, method=method)
+    want = linkage(x, method=method)
+    np.testing.assert_allclose(np.sort(got[:, 2]), np.sort(want[:, 2]),
+                               rtol=1e-8)
+    for k in (2, 3, 5, 10, 25):
+        ours = cut_linkage(got, k)
+        theirs = fcluster(want, k, criterion="maxclust")
+        assert _partitions_equal(ours, theirs), (method, k)
+
+
+def test_weighted_ward_respects_sizes():
+    """Heavy centers resist merging: weighting flips which pair merges
+    first relative to pure geometry."""
+    cents = np.array([[0.0, 0.0], [2.0, 0.0], [3.5, 0.0]])
+    # gaps: (0,1)=2, (1,2)=1.5 — unweighted merges (1,2) first.
+    Z_unw = centroid_linkage(cents, method="ward")
+    assert {int(Z_unw[0, 0]), int(Z_unw[0, 1])} == {1, 2}
+    # With n=(1, 1e6, 1e6): ward cost of (1,2) ~ sqrt(1e6)·1.5 explodes,
+    # while attaching the singleton to center 1 stays ~sqrt(2)·2.
+    Z_w = centroid_linkage(cents, counts=[1, 1e6, 1e6], method="ward")
+    assert {int(Z_w[0, 0]), int(Z_w[0, 1])} == {0, 1}
+
+
+def test_ward_heights_monotone(rng):
+    x = rng.normal(size=(60, 4))
+    Z = centroid_linkage(x, method="ward")
+    heights = Z[:, 2]
+    assert (np.diff(heights) >= -1e-9).all()
+    # Leaf counts: the last merge spans all leaves.
+    assert Z[-1, 3] == 60
+
+
+def test_cut_linkage_validation(rng):
+    Z = centroid_linkage(rng.normal(size=(10, 3)))
+    assert len(set(cut_linkage(Z, 1).tolist())) == 1
+    assert len(set(cut_linkage(Z, 10).tolist())) == 10
+    with pytest.raises(ValueError):
+        cut_linkage(Z, 0)
+    with pytest.raises(ValueError):
+        cut_linkage(Z, 11)
+    with pytest.raises(ValueError):
+        centroid_linkage(rng.normal(size=(1, 3)))
+    with pytest.raises(ValueError):
+        centroid_linkage(rng.normal(size=(4, 3)), counts=[1, 2, 3])
+
+
+def test_merge_to_k_recovers_coarse_structure():
+    """Fit k=12 on 4 well-separated blobs, merge to 4: the merged labels
+    equal the generating partition, and merged centers sit at the blob
+    means."""
+    x, true_labels, gen_centers = make_blobs(
+        jax.random.key(4), 800, 6, 4, cluster_std=0.3
+    )
+    st = fit_lloyd(x, 12, key=jax.random.key(0), max_iter=50)
+    labels4, centers4 = merge_to_k(st, 4)
+    from kmeans_tpu import metrics
+
+    ari = metrics.adjusted_rand_index(np.asarray(true_labels), labels4)
+    assert ari > 0.99
+    # Merged centers match the empirical blob means (up to ordering).
+    emp = np.stack([
+        np.asarray(x)[np.asarray(true_labels) == j].mean(0) for j in range(4)
+    ])
+    got = centers4[np.argsort(centers4[:, 0])]
+    emp = emp[np.argsort(emp[:, 0])]
+    np.testing.assert_allclose(got, emp, rtol=1e-2, atol=5e-2)
+
+
+def test_merge_to_k_passes_outliers_through():
+    """Trimmed fits carry -1 labels; merging must keep them -1."""
+    from kmeans_tpu.models import fit_trimmed
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    x[:4] = 50.0
+    st = fit_trimmed(x, 8, n_trim=4, key=jax.random.key(1), max_iter=30)
+    labels3, centers3 = merge_to_k(st, 3)
+    assert (labels3[np.asarray(st.outlier_mask)] == -1).all()
+    assert centers3.shape == (3, 4)
+    assert labels3[~np.asarray(st.outlier_mask)].min() >= 0
+
+
+def test_shared_linkage_cut_at_many_levels(rng):
+    """One linkage, many cuts — nested partitions (a refinement chain)."""
+    x, _, _ = make_blobs(jax.random.key(6), 300, 4, 3, cluster_std=0.4)
+    st = fit_lloyd(x, 10, key=jax.random.key(0), max_iter=40)
+    Z = centroid_linkage(np.asarray(st.centroids), np.asarray(st.counts))
+    prev = None
+    for k in (8, 5, 3, 2):
+        labels, _ = merge_to_k(st, k, linkage=Z)
+        if prev is not None:
+            # Coarser cut = merge of the finer one: each finer cluster
+            # maps into exactly one coarser cluster.
+            pairs = set(zip(prev.tolist(), labels.tolist()))
+            assert len(pairs) == len(set(prev.tolist()))
+        prev = labels
+
+
+def test_empty_cluster_centers_merge_for_free():
+    """The default empty="keep" policy leaves zero-count centers in the
+    state; linkage must accept them (vanishing weight, cheap merges)."""
+    cents = np.array([[0.0, 0.0], [10.0, 0.0], [5.0, 5.0]])
+    Z = centroid_linkage(cents, counts=[100.0, 100.0, 0.0], method="ward")
+    # The empty center merges FIRST despite being geometrically farthest
+    # from both others.
+    assert 2 in (int(Z[0, 0]), int(Z[0, 1]))

@@ -72,6 +72,19 @@ def state_centers(state):
     return None
 
 
+def state_counts(state):
+    """The per-cluster size/mass array of any family's fit state, or
+    ``None`` when the family doesn't report one.  THE one copy of the
+    field-name mapping (counts / resp_counts) — companion to
+    :func:`state_centers`, used by the dendrogram merge; a new family's
+    state shape only has to be taught here."""
+    for attr in ("counts", "resp_counts"):
+        arr = getattr(state, attr, None)
+        if arr is not None:
+            return arr
+    return None
+
+
 def state_objective(state) -> float:
     """One lower-is-better scalar for any family's fit state: hard
     families report inertia, fuzzy/kernel their objective J, the GMM its
@@ -141,6 +154,7 @@ __all__ = [
     "gap_statistic",
     "suggest_k_gap",
     "state_centers",
+    "state_counts",
     "state_objective",
     "suggest_k",
     "sweep_k",

@@ -170,8 +170,18 @@ def merge_to_k(
     through unchanged), and the (k, d) size-weighted merged centers.
     Pass a precomputed ``linkage`` to cut the same tree at many levels.
     """
-    counts = np.asarray(state.counts, np.float64)
-    cents = np.asarray(state.centroids, np.float64)
+    from kmeans_tpu.models import state_centers, state_counts
+
+    cents = state_centers(state)
+    if cents is None:
+        raise ValueError(
+            "state has no center array to merge (center-free family)"
+        )
+    counts = state_counts(state)
+    if counts is None:
+        raise ValueError("state has no per-cluster counts to weight by")
+    counts = np.asarray(counts, np.float64)
+    cents = np.asarray(cents, np.float64)
     if linkage is None:
         linkage = centroid_linkage(cents, counts, method=method)
     leaf_to_merged = cut_linkage(linkage, k)

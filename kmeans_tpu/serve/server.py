@@ -450,11 +450,27 @@ class KMeansServer:
                               if model == "trimmed" else {})
                     state = fit(x, k, key=jax.random.key(seed + 1),
                                 config=kcfg, **fit_kw)
-                if d >= 2 and k <= MAX_CENTROIDS:
+                board_labels = np.asarray(state.labels)
+                fitted_k = _state_k(state)
+                if d >= 2 and fitted_k > MAX_CENTROIDS and \
+                        models.state_centers(state) is not None and \
+                        models.state_counts(state) is not None:
+                    # A k>3 fit still reaches the board: merge the fitted
+                    # centers down the size-weighted ward dendrogram to
+                    # the reference's 3-centroid cap (app.mjs:127) for
+                    # the VISUALIZATION; train_done reports the real k.
+                    # (Center-free kernel fits can't merge — they skip
+                    # the board exactly as before.)
+                    from kmeans_tpu.models import merge_to_k
+
+                    board_labels, _ = merge_to_k(state, MAX_CENTROIDS)
+                if d >= 2 and np.unique(
+                        board_labels[board_labels >= 0]).size \
+                        <= MAX_CENTROIDS:
                     from kmeans_tpu.session.schema import to_plain
 
                     viz = dataset_to_document(
-                        np.asarray(x), np.asarray(state.labels),
+                        np.asarray(x), board_labels,
                         room=room.code,
                         max_cards=self.config.max_render_cards,
                     )

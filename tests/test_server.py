@@ -616,3 +616,35 @@ def test_train_op_balanced_work_cap(server):
                         "model": "balanced"})
     assert st == 400
     assert "work too large" in body["error"]
+
+
+def test_train_op_large_k_merges_to_board(server):
+    """A k>3 train-demo result reaches the board via the ward merge of
+    its fitted centers: the board shows <=3 centroids while train_done
+    reports the real fitted k."""
+    buf = _train_and_collect(server, "MRGE",
+                             {"n": 200, "d": 2, "k": 8, "max_iter": 15,
+                              "model": "accelerated"})
+    assert b"train_done" in buf, buf[:500]
+    done = next(json.loads(line[len(b"data: "):])
+                for line in buf.split(b"\n")
+                if line.startswith(b"data: ") and b"train_done" in line)
+    assert done["k"] == 8
+    _, _, body = _get(server, "/api/state?room=MRGE")
+    state = json.loads(body)
+    assert len(state["cards"]) == 200
+    assert 1 <= len(state["centroids"]) <= 3
+    assert state["unassigned"] == 0
+
+
+def test_train_op_gmm_large_k_merges_to_board(server):
+    """The GMM's counts live in resp_counts — the state_counts mapping
+    lets its k>3 results merge onto the board too."""
+    buf = _train_and_collect(server, "MRGG",
+                             {"n": 150, "d": 2, "k": 5, "max_iter": 10,
+                              "model": "gmm"})
+    assert b"train_done" in buf, buf[:500]
+    _, _, body = _get(server, "/api/state?room=MRGG")
+    state = json.loads(body)
+    assert len(state["cards"]) == 150
+    assert 1 <= len(state["centroids"]) <= 3

@@ -90,6 +90,21 @@ def _cmd_train(args) -> int:
             jax.random.key(seed_v), n, d, k, cluster_std=args.cluster_std
         )
 
+    if args.merge_k is not None:
+        # Statically-knowable --merge-k mistakes fail before the fit
+        # (the auto-k upper bound is re-checked after, against the
+        # discovered k).
+        if model == "kernel":
+            print("error: --merge-k needs a center-based fit; kernel "
+                  "k-means has no input-space centers", file=sys.stderr)
+            return 2
+        if args.merge_k < 1:
+            print("error: --merge-k must be >= 1", file=sys.stderr)
+            return 2
+        if model not in ("xmeans", "gmeans") and args.merge_k >= k:
+            print(f"error: --merge-k must be in [1, {k - 1}] for --k {k}",
+                  file=sys.stderr)
+            return 2
     if args.whiten and args.pca is None:
         print("error: --whiten requires --pca", file=sys.stderr)
         return 2
@@ -325,6 +340,23 @@ def _cmd_train(args) -> int:
             k = int(state.centroids.shape[0])
     jax_done = time.perf_counter() - t0
 
+    export_labels = state.labels
+    merged_k = None
+    if args.merge_k is not None:
+        fitted_k = int(models.state_centers(state).shape[0])
+        if fitted_k < 2:
+            print("error: --merge-k: this fit has only 1 center; "
+                  "nothing to merge", file=sys.stderr)
+            return 2
+        if args.merge_k >= fitted_k:
+            print(f"error: --merge-k must be in [1, {fitted_k - 1}] "
+                  "for this fit", file=sys.stderr)
+            return 2
+        from kmeans_tpu.models import merge_to_k
+
+        export_labels, _ = merge_to_k(state, args.merge_k)
+        merged_k = args.merge_k
+
     # One "inertia" field, lower = better for every family, so sweep
     # tooling can compare runs uniformly (shared mapping with the serve
     # train_done event).
@@ -341,16 +373,19 @@ def _cmd_train(args) -> int:
         result["stream"] = True
     if args.coreset is not None:
         result["coreset"] = args.coreset
+    if merged_k is not None:
+        result["merged_k"] = merged_k
     print(json.dumps(result))
 
     if args.out:
         # Only the first max_cards rows are exported — slice before
         # np.asarray so a --stream memmap never fully materializes.
+        k_eff = merged_k if merged_k is not None else k
         doc = dataset_to_document(
             np.asarray(x[:args.max_cards]),
-            np.asarray(state.labels[:args.max_cards]),
+            np.asarray(export_labels[:args.max_cards]),
             max_cards=args.max_cards,
-            enforce_limit=k <= 3,
+            enforce_limit=k_eff <= 3,
         )
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(export_json(doc))
@@ -489,6 +524,10 @@ def main(argv=None) -> int:
     t.add_argument("--coreset", type=int, default=None,
                    help="reduce the data to an M-point lightweight coreset "
                         "(Bachem et al. 2018) and run the fit weighted")
+    t.add_argument("--merge-k", type=int, default=None,
+                   help="after fitting, merge the centers down the "
+                        "size-weighted ward dendrogram to this coarser k "
+                        "for the result labels/export (no re-fit)")
     t.add_argument("--pca", type=int, default=None,
                    help="project onto the top N principal components "
                         "before fitting (composes with --coreset/--mesh)")

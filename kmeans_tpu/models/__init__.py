@@ -74,15 +74,24 @@ def state_centers(state):
 
 def state_counts(state):
     """The per-cluster size/mass array of any family's fit state, or
-    ``None`` when the family doesn't report one.  THE one copy of the
-    field-name mapping (counts / resp_counts) — companion to
-    :func:`state_centers`, used by the dendrogram merge; a new family's
-    state shape only has to be taught here."""
+    ``None`` when it cannot be determined.  THE one copy of the
+    field-name mapping (counts / resp_counts, with a label-histogram
+    fallback for states that carry labels but no counts field, e.g.
+    k-medoids) — companion to :func:`state_centers`, used by the
+    dendrogram merge; a new family's state shape only has to be taught
+    here."""
+    import numpy as np
+
     for attr in ("counts", "resp_counts"):
         arr = getattr(state, attr, None)
         if arr is not None:
             return arr
-    return None
+    centers = state_centers(state)
+    labels = getattr(state, "labels", None)
+    if centers is None or labels is None:
+        return None
+    labels = np.asarray(labels)
+    return np.bincount(labels[labels >= 0], minlength=centers.shape[0])
 
 
 def state_objective(state) -> float:

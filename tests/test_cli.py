@@ -353,3 +353,38 @@ def test_train_pca_flag_validation(capsys):
         "train", "--n", "100", "--d", "8", "--k", "3", "--pca", "8",
     ])
     assert rc == 2 and "[1, 7]" in err
+
+
+def test_train_merge_k(tmp_path, capsys):
+    out_json = str(tmp_path / "merged.json")
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "200", "--d", "2", "--k", "8", "--max-iter", "20",
+        "--merge-k", "3", "--out", out_json,
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["k"] == 8 and res["merged_k"] == 3
+    doc = json.loads(open(out_json).read())
+    assert len(doc["centroids"]) <= 3   # board-compatible export
+
+    rc, _, err = _run(capsys, [
+        "train", "--n", "100", "--d", "2", "--k", "3", "--model", "kernel",
+        "--max-iter", "10", "--merge-k", "2",
+    ])
+    assert rc == 2 and "center-based" in err
+    rc, _, err = _run(capsys, [
+        "train", "--n", "100", "--d", "2", "--k", "3", "--merge-k", "3",
+    ])
+    assert rc == 2 and "--merge-k must be" in err
+
+
+def test_train_merge_k_kmedoids(capsys):
+    """KMedoidsState has no counts field; state_counts derives them from
+    the labels, so exemplar fits merge too."""
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "150", "--d", "2", "--k", "6", "--model",
+        "kmedoids", "--max-iter", "15", "--merge-k", "2",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "kmedoids" and res["merged_k"] == 2

@@ -648,3 +648,16 @@ def test_train_op_gmm_large_k_merges_to_board(server):
     state = json.loads(body)
     assert len(state["cards"]) == 150
     assert 1 <= len(state["centroids"]) <= 3
+
+
+def test_train_op_kmedoids_large_k_merges_to_board(server):
+    """KMedoids carries no counts field — the state_counts label
+    histogram fallback lets its k>3 results merge onto the board."""
+    buf = _train_and_collect(server, "MRGM",
+                             {"n": 120, "d": 2, "k": 5, "max_iter": 8,
+                              "model": "kmedoids"})
+    assert b"train_done" in buf, buf[:500]
+    _, _, body = _get(server, "/api/state?room=MRGM")
+    state = json.loads(body)
+    assert len(state["cards"]) == 120
+    assert 1 <= len(state["centroids"]) <= 3

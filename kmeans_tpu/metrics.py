@@ -27,7 +27,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from kmeans_tpu.ops.distance import matmul_precision, sq_norms
+from kmeans_tpu.ops.distance import (
+    matmul_precision,
+    pairwise_sq_dists,
+    sq_norms,
+)
 
 __all__ = [
     "silhouette_score",
@@ -37,6 +41,8 @@ __all__ = [
     "adjusted_rand_index",
     "normalized_mutual_info",
     "homogeneity_completeness_v",
+    "fowlkes_mallows_index",
+    "dunn_index",
 ]
 
 
@@ -326,3 +332,71 @@ def homogeneity_completeness_v(labels_true, labels_pred):
     com = jnp.where(h_b <= 0, 1.0, mi / h_b)
     v = jnp.where(hom + com <= 0, 0.0, 2.0 * hom * com / (hom + com))
     return {"homogeneity": hom, "completeness": com, "v_measure": v}
+
+
+def fowlkes_mallows_index(labels_a, labels_b) -> jax.Array:
+    """Fowlkes–Mallows index: geometric mean of pairwise precision and
+    recall between two labelings (1 = identical partitions, → 0 for
+    independent ones).  Same O(n + ka·kb) contingency reduction as ARI —
+    nothing pairwise is ever materialized.
+    """
+    la = jnp.asarray(labels_a, jnp.int32)
+    lb = jnp.asarray(labels_b, jnp.int32)
+    ka = int(jnp.max(la)) + 1
+    kb = int(jnp.max(lb)) + 1
+    c = _contingency(la, lb, ka=ka, kb=kb)
+    n = la.shape[0]
+    tk = jnp.sum(c * c) - n                 # 2·(pairs together in both)
+    pk = jnp.sum(jnp.sum(c, axis=1) ** 2) - n
+    qk = jnp.sum(jnp.sum(c, axis=0) ** 2) - n
+    return jnp.where((pk <= 0) | (qk <= 0), 0.0,
+                     tk / jnp.sqrt(pk * qk))
+
+
+def dunn_index(x, labels, centroids, *, chunk_size: int = 65536) -> float:
+    """Dunn index (higher = better): min inter-cluster separation over
+    max intra-cluster diameter, in the centroid-linkage approximation —
+    separation = min pairwise CENTROID distance, diameter = 2 × max
+    point-to-own-centroid distance.  The exact all-pairs Dunn is O(n²);
+    this standard surrogate is one chunked pass over x plus a (k, k)
+    centroid matrix, so it runs at engine scale.
+    """
+    return float(_dunn_index(
+        jnp.asarray(x), jnp.asarray(labels, jnp.int32),
+        jnp.asarray(centroids, jnp.float32), chunk_size=chunk_size,
+    ))
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def _dunn_index(x, labels, c, *, chunk_size):
+    k = c.shape[0]
+    valid = labels >= 0
+    (xp, lp, vp), _ = _pad_rows(
+        (x, labels, valid), chunk_size
+    )
+    tiles = (xp.reshape(-1, chunk_size, x.shape[1]),
+             lp.reshape(-1, chunk_size), vp.reshape(-1, chunk_size))
+
+    def body(carry, tile):
+        xt, lt, vt = tile
+        max_r2, counts = carry
+        own = c[jnp.maximum(lt, 0)]
+        d2 = jnp.sum((xt.astype(jnp.float32) - own) ** 2, axis=-1)
+        d2 = jnp.where(vt, d2, -jnp.inf)
+        counts = counts.at[jnp.where(vt, lt, k)].add(1.0)
+        return (jnp.maximum(max_r2, jnp.max(d2)), counts), None
+
+    (max_r2, counts), _ = lax.scan(
+        body, (-jnp.inf, jnp.zeros((k + 1,), jnp.float32)), tiles
+    )
+    diameter = 2.0 * jnp.sqrt(jnp.maximum(max_r2, 0.0))
+
+    # Separation over LIVE clusters only: with empty="keep" a drained
+    # cluster retains its stale init centroid, which can sit arbitrarily
+    # close to a live one (same empty-mask policy as _db_ch).
+    live = counts[:k] > 0
+    dc = pairwise_sq_dists(c, c)
+    off = jnp.eye(k, dtype=bool) | ~(live[:, None] & live[None, :])
+    dc = jnp.where(off, jnp.inf, dc)      # where() not add: 0·inf is NaN
+    separation = jnp.sqrt(jnp.min(dc))
+    return jnp.where(diameter <= 0, jnp.inf, separation / diameter)

@@ -180,3 +180,72 @@ def test_hcv_perfect_and_degenerate():
     got = M.homogeneity_completeness_v(lt, np.zeros(6, np.int32))
     assert float(got["completeness"]) == pytest.approx(1.0)
     assert float(got["homogeneity"]) == pytest.approx(0.0)
+
+
+def test_fowlkes_mallows_matches_sklearn_formula(rng):
+    """Oracle: brute-force pair counting in NumPy."""
+    from kmeans_tpu.metrics import fowlkes_mallows_index
+
+    a = rng.integers(0, 4, 300)
+    b = rng.integers(0, 3, 300)
+
+    def pairs(lbl):
+        same = lbl[:, None] == lbl[None, :]
+        return same[np.triu_indices(len(lbl), 1)]
+
+    pa, pb = pairs(a), pairs(b)
+    tp = float(np.sum(pa & pb))
+    fm_want = tp / np.sqrt(float(pa.sum()) * float(pb.sum()))
+    got = float(fowlkes_mallows_index(a, b))
+    np.testing.assert_allclose(got, fm_want, rtol=1e-6)
+    # identical partitions score 1 (label permutation included)
+    perm = np.array([2, 0, 3, 1])[a]
+    np.testing.assert_allclose(float(fowlkes_mallows_index(a, perm)), 1.0,
+                               rtol=1e-6)
+
+
+def test_dunn_index_orders_configurations():
+    """Well-separated tight blobs score far higher than overlapping
+    ones, and the value matches the centroid-surrogate formula."""
+    import jax
+
+    from kmeans_tpu.data import make_blobs
+    from kmeans_tpu.metrics import dunn_index
+    from kmeans_tpu.models import fit_lloyd
+
+    xt, _, _ = make_blobs(jax.random.key(0), 600, 4, 3, cluster_std=0.2)
+    xo, _, _ = make_blobs(jax.random.key(0), 600, 4, 3, cluster_std=3.0)
+    st_t = fit_lloyd(xt, 3, key=jax.random.key(1), max_iter=40)
+    st_o = fit_lloyd(xo, 3, key=jax.random.key(1), max_iter=40)
+    d_t = dunn_index(xt, st_t.labels, st_t.centroids, chunk_size=128)
+    d_o = dunn_index(xo, st_o.labels, st_o.centroids, chunk_size=128)
+    assert d_t > 3 * d_o > 0
+
+    # Oracle on the tight case.
+    x = np.asarray(xt)
+    lab = np.asarray(st_t.labels)
+    c = np.asarray(st_t.centroids)
+    diam = 2 * max(np.linalg.norm(x[lab == j] - c[j], axis=1).max()
+                   for j in range(3))
+    sep = min(np.linalg.norm(c[i] - c[j])
+              for i in range(3) for j in range(3) if i != j)
+    np.testing.assert_allclose(d_t, sep / diam, rtol=1e-4)
+
+
+def test_dunn_index_masks_empty_clusters():
+    """A drained cluster's stale centroid must not poison separation."""
+    from kmeans_tpu.metrics import dunn_index
+
+    rng = np.random.default_rng(2)
+    x = np.concatenate([rng.normal(size=(50, 2)) * 0.1,
+                        rng.normal(size=(50, 2)) * 0.1 + 10.0]).astype(
+        np.float32
+    )
+    labels = np.array([0] * 50 + [1] * 50, np.int32)
+    # Third centroid is stale junk sitting right next to centroid 0.
+    c = np.array([[0.0, 0.0], [10.0, 10.0], [0.05, 0.0]], np.float32)
+    d = dunn_index(x, labels, c, chunk_size=32)
+    c_live = c[:2]
+    d_live = dunn_index(x, labels, c_live, chunk_size=32)
+    np.testing.assert_allclose(d, d_live, rtol=1e-5)
+    assert d > 1.0

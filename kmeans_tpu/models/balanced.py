@@ -36,6 +36,7 @@ from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_inputs
+from kmeans_tpu.models.lloyd import NearestCentroidMixin
 from kmeans_tpu.ops.distance import pairwise_sq_dists
 
 __all__ = ["BalancedState", "fit_balanced", "BalancedKMeans",
@@ -241,8 +242,12 @@ def fit_balanced(
 
 
 @dataclasses.dataclass
-class BalancedKMeans:
+class BalancedKMeans(NearestCentroidMixin):
     """Estimator wrapper over :func:`fit_balanced` (sklearn-like surface).
+
+    ``predict``/``transform``/``score`` come from the shared
+    nearest-centroid mixin — prediction is UNCONSTRAINED (capacities
+    bind the training mass, not future points).
 
     >>> bk = BalancedKMeans(n_clusters=4, seed=0).fit(x)
     >>> np.bincount(bk.labels_)            # ≈ n/4 each
@@ -288,17 +293,6 @@ class BalancedKMeans:
 
     def fit_predict(self, x, weights=None):
         return self.fit(x, weights=weights).labels_
-
-    def predict(self, x):
-        """Nearest-centroid labels for new data (no balance constraint —
-        capacity applies to the training mass, not future points)."""
-        from kmeans_tpu.ops.distance import assign
-
-        labels, _ = assign(
-            jnp.asarray(x), self.state.centroids,
-            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
-        )
-        return labels
 
     @property
     def cluster_centers_(self):

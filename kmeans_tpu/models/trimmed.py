@@ -36,6 +36,7 @@ from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_inputs
+from kmeans_tpu.models.lloyd import NearestCentroidMixin
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
@@ -200,8 +201,15 @@ def fit_trimmed(
 
 
 @dataclasses.dataclass
-class TrimmedKMeans:
+class TrimmedKMeans(NearestCentroidMixin):
     """Estimator wrapper over :func:`fit_trimmed` (sklearn-like surface).
+
+    ``predict``/``transform``/``score`` come from the shared
+    nearest-centroid mixin — prediction never emits -1 (trimming is a
+    fit-time concept; the mask for TRAINING data is ``outlier_mask_``),
+    and ``score`` likewise sums min-distances over ALL given points, so
+    on the training data ``-score(x) >= inertia_`` (which counts inliers
+    only).
 
     >>> tk = TrimmedKMeans(n_clusters=3, trim_fraction=0.05, seed=0).fit(x)
     >>> tk.labels_          # -1 marks the trimmed outliers
@@ -248,16 +256,6 @@ class TrimmedKMeans:
 
     def fit_predict(self, x, weights=None):
         return self.fit(x, weights=weights).labels_
-
-    def predict(self, x):
-        """Nearest-centroid labels for new data (no trimming on predict)."""
-        from kmeans_tpu.ops.distance import assign
-
-        labels, _ = assign(
-            jnp.asarray(x), self.state.centroids,
-            chunk_size=self.chunk_size, compute_dtype=self.compute_dtype,
-        )
-        return labels
 
     @property
     def cluster_centers_(self):

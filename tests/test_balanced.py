@@ -207,3 +207,12 @@ def test_balanced_sharded_weighted_and_capacities():
                                rtol=1e-3, atol=1e-4)
     np.testing.assert_allclose(float(got.inertia), float(want.inertia),
                                rtol=1e-4)
+
+
+def test_estimator_mixin_surface(rng):
+    """transform/score come from the shared nearest-centroid mixin."""
+    x = rng.normal(size=(60, 4)).astype(np.float32)
+    bk = BalancedKMeans(n_clusters=3, seed=0, chunk_size=64,
+                        sinkhorn_sweeps=40).fit(x)
+    assert np.asarray(bk.transform(x[:5])).shape == (5, 3)
+    assert bk.score(x) <= 0

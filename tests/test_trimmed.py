@@ -217,3 +217,12 @@ def test_trimmed_sharded_zero_trim():
     np.testing.assert_array_equal(np.asarray(got.labels),
                                   np.asarray(want.labels))
     assert not bool(np.asarray(got.outlier_mask).any())
+
+
+def test_estimator_mixin_surface(rng):
+    """transform/score come from the shared nearest-centroid mixin."""
+    x = rng.normal(size=(60, 4)).astype(np.float32)
+    tk = TrimmedKMeans(n_clusters=3, trim_fraction=0.1, seed=0,
+                       chunk_size=64).fit(x)
+    assert np.asarray(tk.transform(x[:5])).shape == (5, 3)
+    assert tk.score(x) <= 0

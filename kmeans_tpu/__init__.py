@@ -41,6 +41,7 @@ from kmeans_tpu.models import (
     KMeansState,
     KMedoids,
     MiniBatchKMeans,
+    SpectralClustering,
     SphericalKMeans,
     TrimmedKMeans,
     fit_balanced,
@@ -56,6 +57,7 @@ from kmeans_tpu.models import (
     fit_lloyd,
     fit_lloyd_accelerated,
     fit_minibatch,
+    fit_spectral,
     fit_spherical,
     fit_trimmed,
     suggest_k,
@@ -76,6 +78,7 @@ __all__ = [
     "KMeansState",
     "KMedoids",
     "MiniBatchKMeans",
+    "SpectralClustering",
     "SphericalKMeans",
     "TrimmedKMeans",
     "fit_balanced",
@@ -91,6 +94,7 @@ __all__ = [
     "fit_lloyd",
     "fit_lloyd_accelerated",
     "fit_minibatch",
+    "fit_spectral",
     "fit_spherical",
     "fit_trimmed",
     "suggest_k",

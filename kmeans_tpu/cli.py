@@ -94,9 +94,9 @@ def _cmd_train(args) -> int:
         # Statically-knowable --merge-k mistakes fail before the fit
         # (the auto-k upper bound is re-checked after, against the
         # discovered k).
-        if model == "kernel":
-            print("error: --merge-k needs a center-based fit; kernel "
-                  "k-means has no input-space centers", file=sys.stderr)
+        if model in ("kernel", "spectral"):
+            print(f"error: --merge-k needs a center-based fit; "
+                  f"{model} has no input-space centers", file=sys.stderr)
             return 2
         if args.merge_k < 1:
             print("error: --merge-k must be >= 1", file=sys.stderr)
@@ -327,6 +327,7 @@ def _cmd_train(args) -> int:
             "kmedoids": models.fit_kmedoids,
             "trimmed": models.fit_trimmed,
             "balanced": models.fit_balanced,
+            "spectral": models.fit_spectral,
             "xmeans": models.fit_xmeans,   # --k is k_max; k is discovered
             "gmeans": models.fit_gmeans,   # likewise (Anderson-Darling)
         }[model]
@@ -504,7 +505,7 @@ def main(argv=None) -> int:
     t.add_argument("--model", default=None, choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
         "fuzzy", "gmm", "kernel", "kmedoids", "trimmed", "balanced",
-        "xmeans", "gmeans",
+        "spectral", "xmeans", "gmeans",
     ], help="model family (default: lloyd, or the config's minibatch "
             "choice); for xmeans/gmeans, --k is k_max and k is discovered; "
             "balanced enforces same-size clusters via Sinkhorn OT")

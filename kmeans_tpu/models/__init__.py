@@ -50,6 +50,12 @@ from kmeans_tpu.models.selection import (
     suggest_k_gap,
     sweep_k,
 )
+from kmeans_tpu.models.spectral import (
+    SpectralClustering,
+    SpectralState,
+    fit_spectral,
+    spectral_embedding,
+)
 from kmeans_tpu.models.streaming import assign_stream, fit_minibatch_stream
 from kmeans_tpu.models.trimmed import TrimmedKMeans, TrimmedState, fit_trimmed
 from kmeans_tpu.models.spherical import (
@@ -154,6 +160,10 @@ __all__ = [
     "fit_lloyd_accelerated",
     "MiniBatchKMeans",
     "fit_minibatch",
+    "SpectralClustering",
+    "SpectralState",
+    "fit_spectral",
+    "spectral_embedding",
     "SphericalKMeans",
     "fit_spherical",
     "TrimmedKMeans",

@@ -1,0 +1,243 @@
+"""Spectral clustering: normalized-Laplacian embedding + k-means.
+
+Clusters by GRAPH connectivity instead of Euclidean compactness — the
+family that solves concentric rings, half-moons, and every other shape
+where nearest-centroid geometry fails.  Classic pipeline (Ng, Jordan &
+Weiss 2002): rbf affinity W, normalized Laplacian
+L_sym = D^{-1/2} W D^{-1/2}, top-k eigenvectors, row-normalize, k-means
+on the embedding.
+
+TPU-first design: the exact eigenproblem is O(n²) storage and a dense
+eigh — hopeless at engine scale — so the embedding is computed through
+the Nyström approximation (Fowlkes et al. 2004), entirely as chunked MXU
+matmuls plus one (m, m) eigh on the landmark kernel:
+
+    C  = K(x, L)                          (n, m)  chunked kernel tiles
+    d̂  = C · K(L,L)⁻¹ · (Cᵀ·1)            approximate degrees
+    Z  = diag(d̂)^{-1/2} · C · K(L,L)^{-1/2}       (n, m)
+    Zᵀ Z = V S Vᵀ  (m, m eigh)  →  U = Z V S^{-1/2}  top-k columns
+
+``U``'s columns approximate the Laplacian's leading eigenvectors; the
+final k-means runs on the row-normalized embedding (the Ng-Jordan-Weiss
+step — exactly :func:`kmeans_tpu.models.fit_spherical`'s geometry, but a
+plain Lloyd on normalized rows is the textbook form and what we use).
+Everything downstream of the embedding rides the existing engine, so
+``mesh=`` scales the final fit like any other.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.lloyd import KMeansState, fit_lloyd
+
+__all__ = ["SpectralState", "spectral_embedding", "fit_spectral",
+           "SpectralClustering"]
+
+
+class SpectralState(NamedTuple):
+    """Result of a spectral fit: cluster labels plus the embedding the
+    k-means ran on (useful for plotting / diagnostics)."""
+
+    labels: jax.Array         # (n,) int32
+    embedding: jax.Array      # (n, k) float32, row-normalized
+    inertia: jax.Array        # k-means objective IN EMBEDDING SPACE
+    n_iter: jax.Array         # scalar int32 (of the embedding k-means)
+    converged: jax.Array      # scalar bool
+    counts: jax.Array         # (k,) float32
+
+
+def spectral_embedding(
+    x: jax.Array,
+    k: int,
+    *,
+    n_landmarks: int = 256,
+    gamma: Optional[float] = None,
+    landmarks: Optional[jax.Array] = None,
+    key: Optional[jax.Array] = None,
+    reg: float = 1e-4,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+) -> jax.Array:
+    """Row-normalized (n, k) Nyström approximation of the normalized
+    Laplacian's top-k eigenvector embedding (rbf affinity).
+
+    ``gamma`` defaults to 1/d (the kernel module's / sklearn's pairwise
+    default — scale your features, or pass gamma, for very
+    small/large-variance data); explicit ``landmarks`` (m, d) control
+    the approximation's support — otherwise ``n_landmarks`` uniform
+    samples (clamped to n).  ``reg`` is the RELATIVE spectrum cutoff of
+    the landmark kernel's pseudo-inverse (see inline comment).
+    ``compute_dtype`` sets the K(x, L) tile matmul dtype (the dominant
+    cost); the small landmark-side eigh stays float32 for stability.
+    """
+    from kmeans_tpu.models.kernel import (
+        kernel_tile,
+        resolve_kernel_params,
+    )
+    from kmeans_tpu.ops.distance import sq_norms
+
+    x = jnp.asarray(x)
+    n, d = x.shape
+    f32 = jnp.float32
+    gamma, degree, coef0 = resolve_kernel_params("rbf", gamma, 3, 1.0, d)
+
+    if landmarks is None:
+        m = min(n_landmarks, n)     # small datasets: exact (all points)
+        if m < k:
+            raise ValueError(
+                f"n_landmarks must be >= k={k}, got {m}"
+            )
+        if key is None:
+            key = jax.random.key(0)
+        idx = jax.random.choice(key, n, shape=(m,), replace=False)
+        landmarks = x[idx]
+    else:
+        landmarks = jnp.asarray(landmarks)
+        if landmarks.ndim != 2 or landmarks.shape[1] != d:
+            raise ValueError(
+                f"landmarks must be (m, {d}), got {landmarks.shape}"
+            )
+        m = landmarks.shape[0]
+        if m < k:
+            raise ValueError(f"need at least k={k} landmarks, got {m}")
+
+    lf = landmarks.astype(f32)
+    l_sq = sq_norms(lf)
+    w_mm = kernel_tile(lf, lf.T, l_sq, l_sq, kernel="rbf", gamma=gamma,
+                       degree=degree, coef0=coef0, cd=f32)
+    w_mm = 0.5 * (w_mm + w_mm.T)
+    s_mm, u_mm = jnp.linalg.eigh(w_mm)
+    # Relative-cutoff PSEUDO-inverse, not an absolute floor: an rbf Gram
+    # over nearby landmarks is numerically low-rank, and flooring its
+    # junk eigenvalues at a tiny constant AMPLIFIES those directions by
+    # 1/sqrt(floor) in f32 — which drowns the Laplacian's informative
+    # eigenvectors entirely (rings come out unseparated).  Truncation
+    # keeps exactly the numerically supported subspace.
+    cut = reg * jnp.max(s_mm)
+    inv_s = jnp.where(s_mm > cut, 1.0 / jnp.maximum(s_mm, cut), 0.0)
+    w_inv = (u_mm * inv_s[None, :]) @ u_mm.T
+    w_inv_sqrt = (u_mm * jnp.sqrt(inv_s)[None, :]) @ u_mm.T
+
+    # C = K(x, L), chunked; then everything is (n, m) @ (m, m) matmuls.
+    xf = x.astype(f32)
+    x_sq = sq_norms(xf)
+    n_pad = -(-n // chunk_size) * chunk_size
+    xp = jnp.zeros((n_pad, d), f32).at[:n].set(xf)
+    sp = jnp.zeros((n_pad,), f32).at[:n].set(x_sq)
+    tiles = (xp.reshape(-1, chunk_size, d), sp.reshape(-1, chunk_size))
+
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else f32
+
+    def body(_, tile):
+        xt, st = tile
+        return None, kernel_tile(xt, lf.T, st, l_sq, kernel="rbf",
+                                 gamma=gamma, degree=degree, coef0=coef0,
+                                 cd=cd)
+
+    _, c_tiles = jax.lax.scan(body, None, tiles)
+    C = c_tiles.reshape(n_pad, m)[:n]
+
+    # Approximate degrees of K̂ = C W⁻¹ Cᵀ (strictly positive for rbf).
+    deg = C @ (w_inv @ (C.T @ jnp.ones((n,), f32)))
+    deg = jnp.maximum(deg, 1e-12)
+    Z = (C / jnp.sqrt(deg)[:, None]) @ w_inv_sqrt        # (n, m)
+
+    # Top-k left singular vectors of Z via the (m, m) Gram eigh.
+    g = Z.T @ Z
+    g = 0.5 * (g + g.T)
+    s_g, v_g = jnp.linalg.eigh(g)
+    top = jnp.flip(jnp.arange(m - k, m))
+    v_top = v_g[:, top]
+    s_top = jnp.maximum(s_g[top], 1e-12)
+    U = (Z @ v_top) / jnp.sqrt(s_top)[None, :]           # (n, k)
+
+    norms = jnp.sqrt(jnp.maximum(jnp.sum(U * U, axis=1, keepdims=True),
+                                 1e-12))
+    return U / norms
+
+
+def fit_spectral(
+    x: jax.Array,
+    k: int,
+    *,
+    n_landmarks: int = 256,
+    gamma: Optional[float] = None,
+    landmarks: Optional[jax.Array] = None,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+) -> SpectralState:
+    """Spectral clustering: Nyström Laplacian embedding + k-means.
+
+    One ``key`` drives both the landmark sample and the embedding-space
+    k-means seeding (fold-in separated), so a fit is reproducible from a
+    single seed.
+    """
+    if key is None:
+        key = jax.random.key(config.seed if config is not None else 0)
+    emb = spectral_embedding(
+        x, k, n_landmarks=n_landmarks, gamma=gamma, landmarks=landmarks,
+        key=key,
+        chunk_size=(config.chunk_size if config is not None else 4096),
+        compute_dtype=(config.compute_dtype if config is not None
+                       else None),
+    )
+    st: KMeansState = fit_lloyd(
+        emb, k, key=jax.random.fold_in(key, 1), config=config, tol=tol,
+        max_iter=max_iter,
+    )
+    return SpectralState(st.labels, emb, st.inertia, st.n_iter,
+                         st.converged, st.counts)
+
+
+@dataclasses.dataclass
+class SpectralClustering:
+    """Estimator wrapper over :func:`fit_spectral` (sklearn-like surface).
+
+    >>> sc = SpectralClustering(n_clusters=2, seed=0).fit(x)
+    >>> sc.labels_            # separates rings Lloyd cannot
+    """
+
+    n_clusters: int = 3
+    n_landmarks: int = 256
+    gamma: Optional[float] = None
+    max_iter: int = 100
+    tol: float = 1e-4
+    seed: int = 0
+    chunk_size: int = 4096
+
+    state: Optional[SpectralState] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def fit(self, x) -> "SpectralClustering":
+        self.state = fit_spectral(
+            jnp.asarray(x), self.n_clusters, n_landmarks=self.n_landmarks,
+            gamma=self.gamma, key=jax.random.key(self.seed),
+            config=KMeansConfig(k=self.n_clusters, max_iter=self.max_iter,
+                                tol=self.tol, seed=self.seed,
+                                chunk_size=self.chunk_size),
+        )
+        return self
+
+    def fit_predict(self, x):
+        return self.fit(x).labels_
+
+    @property
+    def labels_(self):
+        return self.state.labels
+
+    @property
+    def embedding_(self):
+        return self.state.embedding
+
+    @property
+    def n_iter_(self):
+        return int(self.state.n_iter)

@@ -68,6 +68,7 @@ _TRAIN_FITS = {
     "kmedoids": "fit_kmedoids",
     "trimmed": "fit_trimmed",   # outliers come back as unassigned cards
     "balanced": "fit_balanced",  # same-size clusters via Sinkhorn OT
+    "spectral": "fit_spectral",  # graph clustering (rings/moons shapes)
     "xmeans": "fit_xmeans",     # k acts as k_max; BIC discovers the k
     "gmeans": "fit_gmeans",     # k_max likewise; Anderson-Darling test
 }
@@ -382,6 +383,8 @@ class KMeansServer:
         # (the endpoint exists for the teaching-game scale, n=500 d=2 k=3).
         if n * d > 8_000_000:
             raise ValueError("train shape too large: n*d must be <= 8e6")
+        # (spectral's (n, 256) embedding arrays are bounded by the global
+        # n <= 100_000 clamp above: ~100 MB per array worst case.)
         if model == "balanced":
             # Each outer iteration runs sinkhorn_sweeps (=200 default)
             # O(n·k) log-domain sweeps (2 logsumexps each) on top of the

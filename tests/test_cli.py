@@ -388,3 +388,20 @@ def test_train_merge_k_kmedoids(capsys):
     assert rc in (0, None)
     res = json.loads(out.splitlines()[0])
     assert res["mode"] == "kmedoids" and res["merged_k"] == 2
+
+
+def test_train_spectral_family(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "300", "--d", "2", "--k", "3", "--model",
+        "spectral", "--max-iter", "30",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "spectral"
+    assert np.isfinite(res["inertia"])
+    # no input-space centers -> merge-k is a clean static error
+    rc, _, err = _run(capsys, [
+        "train", "--n", "100", "--d", "2", "--k", "3", "--model",
+        "spectral", "--max-iter", "10", "--merge-k", "2",
+    ])
+    assert rc == 2 and "center-based" in err

@@ -661,3 +661,12 @@ def test_train_op_kmedoids_large_k_merges_to_board(server):
     state = json.loads(body)
     assert len(state["cards"]) == 120
     assert 1 <= len(state["centroids"]) <= 3
+
+
+def test_train_op_spectral_family(server):
+    buf = _train_and_collect(server, "SPEC",
+                             {"n": 200, "d": 2, "k": 3, "max_iter": 15,
+                              "model": "spectral"})
+    assert b'"model": "spectral"' in buf, buf[:500]
+    assert b"train_done" in buf
+    assert b"train_error" not in buf

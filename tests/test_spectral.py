@@ -1,0 +1,92 @@
+"""Spectral clustering: the rings case Lloyd can't solve; embedding
+properties; estimator surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models import (
+    SpectralClustering,
+    fit_lloyd,
+    fit_spectral,
+    spectral_embedding,
+)
+
+
+def _rings(n_per, r_inner=1.0, r_outer=6.0, noise=0.05, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for r in (r_inner, r_outer):
+        theta = rng.uniform(0, 2 * np.pi, n_per)
+        pts = np.stack([r * np.cos(theta), r * np.sin(theta)], 1)
+        out.append(pts + noise * rng.normal(size=pts.shape))
+    labels = np.repeat([0, 1], n_per)
+    return np.concatenate(out).astype(np.float32), labels
+
+
+def test_spectral_separates_rings_lloyd_cannot():
+    """The family's defining property, from a cold start (no fixed-point
+    warm start — unlike the kernel k-means rings test)."""
+    from kmeans_tpu import metrics
+
+    x, true = _rings(250)
+    sp = fit_spectral(jnp.asarray(x), 2, n_landmarks=128, gamma=2.0,
+                      key=jax.random.key(0))
+    ari_sp = metrics.adjusted_rand_index(true, np.asarray(sp.labels))
+    assert ari_sp > 0.99
+
+    ll = fit_lloyd(jnp.asarray(x), 2, key=jax.random.key(0))
+    ari_ll = metrics.adjusted_rand_index(true, np.asarray(ll.labels))
+    assert ari_ll < 0.5        # Euclidean k-means slices the annulus
+
+
+def test_spectral_recovers_blobs():
+    """On compact blobs it agrees with the generating partition too."""
+    from kmeans_tpu import metrics
+
+    x, true, _ = make_blobs(jax.random.key(2), 500, 6, 4, cluster_std=0.4)
+    sp = fit_spectral(x, 4, n_landmarks=96, key=jax.random.key(1))
+    assert metrics.adjusted_rand_index(np.asarray(true),
+                                       np.asarray(sp.labels)) > 0.98
+
+
+def test_embedding_shape_and_row_norms(rng):
+    x = rng.normal(size=(300, 5)).astype(np.float32)
+    emb = np.asarray(spectral_embedding(jnp.asarray(x), 3, n_landmarks=64))
+    assert emb.shape == (300, 3)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, rtol=1e-4)
+
+
+def test_landmark_validation(rng):
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    with pytest.raises(ValueError):
+        spectral_embedding(jnp.asarray(x), 3, n_landmarks=2)   # < k
+    # n_landmarks > n clamps to n (exact mode) rather than erroring.
+    emb = spectral_embedding(jnp.asarray(x), 3, n_landmarks=500)
+    assert emb.shape == (50, 3)
+    with pytest.raises(ValueError):
+        spectral_embedding(jnp.asarray(x), 3,
+                           landmarks=np.zeros((10, 4), np.float32))
+
+
+def test_estimator_surface():
+    x, true = _rings(150)
+    sc = SpectralClustering(n_clusters=2, n_landmarks=96, gamma=2.0,
+                            seed=0).fit(x)
+    from kmeans_tpu import metrics
+
+    assert metrics.adjusted_rand_index(true, np.asarray(sc.labels_)) > 0.99
+    assert sc.embedding_.shape == (300, 2)
+    assert sc.n_iter_ >= 1
+
+
+def test_seed_reproducibility():
+    x, _ = _rings(120)
+    a = fit_spectral(jnp.asarray(x), 2, key=jax.random.key(7),
+                     n_landmarks=64, gamma=2.0)
+    b = fit_spectral(jnp.asarray(x), 2, key=jax.random.key(7),
+                     n_landmarks=64, gamma=2.0)
+    np.testing.assert_array_equal(np.asarray(a.labels),
+                                  np.asarray(b.labels))

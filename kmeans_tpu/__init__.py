@@ -12,7 +12,8 @@ Layout:
   models/    model families (Lloyd plain/accelerated, minibatch,
              spherical, bisecting, fuzzy, Gaussian mixture, kernel
              k-means + Nyström, k-medoids, trimmed/k-means--,
-             balanced/Sinkhorn-OT, x-means/g-means auto-k),
+             balanced/Sinkhorn-OT, spectral/Nyström-Laplacian,
+             x-means/g-means auto-k, centroid-dendrogram drill-down),
              seeding (k-means++/k-means||/random), selection (sweep,
              BIC/AIC, gap statistic), streaming fits, LloydRunner
   parallel/  mesh construction, shard_map engine (DP psum, TP pmin-argmin,

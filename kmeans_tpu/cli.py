@@ -417,6 +417,12 @@ def _cmd_sweep(args) -> int:
         print("error: --criterion elbow needs at least 3 swept k values",
               file=sys.stderr)
         return 2
+    if args.criterion == "elbow" and args.model == "spectral":
+        print("error: --criterion elbow is meaningless for --model "
+              "spectral (each k's objective lives in a different "
+              "embedding space); use the default silhouette criterion",
+              file=sys.stderr)
+        return 2
 
     if args.input:
         x = np.load(args.input)
@@ -566,7 +572,7 @@ def main(argv=None) -> int:
     w.add_argument("--k-step", type=int, default=1)
     w.add_argument("--model", default="lloyd", choices=[
         "lloyd", "accelerated", "minibatch", "spherical", "bisecting",
-        "fuzzy", "gmm", "kernel", "kmedoids", "balanced",
+        "fuzzy", "gmm", "kernel", "kmedoids", "balanced", "spectral",
     ])
     w.add_argument("--criterion", default="silhouette",
                    choices=["silhouette", "bic", "aic", "gap", "elbow"],

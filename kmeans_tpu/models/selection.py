@@ -33,6 +33,7 @@ _FITTERS = {
     "kernel": "fit_kernel_kmeans",
     "kmedoids": "fit_kmedoids",
     "balanced": "fit_balanced",
+    "spectral": "fit_spectral",   # center-free: silhouette-only rows
     # trimmed is deliberately absent: its -1 outlier labels would poison
     # the label-based scores, and the trim budget changes meaning with k.
 }
@@ -76,12 +77,18 @@ def sweep_k(
     davies_bouldin, calinski_harabasz}`` ("inertia" is each family's
     lower-is-better objective via
     :func:`kmeans_tpu.models.state_objective`; the two center-based
-    scores are absent for center-free families — ``model="kernel"``
-    rows carry silhouette only).  GMM rows additionally
+    scores are absent for the center-free families — ``kernel`` and
+    ``spectral`` rows carry silhouette only).  GMM rows additionally
     carry ``bic``/``aic`` (diag-covariance parameter count), enabling
     ``suggest_k(rows, criterion="bic")`` — the model-based complement to
     the silhouette pick.  Silhouette is the chunked/sampled
-    implementation, so sweeps stay affordable at large n.
+    implementation, so sweeps stay affordable at large n — and it is
+    scored in the space the family clustered in: spectral rows score in
+    THEIR Laplacian embedding (Euclidean silhouette on x would punish
+    exactly the non-convex shapes the family exists for).  Avoid
+    ``criterion="elbow"`` on spectral rows: each row's objective lives
+    in a different k-dimensional embedding, so the inertia curve has no
+    shared scale.
     """
     import math
 
@@ -119,8 +126,13 @@ def sweep_k(
             row["bic"] = -2.0 * ll + p * math.log(n)
             row["aic"] = -2.0 * ll + 2 * p
         if k >= 2:
+            # Score in the family's own geometry: spectral labels are
+            # meaningful in the Laplacian embedding, not raw x.
+            x_score = getattr(state, "embedding", None)
+            x_score = x if x_score is None else x_score
             row["silhouette"] = float(silhouette_score(
-                x, state.labels, k=int(k), sample_size=silhouette_sample,
+                x_score, state.labels, k=int(k),
+                sample_size=silhouette_sample,
                 key=jax.random.fold_in(key, 10_000 + i),
                 chunk_size=chunk_size,
             ))

@@ -56,7 +56,7 @@ def spectral_embedding(
     x: jax.Array,
     k: int,
     *,
-    n_landmarks: int = 256,
+    n_landmarks: Optional[int] = None,
     gamma: Optional[float] = None,
     landmarks: Optional[jax.Array] = None,
     key: Optional[jax.Array] = None,
@@ -88,7 +88,9 @@ def spectral_embedding(
     gamma, degree, coef0 = resolve_kernel_params("rbf", gamma, 3, 1.0, d)
 
     if landmarks is None:
-        m = min(n_landmarks, n)     # small datasets: exact (all points)
+        # Default scales with k (a k-dim embedding needs comfortably
+        # more than k landmark directions); small datasets go exact.
+        m = min(max(n_landmarks or max(256, 2 * k), 1), n)
         if m < k:
             raise ValueError(
                 f"n_landmarks must be >= k={k}, got {m}"
@@ -166,7 +168,7 @@ def fit_spectral(
     x: jax.Array,
     k: int,
     *,
-    n_landmarks: int = 256,
+    n_landmarks: Optional[int] = None,
     gamma: Optional[float] = None,
     landmarks: Optional[jax.Array] = None,
     key: Optional[jax.Array] = None,
@@ -206,7 +208,7 @@ class SpectralClustering:
     """
 
     n_clusters: int = 3
-    n_landmarks: int = 256
+    n_landmarks: Optional[int] = None
     gamma: Optional[float] = None
     max_iter: int = 100
     tol: float = 1e-4

@@ -288,3 +288,46 @@ def test_suggest_k_elbow_negative_objectives():
     rows = [{"k": k, "inertia": v} for k, v in
             [(2, -10.0), (3, -50.0), (4, -70.0), (5, -75.0), (6, -78.0)]]
     assert _elbow_k(rows) == 4
+
+
+def test_sweep_spectral_family():
+    import jax
+
+    from kmeans_tpu.data import make_blobs
+    from kmeans_tpu.models import suggest_k, sweep_k
+
+    x, _, _ = make_blobs(jax.random.key(13), 300, 4, 3, cluster_std=0.3)
+    rows = sweep_k(x, [2, 3, 4], model="spectral", max_iter=20)
+    assert [r["k"] for r in rows] == [2, 3, 4]
+    # center-free: silhouette present, DB/CH absent (like kernel rows)
+    assert all("silhouette" in r for r in rows)
+    assert all("davies_bouldin" not in r for r in rows)
+    assert suggest_k(rows) == 3
+
+
+def test_sweep_spectral_rings_picks_k2_in_embedding_space():
+    """The silhouette for spectral rows is scored in the Laplacian
+    embedding — on rings, Euclidean silhouette on x would punish the
+    correct k=2 partition."""
+    import jax
+
+    from kmeans_tpu.models import suggest_k, sweep_k
+
+    rng = np.random.default_rng(0)
+    out = []
+    for r in (1.0, 6.0):
+        th = rng.uniform(0, 2 * np.pi, 150)
+        pts = np.stack([r * np.cos(th), r * np.sin(th)], 1)
+        out.append(pts + 0.05 * rng.normal(size=pts.shape))
+    x = np.concatenate(out).astype(np.float32)
+    rows = sweep_k(x, [2, 3, 4], model="spectral", max_iter=30)
+    assert suggest_k(rows) == 2
+
+
+def test_cli_sweep_spectral_rejects_elbow(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main(["sweep", "--model", "spectral", "--criterion", "elbow",
+               "--k-min", "2", "--k-max", "5"])
+    assert rc == 2
+    assert "meaningless" in capsys.readouterr().err

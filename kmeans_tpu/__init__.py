@@ -29,7 +29,7 @@ Layout:
   utils/     checkpointing, profiling, room codes
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 from kmeans_tpu.config import KMeansConfig, MeshConfig, RunConfig, ServeConfig
 from kmeans_tpu.models import (

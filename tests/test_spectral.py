@@ -90,3 +90,20 @@ def test_seed_reproducibility():
                      n_landmarks=64, gamma=2.0)
     np.testing.assert_array_equal(np.asarray(a.labels),
                                   np.asarray(b.labels))
+
+
+def test_spectral_separates_half_moons():
+    """The second canonical non-convex shape: two interleaved crescents."""
+    from kmeans_tpu import metrics
+
+    rng = np.random.default_rng(1)
+    n_per = 200
+    t = rng.uniform(0, np.pi, n_per)
+    m1 = np.stack([np.cos(t), np.sin(t)], 1)
+    m2 = np.stack([1.0 - np.cos(t), 0.5 - np.sin(t)], 1)
+    x = (np.concatenate([m1, m2])
+         + 0.04 * rng.normal(size=(2 * n_per, 2))).astype(np.float32)
+    true = np.repeat([0, 1], n_per)
+
+    sp = fit_spectral(jnp.asarray(x), 2, gamma=20.0, key=jax.random.key(0))
+    assert metrics.adjusted_rand_index(true, np.asarray(sp.labels)) > 0.95

@@ -134,3 +134,18 @@ def test_empty_cluster_centers_merge_for_free():
     # The empty center merges FIRST despite being geometrically farthest
     # from both others.
     assert 2 in (int(Z[0, 0]), int(Z[0, 1]))
+
+
+def test_merge_to_k_on_gmm_state():
+    """The GMM's resp_counts weight the dendrogram via state_counts."""
+    import jax
+
+    from kmeans_tpu.models import fit_gmm
+
+    x, true, _ = make_blobs(jax.random.key(3), 400, 4, 4, cluster_std=0.3)
+    gm = fit_gmm(x, 8, key=jax.random.key(0), max_iter=20)
+    labels4, centers4 = merge_to_k(gm, 4)
+    from kmeans_tpu import metrics
+
+    assert centers4.shape == (4, 4)
+    assert metrics.adjusted_rand_index(np.asarray(true), labels4) > 0.95

@@ -471,3 +471,33 @@ def test_state_objective_and_centers_cover_every_family():
     assert state_objective(states["gmm"]) == -float(
         states["gmm"].log_likelihood
     )
+
+
+def test_state_counts_registry(rng):
+    """counts / resp_counts / label-histogram fallback / None — the four
+    cases of the one-copy mapping."""
+    import jax
+
+    from kmeans_tpu.models import (
+        fit_gmm,
+        fit_kernel_kmeans,
+        fit_kmedoids,
+        fit_lloyd,
+        state_counts,
+    )
+
+    x = jnp.asarray(rng.normal(size=(120, 4)).astype(np.float32))
+    ll = fit_lloyd(x, 3, key=jax.random.key(0), max_iter=10)
+    np.testing.assert_allclose(np.asarray(state_counts(ll)),
+                               np.asarray(ll.counts))
+    gm = fit_gmm(x, 3, key=jax.random.key(0), max_iter=5)
+    np.testing.assert_allclose(np.asarray(state_counts(gm)),
+                               np.asarray(gm.resp_counts))
+    km = fit_kmedoids(x, 3, key=jax.random.key(0), max_iter=5)
+    got = np.asarray(state_counts(km))     # bincount fallback
+    np.testing.assert_allclose(
+        got, np.bincount(np.asarray(km.labels), minlength=3)
+    )
+    kk = fit_kernel_kmeans(x, 3, key=jax.random.key(0), max_iter=5)
+    # kernel has counts (per-cluster masses) — present, not None.
+    assert state_counts(kk) is not None

@@ -1,0 +1,84 @@
+"""Runnable tour of the framework — one small dataset, every major surface.
+
+    python examples/quickstart.py
+
+Prints one line per stage; finishes in under a minute on CPU, faster on
+TPU. Used by the test suite as an integration smoke (tests/test_cli.py),
+so it cannot rot.
+"""
+
+import jax
+import numpy as np
+
+import kmeans_tpu
+from kmeans_tpu import metrics
+from kmeans_tpu.data import lightweight_coreset, make_blobs, pca_fit, pca_transform
+from kmeans_tpu.models import centroid_linkage, merge_to_k
+
+
+def main():
+    x, true_labels, _ = make_blobs(jax.random.key(0), 4000, 16, 5,
+                                   cluster_std=0.4)
+
+    # 1. The flagship fit (estimator surface, best-of-3 restarts).
+    km = kmeans_tpu.KMeans(n_clusters=5, n_init=3, seed=0).fit(x)
+    ari = metrics.adjusted_rand_index(np.asarray(true_labels), km.labels_)
+    print(f"lloyd       ari={float(ari):.3f} inertia={km.inertia_:.1f} "
+          f"iters={km.n_iter_}")
+
+    # 2. Robust fit: plant SCATTERED junk, watch it land in the outlier
+    # mask.  (Junk must be scattered: a clump of identical far points is
+    # a legitimate cluster to k-means--, not outliers.)
+    junk = (60.0 * np.sign(np.random.default_rng(1).normal(size=(8, 16)))
+            ).astype(np.float32)
+    xj = np.concatenate([np.asarray(x), junk])
+    # init="random": k-means++ D²-sampling preferentially SEEDS on far
+    # outliers, handing one a centroid — a known interplay with trimming.
+    tk = kmeans_tpu.TrimmedKMeans(n_clusters=5, trim_fraction=8 / len(xj),
+                                  seed=0, init="random").fit(xj)
+    print(f"trimmed     junk-trimmed="
+          f"{bool(np.asarray(tk.outlier_mask_)[-8:].all())}")
+
+    # 3. Balanced fit: same-size clusters via optimal transport.
+    bk = kmeans_tpu.BalancedKMeans(n_clusters=5, seed=0).fit(x)
+    counts = np.bincount(np.asarray(bk.labels_), minlength=5)
+    print(f"balanced    counts={counts.tolist()}")
+
+    # 4. Spectral: rings that Euclidean k-means cannot cut.
+    rng = np.random.default_rng(0)
+    rings = []
+    for r in (1.0, 6.0):
+        t = rng.uniform(0, 2 * np.pi, 300)
+        rings.append(np.stack([r * np.cos(t), r * np.sin(t)], 1)
+                     + 0.05 * rng.normal(size=(300, 2)))
+    xr = np.concatenate(rings).astype(np.float32)
+    sp = kmeans_tpu.fit_spectral(xr, 2, gamma=2.0, key=jax.random.key(0))
+    ring_ari = metrics.adjusted_rand_index(
+        np.repeat([0, 1], 300), np.asarray(sp.labels))
+    print(f"spectral    rings-ari={float(ring_ari):.3f}")
+
+    # 5. Scale tools: PCA projection and a weighted coreset.
+    pst = pca_fit(x, 4)
+    z = pca_transform(pst, x)
+    pts, w = lightweight_coreset(jax.random.key(1), z, 400)
+    st = kmeans_tpu.fit_lloyd(pts, 5, weights=w, key=jax.random.key(2))
+    print(f"pca+coreset d={z.shape[1]} m={pts.shape[0]} "
+          f"converged={bool(st.converged)}")
+
+    # 6. Drill-down: over-cluster, then cut the dendrogram anywhere.
+    big = kmeans_tpu.fit_lloyd(x, 20, key=jax.random.key(3))
+    Z = centroid_linkage(np.asarray(big.centroids), np.asarray(big.counts))
+    labels5, _ = merge_to_k(big, 5, linkage=Z)
+    merged_ari = metrics.adjusted_rand_index(np.asarray(true_labels),
+                                             labels5)
+    print(f"merge_to_k  k=20->5 ari={float(merged_ari):.3f}")
+
+    # 7. Model selection: sweep + two criteria.
+    rows = kmeans_tpu.sweep_k(x, [3, 4, 5, 6, 7], max_iter=30,
+                              silhouette_sample=2000)
+    print(f"sweep       silhouette-k={kmeans_tpu.suggest_k(rows)} "
+          f"elbow-k={kmeans_tpu.suggest_k(rows, criterion='elbow')}")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,7 @@ the memory-mapped ``--stream`` paths, and the reference-schema export.
 """
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -405,3 +406,19 @@ def test_train_spectral_family(capsys):
         "spectral", "--max-iter", "10", "--merge-k", "2",
     ])
     assert rc == 2 and "center-based" in err
+
+
+def test_examples_quickstart_runs(capsys):
+    """The runnable tour in examples/ is an integration smoke — every
+    printed stage must appear, so the example cannot rot."""
+    import runpy
+    import sys
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "quickstart.py")
+    runpy.run_path(path, run_name="__main__")
+    out = capsys.readouterr().out
+    for stage in ("lloyd", "trimmed", "balanced", "spectral",
+                  "pca+coreset", "merge_to_k", "sweep"):
+        assert stage in out, stage
+    assert "junk-trimmed=True" in out

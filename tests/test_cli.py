@@ -412,7 +412,6 @@ def test_examples_quickstart_runs(capsys):
     """The runnable tour in examples/ is an integration smoke — every
     printed stage must appear, so the example cannot rot."""
     import runpy
-    import sys
 
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "examples", "quickstart.py")

@@ -181,25 +181,36 @@ def _accumulate_full_k(sums, counts, lab, xb, xb_c, wb, *, k, update, cd):
     return sums, counts
 
 
+def _dp_fused_pass(x_loc, c, w_loc, *, backend, chunk_size, compute_dtype,
+                   update, weights_binary):
+    """The shard-local fused pass with the kernel/XLA dispatch — THE one
+    copy shared by the plain DP body and the trimmed DP body (mirrors
+    how ``_make_tp_local`` centralizes the TP dispatch)."""
+    if backend == "pallas_interpret":   # CPU-mesh test hook
+        return lloyd_pass_pallas(
+            x_loc, c, weights=w_loc, compute_dtype=compute_dtype,
+            interpret=True,
+        )
+    return lloyd_pass(
+        x_loc, c,
+        weights=w_loc,
+        chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
+        update=update,
+        weights_are_binary=weights_binary,
+        backend=backend,
+    )
+
+
 def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
                    update, with_labels, backend="xla", empty="keep",
                    weights_binary=True, center_update="mean"):
     """DP shard body: fused local pass + psum merge; centroids replicated."""
-    if backend == "pallas_interpret":   # CPU-mesh test hook
-        labels, min_d2, sums, counts, inertia = lloyd_pass_pallas(
-            x_loc, c, weights=w_loc, compute_dtype=compute_dtype,
-            interpret=True,
-        )
-    else:
-        labels, min_d2, sums, counts, inertia = lloyd_pass(
-            x_loc, c,
-            weights=w_loc,
-            chunk_size=chunk_size,
-            compute_dtype=compute_dtype,
-            update=update,
-            weights_are_binary=weights_binary,
-            backend=backend,
-        )
+    labels, min_d2, sums, counts, inertia = _dp_fused_pass(
+        x_loc, c, w_loc, backend=backend, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update=update,
+        weights_binary=weights_binary,
+    )
     sums = lax.psum(sums, data_axis)
     counts = lax.psum(counts, data_axis)
     inertia = lax.psum(inertia, data_axis)
@@ -964,14 +975,15 @@ def _trim_select_dp(d2m, *, m_loc, m, data_axis):
 
 def _trimmed_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size,
                         compute_dtype, update, m, m_loc, with_labels,
-                        backend="xla", empty="keep"):
+                        backend="xla", empty="keep", weights_binary=True):
     """DP shard body for trimmed k-means: the Lloyd local pass, then the
     distributed top-m selection and an O(m_loc) SUBTRACTION of the trimmed
     rows' contributions before the psum — trimming never costs a second
     sweep of the shard (mirrors models/trimmed.py single-device)."""
-    labels, min_d2, sums, counts, inertia = lloyd_pass(
-        x_loc, c, weights=w_loc, chunk_size=chunk_size,
-        compute_dtype=compute_dtype, update=update, backend=backend,
+    labels, min_d2, sums, counts, inertia = _dp_fused_pass(
+        x_loc, c, w_loc, backend=backend, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update=update,
+        weights_binary=weights_binary,
     )
     from kmeans_tpu.models.trimmed import trim_subtract
 
@@ -1002,11 +1014,12 @@ def _trimmed_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size,
 
 @functools.lru_cache(maxsize=32)
 def _build_trimmed_run(mesh, data_axis, chunk_size, compute_dtype, update,
-                       m, m_loc, empty, backend, max_it):
+                       m, m_loc, empty, backend, max_it,
+                       weights_binary=True):
     local = functools.partial(
         _trimmed_local_pass, data_axis=data_axis, chunk_size=chunk_size,
         compute_dtype=compute_dtype, update=update, m=m, m_loc=m_loc,
-        empty=empty, backend=backend,
+        empty=empty, backend=backend, weights_binary=weights_binary,
     )
     step = jax.shard_map(
         functools.partial(local, with_labels=False), mesh=mesh,
@@ -1106,10 +1119,20 @@ def fit_trimmed_sharded(
         )
 
     m_loc = min(m, x.shape[0] // dp)
+    # Same backend resolution as the plain DP engine (the Pallas fused
+    # kernel serves the trimmed local pass unchanged — trimming is a
+    # post-pass correction).  Resolved against the MESH's platform.
+    weights_binary = bool(np.all((w_host == 0.0) | (w_host == 1.0)))
+    backend = resolve_backend(
+        cfg.backend, x, k, weights_are_binary=weights_binary,
+        weights=w_host, compute_dtype=cfg.compute_dtype,
+        platform=mesh.devices.flat[0].platform,
+    )
     run = _build_trimmed_run(
         mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, cfg.update,
-        m, m_loc, cfg.empty, "xla",
+        m, m_loc, cfg.empty, backend,
         max_iter if max_iter is not None else cfg.max_iter,
+        weights_binary,
     )
     tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
     c, labels, inertia, n_iter, converged, counts, out_mask = run(

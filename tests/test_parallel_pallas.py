@@ -196,3 +196,32 @@ def test_pallas_spherical_sharded_matches_single_device(cpu_devices, kw,
         np.asarray(got.centroids), np.asarray(want.centroids),
         rtol=1e-4, atol=1e-4,
     )
+
+
+def test_pallas_trimmed_dp_matches_single_device(cpu_devices):
+    """The fused kernel serves the trimmed local pass (interpret mode on
+    the CPU mesh): exact label/mask parity with the XLA single-device
+    fit."""
+    from kmeans_tpu.models import fit_trimmed
+    from kmeans_tpu.parallel import fit_trimmed_sharded
+
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(259, 128)).astype(np.float32)
+    x[7] = x[100] = 40.0                      # planted ties
+    c0 = x[:4].copy()
+    cfg = KMeansConfig(k=4, init="given", backend="pallas_interpret",
+                       tol=1e-10, max_iter=15)
+
+    want = fit_trimmed(jnp.asarray(x), 4, n_trim=6, init=jnp.asarray(c0),
+                       tol=1e-10, max_iter=15,
+                       config=KMeansConfig(k=4, init="given",
+                                           chunk_size=64))
+    got = fit_trimmed_sharded(x, 4, mesh=cpu_mesh((8, 1)), n_trim=6,
+                              init=c0, tol=1e-10, max_iter=15, config=cfg)
+    np.testing.assert_array_equal(np.asarray(got.outlier_mask),
+                                  np.asarray(want.outlier_mask))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)

@@ -12,7 +12,8 @@ import numpy as np
 
 import kmeans_tpu
 from kmeans_tpu import metrics
-from kmeans_tpu.data import lightweight_coreset, make_blobs, pca_fit, pca_transform
+from kmeans_tpu.data import (lightweight_coreset, make_blobs, pca_fit,
+                             pca_transform)
 from kmeans_tpu.models import centroid_linkage, merge_to_k
 
 
@@ -45,16 +46,12 @@ def main():
     print(f"balanced    counts={counts.tolist()}")
 
     # 4. Spectral: rings that Euclidean k-means cannot cut.
-    rng = np.random.default_rng(0)
-    rings = []
-    for r in (1.0, 6.0):
-        t = rng.uniform(0, 2 * np.pi, 300)
-        rings.append(np.stack([r * np.cos(t), r * np.sin(t)], 1)
-                     + 0.05 * rng.normal(size=(300, 2)))
-    xr = np.concatenate(rings).astype(np.float32)
+    from kmeans_tpu.data import make_rings
+
+    xr, ring_labels = make_rings(jax.random.key(4), 300)
     sp = kmeans_tpu.fit_spectral(xr, 2, gamma=2.0, key=jax.random.key(0))
-    ring_ari = metrics.adjusted_rand_index(
-        np.repeat([0, 1], 300), np.asarray(sp.labels))
+    ring_ari = metrics.adjusted_rand_index(np.asarray(ring_labels),
+                                           np.asarray(sp.labels))
     print(f"spectral    rings-ari={float(ring_ari):.3f}")
 
     # 5. Scale tools: PCA projection and a weighted coreset.

@@ -9,7 +9,13 @@ from kmeans_tpu.data.preprocess import (
     pca_inverse_transform,
     pca_transform,
 )
-from kmeans_tpu.data.synthetic import BENCH_CONFIGS, bench_config, make_blobs
+from kmeans_tpu.data.synthetic import (
+    BENCH_CONFIGS,
+    bench_config,
+    make_blobs,
+    make_moons,
+    make_rings,
+)
 
 __all__ = [
     "BENCH_CONFIGS",
@@ -17,6 +23,8 @@ __all__ = [
     "bench_config",
     "lightweight_coreset",
     "make_blobs",
+    "make_moons",
+    "make_rings",
     "pca_fit",
     "pca_fit_stream",
     "pca_inverse_transform",

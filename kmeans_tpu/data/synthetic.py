@@ -53,3 +53,47 @@ def bench_config(name: str) -> dict:
     if name not in BENCH_CONFIGS:
         raise KeyError(f"unknown bench config {name!r}; have {sorted(BENCH_CONFIGS)}")
     return dict(BENCH_CONFIGS[name])
+
+
+def make_rings(
+    key: jax.Array,
+    n_per: int,
+    *,
+    radii=(1.0, 6.0),
+    noise: float = 0.05,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Concentric 2-D rings — the canonical dataset Euclidean k-means
+    cannot cut (use the kernel or spectral families).  Returns
+    ``(x (len(radii)*n_per, 2), labels)`` with one label per ring."""
+    ks = jax.random.split(key, 2 * len(radii))
+    parts, labels = [], []
+    for i, r in enumerate(radii):
+        kt, kn = ks[2 * i], ks[2 * i + 1]
+        theta = jax.random.uniform(kt, (n_per,), maxval=2.0 * jnp.pi)
+        pts = jnp.stack([r * jnp.cos(theta), r * jnp.sin(theta)], axis=1)
+        parts.append(pts + noise * jax.random.normal(kn, (n_per, 2)))
+        labels.append(jnp.full((n_per,), i, jnp.int32))
+    return (jnp.concatenate(parts).astype(dtype),
+            jnp.concatenate(labels))
+
+
+def make_moons(
+    key: jax.Array,
+    n_per: int,
+    *,
+    noise: float = 0.05,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Two interleaved half-moon crescents (the other canonical
+    non-convex shape).  Returns ``(x (2*n_per, 2), labels)``."""
+    kt1, kt2, kn = jax.random.split(key, 3)
+    t1 = jax.random.uniform(kt1, (n_per,), maxval=jnp.pi)
+    t2 = jax.random.uniform(kt2, (n_per,), maxval=jnp.pi)
+    m1 = jnp.stack([jnp.cos(t1), jnp.sin(t1)], axis=1)
+    m2 = jnp.stack([1.0 - jnp.cos(t2), 0.5 - jnp.sin(t2)], axis=1)
+    x = jnp.concatenate([m1, m2])
+    x = x + noise * jax.random.normal(kn, x.shape)
+    labels = jnp.concatenate([jnp.zeros((n_per,), jnp.int32),
+                              jnp.ones((n_per,), jnp.int32)])
+    return x.astype(dtype), labels

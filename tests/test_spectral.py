@@ -107,3 +107,19 @@ def test_spectral_separates_half_moons():
 
     sp = fit_spectral(jnp.asarray(x), 2, gamma=20.0, key=jax.random.key(0))
     assert metrics.adjusted_rand_index(true, np.asarray(sp.labels)) > 0.95
+
+
+def test_public_generators_feed_spectral():
+    """make_rings/make_moons (the public generators) separate cleanly."""
+    from kmeans_tpu import metrics
+    from kmeans_tpu.data import make_moons, make_rings
+
+    xr, tr = make_rings(jax.random.key(0), 200)
+    sp = fit_spectral(xr, 2, gamma=2.0, key=jax.random.key(1))
+    assert metrics.adjusted_rand_index(np.asarray(tr),
+                                       np.asarray(sp.labels)) > 0.99
+
+    xm, tm = make_moons(jax.random.key(2), 200, noise=0.04)
+    sp = fit_spectral(xm, 2, gamma=20.0, key=jax.random.key(3))
+    assert metrics.adjusted_rand_index(np.asarray(tm),
+                                       np.asarray(sp.labels)) > 0.95

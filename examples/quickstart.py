@@ -12,8 +12,8 @@ import numpy as np
 
 import kmeans_tpu
 from kmeans_tpu import metrics
-from kmeans_tpu.data import (lightweight_coreset, make_blobs, pca_fit,
-                             pca_transform)
+from kmeans_tpu.data import (lightweight_coreset, make_blobs, make_rings,
+                             pca_fit, pca_transform)
 from kmeans_tpu.models import centroid_linkage, merge_to_k
 
 
@@ -46,8 +46,6 @@ def main():
     print(f"balanced    counts={counts.tolist()}")
 
     # 4. Spectral: rings that Euclidean k-means cannot cut.
-    from kmeans_tpu.data import make_rings
-
     xr, ring_labels = make_rings(jax.random.key(4), 300)
     sp = kmeans_tpu.fit_spectral(xr, 2, gamma=2.0, key=jax.random.key(0))
     ring_ari = metrics.adjusted_rand_index(np.asarray(ring_labels),

@@ -14,7 +14,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["make_blobs", "BENCH_CONFIGS", "bench_config"]
+__all__ = ["make_blobs", "make_moons", "make_rings", "BENCH_CONFIGS",
+           "bench_config"]
 
 
 def make_blobs(

@@ -15,15 +15,12 @@ from kmeans_tpu.models import (
 )
 
 
-def _rings(n_per, r_inner=1.0, r_outer=6.0, noise=0.05, seed=0):
-    rng = np.random.default_rng(seed)
-    out = []
-    for r in (r_inner, r_outer):
-        theta = rng.uniform(0, 2 * np.pi, n_per)
-        pts = np.stack([r * np.cos(theta), r * np.sin(theta)], 1)
-        out.append(pts + noise * rng.normal(size=pts.shape))
-    labels = np.repeat([0, 1], n_per)
-    return np.concatenate(out).astype(np.float32), labels
+def _rings(n_per):
+    """Thin wrapper over the public generator (numpy outputs)."""
+    from kmeans_tpu.data import make_rings
+
+    x, labels = make_rings(jax.random.key(0), n_per)
+    return np.asarray(x), np.asarray(labels)
 
 
 def test_spectral_separates_rings_lloyd_cannot():
@@ -96,14 +93,10 @@ def test_spectral_separates_half_moons():
     """The second canonical non-convex shape: two interleaved crescents."""
     from kmeans_tpu import metrics
 
-    rng = np.random.default_rng(1)
-    n_per = 200
-    t = rng.uniform(0, np.pi, n_per)
-    m1 = np.stack([np.cos(t), np.sin(t)], 1)
-    m2 = np.stack([1.0 - np.cos(t), 0.5 - np.sin(t)], 1)
-    x = (np.concatenate([m1, m2])
-         + 0.04 * rng.normal(size=(2 * n_per, 2))).astype(np.float32)
-    true = np.repeat([0, 1], n_per)
+    from kmeans_tpu.data import make_moons
+
+    x, true = make_moons(jax.random.key(1), 200, noise=0.04)
+    x, true = np.asarray(x), np.asarray(true)
 
     sp = fit_spectral(jnp.asarray(x), 2, gamma=20.0, key=jax.random.key(0))
     assert metrics.adjusted_rand_index(true, np.asarray(sp.labels)) > 0.95

@@ -28,14 +28,12 @@ def _partition_inertia(x, labels, k):
 
 
 def _rings(n_per, r_inner=1.0, r_outer=6.0, noise=0.05, seed=0):
-    rng = np.random.default_rng(seed)
-    out = []
-    for r in (r_inner, r_outer):
-        theta = rng.uniform(0, 2 * np.pi, n_per)
-        pts = np.stack([r * np.cos(theta), r * np.sin(theta)], 1)
-        out.append(pts + noise * rng.normal(size=pts.shape))
-    labels = np.repeat([0, 1], n_per)
-    return np.concatenate(out).astype(np.float32), labels
+    """Thin numpy wrapper over the public generator."""
+    from kmeans_tpu.data import make_rings
+
+    x, labels = make_rings(jax.random.key(seed), n_per,
+                           radii=(r_inner, r_outer), noise=noise)
+    return np.asarray(x), np.asarray(labels)
 
 
 def test_linear_kernel_objective_is_partition_inertia(rng):

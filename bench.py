@@ -26,6 +26,8 @@ half of the metric.
 from __future__ import annotations
 
 import argparse
+import datetime
+import glob
 import json
 import os
 import sys
@@ -34,6 +36,153 @@ import time
 import numpy as np
 
 NORTH_STAR_ITERS_PER_S_PER_CHIP = 10.0 / 8.0   # BASELINE.md derivation
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _extract_half(rec, metric):
+    """(value, vs_baseline, extras) of ``rec`` for the requested metric
+    series, or None when the record cannot serve it.
+
+    Records usually hold the merged headline line (iters metric with the
+    converge half under ``wallclock_to_converge_s``), but a ``--converge``
+    run records a pure seconds line — never hand an iter/s value to a
+    seconds series or vice versa.
+    """
+    rec_metric = rec.get("metric", "")
+    if metric.startswith("wallclock_to_converge_s"):
+        if rec_metric.startswith("wallclock_to_converge_s"):
+            value, vs = rec.get("value"), rec.get("vs_baseline")
+        else:
+            value = rec.get("wallclock_to_converge_s")
+            vs = rec.get("converge_vs_baseline")
+        return None if value is None else (value, vs, {})
+    if not rec_metric.startswith("lloyd_iters_per_sec_per_chip"):
+        return None
+    if rec.get("value") is None:
+        return None
+    extras = {key: rec[key]
+              for key in ("wallclock_to_converge_s", "converge_vs_baseline",
+                          "pallas_vs_xla")
+              if rec.get(key) is not None}
+    return rec["value"], rec.get("vs_baseline"), extras
+
+
+def _latest_local_record(metric):
+    """Most recent builder-recorded on-chip record serving ``metric``.
+
+    ``BENCH_LOCAL_latest.json`` is written by every successful TPU run of
+    this script (see ``_record_local``); the per-round ``BENCH_LOCAL_r*.json``
+    snapshots are kept as history.  Newest mtime that can serve the series
+    wins; unreadable or valueless files are skipped, not fatal.
+    """
+    cands = glob.glob(os.path.join(_REPO, "BENCH_LOCAL_*.json"))
+
+    def mtime(path):
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return 0.0
+
+    for path in sorted(cands, key=mtime, reverse=True):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        half = _extract_half(rec, metric)
+        if half is not None:
+            return path, rec, half
+    return None
+
+
+def _carry_forward_line(metric, unit, error):
+    """Failure JSON that still carries the best available numbers.
+
+    VERDICT.md round-2 item 1: when no fresh measurement is possible the
+    driver artifact must not land empty-handed — embed the latest
+    builder-recorded on-chip measurement verbatim, flagged
+    ``carried_forward: true`` with its source file and timestamp so
+    provenance is explicit.
+    """
+    line = {"metric": metric, "value": None, "unit": unit,
+            "vs_baseline": None, "error": error}
+    try:
+        found = _latest_local_record(metric)
+        if found is None:
+            return line
+        path, rec, (value, vs, extras) = found
+        line.update(extras)
+        line.update({
+            "value": value,
+            "vs_baseline": vs,
+            "carried_forward": True,
+            "carried_from": os.path.basename(path),
+            "carried_timestamp": rec.get(
+                "timestamp",
+                datetime.datetime.fromtimestamp(
+                    os.path.getmtime(path), datetime.timezone.utc
+                ).strftime("%Y-%m-%dT%H:%MZ"),
+            ),
+        })
+    except Exception as e:   # the artifact line must come out no matter what
+        line["carry_forward_error"] = f"{type(e).__name__}: {e}"
+    return line
+
+
+def _record_local(line):
+    """Persist a successful on-chip measurement as the carry-forward source."""
+    rec = {k: v for k, v in line.items() if v is not None}
+    rec["timestamp"] = datetime.datetime.now(
+        datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ")
+    rec["note"] = ("auto-recorded by bench.py on a successful TPU run; "
+                   "used as the carried_forward source when the axon "
+                   "tunnel is dead at a later bench invocation")
+    tmp = os.path.join(_REPO, ".BENCH_LOCAL_latest.tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, os.path.join(_REPO, "BENCH_LOCAL_latest.json"))
+    except OSError as e:     # read-only checkout etc.: measurement still
+        print(f"  could not persist local record: {e}", file=sys.stderr)
+
+
+def _probe_backend(attempts=3, timeout_s=90.0, backoff_s=10.0):
+    """Bounded-retry probe of accelerator init in a subprocess.
+
+    A dead axon tunnel relay hangs ``jax.devices()`` forever with no
+    exception (observed rounds 1-2), and jax backend init is process-global
+    — once it wedges in-process there is no retry.  So the retry loop lives
+    here: each attempt inits the backend in a THROWAWAY subprocess with a
+    hard timeout; only when a probe succeeds does the main process import
+    jax at all.  Returns True when the backend came up.
+    """
+    import subprocess
+
+    for i in range(attempts):
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "print(d[0].platform, len(d))"],
+                timeout=timeout_s, capture_output=True, text=True,
+            )
+            if r.returncode == 0:
+                print(f"  backend probe {i + 1}/{attempts} ok "
+                      f"({time.perf_counter() - t0:.1f}s): "
+                      f"{r.stdout.strip().splitlines()[-1]}", file=sys.stderr)
+                return True
+            detail = (r.stderr or r.stdout).strip().splitlines()
+            print(f"  backend probe {i + 1}/{attempts} failed rc={r.returncode}"
+                  f" ({detail[-1] if detail else 'no output'})",
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"  backend probe {i + 1}/{attempts} hung >{timeout_s:.0f}s "
+                  "(dead tunnel relay?)", file=sys.stderr)
+        if i < attempts - 1:
+            time.sleep(backoff_s * (i + 1))
+    return False
 
 
 def _make_data(n, d, seed=0, dtype="bfloat16", tile=32768, k_gen=64):
@@ -276,10 +425,11 @@ def bench_wallclock_to_converge(n=1_280_000, d=2048, k=1000, *, tol=1e-4,
 def _arm_init_watchdog(metric: str, unit: str, timeout_s: float = 180.0):
     """Bound the time a wedged accelerator runtime can stall the bench.
 
-    A dead TPU tunnel relay hangs ``jax.devices()`` at client init forever
-    (observed live twice this round) — no exception, no timeout.  The
-    watchdog disarms as soon as backend init returns; if it fires instead,
-    it prints one parseable JSON line recording the failure and exits, so
+    Backstop behind ``_probe_backend``: the tunnel can die in the window
+    between a successful subprocess probe and the main process's own init.
+    The watchdog disarms as soon as backend init returns; if it fires
+    instead, it prints one parseable JSON line — carrying forward the
+    latest builder-recorded measurement when one exists — and exits, so
     the driver always gets a bench artifact in bounded time.
     """
     import threading
@@ -289,16 +439,15 @@ def _arm_init_watchdog(metric: str, unit: str, timeout_s: float = 180.0):
     def fire():
         if disarm.wait(timeout_s):
             return
-        print(json.dumps({
-            "metric": metric,
-            "value": None,
-            "unit": unit,
-            "vs_baseline": None,
-            "error": f"accelerator runtime wedged: jax backend init did "
-                     f"not return within {timeout_s:.0f}s (dead tunnel "
-                     "relay?); no measurement possible",
-        }), flush=True)
-        os._exit(0)
+        try:
+            print(json.dumps(_carry_forward_line(
+                metric, unit,
+                f"accelerator runtime wedged: jax backend init did not "
+                f"return within {timeout_s:.0f}s (tunnel died after a "
+                "successful probe); no fresh measurement possible",
+            )), flush=True)
+        finally:        # the exit must happen even if the line can't print
+            os._exit(0)
 
     threading.Thread(target=fire, name="bench-init-watchdog",
                      daemon=True).start()
@@ -324,12 +473,26 @@ def main():
     # to produce, so a parse-last-line driver records the artifact in the
     # right series.
     if args.converge:
-        watchdog = _arm_init_watchdog(
-            "wallclock_to_converge_s@N=1.28M,d=2048,k=1000", "s")
+        metric, unit = "wallclock_to_converge_s@N=1.28M,d=2048,k=1000", "s"
     else:
-        watchdog = _arm_init_watchdog(
-            "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000",
-            "iter/s/chip")
+        metric = "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000"
+        unit = "iter/s/chip"
+
+    # Bounded retry loop BEFORE touching jax in this process: a dead tunnel
+    # wedges backend init forever and init is process-global, so the only
+    # place a retry can live is a throwaway subprocess probe.  Worst case
+    # time-to-artifact: attempts x timeout + backoffs ≈ 5 min.
+    probe_attempts, probe_timeout = 3, 90.0
+    if not _probe_backend(attempts=probe_attempts, timeout_s=probe_timeout):
+        print(json.dumps(_carry_forward_line(
+            metric, unit,
+            f"accelerator backend failed to init in {probe_attempts} probe "
+            f"attempts ({probe_timeout:.0f}s timeout each, backoff between; "
+            "dead tunnel relay?); no fresh measurement possible",
+        )), flush=True)
+        return
+
+    watchdog = _arm_init_watchdog(metric, unit)
     import jax
 
     dev = jax.devices()[0]
@@ -371,7 +534,8 @@ def main():
         }
 
     if args.converge:
-        print(json.dumps(converge_line()))
+        conv = converge_line()
+        print(json.dumps(conv))
         return
 
     conv = None
@@ -431,6 +595,8 @@ def main():
             line["converge_error"] = conv["error"]
     if pallas_check is not None:
         line["pallas_vs_xla"] = pallas_check
+    if dev.platform == "tpu" and line.get("value") is not None:
+        _record_local(line)
     print(json.dumps(line))
 
 

@@ -1,0 +1,173 @@
+"""Tunnel-resilience of the driver bench artifact (VERDICT.md r2 item 1).
+
+The axon tunnel relay died at round-2 end and ``BENCH_r02.json`` recorded
+nothing.  These tests pin the fix: bench.py probes backend init in bounded
+subprocess attempts, and when every attempt fails it emits a failure JSON
+that carries forward the most recent builder-recorded on-chip measurement
+with provenance — so the driver artifact never lands empty-handed again.
+
+No jax import anywhere here: the machinery under test must work exactly
+when the accelerator runtime is unusable.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+ITERS_METRIC = "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000"
+CONV_METRIC = "wallclock_to_converge_s@N=1.28M,d=2048,k=1000"
+
+
+@pytest.fixture
+def local_records(tmp_path, monkeypatch):
+    """Point bench at a scratch repo dir and seed it with two records."""
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    old = {"metric": ITERS_METRIC, "value": 10.0, "vs_baseline": 8.0,
+           "timestamp": "2026-07-29T10:00Z"}
+    new = {"metric": ITERS_METRIC, "value": 15.0, "vs_baseline": 12.0,
+           "timestamp": "2026-07-30T15:03Z",
+           "wallclock_to_converge_s": 1.67, "converge_vs_baseline": 47.9,
+           "pallas_vs_xla": "ok"}
+    (tmp_path / "BENCH_LOCAL_r01.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_LOCAL_latest.json").write_text(json.dumps(new))
+    # Ensure deterministic mtime ordering: latest must win.
+    os.utime(tmp_path / "BENCH_LOCAL_r01.json", (1, 1))
+    return tmp_path
+
+
+def test_carry_forward_picks_latest_record(local_records):
+    line = bench._carry_forward_line(ITERS_METRIC, "iter/s/chip",
+                                     "dead tunnel")
+    assert line["carried_forward"] is True
+    assert line["value"] == 15.0
+    assert line["vs_baseline"] == 12.0
+    assert line["carried_from"] == "BENCH_LOCAL_latest.json"
+    assert line["carried_timestamp"] == "2026-07-30T15:03Z"
+    assert line["wallclock_to_converge_s"] == 1.67
+    assert line["pallas_vs_xla"] == "ok"
+    assert "dead tunnel" in line["error"]
+
+
+def test_carry_forward_converge_series_uses_seconds_half(local_records):
+    # A --converge invocation must NEVER be handed an iter/s value: the
+    # merged record serves its wallclock_to_converge_s half instead
+    # (code-review r3 finding: metric-series mismatch).
+    line = bench._carry_forward_line(CONV_METRIC, "s", "dead tunnel")
+    assert line["value"] == 1.67
+    assert line["vs_baseline"] == 47.9
+    assert line["carried_forward"] is True
+
+
+def test_carry_forward_converge_skips_record_without_seconds_half(
+        local_records):
+    # Newest record lacks the converge half -> fall back to an older one
+    # that has it; none have it -> valueless failure line, not 15.0 s.
+    rec = {"metric": ITERS_METRIC, "value": 15.0,
+           "timestamp": "2026-07-30T16:00Z"}
+    (local_records / "BENCH_LOCAL_latest.json").write_text(json.dumps(rec))
+    line = bench._carry_forward_line(CONV_METRIC, "s", "err")
+    assert line["value"] is None
+    assert "carried_forward" not in line
+
+    # A pure --converge record serves the series directly.
+    conv = {"metric": CONV_METRIC, "value": 1.5, "vs_baseline": 53.3,
+            "timestamp": "2026-07-30T17:00Z"}
+    (local_records / "BENCH_LOCAL_conv.json").write_text(json.dumps(conv))
+    line = bench._carry_forward_line(CONV_METRIC, "s", "err")
+    assert line["value"] == 1.5
+    assert line["carried_from"] == "BENCH_LOCAL_conv.json"
+
+
+def test_carry_forward_skips_valueless_and_corrupt(local_records):
+    # A watchdog failure line (value=None) and a corrupt file must both be
+    # skipped in favor of an older real measurement.
+    (local_records / "BENCH_LOCAL_latest.json").write_text(
+        json.dumps({"metric": ITERS_METRIC, "value": None}))
+    (local_records / "BENCH_LOCAL_junk.json").write_text("{not json")
+    line = bench._carry_forward_line(ITERS_METRIC, "iter/s/chip", "err")
+    assert line["value"] == 10.0
+    assert line["carried_from"] == "BENCH_LOCAL_r01.json"
+
+
+def test_carry_forward_without_any_record(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    line = bench._carry_forward_line(ITERS_METRIC, "iter/s/chip", "err")
+    assert line["value"] is None
+    assert "carried_forward" not in line
+
+
+def test_carry_forward_never_raises(tmp_path, monkeypatch):
+    # The watchdog fire() path runs this; an exception there would kill the
+    # daemon thread before os._exit and leave the process wedged forever.
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(
+        bench, "_latest_local_record",
+        lambda metric: (_ for _ in ()).throw(RuntimeError("boom")))
+    line = bench._carry_forward_line(ITERS_METRIC, "iter/s/chip", "err")
+    assert line["value"] is None
+    assert "boom" in line["carry_forward_error"]
+
+
+def test_record_local_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    bench._record_local({"metric": ITERS_METRIC, "value": 9.9,
+                         "vs_baseline": 7.9,
+                         "wallclock_to_converge_s": None})
+    line = bench._carry_forward_line(ITERS_METRIC, "iter/s/chip", "err")
+    assert line["value"] == 9.9
+    assert line["carried_from"] == "BENCH_LOCAL_latest.json"
+    # _record_local stamps measurement time itself and drops None halves so
+    # they can't clobber an older record's real value when carried forward.
+    assert line["carried_timestamp"].endswith("Z")
+    assert "wallclock_to_converge_s" not in line
+
+
+def test_probe_timeout_is_bounded():
+    # A probe command that hangs must be killed at timeout and retried,
+    # then the whole loop must return False in bounded time.
+    real_run = subprocess.run
+
+    def hanging_run(cmd, **kw):
+        return real_run([sys.executable, "-c", "import time; time.sleep(60)"],
+                        **kw)
+
+    orig = subprocess.run
+    subprocess.run = hanging_run
+    try:
+        import time
+        t0 = time.perf_counter()
+        ok = bench._probe_backend(attempts=2, timeout_s=0.5, backoff_s=0.1)
+        dt = time.perf_counter() - t0
+    finally:
+        subprocess.run = orig
+    assert ok is False
+    assert dt < 10
+
+
+def test_main_emits_carried_artifact_when_probe_fails():
+    """End-to-end: probe failure -> last stdout line is parseable JSON
+    with the carried measurement (exactly what the driver records)."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r)\n"
+         "import bench\n"
+         "bench._probe_backend = lambda **kw: False\n"
+         "sys.argv = ['bench.py']\n"
+         "bench.main()" % REPO],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"].startswith("lloyd_iters_per_sec_per_chip")
+    assert "error" in rec
+    # The repo carries BENCH_LOCAL history, so the artifact must carry data.
+    assert rec["carried_forward"] is True
+    assert rec["value"] is not None

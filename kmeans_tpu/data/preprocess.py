@@ -55,22 +55,38 @@ def _pca_moments(x, *, n_components, chunk_size, compute_dtype):
     n, d = x.shape
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
-    tiles, _, _ = chunk_tiles(x, None, chunk_size)
+    tiles, ws, _ = chunk_tiles(x, None, chunk_size)
+
+    # Pilot mean from the first tile, subtracted BEFORE accumulating: the
+    # uncentered second moment suffers catastrophic cancellation when the
+    # data mean dominates its variance (raw pixels ~N(120, 5): mean² is
+    # ~580x the covariance entries, and the sequential f32 scan carry
+    # loses exactly those low bits).  Centered, the carry holds
+    # variance-scale numbers and cov = E[yyᵀ] − E[y]E[y]ᵀ is exact up to
+    # ordinary f32 rounding.  Shift invariance makes any pilot fine; the
+    # first tile's mean leaves only the O(std) residual.
+    w0 = ws[0]
+    mu0 = (jnp.sum(tiles[0].astype(f32) * w0[:, None], axis=0)
+           / jnp.maximum(jnp.sum(w0), 1.0))
 
     def body(carry, tile):
+        xt, wt = tile
         s, ss = carry
-        t = tile.astype(cd)
-        s = s + jnp.sum(tile.astype(f32), axis=0)
+        # wt is 1 on real rows, 0 on chunk padding — zeroing the CENTERED
+        # rows keeps pad rows from contributing (−mu0) outer products.
+        y = (xt.astype(f32) - mu0) * wt[:, None]
+        t = y.astype(cd)
+        s = s + jnp.sum(y, axis=0)
         ss = ss + jnp.matmul(t.T, t, preferred_element_type=f32)
         return (s, ss), None
 
     (s, ss), _ = lax.scan(
-        body, (jnp.zeros((d,), f32), jnp.zeros((d, d), f32)), tiles
+        body, (jnp.zeros((d,), f32), jnp.zeros((d, d), f32)), (tiles, ws)
     )
-    mean = s / n
-    cov = ss / n - jnp.outer(mean, mean)
+    mean_y = s / n
+    cov = ss / n - jnp.outer(mean_y, mean_y)
     comps, top = _top_eigs(cov, n_components)
-    return mean, comps, top
+    return mu0 + mean_y, comps, top
 
 
 def pca_fit(
@@ -125,10 +141,21 @@ def pca_transform(state: PCAState, x: jax.Array,
     """Project rows onto the fitted components (whitening if fitted so).
     Returns float32 (n, n_components)."""
     x = jnp.asarray(x)
-    scale = (
-        1.0 / jnp.sqrt(jnp.maximum(state.explained_variance, 1e-12))
-        if state.whiten else jnp.ones((), jnp.float32)
-    )
+    if state.whiten:
+        # Zero — don't floor — the scale of numerically-unsupported
+        # components: an eigenvalue within a couple of f32-eps of eigh's
+        # noise floor (≈ eps·λ_max) is indistinguishable from zero (or
+        # n_components > effective rank), and flooring it at 1e-12 would
+        # amplify that junk direction by up to 1e6.  The cutoff sits just
+        # above the noise floor so genuinely low-variance SIGNAL (ratios
+        # down to ~1e-6) still whitens.  Same relative-cutoff reasoning
+        # as spectral.py's landmark-kernel pseudo-inverse (ADVICE r2).
+        ev = state.explained_variance
+        cutoff = 2 * jnp.finfo(jnp.float32).eps * jnp.max(ev)
+        scale = jnp.where(ev > cutoff,
+                          1.0 / jnp.sqrt(jnp.maximum(ev, 1e-30)), 0.0)
+    else:
+        scale = jnp.ones((), jnp.float32)
     return _project(x, state.mean, state.components, scale,
                     chunk_size=chunk_size)
 
@@ -164,28 +191,40 @@ def pca_fit_stream(
             f"n_components must be in [1, {min(n, d)}], got {n_components}"
         )
     f32 = jnp.float32
-    carry = [jnp.zeros((d,), f32), jnp.zeros((d, d), f32)]
+    # [sum(y), sum(yyᵀ), pilot mean] with y = x − mu0; the pilot comes from
+    # the first chunk (same cancellation fix as _pca_moments — the carry
+    # must hold variance-scale numbers, not mean²-scale ones).
+    carry = [jnp.zeros((d,), f32), jnp.zeros((d, d), f32), None]
 
     def step(xb, lo):
+        if carry[2] is None:
+            carry[2] = _chunk_mean(xb)
         carry[0], carry[1] = _accumulate_moments(
-            carry[0], carry[1], xb, compute_dtype=compute_dtype,
+            carry[0], carry[1], xb, carry[2], compute_dtype=compute_dtype,
         )
 
     foreach_chunk(data, chunk_size, step)
-    mean = carry[0] / n
-    cov = carry[1] / n - jnp.outer(mean, mean)
+    mean_y = carry[0] / n
+    cov = carry[1] / n - jnp.outer(mean_y, mean_y)
     comps, top = _top_eigs(cov, n_components)
-    return PCAState(mean, comps, top, whiten)
+    return PCAState(carry[2] + mean_y, comps, top, whiten)
+
+
+@jax.jit
+def _chunk_mean(xb):
+    return jnp.mean(xb.astype(jnp.float32), axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("compute_dtype",))
-def _accumulate_moments(s, ss, xb, *, compute_dtype):
-    """One chunk's contribution to the streamed (sum, second-moment)
-    accumulators.  Module-level so the jit cache persists across calls."""
+def _accumulate_moments(s, ss, xb, mu0, *, compute_dtype):
+    """One chunk's contribution to the streamed centered (sum, second-
+    moment) accumulators.  Module-level so the jit cache persists across
+    calls."""
     f32 = jnp.float32
-    t = (xb.astype(jnp.dtype(compute_dtype))
-         if compute_dtype is not None else xb)
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
+    y = xb.astype(f32) - mu0
+    t = y.astype(cd)
     return (
-        s + jnp.sum(xb.astype(f32), axis=0),
+        s + jnp.sum(y, axis=0),
         ss + jnp.matmul(t.T, t, preferred_element_type=f32),
     )

@@ -250,6 +250,35 @@ def calinski_harabasz_score(x, labels, centroids, *,
     return dispersion_scores(x, labels, centroids, chunk_size=chunk_size)[1]
 
 
+def _masked_pair(labels_a, labels_b):
+    """int32 label pair with rows excluded where EITHER side is negative
+    (the trimmed family's outlier convention, matching `_dunn_index`),
+    plus the surviving row count.
+
+    Exclusion must force BOTH ids negative: segment_sum drops negative
+    combined ids ``la·kb + lb``, but a row like (la=2, lb=−1) combines to
+    a NON-negative id and would land in the wrong contingency cell
+    (ADVICE r2 — fowlkes_mallows could even go negative from the biased
+    ``n``; ARI/MI shared the assumption).
+    """
+    la = jnp.asarray(labels_a, jnp.int32)
+    lb = jnp.asarray(labels_b, jnp.int32)
+    valid = (la >= 0) & (lb >= 0)
+    la = jnp.where(valid, la, -1)
+    lb = jnp.where(valid, lb, -1)
+    return la, lb, jnp.sum(valid)
+
+
+def _masked_contingency(labels_a, labels_b):
+    """``(contingency, n_valid)`` over the rows surviving
+    :func:`_masked_pair` — THE shared preamble of every pair-counting
+    metric below."""
+    la, lb, n = _masked_pair(labels_a, labels_b)
+    ka = max(int(jnp.max(la)) + 1, 1)
+    kb = max(int(jnp.max(lb)) + 1, 1)
+    return _contingency(la, lb, ka=ka, kb=kb), n
+
+
 @functools.partial(jax.jit, static_argnames=("ka", "kb"))
 def _contingency(labels_a, labels_b, *, ka, kb):
     n = labels_a.shape[0]
@@ -265,13 +294,11 @@ def _contingency(labels_a, labels_b, *, ka, kb):
 
 
 def adjusted_rand_index(labels_a, labels_b) -> jax.Array:
-    """Adjusted Rand index between two labelings (1 = identical partitions)."""
-    la = jnp.asarray(labels_a, jnp.int32)
-    lb = jnp.asarray(labels_b, jnp.int32)
-    ka = int(jnp.max(la)) + 1
-    kb = int(jnp.max(lb)) + 1
-    c = _contingency(la, lb, ka=ka, kb=kb)
-    n = la.shape[0]
+    """Adjusted Rand index between two labelings (1 = identical partitions).
+    Rows with a negative label on either side (trimmed-family outliers)
+    are excluded, matching sklearn on the surviving rows."""
+    c, n = _masked_contingency(labels_a, labels_b)
+    n = n.astype(jnp.float32)
 
     def comb2(v):
         return v * (v - 1.0) / 2.0
@@ -279,7 +306,7 @@ def adjusted_rand_index(labels_a, labels_b) -> jax.Array:
     sum_ij = jnp.sum(comb2(c))
     sum_a = jnp.sum(comb2(jnp.sum(c, axis=1)))
     sum_b = jnp.sum(comb2(jnp.sum(c, axis=0)))
-    total = comb2(jnp.asarray(float(n)))
+    total = comb2(n)
     expected = sum_a * sum_b / jnp.maximum(total, 1.0)
     max_index = 0.5 * (sum_a + sum_b)
     denom = max_index - expected
@@ -292,12 +319,8 @@ def _mi_terms(labels_a, labels_b):
     """``(mi, H(a), H(b))`` from the contingency table — THE one copy of
     the mutual-information math, shared by NMI and the
     homogeneity/completeness family."""
-    la = jnp.asarray(labels_a, jnp.int32)
-    lb = jnp.asarray(labels_b, jnp.int32)
-    ka = int(jnp.max(la)) + 1
-    kb = int(jnp.max(lb)) + 1
-    c = _contingency(la, lb, ka=ka, kb=kb)
-    p = c / jnp.sum(c)
+    c, _ = _masked_contingency(labels_a, labels_b)
+    p = c / jnp.maximum(jnp.sum(c), 1.0)
     pa = jnp.sum(p, axis=1)
     pb = jnp.sum(p, axis=0)
 
@@ -340,12 +363,8 @@ def fowlkes_mallows_index(labels_a, labels_b) -> jax.Array:
     independent ones).  Same O(n + ka·kb) contingency reduction as ARI —
     nothing pairwise is ever materialized.
     """
-    la = jnp.asarray(labels_a, jnp.int32)
-    lb = jnp.asarray(labels_b, jnp.int32)
-    ka = int(jnp.max(la)) + 1
-    kb = int(jnp.max(lb)) + 1
-    c = _contingency(la, lb, ka=ka, kb=kb)
-    n = la.shape[0]
+    c, n = _masked_contingency(labels_a, labels_b)
+    n = n.astype(c.dtype)
     tk = jnp.sum(c * c) - n                 # 2·(pairs together in both)
     pk = jnp.sum(jnp.sum(c, axis=1) ** 2) - n
     qk = jnp.sum(jnp.sum(c, axis=0) ** 2) - n

@@ -249,3 +249,42 @@ def test_dunn_index_masks_empty_clusters():
     d_live = dunn_index(x, labels, c_live, chunk_size=32)
     np.testing.assert_allclose(d, d_live, rtol=1e-5)
     assert d > 1.0
+
+
+def test_pair_metrics_mask_negative_labels(rng):
+    # ADVICE r2: the trimmed family emits -1 outlier labels; every
+    # contingency-based metric must score only the rows where BOTH sides
+    # are non-negative (one-sided negatives previously landed in the
+    # wrong cell via la*kb+lb >= 0, and FM's n counted masked rows).
+    from sklearn import metrics as skm
+
+    from kmeans_tpu.metrics import (
+        adjusted_rand_index,
+        fowlkes_mallows_index,
+        normalized_mutual_info,
+    )
+
+    a = rng.integers(0, 4, 400).astype(np.int32)
+    b = rng.integers(0, 5, 400).astype(np.int32)
+    a[rng.random(400) < 0.15] = -1           # outliers on one side
+    b[rng.random(400) < 0.15] = -1           # ... and the other
+    keep = (a >= 0) & (b >= 0)
+    np.testing.assert_allclose(
+        float(fowlkes_mallows_index(a, b)),
+        skm.fowlkes_mallows_score(a[keep], b[keep]), atol=1e-5)
+    np.testing.assert_allclose(
+        float(adjusted_rand_index(a, b)),
+        skm.adjusted_rand_score(a[keep], b[keep]), atol=1e-5)
+    np.testing.assert_allclose(
+        float(normalized_mutual_info(a, b)),
+        skm.normalized_mutual_info_score(a[keep], b[keep]), atol=1e-5)
+
+
+def test_fowlkes_mallows_negative_labels_stay_in_range(rng):
+    from kmeans_tpu.metrics import fowlkes_mallows_index
+
+    # Heavily-trimmed labelings must never push the index negative.
+    a = rng.integers(-1, 3, 200).astype(np.int32)
+    b = rng.integers(-1, 3, 200).astype(np.int32)
+    v = float(fowlkes_mallows_index(a, b))
+    assert 0.0 <= v <= 1.0

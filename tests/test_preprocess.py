@@ -126,3 +126,53 @@ def test_pca_fit_stream_matches_in_memory(tmp_path, rng):
     dots = np.abs(np.sum(np.asarray(got.components)
                          * np.asarray(want.components), axis=1))
     np.testing.assert_allclose(dots, 1.0, atol=1e-4)
+
+
+def test_pca_offset_dominated_data_matches_oracle(rng):
+    # ADVICE r2 (medium): the uncentered second moment cancels
+    # catastrophically when mean >> std (raw-pixel regime, x ~ N(120, 5)).
+    # The centered accumulation must recover the oracle even with a large
+    # constant offset, including whitened variances.
+    x = (120.0 + 5.0 * rng.normal(size=(4100, 24))).astype(np.float32)
+    st = pca_fit(jnp.asarray(x), 6, chunk_size=512)  # 4100 % 512 != 0: pads
+    mean_w, comps_w, var_w = _oracle_pca(x, 6)
+    np.testing.assert_allclose(np.asarray(st.mean), mean_w,
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(st.explained_variance), var_w,
+                               rtol=1e-2)
+    dots = np.abs(np.sum(np.asarray(st.components) * comps_w, axis=1))
+    np.testing.assert_allclose(dots, 1.0, atol=1e-2)
+
+
+def test_pca_stream_offset_dominated_matches_in_memory(rng, tmp_path):
+    from kmeans_tpu.data.preprocess import pca_fit_stream
+
+    x = (120.0 + 5.0 * rng.normal(size=(3000, 16))).astype(np.float32)
+    path = tmp_path / "x.npy"
+    np.save(path, x)
+    mm = np.load(path, mmap_mode="r")
+    st_s = pca_fit_stream(mm, 5, chunk_size=700)   # uneven chunks
+    st_m = pca_fit(jnp.asarray(x), 5, chunk_size=512)
+    np.testing.assert_allclose(np.asarray(st_s.mean), np.asarray(st_m.mean),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(st_s.explained_variance),
+        np.asarray(st_m.explained_variance), rtol=1e-2,
+    )
+    dots = np.abs(np.sum(np.asarray(st_s.components)
+                         * np.asarray(st_m.components), axis=1))
+    np.testing.assert_allclose(dots, 1.0, atol=1e-2)
+
+
+def test_whiten_zeroes_unsupported_components(rng):
+    # ADVICE r2 (low): components past the effective rank must be ZEROED,
+    # not amplified by 1/sqrt(floor) — build rank-3 data in d=8 and ask
+    # for 6 whitened components.
+    basis = np.linalg.qr(rng.normal(size=(8, 3)))[0]        # (8, 3)
+    z = rng.normal(size=(600, 3)) * np.array([4.0, 2.0, 1.0])
+    x = (z @ basis.T).astype(np.float32)
+    st = pca_fit(jnp.asarray(x), 6, whiten=True, chunk_size=128)
+    out = np.asarray(pca_transform(st, jnp.asarray(x), chunk_size=128))
+    # Supported components: unit variance.  Unsupported: exactly zero.
+    np.testing.assert_allclose(out[:, :3].var(axis=0), 1.0, rtol=5e-2)
+    np.testing.assert_array_equal(out[:, 3:], 0.0)

@@ -162,9 +162,18 @@ def kmeans_parallel(
     would reach n (small inputs), where oversampling buys nothing.
     """
     n, d = x.shape
-    ell = int(oversampling) if oversampling is not None else min(2 * k, n)
+    # Default ℓ = k (paper range [k/2, 2k]): measured on-chip at the
+    # north-star config (N=1.28M, d=2048, k=1000), ℓ=k gives EQUAL-OR-LOWER
+    # final inertia than ℓ=2k (4.09-4.15e9 vs 4.46-4.65e9 across seeds)
+    # with ~35% less seeding wall-clock — the refine step redistributes a
+    # 1+4k candidate pool just as well, and each sampling round's (n, ℓ)
+    # distance sweep halves.
+    ell = int(oversampling) if oversampling is not None else min(k, n)
     m = 1 + rounds * ell
-    if m >= n:
+    if 2 * m >= n:
+        # Oversampling buys nothing when the candidate pool reaches a large
+        # fraction of the data — the rounds would sweep nearly every point
+        # anyway.  Exact k-means++ is both cheaper and higher-quality there.
         return kmeans_plus_plus(
             key, x, k, weights=weights, compute_dtype=compute_dtype
         )

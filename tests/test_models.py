@@ -208,8 +208,8 @@ def test_kmeans_parallel_deterministic_and_weighted():
 
 
 def test_kmeans_parallel_small_n_falls_back_to_exact():
-    # Pool >= n -> exact k-means++ result, bit-for-bit.
-    # default pool = 1 + 4 rounds x min(2k, n) candidates = 33 >= n = 20
+    # 2x pool >= n -> exact k-means++ result, bit-for-bit.
+    # default pool = 1 + 4 rounds x min(k, n) candidates = 17, 34 >= n = 20
     x, _, _ = make_blobs(jax.random.key(7), 20, 3, 4)
     c_par = kmeans_parallel(jax.random.key(3), x, 4)
     c_pp = kmeans_plus_plus(jax.random.key(3), x, 4)

@@ -30,18 +30,12 @@ from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
 __all__ = ["fit_minibatch", "MiniBatchKMeans", "batch_update"]
 
 
-def batch_update(centroids, n_seen, xb, *, compute_dtype):
-    """One Sculley streaming-average minibatch update.
-
-    Assigns the batch, then moves each touched centroid toward the batch
-    mean with per-center rate 1/n_seen_total.  THE one copy of the update
-    rule — traced both inside ``_minibatch_loop``'s scan and as the jitted
-    streamed step in :mod:`kmeans_tpu.models.streaming`.
-
-    Returns ``(new_centroids, n_seen_after, shift_sq, batch_inertia)``
-    (batch inertia measured at the pre-update centroids — free from the
-    distance tile, and the signal the early-stopping EWA tracks).
-    """
+def batch_stats(centroids, xb, *, compute_dtype, row_weight=None):
+    """Per-cluster ``(counts, sums, inertia)`` of one batch against fixed
+    centroids — the additive (psum-able) half of :func:`batch_update`.
+    ``row_weight`` (scalar or (b,)) scales every contribution: the sharded
+    loop uses it to importance-weight each shard's samples so stratified
+    per-shard sampling matches global uniform sampling in expectation."""
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
     k = centroids.shape[0]
@@ -51,14 +45,43 @@ def batch_update(centroids, n_seen, xb, *, compute_dtype):
     )
     part = sq_norms(centroids)[None, :] - 2.0 * prod
     labels = jnp.argmin(part, axis=1).astype(jnp.int32)
-    b_inertia = jnp.sum(jnp.maximum(jnp.min(part, axis=1) + sq_norms(xb), 0.0))
-    bc = jax.ops.segment_sum(jnp.ones((xb.shape[0],), f32), labels, k)
-    bs = jax.ops.segment_sum(xb.astype(f32), labels, k)
+    mind = jnp.maximum(jnp.min(part, axis=1) + sq_norms(xb), 0.0)
+    w = (jnp.ones((xb.shape[0],), f32) if row_weight is None
+         else jnp.broadcast_to(jnp.asarray(row_weight, f32),
+                               (xb.shape[0],)))
+    b_inertia = jnp.sum(mind * w)
+    bc = jax.ops.segment_sum(w, labels, k)
+    bs = jax.ops.segment_sum(xb.astype(f32) * w[:, None], labels, k)
+    return bc, bs, b_inertia
+
+
+def apply_batch_stats(centroids, n_seen, bc, bs):
+    """The Sculley streaming-average update from reduced batch stats:
+    ``c += (batch_sum − batch_count·c) / n_seen_total`` per touched center.
+    Returns ``(new_centroids, n_seen_after, shift_sq)``."""
     n_after = n_seen + bc
-    # Streaming mean: c += (batch_sum - batch_count·c) / n_seen_total.
     delta = (bs - bc[:, None] * centroids) / jnp.maximum(n_after, 1.0)[:, None]
     step = jnp.where((bc > 0)[:, None], delta, 0.0)
-    return centroids + step, n_after, jnp.sum(step ** 2), b_inertia
+    return centroids + step, n_after, jnp.sum(step ** 2)
+
+
+def batch_update(centroids, n_seen, xb, *, compute_dtype):
+    """One Sculley streaming-average minibatch update.
+
+    Assigns the batch, then moves each touched centroid toward the batch
+    mean with per-center rate 1/n_seen_total.  THE one copy of the update
+    rule — traced inside ``_minibatch_loop``'s scan, as the jitted
+    streamed step in :mod:`kmeans_tpu.models.streaming`, and (split into
+    its :func:`batch_stats` + :func:`apply_batch_stats` halves around a
+    ``psum``) in the sharded loop.
+
+    Returns ``(new_centroids, n_seen_after, shift_sq, batch_inertia)``
+    (batch inertia measured at the pre-update centroids — free from the
+    distance tile, and the signal the early-stopping EWA tracks).
+    """
+    bc, bs, b_inertia = batch_stats(centroids, xb, compute_dtype=compute_dtype)
+    new_c, n_after, shift_sq = apply_batch_stats(centroids, n_seen, bc, bs)
+    return new_c, n_after, shift_sq, b_inertia
 
 
 #: Jitted entry for eager per-batch callers (partial_fit); the scan-based

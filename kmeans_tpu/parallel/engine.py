@@ -164,6 +164,34 @@ def _reseed_empty_farthest_tp(new_c_loc, counts_loc, valid, x_loc, min_d2,
     return jnp.where(empty_loc[:, None], repl[rank], new_c_loc)
 
 
+def _accumulate_k_slice(sums, counts, rel, xb, xb_c, wb, *, k_loc, update,
+                        cd):
+    """Fold one tile's globally-resolved winners into this shard's k-slice
+    accumulators.  ``rel`` is the shard-relative label; rows whose winner
+    lives on another slice match no one-hot column (matmul flavor) or land
+    in the dropped ``k_loc`` slot (segment flavor).  THE one copy shared
+    by the TP and TP×FP bodies."""
+    f32 = jnp.float32
+    if update == "matmul":
+        onehot = rel[:, None] == jnp.arange(k_loc)[None, :]
+        wt = (onehot * wb[:, None]).astype(cd)
+        sums = sums + jnp.matmul(wt.T, xb_c, preferred_element_type=f32,
+                                 precision=matmul_precision(cd))
+        counts = counts + jnp.sum(
+            onehot.astype(f32) * wb[:, None], axis=0
+        )
+    else:  # "segment"
+        in_shard = (rel >= 0) & (rel < k_loc)
+        seg = jnp.where(in_shard, rel, k_loc)
+        sums = sums + jax.ops.segment_sum(
+            xb.astype(f32) * wb[:, None], seg, num_segments=k_loc + 1
+        )[:k_loc]
+        counts = counts + jax.ops.segment_sum(
+            wb * in_shard, seg, num_segments=k_loc + 1
+        )[:k_loc]
+    return sums, counts
+
+
 def _accumulate_full_k(sums, counts, lab, xb, xb_c, wb, *, k, update, cd):
     """Fold one tile's assignments into (sums, counts) over all k slots."""
     f32 = jnp.float32
@@ -266,24 +294,10 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
         mind_g = jnp.maximum(g + sq_norms(xb), 0.0)
         inertia = inertia + jnp.sum(mind_g * wb)
         # Local k-slice update: rows whose winner lives on this shard.
-        rel = lab_g - k_off
-        if update == "matmul":
-            onehot = rel[:, None] == jnp.arange(k_loc)[None, :]
-            wt = (onehot * wb[:, None]).astype(cd)
-            sums = sums + jnp.matmul(wt.T, xb_c, preferred_element_type=f32,
-                                     precision=matmul_precision(cd))
-            counts = counts + jnp.sum(
-                onehot.astype(f32) * wb[:, None], axis=0
-            )
-        else:  # "segment": clamp out-of-shard rows to an extra dropped slot
-            in_shard = (rel >= 0) & (rel < k_loc)
-            seg = jnp.where(in_shard, rel, k_loc)
-            sums = sums + jax.ops.segment_sum(
-                xb.astype(f32) * wb[:, None], seg, num_segments=k_loc + 1
-            )[:k_loc]
-            counts = counts + jax.ops.segment_sum(
-                wb * in_shard, seg, num_segments=k_loc + 1
-            )[:k_loc]
+        sums, counts = _accumulate_k_slice(
+            sums, counts, lab_g - k_off, xb, xb_c, wb,
+            k_loc=k_loc, update=update, cd=cd,
+        )
         return (sums, counts, inertia), (
             lab_g if with_labels else 0,
             mind_g if empty == "farthest" else 0,
@@ -300,6 +314,101 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
     new_c_loc = _apply_center_update(c_loc, sums, counts,
                                      center_update=center_update)
     if empty == "farthest":
+        mind_rows = minds.reshape(-1)[:n_loc]
+        masked = jnp.where(w_loc > 0, mind_rows, -jnp.inf)
+        new_c_loc = _reseed_empty_farthest_tp(
+            new_c_loc, counts, valid_col, x_loc, masked,
+            data_axis, model_axis, k_real,
+        )
+    if with_labels:
+        labels = labs.reshape(-1)[:n_loc]
+        return new_c_loc, inertia, counts, labels
+    return new_c_loc, inertia, counts
+
+
+def _tpfp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis,
+                     feature_axis, k_real, chunk_size, compute_dtype,
+                     update, with_labels, empty="keep",
+                     center_update="mean"):
+    """DP×TP×FP shard body: centroids sharded over BOTH k (``model_axis``)
+    and d (``feature_axis``); x sharded over rows (``data_axis``) and d
+    (VERDICT r2 item 7 — the corner where k·d exceeds HBM on every single
+    extra axis).
+
+    Composition of the two 2-axis bodies, in score order: (1) the partial
+    contraction x·cᵀ over the local d-slice assembles full distances for
+    the local k-slice with ONE ``psum`` over the feature axis (the
+    :func:`_fp_local_pass` layout), then (2) the global argmin resolves
+    across the model axis with the two-``pmin`` combine that reproduces
+    ``jnp.argmin``'s lowest-global-index tie-break exactly (the
+    :func:`_tp_local_pass` combine), and (3) the update stays slice-local
+    on both axes — sums accumulate into the (k_loc, d_loc) block from the
+    local rows and ``psum`` over the data axis only.  Rows are replicated
+    across the feature group (each fp member holds the same rows' d-slice),
+    so labels/counts/inertia come out identical on every fp member and
+    need no feature-axis collective.
+    """
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
+    n_loc, d_loc = x_loc.shape
+    k_loc = c_loc.shape[0]
+    k_pad_total = k_loc * lax.psum(1, model_axis)
+    k_off = lax.axis_index(model_axis) * k_loc
+
+    valid_col = (k_off + jnp.arange(k_loc)) < k_real        # (k_loc,)
+    c_t = c_loc.astype(cd).T                                 # (d_loc, k_loc)
+    c_sq = lax.psum(sq_norms(c_loc), feature_axis)           # full k-slice norms
+
+    xs, ws, _ = chunk_tiles(x_loc, w_loc, chunk_size)
+    xs_sq = lax.psum(sq_norms(xs), feature_axis)             # full row norms
+
+    def body(carry, tile):
+        sums, counts, inertia = carry
+        xb, wb, xb_sq = tile
+        xb_c = xb.astype(cd)
+        prod = lax.psum(
+            jnp.matmul(xb_c, c_t, preferred_element_type=f32,
+                       precision=matmul_precision(cd)),
+            feature_axis,
+        )                                                    # (chunk, k_loc)
+        part = jnp.where(
+            valid_col[None, :], c_sq[None, :] - 2.0 * prod, jnp.inf
+        )
+        lab_l = jnp.argmin(part, axis=1).astype(jnp.int32)
+        mind_l = jnp.min(part, axis=1)
+        g = lax.pmin(mind_l, model_axis)
+        cand = jnp.where(mind_l == g, lab_l + k_off, k_pad_total)
+        lab_g = lax.pmin(cand, model_axis).astype(jnp.int32)
+        mind_g = jnp.maximum(g + xb_sq, 0.0)
+        inertia = inertia + jnp.sum(mind_g * wb)
+        # Slice-local update: the shared shard-relative fold, with xb
+        # carrying only this shard's d-slice.
+        sums, counts = _accumulate_k_slice(
+            sums, counts, lab_g - k_off, xb, xb_c, wb,
+            k_loc=k_loc, update=update, cd=cd,
+        )
+        return (sums, counts, inertia), (
+            lab_g if with_labels else 0,
+            mind_g if empty == "farthest" else 0,
+        )
+
+    init = (jnp.zeros((k_loc, d_loc), f32), jnp.zeros((k_loc,), f32),
+            jnp.zeros((), f32))
+    (sums, counts, inertia), (labs, minds) = lax.scan(body, init, (xs, ws,
+                                                                   xs_sq))
+
+    sums = lax.psum(sums, data_axis)
+    counts = lax.psum(counts, data_axis)
+    inertia = lax.psum(inertia, data_axis)
+    new_c_loc = _apply_center_update(c_loc, sums, counts,
+                                     center_update=center_update,
+                                     feature_axis=feature_axis)
+    if empty == "farthest":
+        # min_d2 is replicated across BOTH model and feature groups; each
+        # (model, feature) member runs the identical nomination over the
+        # data axis and claims its own (k-slice, d-slice) block of the
+        # winners — the same replication arguments as the TP and FP
+        # reseeds, composed.
         mind_rows = minds.reshape(-1)[:n_loc]
         masked = jnp.where(w_loc > 0, mind_rows, -jnp.inf)
         new_c_loc = _reseed_empty_farthest_tp(
@@ -620,11 +729,9 @@ def fit_lloyd_sharded(
             "spherical fits keep degenerate clusters (matching "
             "fit_spherical); empty='farthest' is a Lloyd policy"
         )
-    if model_axis is not None and feature_axis is not None:
-        raise ValueError(
-            "model_axis (TP over k) and feature_axis (FP over d) are "
-            "mutually exclusive on one fit; pick the axis that is too big"
-        )
+    # model_axis (TP over k) and feature_axis (FP over d) compose: both set
+    # runs the 3-axis DP×TP×FP body (_tpfp_local_pass) for the corner where
+    # k·d over-fills HBM on every single extra axis (VERDICT r2 item 7).
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     dp = axis_sizes[data_axis]
     mp = axis_sizes[model_axis] if model_axis else 1
@@ -677,12 +784,9 @@ def fit_lloyd_sharded(
     k_pad = (-k) % mp
     if k_pad:
         c0 = jnp.concatenate([c0, jnp.zeros((k_pad, x.shape[1]), jnp.float32)])
-    if feature_axis:
-        c_spec = P(None, feature_axis)
-    elif model_axis:
-        c_spec = P(model_axis)
-    else:
-        c_spec = P()
+    # None components partition nothing, so this single spec covers DP
+    # (P(None, None) == replicated), TP, FP, and the 3-axis composition.
+    c_spec = P(model_axis, feature_axis)
     c0 = jax.device_put(c0, NamedSharding(mesh, c_spec))
 
     tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
@@ -703,7 +807,18 @@ def fit_lloyd_sharded(
     update = cfg.update
     if update == "matmul" and not w_exact:
         update = "segment"
-    if model_axis or feature_axis:
+    if model_axis and feature_axis:
+        # No Mosaic body for the 3-axis composition (the XLA
+        # partial-contraction + two-pmin body is the only lowering): the
+        # per-shard operands are k/mp × d/fp slices, so VMEM pressure is
+        # not the concern that motivated the 2-axis kernels.
+        if cfg.backend not in ("auto", "xla"):
+            raise ValueError(
+                "backend='pallas' is not available for the combined "
+                "model_axis+feature_axis fit; use backend='auto' or 'xla'"
+            )
+        backend = "xla"
+    elif model_axis or feature_axis:
         k_gate = (k + k_pad) // mp if model_axis else k
         backend = _resolve_sharded_backend(
             cfg.backend, plat, d=x.shape[1], k_slice=k_gate,
@@ -739,7 +854,25 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
     the compiled executable (jax.jit caches by function identity)."""
     use_pallas = backend in ("pallas", "pallas_interpret")
     interpret = backend == "pallas_interpret"
-    if feature_axis is not None:
+    if model_axis is not None and feature_axis is not None:
+        local = functools.partial(
+            _tpfp_local_pass,
+            data_axis=data_axis,
+            model_axis=model_axis,
+            feature_axis=feature_axis,
+            k_real=k_real,
+            chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            update=update,
+            empty=empty,
+            center_update=center_update,
+        )
+        in_specs = (P(data_axis, feature_axis),
+                    P(model_axis, feature_axis), P(data_axis))
+        out_step = (P(model_axis, feature_axis), P(), P(model_axis))
+        out_final = (P(model_axis, feature_axis), P(), P(model_axis),
+                     P(data_axis))
+    elif feature_axis is not None:
         if use_pallas:
             local = functools.partial(
                 _fp_local_pass_pallas,
@@ -1624,6 +1757,67 @@ def sharded_assign(
     return labels[:n], mind[:n]
 
 
+@functools.lru_cache(maxsize=32)
+def _build_minibatch_run(mesh, data_axis, b_loc, steps, compute_dtype,
+                         n, n_pad):
+    """Jitted sharded minibatch program: ZERO per-step row gathers.
+
+    VERDICT r2 item 4: the previous path drew each global batch by index
+    across shards and leaned on GSPMD to turn the gather into collective
+    traffic — per step, batch_size·d bytes crossed the ICI.  Here each
+    shard samples ``b_loc`` of its OWN rows (shard-local gather), computes
+    the batch's per-cluster stats locally, and the only per-step
+    collective is the (k,)+(k, d) ``psum`` of those stats — the same
+    traffic shape as a full-batch Lloyd step, independent of batch size.
+
+    Stratified-to-uniform correction: shard i draws b_loc rows of its
+    n_valid_i real rows, so each contribution is importance-weighted by
+    ``s_i = n_valid_i·dp/n`` (≈1 everywhere except the padding-carrying
+    tail shard; exactly 0 on an all-padding shard).  Then E[stats] equals
+    the global-uniform sampler's row for row, and the Sculley update is
+    unchanged — fractional counts are already its native currency.
+    """
+    from kmeans_tpu.models.minibatch import apply_batch_stats, batch_stats
+
+    f32 = jnp.float32
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+    n_loc = n_pad // dp
+
+    def local(x_loc, c0, key):
+        k = c0.shape[0]
+        i_sh = lax.axis_index(data_axis)
+        n_valid = jnp.clip(n - i_sh * n_loc, 0, n_loc)
+        s_i = jnp.where(n_valid > 0, n_valid.astype(f32) * dp / n, 0.0)
+        safe_hi = jnp.maximum(n_valid, 1)
+
+        def step(carry, i):
+            c, n_seen = carry
+            bkey = jax.random.fold_in(jax.random.fold_in(key, i), i_sh)
+            idx = jax.random.randint(bkey, (b_loc,), 0, safe_hi)
+            bc, bs, _ = batch_stats(
+                c, x_loc[idx], compute_dtype=compute_dtype, row_weight=s_i,
+            )
+            bc = lax.psum(bc, data_axis)
+            bs = lax.psum(bs, data_axis)
+            c, n_seen, shift_sq = apply_batch_stats(c, n_seen, bc, bs)
+            return (c, n_seen), shift_sq
+
+        (c, _), shifts = lax.scan(
+            step, (c0.astype(f32), jnp.zeros((c0.shape[0],), f32)),
+            jnp.arange(steps),
+        )
+        last = shifts[-1] if steps > 0 else jnp.asarray(jnp.inf, f32)
+        return c, last
+
+    run = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(run)
+
+
 def fit_minibatch_sharded(
     x,
     k: int,
@@ -1638,14 +1832,13 @@ def fit_minibatch_sharded(
 ) -> KMeansState:
     """Sharded minibatch k-means (BASELINE config 5).
 
-    Points live sharded over ``data_axis``; each step draws a global batch by
-    index (XLA turns the gather into collective traffic), runs the batch
-    update with replicated centroids, and the final labeling pass reuses the
-    sharded assign.  The per-step compute is small next to the gather, so
-    this path leans on GSPMD rather than hand-written collectives.
+    Points live sharded over ``data_axis``; each step samples SHARD-LOCAL
+    rows (no cross-ICI row movement — see :func:`_build_minibatch_run`),
+    reduces the batch's per-cluster stats with one ``psum``, and the final
+    labeling pass reuses the sharded assign.  The effective global batch is
+    ``batch_size`` rounded down to a multiple of the data-axis size (at
+    least one row per shard).
     """
-    from kmeans_tpu.models.minibatch import _minibatch_loop
-
     cfg, key = resolve_fit_config(k, key, config)
     ikey, lkey = jax.random.split(key)
 
@@ -1676,22 +1869,26 @@ def fit_minibatch_sharded(
             chunk_size=cfg.chunk_size,
         )
 
-    state = _minibatch_loop(
-        x, c0, lkey,
-        batch_size=batch_size if batch_size is not None else cfg.batch_size,
-        steps=steps if steps is not None else cfg.steps,
-        chunk_size=cfg.chunk_size,
-        compute_dtype=cfg.compute_dtype,
-        n_valid=n,
-        with_final=False,
+    bs_eff = batch_size if batch_size is not None else cfg.batch_size
+    steps_eff = steps if steps is not None else cfg.steps
+    dp = axis_sizes[data_axis]
+    b_loc = max(1, int(bs_eff) // dp)
+    run = _build_minibatch_run(
+        mesh, data_axis, b_loc, int(steps_eff), cfg.compute_dtype,
+        n, x.shape[0],
     )
+    c0 = jax.device_put(jnp.asarray(c0, jnp.float32),
+                        NamedSharding(mesh, P()))
+    centroids, last_shift = run(x, c0, lkey)
+    converged = (last_shift <= 0.0) if steps_eff > 0 else jnp.asarray(False)
     labels, mind = sharded_assign(
-        x, state.centroids, mesh=mesh, data_axis=data_axis,
+        x, centroids, mesh=mesh, data_axis=data_axis,
         chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
     )
     labels, mind = labels[:n], mind[:n]
     inertia = jnp.sum(mind)
     counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), labels, k)
     return KMeansState(
-        state.centroids, labels, inertia, state.n_iter, state.converged, counts
+        centroids, labels, inertia,
+        jnp.asarray(steps_eff, jnp.int32), converged, counts
     )

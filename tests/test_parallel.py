@@ -331,12 +331,18 @@ def test_dp_fp_farthest_reseed_matches_single_device(cpu_devices):
     )
 
 
-def test_fp_and_tp_mutually_exclusive(problem, cpu_devices):
+def test_fp_and_tp_compose_rejects_explicit_pallas(problem, cpu_devices):
+    # model_axis+feature_axis now COMPOSE (the 3-axis body; r2 item 7) —
+    # but there is no Mosaic body for it, so an explicit pallas request
+    # must fail loudly rather than silently running XLA.
+    from kmeans_tpu.config import KMeansConfig
+
     x, c0 = problem
     mesh = cpu_mesh((2, 2, 2), ("data", "model", "feature"))
-    with pytest.raises(ValueError, match="mutually exclusive"):
+    with pytest.raises(ValueError, match="not available"):
         fit_lloyd_sharded(x, 5, mesh=mesh, init=c0, model_axis="model",
-                          feature_axis="feature")
+                          feature_axis="feature",
+                          config=KMeansConfig(k=5, backend="pallas"))
 
 
 @pytest.mark.parametrize("kw", [
@@ -734,3 +740,181 @@ def test_mesh_from_config_and_make_mesh_validation(cpu_devices):
     with pytest.raises(ValueError, match="needs"):
         make_mesh((64, 2), devices=jax.devices("cpu"))
 
+
+
+def test_balanced_sharded_exact_labels_no_near_ties(cpu_devices):
+    """VERDICT r2 item 8: pin the sharded-balanced parity contract.
+
+    The distributed logsumexp reorders accumulation, so labels can flip
+    only on near-tie rows.  Construct a case with NO near-ties —
+    well-separated equal-mass blobs, ~100 apart vs std 0.5, balanced
+    capacities already satisfied by geometry — and require labels to
+    match single-device EXACTLY.  The to-tolerance path stays for the
+    general case (dryrun's <=1% mismatch bound)."""
+    from kmeans_tpu.models import fit_balanced
+    from kmeans_tpu.parallel.engine import fit_balanced_sharded
+
+    rng = np.random.default_rng(7)
+    k, per, d = 4, 60, 8
+    centers = (np.eye(k, d) * 100.0).astype(np.float32)
+    x = np.concatenate([
+        centers[i] + rng.normal(scale=0.5, size=(per, d)).astype(np.float32)
+        for i in range(k)
+    ])
+    x = x[rng.permutation(len(x))]
+    c0 = centers + rng.normal(scale=0.1, size=centers.shape).astype(
+        np.float32)
+
+    want = fit_balanced(jnp.asarray(x), k, init=jnp.asarray(c0),
+                        epsilon=0.05, max_iter=10)
+    got = fit_balanced_sharded(x, k, mesh=cpu_mesh((8, 1)), init=c0,
+                               epsilon=0.05, max_iter=10)
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    # Geometry already balanced -> every cluster holds its share exactly.
+    assert np.bincount(np.asarray(got.labels), minlength=k).tolist() == \
+        [per] * k
+
+
+@pytest.mark.parametrize("empty", ["keep", "farthest"])
+def test_tpfp_three_axis_matches_single_device(cpu_devices, empty):
+    """DP×TP×FP on a (2, 2, 2) mesh (VERDICT r2 item 7): k=5 pads over
+    mp=2, d=7 pads over fp=2, and labels must still match single-device
+    exactly (feature psum inside the TP score preserves the distance
+    values; the two-pmin combine preserves the argmin tie-break)."""
+    from kmeans_tpu.config import KMeansConfig
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(403, 7)).astype(np.float32) * 3
+    # Duplicate first rows in the init so empty="farthest" has work to do.
+    c0 = np.stack([x[0], x[0], x[1], x[2], x[3]]).astype(np.float32)
+    cfg = KMeansConfig(k=5, empty=empty, tol=1e-10, max_iter=12)
+    want = fit_lloyd(jnp.asarray(x), 5, init=jnp.asarray(c0), config=cfg)
+    mesh = cpu_mesh((2, 2, 2), ("data", "model", "feature"))
+    got = fit_lloyd_sharded(
+        x, 5, mesh=mesh, model_axis="model", feature_axis="feature",
+        init=c0, config=cfg,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(got.counts),
+                               np.asarray(want.counts), rtol=1e-5)
+
+
+def test_tpfp_three_axis_blobs_segment_update(cpu_devices):
+    """3-axis with the segment-reduction update flavor and a (2, 2, 2)
+    mesh on real blobs; n chosen so row padding is exercised."""
+    from kmeans_tpu.config import KMeansConfig
+
+    x, _, _ = make_blobs(jax.random.key(9), 514, 12, 4, cluster_std=0.6)
+    x = np.asarray(x)
+    c0 = x[:4].copy()
+    cfg = KMeansConfig(k=4, update="segment", tol=1e-10, max_iter=15)
+    want = fit_lloyd(jnp.asarray(x), 4, init=jnp.asarray(c0), config=cfg)
+    mesh = cpu_mesh((2, 2, 2), ("data", "model", "feature"))
+    got = fit_lloyd_sharded(
+        x, 4, mesh=mesh, model_axis="model", feature_axis="feature",
+        init=c0, config=cfg,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.labels), np.asarray(want.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.centroids), np.asarray(want.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_tpfp_three_axis_spherical_unit_norms(cpu_devices):
+    """Spherical on the 3-axis mesh: the sphere renorm needs the extra
+    feature-axis psum of per-slice squared norms; global centroid norms
+    must come out exactly 1."""
+    from kmeans_tpu.parallel import fit_spherical_sharded
+
+    x, _, _ = make_blobs(jax.random.key(4), 260, 12, 4, cluster_std=0.5)
+    x = np.asarray(x)
+    mesh = cpu_mesh((2, 2, 2), ("data", "model", "feature"))
+    sp = fit_spherical_sharded(
+        x, 4, mesh=mesh, model_axis="model", feature_axis="feature",
+        init=x[:4].copy(), max_iter=5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(sp.centroids ** 2, axis=1)), 1.0, rtol=1e-4
+    )
+
+
+def test_sharded_minibatch_step_has_no_row_gather(cpu_devices):
+    """VERDICT r2 item 4: the per-step collective story must be the (k,) +
+    (k, d) stats psum ONLY — no batch rows cross the ICI.  Pin it in the
+    compiled HLO: all-reduce is allowed, all-gather / all-to-all /
+    collective-permute / gather-style collectives are not."""
+    from kmeans_tpu.parallel.engine import _build_minibatch_run
+
+    mesh = cpu_mesh((8, 1))
+    run = _build_minibatch_run(mesh, "data", 32, 10, None, 2000, 2000)
+    x = jnp.zeros((2000, 16), jnp.float32)
+    c0 = jnp.zeros((6, 16), jnp.float32)
+    hlo = run.lower(
+        jax.device_put(x, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("data"))),
+        jax.device_put(c0, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())),
+        jax.random.key(0),
+    ).compile().as_text()
+    assert "all-reduce" in hlo            # the stats psum
+    for banned in ("all-gather", "all-to-all", "collective-permute"):
+        assert banned not in hlo, f"{banned} found in sharded minibatch step"
+
+
+def test_sharded_minibatch_matches_single_device_stationary(cpu_devices):
+    """Distributional equivalence: per-shard stratified sampling must reach
+    the same stationary behavior as the single-device global sampler on
+    well-separated blobs — same final label partition (up to the argmin
+    assignment both paths share) and inertia within a few percent."""
+    from kmeans_tpu.models import fit_minibatch
+
+    from kmeans_tpu.metrics import adjusted_rand_index
+
+    x, _, centers = make_blobs(jax.random.key(13), 4003, 10, 5,
+                               cluster_std=0.2)
+    x = np.asarray(x)
+    # True centers as the shared init: both samplers then converge to the
+    # SAME optimum and the comparison isolates the sampling scheme (x[:5]
+    # can seed two centers in one blob, where the two RNG streams settle
+    # into different local minima).
+    c0 = np.asarray(centers)
+    want = fit_minibatch(jnp.asarray(x), 5, init=jnp.asarray(c0),
+                         batch_size=256, steps=60)
+    got = fit_minibatch_sharded(x, 5, mesh=cpu_mesh((8, 1)), init=c0,
+                                batch_size=256, steps=60)
+    # Different RNG streams -> different sample paths; stationary behavior
+    # is the contract: same partition (ARI) and matching inertia.
+    ari = float(adjusted_rand_index(np.asarray(got.labels),
+                                    np.asarray(want.labels)))
+    assert ari > 0.99, ari
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=0.05)
+
+
+def test_sharded_minibatch_uneven_tail_shard(cpu_devices):
+    """n chosen so the last shard is mostly padding: importance weights
+    keep the update sane and the final assignment labels all real rows."""
+    x, _, _ = make_blobs(jax.random.key(14), 1801, 8, 4, cluster_std=0.3)
+    x = np.asarray(x)
+    state = fit_minibatch_sharded(x, 4, mesh=cpu_mesh((8, 1)),
+                                  batch_size=64, steps=30)
+    assert state.labels.shape == (1801,)
+    assert np.all(np.asarray(state.counts) > 0)
+    assert np.isfinite(float(state.inertia))

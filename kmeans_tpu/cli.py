@@ -475,7 +475,8 @@ def _cmd_serve(args) -> int:
     print(f"serving on http://{args.host}:{args.port}/ (Ctrl-C to stop)",
           file=sys.stderr)
     try:
-        serve(args.host, args.port, background=False)
+        serve(args.host, args.port, background=False,
+              persist_dir=args.persist_dir or None)
     except KeyboardInterrupt:
         pass
     return 0
@@ -595,6 +596,9 @@ def main(argv=None) -> int:
     s = sub.add_parser("serve", help="run the HTTP/SSE visualizer server")
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=8787)
+    s.add_argument("--persist-dir", default=".kmeans_rooms", metavar="DIR",
+                   help="directory for durable rooms (reloaded on restart; "
+                        "pass '' to disable)")
     s.set_defaults(fn=_cmd_serve)
 
     b = sub.add_parser("bench", help="run the benchmark (one JSON line)")

@@ -172,6 +172,14 @@ class ServeConfig:
     #: unbounded board into memory — metrics snapshots are O(n²) per
     #: cluster, so card count is bounded by max_render_cards on import too.
     max_import_bytes: int = 4 * 1024 * 1024
+    #: Room durability (VERDICT r2 item 3): directory where each room is
+    #: persisted as its export JSON (atomic tmp+rename, debounced on
+    #: version bumps) and reloaded from on boot.  None disables — the
+    #: reference survives server death through its peers' CRDT replicas;
+    #: the server-authoritative rewrite survives through this directory.
+    persist_dir: Optional[str] = None
+    #: Seconds of quiet after a version bump before the room is written.
+    persist_debounce_s: float = 0.5
 
 
 @dataclasses.dataclass(frozen=True)

@@ -50,7 +50,19 @@ async function fetchState() {
     if (!r.ok) { renderAll(); return; }   // server up but erroring: keep
     state = await r.json();               // the last good board + cache
     degraded = false;
-    try { localStorage.setItem(LS_STATE, JSON.stringify(state)); } catch {}
+    // Durability on reconnect (reference parity with the CRDT design: a
+    // surviving peer replays full state, app.mjs:96): if the server doc
+    // is FRESH (a restart without persistence — just the Jessica seed)
+    // and our cache holds a richer board, restore the cache into the
+    // room instead of letting the fresh doc overwrite it.
+    const restore = await maybeRestoreCache();
+    if (restore === "restored") return;     // refetches after the import
+    // A FAILED restore attempt must leave the cache untouched (it is the
+    // only surviving replica; caching the fresh seed doc here would
+    // destroy it with no retry possible).
+    if (restore !== "failed") {
+      try { localStorage.setItem(LS_STATE, JSON.stringify(state)); } catch {}
+    }
   } catch {
     if (!state) {
       try { state = JSON.parse(localStorage.getItem(LS_STATE)); } catch {}
@@ -58,6 +70,40 @@ async function fetchState() {
     degraded = true;
   }
   renderAll();
+}
+
+let restoringCache = false;
+// Returns "none" (no restore applicable), "restored", or "failed" (a
+// restore was ATTEMPTED and did not land — the caller must not overwrite
+// the cache in that case).
+async function maybeRestoreCache() {
+  if (restoringCache) return "none";
+  // Fresh server doc = version <=1 (the Jessica seed bump only).
+  if (!state || state.version > 1) return "none";
+  let cached = null;
+  try { cached = JSON.parse(localStorage.getItem(LS_STATE)); } catch {}
+  if (!cached || !Array.isArray(cached.cards)) return "none";
+  const richer = cached.cards.length > (state.cards || []).length
+    || (cached.centroids || []).length > (state.centroids || []).length;
+  if (!richer) return "none";
+  restoringCache = true;
+  try {
+    const r = await fetch(api("/api/import"), {
+      method: "POST",
+      body: JSON.stringify({
+        cards: cached.cards,
+        centroids: cached.centroids || [],
+        meta: cached.meta || {},
+      }),
+    });
+    if (!r.ok) return "failed";
+    await fetchState();
+    return "restored";
+  } catch {
+    return "failed";
+  } finally {
+    restoringCache = false;
+  }
 }
 async function mutate(op, args = {}) {
   if (degraded) {
@@ -114,14 +160,20 @@ function connectEvents() {
     if (msg.type === "train" || msg.type === "train_done" || msg.type === "train_error") {
       const t = $id("trainStatus");
       t.style.display = "";
-      if (msg.type === "train")
+      if (msg.type === "train") {
         // Non-lloyd families send a start marker without inertia/seconds.
         t.textContent = msg.inertia === undefined
           ? `training ${msg.model || ""}…`
           : `iter ${msg.iteration}: inertia ${msg.inertia.toFixed(1)} (${(msg.seconds * 1000).toFixed(0)}ms)`;
-      else if (msg.type === "train_done")
-        t.textContent = `done: ${msg.n_iter} iters, inertia ${msg.inertia.toFixed(1)}${msg.converged ? " ✓" : ""}`;
-      else t.textContent = `train failed: ${msg.error}`;
+        // d=2 lloyd fits stream normalized centroid positions: animate
+        // them over the board so the Lloyd loop is WATCHABLE.
+        if (Array.isArray(msg.centroids)) renderTrainOverlay(msg.centroids);
+      } else if (msg.type === "train_done") {
+        t.textContent = `done: ${msg.n_iter} iters, k=${msg.k ?? "?"}, inertia ${msg.inertia.toFixed(1)}${msg.converged ? " ✓" : ""}`;
+        // Board refetch replaces the overlay with the imported result;
+        // fade the trajectory out after a beat.
+        setTimeout(clearTrainOverlay, 2500);
+      } else t.textContent = `train failed: ${msg.error}`;
     }
   };
   es.onerror = () => {
@@ -132,6 +184,47 @@ function connectEvents() {
     setStatusChip(true);
   };
   return es;
+}
+
+// ---------- live training overlay ----------
+// One absolutely-positioned dot per centroid over the board; positions are
+// normalized [0,1]² server-side, and the CSS transition makes consecutive
+// SSE train events read as smooth movement.
+function renderTrainOverlay(centroids) {
+  const root = $id("canvas");
+  if (!root) return;
+  // (document.getElementById, not $id: the overlay is created
+  // dynamically and is deliberately outside the static id contract.)
+  let layer = document.getElementById("trainOverlay");
+  if (!layer) {
+    root.style.position = "relative";
+    layer = document.createElement("div");
+    layer.id = "trainOverlay";
+    layer.style.cssText =
+      "position:absolute;inset:0;pointer-events:none;z-index:5;";
+    root.appendChild(layer);
+  }
+  while (layer.children.length > centroids.length)
+    layer.removeChild(layer.lastChild);
+  centroids.forEach(([cx, cy], i) => {
+    let dot = layer.children[i];
+    if (!dot) {
+      dot = document.createElement("div");
+      dot.style.cssText =
+        "position:absolute;width:14px;height:14px;border-radius:50%;" +
+        "margin:-7px 0 0 -7px;border:2px solid #fff;opacity:.9;" +
+        "box-shadow:0 0 6px rgba(0,0,0,.5);" +
+        "transition:left .25s linear,top .25s linear;";
+      dot.style.background = `hsl(${(i * 137.5) % 360} 70% 55%)`;
+      layer.appendChild(dot);
+    }
+    dot.style.left = `${(cx * 100).toFixed(2)}%`;
+    dot.style.top = `${((1 - cy) * 100).toFixed(2)}%`;
+  });
+}
+function clearTrainOverlay() {
+  const layer = document.getElementById("trainOverlay");
+  if (layer) layer.remove();
 }
 
 // ---------- status / presence ----------
@@ -428,8 +521,16 @@ $id("restartAll").addEventListener("click", () => mutate("restartAll"));
 $id("tpuAssign").addEventListener("click", () => mutate("autoAssign", {
   outliers: Math.max(0, parseInt($id("trimOutliers").value, 10) || 0),
 }));
-$id("tpuTrain").addEventListener("click", () =>
-  mutate("train", { n: 500, d: 2, k: 3, model: $id("trainModel").value }));
+$id("tpuTrain").addEventListener("click", () => {
+  // Scale controls (server-validated against the work caps: n·d <= 8e6,
+  // O(n²) families tighter): the one place the TPU scale story is
+  // exercisable from the reference's own UI.
+  const n = Math.max(10, parseInt($id("trainN").value, 10) || 500);
+  const d = Math.max(1, parseInt($id("trainD").value, 10) || 2);
+  const k = Math.max(1, parseInt($id("trainK").value, 10) || 3);
+  clearTrainOverlay();
+  mutate("train", { n, d, k, model: $id("trainModel").value });
+});
 $id("saveName").addEventListener("click", () => {
   myName = $id("name").value.trim() || myName;
   localStorage.setItem(LS_NAME, myName);

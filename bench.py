@@ -454,9 +454,82 @@ def _arm_init_watchdog(metric: str, unit: str, timeout_s: float = 180.0):
     return disarm
 
 
+def bench_input_file(path, k, *, iters=10, chunk_size=None, verbose=True,
+                     backend="auto", compute_dtype="bfloat16"):
+    """Cluster a REAL feature matrix from ``path`` (.npy, rows = samples):
+    one full fit (k-means|| + Lloyd to sklearn-tol convergence) plus the
+    sustained iteration rate at that shape.  This is how the five BASELINE
+    configs run the moment real data exists (VERDICT.md r2 item 2).
+
+    The full-batch fit materializes the matrix on host and device, so it
+    needs host RAM (and HBM) >= the matrix; for larger-than-RAM inputs
+    use the streamed CLI path instead
+    (``python -m kmeans_tpu.cli train --input f.npy --stream``).
+
+    Returns the result dict (also printed as the JSON artifact by main).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import fit_lloyd, kmeans_parallel
+
+    mm = np.load(path, mmap_mode="r")
+    if mm.ndim != 2:
+        raise ValueError(f"--input expects a 2-D (n, d) .npy; got {mm.shape}")
+    n, d = mm.shape
+    if chunk_size is None:
+        chunk_size = min(65536, max(4096, 1 << max(0, (n - 1).bit_length() - 3)))
+    x = jnp.asarray(np.ascontiguousarray(mm), dtype=jnp.bfloat16
+                    if compute_dtype == "bfloat16" else jnp.float32)
+    cfg = KMeansConfig(k=k, chunk_size=chunk_size,
+                       compute_dtype=compute_dtype, backend=backend,
+                       max_iter=300)
+    sub = x[: min(n, max(64 * k, 65536))]
+    tol_abs = 1e-4 * float(jnp.mean(jnp.var(sub.astype(jnp.float32),
+                                            axis=0)))
+
+    def full_fit(seed):
+        c0 = kmeans_parallel(jax.random.key(seed), x, k,
+                             compute_dtype=compute_dtype,
+                             chunk_size=chunk_size)
+        c0.block_until_ready()
+        state = fit_lloyd(x, k, init=c0, tol=tol_abs, config=cfg)
+        state.centroids.block_until_ready()
+        return state
+
+    full_fit(0)                                  # compile warm-up
+    t0 = time.perf_counter()
+    state = full_fit(1)
+    dt = time.perf_counter() - t0
+    rate = bench_lloyd_iters_per_s(n, d, k, iters=iters,
+                                   chunk_size=chunk_size, verbose=verbose,
+                                   backend=backend)
+    out = {
+        "metric": f"real_input_fit@{os.path.basename(path)},n={n},d={d},k={k}",
+        "value": round(dt, 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "n_iter": int(state.n_iter),
+        "converged": bool(state.converged),
+        "inertia": float(state.inertia),
+        "lloyd_iters_per_sec": round(rate, 3),
+    }
+    if verbose:
+        print(f"  {path}: converge {dt:.2f}s in {out['n_iter']} iters, "
+              f"{rate:.2f} iter/s at (n={n}, d={d}, k={k})",
+              file=sys.stderr)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--all", action="store_true", help="run all 5 configs")
+    ap.add_argument("--input", default=None, metavar="PATH.npy",
+                    help="cluster a real (n, d) feature matrix instead of "
+                         "synthetic shapes; requires --k")
+    ap.add_argument("--k", type=int, default=None,
+                    help="number of clusters for --input")
     ap.add_argument("--converge", action="store_true",
                     help="only the wall-clock-of-a-full-fit metric "
                          "(k-means|| seeding + Lloyd to tol)")
@@ -499,6 +572,14 @@ def main():
     n_chips = len(jax.devices())
     watchdog.set()          # backend is alive — disarm
     print(f"platform={dev.platform} devices={n_chips}", file=sys.stderr)
+
+    if args.input is not None:
+        if args.k is None:
+            ap.error("--input requires --k")
+        print(json.dumps(bench_input_file(
+            args.input, args.k, iters=args.iters, backend=args.backend,
+        )))
+        return
 
     if args.all:
         from kmeans_tpu.data import BENCH_CONFIGS
@@ -595,7 +676,11 @@ def main():
             line["converge_error"] = conv["error"]
     if pallas_check is not None:
         line["pallas_vs_xla"] = pallas_check
-    if dev.platform == "tpu" and line.get("value") is not None:
+    # Record only full runs (the merged line with both halves): an
+    # --iters-only artifact would otherwise shadow a richer record as the
+    # newest carry-forward source.
+    if (dev.platform == "tpu" and line.get("value") is not None
+            and line.get("wallclock_to_converge_s") is not None):
         _record_local(line)
     print(json.dumps(line))
 

@@ -69,6 +69,8 @@ def _pallas_ok(x, k, *, weights, weights_are_binary, compute_dtype,
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
     # The kernel's one-hot tile is cast to cd for the MXU — exact only per
     # the shared weights_exact policy (mirrors the XLA eff_update demotion).
+    # Unaligned d is the KERNEL's business (zero-column lane padding under
+    # pallas_lloyd.padded_d); pallas_supported prices it in.
     return (
         weights_exact(cd, weights=weights,
                       weights_are_binary=weights_are_binary)
@@ -155,8 +157,9 @@ def lloyd_pass(
         )
         if backend == "pallas" and not ok:
             raise ValueError(
-                "pallas backend unsupported here (needs TPU, d%128==0, "
-                "VMEM-resident (k,d), and binary weights unless f32)"
+                "pallas backend unsupported here (needs TPU, d within 1.5x "
+                "of a 128 multiple, VMEM-resident (k,d), and binary "
+                "weights unless f32)"
             )
         if ok:
             return lloyd_pass_pallas(

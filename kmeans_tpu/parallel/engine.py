@@ -683,8 +683,8 @@ def _resolve_sharded_backend(req, platform, *, d, k_slice, x_itemsize,
         reason = ("fractional weights need float32 compute (the kernels "
                   "cast the one-hot tile to the compute dtype)"
                   if not weights_exact
-                  else f"needs d % 128 == 0 and VMEM-resident "
-                       f"(k_slice={k_slice}, d={d})")
+                  else f"needs d lane-alignable within the 1.5x zero-pad "
+                       f"cap and VMEM-resident (k_slice={k_slice}, d={d})")
         raise ValueError(
             f"pallas backend unsupported for this sharded fit: {reason}"
         )

@@ -66,15 +66,17 @@ def test_pallas_assignment_only(rng):
     np.testing.assert_allclose(float(inertia), float(wi), rtol=2e-5)
 
 
-def test_pallas_requires_lane_aligned_d(rng):
-    x, c = _pair(rng, 64, 100, 3)
-    with pytest.raises(ValueError, match="d % 128"):
+def test_pallas_rejects_unpaddable_d(rng):
+    # d=2 would inflate 64x under lane padding — rejected, not padded.
+    x, c = _pair(rng, 64, 2, 3)
+    with pytest.raises(ValueError, match="lane-alignable"):
         lloyd_pass_pallas(x, c, interpret=True)
 
 
 def test_pallas_supported_gates():
     assert pallas_supported(10_000, 2048, 1000)        # north-star shape
-    assert not pallas_supported(10_000, 100, 10)       # d not lane-aligned
+    assert pallas_supported(10_000, 100, 10)           # pads 100 -> 128
+    assert not pallas_supported(10_000, 2, 3)          # 64x pad inflation
     assert not pallas_supported(10_000, 8192, 8192)    # (k, d) > VMEM budget
 
 
@@ -89,3 +91,45 @@ def test_forced_pallas_raises_when_unsupported(rng):
     x, c = _pair(rng, 64, 100, 3)                      # d % 128 != 0
     with pytest.raises(ValueError, match="pallas backend unsupported"):
         lloyd_pass(x, c, backend="pallas")
+
+
+def test_padded_d_gate():
+    """Lane-padding route (r3): unaligned d within 1.5x of a 128 multiple
+    is admitted by the auto gate via zero-column padding; degenerate
+    inflation (d=2 -> 128) is not."""
+    from kmeans_tpu.ops.pallas_lloyd import padded_d
+
+    assert padded_d(300) == 384           # GloVe: 1.28x, admitted
+    assert padded_d(784) == 896           # MNIST: 1.14x, admitted
+    assert padded_d(256) == 256           # aligned: unchanged
+    assert padded_d(2) == 0               # 64x inflation: rejected
+    assert padded_d(100) == 128           # 1.28x, admitted
+
+
+def test_lloyd_pass_pads_unaligned_d_exactly(rng):
+    """Zero-column padding is EXACT: labels/min_d2/counts/inertia match
+    the unpadded XLA pass in interpret-mode f32, and sums come back
+    stripped to (k, d).  The padding lives INSIDE the kernel wrappers, so
+    every caller — single-device dispatch, the TP/FP shard bodies —
+    shares it."""
+    from kmeans_tpu.ops.pallas_lloyd import accumulate_pallas
+
+    n, d, k = 257, 300, 5
+    x, c = _pair(rng, n, d, k)
+    want = lloyd_pass(x, c)
+    got = lloyd_pass_pallas(x, c, interpret=True)
+    assert got[2].shape == (k, d)
+    names = ("labels", "min_d2", "sums", "counts", "inertia")
+    for w, g, name in zip(want, got, names):
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(g), rtol=2e-5, atol=2e-5, err_msg=name
+        )
+
+    # The labeled-accumulation kernel pads under the same policy.
+    sums, counts, _ = accumulate_pallas(
+        x, want[0], k, scores=jnp.zeros((n,)), interpret=True)
+    assert sums.shape == (k, d)
+    np.testing.assert_allclose(np.asarray(sums), np.asarray(want[2]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(counts), np.asarray(want[3]),
+                               rtol=2e-5)

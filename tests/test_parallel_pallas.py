@@ -153,13 +153,17 @@ def test_resolve_sharded_backend_gates():
     assert _resolve_sharded_backend(
         "auto", "tpu", d=128, k_slice=4, x_itemsize=4, compute_dtype=None
     ) == "pallas"
-    # misaligned d -> xla on auto, error when forced.
+    # d=100 lane-pads to 128 inside the kernels (r3) -> pallas on auto.
     assert _resolve_sharded_backend(
         "auto", "tpu", d=100, k_slice=4, x_itemsize=4, compute_dtype=None
+    ) == "pallas"
+    # Unpaddable d (64x inflation) -> xla on auto, error when forced.
+    assert _resolve_sharded_backend(
+        "auto", "tpu", d=2, k_slice=4, x_itemsize=4, compute_dtype=None
     ) == "xla"
     with pytest.raises(ValueError, match="pallas backend unsupported"):
         _resolve_sharded_backend(
-            "pallas", "tpu", d=100, k_slice=4, x_itemsize=4,
+            "pallas", "tpu", d=2, k_slice=4, x_itemsize=4,
             compute_dtype=None,
         )
 

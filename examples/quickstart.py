@@ -7,6 +7,11 @@ TPU. Used by the test suite as an integration smoke (tests/test_cli.py),
 so it cannot rot.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import numpy as np
 
@@ -73,6 +78,23 @@ def main():
                               silhouette_sample=2000)
     print(f"sweep       silhouette-k={kmeans_tpu.suggest_k(rows)} "
           f"elbow-k={kmeans_tpu.suggest_k(rows, criterion='elbow')}")
+
+    # 8. The mesh story on whatever devices exist (8 virtual CPU devices
+    # in CI; real chips on a pod): sharded fit + sharded PCA, labels and
+    # components matching single-device.
+    devs = jax.devices("cpu")
+    if len(devs) >= 8:
+        from kmeans_tpu.parallel import (cpu_mesh, fit_lloyd_sharded,
+                                         pca_fit_sharded)
+
+        mesh = cpu_mesh((4, 2), ("data", "model"))
+        sh = fit_lloyd_sharded(np.asarray(x), 5, mesh=mesh,
+                               model_axis="model",
+                               init=np.asarray(km.cluster_centers_))
+        same = bool(np.array_equal(np.asarray(sh.labels), km.labels_))
+        pst_s = pca_fit_sharded(np.asarray(x), 4, mesh=cpu_mesh((8, 1)))
+        print(f"sharded     dp×tp labels==single-device: {same} "
+              f"pca-var={float(pst_s.explained_variance[0]):.2f}")
 
 
 if __name__ == "__main__":

@@ -95,6 +95,11 @@ def prefetch_to_device(
     queue.  Because the native gather releases the GIL, producer and
     consumer truly run in parallel.  Batch order and values are identical
     either way; producer exceptions re-raise in the consumer.
+
+    ``device`` may be a single device OR any ``jax.sharding.Sharding``
+    (``jax.device_put`` accepts both) — the mesh-sharded streamed fit
+    passes a ``NamedSharding`` so each batch lands row-sharded across the
+    data axis straight off the host.
     """
     if depth < 1:
         raise ValueError(f"depth must be >= 1, got {depth}")

@@ -47,6 +47,34 @@ def _stream_step(centroids, n_seen, xb, *, compute_dtype):
     return centroids, n_after
 
 
+@functools.lru_cache(maxsize=16)
+def _build_stream_step_sharded(mesh, data_axis, compute_dtype):
+    """Mesh analog of :func:`_stream_step`: the host-fed batch arrives
+    row-sharded over ``data_axis``, each shard computes its rows' stats
+    (the same psum-able :func:`batch_stats` half the sharded in-memory
+    loop uses), one ``psum`` merges them, and the Sculley update applies
+    replicated — out-of-core n meets the mesh."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from kmeans_tpu.models.minibatch import apply_batch_stats, batch_stats
+
+    def local(c, n_seen, xb_loc):
+        bc, bsums, _ = batch_stats(c, xb_loc, compute_dtype=compute_dtype)
+        bc = lax.psum(bc, data_axis)
+        bsums = lax.psum(bsums, data_axis)
+        new_c, n_after, _ = apply_batch_stats(c, n_seen, bc, bsums)
+        return new_c, n_after
+
+    run = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P(data_axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(run)
+
+
 def assign_stream(
     data,
     centroids,
@@ -94,8 +122,19 @@ def fit_minibatch_stream(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 100,
     resume: bool = False,
+    mesh=None,
+    data_axis: str = "data",
 ) -> KMeansState:
     """Minibatch k-means over host/disk data of unbounded size.
+
+    With ``mesh`` (a ``jax.sharding.Mesh``), each host batch lands
+    row-sharded over ``data_axis`` straight off PCIe and the update runs
+    as a shard_map (per-shard stats + one psum) — out-of-core n composed
+    with multi-chip k·d.  ``batch_size`` rounds down to a multiple of the
+    data-axis size (at least one row per shard); checkpoints record the
+    RAW requested value plus the shard count, and a resume whose mesh
+    doesn't match the checkpoint's is refused (reduction order and batch
+    rounding both depend on it).
 
     ``data`` is any 2-D array-like with numpy fancy indexing (``np.ndarray``,
     ``np.memmap`` from :func:`kmeans_tpu.data.stream.load_mmap`, h5py-style
@@ -124,6 +163,13 @@ def fit_minibatch_stream(
     cfg, key = resolve_fit_config(k, key, config)
     n, d = data.shape
     bs = batch_size if batch_size is not None else cfg.batch_size
+    # Shard count of this run (0 = single-device).  Recorded in checkpoints
+    # and checked on resume: the batch rounding AND the reduction order
+    # both depend on it, so a mesh-mismatched resume would silently fork
+    # the trajectory.  The rounding itself happens AFTER resume resolution
+    # so raw-vs-raw values compare (code-review r3).
+    dp = (dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+          if mesh is not None else 0)
     n_steps = steps if steps is not None else cfg.steps
     host_seed = seed if seed is not None else cfg.seed
 
@@ -218,6 +264,19 @@ def fit_minibatch_stream(
                     f"transfer_dtype={ck['transfer_width']!r} (or matching "
                     "auto/compute_dtype) to continue this stream"
                 )
+            # Mesh presence/shape changes the stats reduction order AND
+            # the effective batch rounding — refuse a silent fork exactly
+            # as for transfer width.  Missing key = pre-mesh checkpoint =
+            # single-device stream.
+            ck_dp = int(ck.get("mesh_dp", 0))
+            if ck_dp != dp:
+                want = (f"mesh with a {ck_dp}-way data axis" if ck_dp
+                        else "no mesh")
+                raise ValueError(
+                    f"resume mesh (data axis {dp or 'absent'}) contradicts "
+                    f"the checkpoint's ({ck_dp or 'absent'}); continue this "
+                    f"stream with {want}"
+                )
             if start_step > n_steps:
                 raise ValueError(
                     f"checkpoint is at step {start_step} > requested "
@@ -249,17 +308,34 @@ def fit_minibatch_stream(
             step=step, config=cfg,
             extra={"stream": True, "host_seed": int(host_seed),
                    "batch_size": int(bs), "total_steps": int(n_steps),
-                   "transfer_width": transfer_width},
+                   "transfer_width": transfer_width, "mesh_dp": int(dp)},
         )
 
+    # Round AFTER resume resolution: raw batch_size is what checkpoints
+    # record and compare; the mesh_dp guard above pins dp itself.
+    if dp:
+        bs = max(dp, bs - bs % dp)    # even shards, >= one row per shard
+
     c = c0.astype(jnp.float32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        place = NamedSharding(mesh, P(data_axis))
+        step_fn = _build_stream_step_sharded(mesh, data_axis,
+                                             cfg.compute_dtype)
+        c = jax.device_put(c, NamedSharding(mesh, P()))
+        n_seen = jax.device_put(n_seen, NamedSharding(mesh, P()))
+    else:
+        place = None
+        step_fn = functools.partial(_stream_step,
+                                    compute_dtype=cfg.compute_dtype)
     batches = sample_batches(data, bs, n_steps, seed=host_seed,
                              start_step=start_step, to_bf16=to_bf16)
     step = start_step
     for xb in prefetch_to_device(batches, depth=prefetch_depth,
-                                 background=background_prefetch):
-        c, n_seen = _stream_step(c, n_seen, xb,
-                                 compute_dtype=cfg.compute_dtype)
+                                 background=background_prefetch,
+                                 device=place):
+        c, n_seen = step_fn(c, n_seen, xb)
         step += 1
         saver.maybe(step, lambda c=c, ns=n_seen, t=step:
                     checkpoint_now(c, ns, t))

@@ -14,6 +14,7 @@ from kmeans_tpu.parallel.engine import (
     sharded_assign,
 )
 from kmeans_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_from_config
+from kmeans_tpu.parallel.preprocess import pca_fit_sharded
 
 __all__ = [
     "ensure_initialized",
@@ -27,6 +28,7 @@ __all__ = [
     "fit_minibatch_sharded",
     "fit_spherical_sharded",
     "fit_trimmed_sharded",
+    "pca_fit_sharded",
     "sharded_assign",
     "cpu_mesh",
     "make_mesh",

@@ -1,0 +1,121 @@
+"""Mesh-sharded PCA fit (DP over rows).
+
+The CIFAR/ImageNet eval recipes front the clustering with PCA/whitening
+(BASELINE.md; README's real-data recipes), so the preprocessing must scale
+the same way the fits do.  The covariance's sufficient statistics are
+plain sums over rows — the DP story is exactly Lloyd's: shard rows,
+accumulate the CENTERED (Σy, Σyyᵀ) locally (one (d, d) MXU matmul per
+tile), and merge with one ``psum`` per statistic at the end of the pass.
+The (d, d) eigh then runs replicated at host scale, identical to the
+single-device :func:`kmeans_tpu.data.preprocess.pca_fit`.
+
+The pilot mean that kills the uncentered-moment cancellation (ADVICE r2;
+see data/preprocess.py) must be GLOBAL — a per-shard pilot would make the
+correction term shard-dependent — so it comes from one tiny psum over
+every shard's first tile before the scan.
+
+``pca_transform`` needs no sharded variant: it is a row-local matmul, so
+calling it on a row-sharded array lets GSPMD partition it for free.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.data.preprocess import PCAState, _top_eigs
+from kmeans_tpu.ops.distance import chunk_tiles
+
+__all__ = ["pca_fit_sharded"]
+
+
+def _moments_local(x_loc, w_loc, *, data_axis, chunk_size, compute_dtype):
+    """Per-shard centered moments + the global pilot mean (see module doc).
+
+    Returns replicated ``(sum_y (d,), sum_yyT (d, d), mu0 (d,),
+    n_eff scalar)`` — all four already psum-merged across the data axis.
+    """
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_loc.dtype
+    tiles, ws, _ = chunk_tiles(x_loc, w_loc, chunk_size)
+    d = x_loc.shape[1]
+
+    # Global pilot mean from every shard's first tile (one small psum
+    # pair); any pilot is correct — shift invariance — this one leaves
+    # only the O(std) residual in the carries.
+    w0 = ws[0]
+    s0 = lax.psum(jnp.sum(tiles[0].astype(f32) * w0[:, None], axis=0),
+                  data_axis)
+    c0 = lax.psum(jnp.sum(w0), data_axis)
+    mu0 = s0 / jnp.maximum(c0, 1.0)
+
+    def body(carry, tile):
+        xt, wt = tile
+        s, ss = carry
+        y = (xt.astype(f32) - mu0) * wt[:, None]   # pad rows -> exactly 0
+        t = y.astype(cd)
+        s = s + jnp.sum(y, axis=0)
+        ss = ss + jnp.matmul(t.T, t, preferred_element_type=f32)
+        return (s, ss), None
+
+    (s, ss), _ = lax.scan(
+        body, (jnp.zeros((d,), f32), jnp.zeros((d, d), f32)), (tiles, ws)
+    )
+    n_eff = lax.psum(jnp.sum(w_loc), data_axis)
+    return lax.psum(s, data_axis), lax.psum(ss, data_axis), mu0, n_eff
+
+
+@functools.lru_cache(maxsize=16)
+def _build_moments(mesh, data_axis, chunk_size, compute_dtype):
+    local = functools.partial(
+        _moments_local, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
+    )
+    run = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P(data_axis)),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(run)
+
+
+def pca_fit_sharded(
+    x,
+    n_components: int,
+    *,
+    mesh: Mesh,
+    whiten: bool = False,
+    chunk_size: int = 8192,
+    compute_dtype: Optional[str] = None,
+    data_axis: str = "data",
+) -> PCAState:
+    """:func:`kmeans_tpu.data.preprocess.pca_fit` on a device mesh (DP over
+    rows; one psum of the centered moments per fit).  Components and
+    variances match the single-device fit to float tolerance."""
+    from kmeans_tpu.parallel.engine import _pad_rows
+
+    if not isinstance(x, jax.Array):
+        x = np.asarray(x)          # same array-like coercion as pca_fit
+    n, d = x.shape
+    if not 1 <= n_components <= min(n, d):
+        raise ValueError(
+            f"n_components must be in [1, {min(n, d)}], got {n_components}"
+        )
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+    x, w_host, n = _pad_rows(x, dp)
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+
+    run = _build_moments(mesh, data_axis, chunk_size, compute_dtype)
+    s, ss, mu0, n_eff = run(x, w)
+    mean_y = s / n_eff
+    cov = ss / n_eff - jnp.outer(mean_y, mean_y)
+    comps, top = _top_eigs(cov, n_components)
+    return PCAState(mu0 + mean_y, comps, top, whiten)

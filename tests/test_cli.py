@@ -418,6 +418,7 @@ def test_examples_quickstart_runs(capsys):
     runpy.run_path(path, run_name="__main__")
     out = capsys.readouterr().out
     for stage in ("lloyd", "trimmed", "balanced", "spectral",
-                  "pca+coreset", "merge_to_k", "sweep"):
+                  "pca+coreset", "merge_to_k", "sweep", "sharded"):
         assert stage in out, stage
     assert "junk-trimmed=True" in out
+    assert "labels==single-device: True" in out

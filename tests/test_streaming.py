@@ -250,3 +250,75 @@ def test_stream_resume_rejects_explicit_init_array(tmp_path, mmap_blobs):
     with pytest.raises(ValueError, match="init"):
         fit_minibatch_stream(data, 4, steps=20, init=x[:4],
                              checkpoint_path=ckpt, resume=True)
+
+
+def test_stream_fit_on_mesh_matches_single_device(tmp_path, rng):
+    """Streamed minibatch on a mesh (r3): host batches are a pure function
+    of (seed, step), so the mesh run sees the SAME batch sequence as the
+    single-device run — centroids must agree to float tolerance and the
+    final labels exactly (well-separated blobs)."""
+    import jax
+
+    from kmeans_tpu.parallel import cpu_mesh
+
+    centers = (np.eye(4, 12) * 40.0).astype(np.float32)
+    lab = rng.integers(0, 4, 4096)
+    x = (centers[lab] + rng.normal(scale=0.3, size=(4096, 12))
+         ).astype(np.float32)
+    path = tmp_path / "x.npy"
+    np.save(path, x)
+    mm = np.load(path, mmap_mode="r")
+
+    c0 = centers + rng.normal(scale=0.05, size=centers.shape).astype(
+        np.float32)
+    want = fit_minibatch_stream(mm, 4, init=jnp.asarray(c0),
+                                batch_size=256, steps=30, seed=3)
+    got = fit_minibatch_stream(mm, 4, init=jnp.asarray(c0),
+                               batch_size=256, steps=30, seed=3,
+                               mesh=cpu_mesh((8, 1)))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+
+
+def test_stream_fit_mesh_rounds_batch_to_shards(tmp_path, rng):
+    x = rng.normal(size=(600, 8)).astype(np.float32)
+    np.save(tmp_path / "x.npy", x)
+    mm = np.load(tmp_path / "x.npy", mmap_mode="r")
+    from kmeans_tpu.parallel import cpu_mesh
+
+    # batch_size=100 rounds down to 96 on 8 shards; must run clean.
+    st = fit_minibatch_stream(mm, 3, batch_size=100, steps=10, seed=0,
+                              mesh=cpu_mesh((8, 1)))
+    assert st.centroids.shape == (3, 8)
+    assert np.all(np.isfinite(np.asarray(st.centroids)))
+
+
+def test_stream_fit_mesh_resume_guards(tmp_path, rng):
+    """A checkpoint records its mesh shard count; resuming under a
+    different mesh (or none) is refused — the reduction order and batch
+    rounding both depend on it (code-review r3)."""
+    from kmeans_tpu.parallel import cpu_mesh
+
+    x = rng.normal(size=(512, 8)).astype(np.float32)
+    np.save(tmp_path / "x.npy", x)
+    mm = np.load(tmp_path / "x.npy", mmap_mode="r")
+    ck = str(tmp_path / "ck")
+
+    fit_minibatch_stream(mm, 3, batch_size=64, steps=6, seed=0,
+                         mesh=cpu_mesh((8, 1)), checkpoint_path=ck,
+                         checkpoint_every=2)
+    with pytest.raises(ValueError, match="mesh"):
+        fit_minibatch_stream(mm, 3, batch_size=64, steps=12, seed=0,
+                             checkpoint_path=ck, resume=True)
+    with pytest.raises(ValueError, match="mesh"):
+        fit_minibatch_stream(mm, 3, batch_size=64, steps=12, seed=0,
+                             mesh=cpu_mesh((4, 2)), checkpoint_path=ck,
+                             resume=True)
+    # The matching mesh resumes clean, same raw batch_size.
+    st = fit_minibatch_stream(mm, 3, batch_size=64, steps=12, seed=0,
+                              mesh=cpu_mesh((8, 1)), checkpoint_path=ck,
+                              resume=True)
+    assert int(st.n_iter) == 12

@@ -203,7 +203,7 @@ def _cmd_train(args) -> int:
                      else 0.05)
 
     mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kernel",
-               "kmedoids", "trimmed", "balanced")
+               "kmedoids", "trimmed", "balanced", "xmeans", "gmeans")
     if mesh is not None and model not in mesh_ok:
         print(
             f"error: --mesh supports --model {'/'.join(mesh_ok)}, "
@@ -211,9 +211,12 @@ def _cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.stream and mesh is not None:
-        print("error: --stream and --mesh are mutually exclusive "
-              "(streaming feeds one chip)", file=sys.stderr)
+    if args.stream and mesh is not None and model != "minibatch":
+        # Mesh streaming exists for the minibatch family (host batches
+        # land row-sharded, stats psum per step); the streamed GMM is
+        # still single-device.
+        print("error: --stream --mesh requires --model minibatch",
+              file=sys.stderr)
         return 2
 
     coreset_ok = ("lloyd", "accelerated", "spherical", "bisecting", "fuzzy",
@@ -267,7 +270,14 @@ def _cmd_train(args) -> int:
                 checkpoint_path=args.checkpoint,
                 checkpoint_every=args.checkpoint_every,
             )
-    elif mesh is not None:
+    elif mesh is not None and not args.stream and model in ("xmeans",
+                                                            "gmeans"):
+        # Auto-k on the mesh: the models-level entry takes mesh directly
+        # (every inner fit/assign rides the sharded engine).
+        fit = (models.fit_xmeans if model == "xmeans" else models.fit_gmeans)
+        state = fit(np.asarray(x), k, config=kcfg, mesh=mesh)
+        k = int(state.centroids.shape[0])
+    elif mesh is not None and not args.stream:
         from kmeans_tpu import parallel
 
         fit = {
@@ -304,6 +314,8 @@ def _cmd_train(args) -> int:
         # contradiction resume guarantee actually fires for CLI flags.
         stream_kw = dict(steps=args.steps, batch_size=args.batch_size,
                          seed=args.seed, **ckpt_kw)
+        if mesh is not None:
+            stream_kw["mesh"] = mesh    # out-of-core rows onto the mesh
         fit_stream = (models.fit_gmm_stream if model == "gmm"
                       else models.fit_minibatch_stream)
         try:

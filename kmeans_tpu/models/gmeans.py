@@ -68,6 +68,8 @@ def fit_gmeans(
     key: Optional[jax.Array] = None,
     config: Optional[KMeansConfig] = None,
     max_rounds: int = 16,
+    mesh=None,
+    data_axis: str = "data",
 ) -> KMeansState:
     """Fit G-means: grow k while any cluster's split-axis projection fails
     the Anderson-Darling normality test at significance ``alpha``
@@ -93,6 +95,7 @@ def fit_gmeans(
     # samples, so smaller clusters can never be split — skip their fits.
     return _grow_k(x, k_max, k_min=k_min, key=key, config=config,
                    max_rounds=max_rounds, accept=accept, family="g-means",
+                   mesh=mesh, data_axis=data_axis,
                    min_split_size=8)
 
 

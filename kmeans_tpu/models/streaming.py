@@ -311,10 +311,11 @@ def fit_minibatch_stream(
                    "transfer_width": transfer_width, "mesh_dp": int(dp)},
         )
 
-    # Round AFTER resume resolution: raw batch_size is what checkpoints
-    # record and compare; the mesh_dp guard above pins dp itself.
-    if dp:
-        bs = max(dp, bs - bs % dp)    # even shards, >= one row per shard
+    # Round AFTER resume resolution and WITHOUT rebinding bs: checkpoints
+    # must record/compare the raw requested value (checkpoint_now closes
+    # over bs), while sampling uses the rounded effective size.  The
+    # mesh_dp guard above pins dp itself, so raw+dp determine bs_eff.
+    bs_eff = max(dp, bs - bs % dp) if dp else bs
 
     c = c0.astype(jnp.float32)
     if mesh is not None:
@@ -329,7 +330,7 @@ def fit_minibatch_stream(
         place = None
         step_fn = functools.partial(_stream_step,
                                     compute_dtype=cfg.compute_dtype)
-    batches = sample_batches(data, bs, n_steps, seed=host_seed,
+    batches = sample_batches(data, bs_eff, n_steps, seed=host_seed,
                              start_step=start_step, to_bf16=to_bf16)
     step = start_step
     for xb in prefetch_to_device(batches, depth=prefetch_depth,

@@ -88,13 +88,22 @@ def _grow_k(
     accept,
     family: str,
     min_split_size: int = 4,
+    mesh=None,
+    data_axis: str = "data",
 ) -> KMeansState:
     """The shared improve-params / improve-structure loop of the auto-k
     family (x-means, g-means): fit at the current k, offer every cluster's
     local 2-means split to ``accept(...)``, rebuild from survivors +
     accepted children, repeat.  ``accept`` receives host-side floats
     (n_j, sse_j, n_a, n_b, sse2, d) plus device-side (mask, st2, lab2,
-    mind2, x) and returns whether to take the split."""
+    mind2, x) and returns whether to take the split.
+
+    With ``mesh``, every fit — the global refinements AND the masked-weight
+    local 2-means splits — runs through the DP-sharded engine (the split
+    masks are binary weights, which the engine's weight-exactness policy
+    admits onto the fused kernel), and assignments ride
+    :func:`kmeans_tpu.parallel.sharded_assign`; the host-side split
+    orchestration is unchanged.  Auto-k at mesh scale."""
     if not 1 <= k_min <= k_max:
         raise ValueError(f"need 1 <= k_min <= k_max, got {k_min}..{k_max}")
     if config is not None:
@@ -106,13 +115,51 @@ def _grow_k(
         )
 
     x = jnp.asarray(x)
-    d = x.shape[1]
+    n_orig, d = x.shape
     f32 = jnp.float32
     cfg2 = dataclasses.replace(cfg, k=2, empty="keep")
 
+    if mesh is None:
+        _fit = fit_lloyd
+        w_base = None                            # all rows real
+
+        def _assign(x_, c_):
+            return assign(x_, c_, chunk_size=cfg.chunk_size,
+                          compute_dtype=cfg.compute_dtype)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kmeans_tpu.parallel import fit_lloyd_sharded, sharded_assign
+
+        # Pad + place x onto the mesh ONCE: every engine call then finds
+        # rows already a shard multiple and already laid out, so
+        # device_put is a no-op and no per-round full-array transfer (or
+        # default-device replica) ever happens.  Pad rows are tracked by
+        # w_base = 0 and threaded into every fit's weights; assigns mask
+        # their distances out below.
+        dp_sz = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+        pad = (-n_orig) % dp_sz
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
+        w_base = jnp.concatenate(
+            [jnp.ones((n_orig,), f32), jnp.zeros((pad,), f32)]
+        )
+        x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
+        w_base = jax.device_put(w_base, NamedSharding(mesh, P(data_axis)))
+
+        def _fit(x_, k_, *, weights=None, **kw):
+            return fit_lloyd_sharded(
+                x_, k_, mesh=mesh, data_axis=data_axis,
+                weights=w_base if weights is None else weights, **kw)
+
+        def _assign(x_, c_):
+            return sharded_assign(x_, c_, mesh=mesh, data_axis=data_axis,
+                                  chunk_size=cfg.chunk_size,
+                                  compute_dtype=cfg.compute_dtype)
+
     key, fkey = jax.random.split(key)
-    state = fit_lloyd(x, k_min, key=fkey,
-                      config=dataclasses.replace(cfg, k=k_min))
+    state = _fit(x, k_min, key=fkey,
+                 config=dataclasses.replace(cfg, k=k_min))
     k = k_min
     converged = False
     rounds = 0
@@ -128,8 +175,8 @@ def _grow_k(
         keep = np.flatnonzero(cnts > 0)
         k2 = max(1, len(keep))
         init2 = np.asarray(state.centroids)[keep[:k2]].astype(np.float32)
-        state = fit_lloyd(x, k2, config=dataclasses.replace(cfg, k=k2),
-                          init=init2)
+        state = _fit(x, k2, config=dataclasses.replace(cfg, k=k2),
+                     init=init2)
         return state, k2
 
     for _ in range(max_rounds):
@@ -137,8 +184,12 @@ def _grow_k(
             break
         rounds += 1
         labels = state.labels
-        _, mind = assign(x, state.centroids, chunk_size=cfg.chunk_size,
-                         compute_dtype=cfg.compute_dtype)
+        _, mind = _assign(x, state.centroids)
+        if w_base is not None:
+            # Mesh-mode pad rows: zero-weight, but _assign still scores
+            # them — mask their distances and exclude them from every
+            # split mask (counts are weighted, so n_js is already clean).
+            mind = jnp.where(w_base[: mind.shape[0]] > 0, mind, 0.0)
         # All per-cluster stats in ONE segment reduction + one transfer
         # (not k masked full-array sums with 2k host syncs).
         n_js = np.asarray(state.counts)
@@ -155,13 +206,13 @@ def _grow_k(
             if n_j < min_split_size:
                 continue
             mask = labels == j
+            if w_base is not None:
+                mask = mask & (w_base[: mask.shape[0]] > 0)
             sse_j = float(sse_js[j])
             key, skey = jax.random.split(key)
-            st2 = fit_lloyd(x, 2, key=skey, config=cfg2,
-                            weights=mask.astype(f32))
-            lab2, mind2 = assign(x, st2.centroids,
-                                 chunk_size=cfg.chunk_size,
-                                 compute_dtype=cfg.compute_dtype)
+            st2 = _fit(x, 2, key=skey, config=cfg2,
+                       weights=mask.astype(f32))
+            lab2, mind2 = _assign(x, st2.centroids)
             n_a = float(jnp.sum(mask & (lab2 == 0)))
             n_b = float(jnp.sum(mask & (lab2 == 1)))
             if n_a < 1 or n_b < 1:
@@ -185,14 +236,16 @@ def _grow_k(
                 new_centers.append(cents[j])
         init = np.stack(new_centers).astype(np.float32)
         k = init.shape[0]
-        state = fit_lloyd(x, k, config=dataclasses.replace(cfg, k=k),
-                          init=init)
+        state = _fit(x, k, config=dataclasses.replace(cfg, k=k),
+                     init=init)
         state, k = drop_empty_slots(state, k)
 
     state, k = drop_empty_slots(state, k)
     return KMeansState(
         centroids=state.centroids,
-        labels=state.labels,
+        # Mesh mode fits on the pre-padded array: strip pad labels so the
+        # caller sees exactly its n rows.
+        labels=state.labels[:n_orig],
         inertia=state.inertia,
         n_iter=jnp.asarray(rounds, jnp.int32),
         converged=jnp.asarray(converged, bool),
@@ -208,9 +261,14 @@ def fit_xmeans(
     key: Optional[jax.Array] = None,
     config: Optional[KMeansConfig] = None,
     max_rounds: int = 16,
+    mesh=None,
+    data_axis: str = "data",
 ) -> KMeansState:
     """Fit X-means: grow k from ``k_min`` toward ``k_max`` by accepting
     BIC-improving cluster splits.
+
+    With ``mesh`` every inner fit/assign rides the DP-sharded engine
+    (auto-k at mesh scale; see :func:`_grow_k`).
 
     Returns a :class:`KMeansState` whose centroids array has exactly the
     discovered k rows; ``n_iter`` counts improve-structure rounds and
@@ -228,7 +286,7 @@ def fit_xmeans(
 
     return _grow_k(x, k_max, k_min=k_min, key=key, config=config,
                    max_rounds=max_rounds, accept=accept, family="x-means",
-                   min_split_size=4)
+                   min_split_size=4, mesh=mesh, data_axis=data_axis)
 
 
 @dataclasses.dataclass

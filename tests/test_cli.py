@@ -422,3 +422,36 @@ def test_examples_quickstart_runs(capsys):
         assert stage in out, stage
     assert "junk-trimmed=True" in out
     assert "labels==single-device: True" in out
+
+
+def test_train_stream_mesh_composes(cifar_like_npy, capsys):
+    """r3: --stream --mesh runs the mesh-sharded streamed minibatch
+    (host batches land row-sharded); still rejected for streamed GMM."""
+    rc, out, _ = _run(capsys, [
+        "train", "--stream", "--input", cifar_like_npy,
+        "--model", "minibatch", "--k", "10",
+        "--steps", "5", "--batch-size", "256", "--mesh", "8",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["stream"] is True
+    assert res["n_iter"] == 5
+
+    rc, _, err = _run(capsys, [
+        "train", "--stream", "--input", cifar_like_npy,
+        "--model", "gmm", "--k", "4",
+        "--steps", "5", "--batch-size", "256", "--mesh", "8",
+    ])
+    assert rc == 2
+    assert "--stream --mesh requires --model minibatch" in err
+
+
+def test_train_xmeans_on_mesh(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--model", "xmeans", "--n", "600", "--d", "8", "--k", "8",
+        "--cluster-std", "0.3", "--seed", "0", "--mesh", "8",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert 1 <= res["k"] <= 8
+    assert res["mode"] == "xmeans"

@@ -4,6 +4,7 @@ import jax
 import numpy as np
 import pytest
 
+from kmeans_tpu.data import make_blobs
 from kmeans_tpu.models import GMeans, anderson_darling_normal, fit_gmeans
 
 
@@ -56,3 +57,15 @@ def test_gmeans_alpha_validation_and_estimator():
     assert est.n_clusters_ == 2
     assert est.predict(x[:5]).shape == (5,)
     assert est.score(x) <= 0.0
+
+
+def test_gmeans_on_mesh_discovers_k(cpu_devices):
+    from kmeans_tpu.metrics import adjusted_rand_index
+    from kmeans_tpu.parallel import cpu_mesh
+
+    x, lab, _ = make_blobs(jax.random.key(5), 900, 8, 4, cluster_std=0.3)
+    st = fit_gmeans(np.asarray(x), 10, key=jax.random.key(1),
+                    mesh=cpu_mesh((8, 1)))
+    assert st.centroids.shape[0] == 4
+    ari = float(adjusted_rand_index(np.asarray(lab), np.asarray(st.labels)))
+    assert ari > 0.99, ari

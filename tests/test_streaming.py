@@ -322,3 +322,23 @@ def test_stream_fit_mesh_resume_guards(tmp_path, rng):
                               mesh=cpu_mesh((8, 1)), checkpoint_path=ck,
                               resume=True)
     assert int(st.n_iter) == 12
+
+
+def test_stream_fit_mesh_resume_raw_batch_size(tmp_path, rng):
+    """Checkpoints record the RAW requested batch_size (rounding to the
+    shard multiple happens at sampling time), so resuming with identical
+    arguments always works even when batch_size is not a shard multiple
+    (code-review r3 repro: 100 on an 8-way mesh)."""
+    from kmeans_tpu.parallel import cpu_mesh
+
+    x = rng.normal(size=(512, 8)).astype(np.float32)
+    np.save(tmp_path / "x.npy", x)
+    mm = np.load(tmp_path / "x.npy", mmap_mode="r")
+    ck = str(tmp_path / "ck")
+    fit_minibatch_stream(mm, 3, batch_size=100, steps=6, seed=0,
+                         mesh=cpu_mesh((8, 1)), checkpoint_path=ck,
+                         checkpoint_every=2)
+    st = fit_minibatch_stream(mm, 3, batch_size=100, steps=12, seed=0,
+                              mesh=cpu_mesh((8, 1)), checkpoint_path=ck,
+                              resume=True)
+    assert int(st.n_iter) == 12

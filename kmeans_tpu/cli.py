@@ -203,7 +203,8 @@ def _cmd_train(args) -> int:
                      else 0.05)
 
     mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kernel",
-               "kmedoids", "trimmed", "balanced", "xmeans", "gmeans")
+               "kmedoids", "trimmed", "balanced", "xmeans", "gmeans",
+               "spectral")
     if mesh is not None and model not in mesh_ok:
         print(
             f"error: --mesh supports --model {'/'.join(mesh_ok)}, "
@@ -271,12 +272,16 @@ def _cmd_train(args) -> int:
                 checkpoint_every=args.checkpoint_every,
             )
     elif mesh is not None and not args.stream and model in ("xmeans",
-                                                            "gmeans"):
-        # Auto-k on the mesh: the models-level entry takes mesh directly
-        # (every inner fit/assign rides the sharded engine).
-        fit = (models.fit_xmeans if model == "xmeans" else models.fit_gmeans)
+                                                            "gmeans",
+                                                            "spectral"):
+        # Models-level entries that take mesh directly: auto-k (every
+        # inner fit/assign rides the sharded engine) and spectral (the
+        # embedding-space k-means does).
+        fit = {"xmeans": models.fit_xmeans, "gmeans": models.fit_gmeans,
+               "spectral": models.fit_spectral}[model]
         state = fit(np.asarray(x), k, config=kcfg, mesh=mesh)
-        k = int(state.centroids.shape[0])
+        if model in ("xmeans", "gmeans"):
+            k = int(state.centroids.shape[0])
     elif mesh is not None and not args.stream:
         from kmeans_tpu import parallel
 

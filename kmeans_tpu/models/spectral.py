@@ -175,12 +175,19 @@ def fit_spectral(
     config: Optional[KMeansConfig] = None,
     tol: Optional[float] = None,
     max_iter: Optional[int] = None,
+    mesh=None,
+    data_axis: str = "data",
 ) -> SpectralState:
     """Spectral clustering: Nyström Laplacian embedding + k-means.
 
     One ``key`` drives both the landmark sample and the embedding-space
     k-means seeding (fold-in separated), so a fit is reproducible from a
     single seed.
+
+    With ``mesh``, the embedding-space k-means runs through the
+    DP-sharded engine (the embedding itself is chunked (n, m) kernel-tile
+    matmuls + an (m, m) eigh — row-parallel by construction, so the fit
+    is the part that needs the mesh's collectives).
     """
     if key is None:
         key = jax.random.key(config.seed if config is not None else 0)
@@ -191,10 +198,19 @@ def fit_spectral(
         compute_dtype=(config.compute_dtype if config is not None
                        else None),
     )
-    st: KMeansState = fit_lloyd(
-        emb, k, key=jax.random.fold_in(key, 1), config=config, tol=tol,
-        max_iter=max_iter,
-    )
+    if mesh is None:
+        st: KMeansState = fit_lloyd(
+            emb, k, key=jax.random.fold_in(key, 1), config=config, tol=tol,
+            max_iter=max_iter,
+        )
+    else:
+        from kmeans_tpu.parallel import fit_lloyd_sharded
+
+        st = fit_lloyd_sharded(
+            emb, k, mesh=mesh, data_axis=data_axis,
+            key=jax.random.fold_in(key, 1), config=config, tol=tol,
+            max_iter=max_iter,
+        )
     return SpectralState(st.labels, emb, st.inertia, st.n_iter,
                          st.converged, st.counts)
 

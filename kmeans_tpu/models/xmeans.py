@@ -131,21 +131,22 @@ def _grow_k(
 
         from kmeans_tpu.parallel import fit_lloyd_sharded, sharded_assign
 
-        # Pad + place x onto the mesh ONCE: every engine call then finds
-        # rows already a shard multiple and already laid out, so
-        # device_put is a no-op and no per-round full-array transfer (or
-        # default-device replica) ever happens.  Pad rows are tracked by
-        # w_base = 0 and threaded into every fit's weights; assigns mask
-        # their distances out below.
+        from kmeans_tpu.parallel.engine import _pad_rows
+
+        # Pad + place x onto the mesh ONCE (the engine's own _pad_rows, so
+        # the pad policy cannot drift): every engine call then finds rows
+        # already a shard multiple and already laid out, so its device_put
+        # of x is a no-op — no per-round full-ARRAY transfer or
+        # default-device replica.  (The (n,) weight vectors still make a
+        # host round-trip per inner fit — engine API; ~0.05% of x's bytes
+        # at the eval widths.)  Pad rows are tracked by w_base = 0 and
+        # threaded into every fit's weights; assigns mask their distances
+        # out below.
         dp_sz = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
-        pad = (-n_orig) % dp_sz
-        if pad:
-            x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
-        w_base = jnp.concatenate(
-            [jnp.ones((n_orig,), f32), jnp.zeros((pad,), f32)]
-        )
+        x, w_host, _ = _pad_rows(x, dp_sz)
         x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
-        w_base = jax.device_put(w_base, NamedSharding(mesh, P(data_axis)))
+        w_base = jax.device_put(jnp.asarray(w_host, f32),
+                                NamedSharding(mesh, P(data_axis)))
 
         def _fit(x_, k_, *, weights=None, **kw):
             return fit_lloyd_sharded(

@@ -455,3 +455,14 @@ def test_train_xmeans_on_mesh(capsys):
     res = json.loads(out.splitlines()[0])
     assert 1 <= res["k"] <= 8
     assert res["mode"] == "xmeans"
+
+
+def test_train_spectral_on_mesh(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--model", "spectral", "--n", "400", "--d", "4", "--k", "3",
+        "--mesh", "8", "--max-iter", "20",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "spectral"
+    assert np.isfinite(res["inertia"])

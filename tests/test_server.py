@@ -858,3 +858,34 @@ def test_evicted_room_revives_from_disk_not_fresh(tmp_path):
                          and "Carol" in (tmp_path / "EVIC.json").read_text())
     finally:
         s.stop()
+
+
+def test_trained_board_survives_restart(tmp_path):
+    """The train op's imported result rides the same durability path as
+    manual mutations: train, wait for the debounced save, restart over
+    the persist dir, board intact with its fitted zones."""
+    cfg = ServeConfig(host="127.0.0.1", port=0, persist_dir=str(tmp_path),
+                      persist_debounce_s=0.05)
+    s = KMeansServer(cfg)
+    httpd = s.start(background=True)
+    s.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        buf = _train_and_collect(s, "TDUR", {"n": 150, "d": 2, "k": 3,
+                                             "max_iter": 8})
+        assert b"train_done" in buf
+        assert _wait_for(lambda: (tmp_path / "TDUR.json").exists()
+                         and "card" in (tmp_path / "TDUR.json").read_text())
+    finally:
+        s.stop()
+
+    s2 = KMeansServer(ServeConfig(host="127.0.0.1", port=0,
+                                  persist_dir=str(tmp_path)))
+    httpd2 = s2.start(background=True)
+    s2.base = f"http://127.0.0.1:{httpd2.server_address[1]}"
+    try:
+        _, _, body = _get(s2, "/api/state?room=TDUR")
+        st = json.loads(body)
+        assert len(st["cards"]) == 150
+        assert len(st["centroids"]) == 3
+    finally:
+        s2.stop()

@@ -116,3 +116,18 @@ def test_public_generators_feed_spectral():
     sp = fit_spectral(xm, 2, gamma=20.0, key=jax.random.key(3))
     assert metrics.adjusted_rand_index(np.asarray(tm),
                                        np.asarray(sp.labels)) > 0.95
+
+
+def test_spectral_on_mesh_cuts_rings(cpu_devices):
+    """r3: the embedding-space k-means rides the sharded engine; rings
+    are cut from a cold start exactly as single-device."""
+    from kmeans_tpu.data import make_rings
+    from kmeans_tpu.metrics import adjusted_rand_index
+    from kmeans_tpu.parallel import cpu_mesh
+
+    x, lab = make_rings(jax.random.key(4), 402)
+    st = fit_spectral(np.asarray(x), 2, gamma=2.0, key=jax.random.key(0),
+                      mesh=cpu_mesh((8, 1)))
+    ari = float(adjusted_rand_index(np.asarray(lab), np.asarray(st.labels)))
+    assert ari == 1.0, ari
+    assert st.labels.shape == (804,)   # 402 per ring x 2

@@ -204,7 +204,7 @@ def _cmd_train(args) -> int:
 
     mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kernel",
                "kmedoids", "trimmed", "balanced", "xmeans", "gmeans",
-               "spectral")
+               "spectral", "bisecting")
     if mesh is not None and model not in mesh_ok:
         print(
             f"error: --mesh supports --model {'/'.join(mesh_ok)}, "
@@ -271,14 +271,14 @@ def _cmd_train(args) -> int:
                 checkpoint_path=args.checkpoint,
                 checkpoint_every=args.checkpoint_every,
             )
-    elif mesh is not None and not args.stream and model in ("xmeans",
-                                                            "gmeans",
-                                                            "spectral"):
-        # Models-level entries that take mesh directly: auto-k (every
-        # inner fit/assign rides the sharded engine) and spectral (the
-        # embedding-space k-means does).
+    elif mesh is not None and not args.stream and model in (
+            "xmeans", "gmeans", "spectral", "bisecting"):
+        # Models-level entries that take mesh directly: auto-k and
+        # bisecting (every inner fit/assign rides the sharded engine) and
+        # spectral (the embedding-space k-means does).
         fit = {"xmeans": models.fit_xmeans, "gmeans": models.fit_gmeans,
-               "spectral": models.fit_spectral}[model]
+               "spectral": models.fit_spectral,
+               "bisecting": models.fit_bisecting}[model]
         state = fit(np.asarray(x), k, config=kcfg, mesh=mesh)
         if model in ("xmeans", "gmeans"):
             k = int(state.centroids.shape[0])

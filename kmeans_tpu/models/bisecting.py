@@ -40,6 +40,8 @@ def fit_bisecting(
     config: Optional[KMeansConfig] = None,
     strategy: str = "biggest_inertia",
     weights: Optional[jax.Array] = None,
+    mesh=None,
+    data_axis: str = "data",
 ) -> KMeansState:
     """Fit bisecting k-means: start from one cluster, repeatedly 2-means-split
     the worst cluster (by SSE or by size) until k clusters exist.
@@ -62,21 +64,49 @@ def fit_bisecting(
             "(an init array) is not supported"
         )
 
-    n, d = x.shape
+    n_orig, d = x.shape
     f32 = jnp.float32
-    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
     # k=2 sub-problem config, honoring the caller's init method; "keep" for
     # empties — a split that can't find two clusters leaves the second child
     # empty, handled by the splittable mask.
     cfg2 = dataclasses.replace(cfg, k=2, empty="keep")
+
+    if mesh is None:
+        w = (jnp.ones((n_orig,), f32) if weights is None
+             else weights.astype(f32))
+        _fit = fit_lloyd
+
+        def _assign(x_, c_):
+            return assign(x_, c_, chunk_size=cfg.chunk_size,
+                          compute_dtype=cfg.compute_dtype)
+    else:
+        # Mesh: every split's weighted 2-means rides the DP-sharded
+        # engine.  x pads + places ONCE (the engine's own _pad_rows so the
+        # policy can't drift); pad rows carry weight 0, and every
+        # reduction below is already weight-gated, so they are inert
+        # without further masking.  The returned labels strip to n_orig.
+        from kmeans_tpu.parallel import fit_lloyd_sharded, sharded_assign
+        from kmeans_tpu.parallel.engine import pad_and_place
+
+        x, w, _ = pad_and_place(x, mesh, data_axis, weights=weights)
+
+        def _fit(x_, k_, **kw):
+            return fit_lloyd_sharded(x_, k_, mesh=mesh,
+                                     data_axis=data_axis, **kw)
+
+        def _assign(x_, c_):
+            return sharded_assign(x_, c_, mesh=mesh, data_axis=data_axis,
+                                  chunk_size=cfg.chunk_size,
+                                  compute_dtype=cfg.compute_dtype)
+
+    n = x.shape[0]
 
     labels = jnp.zeros((n,), jnp.int32)
     w_total = w.sum()
     mean0 = (w[:, None] * x.astype(f32)).sum(0) / jnp.where(
         w_total > 0, w_total, 1.0
     )
-    _, mind0 = assign(x, mean0[None], chunk_size=cfg.chunk_size,
-                      compute_dtype=cfg.compute_dtype)
+    _, mind0 = _assign(x, mean0[None])
     centroids = jnp.zeros((k, d), f32).at[0].set(mean0)
     sse = jnp.zeros((k,), f32).at[0].set(jnp.sum(w * mind0))
     counts = jnp.zeros((k,), f32).at[0].set(jnp.sum(w))
@@ -93,10 +123,9 @@ def fit_bisecting(
             break  # nothing splittable (or all remaining SSE exactly 0)
         mask_w = jnp.where(labels == target, w, 0.0)
 
-        st2 = fit_lloyd(x, 2, key=jax.random.fold_in(key, i),
-                        config=cfg2, weights=mask_w)
-        lab2, mind2 = assign(x, st2.centroids, chunk_size=cfg.chunk_size,
-                             compute_dtype=cfg.compute_dtype)
+        st2 = _fit(x, 2, key=jax.random.fold_in(key, i),
+                   config=cfg2, weights=mask_w)
+        lab2, mind2 = _assign(x, st2.centroids)
         in_b = (labels == target) & (lab2 == 1)
         labels = jnp.where(in_b, i, labels)
 
@@ -122,7 +151,7 @@ def fit_bisecting(
 
     return KMeansState(
         centroids=centroids,
-        labels=labels,
+        labels=labels[:n_orig],     # mesh mode fits on the padded array
         inertia=jnp.sum(sse),
         n_iter=jnp.asarray(n_splits, jnp.int32),
         converged=jnp.asarray(n_splits == k - 1, bool),

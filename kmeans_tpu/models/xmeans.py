@@ -127,11 +127,8 @@ def _grow_k(
             return assign(x_, c_, chunk_size=cfg.chunk_size,
                           compute_dtype=cfg.compute_dtype)
     else:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         from kmeans_tpu.parallel import fit_lloyd_sharded, sharded_assign
-
-        from kmeans_tpu.parallel.engine import _pad_rows
+        from kmeans_tpu.parallel.engine import pad_and_place
 
         # Pad + place x onto the mesh ONCE (the engine's own _pad_rows, so
         # the pad policy cannot drift): every engine call then finds rows
@@ -142,11 +139,7 @@ def _grow_k(
         # at the eval widths.)  Pad rows are tracked by w_base = 0 and
         # threaded into every fit's weights; assigns mask their distances
         # out below.
-        dp_sz = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
-        x, w_host, _ = _pad_rows(x, dp_sz)
-        x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
-        w_base = jax.device_put(jnp.asarray(w_host, f32),
-                                NamedSharding(mesh, P(data_axis)))
+        x, w_base, _ = pad_and_place(x, mesh, data_axis)
 
         def _fit(x_, k_, *, weights=None, **kw):
             return fit_lloyd_sharded(

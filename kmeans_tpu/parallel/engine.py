@@ -630,6 +630,20 @@ def _pad_rows(x: jax.Array, multiple: int, weights=None):
     return x, w, n
 
 
+def pad_and_place(x, mesh, data_axis="data", weights=None):
+    """Pad rows to the data-axis multiple and lay x + weights out on the
+    mesh — THE one copy of the pad-and-place idiom for callers that
+    pre-position a dataset once and then make many engine calls (the
+    auto-k/bisecting split loops, the sharded PCA).  Returns
+    ``(x_sharded, w_sharded, n_real)``; pad rows carry weight 0."""
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+    x, w_host, n = _pad_rows(x, dp, weights=weights)
+    spec = NamedSharding(mesh, P(data_axis))
+    x = jax.device_put(jnp.asarray(x), spec)
+    w = jax.device_put(jnp.asarray(w_host, jnp.float32), spec)
+    return x, w, n
+
+
 def _make_tp_local(backend, *, data_axis, model_axis, k_real, chunk_size,
                    compute_dtype, update, with_labels, empty,
                    center_update="mean"):

@@ -99,7 +99,7 @@ def pca_fit_sharded(
     """:func:`kmeans_tpu.data.preprocess.pca_fit` on a device mesh (DP over
     rows; one psum of the centered moments per fit).  Components and
     variances match the single-device fit to float tolerance."""
-    from kmeans_tpu.parallel.engine import _pad_rows
+    from kmeans_tpu.parallel.engine import pad_and_place
 
     if not isinstance(x, jax.Array):
         x = np.asarray(x)          # same array-like coercion as pca_fit
@@ -108,10 +108,7 @@ def pca_fit_sharded(
         raise ValueError(
             f"n_components must be in [1, {min(n, d)}], got {n_components}"
         )
-    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
-    x, w_host, n = _pad_rows(x, dp)
-    x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
-    w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
+    x, w, n = pad_and_place(x, mesh, data_axis)
 
     run = _build_moments(mesh, data_axis, chunk_size, compute_dtype)
     s, ss, mu0, n_eff = run(x, w)

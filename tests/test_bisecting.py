@@ -142,3 +142,22 @@ def test_bisecting_zero_count_slots_duplicate_centroid_zero():
     est.state = st
     pred = np.asarray(est.predict(x))
     assert set(pred.tolist()) <= set(np.flatnonzero(counts > 0).tolist())
+
+
+def test_bisecting_on_mesh_matches_single_device(cpu_devices):
+    """r3: every split's weighted 2-means rides the sharded engine; the
+    sharded engine is label-exact, so the whole split TRAJECTORY (and
+    final hierarchical labels) match single-device exactly."""
+    from kmeans_tpu.parallel import cpu_mesh
+
+    x, _, _ = make_blobs(jax.random.key(6), 901, 8, 5, cluster_std=0.4)
+    x = np.asarray(x)
+    want = fit_bisecting(jnp.asarray(x), 5, key=jax.random.key(2))
+    got = fit_bisecting(x, 5, key=jax.random.key(2), mesh=cpu_mesh((8, 1)))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)

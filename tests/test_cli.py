@@ -466,3 +466,14 @@ def test_train_spectral_on_mesh(capsys):
     res = json.loads(out.splitlines()[0])
     assert res["mode"] == "spectral"
     assert np.isfinite(res["inertia"])
+
+
+def test_train_bisecting_on_mesh(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--model", "bisecting", "--n", "400", "--d", "6",
+        "--k", "4", "--mesh", "8",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "bisecting"
+    assert res["k"] == 4

@@ -1,0 +1,55 @@
+"""Regenerate docs/API.md: python docs/gen_api.py > docs/API.md"""
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import kmeans_tpu  # noqa: E402
+from kmeans_tpu import config, data, metrics, models, parallel  # noqa: E402
+
+print("""# Public API index
+
+Generated inventory of every public symbol (the `__all__` surface), with
+its first docstring line — the one-page answer to "does the framework
+have X".  Regenerate with the script in the page footer.
+""")
+
+
+def first_line(obj):
+    doc = inspect.getdoc(obj)
+    if not doc:
+        return ""
+    line = doc.splitlines()[0].strip()
+    return line if len(line) < 110 else line[:107] + "..."
+
+
+for title, mod in (
+    ("`kmeans_tpu` (top level)", kmeans_tpu),
+    ("`kmeans_tpu.models`", models),
+    ("`kmeans_tpu.parallel`", parallel),
+    ("`kmeans_tpu.data`", data),
+    ("`kmeans_tpu.metrics`", metrics),
+    ("`kmeans_tpu.config`", config),
+):
+    pub = getattr(mod, "__all__", None) or sorted(
+        n for n in dir(mod) if not n.startswith("_"))
+    print(f"\n## {title} — {len(pub)} symbols\n")
+    print("| Symbol | What it is |")
+    print("|---|---|")
+    for n in sorted(pub):
+        obj = getattr(mod, n, None)
+        kind = ("class" if inspect.isclass(obj)
+                else "fn" if callable(obj) else "const")
+        print(f"| `{n}` ({kind}) | {first_line(obj)} |")
+
+print("""
+---
+Regenerate: `python docs/gen_api.py > docs/API.md`.  The CLI
+(`python -m kmeans_tpu.cli --help`) and the HTTP surface
+(`serve/server.py` docstrings) are documented in README.md.""")

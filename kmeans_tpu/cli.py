@@ -202,9 +202,9 @@ def _cmd_train(args) -> int:
     trim_fraction = (args.trim_fraction if args.trim_fraction is not None
                      else 0.05)
 
-    mesh_ok = ("lloyd", "minibatch", "spherical", "fuzzy", "gmm", "kernel",
-               "kmedoids", "trimmed", "balanced", "xmeans", "gmeans",
-               "spectral", "bisecting")
+    mesh_ok = ("lloyd", "accelerated", "minibatch", "spherical", "fuzzy",
+               "gmm", "kernel", "kmedoids", "trimmed", "balanced",
+               "xmeans", "gmeans", "spectral", "bisecting")
     if mesh is not None and model not in mesh_ok:
         print(
             f"error: --mesh supports --model {'/'.join(mesh_ok)}, "
@@ -287,6 +287,7 @@ def _cmd_train(args) -> int:
 
         fit = {
             "lloyd": parallel.fit_lloyd_sharded,
+            "accelerated": parallel.fit_lloyd_accelerated_sharded,
             "minibatch": parallel.fit_minibatch_sharded,
             "spherical": parallel.fit_spherical_sharded,
             "fuzzy": parallel.fit_fuzzy_sharded,

@@ -5,6 +5,7 @@ from kmeans_tpu.parallel.kernel import fit_kernel_kmeans_sharded
 from kmeans_tpu.parallel.medoids import fit_kmedoids_sharded
 from kmeans_tpu.parallel.engine import (
     fit_balanced_sharded,
+    fit_lloyd_accelerated_sharded,
     fit_fuzzy_sharded,
     fit_gmm_sharded,
     fit_lloyd_sharded,
@@ -24,6 +25,7 @@ __all__ = [
     "fit_gmm_sharded",
     "fit_kernel_kmeans_sharded",
     "fit_kmedoids_sharded",
+    "fit_lloyd_accelerated_sharded",
     "fit_lloyd_sharded",
     "fit_minibatch_sharded",
     "fit_spherical_sharded",

@@ -979,6 +979,151 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
     return run
 
 
+@functools.lru_cache(maxsize=32)
+def _build_accelerated_run(mesh, data_axis, chunk_size, compute_dtype,
+                           update, max_it, backend, weights_binary,
+                           beta_max):
+    """Jitted sharded accelerated-Lloyd program (DP over points).
+
+    The over-relaxation scheme of
+    :func:`kmeans_tpu.models.accelerated.fit_lloyd_accelerated` — c ←
+    T(c) + β(T(c) − c) with the free-objective safeguard — needs only the
+    fused pass's (sums, counts, inertia), so the shard story is plain
+    DP: one psum of those three per iteration, extrapolation arithmetic
+    O(k·d) replicated.  The final labeling pass reuses the DP body."""
+
+    # THE one DP shard body serves both phases (no second copy of the
+    # psum+update merge): step reads (T(c), f(c)) from its
+    # (new_c, inertia) outputs; final adds labels.
+    local = functools.partial(
+        _dp_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update=update, backend=backend,
+        empty="keep", weights_binary=weights_binary,
+    )
+    step = jax.shard_map(
+        functools.partial(local, with_labels=False), mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    final = jax.shard_map(
+        functools.partial(local, with_labels=True), mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P(), P(data_axis)),
+        check_vma=False,
+    )
+    f32 = jnp.float32
+
+    @jax.jit
+    def run(x, w, c0, tol_v):
+        def cond(s):
+            c, c_safe, f_prev, beta, it, shift_sq, done = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            # Same accept/reject arithmetic as the single-device
+            # _accelerated_loop (models/accelerated.py) — only the pass
+            # reduction is distributed.
+            c, c_safe, f_prev, beta, it, _, _ = s
+            tc, f_c, _ = step(x, c, w)
+            shift_sq = jnp.sum((tc - c) ** 2)
+            rejected = f_c > f_prev
+            c_acc = tc + beta * (tc - c)
+            c_next = jnp.where(rejected, c_safe, c_acc)
+            beta_next = jnp.where(
+                rejected, 0.0, jnp.minimum(beta_max, 1.1 * beta + 0.1)
+            )
+            f_next = jnp.where(rejected, f_prev, f_c)
+            c_safe_next = jnp.where(rejected, c_safe, tc)
+            done = (shift_sq <= tol_v) & ~rejected
+            return (c_next, c_safe_next, f_next, beta_next.astype(f32),
+                    it + 1, shift_sq, done)
+
+        init = (
+            c0.astype(f32), c0.astype(f32), jnp.asarray(jnp.inf, f32),
+            jnp.zeros((), f32), jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
+        )
+        c, c_safe, _, _, n_iter, _, converged = lax.while_loop(
+            cond, body, init
+        )
+        _, inertia, counts, labels = final(x, c_safe, w)
+        return c_safe, labels, inertia, n_iter, converged, counts
+
+    return run
+
+
+def fit_lloyd_accelerated_sharded(
+    x,
+    k: int,
+    *,
+    mesh: Mesh,
+    key: Optional[jax.Array] = None,
+    config: Optional[KMeansConfig] = None,
+    init=None,
+    weights=None,
+    data_axis: str = "data",
+    tol: Optional[float] = None,
+    max_iter: Optional[int] = None,
+    beta_max: float = 1.0,
+) -> KMeansState:
+    """Safeguarded over-relaxed Lloyd on a device mesh (DP over points) —
+    the sharded counterpart of
+    :func:`kmeans_tpu.models.fit_lloyd_accelerated`, completing the
+    mesh story for the last center-based family.  Same contract; DP only
+    (the extrapolation needs full centroids, which DP replicates anyway).
+    """
+    cfg, key = resolve_fit_config(k, key, config)
+    if cfg.empty == "farthest":
+        raise NotImplementedError(
+            "empty='farthest' is not supported by the accelerated loop "
+            "(reseeding mid-extrapolation breaks the fixed-point "
+            "safeguard); use fit_lloyd_sharded"
+        )
+    if weights is not None and np.asarray(weights).shape != (x.shape[0],):
+        raise ValueError(
+            f"weights shape {np.asarray(weights).shape} != ({x.shape[0]},)"
+        )
+    x, w, n = pad_and_place(x, mesh, data_axis, weights=weights)
+    w_host = np.asarray(w)
+    weights_binary = bool(np.all((w_host == 0.0) | (w_host == 1.0)))
+
+    if init is not None and not isinstance(init, str):
+        c0 = jnp.asarray(init, jnp.float32)
+        if c0.shape != (k, x.shape[1]):
+            raise ValueError(
+                f"init centroids shape {c0.shape} != {(k, x.shape[1])}"
+            )
+    else:
+        method = init if isinstance(init, str) else cfg.init
+        c0 = init_centroids(
+            key, x, k, method=method, weights=w,
+            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        )
+    c0 = jax.device_put(c0, NamedSharding(mesh, P()))
+
+    cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
+          else jnp.dtype(x.dtype))
+    w_exact = _weights_exact(cd, weights=w_host,
+                             weights_are_binary=weights_binary)
+    update = cfg.update
+    if update == "matmul" and not w_exact:
+        update = "segment"
+    backend = resolve_backend(
+        cfg.backend, x, k, weights_are_binary=weights_binary,
+        weights=w_host, compute_dtype=cfg.compute_dtype,
+        platform=mesh.devices.flat[0].platform,
+    )
+    run = _build_accelerated_run(
+        mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, update,
+        max_iter if max_iter is not None else cfg.max_iter, backend,
+        weights_binary, float(beta_max),
+    )
+    tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
+    c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
+    return KMeansState(c, labels[:n], inertia, n_iter, converged, counts)
+
+
 def fit_spherical_sharded(
     x,
     k: int,

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from kmeans_tpu import fit_lloyd, fit_lloyd_accelerated
 from kmeans_tpu.data import make_blobs
@@ -84,3 +85,37 @@ def test_accelerated_k_zero_raises(blobs):
     x, _ = blobs
     with pytest.raises(ValueError):
         fit_lloyd_accelerated(x, 0)
+
+
+def test_accelerated_sharded_matches_single_device(cpu_devices):
+    """r3: the sharded accelerated loop (DP psum of the fused-pass
+    reductions, replicated extrapolation) reproduces the single-device
+    trajectory — labels exactly, centroids/inertia to float tolerance."""
+    from kmeans_tpu.parallel import cpu_mesh, fit_lloyd_accelerated_sharded
+
+    x, _, _ = make_blobs(jax.random.key(3), 803, 10, 5, cluster_std=0.6)
+    x = np.asarray(x)
+    c0 = x[:5].copy()
+    want = fit_lloyd_accelerated(jnp.asarray(x), 5, init=jnp.asarray(c0),
+                                 tol=1e-10, max_iter=40)
+    got = fit_lloyd_accelerated_sharded(x, 5, mesh=cpu_mesh((8, 1)),
+                                        init=c0, tol=1e-10, max_iter=40)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(got.inertia), float(want.inertia),
+                               rtol=1e-4)
+    assert int(got.n_iter) == int(want.n_iter)
+
+
+def test_accelerated_sharded_rejects_farthest(cpu_devices):
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.parallel import cpu_mesh, fit_lloyd_accelerated_sharded
+
+    x, _, _ = make_blobs(jax.random.key(3), 200, 4, 3)
+    with pytest.raises(NotImplementedError, match="farthest"):
+        fit_lloyd_accelerated_sharded(
+            np.asarray(x), 3, mesh=cpu_mesh((8, 1)),
+            config=KMeansConfig(k=3, empty="farthest"))

@@ -477,3 +477,14 @@ def test_train_bisecting_on_mesh(capsys):
     res = json.loads(out.splitlines()[0])
     assert res["mode"] == "bisecting"
     assert res["k"] == 4
+
+
+def test_train_accelerated_on_mesh(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--model", "accelerated", "--n", "400", "--d", "6",
+        "--k", "3", "--mesh", "8", "--max-iter", "30",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "accelerated"
+    assert np.isfinite(res["inertia"])

@@ -212,11 +212,11 @@ def _cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.stream and mesh is not None and model != "minibatch":
-        # Mesh streaming exists for the minibatch family (host batches
-        # land row-sharded, stats psum per step); the streamed GMM is
-        # still single-device.
-        print("error: --stream --mesh requires --model minibatch",
+    if args.stream and mesh is not None and model not in ("minibatch",
+                                                          "gmm"):
+        # Mesh streaming: host batches land row-sharded, per-step stats
+        # (hard one-hot or GMM soft moments) psum-merge.
+        print("error: --stream --mesh requires --model minibatch or gmm",
               file=sys.stderr)
         return 2
 

@@ -48,6 +48,24 @@ from kmeans_tpu.ops.distance import chunk_tiles
 __all__ = ["fit_gmm_stream", "gmm_assign_stream"]
 
 
+def _blend_and_mstep(params, stats, N, S, Q, ll, b, rho, reg_covar, *,
+                     covariance_type):
+    """The post-reduction half of one stepwise-EM update: Robbins–Monro
+    blend of the per-unit batch moments into the running statistics, then
+    the closed-form M-step — THE one copy shared by the single-device and
+    mesh step paths (the two must never diverge; only the moment
+    REDUCTION differs between them)."""
+    batch = (N / b, S / b, Q / b)
+    stats = jax.tree.map(
+        lambda s, bn: (1.0 - rho) * s + rho * bn, stats, batch
+    )
+    new_params = gmm_m_step(
+        params, stats[0], stats[1], stats[2],
+        covariance_type=covariance_type, reg_covar=reg_covar,
+    )
+    return new_params, stats, ll / b
+
+
 @functools.partial(
     jax.jit, static_argnames=("covariance_type", "compute_dtype")
 )
@@ -66,15 +84,46 @@ def _gmm_stream_step(params: GMMParams, stats, xb, rho, reg_covar, *,
     N, S, Q, ll, _ = gmm_scan_tiles(
         xs, ws, params, compute_dtype=compute_dtype, with_labels=False
     )
-    batch = (N / b, S / b, Q / b)
-    stats = jax.tree.map(
-        lambda s, bn: (1.0 - rho) * s + rho * bn, stats, batch
+    return _blend_and_mstep(params, stats, N, S, Q, ll, b, rho, reg_covar,
+                            covariance_type=covariance_type)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_gmm_stream_step_sharded(mesh, data_axis, covariance_type,
+                                   compute_dtype):
+    """Mesh analog of :func:`_gmm_stream_step`: the host-fed batch arrives
+    row-sharded over ``data_axis``, each shard computes its rows' soft
+    moments with the same ``gmm_scan_tiles`` tile, one ``psum`` merges
+    (N, S, Q, ll), and the Robbins–Monro blend + closed-form M-step run
+    replicated — out-of-core EM meets the mesh."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    def local(params, xb_loc):
+        b_loc = xb_loc.shape[0]
+        xs = xb_loc[None]
+        ws = jnp.ones((1, b_loc), jnp.float32)
+        N, S, Q, ll, _ = gmm_scan_tiles(
+            xs, ws, params, compute_dtype=compute_dtype, with_labels=False
+        )
+        return (lax.psum(N, data_axis), lax.psum(S, data_axis),
+                lax.psum(Q, data_axis), lax.psum(ll, data_axis))
+
+    run = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(GMMParams(P(), P(), P()), P(data_axis)),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
     )
-    new_params = gmm_m_step(
-        params, stats[0], stats[1], stats[2],
-        covariance_type=covariance_type, reg_covar=reg_covar,
-    )
-    return new_params, stats, ll / b
+
+    @jax.jit
+    def step(params, stats, xb, rho, reg_covar):
+        N, S, Q, ll = run(params, xb)
+        return _blend_and_mstep(params, stats, N, S, Q, ll, xb.shape[0],
+                                rho, reg_covar,
+                                covariance_type=covariance_type)
+
+    return step
 
 
 def gmm_assign_stream(
@@ -127,8 +176,17 @@ def fit_gmm_stream(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 100,
     resume: bool = False,
+    mesh=None,
+    data_axis: str = "data",
 ) -> GMMState:
     """Online EM over host/disk data of unbounded size.
+
+    With ``mesh`` each host batch lands row-sharded over ``data_axis``
+    straight off PCIe and the E-step's soft moments merge with one
+    ``psum`` (see :func:`_build_gmm_stream_step_sharded`); ``batch_size``
+    rounds down to a shard multiple at sampling time, checkpoints record
+    the RAW value plus the shard count, and a mesh-mismatched resume is
+    refused (reduction order and rounding both depend on it).
 
     ``data`` is any 2-D array-like with numpy indexing (``np.ndarray``,
     ``np.memmap``).  ``kappa`` is the Robbins–Monro decay exponent
@@ -159,6 +217,8 @@ def fit_gmm_stream(
     cfg, key = resolve_fit_config(k, key, config)
     n, d = data.shape
     bs = batch_size if batch_size is not None else cfg.batch_size
+    dp = (dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+          if mesh is not None else 0)
     n_steps = steps if steps is not None else cfg.steps
     host_seed = seed if seed is not None else cfg.seed
 
@@ -207,6 +267,18 @@ def fit_gmm_stream(
             kappa, t0 = r["kappa"], r["t0"]
             covariance_type = r["covariance_type"]
             reg_covar = r["reg_covar"]
+            # Mesh presence/shape changes the soft-moment reduction order
+            # AND the effective batch rounding — refuse a silent fork
+            # (same guard as the streamed minibatch).
+            ck_dp = int(ck.get("mesh_dp", 0))
+            if ck_dp != dp:
+                want = (f"mesh with a {ck_dp}-way data axis" if ck_dp
+                        else "no mesh")
+                raise ValueError(
+                    f"resume mesh (data axis {dp or 'absent'}) contradicts "
+                    f"the checkpoint's ({ck_dp or 'absent'}); continue "
+                    f"this stream with {want}"
+                )
             params = GMMParams(arrays["means"], arrays["variances"],
                                arrays["log_pi"])
             stats = (arrays["stat_n"], arrays["stat_s"], arrays["stat_q"])
@@ -261,21 +333,35 @@ def fit_gmm_stream(
                    "batch_size": int(bs), "kappa": float(kappa),
                    "t0": float(t0), "covariance_type": covariance_type,
                    "reg_covar": float(reg_covar),
-                   "total_steps": int(n_steps)},
+                   "total_steps": int(n_steps), "mesh_dp": int(dp)},
         )
 
     reg = jnp.asarray(reg_covar, jnp.float32)
-    batches = sample_batches(data, bs, n_steps, seed=host_seed,
+    # Round AFTER resume resolution, raw value recorded (same scheme as
+    # the streamed minibatch): sampling uses the shard-even size.
+    bs_eff = max(dp, bs - bs % dp) if dp else bs
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        place = NamedSharding(mesh, P(data_axis))
+        repl = NamedSharding(mesh, P())
+        params = jax.device_put(params, repl)
+        stats = jax.device_put(stats, repl)
+        step_fn = _build_gmm_stream_step_sharded(
+            mesh, data_axis, covariance_type, cfg.compute_dtype)
+    else:
+        place = None
+        step_fn = functools.partial(
+            _gmm_stream_step, covariance_type=covariance_type,
+            compute_dtype=cfg.compute_dtype)
+    batches = sample_batches(data, bs_eff, n_steps, seed=host_seed,
                              start_step=start_step)
     step = start_step
     for xb in prefetch_to_device(batches, depth=prefetch_depth,
-                                 background=background_prefetch):
+                                 background=background_prefetch,
+                                 device=place):
         rho = jnp.asarray((step + t0) ** (-kappa), jnp.float32)
-        params, stats, _ = _gmm_stream_step(
-            params, stats, xb, rho, reg,
-            covariance_type=covariance_type,
-            compute_dtype=cfg.compute_dtype,
-        )
+        params, stats, _ = step_fn(params, stats, xb, rho, reg)
         step += 1
         saver.maybe(step, lambda p=params, s=stats, t=step: save(p, s, t))
     saver.maybe(step, lambda: save(params, stats, step), force=True)

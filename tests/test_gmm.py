@@ -431,3 +431,48 @@ def test_gmm_predict_matches_log_resp_argmax(rng):
     np.testing.assert_array_equal(
         np.asarray(lab), np.asarray(jnp.argmax(log_resp, axis=1))
     )
+
+
+def test_gmm_stream_on_mesh_matches_single_device(tmp_path, rng, cpu_devices):
+    """Streamed EM on a mesh (r3): same (seed, step)-pure batches, so the
+    mesh trajectory matches single-device to float tolerance."""
+    from kmeans_tpu.models import fit_gmm_stream
+    from kmeans_tpu.parallel import cpu_mesh
+
+    centers = (np.eye(3, 10) * 30.0).astype(np.float32)
+    lab = rng.integers(0, 3, 3072)
+    x = (centers[lab] + rng.normal(scale=0.5, size=(3072, 10))
+         ).astype(np.float32)
+    np.save(tmp_path / "x.npy", x)
+    mm = np.load(tmp_path / "x.npy", mmap_mode="r")
+
+    want = fit_gmm_stream(mm, 3, init=jnp.asarray(centers),
+                          batch_size=256, steps=25, seed=4)
+    got = fit_gmm_stream(mm, 3, init=jnp.asarray(centers),
+                         batch_size=256, steps=25, seed=4,
+                         mesh=cpu_mesh((8, 1)))
+    np.testing.assert_allclose(np.asarray(got.means),
+                               np.asarray(want.means), rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+
+
+def test_gmm_stream_mesh_resume_guard(tmp_path, rng, cpu_devices):
+    from kmeans_tpu.models import fit_gmm_stream
+    from kmeans_tpu.parallel import cpu_mesh
+
+    x = rng.normal(size=(512, 6)).astype(np.float32)
+    np.save(tmp_path / "x.npy", x)
+    mm = np.load(tmp_path / "x.npy", mmap_mode="r")
+    ck = str(tmp_path / "ck")
+    fit_gmm_stream(mm, 3, batch_size=100, steps=6, seed=0,
+                   mesh=cpu_mesh((8, 1)), checkpoint_path=ck,
+                   checkpoint_every=2)
+    with pytest.raises(ValueError, match="mesh"):
+        fit_gmm_stream(mm, 3, batch_size=100, steps=12, seed=0,
+                       checkpoint_path=ck, resume=True)
+    # Same mesh + same raw batch_size resumes clean.
+    st = fit_gmm_stream(mm, 3, batch_size=100, steps=12, seed=0,
+                        mesh=cpu_mesh((8, 1)), checkpoint_path=ck,
+                        resume=True)
+    assert int(st.n_iter) == 12

@@ -50,6 +50,11 @@ def _extract_half(rec, metric):
     seconds series or vice versa.
     """
     rec_metric = rec.get("metric", "")
+    if not (metric.startswith("wallclock_to_converge_s")
+            or metric.startswith("lloyd_iters_per_sec_per_chip")):
+        # Unknown series (e.g. a real_input_fit run): nothing recorded can
+        # legitimately serve it — the failure line carries only the error.
+        return None
     if metric.startswith("wallclock_to_converge_s"):
         if rec_metric.startswith("wallclock_to_converge_s"):
             value, vs = rec.get("value"), rec.get("vs_baseline")
@@ -147,42 +152,110 @@ def _record_local(line):
         print(f"  could not persist local record: {e}", file=sys.stderr)
 
 
+_PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp; d = jax.devices(); "
+    "x = jnp.ones((128, 128), jnp.bfloat16); "
+    "y = (x @ x).block_until_ready(); "
+    "print(d[0].platform, len(d), int(y[0, 0]))"
+)
+
+
 def _probe_backend(attempts=3, timeout_s=90.0, backoff_s=10.0):
-    """Bounded-retry probe of accelerator init in a subprocess.
+    """Bounded-retry probe of accelerator init AND usability in a subprocess.
 
     A dead axon tunnel relay hangs ``jax.devices()`` forever with no
     exception (observed rounds 1-2), and jax backend init is process-global
     — once it wedges in-process there is no retry.  So the retry loop lives
     here: each attempt inits the backend in a THROWAWAY subprocess with a
     hard timeout; only when a probe succeeds does the main process import
-    jax at all.  Returns True when the backend came up.
+    jax at all.
+
+    The probe is more than ``jax.devices()``: it allocates a small device
+    buffer and runs a tiny matmul.  Round 3's chip initialized fine but had
+    zero free HBM (a stale process held it all — an 8 KB ``jnp.asarray``
+    raised RESOURCE_EXHAUSTED mid-bench and the artifact landed empty), so
+    "init ok" alone proves nothing; the probe must prove the chip can
+    actually hold data and compute (VERDICT.md r3 item 1).
+
+    Returns ``(ok, diagnosis)``: ``ok`` True when the backend came up and
+    passed the allocation check; ``diagnosis`` summarises the LAST failed
+    attempt so the artifact's error field can name the real root cause
+    (HBM-exhausted is a different operator action than dead-tunnel).
     """
     import subprocess
 
+    diagnosis = "no probe attempt ran"
     for i in range(attempts):
         t0 = time.perf_counter()
         try:
             r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; d = jax.devices(); "
-                 "print(d[0].platform, len(d))"],
+                [sys.executable, "-c", _PROBE_SNIPPET],
                 timeout=timeout_s, capture_output=True, text=True,
             )
             if r.returncode == 0:
                 print(f"  backend probe {i + 1}/{attempts} ok "
                       f"({time.perf_counter() - t0:.1f}s): "
                       f"{r.stdout.strip().splitlines()[-1]}", file=sys.stderr)
-                return True
-            detail = (r.stderr or r.stdout).strip().splitlines()
-            print(f"  backend probe {i + 1}/{attempts} failed rc={r.returncode}"
-                  f" ({detail[-1] if detail else 'no output'})",
-                  file=sys.stderr)
+                return True, "ok"
+            blob = (r.stderr or "") + (r.stdout or "")
+            detail = blob.strip().splitlines()
+            if "RESOURCE_EXHAUSTED" in blob:
+                # Init succeeded but the chip can't hold a 32 KB buffer:
+                # HBM is held by a stale process.  Worth retrying (the
+                # holder may exit), but the distinct diagnosis must reach
+                # the artifact if all attempts fail.
+                diagnosis = ("backend init succeeded but the chip has no "
+                             "free HBM — a tiny probe allocation raised "
+                             "RESOURCE_EXHAUSTED (stale process holding "
+                             "device memory?)")
+                print(f"  backend probe {i + 1}/{attempts}: init ok but HBM "
+                      "exhausted (stale process holding device memory?)",
+                      file=sys.stderr)
+            else:
+                diagnosis = (f"probe subprocess exited rc={r.returncode}: "
+                             f"{detail[-1] if detail else 'no output'}")
+                print(f"  backend probe {i + 1}/{attempts} failed "
+                      f"rc={r.returncode} "
+                      f"({detail[-1] if detail else 'no output'})",
+                      file=sys.stderr)
         except subprocess.TimeoutExpired:
+            diagnosis = (f"probe hung >{timeout_s:.0f}s with no output "
+                         "(dead tunnel relay?)")
             print(f"  backend probe {i + 1}/{attempts} hung >{timeout_s:.0f}s "
                   "(dead tunnel relay?)", file=sys.stderr)
         if i < attempts - 1:
             time.sleep(backoff_s * (i + 1))
-    return False
+    return False, diagnosis
+
+
+def _is_oom(e):
+    return "RESOURCE_EXHAUSTED" in repr(e)
+
+
+def _free_device_buffers():
+    """Best-effort release of every live device array + compiled executable.
+
+    The once-only OOM retry path: a transient RESOURCE_EXHAUSTED (another
+    process briefly held HBM, or a prior bench half's buffers are still
+    live) should not cost the round its artifact.  Deleting live arrays
+    frees their HBM immediately; clearing caches drops executables whose
+    temp allocations are sized to stale inputs.
+    """
+    import jax
+
+    freed = 0
+    for buf in list(jax.live_arrays()):
+        try:
+            buf.delete()
+            freed += 1
+        except Exception:
+            pass
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    print(f"  freed {freed} live device buffers + jit caches for OOM retry",
+          file=sys.stderr)
 
 
 def _make_data(n, d, seed=0, dtype="bfloat16", tile=32768, k_gen=64):
@@ -422,15 +495,37 @@ def bench_wallclock_to_converge(n=1_280_000, d=2048, k=1000, *, tol=1e-4,
     return out
 
 
-def _arm_init_watchdog(metric: str, unit: str, timeout_s: float = 180.0):
+def _merge_fresh_conv(line, fresh, unit):
+    """Overlay a THIS-RUN converge measurement onto a failure line.
+
+    Only a same-series fresh value may land: the headline (iter/s/chip)
+    line's ``wallclock_to_converge_s`` field names the N=1.28M config, so
+    a CPU-fallback 20k/256/64 converge dict (metric
+    ``..._cpu_fallback_...``, no ``@``) must never be written there.
+    """
+    conv = (fresh or {}).get("conv")
+    if (conv is not None and conv.get("value") is not None
+            and unit == "iter/s/chip"
+            and conv.get("metric", "").startswith(
+                "wallclock_to_converge_s@")):
+        line["wallclock_to_converge_s"] = conv["value"]
+        line["converge_vs_baseline"] = conv["vs_baseline"]
+        line["converge_fresh"] = True
+
+
+def _arm_watchdog(metric: str, unit: str, timeout_s: float, phase: str,
+                  fresh=None):
     """Bound the time a wedged accelerator runtime can stall the bench.
 
-    Backstop behind ``_probe_backend``: the tunnel can die in the window
-    between a successful subprocess probe and the main process's own init.
-    The watchdog disarms as soon as backend init returns; if it fires
-    instead, it prints one parseable JSON line — carrying forward the
-    latest builder-recorded measurement when one exists — and exits, so
-    the driver always gets a bench artifact in bounded time.
+    Backstop behind ``_probe_backend``: the tunnel can die at any moment
+    after a successful probe — before the main process's own init (rounds
+    1-2) or in the middle of a device computation, where
+    ``block_until_ready`` blocks forever and no exception ever surfaces,
+    so no try/except can save the artifact.  If the watchdog fires it
+    prints one parseable JSON line — carrying forward the latest
+    builder-recorded measurement when one exists — and exits, so the
+    driver always gets a bench artifact in bounded time.  ``.set()`` the
+    returned event to disarm.
     """
     import threading
 
@@ -440,16 +535,18 @@ def _arm_init_watchdog(metric: str, unit: str, timeout_s: float = 180.0):
         if disarm.wait(timeout_s):
             return
         try:
-            print(json.dumps(_carry_forward_line(
+            line = _carry_forward_line(
                 metric, unit,
-                f"accelerator runtime wedged: jax backend init did not "
-                f"return within {timeout_s:.0f}s (tunnel died after a "
-                "successful probe); no fresh measurement possible",
-            )), flush=True)
+                f"accelerator runtime wedged: {phase} did not finish "
+                f"within {timeout_s:.0f}s (tunnel died after a successful "
+                "probe?); no fresh measurement possible",
+            )
+            _merge_fresh_conv(line, fresh, unit)
+            print(json.dumps(line), flush=True)
         finally:        # the exit must happen even if the line can't print
             os._exit(0)
 
-    threading.Thread(target=fire, name="bench-init-watchdog",
+    threading.Thread(target=fire, name=f"bench-watchdog-{phase[:16]}",
                      daemon=True).start()
     return disarm
 
@@ -540,12 +637,24 @@ def main():
                     choices=("auto", "xla", "pallas"),
                     help="fused-pass backend (auto = pallas on TPU when "
                          "supported)")
+    ap.add_argument("--watchdog-s", type=float, default=2700.0,
+                    help="whole-run hang backstop: if the benches have not "
+                         "finished after this many seconds (tunnel death "
+                         "mid-computation blocks forever), emit the "
+                         "carry-forward artifact line and exit")
     args = ap.parse_args()
+    if args.input is not None and args.k is None:
+        ap.error("--input requires --k")
 
     # The failure line carries the metric name this invocation was asked
     # to produce, so a parse-last-line driver records the artifact in the
-    # right series.
-    if args.converge:
+    # right series.  An --input run gets its own series name: its failure
+    # line must NEVER carry synthetic-config numbers (there is no valid
+    # carry-forward source for an arbitrary real input), only the error.
+    if args.input is not None:
+        metric = f"real_input_fit@{os.path.basename(args.input)},k={args.k}"
+        unit = "s"
+    elif args.converge:
         metric, unit = "wallclock_to_converge_s@N=1.28M,d=2048,k=1000", "s"
     else:
         metric = "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000"
@@ -556,40 +665,80 @@ def main():
     # place a retry can live is a throwaway subprocess probe.  Worst case
     # time-to-artifact: attempts x timeout + backoffs ≈ 5 min.
     probe_attempts, probe_timeout = 3, 90.0
-    if not _probe_backend(attempts=probe_attempts, timeout_s=probe_timeout):
+    probe_ok, probe_diag = _probe_backend(attempts=probe_attempts,
+                                          timeout_s=probe_timeout)
+    if not probe_ok:
         print(json.dumps(_carry_forward_line(
             metric, unit,
-            f"accelerator backend failed to init in {probe_attempts} probe "
-            f"attempts ({probe_timeout:.0f}s timeout each, backoff between; "
-            "dead tunnel relay?); no fresh measurement possible",
+            f"accelerator backend unusable after {probe_attempts} probe "
+            f"attempts ({probe_timeout:.0f}s timeout each, backoff "
+            f"between) — last attempt: {probe_diag}; no fresh measurement "
+            "possible",
         )), flush=True)
         return
 
-    watchdog = _arm_init_watchdog(metric, unit)
+    # Everything after a successful probe runs under BOTH protections the
+    # round-3 failure demanded (VERDICT.md r3 item 1): a try/except that
+    # converts ANY raise into the carry-forward artifact line (round 3's
+    # empty artifact came from an uncaught RESOURCE_EXHAUSTED in the
+    # headline call), and a whole-run watchdog for the failures try/except
+    # cannot see (tunnel death mid-computation hangs block_until_ready
+    # forever).  Exactly one final JSON line comes out on every path.
+    fresh = {}
+    run_watchdog = _arm_watchdog(metric, unit, args.watchdog_s, "bench run",
+                                 fresh)
+    try:
+        line = _run_benches(args, metric, unit, fresh)
+    except Exception as e:
+        line = _carry_forward_line(
+            metric, unit,
+            f"bench raised after successful backend probe: "
+            f"{type(e).__name__}: {e}")
+        # The converge half may have measured fresh this run before the
+        # headline raised — report it over any stale carried value.
+        _merge_fresh_conv(line, fresh, unit)
+    run_watchdog.set()
+    print(json.dumps(line), flush=True)
+
+
+def _run_benches(args, metric, unit, fresh=None):
+    """All post-probe bench phases; returns the final artifact line dict.
+
+    ``fresh`` (a dict, when given) receives intermediate measurements as
+    they land — main()'s exception handler reads it so a fresh converge
+    number survives a later headline crash instead of being shadowed by a
+    stale carried-forward record.
+    """
+    if fresh is None:
+        fresh = {}
+    init_watchdog = _arm_watchdog(metric, unit, 180.0, "jax backend init")
     import jax
 
     dev = jax.devices()[0]
     n_chips = len(jax.devices())
-    watchdog.set()          # backend is alive — disarm
+    init_watchdog.set()          # backend is alive — disarm
     print(f"platform={dev.platform} devices={n_chips}", file=sys.stderr)
 
     if args.input is not None:
-        if args.k is None:
-            ap.error("--input requires --k")
-        print(json.dumps(bench_input_file(
+        return bench_input_file(
             args.input, args.k, iters=args.iters, backend=args.backend,
-        )))
-        return
+        )
 
     if args.all:
         from kmeans_tpu.data import BENCH_CONFIGS
 
         for name, cfg in BENCH_CONFIGS.items():
-            r = bench_lloyd_iters_per_s(
-                cfg["n"], cfg["d"], cfg["k"], iters=args.iters, verbose=True,
-                backend=args.backend,
-            )
-            print(f"{name}: {r:.2f} Lloyd iter/s", file=sys.stderr)
+            try:
+                r = bench_lloyd_iters_per_s(
+                    cfg["n"], cfg["d"], cfg["k"], iters=args.iters,
+                    verbose=True, backend=args.backend,
+                )
+                print(f"{name}: {r:.2f} Lloyd iter/s", file=sys.stderr)
+            except Exception as e:  # one config must not kill the table
+                print(f"{name}: ERROR {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                if _is_oom(e):
+                    _free_device_buffers()
 
     def converge_line():
         # Wall-clock-to-converge: the second half of the driver metric
@@ -615,9 +764,7 @@ def main():
         }
 
     if args.converge:
-        conv = converge_line()
-        print(json.dumps(conv))
-        return
+        return converge_line()
 
     conv = None
     if not args.iters_only:
@@ -627,7 +774,10 @@ def main():
             print(f"  converge bench errored: {e}", file=sys.stderr)
             conv = {"value": None, "vs_baseline": None,  # headline line
                     "error": f"{type(e).__name__}: {e}"}
+            if _is_oom(e):  # leave a clean slate for the halves that follow
+                _free_device_buffers()
     if conv is not None and conv.get("value") is not None:
+        fresh["conv"] = conv
         print(json.dumps(conv))
 
     # On-chip kernel correctness (driver-visible): compiled Mosaic kernel
@@ -643,6 +793,8 @@ def main():
         except Exception as e:  # compile/gate failure: record, keep benching
             pallas_check = f"ERROR: {type(e).__name__}: {e}"
             print(f"  pallas-vs-xla check errored: {e}", file=sys.stderr)
+            if _is_oom(e):
+                _free_device_buffers()
 
     # Headline: the north-star config on however many chips we have.
     if dev.platform != "tpu":
@@ -658,8 +810,21 @@ def main():
             "vs_baseline": None,
         }
     else:
-        rate = bench_lloyd_iters_per_s(iters=args.iters, verbose=True,
-                                       backend=args.backend)
+        try:
+            rate = bench_lloyd_iters_per_s(iters=args.iters, verbose=True,
+                                           backend=args.backend)
+        except Exception as e:
+            # Round 3's fatal path: an OOM here escaped and the artifact
+            # was empty.  Free whatever the earlier halves left on the
+            # device and retry ONCE; a second failure propagates to
+            # main()'s carry-forward handler.
+            if not _is_oom(e):
+                raise
+            print(f"  headline bench OOM ({e}); retrying once after "
+                  "freeing device memory", file=sys.stderr)
+            _free_device_buffers()
+            rate = bench_lloyd_iters_per_s(iters=args.iters, verbose=True,
+                                           backend=args.backend)
         per_chip = rate / max(1, n_chips)
         line = {
             "metric": "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000",
@@ -682,7 +847,7 @@ def main():
     if (dev.platform == "tpu" and line.get("value") is not None
             and line.get("wallclock_to_converge_s") is not None):
         _record_local(line)
-    print(json.dumps(line))
+    return line
 
 
 if __name__ == "__main__":

@@ -6,8 +6,11 @@ subprocess attempts, and when every attempt fails it emits a failure JSON
 that carries forward the most recent builder-recorded on-chip measurement
 with provenance — so the driver artifact never lands empty-handed again.
 
-No jax import anywhere here: the machinery under test must work exactly
-when the accelerator runtime is unusable.
+No jax import in THIS process anywhere here: the machinery under test
+must work exactly when the accelerator runtime is unusable.  (The one
+exception is test_probe_snippet_allocates_and_computes, which execs the
+probe snippet in a SUBPROCESS on the CPU backend to pin its semantics —
+it skips itself when jax is not importable there.)
 """
 
 import json
@@ -144,11 +147,13 @@ def test_probe_timeout_is_bounded():
     try:
         import time
         t0 = time.perf_counter()
-        ok = bench._probe_backend(attempts=2, timeout_s=0.5, backoff_s=0.1)
+        ok, diag = bench._probe_backend(attempts=2, timeout_s=0.5,
+                                        backoff_s=0.1)
         dt = time.perf_counter() - t0
     finally:
         subprocess.run = orig
     assert ok is False
+    assert "hung" in diag
     assert dt < 10
 
 
@@ -159,7 +164,7 @@ def test_main_emits_carried_artifact_when_probe_fails():
         [sys.executable, "-c",
          "import sys; sys.path.insert(0, %r)\n"
          "import bench\n"
-         "bench._probe_backend = lambda **kw: False\n"
+         "bench._probe_backend = lambda **kw: (False, 'probe hung >90s with no output (dead tunnel relay?)')\n"
          "sys.argv = ['bench.py']\n"
          "bench.main()" % REPO],
         capture_output=True, text=True, timeout=60, cwd=REPO,
@@ -171,3 +176,271 @@ def test_main_emits_carried_artifact_when_probe_fails():
     # The repo carries BENCH_LOCAL history, so the artifact must carry data.
     assert rec["carried_forward"] is True
     assert rec["value"] is not None
+
+
+# ---------------------------------------------------------------------------
+# Round-4 hardening (VERDICT.md r3 item 1): round 3's artifact landed empty
+# because a RESOURCE_EXHAUSTED *after* a successful probe escaped uncaught.
+# These tests inject a failure into each post-probe phase and assert the
+# final stdout line is still a parseable artifact.  A fake ``jax`` module
+# stands in for the backend so the tests exercise exactly the paths that
+# run when the real chip misbehaves.
+
+_FAKE_JAX_PROLOGUE = """
+import sys, types
+sys.path.insert(0, %(repo)r)
+fake = types.ModuleType("jax")
+class _Dev:
+    platform = "tpu"
+fake.devices = lambda: [_Dev()]
+fake.live_arrays = lambda: []
+fake.clear_caches = lambda: None
+sys.modules["jax"] = fake
+import bench
+bench._REPO = %(tmp)r
+bench._probe_backend = lambda **kw: (True, "ok")
+"""
+
+
+def _run_main_script(body, tmp_path, argv=("bench.py",), timeout=60):
+    script = (_FAKE_JAX_PROLOGUE % {"repo": REPO, "tmp": str(tmp_path)}
+              + body + f"\nimport sys\nsys.argv = {list(argv)!r}\n"
+              "bench.main()\n")
+    return subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=timeout,
+                          cwd=REPO)
+
+
+def _seed_record(tmp_path, value=15.0):
+    rec = {"metric": ITERS_METRIC, "value": value, "vs_baseline": 12.0,
+           "timestamp": "2026-07-30T15:03Z",
+           "wallclock_to_converge_s": 1.67, "converge_vs_baseline": 47.9}
+    (tmp_path / "BENCH_LOCAL_latest.json").write_text(json.dumps(rec))
+
+
+def test_main_emits_carried_artifact_when_headline_ooms(tmp_path):
+    # Round 3's exact failure mode: probe ok, then every device phase OOMs.
+    # The final line must be the carried artifact, and the headline must
+    # have been retried once after freeing device memory.
+    _seed_record(tmp_path)
+    body = """
+def _boom(*a, **kw):
+    raise RuntimeError("RESOURCE_EXHAUSTED: out of memory while trying "
+                       "to allocate 8192 bytes")
+bench.bench_wallclock_to_converge = _boom
+bench.check_pallas_vs_xla = _boom
+bench.bench_lloyd_iters_per_s = _boom
+"""
+    r = _run_main_script(body, tmp_path)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == ITERS_METRIC
+    assert rec["carried_forward"] is True
+    assert rec["value"] == 15.0
+    assert "RESOURCE_EXHAUSTED" in rec["error"]
+    assert "retrying once" in r.stderr          # the OOM retry path ran
+    assert "freed 0 live device buffers" in r.stderr
+
+
+def test_main_oom_retry_recovers_fresh_value(tmp_path):
+    # Transient OOM: first headline call raises, the retry succeeds -> the
+    # artifact carries the FRESH value (no carried_forward), and the local
+    # record lands in the scratch repo dir.
+    body = """
+calls = {"n": 0}
+def _flaky(*a, **kw):
+    calls["n"] += 1
+    if calls["n"] == 1:
+        raise RuntimeError("RESOURCE_EXHAUSTED: boom")
+    return 12.5
+bench.bench_lloyd_iters_per_s = _flaky
+bench.bench_wallclock_to_converge = lambda *a, **kw: {
+    "total_s": 1.5, "init_s": 0.2, "lloyd_s": 1.3, "n_iter": 10,
+    "converged": True, "inertia": 1.0, "tol_abs": 1e-3}
+bench.check_pallas_vs_xla = lambda *a, **kw: {"labels_equal": True}
+"""
+    r = _run_main_script(body, tmp_path)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 12.5
+    assert "carried_forward" not in rec
+    assert rec["wallclock_to_converge_s"] == 1.5
+    assert (tmp_path / "BENCH_LOCAL_latest.json").exists()
+
+
+def test_main_nonoom_raise_still_emits_artifact(tmp_path):
+    # A non-OOM raise (version skew, tunnel RPC error, ...) must not be
+    # retried but must still produce the carried artifact line.
+    _seed_record(tmp_path, value=14.0)
+    body = """
+def _boom(*a, **kw):
+    raise ValueError("jaxlib/mosaic version skew")
+bench.bench_wallclock_to_converge = _boom
+bench.check_pallas_vs_xla = _boom
+bench.bench_lloyd_iters_per_s = _boom
+"""
+    r = _run_main_script(body, tmp_path)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["carried_forward"] is True
+    assert rec["value"] == 14.0
+    assert "version skew" in rec["error"]
+    assert "retrying once" not in r.stderr
+
+
+def test_main_converge_error_does_not_kill_headline(tmp_path):
+    body = """
+def _boom(*a, **kw):
+    raise RuntimeError("RESOURCE_EXHAUSTED: converge half boom")
+bench.bench_wallclock_to_converge = _boom
+bench.check_pallas_vs_xla = lambda *a, **kw: {"labels_equal": True}
+bench.bench_lloyd_iters_per_s = lambda *a, **kw: 16.0
+"""
+    r = _run_main_script(body, tmp_path)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 16.0
+    assert rec["wallclock_to_converge_s"] is None
+    assert "converge half boom" in rec["converge_error"]
+
+
+def test_main_watchdog_rescues_midrun_hang(tmp_path):
+    # Tunnel death mid-computation: block_until_ready never returns and no
+    # exception fires.  The whole-run watchdog must emit the carried
+    # artifact and exit in bounded time.
+    _seed_record(tmp_path)
+    body = """
+import time
+bench.bench_lloyd_iters_per_s = lambda *a, **kw: time.sleep(600)
+"""
+    import time as _t
+    t0 = _t.perf_counter()
+    r = _run_main_script(body, tmp_path,
+                         argv=("bench.py", "--iters-only",
+                               "--watchdog-s", "2"), timeout=90)
+    dt = _t.perf_counter() - t0
+    assert dt < 60
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["carried_forward"] is True
+    assert rec["value"] == 15.0
+    assert "wedged" in rec["error"]
+
+
+def test_probe_detects_hbm_exhausted_chip(capsys):
+    # Round 3's chip: init fine, zero free HBM.  The probe's device
+    # allocation must catch it and report the distinct diagnosis.
+    class _R:
+        returncode = 1
+        stdout = ""
+        stderr = ("RESOURCE_EXHAUSTED: Out of memory while trying to "
+                  "allocate 32768 bytes")
+
+    real_run = subprocess.run
+    subprocess.run = lambda *a, **kw: _R()
+    try:
+        ok, diag = bench._probe_backend(attempts=2, timeout_s=1.0,
+                                        backoff_s=0.0)
+    finally:
+        subprocess.run = real_run
+    assert ok is False
+    assert "no free HBM" in diag
+    assert "HBM exhausted" in capsys.readouterr().err
+
+
+def test_probe_snippet_allocates_and_computes():
+    # The probe must prove the chip can hold a buffer and run a matmul,
+    # not just init (VERDICT r3 weak-2).  Pin the snippet's semantics by
+    # executing it on the CPU backend in a subprocess.
+    script = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+              + "exec(%r)" % bench._PROBE_SNIPPET)
+    r = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                       text=True, timeout=120)
+    if r.returncode != 0 and "ModuleNotFoundError" in r.stderr:
+        pytest.skip("jax not importable in a plain subprocess here")
+    assert r.returncode == 0, r.stderr
+    out = r.stdout.strip().splitlines()[-1].split()
+    assert out[0] == "cpu" and out[2] == "128"
+
+
+def test_main_input_failure_stays_in_its_own_series(tmp_path):
+    # A failed --input run must NOT emit a carried synthetic-config record
+    # (wrong series): its artifact names the real_input series and carries
+    # only the error (code-review r4 finding).
+    _seed_record(tmp_path)
+    body = """
+def _boom(*a, **kw):
+    raise ValueError("input file is 1-D, expected (n, d)")
+bench.bench_input_file = _boom
+"""
+    r = _run_main_script(body, tmp_path,
+                         argv=("bench.py", "--input", "real.npy",
+                               "--k", "100"))
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "real_input_fit@real.npy,k=100"
+    assert rec["value"] is None
+    assert "carried_forward" not in rec
+    assert "1-D" in rec["error"]
+
+
+def test_main_fresh_converge_survives_headline_crash(tmp_path):
+    # converge measures fresh, then the headline raises non-OOM: the final
+    # carried line must report the FRESH converge value, not the stale
+    # record's (code-review r4 finding).
+    _seed_record(tmp_path)      # stale record says converge=1.67
+    body = """
+bench.bench_wallclock_to_converge = lambda *a, **kw: {
+    "total_s": 0.99, "init_s": 0.2, "lloyd_s": 0.79, "n_iter": 10,
+    "converged": True, "inertia": 1.0, "tol_abs": 1e-3}
+bench.check_pallas_vs_xla = lambda *a, **kw: {"labels_equal": True}
+def _boom(*a, **kw):
+    raise ValueError("mosaic version skew at headline shape")
+bench.bench_lloyd_iters_per_s = _boom
+"""
+    r = _run_main_script(body, tmp_path)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["carried_forward"] is True       # iters half: stale 15.0
+    assert rec["value"] == 15.0
+    assert rec["wallclock_to_converge_s"] == 0.99   # converge half: FRESH
+    assert rec["converge_fresh"] is True
+
+
+def test_watchdog_fire_preserves_fresh_converge(tmp_path):
+    # Headline hangs AFTER a fresh converge measurement: the watchdog's
+    # final line must carry the fresh converge value, like the raise path
+    # (code-review r4 finding).
+    _seed_record(tmp_path)      # stale record says converge=1.67
+    body = """
+import time
+bench.bench_wallclock_to_converge = lambda *a, **kw: {
+    "total_s": 0.77, "init_s": 0.2, "lloyd_s": 0.57, "n_iter": 9,
+    "converged": True, "inertia": 1.0, "tol_abs": 1e-3}
+bench.check_pallas_vs_xla = lambda *a, **kw: {"labels_equal": True}
+bench.bench_lloyd_iters_per_s = lambda *a, **kw: time.sleep(600)
+"""
+    r = _run_main_script(body, tmp_path,
+                         argv=("bench.py", "--watchdog-s", "3"), timeout=90)
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["carried_forward"] is True
+    assert "wedged" in rec["error"]
+    assert rec["wallclock_to_converge_s"] == 0.77
+    assert rec["converge_fresh"] is True
+
+
+def test_merge_fresh_conv_rejects_cross_series():
+    # A CPU-fallback converge dict (20k/256/64, metric has no '@') must
+    # never land in the N=1.28M headline field (code-review r4 finding).
+    line = {"metric": ITERS_METRIC}
+    bench._merge_fresh_conv(
+        line,
+        {"conv": {"metric": "wallclock_to_converge_s_cpu_fallback_20k_256_64",
+                  "value": 3.2, "vs_baseline": None}},
+        "iter/s/chip")
+    assert "wallclock_to_converge_s" not in line
+
+    bench._merge_fresh_conv(
+        line, {"conv": {"metric": CONV_METRIC + ",chips=1", "value": 1.41,
+                        "vs_baseline": 56.7}}, "iter/s/chip")
+    assert line["wallclock_to_converge_s"] == 1.41
+    assert line["converge_fresh"] is True

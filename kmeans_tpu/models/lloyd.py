@@ -28,6 +28,12 @@ from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = ["KMeansState", "fit_lloyd", "KMeans", "best_of_n_init"]
 
+#: Full-reduction refresh period of the ``update="delta"`` loop: one sweep
+#: in every _DELTA_REFRESH recomputes sums/counts from scratch, bounding the
+#: f32 drift of repeated +/- delta accumulation (~1e-7 relative per sweep)
+#: to a level far below the bf16 distance noise that dominates label ties.
+_DELTA_REFRESH = 16
+
 
 class KMeansState(NamedTuple):
     """Result of a fit: arrays are committed (device) values."""
@@ -64,33 +70,102 @@ def _lloyd_loop(
         weights=weights,
         chunk_size=chunk_size,
         compute_dtype=compute_dtype,
-        update=update,
+        update=update,           # lloyd_pass maps "delta" -> "matmul"
         backend=backend,
     )
 
-    def cond(s):
-        c, it, shift_sq, done = s
-        return (it < max_iter) & ~done
+    def reseed(new_c, counts, min_d2):
+        if empty != "farthest":
+            return new_c
+        mind = min_d2 if weights is None else jnp.where(
+            weights > 0, min_d2, -jnp.inf
+        )
+        return reseed_empty_farthest(new_c, counts, x, mind)
 
-    def body(s):
-        c, it, _, _ = s
-        labels, min_d2, sums, counts, _ = lloyd_pass(x, c, **kw)
-        new_c = apply_update(c, sums, counts)
-        if empty == "farthest":
-            mind = min_d2 if weights is None else jnp.where(
-                weights > 0, min_d2, -jnp.inf
-            )
-            new_c = reseed_empty_farthest(new_c, counts, x, mind)
-        shift_sq = jnp.sum((new_c - c) ** 2)
-        return (new_c, it + 1, shift_sq, shift_sq <= tol)
+    if update == "delta":
+        # Incremental update (ops/delta): distance matmul every sweep, the
+        # one-hot update only over rows whose label changed — halves the
+        # steady-state MXU work.  The carried (labels, sums, counts) always
+        # satisfy sums == Σ w·x·onehot(labels); a full refresh every
+        # _DELTA_REFRESH sweeps bounds f32 +/- drift.  Reseeding composes:
+        # the invariant constrains labels/sums, not where centroids moved.
+        from kmeans_tpu.ops.delta import default_cap, delta_pass
 
-    init = (
-        centroids0.astype(jnp.float32),
-        jnp.zeros((), jnp.int32),
-        jnp.asarray(jnp.inf, jnp.float32),
-        jnp.zeros((), bool),
-    )
-    centroids, n_iter, shift_sq, converged = lax.while_loop(cond, body, init)
+        n, _ = x.shape
+        cap = default_cap(n)
+        dkw = dict(
+            weights=weights, cap=cap, chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            # resolve_backend gated "pallas" at the CLASSIC kernel's
+            # footprint; hand "auto" down so delta_pass re-gates at the
+            # delta kernel's own (block_rows=1024) footprint and falls
+            # back to XLA instead of failing Mosaic VMEM checks.
+            backend="auto" if backend == "pallas" else backend,
+            # The raw-score shortcut is only safe when min_d2 is never
+            # read; the farthest-reseed policy reads it every sweep.
+            with_mind=(empty == "farthest"),
+        )
+
+        def cond(s):
+            c, it, shift_sq, done, lab, sums, counts = s
+            return (it < max_iter) & ~done
+
+        def body(s):
+            c, it, _, _, lab, sums, counts = s
+
+            def refresh_sweep(_):
+                # Drift-bounding refresh (and the first sweep): the classic
+                # fused pass computes labels + full sums in ONE read of x —
+                # running the delta kernel and then discarding its
+                # compaction for a separate full reduction would cost ~2x
+                # a classic sweep.
+                labels, min_d2, s2, c2, _ = lloyd_pass(x, c, **kw)
+                return labels, min_d2, s2, c2
+
+            def delta_sweep(_):
+                labels, min_d2, s2, c2, _, _ = delta_pass(
+                    x, c, lab, sums, counts, **dkw)
+                return labels, min_d2, s2, c2
+
+            lab, min_d2, sums, counts = lax.cond(
+                (it % _DELTA_REFRESH) == 0, refresh_sweep, delta_sweep, None)
+            new_c = reseed(apply_update(c, sums, counts), counts, min_d2)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol, lab, sums,
+                    counts)
+
+        k, d = centroids0.shape
+        init = (
+            centroids0.astype(jnp.float32),
+            jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),     # sentinel -> first sweep full
+            jnp.zeros((k, d), jnp.float32),
+            jnp.zeros((k,), jnp.float32),
+        )
+        centroids = lax.while_loop(cond, body, init)
+        centroids, n_iter, shift_sq, converged = centroids[:4]
+    else:
+        def cond(s):
+            c, it, shift_sq, done = s
+            return (it < max_iter) & ~done
+
+        def body(s):
+            c, it, _, _ = s
+            labels, min_d2, sums, counts, _ = lloyd_pass(x, c, **kw)
+            new_c = reseed(apply_update(c, sums, counts), counts, min_d2)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol)
+
+        init = (
+            centroids0.astype(jnp.float32),
+            jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.zeros((), bool),
+        )
+        centroids, n_iter, shift_sq, converged = lax.while_loop(
+            cond, body, init)
     # Final consistent view: labels/inertia/counts at the *final* centroids.
     labels, _, _, counts, inertia = lloyd_pass(x, centroids, **kw)
     return KMeansState(centroids, labels, inertia, n_iter, converged, counts)

@@ -1,0 +1,269 @@
+"""Incremental (delta) Lloyd update: distance pass + changed-rows-only reduce.
+
+The classic fused pass (:func:`kmeans_tpu.ops.lloyd.lloyd_pass`) spends two
+equal MXU matmuls per sweep — the (n, d) @ (d, k) distance product and the
+(k, n) @ (n, d) one-hot update product — i.e. 4·n·d·k FLOPs per Lloyd
+iteration.  On a v5e chip the measured 16 iter/s at the north-star config is
+~86% of bf16 peak counting BOTH matmuls, so the dense pass has no 20-iter/s
+headroom: peak itself is only ~18.8 iter/s at 4·n·d·k.  The FLOPs must be
+removed, not rescheduled (VERDICT.md r3 item 3).
+
+This module removes the update matmul's n-dependence.  Lloyd label churn
+collapses after the first iterations (measured at the north-star bench
+config: 78% on iteration 1, then 5-10% steady-state), and the per-cluster
+sums are an additive function of the assignment:
+
+    sums_t = sums_{t-1} + Σ_{i: changed} w_i·x_i·(e_{new_i} - e_{old_i})
+
+so a sweep only needs the distance matmul (2·n·d·k) plus a one-hot update
+over the ~8% of rows that changed labels — gathered into a fixed-capacity
+buffer so shapes stay static under jit.  When more than ``cap`` rows change
+(always true on the first pass, where every row "changes" from the -1
+sentinel), a ``lax.cond`` falls back to the full reduction over all rows.
+
+TPU-first details:
+
+* the changed-row compaction is ``jnp.nonzero(..., size=cap, fill_value=n)``
+  — static shapes, no host sync;
+* on TPU the whole sweep is ONE fused kernel
+  (:func:`kmeans_tpu.ops.pallas_lloyd.lloyd_delta_pallas`): changed rows
+  compact per tile via an MXU permutation-matrix gather and fold in a
+  single signed one-hot matmul (+w at the new label, -w at the old); the
+  XLA route gathers changed rows into a fixed-``cap`` buffer and folds
+  them twice per HBM read (:func:`_accumulate_xla`);
+* subtraction weights are exactly representable (-1, or -w in f32 compute),
+  under the same :func:`kmeans_tpu.ops.lloyd.weights_exact` policy as the
+  fused pass;
+* float drift from repeated +/- accumulation is bounded by periodic full
+  refreshes (``force_full``, driven by the fit loop's ``delta_refresh``).
+
+The reference has no analog (its assignment is human drag-and-drop,
+/root/reference/app.mjs:358-372); this is north-star numeric engine work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.ops.distance import matmul_precision
+from kmeans_tpu.ops.lloyd import lloyd_pass, weights_exact
+from kmeans_tpu.ops.pallas_lloyd import (accumulate_pallas,
+                                         delta_pallas_supported,
+                                         lloyd_delta_pallas)
+
+__all__ = ["delta_pass", "default_cap"]
+
+
+def default_cap(n: int) -> int:
+    """Fixed capacity of the changed-rows buffer: n/8 covers the measured
+    5-10% steady-state churn with margin while keeping the delta matmul at
+    ~1/8 the cost of a full update."""
+    return max(1, n // 8)
+
+
+def _accumulate_xla(x, lab_a, w_a, lab_b, w_b, k, *, chunk_size,
+                    compute_dtype):
+    """Chunked one-hot accumulation (the Pallas-kernel fallback): one —
+    or, when ``lab_b`` is given, two — (chunk, k)ᵀ @ (chunk, d) MXU
+    products per tile, f32 accumulators.  Sentinel labels (outside
+    [0, k)) contribute nothing; the dual fold serves the delta path's
+    add-at-new / subtract-at-old in a single read of each tile."""
+    n, d = x.shape
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    dual = lab_b is not None
+
+    pad = (-n) % chunk_size
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
+        lab_a = jnp.concatenate([lab_a, jnp.full((pad,), -1, jnp.int32)])
+        w_a = jnp.concatenate([w_a, jnp.zeros((pad,), f32)])
+        if dual:
+            lab_b = jnp.concatenate(
+                [lab_b, jnp.full((pad,), -1, jnp.int32)])
+            w_b = jnp.concatenate([w_b, jnp.zeros((pad,), f32)])
+    n_chunks = (n + pad) // chunk_size
+    cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+
+    def fold(sums, counts, xb_c, lab, w):
+        onehot = lab[:, None] == cols                 # sentinel matches none
+        wt = onehot * w[:, None]                      # (chunk, k) f32
+        counts = counts + jnp.sum(wt, axis=0)
+        sums = sums + jnp.matmul(
+            wt.T.astype(cd), xb_c, preferred_element_type=f32,
+            precision=matmul_precision(cd),
+        )
+        return sums, counts
+
+    def body(carry, tile):
+        sums, counts = carry
+        xb, la, wa, lb, wb = tile
+        xb_c = xb.astype(cd)
+        sums, counts = fold(sums, counts, xb_c, la, wa)
+        if dual:
+            sums, counts = fold(sums, counts, xb_c, lb, wb)
+        return (sums, counts), None
+
+    reshape = lambda a: a.reshape(n_chunks, chunk_size, *a.shape[1:])
+    zeros_i = jnp.zeros((n_chunks, chunk_size), jnp.int32)
+    zeros_f = jnp.zeros((n_chunks, chunk_size), f32)
+    (sums, counts), _ = lax.scan(
+        body,
+        (jnp.zeros((k, d), f32), jnp.zeros((k,), f32)),
+        (
+            reshape(x), reshape(lab_a), reshape(w_a),
+            reshape(lab_b) if dual else zeros_i,
+            reshape(w_b) if dual else zeros_f,
+        ),
+    )
+    return sums, counts
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cap", "chunk_size", "compute_dtype", "backend",
+                     "weights_are_binary", "with_mind"),
+)
+def delta_pass(
+    x: jax.Array,
+    centroids: jax.Array,
+    labels_prev: jax.Array,
+    sums_prev: jax.Array,
+    counts_prev: jax.Array,
+    *,
+    weights: Optional[jax.Array] = None,
+    cap: int,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+    backend: str = "xla",
+    weights_are_binary: bool = False,
+    force_full: Optional[jax.Array] = None,
+    with_mind: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One Lloyd sweep with an incremental update.
+
+    Args:
+      x: (n, d) points.
+      centroids: (k, d) current centroids.
+      labels_prev: (n,) int32 labels from the previous sweep, and
+        ``sums_prev``/``counts_prev`` the matching reduction — the invariant
+        is ``sums_prev == Σ_i w_i·x_i·onehot(labels_prev_i)`` (how the
+        centroids moved since is irrelevant, so empty-cluster reseeding
+        composes).  Pass ``labels_prev = -1`` everywhere (with zero sums) to
+        force the full reduction, e.g. on the first sweep.
+      cap: static capacity of the changed-rows buffer on the XLA
+        (gather-based) route; more churn than this falls back to the full
+        reduction.  The Pallas route compacts per kernel tile instead and
+        falls back on any tile overflow — ``cap`` is not used there.
+      force_full: optional traced bool — True forces the full reduction
+        (the fit loop's periodic drift-bounding refresh).
+      with_mind: when False, ``min_d2``/``inertia`` come back as raw
+        scores (no row norm) — for loops that converge on centroid shift
+        and read neither; Pallas route only, saves the (T, d) row-norm
+        pass.
+
+    Returns:
+      ``(labels, min_d2, sums, counts, inertia, n_changed)`` with the same
+      meanings as :func:`kmeans_tpu.ops.lloyd.lloyd_pass`; ``sums``/
+      ``counts`` always satisfy the invariant for ``labels``, whichever
+      branch ran.
+    """
+    n, d = x.shape
+    k = centroids.shape[0]
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    # The delta subtract side uses -w: exact for the internal ±1 weights or
+    # f32 compute, same policy as the fused kernel's one-hot cast.  The
+    # VMEM gate runs at the DELTA kernel's own footprint (block_rows=1024
+    # plus the resident triangular prefix operand) — an upstream
+    # resolve_backend "pallas" was gated at the classic kernel's 512-row
+    # estimate and must not be trusted here, so the fit loop hands this
+    # function "auto".
+    supported = (
+        weights_exact(cd, weights=weights,
+                      weights_are_binary=weights_are_binary)
+        and delta_pallas_supported(n, d, k,
+                                   x_itemsize=x.dtype.itemsize,
+                                   cd_itemsize=cd.itemsize)
+    )
+    if backend == "pallas" and not supported:
+        raise ValueError(
+            "pallas delta pass unsupported here (needs TPU-shaped VMEM at "
+            "block_rows=1024, lane-alignable d, and binary weights unless "
+            "f32); use backend='auto' to fall back"
+        )
+    use_pallas = backend == "pallas" or (backend == "auto" and supported)
+    w_all = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+
+    if use_pallas:
+        # Fused single-sweep kernel: distance + argmin + in-tile matmul
+        # compaction + signed one-hot fold, one HBM read of x.  Its delta
+        # is valid unless any tile overflowed its slot budget (first
+        # sweeps and high-churn sweeps overflow by design).
+        (labels, min_d2, dsums, dcounts, inertia, n_changed,
+         overflowed) = lloyd_delta_pallas(
+            x, centroids, labels_prev, weights=weights,
+            compute_dtype=compute_dtype, with_mind=with_mind,
+        )
+        pred = ~overflowed
+        if force_full is not None:
+            pred = pred & ~force_full
+
+        def incremental(_):
+            return sums_prev + dsums, counts_prev + dcounts
+
+        def full(_):
+            s, c, _ = accumulate_pallas(
+                x, labels, k, weights=w_all, compute_dtype=compute_dtype,
+            )
+            return s, c
+
+        sums, counts = lax.cond(pred, incremental, full, None)
+        return labels, min_d2, sums, counts, inertia, n_changed
+
+    labels, min_d2, _, _, inertia = lloyd_pass(
+        x, centroids, weights=weights, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, with_update=False,
+        weights_are_binary=weights_are_binary, backend=backend,
+    )
+
+    # Zero-weight rows contribute nothing to sums, so they are never
+    # "changed" — the same exclusion the Pallas kernel applies, keeping
+    # n_changed's meaning identical across backends and cap slots for
+    # rows that matter.
+    changed = (labels != labels_prev) & (w_all > 0.0)
+    n_changed = jnp.sum(changed)
+    pred = n_changed <= cap
+    if force_full is not None:
+        pred = pred & ~force_full
+
+    def _acc(rows, lab_a, w_a, lab_b, w_b):
+        return _accumulate_xla(rows, lab_a, w_a, lab_b, w_b, k,
+                               chunk_size=chunk_size,
+                               compute_dtype=compute_dtype)
+
+    def incremental(_):
+        idx = jnp.nonzero(changed, size=cap, fill_value=n)[0]
+        valid = idx < n
+        safe = jnp.where(valid, idx, 0)
+        rows = x[safe]                                 # (cap, d)
+        wg = jnp.where(valid, w_all[safe], 0.0)
+        lab_new = jnp.where(valid, labels[safe], -1)   # sentinel: no-op
+        lab_old = jnp.where(valid, labels_prev[safe], -1)
+        ds, dc = _acc(rows, lab_new, wg, lab_old, -wg)
+        return sums_prev + ds, counts_prev + dc
+
+    def full(_):
+        s, c = _accumulate_xla(x, labels, w_all, None, None, k,
+                               chunk_size=chunk_size,
+                               compute_dtype=compute_dtype)
+        return s, c
+
+    sums, counts = lax.cond(pred, incremental, full, None)
+    return labels, min_d2, sums, counts, inertia, n_changed

@@ -149,6 +149,13 @@ def lloyd_pass(
     """
     if backend not in ("xla", "pallas", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
+    if update == "delta":
+        # "delta" is a LOOP-level structure (carried labels/sums state in
+        # fit_lloyd); a single stateless sweep's reduction is the dense
+        # matmul.  Accepting it here lets every model that forwards
+        # cfg.update (spherical, trimmed, accelerated, runner, ...) run
+        # under a delta-configured KMeansConfig.
+        update = "matmul"
     if backend != "xla":
         ok = _pallas_ok(
             x, centroids.shape[0], weights=weights,

@@ -38,7 +38,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
-__all__ = ["lloyd_pass_pallas", "accumulate_pallas", "pallas_supported"]
+__all__ = ["lloyd_pass_pallas", "accumulate_pallas", "pallas_supported",
+           "lloyd_delta_pallas", "delta_pallas_supported"]
 
 # Fallback VMEM budget when the device can't be queried (non-TPU default
 # backend, e.g. interpret-mode tests on the CPU mesh).  Calibrated
@@ -133,6 +134,27 @@ def pallas_supported(n: int, d: int, k: int, *, block_rows: int = 512,
     return est <= _vmem_budget()
 
 
+def delta_pallas_supported(n: int, d: int, k: int, *,
+                           block_rows: int = 1024, mc: int = 152,
+                           x_itemsize: int = 2,
+                           cd_itemsize: int = 2) -> bool:
+    """VMEM gate for :func:`lloyd_delta_pallas` — the classic estimate
+    PLUS the delta kernel's own resident operands: the (T, T) triangular
+    prefix matrix and the (mc, ·) compaction intermediates.  The classic
+    gate alone under-counts by ~5 MiB at the default tile, which matters
+    on small-VMEM generations and VMEM-marginal shapes."""
+    d_eff = padded_d(d)
+    if not d_eff:
+        return False
+    k_pad = _round_up(k, _LANE)
+    est = _vmem_estimate(block_rows, d_eff, k_pad, x_itemsize, cd_itemsize)
+    est += block_rows * block_rows * cd_itemsize        # resident tri
+    est += mc * block_rows * (4 + cd_itemsize)          # p_mat + builds
+    est += mc * d_eff * 4                               # x_c gather output
+    est += mc * k_pad * (4 + cd_itemsize)               # signed one-hot
+    return est <= _vmem_budget()
+
+
 def _fold_tile(sums_ref, counts_ref, labels, w, xb_c, cols, *, cd):
     """Fold one tile into the (sums, counts) accumulators: one-hot from
     ``labels`` (any value outside the column range matches nothing), counts
@@ -159,10 +181,38 @@ def _row_sq(xb):
     return jnp.sum(xf * xf, axis=1)
 
 
+def _argmin_rows(part, k_pad):
+    """Row-wise (min, argmin-with-lowest-index-tie-break) of ``part``.
+
+    Spelled as an integer min over the columns that achieve the row minimum
+    — Mosaic has no argmin lowering.  THE one copy shared by every kernel
+    in this file; the tie-break must match ``jnp.argmin`` exactly.
+    """
+    part_min = jnp.min(part, axis=1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, part.shape, 1)
+    labels = jnp.min(
+        jnp.where(part <= part_min[:, None], cols, k_pad), axis=1
+    ).astype(jnp.int32)
+    return part_min, labels, cols
+
+
 def _kernel(x_ref, w_ref, ct_ref, csq_ref,
             labels_ref, mind_ref, sums_ref, counts_ref,
-            *, cd, with_update, raw_scores=False):
-    """One row tile: distances on the MXU, argmin on the VPU, accumulate."""
+            *, cd, with_update, raw_scores=False, sub_split=4):
+    """One row tile: distances on the MXU, argmin on the VPU, accumulate.
+
+    ``sub_split`` > 1 processes the tile as that many independent row
+    sub-tiles, statically unrolled in STAGED order: all sub-tile distance
+    matmuls are emitted first, then the VPU argmin/fold chains.  The math
+    per row is identical — distances/argmin/fold never mix across rows —
+    but the staging matters on TPU: the in-order core issues a matmul to
+    the (asynchronous) MXU and can then run VPU instructions while the
+    systolic array drains, so emitting sub-tile B's matmul before sub-tile
+    A's argmin lets them overlap.  Measured on a v5e at the north-star
+    shape: the interleaved order serializes MXU ~27 ms + VPU ~11 ms per
+    sweep; the staged order hides ~5 ms of the VPU time (distance-only
+    38.5 -> 33.7 ms at block_rows=1024).
+    """
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -177,42 +227,49 @@ def _kernel(x_ref, w_ref, ct_ref, csq_ref,
     w = w_ref[:][:, 0]                             # (T,) f32
     t, _ = xb.shape
     k_pad = ct_ref.shape[1]
+    ct = ct_ref[:]
+    csq = csq_ref[:]
 
-    # argmin_k ||x-c||² == argmin_k (||c||² - 2 x·c); padded columns carry
-    # csq=+inf so they can never win.
-    prod = jnp.dot(xb_c, ct_ref[:], preferred_element_type=jnp.float32,
-                   precision=matmul_precision(cd))
-    part = csq_ref[:] - 2.0 * prod                 # (1,k)+(T,k) -> (T, k_pad)
-    part_min = jnp.min(part, axis=1)               # (T,)
-    # argmin with lowest-index tie-break, spelled as an integer min over the
-    # columns that achieve the row minimum (Mosaic has no argmin lowering).
-    cols = jax.lax.broadcasted_iota(jnp.int32, part.shape, 1)
-    labels = jnp.min(
-        jnp.where(part <= part_min[:, None], cols, k_pad), axis=1
-    ).astype(jnp.int32)
-    if raw_scores:
-        # The un-normalised, un-clamped score min_k(||c||² - 2x·c): what a
-        # sharded caller needs for an exact cross-shard argmin tie-break
-        # (adding the row norm or clamping at 0 would merge near-ties that
-        # jnp.argmin on the full distance matrix still distinguishes).
-        mind = part_min
-    else:
-        mind = jnp.maximum(part_min + _row_sq(xb), 0.0)
+    assert t % sub_split == 0
+    ts = t // sub_split
+    subs = [slice(s * ts, (s + 1) * ts) for s in range(sub_split)]
+    # Stage 1: every sub-tile's distance matmul (async MXU issues).
+    prods = [
+        jnp.dot(xb_c[rows, :], ct, preferred_element_type=jnp.float32,
+                precision=matmul_precision(cd))
+        for rows in subs
+    ]
+    # Stage 2: VPU argmin + fold per sub-tile, overlapping the MXU drain.
+    for rows, prod in zip(subs, prods):
+        # argmin_k ||x-c||² == argmin_k (||c||² - 2 x·c); padded columns
+        # carry csq=+inf so they can never win.
+        part = csq - 2.0 * prod              # (1,k)+(ts,k) -> (ts, k_pad)
+        part_min, labels, cols = _argmin_rows(part, k_pad)
+        if raw_scores:
+            # The un-normalised, un-clamped score min_k(||c||² - 2x·c):
+            # what a sharded caller needs for an exact cross-shard argmin
+            # tie-break (adding the row norm or clamping at 0 would merge
+            # near-ties that jnp.argmin on the full distance matrix still
+            # distinguishes).
+            mind = part_min
+        else:
+            mind = jnp.maximum(part_min + _row_sq(xb[rows, :]), 0.0)
 
-    labels_ref[:] = labels[:, None]
-    mind_ref[:] = mind[:, None]
-    # Inertia (Σ w·min_d2) is finished outside the kernel from the mind
-    # output — a scalar VPU reduction here trips a Mosaic layout bug on
-    # 1-sublane vectors, and the XLA epilogue costs one O(n) fused read.
+        labels_ref[rows, :] = labels[:, None]
+        mind_ref[rows, :] = mind[:, None]
+        # Inertia (Σ w·min_d2) is finished outside the kernel from the mind
+        # output — a scalar VPU reduction here trips a Mosaic layout bug on
+        # 1-sublane vectors, and the XLA epilogue costs one O(n) fused read.
 
-    if with_update:
-        _fold_tile(sums_ref, counts_ref, labels, w, xb_c, cols, cd=cd)
+        if with_update:
+            _fold_tile(sums_ref, counts_ref, labels, w[rows], xb_c[rows, :],
+                       cols, cd=cd)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("block_rows", "compute_dtype", "with_update",
-                     "raw_scores", "interpret"),
+                     "raw_scores", "interpret", "sub_split"),
 )
 def lloyd_pass_pallas(
     x: jax.Array,
@@ -225,6 +282,7 @@ def lloyd_pass_pallas(
     with_update: bool = True,
     raw_scores: bool = False,
     interpret: bool = False,
+    sub_split: int = 4,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fused assign(+reduce) sweep as a single Pallas kernel.
 
@@ -282,8 +340,10 @@ def lloyd_pass_pallas(
         )
 
     grid = (n_chunks,)
+    if block_rows % sub_split or (block_rows // sub_split) % 8:
+        sub_split = 1        # sub-tiles must be whole sublane groups
     kernel = functools.partial(_kernel, cd=cd, with_update=with_update,
-                               raw_scores=raw_scores)
+                               raw_scores=raw_scores, sub_split=sub_split)
     labels, min_d2, sums, counts = pl.pallas_call(
         kernel,
         grid=grid,
@@ -322,6 +382,271 @@ def lloyd_pass_pallas(
     min_d2 = min_d2[:n, 0]
     inertia = jnp.sum(min_d2 * w[:n])
     return labels, min_d2, sums[:k, :d_in], counts[0, :k], inertia
+
+
+def _delta_kernel(x_ref, w_ref, prev_ref, ct_ref, csq_ref, tri_ref,
+                  labels_ref, mind_ref, sums_ref, counts_ref, chc_ref,
+                  *, cd, mc, sub_split, with_mind=True):
+    """Fused Lloyd sweep with an INCREMENTAL update: distances + argmin as
+    in :func:`_kernel`, then a changed-rows-only fold.
+
+    The trick is doing the sparse fold entirely on the MXU — no serial
+    row copies, which the VPU is terrible at (a (1, d) dynamic-offset
+    read-modify-write occupies one sublane of every vreg it touches):
+
+    1. ``changed = (labels != prev) & (w > 0)`` and its prefix sum give
+       each changed row a dense slot ``pos`` in [0, mc).
+    2. A 0/1 compaction matrix ``P[(j, r)] = (pos_r == j) & changed_r``
+       GATHERS the changed rows as a matmul: ``x_c = P @ x`` (exact — one
+       1 per column at most, so the f32 accumulation copies bf16 values
+       bit-for-bit), and small VPU contractions give the compacted
+       new/old labels and weights the same way.
+    3. ONE signed one-hot ``O[j, c] = w_j·([new_j = c] - [old_j = c])``
+       folds add-at-new and subtract-at-old in a single
+       (k, mc) @ (mc, d) matmul; its column sums are the count deltas.
+
+    Per tile the extra MXU work is 2·mc·(T + k_pad)·d FLOPs vs the dense
+    fold's 2·T·k_pad·d — a ~3x reduction at mc = 160, T = 1024, k = 1000.
+    A tile with more than ``mc`` changed rows sets the overflow flag and
+    contributes a DROPPED delta — the caller must discard the whole delta
+    and fall back to a full reduction (it does, via lax.cond on the flag;
+    first sweeps and high-churn sweeps land there by design).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    xb = x_ref[:]                                  # (T, d)
+    xb_c = xb.astype(cd)
+    w = w_ref[:][:, 0]                             # (T,) f32
+    prev = prev_ref[:][:, 0]                       # (T,) int32
+    t, _ = xb.shape
+    k_pad = ct_ref.shape[1]
+    ct = ct_ref[:]
+    csq = csq_ref[:]
+
+    ts = t // sub_split
+    subs = [slice(s * ts, (s + 1) * ts) for s in range(sub_split)]
+    prods = [
+        jnp.dot(xb_c[rows, :], ct, preferred_element_type=jnp.float32,
+                precision=matmul_precision(cd))
+        for rows in subs
+    ]
+    for rows, prod in zip(subs, prods):
+        part = csq - 2.0 * prod
+        part_min, labels, _ = _argmin_rows(part, k_pad)
+        labels_ref[rows, :] = labels[:, None]
+        if with_mind:
+            mind = jnp.maximum(part_min + _row_sq(xb[rows, :]), 0.0)
+        else:
+            # The steady-state fit/bench loop converges on centroid shift
+            # and never reads min_d2 — skipping the (T, d) row-norm pass
+            # saves ~3 ms/sweep at the north-star shape.
+            mind = part_min
+        mind_ref[rows, :] = mind[:, None]
+
+    # Whole-tile labels come back off the just-written output block — a
+    # 1-D concatenate of the sub-tile vectors is not tileable in Mosaic
+    # ("input offsets outside of the first tile").
+    lab = labels_ref[:][:, 0]                      # (T,) int32
+    # Zero-weight rows never contribute to sums, so they are never
+    # "changed" — this also keeps the wrapper's padding rows (w=0, prev
+    # sentinel) out of the compaction budget.
+    changed = (lab != prev) & (w > 0.0)
+    chf = changed.astype(jnp.float32)
+    # No in-kernel changed-count/overflow scalars: a scalar reduction into
+    # a (1, 1) output trips the same Mosaic 1-sublane layout bug the
+    # inertia epilogue avoids (see _kernel), and the caller derives both
+    # from the labels output in one fused XLA pass anyway.
+
+    # Dense slot per changed row = exclusive prefix count of changed rows
+    # before it.  Mosaic has no cumsum lowering, so the prefix sum runs on
+    # the MXU as a lower-triangular-ones matmul — 0/1 bf16 operands with
+    # f32 accumulation make every partial count (≤ T < 2^24) exact.
+    # The chf operand is lane-replicated to a full (t, LANE) tile — Mosaic
+    # cannot tile a (t, 1) matmul operand ("input offsets outside of the
+    # first tile"); column 0 of the product is the wanted prefix.  The
+    # lower-triangular-ones operand is a resident kernel input: building
+    # its (T, T) iota comparison on the VPU every tile costs ~4 us/tile.
+    chf_rep = jnp.broadcast_to(chf.astype(cd)[:, None], (t, _LANE))
+    pos_incl = jax.lax.dot_general(
+        tri_ref[:], chf_rep,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=matmul_precision(cd),
+    )[:, 0]                                         # (t,) inclusive prefix
+    # Rows past capacity get pos clamped to mc, which matches no slot row —
+    # their delta is silently dropped, which is exactly why overflow forces
+    # the caller's full fallback.  (tpu.iota is integer-only, so slot
+    # comparisons run in int32; every value here is an exact small int.)
+    # The inclusive prefix doubles as the changed-count report: its last
+    # element is this tile's total changed count, which the wrapper reads
+    # back for the overflow/churn epilogue — an XLA reduction over the
+    # full (n,) changed mask costs ~9 ms at the north-star shape; reading
+    # one prefix element per tile costs nothing.
+    chc_ref[:] = pos_incl[:, None]
+    pos = jnp.minimum(pos_incl - 1.0, float(mc)).astype(jnp.int32)
+    slot = jax.lax.broadcasted_iota(jnp.int32, (mc, t), 0)
+    p_mat = jnp.where((slot == pos[None, :]) & changed[None, :], 1.0, 0.0)
+    x_c = jnp.dot(p_mat.astype(cd), xb_c,
+                  preferred_element_type=jnp.float32,
+                  precision=matmul_precision(cd))   # (mc, d) exact copies
+    # Compacted per-slot metadata via the same contraction on the VPU
+    # (f32 holds any label < 2^24 exactly; bf16 would not).
+    lab_new = jnp.sum(p_mat * lab.astype(jnp.float32)[None, :],
+                      axis=1).astype(jnp.int32)
+    lab_old = jnp.sum(p_mat * prev.astype(jnp.float32)[None, :],
+                      axis=1).astype(jnp.int32)
+    w_c = jnp.sum(p_mat * w[None, :], axis=1)       # 0 for empty slots
+    cols_k = jax.lax.broadcasted_iota(jnp.int32, (mc, k_pad), 1)
+    signed = (
+        jnp.where(lab_new[:, None] == cols_k, w_c[:, None], 0.0)
+        - jnp.where(lab_old[:, None] == cols_k, w_c[:, None], 0.0)
+    )                                               # (mc, k_pad) in {0,±w}
+    counts_ref[:] += jnp.sum(signed, axis=0, keepdims=True)
+    sums_ref[:] += jax.lax.dot_general(
+        signed.astype(cd), x_c.astype(cd),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=matmul_precision(cd),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_rows", "mc", "compute_dtype", "interpret",
+                     "sub_split", "with_mind"),
+)
+def lloyd_delta_pallas(
+    x: jax.Array,
+    centroids: jax.Array,
+    labels_prev: jax.Array,
+    *,
+    weights: Optional[jax.Array] = None,
+    block_rows: int = 1024,
+    mc: int = 152,
+    compute_dtype=None,
+    interpret: bool = False,
+    sub_split: int = 4,
+    with_mind: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array,
+           jax.Array, jax.Array]:
+    """Fused incremental Lloyd sweep (see :func:`_delta_kernel`).
+
+    Returns ``(labels, min_d2, delta_sums, delta_counts, inertia,
+    n_changed, overflowed)``: ``delta_sums``/``delta_counts`` are the
+    exact signed corrections such that ``sums_prev + delta_sums``
+    reproduces the full reduction at the new labels — VALID ONLY when
+    ``overflowed == 0``; on overflow the caller must discard the delta
+    and run a full reduction.  ``labels_prev`` entries outside [0, k)
+    (e.g. the -1 first-sweep sentinel) make every row "changed", which
+    overflows immediately — the intended route to the full branch.
+
+    Same exactness caveats as :func:`lloyd_pass_pallas`; the signed fold
+    weights (±w) additionally require binary weights or f32 compute, per
+    :func:`kmeans_tpu.ops.lloyd.weights_exact`.
+
+    ``with_mind=False`` returns the raw per-row score ``min(||c||²-2x·c)``
+    (no row norm, no clamp) in the min_d2 slot and a matching raw
+    ``inertia`` — for loops that converge on centroid shift and never read
+    either, saving the (T, d) row-norm pass.
+    """
+    n, d_in = x.shape
+    k = centroids.shape[0]
+    d = padded_d(d_in)
+    if not d:
+        raise ValueError(
+            f"pallas delta pass: d={d_in} is not lane-alignable within the "
+            f"{_PAD_INFLATION_CAP}x zero-padding cap"
+        )
+    if d != d_in:
+        x, centroids = _pad_d_inputs(d, x, centroids)
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    t = block_rows
+    if t % sub_split or (t // sub_split) % 8:
+        sub_split = 1
+    n_pad = _round_up(max(n, 1), t)
+    k_pad = _round_up(k, _LANE)
+
+    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    prev = labels_prev.astype(jnp.int32)
+    if n_pad != n:
+        x = jnp.concatenate([x, jnp.zeros((n_pad - n, d), x.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((n_pad - n,), f32)])
+        prev = jnp.concatenate(
+            [prev, jnp.full((n_pad - n,), -1, jnp.int32)]
+        )
+    n_chunks = n_pad // t
+
+    c_t = centroids.astype(cd).T
+    c_sq = sq_norms(centroids)
+    if k_pad != k:
+        c_t = jnp.concatenate([c_t, jnp.zeros((d, k_pad - k), cd)], axis=1)
+        c_sq = jnp.concatenate(
+            [c_sq, jnp.full((k_pad - k,), jnp.inf, f32)]
+        )
+
+    tri = (jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+           >= jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)).astype(cd)
+    row_spec = pl.BlockSpec((t, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    kernel = functools.partial(_delta_kernel, cd=cd, mc=mc,
+                               sub_split=sub_split, with_mind=with_mind)
+    labels, min_d2, sums, counts, chcount = pl.pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            row_spec, row_spec,
+            pl.BlockSpec((d, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, t), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            row_spec, row_spec,
+            pl.BlockSpec((k_pad, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            row_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), f32),
+            jax.ShapeDtypeStruct((k_pad, d), f32),
+            jax.ShapeDtypeStruct((1, k_pad), f32),
+            jax.ShapeDtypeStruct((n_pad, 1), f32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(x, w[:, None], prev[:, None], c_t, c_sq[None, :], tri)
+
+    # Per-tile changed counts come off the kernel's own MXU prefix sum
+    # (last prefix element per tile) — deriving them in XLA from the full
+    # (n,) changed mask costs ~9 ms at the north-star shape; this strided
+    # read of n_chunks elements is free.  The overflow rule mirrors the
+    # kernel's slot clamping EXACTLY: any tile whose changed count exceeds
+    # mc dropped rows, so its delta is invalid and the caller must fall
+    # back to a full reduction.
+    per_tile = chcount[:, 0].reshape(n_chunks, t)[:, t - 1]
+    overflowed = jnp.any(per_tile > mc)
+    n_changed = jnp.sum(per_tile).astype(jnp.int32)
+
+    labels = labels[:n, 0]
+    min_d2 = min_d2[:n, 0]
+    inertia = jnp.sum(min_d2 * w[:n])
+    return (labels, min_d2, sums[:k, :d_in], counts[0, :k], inertia,
+            n_changed, overflowed)
 
 
 def _acc_kernel(x_ref, w_ref, lab_ref, g_ref,
@@ -412,22 +737,22 @@ def accumulate_pallas(
         )
     n_chunks = n_pad // t
 
+    row_spec = pl.BlockSpec((t, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
     kernel = functools.partial(_acc_kernel, cd=cd)
     sums, counts, mind = pl.pallas_call(
         kernel,
         grid=(n_chunks,),
         in_specs=[
             pl.BlockSpec((t, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            row_spec, row_spec, row_spec,
         ],
         out_specs=[
             pl.BlockSpec((k_pad, d), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k_pad), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((t, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            row_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((k_pad, d), f32),

@@ -819,6 +819,11 @@ def fit_lloyd_sharded(
     # would quantize them — demote to the exact segment reduction (the
     # shared single-device policy, ops.lloyd.weights_exact).
     update = cfg.update
+    if update == "delta":
+        # The incremental update is a single-device loop structure (carried
+        # labels/sums state); the sharded engines run the classic fused
+        # reduction — same results, psum'd per sweep.
+        update = "matmul"
     if update == "matmul" and not w_exact:
         update = "segment"
     if model_axis and feature_axis:
@@ -1107,6 +1112,11 @@ def fit_lloyd_accelerated_sharded(
     w_exact = _weights_exact(cd, weights=w_host,
                              weights_are_binary=weights_binary)
     update = cfg.update
+    if update == "delta":
+        # The incremental update is a single-device loop structure (carried
+        # labels/sums state); the sharded engines run the classic fused
+        # reduction — same results, psum'd per sweep.
+        update = "matmul"
     if update == "matmul" and not w_exact:
         update = "segment"
     backend = resolve_backend(

@@ -501,3 +501,52 @@ def test_state_counts_registry(rng):
     kk = fit_kernel_kmeans(x, 3, key=jax.random.key(0), max_iter=5)
     # kernel has counts (per-cluster masses) — present, not None.
     assert state_counts(kk) is not None
+
+
+# ---------------------------------------------------------------------------
+# update="delta" fit path (round 4): identical trajectory to the classic
+# dense update, composed with both empty-cluster policies.
+
+@pytest.mark.parametrize("empty", ["keep", "farthest"])
+def test_fit_lloyd_delta_matches_matmul(rng, empty):
+    from kmeans_tpu.config import KMeansConfig
+
+    x = jnp.asarray(rng.normal(size=(3000, 16)).astype(np.float32))
+    kw = dict(k=12, max_iter=60, backend="xla", empty=empty)
+    sm = fit_lloyd(x, 12, key=jax.random.key(5),
+                   config=KMeansConfig(update="matmul", **kw))
+    sd = fit_lloyd(x, 12, key=jax.random.key(5),
+                   config=KMeansConfig(update="delta", **kw))
+    assert int(sm.n_iter) == int(sd.n_iter)
+    assert bool(sm.converged) == bool(sd.converged)
+    assert (np.asarray(sm.labels) == np.asarray(sd.labels)).all()
+    np.testing.assert_allclose(np.asarray(sm.centroids),
+                               np.asarray(sd.centroids), atol=1e-4)
+    np.testing.assert_allclose(float(sm.inertia), float(sd.inertia),
+                               rtol=1e-6)
+
+
+def test_kmeans_estimator_update_delta(rng):
+    x = jnp.asarray(rng.normal(size=(2000, 8)).astype(np.float32))
+    km = KMeans(n_clusters=6, seed=3, update="delta", backend="xla").fit(x)
+    ref = KMeans(n_clusters=6, seed=3, update="matmul", backend="xla").fit(x)
+    assert km.n_iter_ == ref.n_iter_
+    np.testing.assert_allclose(km.inertia_, ref.inertia_, rtol=1e-6)
+
+
+def test_update_delta_config_safe_across_models(rng):
+    # Models that forward cfg.update verbatim into lloyd_pass (spherical
+    # and trimmed here) must accept a delta-configured KMeansConfig —
+    # lloyd_pass maps it to the dense reduction (delta is a fit_lloyd
+    # loop structure, not a sweep flavor).
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models.spherical import fit_spherical
+    from kmeans_tpu.models.trimmed import fit_trimmed
+
+    x = jnp.asarray(rng.normal(size=(500, 16)).astype(np.float32))
+    cfg = KMeansConfig(k=4, max_iter=20, update="delta", backend="xla")
+    st = fit_spherical(x, 4, key=jax.random.key(0), config=cfg)
+    assert st.centroids.shape == (4, 16)
+    st2 = fit_trimmed(x, 4, key=jax.random.key(0), trim_fraction=0.1,
+                      config=cfg)
+    assert st2.centroids.shape == (4, 16)

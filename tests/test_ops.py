@@ -123,3 +123,68 @@ def test_bf16_compute_dtype_runs_and_is_close(rng):
     # bf16 rounding may flip a few boundary points; most must agree.
     agree = np.mean(np.asarray(labels32) == np.asarray(labels16))
     assert agree > 0.9
+
+
+# ---------------------------------------------------------------------------
+# delta_pass (kmeans_tpu.ops.delta): the incremental-update sweep, XLA
+# (gather) route — the Pallas fused route is covered by test_pallas.py and
+# the on-chip bench (round 4, VERDICT r3 item 3).
+
+class TestDeltaPass:
+    def _trajectories(self, rng, n=4000, d=32, k=24, iters=6, weights=None,
+                      chunk=512):
+        from kmeans_tpu.ops.delta import default_cap, delta_pass
+        from kmeans_tpu.ops.lloyd import lloyd_pass
+        from kmeans_tpu.ops.update import apply_update
+
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        c0 = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+
+        c_ref = c0
+        ref = []
+        for _ in range(iters):
+            lab, _, sums, counts, _ = lloyd_pass(
+                x, c_ref, weights=weights, chunk_size=chunk)
+            c_ref = apply_update(c_ref, sums, counts)
+            ref.append(np.asarray(lab))
+
+        c_d = c0
+        lab_p = jnp.full((n,), -1, jnp.int32)
+        sums = jnp.zeros((k, d), jnp.float32)
+        counts = jnp.zeros((k,), jnp.float32)
+        ms = []
+        for i in range(iters):
+            lab_p, _, sums, counts, _, m = delta_pass(
+                x, c_d, lab_p, sums, counts, weights=weights,
+                cap=default_cap(n), chunk_size=chunk, backend="xla")
+            assert (np.asarray(lab_p) == ref[i]).all(), f"diverged at {i}"
+            ms.append(int(m))
+            c_d = apply_update(c_d, sums, counts)
+        return np.asarray(c_ref), np.asarray(c_d), ms
+
+    def test_matches_classic_trajectory(self, rng):
+        c_ref, c_d, ms = self._trajectories(rng)
+        np.testing.assert_allclose(c_d, c_ref, atol=1e-4)
+        assert ms[0] == 4000          # sentinel: everything changed
+        assert ms[-1] < ms[1]         # churn decays -> incremental branch
+
+    def test_matches_with_weights(self, rng):
+        w = jnp.asarray((rng.random(4000) > 0.25).astype(np.float32))
+        c_ref, c_d, _ = self._trajectories(rng, weights=w)
+        np.testing.assert_allclose(c_d, c_ref, atol=1e-4)
+
+    def test_force_full_refresh(self, rng):
+        from kmeans_tpu.ops.delta import delta_pass
+        from kmeans_tpu.ops.lloyd import lloyd_pass
+
+        n, d, k = 1000, 16, 8
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+        lab, _, sums, counts, _ = lloyd_pass(x, c, chunk_size=256)
+        # Poisoned carried sums: a forced refresh must discard them.
+        bad = sums + 100.0
+        _, _, s2, c2, _, _ = delta_pass(
+            x, c, lab, bad, counts, cap=n // 8, chunk_size=256,
+            backend="xla", force_full=jnp.asarray(True))
+        np.testing.assert_allclose(np.asarray(s2), np.asarray(sums),
+                                   atol=1e-4)

@@ -133,3 +133,115 @@ def test_lloyd_pass_pads_unaligned_d_exactly(rng):
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(counts), np.asarray(want[3]),
                                rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Incremental (delta) sweep kernel — kmeans_tpu.ops.pallas_lloyd.
+# lloyd_delta_pallas (round 4, VERDICT r3 item 3).  Interpreter mode here;
+# the compiled path is driven on-chip by bench.py (update="delta" is its
+# headline default).
+
+def _np_sums(x, lab, k, w=None):
+    n, d = x.shape
+    s = np.zeros((k, d), np.float32)
+    c = np.zeros((k,), np.float32)
+    wn = np.ones(n, np.float32) if w is None else np.asarray(w)
+    for i in range(n):
+        if 0 <= lab[i] < k:
+            s[lab[i]] += wn[i] * np.asarray(x)[i]
+            c[lab[i]] += wn[i]
+    return s, c
+
+
+def test_delta_kernel_matches_oracle(rng):
+    from kmeans_tpu.ops.pallas_lloyd import lloyd_delta_pallas
+
+    n, d, k = 3000, 256, 50
+    x, c = _pair(rng, n, d, k)
+    lab_ref, mind_ref, *_ = lloyd_pass_pallas(x, c, interpret=True)
+    lab_ref = np.asarray(lab_ref)
+    prev = lab_ref.copy()
+    pert = rng.random(n) < 0.05
+    prev[pert] = rng.integers(0, k, pert.sum())
+
+    lab, mind, ds, dc, inertia, m, over = lloyd_delta_pallas(
+        x, c, jnp.asarray(prev.astype(np.int32)), block_rows=512, mc=64,
+        interpret=True)
+    assert (np.asarray(lab) == lab_ref).all()
+    assert int(m) == int((prev != lab_ref).sum())
+    assert not bool(over)
+    s_new, c_new = _np_sums(x, lab_ref, k)
+    s_old, c_old = _np_sums(x, prev, k)
+    np.testing.assert_allclose(np.asarray(ds), s_new - s_old, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dc), c_new - c_old, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(mind), np.asarray(mind_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_delta_kernel_overflow_and_sentinel(rng):
+    from kmeans_tpu.ops.pallas_lloyd import lloyd_delta_pallas
+
+    n, d, k = 2000, 128, 30
+    x, c = _pair(rng, n, d, k)
+    lab_ref = np.asarray(lloyd_pass_pallas(x, c, interpret=True)[0])
+
+    # First sweep: -1 sentinel makes every row changed -> overflow, labels
+    # still exact (the assignment half never depends on the fold).
+    lab, _, _, _, _, m, over = lloyd_delta_pallas(
+        x, c, jnp.full((n,), -1, jnp.int32), block_rows=512, mc=64,
+        interpret=True)
+    assert bool(over) and int(m) == n
+    assert (np.asarray(lab) == lab_ref).all()
+
+    # A tile with more changes than mc overflows even when the global
+    # count is small: perturb 70 rows inside one 512-row tile.
+    prev = lab_ref.copy()
+    prev[100:170] = (prev[100:170] + 1) % k
+    _, _, _, _, _, m2, over2 = lloyd_delta_pallas(
+        x, c, jnp.asarray(prev.astype(np.int32)), block_rows=512, mc=64,
+        interpret=True)
+    assert int(m2) >= 70 and bool(over2)
+
+
+def test_delta_kernel_weights_and_mind_flag(rng):
+    from kmeans_tpu.ops.pallas_lloyd import lloyd_delta_pallas
+
+    n, d, k = 1500, 128, 20
+    x, c = _pair(rng, n, d, k)
+    lab_ref = np.asarray(lloyd_pass_pallas(x, c, interpret=True)[0])
+    prev = lab_ref.copy()
+    pert = rng.random(n) < 0.04
+    prev[pert] = rng.integers(0, k, pert.sum())
+    w = jnp.asarray((rng.random(n) > 0.3).astype(np.float32))
+
+    lab, mind_raw, ds, dc, _, m, over = lloyd_delta_pallas(
+        x, c, jnp.asarray(prev.astype(np.int32)), weights=w,
+        block_rows=512, mc=128, with_mind=False, interpret=True)
+    # Zero-weight rows are never "changed" (they contribute nothing).
+    wn = np.asarray(w)
+    assert int(m) == int(((prev != lab_ref) & (wn > 0)).sum())
+    s_new, c_new = _np_sums(x, np.asarray(lab), k, w)
+    s_old, c_old = _np_sums(x, prev, k, w)
+    np.testing.assert_allclose(np.asarray(ds), s_new - s_old, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dc), c_new - c_old, atol=1e-3)
+    # with_mind=False returns the raw (no row norm, unclamped) score.
+    _, mind_full, *_ = lloyd_delta_pallas(
+        x, c, jnp.asarray(prev.astype(np.int32)), weights=w,
+        block_rows=512, mc=128, with_mind=True, interpret=True)
+    xsq = np.sum(np.asarray(x).astype(np.float32) ** 2, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(mind_full),
+        np.maximum(np.asarray(mind_raw) + xsq, 0.0), rtol=1e-5, atol=1e-4)
+
+
+def test_kernel_sub_split_invariance(rng):
+    # Staged sub-tiling is a pure scheduling change: every sub_split must
+    # produce bit-identical labels and near-identical reductions.
+    n, d, k = 1030, 128, 17
+    x, c = _pair(rng, n, d, k)
+    base = lloyd_pass_pallas(x, c, interpret=True, sub_split=1)
+    for ss in (2, 4):
+        got = lloyd_pass_pallas(x, c, interpret=True, sub_split=ss)
+        assert (np.asarray(got[0]) == np.asarray(base[0])).all()
+        np.testing.assert_allclose(np.asarray(got[2]), np.asarray(base[2]),
+                                   rtol=1e-6, atol=1e-5)

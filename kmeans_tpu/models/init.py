@@ -25,7 +25,24 @@ from jax import lax
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
 __all__ = ["random_init", "kmeans_plus_plus", "kmeans_parallel",
-           "init_centroids", "resolve_fit_inputs", "host_subsample_seed"]
+           "init_centroids", "resolve_fit_inputs", "host_subsample_seed",
+           "row_gumbel"]
+
+
+def row_gumbel(key: jax.Array, idx: jax.Array) -> jax.Array:
+    """Per-row Gumbel noise keyed by GLOBAL row index.
+
+    ``g[i]`` depends only on ``(key, idx[i])`` — not on the shape or
+    sharding of the batch it is drawn inside — so a data-sharded caller
+    that passes its global row offsets draws EXACTLY the noise the
+    single-device caller draws for the same rows.  This is what makes the
+    sharded k-means|| (kmeans_tpu.parallel.init_sharded) sample
+    identically to :func:`kmeans_parallel` on any mesh shape.
+    """
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, idx)
+    return jax.vmap(
+        lambda kk: jax.random.gumbel(kk, (), dtype=jnp.float32)
+    )(keys)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -110,7 +127,9 @@ def _kmpar_round(key, x, d2, logw, *, ell, chunk_size, compute_dtype):
     work, unlike k-means++'s k sequential matvec-scale rounds."""
     from kmeans_tpu.ops.distance import assign
 
-    g = jax.random.gumbel(key, d2.shape, dtype=jnp.float32)
+    # Row-keyed noise (not one (n,)-shaped draw): see row_gumbel — the
+    # sharded init must reproduce these draws shard-locally.
+    g = row_gumbel(key, jnp.arange(d2.shape[0]))
     # log(w·D²) = logw + log(D²); chosen points have D²=0 → -inf → excluded.
     score = logw + jnp.log(d2) + g
     top, idx = lax.top_k(score, ell)
@@ -126,6 +145,37 @@ def _kmpar_round(key, x, d2, logw, *, ell, chunk_size, compute_dtype):
     lab, mind = assign(x, cand, chunk_size=chunk_size,
                        compute_dtype=compute_dtype)
     return cand, lab, mind, valid
+
+
+def _kmpar_plan(n: int, k: int, rounds: int, oversampling):
+    """(ell, m, use_fallback): the k-means|| sampling plan — THE one copy
+    shared by the single-device and shard_map implementations, whose
+    draw-parity guarantee requires identical ell/m/fallback decisions."""
+    ell = int(oversampling) if oversampling is not None else min(k, n)
+    m = 1 + rounds * ell
+    if not (2 * m >= n) and m < k:
+        raise ValueError(
+            f"candidate pool 1 + rounds*oversampling = {m} < k = {k}; "
+            f"raise rounds/oversampling"
+        )
+    return ell, m, 2 * m >= n
+
+
+def _kmpar_refine(key, candidates, cand_w, k, *, refine_iters, chunk_size,
+                  compute_dtype):
+    """Recluster the weighted candidate pool down to k — shared by both
+    k-means|| implementations (same config, same 0xC11 key fold)."""
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models.lloyd import fit_lloyd  # cycle-free at call time
+
+    m = candidates.shape[0]
+    refine_cfg = KMeansConfig(
+        k=k, init="k-means++", max_iter=refine_iters, empty="farthest",
+        chunk_size=min(chunk_size, m), compute_dtype=compute_dtype,
+    )
+    state = fit_lloyd(candidates, k, key=jax.random.fold_in(key, 0xC11),
+                      config=refine_cfg, weights=cand_w)
+    return state.centroids
 
 
 def kmeans_parallel(
@@ -168,23 +218,15 @@ def kmeans_parallel(
     # with ~35% less seeding wall-clock — the refine step redistributes a
     # 1+4k candidate pool just as well, and each sampling round's (n, ℓ)
     # distance sweep halves.
-    ell = int(oversampling) if oversampling is not None else min(k, n)
-    m = 1 + rounds * ell
-    if 2 * m >= n:
+    ell, m, fallback = _kmpar_plan(n, k, rounds, oversampling)
+    if fallback:
         # Oversampling buys nothing when the candidate pool reaches a large
         # fraction of the data — the rounds would sweep nearly every point
         # anyway.  Exact k-means++ is both cheaper and higher-quality there.
         return kmeans_plus_plus(
             key, x, k, weights=weights, compute_dtype=compute_dtype
         )
-    if m < k:
-        raise ValueError(
-            f"candidate pool 1 + rounds*oversampling = {m} < k = {k}; "
-            f"raise rounds/oversampling"
-        )
 
-    from kmeans_tpu.config import KMeansConfig
-    from kmeans_tpu.models.lloyd import fit_lloyd  # cycle-free at call time
     from kmeans_tpu.ops.distance import assign
 
     f32 = jnp.float32
@@ -192,7 +234,7 @@ def kmeans_parallel(
     logw = jnp.log(w)
 
     key0, key_r = jax.random.split(key)
-    g0 = jax.random.gumbel(key0, (n,), dtype=f32)
+    g0 = row_gumbel(key0, jnp.arange(n))
     first = jnp.argmax(logw + g0)
     c0 = x[first].astype(f32)[None]
     _, d2 = assign(x, c0, chunk_size=chunk_size, compute_dtype=compute_dtype)
@@ -225,13 +267,9 @@ def kmeans_parallel(
     cand_w = jnp.where(
         cand_valid, jax.ops.segment_sum(w, labels, num_segments=m), 0.0
     )
-    refine_cfg = KMeansConfig(
-        k=k, init="k-means++", max_iter=refine_iters, empty="farthest",
-        chunk_size=min(chunk_size, m), compute_dtype=compute_dtype,
-    )
-    state = fit_lloyd(candidates, k, key=jax.random.fold_in(key, 0xC11),
-                      config=refine_cfg, weights=cand_w)
-    return state.centroids
+    return _kmpar_refine(key, candidates, cand_w, k,
+                         refine_iters=refine_iters, chunk_size=chunk_size,
+                         compute_dtype=compute_dtype)
 
 
 def init_centroids(

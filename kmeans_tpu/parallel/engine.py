@@ -49,6 +49,29 @@ from kmeans_tpu.ops.pallas_lloyd import (
 )
 from kmeans_tpu.ops.update import apply_update
 
+
+def _init_centroids_on_mesh(key, x, k, *, mesh, data_axis, method, w, cfg):
+    """Init router for sharded fits: k-means|| goes through the explicit
+    shard_map implementation (kmeans_tpu.parallel.init_sharded) whenever
+    the rows are purely data-sharded — the GSPMD lowering of the
+    single-device code materializes ~6 full-row all-gathers (measured on
+    the 8-device CPU mesh; VERDICT.md r3 item 4).  Everything else (++/
+    random, feature-sharded x) keeps the auto-sharded init_centroids
+    route."""
+    if method == "k-means||":
+        from kmeans_tpu.parallel.init_sharded import (
+            kmeans_parallel_sharded, sharded_init_applicable)
+
+        if sharded_init_applicable(x, k, mesh=mesh, data_axis=data_axis):
+            return kmeans_parallel_sharded(
+                key, x, k, mesh=mesh, data_axis=data_axis, weights=w,
+                compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+            )
+    return init_centroids(
+        key, x, k, method=method, weights=w,
+        compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+    )
+
 __all__ = [
     "fit_fuzzy_sharded",
     "fit_gmm_sharded",
@@ -783,9 +806,9 @@ def fit_lloyd_sharded(
             )
     else:
         method = init if isinstance(init, str) else cfg.init
-        c0 = init_centroids(
-            key, x, k, method=method, weights=w,
-            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        c0 = _init_centroids_on_mesh(
+            key, x, k, mesh=mesh, data_axis=data_axis, method=method, w=w,
+            cfg=cfg,
         )
 
     if center_update == "sphere":
@@ -1101,9 +1124,9 @@ def fit_lloyd_accelerated_sharded(
             )
     else:
         method = init if isinstance(init, str) else cfg.init
-        c0 = init_centroids(
-            key, x, k, method=method, weights=w,
-            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        c0 = _init_centroids_on_mesh(
+            key, x, k, mesh=mesh, data_axis=data_axis, method=method, w=w,
+            cfg=cfg,
         )
     c0 = jax.device_put(c0, NamedSharding(mesh, P()))
 
@@ -1402,9 +1425,9 @@ def fit_trimmed_sharded(
                              f"{(k, x.shape[1])}")
     else:
         method = init if isinstance(init, str) else cfg.init
-        c0 = init_centroids(
-            key, x, k, method=method, weights=w,
-            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        c0 = _init_centroids_on_mesh(
+            key, x, k, mesh=mesh, data_axis=data_axis, method=method, w=w,
+            cfg=cfg,
         )
     c0 = jax.device_put(c0, NamedSharding(mesh, P()))
 
@@ -1619,9 +1642,9 @@ def fit_balanced_sharded(
                              f"{(k, x.shape[1])}")
     else:
         method = init if isinstance(init, str) else cfg.init
-        c0 = init_centroids(
-            key, x, k, method=method, weights=w,
-            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        c0 = _init_centroids_on_mesh(
+            key, x, k, mesh=mesh, data_axis=data_axis, method=method, w=w,
+            cfg=cfg,
         )
     c0 = jax.device_put(c0, NamedSharding(mesh, P()))
 
@@ -1693,9 +1716,9 @@ def fit_fuzzy_sharded(
                              f"{(k, x.shape[1])}")
     else:
         method = init if isinstance(init, str) else cfg.init
-        c0 = init_centroids(
-            key, x, k, method=method, weights=w,
-            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        c0 = _init_centroids_on_mesh(
+            key, x, k, mesh=mesh, data_axis=data_axis, method=method, w=w,
+            cfg=cfg,
         )
     c0 = jax.device_put(c0, NamedSharding(mesh, P()))
 
@@ -1862,9 +1885,9 @@ def fit_gmm_sharded(
                              f"{(k, x.shape[1])}")
     else:
         method = init if isinstance(init, str) else cfg.init
-        c0 = init_centroids(
-            key, x, k, method=method, weights=w,
-            compute_dtype=cfg.compute_dtype, chunk_size=cfg.chunk_size,
+        c0 = _init_centroids_on_mesh(
+            key, x, k, mesh=mesh, data_axis=data_axis, method=method, w=w,
+            cfg=cfg,
         )
 
     # Global weighted feature moments on the sharded array (auto-sharded

@@ -1,0 +1,60 @@
+"""Subprocess worker for the streamed-fit kill -9 drill (VERDICT r3 item 6).
+
+Runs a streamed fit (minibatch or GMM) on its OWN 8-device virtual CPU mesh
+with periodic checkpoints; the parent test SIGKILLs this process once the
+first checkpoint lands — no flush, no atexit — then resumes from the
+checkpoint and asserts the final state matches an uninterrupted run.
+
+Usage: python stream_worker.py <family> <data.npy> <ckpt.npz> <k> <steps>
+       <batch> <seed>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    family, data_path, ckpt, k, steps, batch, seed = sys.argv[1:8]
+    k, steps, batch, seed = int(k), int(steps), int(batch), int(seed)
+
+    from jax.sharding import Mesh
+
+    from kmeans_tpu.data.stream import load_mmap
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]).reshape(8, 1),
+                ("data", "model"))
+    data = load_mmap(data_path)
+
+    if family == "minibatch":
+        from kmeans_tpu.models import fit_minibatch_stream
+
+        fit_minibatch_stream(
+            data, k, batch_size=batch, steps=steps, seed=seed,
+            checkpoint_path=ckpt, checkpoint_every=5, mesh=mesh,
+            final_pass=False,
+        )
+    elif family == "gmm":
+        from kmeans_tpu.models import fit_gmm_stream
+
+        fit_gmm_stream(
+            data, k, batch_size=batch, steps=steps, seed=seed,
+            checkpoint_path=ckpt, checkpoint_every=5, mesh=mesh,
+            final_pass=False,
+        )
+    else:
+        raise SystemExit(f"unknown family {family!r}")
+    print("WORKER_FINISHED", flush=True)
+
+
+if __name__ == "__main__":
+    main()

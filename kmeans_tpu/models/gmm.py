@@ -18,9 +18,17 @@ is TWO matmuls per tile — ``x @ lin.T`` and ``x^2 @ inv_var.T`` — so the
 whole E-step rides the MXU exactly like the Lloyd distance pass, and the
 M-step reductions (``r^T 1``, ``r^T x``, ``r^T x^2``) are the same
 transpose-matmul shape as the Lloyd centroid update.  Nothing beyond a
-(chunk, k) tile ever materializes.  Full covariance is deliberately not
-offered: (k, d, d) at the eval scales (k=1000, d=2048) is 16 TB — diag and
-spherical are the TPU-honest variants.
+(chunk, k) tile ever materializes.
+
+``covariance_type="tied"`` shares ONE (d, d) covariance across components
+(sklearn's tied): the E-step whitens each tile with the Cholesky inverse
+(``x @ L^-T`` — a (chunk, d) @ (d, d) MXU matmul) and the M-step exploits
+that the global scatter ``G = sum_i w_i x_i x_i^T`` is CONSTANT across EM
+iterations — computed once per fit, after which every iteration's tied
+update is just ``(G - mu^T diag(N) mu) / N_tot``, no per-iteration (d, d)
+data reduction at all.  Full per-component covariance is deliberately not
+offered: (k, d, d) at the eval scales (k=1000, d=2048) is 16 TB — diag,
+spherical and tied ((d, d) = 16 MB) are the TPU-honest variants.
 
 Update rules (responsibilities r_ij, sample weights w_i):
 
@@ -60,13 +68,13 @@ class GMMParams(NamedTuple):
     """The EM parameter pytree (carried through ``lax.while_loop``)."""
 
     means: jax.Array        # (k, d) float32
-    variances: jax.Array    # (k, d) float32 (spherical: constant per row)
+    variances: jax.Array    # (k, d) float32 diag/spherical; (d, d) tied
     log_pi: jax.Array       # (k,) float32 — log mixing proportions
 
 
 class GMMState(NamedTuple):
     means: jax.Array           # (k, d) float32
-    covariances: jax.Array     # (k, d) float32 diagonal covariances
+    covariances: jax.Array     # (k, d) diag/spherical; (d, d) shared tied
     mix_weights: jax.Array     # (k,) float32 — mixing proportions pi
     labels: jax.Array          # (n,) int32 — argmax responsibility
     log_likelihood: jax.Array  # scalar float32 — total weighted log p(x)
@@ -75,8 +83,28 @@ class GMMState(NamedTuple):
     resp_counts: jax.Array     # (k,) float32 — soft counts N_j
 
 
-def _logp_terms(params: GMMParams):
-    """Per-component constants + matmul operands for the tile log-density."""
+def _logp_terms(params: GMMParams, covariance_type: str = "diag"):
+    """Per-component constants + matmul operands for the tile log-density.
+
+    Diag/spherical: ``(quad_t, lin_t, const)`` with quad_t the (d, k)
+    transposed inverse variances.  Tied: quad_t is instead the (d, d)
+    whitener ``L^-T`` (Cholesky of the shared covariance), so the tile's
+    quadratic term is a row norm after one (chunk, d) @ (d, d) matmul.
+    """
+    f32 = jnp.float32
+    if covariance_type == "tied":
+        sigma = params.variances                           # (d, d)
+        d = sigma.shape[0]
+        chol = jnp.linalg.cholesky(sigma)
+        l_inv = jax.scipy.linalg.solve_triangular(
+            chol, jnp.eye(d, dtype=f32), lower=True)       # L^-1
+        lin = jax.scipy.linalg.cho_solve(
+            (chol, True), params.means.T).T                # (k, d) Σ^-1 μ
+        logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)))
+        const = params.log_pi - 0.5 * (
+            d * _LOG_2PI + logdet + jnp.sum(params.means * lin, axis=1)
+        )
+        return l_inv, lin, const          # caller transposes -> L^-T
     inv_var = 1.0 / params.variances                       # (k, d)
     lin = params.means * inv_var                           # (k, d)
     const = params.log_pi - 0.5 * (
@@ -87,24 +115,34 @@ def _logp_terms(params: GMMParams):
     return inv_var, lin, const
 
 
-def _logp_tile(xb, inv_var_t, lin_t, const, cd):
+def _logp_tile(xb, quad_t, lin_t, const, cd, covariance_type="diag"):
     """(chunk, k) component log-densities for one row tile — THE one copy
-    of the E-step matmul pair, shared by the training scan, predict, and
+    of the E-step matmuls, shared by the training scan, predict, and
     log_resp so they can't drift.  Also returns the f32 ``xb²`` the
-    M-step moment matmul reuses."""
+    diag M-step moment matmul reuses.
+
+    Diag/spherical quadratic term: ``x² @ inv_varᵀ`` (a k-matmul).  Tied:
+    the per-row whitened norm ``‖x @ L^-T‖²`` (a d-matmul), identical for
+    every component so it enters as a column broadcast."""
     f32 = jnp.float32
     xb_f = xb.astype(f32)
     xb_sq = xb_f * xb_f
-    quad = jnp.matmul(xb_sq.astype(cd), inv_var_t,
-                      preferred_element_type=f32,
-                      precision=matmul_precision(cd))
+    if covariance_type == "tied":
+        z = jnp.matmul(xb.astype(cd), quad_t.astype(cd),
+                       preferred_element_type=f32,
+                       precision=matmul_precision(cd))     # (chunk, d)
+        quad = jnp.sum(z * z, axis=1)[:, None]             # (chunk, 1)
+    else:
+        quad = jnp.matmul(xb_sq.astype(cd), quad_t,
+                          preferred_element_type=f32,
+                          precision=matmul_precision(cd))
     cross = jnp.matmul(xb.astype(cd), lin_t, preferred_element_type=f32,
                        precision=matmul_precision(cd))
     return const[None, :] + cross - 0.5 * quad, xb_sq
 
 
 def gmm_scan_tiles(xs, ws, params: GMMParams, *, compute_dtype, with_labels,
-                   with_moments=True):
+                   with_moments=True, covariance_type="diag"):
     """The EM tile scan — log-density tile, responsibilities, weighted soft
     reductions — WITHOUT the M-step: returns local
     ``(N (k,), S (k,d), Q (k,d), ll scalar, labels-per-tile)``.  THE one
@@ -120,14 +158,15 @@ def gmm_scan_tiles(xs, ws, params: GMMParams, *, compute_dtype, with_labels,
     cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
           else xs.dtype)
     k, d = params.means.shape
-    inv_var, lin, const = _logp_terms(params)
-    inv_var_t = inv_var.astype(cd).T                       # (d, k)
+    quad, lin, const = _logp_terms(params, covariance_type)
+    quad_t = quad.astype(cd).T                  # (d, k) — or (d, d) tied
     lin_t = lin.astype(cd).T                               # (d, k)
 
     def body(carry, tile):
         N, S, Q, ll = carry
         xb, wb = tile
-        logp, xb_sq = _logp_tile(xb, inv_var_t, lin_t, const, cd)
+        logp, xb_sq = _logp_tile(xb, quad_t, lin_t, const, cd,
+                                 covariance_type)
         row_ll = jax.nn.logsumexp(logp, axis=1)            # (chunk,)
         r = jnp.exp(logp - row_ll[:, None]) * wb[:, None]  # weighted resp
         ll = ll + jnp.sum(wb * row_ll)
@@ -137,9 +176,14 @@ def gmm_scan_tiles(xs, ws, params: GMMParams, *, compute_dtype, with_labels,
             S = S + jnp.matmul(r_c.T, xb.astype(cd),
                                preferred_element_type=f32,
                                precision=matmul_precision(cd))
-            Q = Q + jnp.matmul(r_c.T, xb_sq.astype(cd),
-                               preferred_element_type=f32,
-                               precision=matmul_precision(cd))
+            if covariance_type != "tied":
+                # The tied M-step needs no per-component second moment —
+                # its (d, d) update comes from the once-per-fit global
+                # scatter, so the Q matmul (half the M-step moment cost)
+                # is skipped.
+                Q = Q + jnp.matmul(r_c.T, xb_sq.astype(cd),
+                                   preferred_element_type=f32,
+                                   precision=matmul_precision(cd))
         lab = (jnp.argmax(logp, axis=1).astype(jnp.int32)
                if with_labels else 0)
         return (N, S, Q, ll), lab
@@ -151,17 +195,31 @@ def gmm_scan_tiles(xs, ws, params: GMMParams, *, compute_dtype, with_labels,
 
 
 def gmm_m_step(params: GMMParams, N, S, Q, *, covariance_type,
-               reg_covar) -> GMMParams:
+               reg_covar, scatter=None) -> GMMParams:
     """Closed-form M-step from the psummed soft moments.
 
     Components with (near-)zero soft mass keep their previous mean/variance
     and get mixing weight N_j / sum N — they stay where they were and simply
     stop attracting mass (the analog of Lloyd's ``empty='keep'``).
+
+    ``covariance_type="tied"`` requires ``scatter`` — the once-per-fit
+    global second moment ``G = Σ_i w_i x_i x_iᵀ`` (d, d); the shared
+    covariance is then ``(G - μᵀ diag(N) μ) / Σ_j N_j + reg·I`` (exact
+    because responsibilities sum to the row weight over components).
     """
     f32 = jnp.float32
     alive = N > 1e-12
     denom = jnp.where(alive, N, 1.0)
     means = jnp.where(alive[:, None], S / denom[:, None], params.means)
+    if covariance_type == "tied":
+        if scatter is None:
+            raise ValueError("tied M-step requires the global scatter")
+        d = means.shape[1]
+        sigma = (scatter - means.T @ (means * N[:, None])) / jnp.sum(N)
+        sigma = 0.5 * (sigma + sigma.T) + reg_covar * jnp.eye(d, dtype=f32)
+        pi = N / jnp.sum(N)
+        log_pi = jnp.log(jnp.maximum(pi, 1e-37)).astype(f32)
+        return GMMParams(means.astype(f32), sigma.astype(f32), log_pi)
     var = Q / denom[:, None] - means * means
     if covariance_type == "spherical":
         var = jnp.mean(var, axis=1, keepdims=True) * jnp.ones_like(var)
@@ -170,6 +228,25 @@ def gmm_m_step(params: GMMParams, N, S, Q, *, covariance_type,
     pi = N / jnp.sum(N)
     log_pi = jnp.log(jnp.maximum(pi, 1e-37)).astype(f32)
     return GMMParams(means.astype(f32), var.astype(f32), log_pi)
+
+
+def _global_scatter(xs, ws):
+    """``G = Σ_i w_i x_i x_iᵀ`` (d, d) — the tied M-step's only data
+    moment, constant across EM iterations, so it is computed exactly once
+    per fit.  f32 operands: the scatter feeds a Cholesky, where bf16
+    rounding would cost far more than this one O(n·d²) pass saves."""
+    f32 = jnp.float32
+    d = xs.shape[-1]
+
+    def body(g, tile):
+        xb, wb = tile
+        xb_f = xb.astype(f32)
+        g = g + jnp.matmul((xb_f * wb[:, None]).T, xb_f,
+                           preferred_element_type=f32)
+        return g, 0
+
+    g, _ = lax.scan(body, jnp.zeros((d, d), f32), (xs, ws))
+    return 0.5 * (g + g.T)
 
 
 def _weighted_feature_moments(xs, ws):
@@ -210,9 +287,13 @@ def init_gmm_params(c0, xs, ws, *, covariance_type, reg_covar) -> GMMParams:
     if covariance_type == "spherical":
         var = jnp.mean(var) * jnp.ones_like(var)
     var = jnp.maximum(var, 0.0) + reg_covar
+    if covariance_type == "tied":
+        cov0 = jnp.diag(var).astype(f32)       # (d, d) shared start
+    else:
+        cov0 = jnp.broadcast_to(var, c0.shape).astype(f32)
     return GMMParams(
         c0.astype(f32),
-        jnp.broadcast_to(var, c0.shape).astype(f32),
+        cov0,
         jnp.full((k,), -math.log(k), f32),
     )
 
@@ -232,14 +313,18 @@ def _gmm_loop(x, c0, weights, tol, reg_covar, *, max_iter, chunk_size,
     params0 = init_gmm_params(
         c0, xs, ws, covariance_type=covariance_type, reg_covar=reg_covar
     )
+    scatter = (
+        _global_scatter(xs, ws) if covariance_type == "tied" else None
+    )
 
     def pass_once(params, with_labels):
         N, S, Q, ll, labs = gmm_scan_tiles(
-            xs, ws, params, compute_dtype=cd, with_labels=with_labels
+            xs, ws, params, compute_dtype=cd, with_labels=with_labels,
+            covariance_type=covariance_type,
         )
         new_params = gmm_m_step(
             params, N, S, Q, covariance_type=covariance_type,
-            reg_covar=reg_covar,
+            reg_covar=reg_covar, scatter=scatter,
         )
         return new_params, N, ll, labs
 
@@ -262,7 +347,7 @@ def _gmm_loop(x, c0, weights, tol, reg_covar, *, max_iter, chunk_size,
     # Final labeling pass: no M-step follows, so skip the moment matmuls.
     N, _, _, ll, labs = gmm_scan_tiles(
         xs, ws, params, compute_dtype=cd, with_labels=True,
-        with_moments=False,
+        with_moments=False, covariance_type=covariance_type,
     )
     labels = labs.reshape(-1)[:n]
     return GMMState(
@@ -292,10 +377,11 @@ def fit_gmm(
     log-likelihood (sklearn semantics; its GMM default is 1e-3 — pass
     ``tol=`` explicitly if the shared KMeansConfig default is too tight).
     """
-    if covariance_type not in ("diag", "spherical"):
+    if covariance_type not in ("diag", "spherical", "tied"):
         raise ValueError(
-            f"covariance_type must be 'diag' or 'spherical' (full is a "
-            f"(k, d, d) non-starter at TPU scale), got {covariance_type!r}"
+            f"covariance_type must be 'diag', 'spherical' or 'tied' (full "
+            f"is a (k, d, d) non-starter at TPU scale), "
+            f"got {covariance_type!r}"
         )
     if not reg_covar >= 0.0:
         raise ValueError(f"reg_covar must be >= 0, got {reg_covar}")
@@ -311,13 +397,15 @@ def fit_gmm(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size", "compute_dtype"))
+@functools.partial(jax.jit, static_argnames=("chunk_size", "compute_dtype",
+                                             "covariance_type"))
 def gmm_log_resp(
     x: jax.Array,
     params: GMMParams,
     *,
     chunk_size: int = 4096,
     compute_dtype=None,
+    covariance_type: str = "diag",
 ) -> tuple[jax.Array, jax.Array]:
     """``(log_resp (n, k), log_prob (n,))`` for given parameters.
 
@@ -327,12 +415,12 @@ def gmm_log_resp(
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
     n = x.shape[0]
     xs, _, _ = chunk_tiles(x, None, chunk_size)
-    inv_var, lin, const = _logp_terms(params)
-    inv_var_t = inv_var.astype(cd).T
+    quad, lin, const = _logp_terms(params, covariance_type)
+    quad_t = quad.astype(cd).T
     lin_t = lin.astype(cd).T
 
     def body(_, xb):
-        logp, _ = _logp_tile(xb, inv_var_t, lin_t, const, cd)
+        logp, _ = _logp_tile(xb, quad_t, lin_t, const, cd, covariance_type)
         row_ll = jax.nn.logsumexp(logp, axis=1)
         return 0, (logp - row_ll[:, None], row_ll)
 
@@ -341,13 +429,15 @@ def gmm_log_resp(
     return log_resp.reshape(-1, k)[:n], log_prob.reshape(-1)[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size", "compute_dtype"))
+@functools.partial(jax.jit, static_argnames=("chunk_size", "compute_dtype",
+                                             "covariance_type"))
 def gmm_predict(
     x: jax.Array,
     params: GMMParams,
     *,
     chunk_size: int = 4096,
     compute_dtype=None,
+    covariance_type: str = "diag",
 ) -> jax.Array:
     """Component labels (argmax responsibility), tiled — never materializes
     the (n, k) responsibility matrix (``gmm_log_resp`` does; at k=1000 and
@@ -355,12 +445,12 @@ def gmm_predict(
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
     n = x.shape[0]
     xs, _, _ = chunk_tiles(x, None, chunk_size)
-    inv_var, lin, const = _logp_terms(params)
-    inv_var_t = inv_var.astype(cd).T
+    quad, lin, const = _logp_terms(params, covariance_type)
+    quad_t = quad.astype(cd).T
     lin_t = lin.astype(cd).T
 
     def body(_, xb):
-        logp, _ = _logp_tile(xb, inv_var_t, lin_t, const, cd)
+        logp, _ = _logp_tile(xb, quad_t, lin_t, const, cd, covariance_type)
         return 0, jnp.argmax(logp, axis=1).astype(jnp.int32)
 
     _, labs = lax.scan(body, 0, xs)
@@ -425,6 +515,8 @@ class GaussianMixture:
     def covariances_(self):
         if self.covariance_type == "spherical":
             return self.state.covariances[:, 0]
+        # tied: the shared (d, d) matrix, diag: (k, d) — both sklearn's
+        # shapes for the matching covariance_type.
         return self.state.covariances
 
     @property
@@ -445,13 +537,15 @@ class GaussianMixture:
 
     def _n_parameters(self) -> int:
         k, d = self.state.means.shape
-        cov = k * d if self.covariance_type == "diag" else k
+        cov = {"diag": k * d, "spherical": k,
+               "tied": d * (d + 1) // 2}[self.covariance_type]
         return k * d + cov + (k - 1)
 
     def score_samples(self, x):
         _, log_prob = gmm_log_resp(
             jnp.asarray(x), self._params, chunk_size=self.chunk_size,
             compute_dtype=self.compute_dtype,
+            covariance_type=self.covariance_type,
         )
         return log_prob
 
@@ -462,6 +556,7 @@ class GaussianMixture:
         log_resp, _ = gmm_log_resp(
             jnp.asarray(x), self._params, chunk_size=self.chunk_size,
             compute_dtype=self.compute_dtype,
+            covariance_type=self.covariance_type,
         )
         return jnp.exp(log_resp)
 
@@ -469,13 +564,15 @@ class GaussianMixture:
         return gmm_predict(
             jnp.asarray(x), self._params, chunk_size=self.chunk_size,
             compute_dtype=self.compute_dtype,
+            covariance_type=self.covariance_type,
         )
 
     def sample(self, n: int, *, key=None):
         """(x (n, d), components (n,)) drawn from the fitted mixture."""
         if key is None:
             key = jax.random.key(self.seed + 1)
-        return gmm_sample(key, self._params, n)
+        return gmm_sample(key, self._params, n,
+                          covariance_type=self.covariance_type)
 
     def bic(self, x) -> float:
         n = jnp.asarray(x).shape[0]
@@ -488,8 +585,9 @@ class GaussianMixture:
         return float(-2.0 * self.score(x) * n + 2 * self._n_parameters())
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def gmm_sample(key: jax.Array, params: GMMParams, n: int):
+@functools.partial(jax.jit, static_argnames=("n", "covariance_type"))
+def gmm_sample(key: jax.Array, params: GMMParams, n: int,
+               covariance_type: str = "diag"):
     """Draw ``n`` samples from the fitted mixture.
 
     Returns ``(x (n, d) float32, components (n,) int32)``: components by
@@ -500,6 +598,12 @@ def gmm_sample(key: jax.Array, params: GMMParams, n: int):
     comp = jax.random.categorical(
         kc, params.log_pi, shape=(n,)
     ).astype(jnp.int32)
-    noise = jax.random.normal(kn, (n, params.means.shape[1]), jnp.float32)
-    x = params.means[comp] + noise * jnp.sqrt(params.variances[comp])
+    d = params.means.shape[1]
+    noise = jax.random.normal(kn, (n, d), jnp.float32)
+    if covariance_type == "tied":
+        # Shared (d, d) covariance: correlate the noise with its Cholesky.
+        chol = jnp.linalg.cholesky(params.variances)
+        x = params.means[comp] + noise @ chol.T
+    else:
+        x = params.means[comp] + noise * jnp.sqrt(params.variances[comp])
     return x, comp

@@ -208,8 +208,12 @@ def fit_gmm_stream(
     refused rather than silently diverging.
     """
     if covariance_type not in (None, "diag", "spherical"):
+        # "tied" is full-batch only: its M-step leans on the global scatter
+        # being constant across iterations, which online EM's decaying
+        # averages don't provide.
         raise ValueError(
-            f"covariance_type must be 'diag' or 'spherical', "
+            f"covariance_type must be 'diag' or 'spherical' for the "
+            f"streamed fit ('tied' is full-batch fit_gmm only), "
             f"got {covariance_type!r}"
         )
     if reg_covar is not None and not reg_covar >= 0.0:

@@ -1747,25 +1747,33 @@ def _gmm_init_params(x, w, c0, reg_covar, *, covariance_type):
     if covariance_type == "spherical":
         var = jnp.mean(var) * jnp.ones_like(var)
     var = var + reg_covar
+    if covariance_type == "tied":
+        cov0 = jnp.diag(var).astype(f32)
+    else:
+        cov0 = jnp.broadcast_to(var, c0.shape).astype(f32)
     return GMMParams(
         c0.astype(f32),
-        jnp.broadcast_to(var, c0.shape).astype(f32),
+        cov0,
         jnp.full((k,), -jnp.log(float(k)), f32),
     )
 
 
-def _gmm_local_pass(x_loc, params, w_loc, *, data_axis, chunk_size,
-                    compute_dtype, covariance_type, reg_covar, with_labels):
+def _gmm_local_pass(x_loc, params, w_loc, scatter, *, data_axis,
+                    chunk_size, compute_dtype, covariance_type, reg_covar,
+                    with_labels):
     """DP shard body for GMM EM: responsibilities are row-local given
     replicated parameters, so one ``psum`` of the soft moments
     (N, S, Q, log-likelihood) per pass is the whole collective story —
-    the M-step then runs replicated on every device."""
+    the M-step then runs replicated on every device.  ``scatter`` is the
+    replicated once-per-fit global second moment the tied M-step needs
+    (a (1, 1) zero placeholder otherwise)."""
     from kmeans_tpu.models.gmm import gmm_m_step, gmm_scan_tiles
 
     xs, ws, n_loc = chunk_tiles(x_loc, w_loc, chunk_size)
     N, S, Q, ll, labs = gmm_scan_tiles(
         xs, ws, params, compute_dtype=compute_dtype,
         with_labels=with_labels, with_moments=not with_labels,
+        covariance_type=covariance_type,
     )
     N = lax.psum(N, data_axis)
     ll = lax.psum(ll, data_axis)
@@ -1777,6 +1785,7 @@ def _gmm_local_pass(x_loc, params, w_loc, *, data_axis, chunk_size,
     new_params = gmm_m_step(
         params, N, S, Q, covariance_type=covariance_type,
         reg_covar=reg_covar,
+        scatter=scatter if covariance_type == "tied" else None,
     )
     return new_params, N, ll
 
@@ -1794,18 +1803,27 @@ def _build_gmm_run(mesh, data_axis, chunk_size, compute_dtype,
     params_spec = GMMParams(P(), P(), P())
     step = jax.shard_map(
         functools.partial(local, with_labels=False), mesh=mesh,
-        in_specs=(P(data_axis), params_spec, P(data_axis)),
+        in_specs=(P(data_axis), params_spec, P(data_axis), P()),
         out_specs=(params_spec, P(), P()), check_vma=False,
     )
     final = jax.shard_map(
         functools.partial(local, with_labels=True), mesh=mesh,
-        in_specs=(P(data_axis), params_spec, P(data_axis)),
+        in_specs=(P(data_axis), params_spec, P(data_axis), P()),
         out_specs=(P(), P(), P(data_axis)), check_vma=False,
     )
 
     @jax.jit
     def run(x, w, params0, tol_v):
         total_w = jnp.sum(w)
+        if covariance_type == "tied":
+            # Once-per-fit global scatter: a contraction over the sharded
+            # row axis, which GSPMD lowers to per-shard (d, d) partials +
+            # one all-reduce — no row movement.
+            xf = x.astype(jnp.float32)
+            g = (xf * w[:, None]).T @ xf
+            scatter = 0.5 * (g + g.T)
+        else:
+            scatter = jnp.zeros((1, 1), jnp.float32)
 
         def cond(s):
             params, it, prev_ll, done = s
@@ -1813,7 +1831,7 @@ def _build_gmm_run(mesh, data_axis, chunk_size, compute_dtype,
 
         def body(s):
             params, it, prev_ll, _ = s
-            new_params, _, ll = step(x, params, w)
+            new_params, _, ll = step(x, params, w, scatter)
             mean_ll = ll / total_w
             done = jnp.abs(mean_ll - prev_ll) <= tol_v
             return (new_params, it + 1, mean_ll, done)
@@ -1823,7 +1841,7 @@ def _build_gmm_run(mesh, data_axis, chunk_size, compute_dtype,
             (params0, jnp.zeros((), jnp.int32),
              jnp.asarray(-jnp.inf, jnp.float32), jnp.zeros((), bool)),
         )
-        N, ll, labels = final(x, params, w)
+        N, ll, labels = final(x, params, w, scatter)
         return GMMState(
             params.means, params.variances, jnp.exp(params.log_pi), labels,
             ll, n_iter, converged, N,
@@ -1859,9 +1877,9 @@ def fit_gmm_sharded(
     """
     from kmeans_tpu.models.gmm import GMMParams, GMMState
 
-    if covariance_type not in ("diag", "spherical"):
+    if covariance_type not in ("diag", "spherical", "tied"):
         raise ValueError(
-            f"covariance_type must be 'diag' or 'spherical', "
+            f"covariance_type must be 'diag', 'spherical' or 'tied', "
             f"got {covariance_type!r}"
         )
     if not reg_covar >= 0.0:

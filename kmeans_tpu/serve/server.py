@@ -232,6 +232,8 @@ class KMeansServer:
             self.config.max_concurrent_train
         )
         self.rooms: Dict[str, _Room] = {}
+        self._save_locks: Dict[str, threading.Lock] = {}
+        self._save_locks_guard = threading.Lock()
         self._lock = threading.Lock()
         self.httpd: Optional[ThreadingHTTPServer] = None
         if self.config.persist_dir:
@@ -303,19 +305,50 @@ class KMeansServer:
             room._save_timer = t
             t.start()
 
+    def _code_save_lock(self, code: str) -> threading.Lock:
+        """One save lock PER ROOM CODE, not per _Room instance: a fired
+        debounce timer can still be mid-write on an evicted instance while
+        a revived instance of the same code saves — per-instance locks
+        would not serialize them (they also share nothing else).  Lock
+        objects are tiny and codes are operator-bounded, so the table
+        only grows, never evicts."""
+        with self._save_locks_guard:
+            lock = self._save_locks.get(code)
+            if lock is None:
+                lock = self._save_locks[code] = threading.Lock()
+            return lock
+
+    def _flush_pending_save(self, room: _Room, *, always: bool = False) -> None:
+        """Cancel a pending debounce timer and write NOW (when one was
+        pending, or unconditionally with ``always``) — THE one copy of the
+        cancel-then-save sequence, used by clean shutdown and eviction."""
+        with room._lock:
+            pending = room._save_timer is not None
+            if pending:
+                room._save_timer.cancel()
+        if pending or always:
+            self._save_room(room)
+
     def _save_room(self, room: _Room) -> None:
         from kmeans_tpu.session.schema import export_json
 
         with room._lock:
             room._save_timer = None
         try:
-            with room.doc.read_lock():
-                text = export_json(room.doc)
-            path = self._room_path(room.code)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(text)
-            os.replace(tmp, path)             # atomic: never a torn file
+            # One writer at a time per room CODE, and a per-thread tmp
+            # name: concurrent writers (fired timer + flush, or an evicted
+            # instance's late timer vs its revived successor) would
+            # otherwise interleave on the same tmp path and os.replace
+            # could publish a torn or stale file.
+            with self._code_save_lock(room.code):
+                with room.doc.read_lock():
+                    text = export_json(room.doc)
+                path = self._room_path(room.code)
+                tmp = (f"{path}.tmp.{os.getpid()}."
+                       f"{threading.get_ident()}")
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write(text)
+                os.replace(tmp, path)         # atomic: never a torn file
         except Exception as e:
             print(f"kmeans_tpu.serve: persisting room {room.code} failed: "
                   f"{e}", file=sys.stderr)
@@ -326,12 +359,7 @@ class KMeansServer:
         if not self.config.persist_dir:
             return
         for room in list(self.rooms.values()):
-            with room._lock:
-                pending = room._save_timer is not None
-                if pending and room._save_timer is not None:
-                    room._save_timer.cancel()
-            if pending:
-                self._save_room(room)
+            self._flush_pending_save(room)
 
     def room(self, code: Optional[str]) -> _Room:
         # Restrict to the reference's room-code alphabet shape (app.mjs:19):
@@ -356,6 +384,17 @@ class KMeansServer:
                             f"room table full ({_MAX_ROOMS} active rooms)"
                         )
                     victim = min(idle, key=lambda r: r.last_active)
+                    # The victim's state must land on disk BEFORE its code
+                    # can be revived: a pending (or already in-flight —
+                    # the per-code save lock serializes that) save firing
+                    # after eviction could clobber a newer file written by
+                    # a revived instance (ADVICE r3).  Deliberately done
+                    # under self._lock: eviction only happens on the rare
+                    # table-full path, docs are import-cap bounded, and
+                    # flushing outside the lock would reopen the
+                    # revive-before-flush ordering race.
+                    if self.config.persist_dir:
+                        self._flush_pending_save(victim, always=True)
                     del self.rooms[victim.code]
                 room = self.rooms[code] = self._revive_or_create(code)
                 self._wire_persistence(room)
@@ -699,7 +738,16 @@ class KMeansServer:
                     )
                 if path == "/api/state":
                     room = server.room(q.get("room"))
-                    return self._json(room.state())
+                    payload = room.state()
+                    # Durability hint for the client's cache-restore gate:
+                    # with persistence ON, a fresh doc means the server
+                    # genuinely has nothing (new room or deliberate reset)
+                    # — the client asks before resurrecting its cache;
+                    # with persistence OFF the cache is the only replica
+                    # and restores silently (ADVICE r3).
+                    payload["persisted"] = bool(
+                        server.config.persist_dir)
+                    return self._json(payload)
                 if path == "/api/export":
                     room = server.room(q.get("room"))
                     with room.doc.read_lock():
@@ -835,5 +883,14 @@ def serve(host: str = "127.0.0.1", port: int = 8787, *,
           persist_dir: Optional[str] = None) -> KMeansServer:
     s = KMeansServer(ServeConfig(host=host, port=port,
                                  persist_dir=persist_dir))
-    s.start(background=background)
+    try:
+        s.start(background=background)
+    except KeyboardInterrupt:
+        # Foreground Ctrl-C: a clean exit must flush pending debounced
+        # saves — otherwise the interactive path loses the last debounce
+        # window exactly like kill -9 (ADVICE r3).  Re-raised so callers
+        # keep interrupt semantics (a retry loop must not resurrect the
+        # server the user just killed); the CLI catches it.
+        s.stop()
+        raise
     return s

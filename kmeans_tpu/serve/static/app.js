@@ -57,10 +57,10 @@ async function fetchState() {
     // room instead of letting the fresh doc overwrite it.
     const restore = await maybeRestoreCache();
     if (restore === "restored") return;     // refetches after the import
-    // A FAILED restore attempt must leave the cache untouched (it is the
-    // only surviving replica; caching the fresh seed doc here would
+    // A FAILED or DECLINED restore must leave the cache untouched (it is
+    // the only surviving replica; caching the fresh seed doc here would
     // destroy it with no retry possible).
-    if (restore !== "failed") {
+    if (restore !== "failed" && restore !== "declined") {
       try { localStorage.setItem(LS_STATE, JSON.stringify(state)); } catch {}
     }
   } catch {
@@ -73,9 +73,10 @@ async function fetchState() {
 }
 
 let restoringCache = false;
-// Returns "none" (no restore applicable), "restored", or "failed" (a
-// restore was ATTEMPTED and did not land — the caller must not overwrite
-// the cache in that case).
+// Returns "none" (no restore applicable), "restored", "declined" (the
+// user kept the server board; the cache must survive for a retry), or
+// "failed" (a restore was ATTEMPTED and did not land — the caller must
+// not overwrite the cache in that case).
 async function maybeRestoreCache() {
   if (restoringCache) return "none";
   // Fresh server doc = version <=1 (the Jessica seed bump only).
@@ -86,6 +87,19 @@ async function maybeRestoreCache() {
   const richer = cached.cards.length > (state.cards || []).length
     || (cached.centroids || []).length > (state.centroids || []).length;
   if (!richer) return "none";
+  // Durability-aware gate: when the server persists rooms, a fresh doc is
+  // deliberate (new room, or an operator reset) — ask before resurrecting
+  // the local cache for every peer. Without persistence the cache is the
+  // only surviving replica and restores silently (the designed degraded-
+  // durability path).
+  if (state.persisted
+      && !confirm("The server has a fresh board but this browser holds a "
+                  + "cached copy. Restore the cached board for everyone?")) {
+    // Declined is NOT "none": the cache is still the only replica of the
+    // richer board, and returning "none" would let fetchState overwrite
+    // it with the fresh seed doc — unrecoverable after one wrong click.
+    return "declined";
+  }
   restoringCache = true;
   try {
     const r = await fetch(api("/api/import"), {

@@ -3,6 +3,7 @@
 
 import json
 import threading
+import time
 import urllib.request
 
 import pytest
@@ -889,3 +890,85 @@ def test_trained_board_survives_restart(tmp_path):
         assert len(st["centroids"]) == 3
     finally:
         s2.stop()
+
+
+def test_sse_soak_slow_clients_burst_no_leak(server):
+    """SURVEY §5.3 churn resilience (VERDICT r3 item 8): N slow SSE clients
+    that stop reading while a mutation burst overflows their bounded
+    queues must not leak server threads, must keep their streams LIVE
+    (later events still arrive after the drops), and the room state all
+    clients would refetch must hold the final version."""
+    import socket
+
+    room = "SOAK"
+    host, port = server.httpd.server_address
+    n_clients, burst = 8, 120
+
+    threads_before = threading.active_count()
+    socks = []
+    try:
+        for _ in range(n_clients):
+            sock = socket.create_connection((host, port), timeout=10)
+            sock.sendall(
+                f"GET /api/events?room={room} HTTP/1.1\r\n"
+                f"Host: {host}\r\nAccept: text/event-stream\r\n\r\n".encode()
+            )
+            buf = b""
+            while b'"type": "hello"' not in buf:
+                buf += sock.recv(4096)
+            socks.append(sock)
+        assert server.room(room).peer_count() == n_clients
+
+        # Burst while every client is asleep: per-subscriber queues
+        # (maxsize=64) overflow and drop — the server must stay healthy.
+        for i in range(burst):
+            _mutate(server, room, "addCard", {"title": f"card {i}"})
+
+        st = server.room(room).state()
+        assert len(st["cards"]) >= burst
+        final_version = st["version"]
+
+        # Streams stay live: drain whatever was queued, then one more
+        # mutation must reach EVERY client as a fresh change event with a
+        # version PAST the burst (dropped events self-heal by refetch, so
+        # liveness of the stream is the contract, not completeness).
+        for sock in socks:
+            sock.settimeout(0.2)
+            try:
+                while True:
+                    if not sock.recv(65536):
+                        break
+            except socket.timeout:
+                pass
+        _mutate(server, room, "addCentroid")
+        bumped = server.room(room).state()["version"]
+        assert bumped > final_version
+        for i, sock in enumerate(socks):
+            sock.settimeout(5.0)
+            got = b""
+            while f'"version": {bumped}'.encode() not in got:
+                chunk = sock.recv(65536)
+                assert chunk, f"client {i} stream died after the burst"
+                got += chunk
+            assert b'"type": "change"' in got
+    finally:
+        for sock in socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # No thread leak: handler threads drain once clients disconnect.  A
+    # dead connection is noticed at the next WRITE (event or the 15 s
+    # ping), so nudge with a mutation while waiting rather than waiting
+    # out the ping interval.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if (threading.active_count() <= threads_before + 1
+                and server.room(room).peer_count() == 0):
+            break
+        _mutate(server, room, "addCard", {"title": "nudge"})
+        time.sleep(0.2)
+    assert server.room(room).peer_count() == 0
+    assert threading.active_count() <= threads_before + 1, (
+        threads_before, threading.active_count())

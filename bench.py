@@ -815,7 +815,7 @@ def _run_benches(args, metric, unit, fresh=None):
             try:
                 r = bench_lloyd_iters_per_s(
                     cfg["n"], cfg["d"], cfg["k"], iters=args.iters,
-                    verbose=True, backend=args.backend,
+                    verbose=True, backend=args.backend, update=args.update,
                 )
                 print(f"{name}: {r:.2f} Lloyd iter/s", file=sys.stderr)
             except Exception as e:  # one config must not kill the table

@@ -198,7 +198,11 @@ def delta_pass(
             "block_rows=1024, lane-alignable d, and binary weights unless "
             "f32); use backend='auto' to fall back"
         )
-    use_pallas = backend == "pallas" or (backend == "auto" and supported)
+    # "pallas_interpret" is the CPU-mesh kernel hook (same as lloyd_pass's):
+    # the fused delta kernel runs in interpreter mode, VMEM gates waived.
+    interpret = backend == "pallas_interpret"
+    use_pallas = (backend == "pallas" or interpret
+                  or (backend == "auto" and supported))
     w_all = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
 
     if use_pallas:
@@ -210,6 +214,7 @@ def delta_pass(
          overflowed) = lloyd_delta_pallas(
             x, centroids, labels_prev, weights=weights,
             compute_dtype=compute_dtype, with_mind=with_mind,
+            interpret=interpret,
         )
         pred = ~overflowed
         if force_full is not None:
@@ -221,6 +226,7 @@ def delta_pass(
         def full(_):
             s, c, _ = accumulate_pallas(
                 x, labels, k, weights=w_all, compute_dtype=compute_dtype,
+                interpret=interpret,
             )
             return s, c
 

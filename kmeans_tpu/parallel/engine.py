@@ -842,10 +842,11 @@ def fit_lloyd_sharded(
     # would quantize them — demote to the exact segment reduction (the
     # shared single-device policy, ops.lloyd.weights_exact).
     update = cfg.update
-    if update == "delta":
-        # The incremental update is a single-device loop structure (carried
-        # labels/sums state); the sharded engines run the classic fused
-        # reduction — same results, psum'd per sweep.
+    if update == "delta" and (model_axis or feature_axis or not w_exact):
+        # The incremental update needs the DP body's carried labels/sums
+        # state and exact signed-fold weights; the TP/FP bodies and
+        # fractional-weight runs use the classic fused reduction — same
+        # results, psum'd per sweep.
         update = "matmul"
     if update == "matmul" and not w_exact:
         update = "segment"
@@ -873,14 +874,24 @@ def fit_lloyd_sharded(
             cfg.backend, x, k, weights_are_binary=weights_binary,
             weights=w_host, compute_dtype=cfg.compute_dtype, platform=plat,
         )
-    run = _build_lloyd_run(
-        mesh, data_axis, model_axis, k, cfg.chunk_size, cfg.compute_dtype,
-        update, max_it, backend, cfg.empty, feature_axis,
-        # Only the DP body reads the flag; normalize it for TP/FP so weight
-        # type doesn't force a spurious recompile of an identical program.
-        weights_binary if not (model_axis or feature_axis) else True,
-        center_update,
-    )
+    if update == "delta":
+        # DP incremental loop: per-shard carried (labels, sums, counts),
+        # one psum per sweep, per-shard fallback on tile overflow.
+        run = _build_lloyd_delta_run(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, max_it,
+            backend, cfg.empty, center_update,
+        )
+    else:
+        run = _build_lloyd_run(
+            mesh, data_axis, model_axis, k, cfg.chunk_size,
+            cfg.compute_dtype, update, max_it, backend, cfg.empty,
+            feature_axis,
+            # Only the DP body reads the flag; normalize it for TP/FP so
+            # weight type doesn't force a spurious recompile of an
+            # identical program.
+            weights_binary if not (model_axis or feature_axis) else True,
+            center_update,
+        )
     c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
     return KMeansState(
         c[:k, :d_real], labels[:n], inertia, n_iter, converged, counts[:k]
@@ -1005,6 +1016,112 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
         return c, labels, inertia, n_iter, converged, counts
 
     return run
+
+
+def _dp_delta_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
+                         force_full, *, data_axis, chunk_size,
+                         compute_dtype, backend, empty, center_update):
+    """DP shard body for the incremental (delta) update: each shard runs
+    :func:`kmeans_tpu.ops.delta.delta_pass` on its rows — carrying ITS OWN
+    (labels, sums, counts) state, so a shard whose tile budget overflows
+    falls back to a full local reduction independently — and one psum of
+    the per-shard (sums, counts) merges the update, exactly the collective
+    story of the dense DP body.  The delta invariant (sums == the
+    reduction at the carried labels) is per-shard, so reseeding and the
+    spherical renormalized update compose unchanged."""
+    from kmeans_tpu.ops.delta import default_cap, delta_pass
+
+    n_loc = x_loc.shape[0]
+    labels, min_d2, sums_new, counts_new, _, _ = delta_pass(
+        x_loc, c, lab_prev, sums_loc, counts_loc, weights=w_loc,
+        cap=default_cap(n_loc), chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
+        # The engine resolved "pallas" at the classic kernel's footprint;
+        # hand delta_pass "auto" so it re-gates at the delta kernel's own.
+        backend="auto" if backend == "pallas" else backend,
+        weights_are_binary=True, force_full=force_full,
+        with_mind=(empty == "farthest"),
+    )
+    g_sums = lax.psum(sums_new, data_axis)
+    g_counts = lax.psum(counts_new, data_axis)
+    new_c = _apply_center_update(c, g_sums, g_counts,
+                                 center_update=center_update)
+    if empty == "farthest":
+        masked = jnp.where(w_loc > 0, min_d2, -jnp.inf)
+        new_c = _reseed_empty_farthest_dp(
+            new_c, g_counts, x_loc, masked, data_axis
+        )
+    return new_c, labels, sums_new, counts_new
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
+                           max_it, backend, empty, center_update):
+    """Jitted whole-fit program for the DP ``update="delta"`` path: the
+    while_loop carries per-shard labels and reduction state (stacked over
+    ``data_axis``) alongside the replicated centroids.  The final labeling
+    pass is the classic dense body (same as every other run builder)."""
+    local = functools.partial(
+        _dp_delta_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, backend=backend, empty=empty,
+        center_update=center_update,
+    )
+    step = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis), P(data_axis),
+                  P(data_axis), P(data_axis), P()),
+        out_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
+        check_vma=False,
+    )
+    final_local = functools.partial(
+        _dp_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update="matmul", backend=backend,
+        with_labels=True, empty="keep", center_update=center_update,
+    )
+    final = jax.shard_map(
+        final_local, mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P(), P(data_axis)),
+        check_vma=False,
+    )
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+
+    @jax.jit
+    def run(x, w, c0, tol_v):
+        n = x.shape[0]
+        k, d = c0.shape
+
+        def cond(s):
+            c, it, shift_sq, done, lab, sums, counts = s
+            return (it < max_it) & ~done
+
+        def body(s):
+            c, it, _, _, lab, sums, counts = s
+            new_c, lab, sums, counts = step(
+                x, c, w, lab, sums, counts,
+                (it % _DELTA_REFRESH_SHARDED) == 0,
+            )
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
+                    counts)
+
+        init = (
+            c0, jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, jnp.float32), jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),     # sentinel -> first sweep full
+            jnp.zeros((dp * k, d), jnp.float32),   # per-shard sums, stacked
+            jnp.zeros((dp * k,), jnp.float32),
+        )
+        c, n_iter, _, converged = lax.while_loop(cond, body, init)[:4]
+        _, inertia, counts, labels = final(x, c, w)
+        return c, labels, inertia, n_iter, converged, counts
+
+    return run
+
+
+#: Per-shard full-refresh cadence of the sharded delta loop (same drift
+#: rationale as models.lloyd._DELTA_REFRESH).
+_DELTA_REFRESH_SHARDED = 16
 
 
 @functools.lru_cache(maxsize=32)

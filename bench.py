@@ -437,11 +437,11 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
 
         args = ()
 
-    # Three timed windows, best one reported: the tunnel/host adds run-to-
+    # Five timed windows, best one reported: the tunnel/host adds run-to-
     # run jitter of ~10% on a 0.5 s window, and the quantity being measured
     # (sustained device iteration rate at fixed shapes) is deterministic —
     # repeats only remove measurement noise, they cannot flatter the chip.
-    windows = 3
+    windows = 5
     if n_dev <= 1 and update == "delta":
         # State-carrying loop.  Warm-up runs TWO sweeps: the first is the
         # all-rows-changed full reduction (sentinel labels), the second is

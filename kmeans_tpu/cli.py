@@ -139,6 +139,17 @@ def _cmd_train(args) -> int:
               f"--model {model} runs to --max-iter/--tol", file=sys.stderr)
         return 2
 
+    # --update configures the Lloyd-family centroid reduction ("delta" is
+    # the incremental sweep); families that never read cfg.update would
+    # silently ignore it — reject, matching the guards above.
+    lloyd_family = model in (None, "lloyd", "accelerated", "spherical",
+                             "trimmed") and not minibatch and not args.stream
+    if getattr(args, "update", None) and not lloyd_family:
+        print(f"error: --update configures the Lloyd-family reduction; "
+              f"it has no effect with --model {model or 'minibatch'}"
+              f"{' --stream' if args.stream else ''}", file=sys.stderr)
+        return 2
+
     if args.steps is not None and args.steps < 1:
         print("error: --steps must be positive", file=sys.stderr)
         return 2
@@ -151,6 +162,8 @@ def _cmd_train(args) -> int:
         cfg_kw["steps"] = args.steps
     if args.batch_size is not None:
         cfg_kw["batch_size"] = args.batch_size
+    if getattr(args, "update", None):
+        cfg_kw["update"] = args.update
     kcfg = KMeansConfig(
         k=k, init=args.init,
         max_iter=args.max_iter if args.max_iter is not None else 100,
@@ -561,6 +574,11 @@ def main(argv=None) -> int:
                    help="with --pca: rescale components to unit variance")
     t.add_argument("--batch-size", type=int, default=None,
                    help="minibatch/stream batch size (default 8192)")
+    t.add_argument("--update", default=None,
+                   choices=["matmul", "segment", "delta"],
+                   help="Lloyd centroid-update reduction; 'delta' is the "
+                        "incremental changed-rows-only sweep (single-device "
+                        "and DP-mesh fits)")
     t.add_argument("--tol", type=float, default=1e-4)
     t.add_argument("--seed", type=int, default=None,
                    help="RNG seed (default 0; leaving it unset lets a "

@@ -28,12 +28,6 @@ from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = ["KMeansState", "fit_lloyd", "KMeans", "best_of_n_init"]
 
-#: Full-reduction refresh period of the ``update="delta"`` loop: one sweep
-#: in every _DELTA_REFRESH recomputes sums/counts from scratch, bounding the
-#: f32 drift of repeated +/- delta accumulation (~1e-7 relative per sweep)
-#: to a level far below the bf16 distance noise that dominates label ties.
-_DELTA_REFRESH = 16
-
 
 class KMeansState(NamedTuple):
     """Result of a fit: arrays are committed (device) values."""
@@ -89,7 +83,8 @@ def _lloyd_loop(
         # satisfy sums == Σ w·x·onehot(labels); a full refresh every
         # _DELTA_REFRESH sweeps bounds f32 +/- drift.  Reseeding composes:
         # the invariant constrains labels/sums, not where centroids moved.
-        from kmeans_tpu.ops.delta import default_cap, delta_pass
+        from kmeans_tpu.ops.delta import (DELTA_REFRESH, default_cap,
+                                          delta_pass)
 
         n, _ = x.shape
         cap = default_cap(n)
@@ -128,7 +123,7 @@ def _lloyd_loop(
                 return labels, min_d2, s2, c2
 
             lab, min_d2, sums, counts = lax.cond(
-                (it % _DELTA_REFRESH) == 0, refresh_sweep, delta_sweep, None)
+                (it % DELTA_REFRESH) == 0, refresh_sweep, delta_sweep, None)
             new_c = reseed(apply_update(c, sums, counts), counts, min_d2)
             shift_sq = jnp.sum((new_c - c) ** 2)
             return (new_c, it + 1, shift_sq, shift_sq <= tol, lab, sums,

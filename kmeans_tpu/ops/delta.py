@@ -56,7 +56,15 @@ from kmeans_tpu.ops.pallas_lloyd import (accumulate_pallas,
                                          delta_pallas_supported,
                                          lloyd_delta_pallas)
 
-__all__ = ["delta_pass", "default_cap"]
+__all__ = ["delta_pass", "default_cap", "DELTA_REFRESH"]
+
+#: Full-reduction refresh period of delta-update loops: one sweep in every
+#: DELTA_REFRESH recomputes sums/counts from scratch, bounding the f32
+#: drift of repeated +/- accumulation (~1e-7 relative per sweep) far below
+#: the bf16 distance noise that dominates label ties.  THE one copy — the
+#: single-device and sharded loops must share the cadence or their
+#: trajectories fork.
+DELTA_REFRESH = 16
 
 
 def default_cap(n: int) -> int:

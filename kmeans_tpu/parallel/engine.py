@@ -1085,6 +1085,7 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
         check_vma=False,
     )
     dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+    from kmeans_tpu.ops.delta import DELTA_REFRESH
 
     @jax.jit
     def run(x, w, c0, tol_v):
@@ -1099,7 +1100,7 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
             c, it, _, _, lab, sums, counts = s
             new_c, lab, sums, counts = step(
                 x, c, w, lab, sums, counts,
-                (it % _DELTA_REFRESH_SHARDED) == 0,
+                (it % DELTA_REFRESH) == 0,
             )
             shift_sq = jnp.sum((new_c - c) ** 2)
             return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
@@ -1118,10 +1119,6 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
 
     return run
 
-
-#: Per-shard full-refresh cadence of the sharded delta loop (same drift
-#: rationale as models.lloyd._DELTA_REFRESH).
-_DELTA_REFRESH_SHARDED = 16
 
 
 @functools.lru_cache(maxsize=32)

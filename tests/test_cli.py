@@ -489,3 +489,23 @@ def test_train_accelerated_on_mesh(capsys):
     res = json.loads(out.splitlines()[0])
     assert res["mode"] == "accelerated"
     assert np.isfinite(res["inertia"])
+
+
+def test_cli_train_update_delta(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main([
+        "train", "--n", "2000", "--d", "8", "--k", "4",
+        "--update", "delta", "--max-iter", "30",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["mode"] == "lloyd" and out["converged"]
+
+    rc = main([
+        "train", "--n", "2000", "--d", "8", "--k", "4",
+        "--update", "delta", "--mesh", "4", "--max-iter", "30",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["converged"]

@@ -139,16 +139,27 @@ def _cmd_train(args) -> int:
               f"--model {model} runs to --max-iter/--tol", file=sys.stderr)
         return 2
 
-    # --update configures the Lloyd-family centroid reduction ("delta" is
-    # the incremental sweep); families that never read cfg.update would
-    # silently ignore it — reject, matching the guards above.
-    lloyd_family = model in (None, "lloyd", "accelerated", "spherical",
-                             "trimmed") and not minibatch and not args.stream
-    if getattr(args, "update", None) and not lloyd_family:
-        print(f"error: --update configures the Lloyd-family reduction; "
-              f"it has no effect with --model {model or 'minibatch'}"
-              f"{' --stream' if args.stream else ''}", file=sys.stderr)
-        return 2
+    # --update configures the Lloyd-family centroid reduction; paths that
+    # never read cfg.update — or that silently demote "delta" to the dense
+    # reduction (accelerated/spherical/trimmed, and the step-wise runner)
+    # — must reject it rather than mislead (matching the guards above).
+    if getattr(args, "update", None):
+        dense_updates = model in ("lloyd", "accelerated", "spherical",
+                                  "trimmed") and not args.stream
+        if not dense_updates:
+            print(f"error: --update configures the Lloyd-family reduction; "
+                  f"it has no effect with --model {model}"
+                  f"{' --stream' if args.stream else ''}", file=sys.stderr)
+            return 2
+        runner_flags = bool(args.progress or args.checkpoint
+                            or args.resume or args.profile)
+        if args.update == "delta" and (model != "lloyd" or runner_flags):
+            print("error: --update delta (the incremental sweep) runs only "
+                  "in the plain lloyd fit loop; accelerated/spherical/"
+                  "trimmed and the runner (--progress/--checkpoint/"
+                  "--resume/--profile) use the dense reduction",
+                  file=sys.stderr)
+            return 2
 
     if args.steps is not None and args.steps < 1:
         print("error: --steps must be positive", file=sys.stderr)

@@ -81,7 +81,8 @@ def _lloyd_loop(
         # one-hot update only over rows whose label changed — halves the
         # steady-state MXU work.  The carried (labels, sums, counts) always
         # satisfy sums == Σ w·x·onehot(labels); a full refresh every
-        # _DELTA_REFRESH sweeps bounds f32 +/- drift.  Reseeding composes:
+        # ops.delta.DELTA_REFRESH sweeps bounds f32 drift.  Reseeding
+        # composes:
         # the invariant constrains labels/sums, not where centroids moved.
         from kmeans_tpu.ops.delta import (DELTA_REFRESH, default_cap,
                                           delta_pass)

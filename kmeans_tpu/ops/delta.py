@@ -51,7 +51,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from kmeans_tpu.ops.distance import matmul_precision
-from kmeans_tpu.ops.lloyd import lloyd_pass, weights_exact
+from kmeans_tpu.ops.lloyd import _platform_of, lloyd_pass, weights_exact
 from kmeans_tpu.ops.pallas_lloyd import (accumulate_pallas,
                                          delta_pallas_supported,
                                          lloyd_delta_pallas)
@@ -196,6 +196,7 @@ def delta_pass(
     supported = (
         weights_exact(cd, weights=weights,
                       weights_are_binary=weights_are_binary)
+        and _platform_of(x) == "tpu"
         and delta_pallas_supported(n, d, k,
                                    x_itemsize=x.dtype.itemsize,
                                    cd_itemsize=cd.itemsize)

@@ -417,11 +417,14 @@ def test_examples_quickstart_runs(capsys):
         os.path.abspath(__file__))), "examples", "quickstart.py")
     runpy.run_path(path, run_name="__main__")
     out = capsys.readouterr().out
-    for stage in ("lloyd", "trimmed", "balanced", "spectral",
-                  "pca+coreset", "merge_to_k", "sweep", "sharded"):
+    for stage in ("lloyd", "delta", "gmm-tied", "trimmed", "balanced",
+                  "spectral", "pca+coreset", "merge_to_k", "sweep",
+                  "sharded"):
         assert stage in out, stage
     assert "junk-trimmed=True" in out
     assert "labels==single-device: True" in out
+    assert "labels==dense: True" in out
+    assert "sigma=(16, 16)" in out
 
 
 def test_train_stream_mesh_composes(cifar_like_npy, capsys):
@@ -509,3 +512,16 @@ def test_cli_train_update_delta(capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip())
     assert out["converged"]
+
+
+def test_cli_update_delta_rejected_outside_plain_lloyd(capsys):
+    from kmeans_tpu.cli import main
+
+    # Families/paths that silently demote delta to the dense reduction
+    # must reject it instead.
+    for extra in (["--model", "spherical"], ["--model", "gmm"],
+                  ["--progress"], ["--minibatch"]):
+        rc = main(["train", "--n", "500", "--d", "4", "--k", "3",
+                   "--update", "delta", *extra])
+        assert rc == 2, extra
+        assert "--update" in capsys.readouterr().err

@@ -139,6 +139,16 @@ def _cmd_train(args) -> int:
               f"--model {model} runs to --max-iter/--tol", file=sys.stderr)
         return 2
 
+    if getattr(args, "covariance_type", None) and model != "gmm":
+        print(f"error: --covariance-type is a GMM flag; --model {model} "
+              "ignores it", file=sys.stderr)
+        return 2
+    # One copy of the GMM fit-kwarg plumbing for all three dispatch
+    # branches (mesh / stream / in-memory).
+    gmm_kw = ({"covariance_type": args.covariance_type}
+              if model == "gmm" and getattr(args, "covariance_type", None)
+              else {})
+
     # --update configures the Lloyd-family centroid reduction; paths that
     # never read cfg.update — or that silently demote "delta" to the dense
     # reduction (accelerated/spherical/trimmed, and the step-wise runner)
@@ -322,7 +332,7 @@ def _cmd_train(args) -> int:
             "balanced": parallel.fit_balanced_sharded,
         }[model]
         fit_kw = ({"trim_fraction": trim_fraction}
-                  if model == "trimmed" else {})
+                  if model == "trimmed" else {}) | gmm_kw
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg, **fit_kw)
     elif args.stream:
         ckpt_kw = {}
@@ -348,6 +358,7 @@ def _cmd_train(args) -> int:
             stream_kw["mesh"] = mesh    # out-of-core rows onto the mesh
         fit_stream = (models.fit_gmm_stream if model == "gmm"
                       else models.fit_minibatch_stream)
+        stream_kw |= gmm_kw
         try:
             state = fit_stream(x, k, config=kcfg, **stream_kw)
         except ValueError as e:
@@ -374,7 +385,7 @@ def _cmd_train(args) -> int:
             "gmeans": models.fit_gmeans,   # likewise (Anderson-Darling)
         }[model]
         fit_kw = ({"trim_fraction": trim_fraction}
-                  if model == "trimmed" else {})
+                  if model == "trimmed" else {}) | gmm_kw
         if fit_weights is not None:
             state = fit(x, k, config=kcfg, weights=fit_weights, **fit_kw)
         else:
@@ -558,6 +569,10 @@ def main(argv=None) -> int:
     ], help="model family (default: lloyd, or the config's minibatch "
             "choice); for xmeans/gmeans, --k is k_max and k is discovered; "
             "balanced enforces same-size clusters via Sinkhorn OT")
+    t.add_argument("--covariance-type", default=None,
+                   choices=["diag", "spherical", "tied"],
+                   help="GMM covariance structure (--model gmm; streamed "
+                        "GMM supports diag/spherical)")
     t.add_argument("--trim-fraction", type=float, default=None,
                    help="--model trimmed: fraction of points excluded as "
                         "outliers each iteration (default 0.05); trimmed "

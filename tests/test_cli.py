@@ -525,3 +525,18 @@ def test_cli_update_delta_rejected_outside_plain_lloyd(capsys):
                    "--update", "delta", *extra])
         assert rc == 2, extra
         assert "--update" in capsys.readouterr().err
+
+
+def test_cli_gmm_covariance_type(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main(["train", "--n", "800", "--d", "6", "--k", "3",
+               "--model", "gmm", "--covariance-type", "tied"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["mode"] == "gmm"
+
+    rc = main(["train", "--n", "500", "--d", "4", "--k", "3",
+               "--covariance-type", "tied"])       # lloyd ignores it
+    assert rc == 2
+    assert "--covariance-type" in capsys.readouterr().err

@@ -11,7 +11,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import kmeans_tpu  # noqa: E402
-from kmeans_tpu import config, data, metrics, models, parallel  # noqa: E402
+from kmeans_tpu import config, data, metrics, models, ops, parallel  # noqa: E402
 
 print("""# Public API index
 
@@ -33,6 +33,7 @@ for title, mod in (
     ("`kmeans_tpu` (top level)", kmeans_tpu),
     ("`kmeans_tpu.models`", models),
     ("`kmeans_tpu.parallel`", parallel),
+    ("`kmeans_tpu.ops`", ops),
     ("`kmeans_tpu.data`", data),
     ("`kmeans_tpu.metrics`", metrics),
     ("`kmeans_tpu.config`", config),

@@ -1,5 +1,6 @@
 """Numeric kernels: assignment, fused Lloyd pass, centroid update."""
 
+from kmeans_tpu.ops.delta import delta_pass
 from kmeans_tpu.ops.distance import assign, pairwise_sq_dists, sq_norms
 from kmeans_tpu.ops.lloyd import lloyd_pass
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
@@ -9,6 +10,7 @@ __all__ = [
     "pairwise_sq_dists",
     "sq_norms",
     "lloyd_pass",
+    "delta_pass",
     "apply_update",
     "reseed_empty_farthest",
 ]

@@ -14,6 +14,7 @@ from kmeans_tpu.parallel.engine import (
     fit_trimmed_sharded,
     sharded_assign,
 )
+from kmeans_tpu.parallel.init_sharded import kmeans_parallel_sharded
 from kmeans_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_from_config
 from kmeans_tpu.parallel.preprocess import pca_fit_sharded
 
@@ -30,6 +31,7 @@ __all__ = [
     "fit_minibatch_sharded",
     "fit_spherical_sharded",
     "fit_trimmed_sharded",
+    "kmeans_parallel_sharded",
     "pca_fit_sharded",
     "sharded_assign",
     "cpu_mesh",

@@ -163,11 +163,18 @@ def _cmd_train(args) -> int:
             return 2
         runner_flags = bool(args.progress or args.checkpoint
                             or args.resume or args.profile)
-        if args.update == "delta" and (model != "lloyd" or runner_flags):
+        if args.update == "delta" and model != "lloyd":
             print("error: --update delta (the incremental sweep) runs only "
-                  "in the plain lloyd fit loop; accelerated/spherical/"
-                  "trimmed and the runner (--progress/--checkpoint/"
-                  "--resume/--profile) use the dense reduction",
+                  "in the lloyd family; accelerated/spherical/trimmed use "
+                  "the dense reduction (or --update auto to let the policy "
+                  "decide)", file=sys.stderr)
+            return 2
+        if args.update == "delta" and runner_flags and args.mesh \
+                and args.mesh > 1:
+            print("error: --update delta with runner flags (--progress/"
+                  "--checkpoint/--resume/--profile) runs single-device "
+                  "only; the mesh runner steps the dense reduction — drop "
+                  "--mesh or the runner flags, or use --update auto",
                   file=sys.stderr)
             return 2
 
@@ -601,10 +608,12 @@ def main(argv=None) -> int:
     t.add_argument("--batch-size", type=int, default=None,
                    help="minibatch/stream batch size (default 8192)")
     t.add_argument("--update", default=None,
-                   choices=["matmul", "segment", "delta"],
-                   help="Lloyd centroid-update reduction; 'delta' is the "
-                        "incremental changed-rows-only sweep (single-device "
-                        "and DP-mesh fits)")
+                   choices=["auto", "matmul", "segment", "delta"],
+                   help="Lloyd centroid-update reduction (default auto: the "
+                        "incremental 'delta' sweep wherever its gates pass "
+                        "— single-device and DP-mesh lloyd fits with exact "
+                        "weights — else the dense reduction); explicit "
+                        "'delta' errors where unsupported")
     t.add_argument("--tol", type=float, default=1e-4)
     t.add_argument("--seed", type=int, default=None,
                    help="RNG seed (default 0; leaving it unset lets a "

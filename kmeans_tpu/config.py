@@ -97,12 +97,17 @@ class KMeansConfig:
     #: Matmul input dtype ("bfloat16" | "float32" | None = x.dtype).
     #: Accumulation is always float32.
     compute_dtype: Optional[str] = None
-    #: Centroid-update reduction: "matmul" (one-hot^T @ X on the MXU),
-    #: "segment" (jax.ops.segment_sum scatter-add), or "delta" (incremental:
-    #: the one-hot update runs only over rows whose label changed since the
-    #: previous sweep — ~2x fewer MXU FLOPs at steady-state churn; see
-    #: kmeans_tpu.ops.delta).
-    update: str = "matmul"
+    #: Centroid-update reduction: "auto" (the policy default: the
+    #: incremental "delta" sweep wherever its gates pass — a plain or
+    #: DP-sharded Lloyd fit with exactly-representable weights — else the
+    #: dense "matmul"/"segment" reduction), "matmul" (one-hot^T @ X on the
+    #: MXU), "segment" (jax.ops.segment_sum scatter-add), or "delta"
+    #: (forced incremental: the one-hot update runs only over rows whose
+    #: label changed since the previous sweep — ~2x fewer MXU FLOPs at
+    #: steady-state churn, bit-exact labels; RAISES where unsupported, the
+    #: same strictness contract as backend="pallas"; see
+    #: kmeans_tpu.ops.delta and kmeans_tpu.ops.lloyd.resolve_update).
+    update: str = "auto"
     #: Empty-cluster policy: "keep" (retain old centroid) or "farthest"
     #: (reseed to the currently-worst-fit points).
     empty: str = "keep"
@@ -121,7 +126,7 @@ class KMeansConfig:
             raise ValueError(f"k must be >= 1, got {self.k}")
         if self.init not in ("k-means++", "k-means||", "random", "given"):
             raise ValueError(f"unknown init {self.init!r}")
-        if self.update not in ("matmul", "segment", "delta"):
+        if self.update not in ("auto", "matmul", "segment", "delta"):
             raise ValueError(f"unknown update {self.update!r}")
         if self.empty not in ("keep", "farthest"):
             raise ValueError(f"unknown empty-cluster policy {self.empty!r}")

@@ -23,10 +23,12 @@ from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_inputs
-from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
+from kmeans_tpu.ops.lloyd import (lloyd_pass, resolve_backend,
+                                  resolve_update, weights_exact)
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
-__all__ = ["KMeansState", "fit_lloyd", "KMeans", "best_of_n_init"]
+__all__ = ["KMeansState", "fit_lloyd", "fit_plan", "KMeans",
+           "best_of_n_init"]
 
 
 class KMeansState(NamedTuple):
@@ -187,6 +189,11 @@ def fit_lloyd(
     backend = resolve_backend(
         cfg.backend, x, k, weights=weights, compute_dtype=cfg.compute_dtype,
     )
+    cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
+          else x.dtype)
+    update = resolve_update(
+        cfg.update, w_exact=weights_exact(cd, weights=weights),
+    )
     return _lloyd_loop(
         x,
         centroids0,
@@ -195,10 +202,64 @@ def fit_lloyd(
         max_iter=max_iter if max_iter is not None else cfg.max_iter,
         chunk_size=cfg.chunk_size,
         compute_dtype=cfg.compute_dtype,
-        update=cfg.update,
+        update=update,
         empty=cfg.empty,
         backend=backend,
     )
+
+
+def fit_plan(
+    x,
+    k: int,
+    *,
+    config: Optional[KMeansConfig] = None,
+    weights: Optional[jax.Array] = None,
+) -> dict:
+    """The concrete execution plan a :func:`fit_lloyd` call with these
+    arguments runs — the resolved-policy report the bench prints and the
+    tests assert against (so "the judged number is the shipped path" is a
+    checkable claim, not a README sentence).
+
+    Returns ``{"update", "backend", "delta_backend"}``: the resolved
+    reduction flavor, the resolved classic-sweep backend, and — when
+    ``update == "delta"`` — which backend the delta sweeps themselves run
+    (``"pallas"`` for the fused Mosaic kernel, ``"xla"`` for the
+    gather-based route), mirroring the re-gating :func:`fit_lloyd`'s loop
+    performs at the delta kernel's own VMEM footprint.  Raises exactly
+    where :func:`fit_lloyd` would (explicit unsupported choices).
+    """
+    from kmeans_tpu.ops.delta import delta_pallas_ok
+
+    cfg = (config or KMeansConfig(k=k)).validate()
+    # Metadata only: every resolver consumes shape/dtype/platform, so a
+    # host numpy array must NOT be materialized onto a device (at the
+    # headline shape that would be a ~10 GB transfer for a 3-key dict).
+    if not hasattr(x, "shape") or not hasattr(x, "dtype"):
+        import numpy as _np
+
+        x = _np.asarray(x)
+    cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
+          else x.dtype)
+    w_exact = weights_exact(cd, weights=weights)
+    update = resolve_update(cfg.update, w_exact=w_exact)
+    backend = resolve_backend(
+        cfg.backend, x, k, weights=weights, compute_dtype=cfg.compute_dtype,
+    )
+    delta_backend = None
+    if update == "delta":
+        # Mirror _lloyd_loop's hand-down ("pallas" re-gates as "auto") and
+        # dispatch on THE shared gate (ops.delta.delta_pallas_ok) so this
+        # report cannot drift from what delta_pass actually runs.
+        eff = "auto" if backend == "pallas" else backend
+        if eff == "pallas_interpret":
+            delta_backend = "pallas_interpret"
+        elif eff == "auto" and delta_pallas_ok(
+                x, k, weights=weights, compute_dtype=cfg.compute_dtype):
+            delta_backend = "pallas"
+        else:
+            delta_backend = "xla"
+    return {"update": update, "backend": backend,
+            "delta_backend": delta_backend}
 
 
 def best_of_n_init(fit_one, key, n_init, *, score=lambda s: float(s.inertia)):
@@ -286,7 +347,7 @@ class KMeans(NearestCentroidMixin):
     n_init: int = 1
     chunk_size: int = 4096
     compute_dtype: Optional[str] = None
-    update: str = "matmul"
+    update: str = "auto"
     empty: str = "keep"
     backend: str = "auto"
 

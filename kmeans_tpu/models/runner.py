@@ -25,7 +25,7 @@ import numpy as np
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState
-from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend, resolve_update
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = ["LloydRunner", "IterInfo"]
@@ -75,9 +75,19 @@ class LloydRunner:
         self.centroids: Optional[jax.Array] = None
         self.last_inertia: Optional[float] = None
 
+        # Carried (labels, sums, counts) of the incremental update between
+        # step() calls; None = next sweep must be a full refresh (fresh
+        # runner, post-resume, post-init).
+        self._dstate = None
+
         if mesh is None:
             self.x = jnp.asarray(x)
             cfg = self.cfg
+            # The runner has no sample weights, so w_exact always holds —
+            # "auto" resolves to the incremental delta loop (the same path
+            # fit_lloyd's default takes), carried across step() calls so
+            # the serve train stream runs the headline kernel too.
+            self._update = resolve_update(cfg.update, w_exact=True)
             self._backend = resolve_backend(
                 cfg.backend, self.x, k, compute_dtype=cfg.compute_dtype,
             )
@@ -89,14 +99,44 @@ class LloydRunner:
                     x, c,
                     chunk_size=cfg.chunk_size,
                     compute_dtype=cfg.compute_dtype,
-                    update=cfg.update,
+                    update=self._update,
                     backend=backend,
                 )
                 new_c = apply_update(c, sums, counts)
                 if cfg.empty == "farthest":
                     new_c = reseed_empty_farthest(new_c, counts, x, min_d2)
                 shift_sq = jnp.sum((new_c - c) ** 2)
+                if self._update == "delta":
+                    return new_c, inertia, shift_sq, labels, sums, counts
                 return new_c, inertia, shift_sq
+
+            if self._update == "delta":
+                from kmeans_tpu.ops.delta import default_cap, delta_pass
+
+                dkw = dict(
+                    cap=default_cap(self.x.shape[0]),
+                    chunk_size=cfg.chunk_size,
+                    compute_dtype=cfg.compute_dtype,
+                    # Re-gate at the delta kernel's own VMEM footprint
+                    # (models/lloyd._lloyd_loop does the same).
+                    backend="auto" if backend == "pallas" else backend,
+                    # The runner reports inertia every iteration, so the
+                    # raw-score shortcut is never safe here.
+                    with_mind=True,
+                )
+
+                @jax.jit
+                def step_delta(x, c, lab, sums, counts):
+                    labels, min_d2, sums, counts, inertia, _ = delta_pass(
+                        x, c, lab, sums, counts, **dkw)
+                    new_c = apply_update(c, sums, counts)
+                    if cfg.empty == "farthest":
+                        new_c = reseed_empty_farthest(
+                            new_c, counts, x, min_d2)
+                    shift_sq = jnp.sum((new_c - c) ** 2)
+                    return new_c, inertia, shift_sq, labels, sums, counts
+
+                self._step_delta = step_delta
 
             self._step = step
         else:
@@ -110,6 +150,17 @@ class LloydRunner:
                 _resolve_sharded_backend,
             )
 
+            # The step-wise mesh path runs the dense per-sweep reduction
+            # (stateless shard bodies); the carried-state incremental loop
+            # on a mesh is fit_lloyd_sharded's _build_lloyd_delta_run.
+            if self.cfg.update == "delta":
+                raise ValueError(
+                    "LloydRunner on a mesh runs the dense per-sweep "
+                    "reduction; use fit_lloyd_sharded(update='delta') for "
+                    "the incremental sharded loop, or update='auto'"
+                )
+            self._update = ("matmul" if self.cfg.update == "auto"
+                            else self.cfg.update)
             axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
             xp, w_host, self._n = _pad_rows(np.asarray(x), axis_sizes[data_axis])
             self.x = jax.device_put(xp, NamedSharding(mesh, P(data_axis)))
@@ -127,7 +178,7 @@ class LloydRunner:
                     _dp_local_pass, data_axis=data_axis,
                     chunk_size=self.cfg.chunk_size,
                     compute_dtype=self.cfg.compute_dtype,
-                    update=self.cfg.update, with_labels=False,
+                    update=self._update, with_labels=False,
                     backend=self._backend, empty=self.cfg.empty,
                 )
                 in_specs = (P(data_axis), P(), P(data_axis))
@@ -150,7 +201,7 @@ class LloydRunner:
                     model_axis=model_axis, k_real=k,
                     chunk_size=self.cfg.chunk_size,
                     compute_dtype=self.cfg.compute_dtype,
-                    update=self.cfg.update, with_labels=False,
+                    update=self._update, with_labels=False,
                     empty=self.cfg.empty,
                 )
                 in_specs = (P(data_axis), P(model_axis), P(data_axis))
@@ -170,6 +221,7 @@ class LloydRunner:
 
     # ------------------------------------------------------------------ API
     def init(self, init=None) -> None:
+        self._dstate = None          # carried delta state is init-specific
         if init is not None and not isinstance(init, str):
             self.centroids = jnp.asarray(init, jnp.float32)
         else:
@@ -205,7 +257,24 @@ class LloydRunner:
         converged = False
         for _ in range(max_iter):
             t0 = time.perf_counter()
-            new_c, inertia, shift_sq = self._step(self.x, self.centroids)
+            if self.mesh is None and self._update == "delta":
+                # Incremental loop: full refresh on the first sweep after
+                # (re)init/resume and every DELTA_REFRESH-th iteration
+                # (drift bound, same cadence as fit_lloyd's fused loop),
+                # the carried-state delta sweep otherwise.
+                from kmeans_tpu.ops.delta import DELTA_REFRESH
+
+                if (self._dstate is None
+                        or self.iteration % DELTA_REFRESH == 0):
+                    new_c, inertia, shift_sq, lab, sums, counts = \
+                        self._step(self.x, self.centroids)
+                else:
+                    new_c, inertia, shift_sq, lab, sums, counts = \
+                        self._step_delta(self.x, self.centroids,
+                                         *self._dstate)
+                self._dstate = (lab, sums, counts)
+            else:
+                new_c, inertia, shift_sq = self._step(self.x, self.centroids)
             new_c.block_until_ready()
             dt = time.perf_counter() - t0
             self.centroids = new_c
@@ -279,6 +348,7 @@ class LloydRunner:
 
         state, meta = load_checkpoint(path)
         self.centroids = jnp.asarray(state.centroids, jnp.float32)
+        self._dstate = None          # stale across a process boundary
         self.iteration = int(meta["step"])
         if "key" in meta:
             self.key = meta["key"]

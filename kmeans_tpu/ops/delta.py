@@ -56,7 +56,7 @@ from kmeans_tpu.ops.pallas_lloyd import (accumulate_pallas,
                                          delta_pallas_supported,
                                          lloyd_delta_pallas)
 
-__all__ = ["delta_pass", "default_cap", "DELTA_REFRESH"]
+__all__ = ["delta_pass", "delta_pallas_ok", "default_cap", "DELTA_REFRESH"]
 
 #: Full-reduction refresh period of delta-update loops: one sweep in every
 #: DELTA_REFRESH recomputes sums/counts from scratch, bounding the f32
@@ -65,6 +65,27 @@ __all__ = ["delta_pass", "default_cap", "DELTA_REFRESH"]
 #: single-device and sharded loops must share the cadence or their
 #: trajectories fork.
 DELTA_REFRESH = 16
+
+
+def delta_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,
+                    compute_dtype=None, platform=None) -> bool:
+    """Whether the fused Mosaic delta kernel can serve this sweep — THE one
+    copy of the gate (``delta_pass`` dispatches on it; ``fit_plan`` and the
+    bench report from it, so the evidence cannot drift from the dispatch).
+    The VMEM pricing runs at the DELTA kernel's own footprint
+    (block_rows=1024 plus the resident triangular prefix operand) — an
+    upstream ``resolve_backend`` "pallas" was gated at the classic kernel's
+    512-row estimate and must not be trusted here."""
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    n, d = x.shape
+    return (
+        weights_exact(cd, weights=weights,
+                      weights_are_binary=weights_are_binary)
+        and _platform_of(x, platform) == "tpu"
+        and delta_pallas_supported(n, d, k,
+                                   x_itemsize=x.dtype.itemsize,
+                                   cd_itemsize=cd.itemsize)
+    )
 
 
 def default_cap(n: int) -> int:
@@ -170,10 +191,13 @@ def delta_pass(
         falls back on any tile overflow — ``cap`` is not used there.
       force_full: optional traced bool — True forces the full reduction
         (the fit loop's periodic drift-bounding refresh).
-      with_mind: when False, ``min_d2``/``inertia`` come back as raw
-        scores (no row norm) — for loops that converge on centroid shift
-        and read neither; Pallas route only, saves the (T, d) row-norm
-        pass.
+      with_mind: when False, ``min_d2``/``inertia`` come back as NaN on
+        EVERY backend — for loops that converge on centroid shift and
+        read neither.  On the Pallas route this saves the (T, d) row-norm
+        pass (the kernel ranks raw ``||c||² − 2x·c`` scores); the NaN
+        poisoning (rather than returning the raw scores) keeps the
+        outputs backend-independent: no caller can accidentally consume
+        raw scores as distances (ADVICE r4).
 
     Returns:
       ``(labels, min_d2, sums, counts, inertia, n_changed)`` with the same
@@ -188,18 +212,11 @@ def delta_pass(
 
     # The delta subtract side uses -w: exact for the internal ±1 weights or
     # f32 compute, same policy as the fused kernel's one-hot cast.  The
-    # VMEM gate runs at the DELTA kernel's own footprint (block_rows=1024
-    # plus the resident triangular prefix operand) — an upstream
-    # resolve_backend "pallas" was gated at the classic kernel's 512-row
-    # estimate and must not be trusted here, so the fit loop hands this
-    # function "auto".
-    supported = (
-        weights_exact(cd, weights=weights,
-                      weights_are_binary=weights_are_binary)
-        and _platform_of(x) == "tpu"
-        and delta_pallas_supported(n, d, k,
-                                   x_itemsize=x.dtype.itemsize,
-                                   cd_itemsize=cd.itemsize)
+    # fit loop hands this function "auto" (see delta_pallas_ok: the gate
+    # prices the delta kernel's own VMEM footprint).
+    supported = delta_pallas_ok(
+        x, k, weights=weights, weights_are_binary=weights_are_binary,
+        compute_dtype=compute_dtype,
     )
     if backend == "pallas" and not supported:
         raise ValueError(
@@ -240,6 +257,9 @@ def delta_pass(
             return s, c
 
         sums, counts = lax.cond(pred, incremental, full, None)
+        if not with_mind:
+            min_d2 = jnp.full((n,), jnp.nan, f32)
+            inertia = jnp.asarray(jnp.nan, f32)
         return labels, min_d2, sums, counts, inertia, n_changed
 
     labels, min_d2, _, _, inertia = lloyd_pass(
@@ -281,4 +301,10 @@ def delta_pass(
         return s, c
 
     sums, counts = lax.cond(pred, incremental, full, None)
+    if not with_mind:
+        # Same poisoning as the Pallas route (XLA computes min_d2 as a
+        # by-product; the dead adds are DCE'd) — the flag's contract is
+        # "these outputs are not produced", identically on every backend.
+        min_d2 = jnp.full((n,), jnp.nan, f32)
+        inertia = jnp.asarray(jnp.nan, f32)
     return labels, min_d2, sums, counts, inertia, n_changed

@@ -107,6 +107,59 @@ def resolve_backend(
     return "pallas" if ok else "xla"
 
 
+def resolve_update(
+    update: str,
+    *,
+    w_exact: bool,
+    sharded_axes: bool = False,
+) -> str:
+    """Resolve a config ``update`` flavor for the Lloyd fit doors — THE one
+    copy of the policy (``fit_lloyd`` and ``fit_lloyd_sharded`` both call
+    it, so single-device and sharded fits cannot drift).
+
+    * ``"auto"`` (the config default) picks the incremental ``"delta"``
+      sweep wherever its gates pass — no k/d sharding (the carried
+      labels/sums state is a row-parallel structure) and
+      exactly-representable weights (the signed ±w fold) — and the dense
+      ``"matmul"``/``"segment"`` reduction elsewhere.  The headline bench
+      path is therefore the path every default fit runs.
+    * explicit ``"delta"`` RAISES where unsupported — the same strictness
+      contract as ``backend="pallas"`` (which raises rather than silently
+      demoting) and the CLI's ``--update`` guards.
+    * ``"matmul"`` with inexact weights demotes to the equal-value
+      ``"segment"`` reduction (the long-standing exactness policy of
+      :func:`weights_exact`; both reductions are tested equal, so this is
+      value-preserving, unlike a delta demotion which changes the FLOP
+      contract the caller asked for).
+
+    ``sharded_axes`` is True when centroids are sharded over k (TP) or
+    features over d (FP) — the delta state machine is DP-only.
+    """
+    if update == "auto":
+        if w_exact and not sharded_axes:
+            return "delta"
+        return "matmul" if w_exact else "segment"
+    if update == "delta":
+        if sharded_axes:
+            raise ValueError(
+                "update='delta' carries per-shard (labels, sums, counts) "
+                "state over data-parallel rows; it does not compose with "
+                "model_axis/feature_axis sharding — use update='auto' to "
+                "fall back to the dense reduction"
+            )
+        if not w_exact:
+            raise ValueError(
+                "update='delta' folds changed rows with signed ±w weights, "
+                "exact only for binary weights or float32 compute "
+                "(ops.lloyd.weights_exact); use update='auto' to fall back "
+                "or compute_dtype='float32' to keep delta"
+            )
+        return "delta"
+    if update == "matmul" and not w_exact:
+        return "segment"
+    return update
+
+
 def _pad_to_chunks(x, w, chunk_size):
     n = x.shape[0]
     pad = (-n) % chunk_size
@@ -149,12 +202,12 @@ def lloyd_pass(
     """
     if backend not in ("xla", "pallas", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
-    if update == "delta":
+    if update in ("auto", "delta"):
         # "delta" is a LOOP-level structure (carried labels/sums state in
         # fit_lloyd); a single stateless sweep's reduction is the dense
-        # matmul.  Accepting it here lets every model that forwards
-        # cfg.update (spherical, trimmed, accelerated, runner, ...) run
-        # under a delta-configured KMeansConfig.
+        # matmul.  Accepting it — and the "auto" config default — here
+        # lets every model that forwards cfg.update (spherical, trimmed,
+        # accelerated, runner, ...) run under any KMeansConfig.
         update = "matmul"
     if backend != "xla":
         ok = _pallas_ok(

@@ -40,6 +40,7 @@ from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import (
     lloyd_pass,
     resolve_backend,
+    resolve_update,
     weights_exact as _weights_exact,
 )
 from kmeans_tpu.ops.pallas_lloyd import (
@@ -838,18 +839,16 @@ def fit_lloyd_sharded(
           else jnp.dtype(x.dtype))
     w_exact = _weights_exact(cd, weights=w_host,
                              weights_are_binary=weights_binary)
-    # Fractional weights in a sub-f32 compute dtype: the one-hot MXU update
-    # would quantize them — demote to the exact segment reduction (the
-    # shared single-device policy, ops.lloyd.weights_exact).
-    update = cfg.update
-    if update == "delta" and (model_axis or feature_axis or not w_exact):
-        # The incremental update needs the DP body's carried labels/sums
-        # state and exact signed-fold weights; the TP/FP bodies and
-        # fractional-weight runs use the classic fused reduction — same
-        # results, psum'd per sweep.
-        update = "matmul"
-    if update == "matmul" and not w_exact:
-        update = "segment"
+    # THE shared update policy (ops.lloyd.resolve_update): "auto" picks the
+    # incremental DP delta loop wherever its gates pass, the dense
+    # reduction elsewhere; an explicit "delta" RAISES on TP/FP meshes and
+    # inexact weights (the same strictness contract as backend="pallas");
+    # "matmul" with inexact weights demotes to the equal-value segment
+    # reduction.
+    update = resolve_update(
+        cfg.update, w_exact=w_exact,
+        sharded_axes=bool(model_axis or feature_axis),
+    )
     if model_axis and feature_axis:
         # No Mosaic body for the 3-axis composition (the XLA
         # partial-contraction + two-pmin body is the only lowering): the
@@ -1249,10 +1248,19 @@ def fit_lloyd_accelerated_sharded(
     w_exact = _weights_exact(cd, weights=w_host,
                              weights_are_binary=weights_binary)
     update = cfg.update
-    if update == "delta":
-        # The incremental update is a single-device loop structure (carried
-        # labels/sums state); the sharded engines run the classic fused
-        # reduction — same results, psum'd per sweep.
+    if update in ("auto", "delta"):
+        # The incremental update is a Lloyd loop structure (carried
+        # labels/sums state); the accelerated engine's extrapolated steps
+        # run the classic fused reduction — same per-sweep results.  This
+        # ACCEPTANCE (not a raise) is the stateless-sweep families'
+        # documented contract — one KMeansConfig serves every family
+        # (tests/test_models.py::test_update_delta_config_safe_across_
+        # models pins it; the single-device accelerated/spherical/trimmed
+        # fits behave identically via ops.lloyd.lloyd_pass, and the CLI
+        # rejects an explicit --update delta for these models).  Only the
+        # Lloyd fit doors (fit_lloyd / fit_lloyd_sharded / the runner),
+        # where "delta" names a path that actually exists, raise when it
+        # can't run.
         update = "matmul"
     if update == "matmul" and not w_exact:
         update = "segment"

@@ -113,7 +113,7 @@ def test_carry_forward_never_raises(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "_REPO", str(tmp_path))
     monkeypatch.setattr(
         bench, "_latest_local_record",
-        lambda metric: (_ for _ in ()).throw(RuntimeError("boom")))
+        lambda metric, update_flavor=None: (_ for _ in ()).throw(RuntimeError("boom")))
     line = bench._carry_forward_line(ITERS_METRIC, "iter/s/chip", "err")
     assert line["value"] is None
     assert "boom" in line["carry_forward_error"]

@@ -518,9 +518,11 @@ def test_cli_update_delta_rejected_outside_plain_lloyd(capsys):
     from kmeans_tpu.cli import main
 
     # Families/paths that silently demote delta to the dense reduction
-    # must reject it instead.
+    # must reject it instead.  (The single-device step-wise runner is NOT
+    # in this list since round 5: it carries real delta state —
+    # tests/test_update_auto.py — only the MESH runner rejects.)
     for extra in (["--model", "spherical"], ["--model", "gmm"],
-                  ["--progress"], ["--minibatch"]):
+                  ["--minibatch"], ["--progress", "--mesh", "2"]):
         rc = main(["train", "--n", "500", "--d", "4", "--k", "3",
                    "--update", "delta", *extra])
         assert rc == 2, extra

@@ -179,6 +179,23 @@ def _record_local(line):
         print(f"  could not persist local record: {e}", file=sys.stderr)
 
 
+def _record_input_local(out):
+    """Persist a successful real-data ``--input`` measurement (the
+    README real-data evidence line's source of truth)."""
+    rec = dict(out)
+    rec["timestamp"] = datetime.datetime.now(
+        datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ")
+    rec["note"] = ("auto-recorded by bench.py --input on a successful TPU "
+                   "run; rendered into README by tools/bench_table.py")
+    tmp = os.path.join(_REPO, ".BENCH_INPUT_latest.tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(tmp, os.path.join(_REPO, "BENCH_INPUT_latest.json"))
+    except OSError as e:
+        print(f"  could not persist --input record: {e}", file=sys.stderr)
+
+
 def _record_all_local(rows):
     """Persist the 5-config ``--all`` measurements (table source of truth)."""
     rec = {
@@ -441,48 +458,80 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
 
     if n_dev > 1:
         from kmeans_tpu.parallel import make_mesh
-        from kmeans_tpu.parallel.engine import _dp_local_pass, _pad_rows
-
-        if update == "delta":
-            # The sharded DP loop runs the classic dense reduction (the
-            # incremental state machine is single-device); say so rather
-            # than mislabeling the measurement.
-            update = "full"
-            print("  multi-chip path ignores --update delta; measuring the "
-                  "dense (full) update", file=sys.stderr)
+        from kmeans_tpu.parallel.engine import (_dp_delta_local_pass,
+                                                _dp_local_pass, _pad_rows)
 
         mesh = make_mesh((n_dev, 1), ("data", "model"))
         x, w_host, _ = _pad_rows(x, n_dev)
+        n_pad_rows = x.shape[0]
         x = jax.device_put(x, NamedSharding(mesh, P("data")))
         w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P("data")))
-        local = functools.partial(
-            _dp_local_pass, data_axis="data", chunk_size=chunk_size,
-            compute_dtype="bfloat16", update="matmul", with_labels=False,
-            backend=backend,
-        )
-        step_sm = jax.shard_map(
-            local, mesh=mesh,
-            in_specs=(P("data"), P(), P("data")),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
-        )
-        step = jax.jit(lambda x, c, w: step_sm(x, c, w)[0])
-        args = (w,)
+        if update == "delta":
+            # The DP incremental loop IS the multi-chip production default
+            # (update="auto" resolves to delta on a data-only mesh), so
+            # the headline must measure it: per-shard carried
+            # (labels, sums, counts), one psum per sweep — the same body
+            # fit_lloyd_sharded runs (_build_lloyd_delta_run).
+            local = functools.partial(
+                _dp_delta_local_pass, data_axis="data",
+                chunk_size=chunk_size, compute_dtype="bfloat16",
+                backend=backend, empty="keep", center_update="mean",
+            )
+            step_sm = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P("data"), P(), P("data"), P("data"), P("data"),
+                          P("data"), P()),
+                out_specs=(P(), P("data"), P("data"), P("data")),
+                check_vma=False,
+            )
+
+            @jax.jit
+            def step(x, state, w):
+                c, lab, sums, counts = state
+                new_c, lab, sums, counts = step_sm(
+                    x, c, w, lab, sums, counts, jnp.zeros((), bool))
+                return (new_c, lab, sums, counts)
+
+            sh_rows = NamedSharding(mesh, P("data"))
+            delta_state0 = (
+                c0,
+                jax.device_put(jnp.full((n_pad_rows,), -1, jnp.int32),
+                               sh_rows),
+                jax.device_put(jnp.zeros((n_dev * k, d), jnp.float32),
+                               sh_rows),
+                jax.device_put(jnp.zeros((n_dev * k,), jnp.float32),
+                               sh_rows),
+            )
+            from kmeans_tpu.ops.delta import resolve_delta_backend
+
+            _, backend_ran = resolve_delta_backend(
+                backend, x, k, compute_dtype="bfloat16")
+        else:
+            local = functools.partial(
+                _dp_local_pass, data_axis="data", chunk_size=chunk_size,
+                compute_dtype="bfloat16", update="matmul",
+                with_labels=False, backend=backend,
+            )
+            step_sm = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P("data"), P(), P("data")),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            )
+            step = jax.jit(lambda x, c, w: step_sm(x, c, w)[0])
+            args = (w,)
     elif update == "delta":
-        from kmeans_tpu.ops.delta import (default_cap, delta_pallas_ok,
-                                          delta_pass)
+        from kmeans_tpu.ops.delta import (default_cap, delta_pass,
+                                          resolve_delta_backend)
 
         cap = default_cap(n)
         # What the timed sweeps will actually run: the delta dispatch
-        # re-gates at its own footprint (delta_pallas_ok), so the classic
-        # resolve_backend answer above can over-claim "pallas" on
-        # VMEM-marginal shapes.  Record the true route.
-        eff = "auto" if backend == "pallas" else backend
-        if eff == "auto":
-            backend_ran = ("pallas" if delta_pallas_ok(
-                x, k, compute_dtype="bfloat16") else "xla")
-        else:
-            backend_ran = eff
+        # re-gates at its own footprint (the shared
+        # ops.delta.resolve_delta_backend — the same call fit_plan makes),
+        # so the classic resolve_backend answer above can over-claim
+        # "pallas" on VMEM-marginal shapes.  Record the true route.
+        eff, backend_ran = resolve_delta_backend(
+            backend, x, k, compute_dtype="bfloat16")
 
         @jax.jit
         def step(x, state):
@@ -514,7 +563,21 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
         args = ()
 
     windows = BENCH_WINDOWS    # best-of-N; see the constant's docstring
-    if n_dev <= 1 and update == "delta":
+    if n_dev > 1 and update == "delta":
+        # Sharded state-carrying loop: same two-sweep warm-up rationale as
+        # the single-device delta branch below (sentinel full sweep, then
+        # the first-update reshuffle), then sustained incremental sweeps.
+        state = step(x, delta_state0, w)
+        state = step(x, state, w)
+        jax.block_until_ready(state)
+        dt = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state = step(x, state, w)
+            jax.block_until_ready(state)
+            dt = min(dt, time.perf_counter() - t0)
+    elif n_dev <= 1 and update == "delta":
         # State-carrying loop.  Warm-up runs TWO sweeps: the first is the
         # all-rows-changed full reduction (sentinel labels), the second is
         # the one-time ~78%-churn reshuffle right after the first centroid
@@ -548,11 +611,12 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
             dt = min(dt, time.perf_counter() - t0)
     rate = iters / dt
     bench_lloyd_iters_per_s.last_update = update    # what actually ran
-    # The backend the timed sweeps ACTUALLY ran: the single-device delta
-    # branch re-gates (backend_ran); everything else runs the classic
-    # resolution.
+    # The backend the timed sweeps ACTUALLY ran: the delta branches
+    # re-gate at the delta kernel's footprint (backend_ran, via the
+    # shared ops.delta.resolve_delta_backend); everything else runs the
+    # classic resolution.
     bench_lloyd_iters_per_s.last_backend = (
-        backend_ran if (n_dev <= 1 and update == "delta") else backend)
+        backend_ran if update == "delta" else backend)
     if verbose:
         # Both FLOP conventions, so the peak fraction stays honest: payload
         # = the distance matmul alone (2NdK); classic-equivalent counts the
@@ -885,9 +949,15 @@ def _run_benches(args, metric, unit, fresh=None):
     print(f"platform={dev.platform} devices={n_chips}", file=sys.stderr)
 
     if args.input is not None:
-        return bench_input_file(
+        out = bench_input_file(
             args.input, args.k, iters=args.iters, backend=args.backend,
         )
+        if dev.platform == "tpu" and out.get("value") is not None:
+            # Real-data evidence artifact: README's real-data line is
+            # generated from this file (tools/bench_table.py), same
+            # no-drift contract as the synthetic tables.
+            _record_input_local(out)
+        return out
 
     if args.all:
         from kmeans_tpu.data import BENCH_CONFIGS

@@ -189,8 +189,13 @@ def fit_lloyd(
     backend = resolve_backend(
         cfg.backend, x, k, weights=weights, compute_dtype=cfg.compute_dtype,
     )
+    # Canonicalized dtype: a float64 numpy input actually computes in f32
+    # under jax's default x64-off canonicalization, so the exactness
+    # policy must judge the dtype the arithmetic RUNS in, not the host
+    # container's (raw x.dtype would wrongly fail weights_exact and lose
+    # the delta default / raise on explicit delta).
     cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
-          else x.dtype)
+          else jax.dtypes.canonicalize_dtype(x.dtype))
     update = resolve_update(
         cfg.update, w_exact=weights_exact(cd, weights=weights),
     )
@@ -228,7 +233,7 @@ def fit_plan(
     performs at the delta kernel's own VMEM footprint.  Raises exactly
     where :func:`fit_lloyd` would (explicit unsupported choices).
     """
-    from kmeans_tpu.ops.delta import delta_pallas_ok
+    from kmeans_tpu.ops.delta import resolve_delta_backend
 
     cfg = (config or KMeansConfig(k=k)).validate()
     # Metadata only: every resolver consumes shape/dtype/platform, so a
@@ -239,7 +244,7 @@ def fit_plan(
 
         x = _np.asarray(x)
     cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
-          else x.dtype)
+          else jax.dtypes.canonicalize_dtype(x.dtype))
     w_exact = weights_exact(cd, weights=weights)
     update = resolve_update(cfg.update, w_exact=w_exact)
     backend = resolve_backend(
@@ -247,17 +252,13 @@ def fit_plan(
     )
     delta_backend = None
     if update == "delta":
-        # Mirror _lloyd_loop's hand-down ("pallas" re-gates as "auto") and
-        # dispatch on THE shared gate (ops.delta.delta_pallas_ok) so this
+        # THE shared hand-down + gate (ops.delta.resolve_delta_backend) —
+        # the same call the fit loop / runner / bench make, so this
         # report cannot drift from what delta_pass actually runs.
-        eff = "auto" if backend == "pallas" else backend
-        if eff == "pallas_interpret":
-            delta_backend = "pallas_interpret"
-        elif eff == "auto" and delta_pallas_ok(
-                x, k, weights=weights, compute_dtype=cfg.compute_dtype):
-            delta_backend = "pallas"
-        else:
-            delta_backend = "xla"
+        _, delta_backend = resolve_delta_backend(
+            backend, x, k, weights=weights,
+            compute_dtype=cfg.compute_dtype,
+        )
     return {"update": update, "backend": backend,
             "delta_backend": delta_backend}
 

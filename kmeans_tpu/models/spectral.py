@@ -40,6 +40,35 @@ __all__ = ["SpectralState", "spectral_embedding", "fit_spectral",
            "SpectralClustering"]
 
 
+def landmark_ops(landmarks, *, gamma, degree, coef0, reg):
+    """Landmark-side operators of the Nyström embedding: the f32 landmark
+    matrix, its row norms, and the pseudo-inverse / inverse-sqrt of the
+    (m, m) landmark kernel — THE one copy shared by the single-device
+    embedding and the sharded shard_map embedding
+    (:mod:`kmeans_tpu.parallel.spectral`), so the two cannot drift."""
+    from kmeans_tpu.models.kernel import kernel_tile
+    from kmeans_tpu.ops.distance import sq_norms
+
+    f32 = jnp.float32
+    lf = landmarks.astype(f32)
+    l_sq = sq_norms(lf)
+    w_mm = kernel_tile(lf, lf.T, l_sq, l_sq, kernel="rbf", gamma=gamma,
+                       degree=degree, coef0=coef0, cd=f32)
+    w_mm = 0.5 * (w_mm + w_mm.T)
+    s_mm, u_mm = jnp.linalg.eigh(w_mm)
+    # Relative-cutoff PSEUDO-inverse, not an absolute floor: an rbf Gram
+    # over nearby landmarks is numerically low-rank, and flooring its
+    # junk eigenvalues at a tiny constant AMPLIFIES those directions by
+    # 1/sqrt(floor) in f32 — which drowns the Laplacian's informative
+    # eigenvectors entirely (rings come out unseparated).  Truncation
+    # keeps exactly the numerically supported subspace.
+    cut = reg * jnp.max(s_mm)
+    inv_s = jnp.where(s_mm > cut, 1.0 / jnp.maximum(s_mm, cut), 0.0)
+    w_inv = (u_mm * inv_s[None, :]) @ u_mm.T
+    w_inv_sqrt = (u_mm * jnp.sqrt(inv_s)[None, :]) @ u_mm.T
+    return lf, l_sq, w_inv, w_inv_sqrt
+
+
 class SpectralState(NamedTuple):
     """Result of a spectral fit: cluster labels plus the embedding the
     k-means ran on (useful for plotting / diagnostics)."""
@@ -109,22 +138,8 @@ def spectral_embedding(
         if m < k:
             raise ValueError(f"need at least k={k} landmarks, got {m}")
 
-    lf = landmarks.astype(f32)
-    l_sq = sq_norms(lf)
-    w_mm = kernel_tile(lf, lf.T, l_sq, l_sq, kernel="rbf", gamma=gamma,
-                       degree=degree, coef0=coef0, cd=f32)
-    w_mm = 0.5 * (w_mm + w_mm.T)
-    s_mm, u_mm = jnp.linalg.eigh(w_mm)
-    # Relative-cutoff PSEUDO-inverse, not an absolute floor: an rbf Gram
-    # over nearby landmarks is numerically low-rank, and flooring its
-    # junk eigenvalues at a tiny constant AMPLIFIES those directions by
-    # 1/sqrt(floor) in f32 — which drowns the Laplacian's informative
-    # eigenvectors entirely (rings come out unseparated).  Truncation
-    # keeps exactly the numerically supported subspace.
-    cut = reg * jnp.max(s_mm)
-    inv_s = jnp.where(s_mm > cut, 1.0 / jnp.maximum(s_mm, cut), 0.0)
-    w_inv = (u_mm * inv_s[None, :]) @ u_mm.T
-    w_inv_sqrt = (u_mm * jnp.sqrt(inv_s)[None, :]) @ u_mm.T
+    lf, l_sq, w_inv, w_inv_sqrt = landmark_ops(
+        landmarks, gamma=gamma, degree=degree, coef0=coef0, reg=reg)
 
     # C = K(x, L), chunked; then everything is (n, m) @ (m, m) matmuls.
     xf = x.astype(f32)
@@ -184,28 +199,39 @@ def fit_spectral(
     k-means seeding (fold-in separated), so a fit is reproducible from a
     single seed.
 
-    With ``mesh``, the embedding-space k-means runs through the
-    DP-sharded engine (the embedding itself is chunked (n, m) kernel-tile
-    matmuls + an (m, m) eigh — row-parallel by construction, so the fit
-    is the part that needs the mesh's collectives).
+    With ``mesh``, BOTH stages shard: the embedding runs through the
+    explicit shard_map Nyström implementation
+    (:func:`kmeans_tpu.parallel.spectral.spectral_embedding_sharded` —
+    only landmark-sized data crosses the ICI; the GSPMD lowering of the
+    single-device chunked scan moves full rows, the round-4 init lesson)
+    and the embedding-space k-means rides the DP-sharded engine.  Same
+    key => same landmark draws => the same embedding as single-device up
+    to f32 psum order.
     """
     if key is None:
         key = jax.random.key(config.seed if config is not None else 0)
-    emb = spectral_embedding(
-        x, k, n_landmarks=n_landmarks, gamma=gamma, landmarks=landmarks,
-        key=key,
-        chunk_size=(config.chunk_size if config is not None else 4096),
-        compute_dtype=(config.compute_dtype if config is not None
-                       else None),
-    )
     if mesh is None:
+        emb = spectral_embedding(
+            x, k, n_landmarks=n_landmarks, gamma=gamma,
+            landmarks=landmarks, key=key,
+            chunk_size=(config.chunk_size if config is not None else 4096),
+            compute_dtype=(config.compute_dtype if config is not None
+                           else None),
+        )
         st: KMeansState = fit_lloyd(
             emb, k, key=jax.random.fold_in(key, 1), config=config, tol=tol,
             max_iter=max_iter,
         )
     else:
         from kmeans_tpu.parallel import fit_lloyd_sharded
+        from kmeans_tpu.parallel.spectral import spectral_embedding_sharded
 
+        emb = spectral_embedding_sharded(
+            x, k, mesh=mesh, data_axis=data_axis, n_landmarks=n_landmarks,
+            gamma=gamma, landmarks=landmarks, key=key,
+            compute_dtype=(config.compute_dtype if config is not None
+                           else None),
+        )
         st = fit_lloyd_sharded(
             emb, k, mesh=mesh, data_axis=data_axis,
             key=jax.random.fold_in(key, 1), config=config, tol=tol,

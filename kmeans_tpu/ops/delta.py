@@ -56,7 +56,8 @@ from kmeans_tpu.ops.pallas_lloyd import (accumulate_pallas,
                                          delta_pallas_supported,
                                          lloyd_delta_pallas)
 
-__all__ = ["delta_pass", "delta_pallas_ok", "default_cap", "DELTA_REFRESH"]
+__all__ = ["delta_pass", "delta_pallas_ok", "resolve_delta_backend",
+           "default_cap", "DELTA_REFRESH"]
 
 #: Full-reduction refresh period of delta-update loops: one sweep in every
 #: DELTA_REFRESH recomputes sums/counts from scratch, bounding the f32
@@ -73,19 +74,48 @@ def delta_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,
     copy of the gate (``delta_pass`` dispatches on it; ``fit_plan`` and the
     bench report from it, so the evidence cannot drift from the dispatch).
     The VMEM pricing runs at the DELTA kernel's own footprint
-    (block_rows=1024 plus the resident triangular prefix operand) — an
+    (block_rows=1024 plus the compaction/dense-fold operands) — an
     upstream ``resolve_backend`` "pallas" was gated at the classic kernel's
-    512-row estimate and must not be trusted here."""
-    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    512-row estimate and must not be trusted here.  Dtypes canonicalize
+    (x64-off: a float64 host array computes — and occupies VMEM — as f32),
+    so metadata-only callers like ``fit_plan`` judge the dtype the
+    arithmetic runs in."""
+    from jax.dtypes import canonicalize_dtype
+
+    x_dtype = jnp.dtype(canonicalize_dtype(x.dtype))
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_dtype
     n, d = x.shape
     return (
         weights_exact(cd, weights=weights,
                       weights_are_binary=weights_are_binary)
         and _platform_of(x, platform) == "tpu"
         and delta_pallas_supported(n, d, k,
-                                   x_itemsize=x.dtype.itemsize,
+                                   x_itemsize=x_dtype.itemsize,
                                    cd_itemsize=cd.itemsize)
     )
+
+
+def resolve_delta_backend(backend, x, k: int, *, weights=None,
+                          weights_are_binary=False, compute_dtype=None,
+                          platform=None):
+    """Map a classic-footprint backend resolution onto the delta dispatch —
+    THE one copy of the hand-down idiom (``"pallas"`` was gated at the
+    classic kernel's 512-row estimate, so it re-enters here as ``"auto"``
+    and re-gates at the delta kernel's own footprint).
+
+    Returns ``(effective_request, concrete_route)``: the first is what a
+    caller should pass as :func:`delta_pass`'s ``backend``; the second is
+    the route those sweeps actually run (``"pallas"`` /
+    ``"pallas_interpret"`` / ``"xla"``) — what ``fit_plan`` and the bench
+    report, so prediction and dispatch cannot drift.
+    """
+    eff = "auto" if backend == "pallas" else backend
+    if eff == "pallas_interpret":
+        return eff, "pallas_interpret"
+    ok = delta_pallas_ok(x, k, weights=weights,
+                         weights_are_binary=weights_are_binary,
+                         compute_dtype=compute_dtype, platform=platform)
+    return eff, ("pallas" if (eff in ("auto", "pallas") and ok) else "xla")
 
 
 def default_cap(n: int) -> int:
@@ -188,7 +218,8 @@ def delta_pass(
       cap: static capacity of the changed-rows buffer on the XLA
         (gather-based) route; more churn than this falls back to the full
         reduction.  The Pallas route compacts per kernel tile instead and
-        falls back on any tile overflow — ``cap`` is not used there.
+        a tile over its slot budget folds densely in-kernel, so its delta
+        is always valid — ``cap`` is not used there.
       force_full: optional traced bool — True forces the full reduction
         (the fit loop's periodic drift-bounding refresh).
       with_mind: when False, ``min_d2``/``inertia`` come back as NaN on
@@ -233,30 +264,31 @@ def delta_pass(
 
     if use_pallas:
         # Fused single-sweep kernel: distance + argmin + in-tile matmul
-        # compaction + signed one-hot fold, one HBM read of x.  Its delta
-        # is valid unless any tile overflowed its slot budget (first
-        # sweeps and high-churn sweeps overflow by design).
+        # compaction + signed one-hot fold, one HBM read of x.  The delta
+        # is valid on EVERY sweep — a tile whose churn exceeds the slot
+        # budget folds densely in-kernel (round 5) — so the only full
+        # recompute left is the caller's periodic drift-bounding refresh.
         (labels, min_d2, dsums, dcounts, inertia, n_changed,
-         overflowed) = lloyd_delta_pallas(
+         _dense_tiles) = lloyd_delta_pallas(
             x, centroids, labels_prev, weights=weights,
             compute_dtype=compute_dtype, with_mind=with_mind,
             interpret=interpret,
         )
-        pred = ~overflowed
-        if force_full is not None:
-            pred = pred & ~force_full
 
         def incremental(_):
             return sums_prev + dsums, counts_prev + dcounts
 
-        def full(_):
-            s, c, _ = accumulate_pallas(
-                x, labels, k, weights=w_all, compute_dtype=compute_dtype,
-                interpret=interpret,
-            )
-            return s, c
+        if force_full is None:
+            sums, counts = incremental(None)
+        else:
+            def full(_):
+                s, c, _ = accumulate_pallas(
+                    x, labels, k, weights=w_all,
+                    compute_dtype=compute_dtype, interpret=interpret,
+                )
+                return s, c
 
-        sums, counts = lax.cond(pred, incremental, full, None)
+            sums, counts = lax.cond(~force_full, incremental, full, None)
         if not with_mind:
             min_d2 = jnp.full((n,), jnp.nan, f32)
             inertia = jnp.asarray(jnp.nan, f32)

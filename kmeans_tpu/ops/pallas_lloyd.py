@@ -135,14 +135,15 @@ def pallas_supported(n: int, d: int, k: int, *, block_rows: int = 512,
 
 
 def delta_pallas_supported(n: int, d: int, k: int, *,
-                           block_rows: int = 1024, mc: int = 152,
+                           block_rows: int = 1024, mc: int = 128,
                            x_itemsize: int = 2,
                            cd_itemsize: int = 2) -> bool:
     """VMEM gate for :func:`lloyd_delta_pallas` — the classic estimate
     PLUS the delta kernel's own resident operands: the (T, T) triangular
-    prefix matrix and the (mc, ·) compaction intermediates.  The classic
-    gate alone under-counts by ~5 MiB at the default tile, which matters
-    on small-VMEM generations and VMEM-marginal shapes."""
+    prefix matrix, the (mc, ·) compaction intermediates, and the dense
+    per-tile fallback's (T, k_pad) signed one-hot.  The classic gate
+    alone under-counts by ~5 MiB at the default tile, which matters on
+    small-VMEM generations and VMEM-marginal shapes."""
     d_eff = padded_d(d)
     if not d_eff:
         return False
@@ -152,6 +153,7 @@ def delta_pallas_supported(n: int, d: int, k: int, *,
     est += mc * block_rows * (4 + cd_itemsize)          # p_mat + builds
     est += mc * d_eff * 4                               # x_c gather output
     est += mc * k_pad * (4 + cd_itemsize)               # signed one-hot
+    est += block_rows * k_pad * (4 + cd_itemsize)       # dense-branch fold
     return est <= _vmem_budget()
 
 
@@ -406,11 +408,20 @@ def _delta_kernel(x_ref, w_ref, prev_ref, ct_ref, csq_ref, tri_ref,
        (k, mc) @ (mc, d) matmul; its column sums are the count deltas.
 
     Per tile the extra MXU work is 2·mc·(T + k_pad)·d FLOPs vs the dense
-    fold's 2·T·k_pad·d — a ~3x reduction at mc = 160, T = 1024, k = 1000.
-    A tile with more than ``mc`` changed rows sets the overflow flag and
-    contributes a DROPPED delta — the caller must discard the whole delta
-    and fall back to a full reduction (it does, via lax.cond on the flag;
-    first sweeps and high-churn sweeps land there by design).
+    fold's 2·T·k_pad·d — a ~4x reduction at mc = 128, T = 1024, k = 1000.
+
+    A tile with more than ``mc`` changed rows takes the PER-TILE dense
+    branch instead (round 5): the signed one-hot over ALL T rows —
+    unchanged rows have new == old and contribute exactly zero — folds
+    that tile's delta at the classic dense-fold cost, so the delta output
+    is valid on EVERY sweep and the old whole-delta discard (a second
+    full HBM read of x through the separate accumulation kernel) is gone.
+    First sweeps (sentinel prev) simply run every tile dense: one sweep at
+    classic cost, not two.  This also frees ``mc`` from the mean+5σ churn
+    headroom that forced 152 slots: overflow now costs one tile's dense
+    fold, not a whole extra pass, so mc can sit at the MXU-tile-aligned
+    128 (the (mc, ·) operands pad to the next 128 multiple anyway —
+    mc = 152 paid for 256).
     """
     i = pl.program_id(0)
 
@@ -471,6 +482,12 @@ def _delta_kernel(x_ref, w_ref, prev_ref, ct_ref, csq_ref, tri_ref,
     # first tile"); column 0 of the product is the wanted prefix.  The
     # lower-triangular-ones operand is a resident kernel input: building
     # its (T, T) iota comparison on the VPU every tile costs ~4 us/tile.
+    # (A hierarchical lane-blocked prefix — 1000x fewer FLOPs — was tried
+    # in round 5 and rejected by Mosaic: the (t/128, 128) -> (t,) flatten
+    # is an "unsupported shape cast"; row data lives sublane-major and
+    # the cheap prefix lives lane-major, and no supported relayout
+    # bridges them.  The tri matmul costs ~2 ms/sweep at the north-star
+    # shape — revisit if tpu.reshape ever learns this cast.)
     chf_rep = jnp.broadcast_to(chf.astype(cd)[:, None], (t, _LANE))
     pos_incl = jax.lax.dot_general(
         tri_ref[:], chf_rep,
@@ -488,31 +505,65 @@ def _delta_kernel(x_ref, w_ref, prev_ref, ct_ref, csq_ref, tri_ref,
     # full (n,) changed mask costs ~9 ms at the north-star shape; reading
     # one prefix element per tile costs nothing.
     chc_ref[:] = pos_incl[:, None]
-    pos = jnp.minimum(pos_incl - 1.0, float(mc)).astype(jnp.int32)
-    slot = jax.lax.broadcasted_iota(jnp.int32, (mc, t), 0)
-    p_mat = jnp.where((slot == pos[None, :]) & changed[None, :], 1.0, 0.0)
-    x_c = jnp.dot(p_mat.astype(cd), xb_c,
-                  preferred_element_type=jnp.float32,
-                  precision=matmul_precision(cd))   # (mc, d) exact copies
-    # Compacted per-slot metadata via the same contraction on the VPU
-    # (f32 holds any label < 2^24 exactly; bf16 would not).
-    lab_new = jnp.sum(p_mat * lab.astype(jnp.float32)[None, :],
-                      axis=1).astype(jnp.int32)
-    lab_old = jnp.sum(p_mat * prev.astype(jnp.float32)[None, :],
-                      axis=1).astype(jnp.int32)
-    w_c = jnp.sum(p_mat * w[None, :], axis=1)       # 0 for empty slots
-    cols_k = jax.lax.broadcasted_iota(jnp.int32, (mc, k_pad), 1)
-    signed = (
-        jnp.where(lab_new[:, None] == cols_k, w_c[:, None], 0.0)
-        - jnp.where(lab_old[:, None] == cols_k, w_c[:, None], 0.0)
-    )                                               # (mc, k_pad) in {0,±w}
-    counts_ref[:] += jnp.sum(signed, axis=0, keepdims=True)
-    sums_ref[:] += jax.lax.dot_general(
-        signed.astype(cd), x_c.astype(cd),
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=matmul_precision(cd),
-    )
+    # Per-tile dispatch on the changed count (the prefix's last element —
+    # a vector→scalar reduce is fine in Mosaic; it is the scalar STORE
+    # into a (1, 1) output that trips the layout bug): the compact path
+    # below handles ≤ mc changed rows; a rare high-churn tile folds
+    # densely instead, so the delta output is valid on every sweep.
+    count = jnp.max(pos_incl)
+    fits = count <= float(mc)
+
+    @pl.when(fits)
+    def _compact():
+        pos = jnp.minimum(pos_incl - 1.0, float(mc)).astype(jnp.int32)
+        slot = jax.lax.broadcasted_iota(jnp.int32, (mc, t), 0)
+        p_mat = jnp.where((slot == pos[None, :]) & changed[None, :],
+                          1.0, 0.0)
+        x_c = jnp.dot(p_mat.astype(cd), xb_c,
+                      preferred_element_type=jnp.float32,
+                      precision=matmul_precision(cd))  # (mc, d) exact copies
+        # Compacted per-slot metadata via the same contraction on the VPU
+        # (f32 holds any label < 2^24 exactly; bf16 would not).
+        lab_new = jnp.sum(p_mat * lab.astype(jnp.float32)[None, :],
+                          axis=1).astype(jnp.int32)
+        lab_old = jnp.sum(p_mat * prev.astype(jnp.float32)[None, :],
+                          axis=1).astype(jnp.int32)
+        w_c = jnp.sum(p_mat * w[None, :], axis=1)   # 0 for empty slots
+        cols_k = jax.lax.broadcasted_iota(jnp.int32, (mc, k_pad), 1)
+        signed = (
+            jnp.where(lab_new[:, None] == cols_k, w_c[:, None], 0.0)
+            - jnp.where(lab_old[:, None] == cols_k, w_c[:, None], 0.0)
+        )                                           # (mc, k_pad) in {0,±w}
+        counts_ref[:] += jnp.sum(signed, axis=0, keepdims=True)
+        sums_ref[:] += jax.lax.dot_general(
+            signed.astype(cd), x_c.astype(cd),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(cd),
+        )
+
+    @pl.when(jnp.logical_not(fits))
+    def _dense():
+        # Signed one-hot over ALL T rows: unchanged rows have
+        # new == old, so their +w and -w land on the same column and the
+        # row is exactly zero — the result is the same tile delta the
+        # compact path would produce with unlimited slots, at the classic
+        # dense-fold cost (2·T·k_pad·d), paid only by this tile.
+        # Sentinel prev labels (< 0, first sweep) match no column: the
+        # fold degenerates to +w at the new label — the full reduction.
+        cols_k = jax.lax.broadcasted_iota(jnp.int32, (t, k_pad), 1)
+        wch = w * chf                               # only changed rows fold
+        signed = (
+            jnp.where(lab[:, None] == cols_k, wch[:, None], 0.0)
+            - jnp.where(prev[:, None] == cols_k, wch[:, None], 0.0)
+        )                                           # (T, k_pad) in {0,±w}
+        counts_ref[:] += jnp.sum(signed, axis=0, keepdims=True)
+        sums_ref[:] += jax.lax.dot_general(
+            signed.astype(cd), xb_c,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(cd),
+        )
 
 
 @functools.partial(
@@ -527,7 +578,7 @@ def lloyd_delta_pallas(
     *,
     weights: Optional[jax.Array] = None,
     block_rows: int = 1024,
-    mc: int = 152,
+    mc: int = 128,
     compute_dtype=None,
     interpret: bool = False,
     sub_split: int = 4,
@@ -537,13 +588,17 @@ def lloyd_delta_pallas(
     """Fused incremental Lloyd sweep (see :func:`_delta_kernel`).
 
     Returns ``(labels, min_d2, delta_sums, delta_counts, inertia,
-    n_changed, overflowed)``: ``delta_sums``/``delta_counts`` are the
+    n_changed, dense_tiles)``: ``delta_sums``/``delta_counts`` are the
     exact signed corrections such that ``sums_prev + delta_sums``
-    reproduces the full reduction at the new labels — VALID ONLY when
-    ``overflowed == 0``; on overflow the caller must discard the delta
-    and run a full reduction.  ``labels_prev`` entries outside [0, k)
-    (e.g. the -1 first-sweep sentinel) make every row "changed", which
-    overflows immediately — the intended route to the full branch.
+    reproduces the full reduction at the new labels — valid on EVERY
+    sweep: a tile with more than ``mc`` changed rows folds densely
+    in-kernel (round 5) instead of invalidating the delta.
+    ``dense_tiles`` reports how many tiles took that branch
+    (informational — churn observability, not a validity flag).
+    ``labels_prev`` entries outside [0, k) (e.g. the -1 first-sweep
+    sentinel) make every row "changed": the first sweep simply runs every
+    tile dense, i.e. one sweep at classic cost, and its delta over zero
+    ``sums_prev`` IS the full reduction.
 
     Same exactness caveats as :func:`lloyd_pass_pallas`; the signed fold
     weights (±w) additionally require binary weights or f32 compute, per
@@ -568,6 +623,12 @@ def lloyd_delta_pallas(
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
 
     t = block_rows
+    if t % _LANE:
+        raise ValueError(
+            f"delta kernel block_rows must be a multiple of {_LANE}: the "
+            f"(t, t) triangular prefix operand and the (mc, t) slot "
+            f"comparison tile t along the lane axis; got {t}"
+        )
     if t % sub_split or (t // sub_split) % 8:
         sub_split = 1
     n_pad = _round_up(max(n, 1), t)
@@ -634,19 +695,18 @@ def lloyd_delta_pallas(
     # Per-tile changed counts come off the kernel's own MXU prefix sum
     # (last prefix element per tile) — deriving them in XLA from the full
     # (n,) changed mask costs ~9 ms at the north-star shape; this strided
-    # read of n_chunks elements is free.  The overflow rule mirrors the
-    # kernel's slot clamping EXACTLY: any tile whose changed count exceeds
-    # mc dropped rows, so its delta is invalid and the caller must fall
-    # back to a full reduction.
+    # read of n_chunks elements is free.  The count rule mirrors the
+    # kernel's branch predicate EXACTLY: a tile whose changed count
+    # exceeds mc folded densely in-kernel (delta still valid).
     per_tile = chcount[:, 0].reshape(n_chunks, t)[:, t - 1]
-    overflowed = jnp.any(per_tile > mc)
+    dense_tiles = jnp.sum(per_tile > mc).astype(jnp.int32)
     n_changed = jnp.sum(per_tile).astype(jnp.int32)
 
     labels = labels[:n, 0]
     min_d2 = min_d2[:n, 0]
     inertia = jnp.sum(min_d2 * w[:n])
     return (labels, min_d2, sums[:k, :d_in], counts[0, :k], inertia,
-            n_changed, overflowed)
+            n_changed, dense_tiles)
 
 
 def _acc_kernel(x_ref, w_ref, lab_ref, g_ref,

@@ -17,6 +17,7 @@ from kmeans_tpu.parallel.engine import (
 from kmeans_tpu.parallel.init_sharded import kmeans_parallel_sharded
 from kmeans_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_from_config
 from kmeans_tpu.parallel.preprocess import pca_fit_sharded
+from kmeans_tpu.parallel.spectral import spectral_embedding_sharded
 
 __all__ = [
     "ensure_initialized",
@@ -34,6 +35,7 @@ __all__ = [
     "kmeans_parallel_sharded",
     "pca_fit_sharded",
     "sharded_assign",
+    "spectral_embedding_sharded",
     "cpu_mesh",
     "make_mesh",
     "mesh_from_config",

@@ -835,8 +835,10 @@ def fit_lloyd_sharded(
     # shapes: TP's kernel sees the local k-slice; FP's Ulysses body needs
     # the FULL (k, d) centroids VMEM-resident.
     plat = mesh.devices.flat[0].platform
+    # Canonicalized (x64-off maps float64 hosts arrays to f32 compute) so
+    # the exactness policy judges the dtype the arithmetic runs in.
     cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
-          else jnp.dtype(x.dtype))
+          else jnp.dtype(jax.dtypes.canonicalize_dtype(x.dtype)))
     w_exact = _weights_exact(cd, weights=w_host,
                              weights_are_binary=weights_binary)
     # THE shared update policy (ops.lloyd.resolve_update): "auto" picks the
@@ -1243,8 +1245,10 @@ def fit_lloyd_accelerated_sharded(
         )
     c0 = jax.device_put(c0, NamedSharding(mesh, P()))
 
+    # Canonicalized (x64-off maps float64 hosts arrays to f32 compute) so
+    # the exactness policy judges the dtype the arithmetic runs in.
     cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
-          else jnp.dtype(x.dtype))
+          else jnp.dtype(jax.dtypes.canonicalize_dtype(x.dtype)))
     w_exact = _weights_exact(cd, weights=w_host,
                              weights_are_binary=weights_binary)
     update = cfg.update

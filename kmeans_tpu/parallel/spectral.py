@@ -1,0 +1,147 @@
+"""Explicit shard_map Nyström spectral embedding (SURVEY.md §7 hard part
+(b) discipline, round 5).
+
+The single-device :func:`kmeans_tpu.models.spectral.spectral_embedding`
+is numerically row-parallel, but trusting GSPMD to partition it is not:
+its chunked ``lax.scan`` over row tiles — the same pattern that broke the
+k-means|| init (six full-row all-gathers, ROUND4.md V4) — lowers on a
+row-sharded input to row-scale all-gathers (measured on the 8-device CPU
+mesh: a chunked x gather plus a full (n, m) C gather).  This module is
+the explicit version: every O(n·m) op runs shard-local and only
+LANDMARK-sized data crosses the ICI —
+
+* landmark draw: the same global ``jax.random.choice`` indices as the
+  single-device embedding, gathered once ((m, d) — candidate-sized);
+* degrees: one (m,) ``psum`` of the local Cᵀ·1 partials;
+* the Gram of Z: one (m, m) ``psum``; its eigh runs replicated;
+* the final U = Z V S^{-1/2} and row normalization are row-local.
+
+Sampling parity: the same key draws the same landmark indices as the
+single-device embedding, so the two return identical embeddings up to
+f32 psum ordering (pinned by tests/test_hlo_pins.py; the compiled HLO is
+asserted free of row-scale all-gathers there too).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.models.kernel import kernel_tile, resolve_kernel_params
+from kmeans_tpu.models.spectral import landmark_ops
+from kmeans_tpu.ops.distance import sq_norms
+
+__all__ = ["spectral_embedding_sharded"]
+
+
+def _embed_local(x_loc, w_loc, lf, l_sq, w_inv, w_inv_sqrt,
+                 *, data_axis, k, gamma, degree, coef0, cd):
+    """Shard body: local kernel block -> two landmark-sized collectives ->
+    row-local embedding.  Zero-weight (padding) rows are masked out of
+    both global reductions, so the math over real rows is exactly the
+    single-device embedding's."""
+    f32 = jnp.float32
+    xf = x_loc.astype(f32)
+    valid = (w_loc > 0.0).astype(f32)
+    c_loc = kernel_tile(xf, lf.T, sq_norms(xf), l_sq, kernel="rbf",
+                        gamma=gamma, degree=degree, coef0=coef0, cd=cd)
+
+    # Approximate degrees of K̂ = C W⁻¹ Cᵀ: t = Cᵀ·1 over REAL rows.
+    t = lax.psum(c_loc.T @ valid, data_axis)             # (m,)
+    deg = jnp.maximum(c_loc @ (w_inv @ t), 1e-12)        # (n_loc,)
+    z_loc = (c_loc / jnp.sqrt(deg)[:, None]) @ w_inv_sqrt
+
+    # Gram of Z over real rows; eigh replicated on every shard.
+    zm = z_loc * valid[:, None]
+    g = lax.psum(zm.T @ zm, data_axis)                   # (m, m)
+    g = 0.5 * (g + g.T)
+    s_g, v_g = jnp.linalg.eigh(g)
+    m = g.shape[0]
+    top = jnp.flip(jnp.arange(m - k, m))
+    v_top = v_g[:, top]
+    s_top = jnp.maximum(s_g[top], 1e-12)
+    u_loc = (z_loc @ v_top) / jnp.sqrt(s_top)[None, :]   # (n_loc, k)
+    norms = jnp.sqrt(jnp.maximum(
+        jnp.sum(u_loc * u_loc, axis=1, keepdims=True), 1e-12))
+    return u_loc / norms
+
+
+@functools.lru_cache(maxsize=32)
+def _build_embed(mesh, data_axis, k, gamma, degree, coef0, cd):
+    local = functools.partial(
+        _embed_local, data_axis=data_axis, k=k, gamma=gamma, degree=degree,
+        coef0=coef0, cd=jnp.dtype(cd) if cd is not None else jnp.float32,
+    )
+    sm = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P(data_axis), P(), P(), P(), P()),
+        out_specs=P(data_axis),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+def spectral_embedding_sharded(
+    x,
+    k: int,
+    *,
+    mesh,
+    data_axis: str = "data",
+    n_landmarks: Optional[int] = None,
+    gamma: Optional[float] = None,
+    landmarks: Optional[jax.Array] = None,
+    key: Optional[jax.Array] = None,
+    reg: float = 1e-4,
+    compute_dtype=None,
+):
+    """Row-normalized (n, k) Nyström embedding on a device mesh.
+
+    Same contract (and same draws, for the same ``key``) as
+    :func:`kmeans_tpu.models.spectral.spectral_embedding`; ``x`` may be a
+    host array or already row-sharded.  Returns the embedding stripped to
+    the real row count, laid out over ``data_axis``.
+    """
+    from kmeans_tpu.parallel.engine import pad_and_place
+
+    if not isinstance(x, jax.Array):
+        import numpy as np
+
+        x = np.asarray(x)
+    n, d = x.shape
+    gamma, degree, coef0 = resolve_kernel_params("rbf", gamma, 3, 1.0, d)
+    x, w, n = pad_and_place(x, mesh, data_axis)
+
+    if landmarks is None:
+        m = min(max(n_landmarks or max(256, 2 * k), 1), n)
+        if m < k:
+            raise ValueError(f"n_landmarks must be >= k={k}, got {m}")
+        if key is None:
+            key = jax.random.key(0)
+        # Same global draw as the single-device embedding (indices over
+        # the REAL rows); the (m, d) gather is the candidate-sized
+        # cross-shard movement this module allows.
+        idx = jax.random.choice(key, n, shape=(m,), replace=False)
+        landmarks = x[idx]
+    else:
+        landmarks = jnp.asarray(landmarks)
+        if landmarks.ndim != 2 or landmarks.shape[1] != d:
+            raise ValueError(
+                f"landmarks must be (m, {d}), got {landmarks.shape}")
+        if landmarks.shape[0] < k:
+            raise ValueError(
+                f"need at least k={k} landmarks, got {landmarks.shape[0]}")
+
+    lf, l_sq, w_inv, w_inv_sqrt = landmark_ops(
+        landmarks, gamma=gamma, degree=degree, coef0=coef0, reg=reg)
+    rep = NamedSharding(mesh, P())
+    run = _build_embed(mesh, data_axis, k, gamma, degree, coef0,
+                       compute_dtype)
+    emb = run(x, w,
+              jax.device_put(lf, rep), jax.device_put(l_sq, rep),
+              jax.device_put(w_inv, rep), jax.device_put(w_inv_sqrt, rep))
+    return emb[:n]

@@ -99,10 +99,59 @@ def main():
         np.asarray(bal.col_masses), 1.0 / k, rtol=1e-3
     )
 
+    # Round-4 paths under real jax.distributed (VERDICT r4 item 4): the
+    # incremental update="delta" DP loop carries per-shard (labels, sums,
+    # counts) state across a PROCESS boundary — its per-sweep psum and
+    # the drift-refresh cadence must behave exactly as in-process.
+    # Labels stay shard-local (not addressable cross-host), so parity is
+    # asserted on the replicated outputs: counts are label-derived
+    # (bit-exact labels <=> exact counts), plus inertia and n_iter.
+    from kmeans_tpu.config import KMeansConfig
+
+    d_got = fit_lloyd_sharded(
+        x, k, mesh=mesh, init=c0, tol=1e-10, max_iter=10,
+        config=KMeansConfig(k=k, update="delta"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(d_got.counts), np.asarray(want.counts), rtol=0, atol=0
+    )
+    np.testing.assert_allclose(
+        float(d_got.inertia), float(want.inertia), rtol=1e-5
+    )
+    assert int(d_got.n_iter) == int(want.n_iter)
+
+    # And the explicit sharded k-means|| init: multi-round candidate
+    # gathers (top-ell unions + masked psum winner recovery) across the
+    # process boundary must reproduce the single-device draws exactly
+    # (row-keyed Gumbel noise).
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kmeans_tpu.models.init import kmeans_parallel
+    from kmeans_tpu.parallel.init_sharded import (
+        kmeans_parallel_sharded,
+        sharded_init_applicable,
+    )
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+    assert sharded_init_applicable(xs, 6, mesh=mesh, data_axis="data")
+    ci = kmeans_parallel_sharded(
+        jax.random.key(11), xs, 6, mesh=mesh, data_axis="data",
+        rounds=3, oversampling=16, chunk_size=64,
+    )
+    ci_ref = kmeans_parallel(
+        jax.random.key(11), jnp.asarray(x), 6,
+        rounds=3, oversampling=16, chunk_size=64,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ci), np.asarray(ci_ref), rtol=1e-4, atol=1e-4
+    )
+
     print(f"DCN_OK pid={pid} procs={info['process_count']} "
           f"devices={info['device_count']} inertia={float(got.inertia):.4f} "
           f"gmm_ll={float(gm.log_likelihood):.4f} "
-          f"trim_inertia={float(tr.inertia):.4f}",
+          f"trim_inertia={float(tr.inertia):.4f} "
+          f"delta_iter={int(d_got.n_iter)} init_sharded=ok",
           flush=True)
 
 

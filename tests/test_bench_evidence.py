@@ -60,6 +60,23 @@ def test_artifacts_are_well_formed():
         assert r["backend"] in ("pallas", "xla")
 
 
+def test_bench_multidev_delta_measures_the_delta_loop():
+    """On >1 device the bench must run the DP carried-state delta loop
+    (the multi-chip production default via update='auto'), not silently
+    demote to the dense body (review finding, round 5)."""
+    import jax
+
+    import bench
+
+    assert len(jax.devices()) > 1    # conftest pins the 8-device CPU mesh
+    rate = bench.bench_lloyd_iters_per_s(
+        2048, 32, 6, iters=2, chunk_size=512, verbose=False,
+        backend="xla", update="delta")
+    assert rate > 0
+    assert bench.bench_lloyd_iters_per_s.last_update == "delta"
+    assert bench.bench_lloyd_iters_per_s.last_backend == "xla"
+
+
 def test_headline_table_value_is_artifact_value():
     """The bold headline number in the README IS the artifact value."""
     with open(os.path.join(_REPO, "BENCH_LOCAL_latest.json")) as f:

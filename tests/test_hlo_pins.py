@@ -1,0 +1,214 @@
+"""Compiled-HLO pins for every auto-sharded ("GSPMD-trusted") contraction
+(VERDICT r4 item 3).
+
+Round 4 proved a comment asserting "GSPMD lowers this without row
+movement" can be false (the k-means|| init materialized SIX full-row
+all-gathers).  These tests make every surviving trust site a RED TEST
+instead of a comment: the compiled HLO of each site on the 8-device mesh
+must contain no all-gather larger than its stated budget.
+
+Sites audited:
+* tied-GMM whole-fit run — the once-per-fit global scatter
+  ``(w·x)ᵀ @ x`` (parallel/engine.py `_build_gmm_run`) plus the E/M loop;
+* GMM init moments (`_gmm_init_params` on a sharded x);
+* sharded PCA moments (`parallel/preprocess._build_moments`);
+* bisecting's between-split bookkeeping reductions (weighted mean /
+  masked SSE / masked counts on the sharded x);
+* the explicit shard_map spectral embedding
+  (`parallel/spectral`) — its GSPMD predecessor is ALSO compiled here and
+  REQUIRED to move rows, documenting why the explicit path exists.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.parallel import make_mesh
+
+N, D, K = 4096, 32, 6
+
+
+def _mesh(cpu_devices):
+    return make_mesh((8, 1), ("data", "model"), devices=cpu_devices)
+
+
+def _sharded_xw(mesh):
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        jnp.asarray(rng.normal(size=(N, D)).astype(np.float32)),
+        NamedSharding(mesh, P("data")))
+    w = jax.device_put(jnp.ones((N,), jnp.float32),
+                       NamedSharding(mesh, P("data")))
+    return x, w
+
+
+def _gather_sizes(hlo):
+    """Element counts of every all-gather result in the compiled HLO."""
+    sizes = []
+    for line in hlo.splitlines():
+        if "all-gather(" not in line and "all-gather-start(" not in line:
+            continue
+        m = re.search(r"=\s+\(?([a-z0-9]+)\[([0-9,]*)\]", line)
+        if not m or m.group(1) == "token":
+            continue
+        dims = [int(v) for v in m.group(2).split(",") if v]
+        sizes.append(int(np.prod(dims)) if dims else 1)
+    return sizes
+
+
+def _assert_no_row_gather(hlo, budget, *, what):
+    for size in _gather_sizes(hlo):
+        assert size <= budget, (
+            f"{what}: all-gather of {size} elements exceeds the "
+            f"budget {budget} — rows are crossing the ICI")
+
+
+def test_tied_gmm_run_has_no_row_gather(cpu_devices):
+    """The tied scatter comment (engine.py `_build_gmm_run`) becomes a
+    pin: the WHOLE compiled tied fit moves nothing row-scale."""
+    from kmeans_tpu.parallel.engine import _build_gmm_run, _gmm_init_params
+
+    mesh = _mesh(cpu_devices)
+    x, w = _sharded_xw(mesh)
+    c0 = x[:K]
+    params0 = _gmm_init_params(x, w, c0, jnp.asarray(1e-6, jnp.float32),
+                               covariance_type="tied")
+    run = _build_gmm_run(mesh, "data", 1024, None, "tied", 1e-6, 5)
+    hlo = run.lower(x, w, params0,
+                    jnp.asarray(1e-4, jnp.float32)).compile().as_text()
+    # Legitimate movement: replicated (k, d)/(d, d) parameter updates.
+    _assert_no_row_gather(hlo, max(K * D, D * D), what="tied gmm run")
+
+
+@pytest.mark.parametrize("cov", ["diag", "tied"])
+def test_gmm_init_moments_have_no_row_gather(cpu_devices, cov):
+    from kmeans_tpu.parallel.engine import _gmm_init_params
+
+    mesh = _mesh(cpu_devices)
+    x, w = _sharded_xw(mesh)
+    c0 = x[:K]
+    f = jax.jit(lambda x, w, c: _gmm_init_params(
+        x, w, c, jnp.asarray(1e-6, jnp.float32), covariance_type=cov))
+    hlo = f.lower(x, w, c0).compile().as_text()
+    _assert_no_row_gather(hlo, max(K * D, D * D),
+                          what=f"gmm init moments ({cov})")
+
+
+def test_pca_moments_have_no_row_gather(cpu_devices):
+    from kmeans_tpu.parallel.preprocess import _build_moments
+
+    mesh = _mesh(cpu_devices)
+    x, w = _sharded_xw(mesh)
+    run = _build_moments(mesh, "data", 1024, None)
+    hlo = run.lower(x, w).compile().as_text()
+    _assert_no_row_gather(hlo, D * D, what="pca moments")
+
+
+def test_bisecting_bookkeeping_has_no_row_gather(cpu_devices):
+    """The between-split reductions fit_bisecting runs on the sharded x
+    (weighted mean, masked SSE/count updates) — the exact expressions,
+    compiled over sharded operands."""
+    mesh = _mesh(cpu_devices)
+    x, w = _sharded_xw(mesh)
+    labels = jax.device_put(
+        jnp.zeros((N,), jnp.int32), NamedSharding(mesh, P("data")))
+    mind = jax.device_put(
+        jnp.ones((N,), jnp.float32), NamedSharding(mesh, P("data")))
+
+    def book(x, w, labels, mind):
+        f32 = jnp.float32
+        tot = w.sum()
+        mean0 = (w[:, None] * x.astype(f32)).sum(0) / jnp.where(
+            tot > 0, tot, 1.0)
+        mask_w = jnp.where(labels == 0, w, 0.0)
+        wa = jnp.where(labels == 0, mask_w, 0.0)
+        return (mean0, jnp.sum(wa * mind), jnp.sum(wa),
+                jnp.sum(wa > 0))
+
+    hlo = jax.jit(book).lower(x, w, labels, mind).compile().as_text()
+    _assert_no_row_gather(hlo, D, what="bisecting bookkeeping")
+
+
+def test_sharded_spectral_embedding_has_no_row_gather(cpu_devices):
+    """Only landmark-sized data may move: the (m, d) landmark gather and
+    the (m,)/(m, m) psums.  The GSPMD lowering of the single-device
+    embedding FAILS this budget (measured: a chunked x gather plus a
+    full (n, m) C gather) — which is why the explicit path exists."""
+    from kmeans_tpu.models.spectral import spectral_embedding
+    from kmeans_tpu.parallel.spectral import (_build_embed, landmark_ops,
+                                              resolve_kernel_params)
+
+    mesh = _mesh(cpu_devices)
+    x, w = _sharded_xw(mesh)
+    m = 64
+    gamma, degree, coef0 = resolve_kernel_params("rbf", None, 3, 1.0, D)
+    rng = np.random.default_rng(1)
+    lmk = jnp.asarray(rng.normal(size=(m, D)).astype(np.float32))
+    lf, l_sq, w_inv, w_inv_sqrt = landmark_ops(
+        lmk, gamma=gamma, degree=degree, coef0=coef0, reg=1e-4)
+    rep = NamedSharding(mesh, P())
+    run = _build_embed(mesh, "data", K, gamma, degree, coef0, None)
+    hlo = run.lower(
+        x, w, jax.device_put(lf, rep), jax.device_put(l_sq, rep),
+        jax.device_put(w_inv, rep), jax.device_put(w_inv_sqrt, rep),
+    ).compile().as_text()
+    _assert_no_row_gather(hlo, m * D, what="sharded spectral embedding")
+
+    # The trust-GSPMD route must remain banned: compiling the
+    # single-device embedding over the sharded x DOES move rows — if this
+    # ever starts passing, the explicit path can be retired.
+    f = jax.jit(lambda x: spectral_embedding(
+        x, K, landmarks=lmk, chunk_size=1024))
+    hlo_gspmd = f.lower(x).compile().as_text()
+    assert any(s > m * D for s in _gather_sizes(hlo_gspmd)), (
+        "GSPMD now partitions the single-device embedding without row "
+        "movement — re-evaluate whether parallel/spectral.py is needed")
+
+
+def test_sharded_spectral_embedding_matches_single_device(cpu_devices):
+    """Same key -> same landmark draws -> same embedding (up to f32 psum
+    order and eigh column sign)."""
+    from kmeans_tpu.models.spectral import spectral_embedding
+    from kmeans_tpu.parallel.spectral import spectral_embedding_sharded
+
+    mesh = _mesh(cpu_devices)
+    rng = np.random.default_rng(2)
+    xh = rng.normal(size=(2000, 16)).astype(np.float32)
+
+    want = np.asarray(spectral_embedding(
+        jnp.asarray(xh), 4, n_landmarks=64, key=jax.random.key(5)))
+    got = np.asarray(spectral_embedding_sharded(
+        xh, 4, mesh=mesh, n_landmarks=64, key=jax.random.key(5)))
+    assert got.shape == want.shape
+    # eigh column signs are arbitrary under psum reordering — align.
+    for j in range(want.shape[1]):
+        ref = want[np.argmax(np.abs(want[:, j])), j]
+        cur = got[np.argmax(np.abs(want[:, j])), j]
+        if ref * cur < 0:
+            got[:, j] = -got[:, j]
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+
+def test_fit_spectral_mesh_uses_sharded_embedding(cpu_devices):
+    """End-to-end: the mesh path separates rings, same as single-device."""
+    from kmeans_tpu.models.spectral import fit_spectral
+
+    mesh = _mesh(cpu_devices)
+    rng = np.random.default_rng(3)
+    t1 = rng.uniform(0, 2 * np.pi, 400)
+    t2 = rng.uniform(0, 2 * np.pi, 400)
+    inner = np.stack([np.cos(t1), np.sin(t1)], 1)
+    outer = 3.0 * np.stack([np.cos(t2), np.sin(t2)], 1)
+    x = (np.concatenate([inner, outer])
+         + 0.05 * rng.normal(size=(800, 2))).astype(np.float32)
+    truth = np.concatenate([np.zeros(400), np.ones(400)]).astype(int)
+
+    st = fit_spectral(x, 2, n_landmarks=128, gamma=2.0,
+                      key=jax.random.key(0), mesh=mesh)
+    lab = np.asarray(st.labels)
+    agree = max((lab == truth).mean(), (lab != truth).mean())
+    assert agree > 0.95, agree

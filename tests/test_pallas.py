@@ -178,29 +178,79 @@ def test_delta_kernel_matches_oracle(rng):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_delta_kernel_overflow_and_sentinel(rng):
+def test_delta_kernel_dense_fallback_and_sentinel(rng):
+    """Round 5: a tile over its slot budget folds densely IN-KERNEL, so
+    the delta is exact on every sweep — including the all-changed first
+    sweep, whose delta over zero sums IS the full reduction."""
     from kmeans_tpu.ops.pallas_lloyd import lloyd_delta_pallas
 
     n, d, k = 2000, 128, 30
     x, c = _pair(rng, n, d, k)
     lab_ref = np.asarray(lloyd_pass_pallas(x, c, interpret=True)[0])
 
-    # First sweep: -1 sentinel makes every row changed -> overflow, labels
-    # still exact (the assignment half never depends on the fold).
-    lab, _, _, _, _, m, over = lloyd_delta_pallas(
+    # First sweep: -1 sentinel makes every row changed -> every tile takes
+    # the dense branch; labels exact AND the delta equals the full
+    # reduction (sentinel matches no subtract column).
+    lab, _, ds, dc, _, m, dense = lloyd_delta_pallas(
         x, c, jnp.full((n,), -1, jnp.int32), block_rows=512, mc=64,
         interpret=True)
-    assert bool(over) and int(m) == n
+    assert int(dense) == -(-n // 512) and int(m) == n
     assert (np.asarray(lab) == lab_ref).all()
+    s_full, c_full = _np_sums(x, lab_ref, k)
+    np.testing.assert_allclose(np.asarray(ds), s_full, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dc), c_full, atol=1e-3)
 
-    # A tile with more changes than mc overflows even when the global
-    # count is small: perturb 70 rows inside one 512-row tile.
+    # A tile with more changes than mc folds densely even when the global
+    # count is small — and its delta must still be exact: perturb 70 rows
+    # inside one 512-row tile.
     prev = lab_ref.copy()
     prev[100:170] = (prev[100:170] + 1) % k
-    _, _, _, _, _, m2, over2 = lloyd_delta_pallas(
+    _, _, ds2, dc2, _, m2, dense2 = lloyd_delta_pallas(
         x, c, jnp.asarray(prev.astype(np.int32)), block_rows=512, mc=64,
         interpret=True)
-    assert int(m2) >= 70 and bool(over2)
+    assert int(m2) >= 70 and int(dense2) == 1
+    s_old, c_old = _np_sums(x, prev, k)
+    np.testing.assert_allclose(np.asarray(ds2), s_full - s_old, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dc2), c_full - c_old, atol=1e-3)
+
+
+@pytest.mark.parametrize("churn0", [0, 63, 64, 65, 512])
+def test_delta_kernel_tile_budget_sweep(rng, churn0):
+    """Per-tile churn driven through the mc slot budget (interpret mode):
+    below, at, one past, and far past mc=64 in tile 0, with tile 1 held
+    at moderate churn and zero-weight churn rows composed.  The delta
+    must be exact at EVERY boundary — under-budget tiles via the MXU
+    compaction, over-budget tiles via the in-kernel dense fold — i.e.
+    sums_prev + delta == the full reduction at the new labels
+    (VERDICT r4 item 5)."""
+    from kmeans_tpu.ops.pallas_lloyd import lloyd_delta_pallas
+
+    n, d, k, t, mc = 1024, 128, 16, 512, 64
+    x, c = _pair(rng, n, d, k)
+    w = np.ones((n,), np.float32)
+    w[rng.random(n) < 0.15] = 0.0
+    wj = jnp.asarray(w)
+    lab_ref = np.asarray(lloyd_pass_pallas(
+        x, c, weights=wj, interpret=True)[0])
+
+    prev = lab_ref.copy()
+    live0 = np.flatnonzero((w > 0) & (np.arange(n) < t))[:churn0]
+    prev[live0] = (prev[live0] + 1) % k
+    live1 = np.flatnonzero((w > 0) & (np.arange(n) >= t))[:20]
+    prev[live1] = (prev[live1] + 1) % k
+    dead = np.flatnonzero(w == 0)[:8]      # zero-weight churn: no slots
+    prev[dead] = (prev[dead] + 1) % k
+
+    lab, _, ds, dc, _, m, dense = lloyd_delta_pallas(
+        x, c, jnp.asarray(prev.astype(np.int32)), weights=wj,
+        block_rows=t, mc=mc, interpret=True)
+    assert (np.asarray(lab) == lab_ref).all()
+    assert int(m) == len(live0) + len(live1)
+    assert int(dense) == (1 if churn0 > mc else 0)
+    s_new, c_new = _np_sums(x, lab_ref, k, w)
+    s_old, c_old = _np_sums(x, prev, k, w)
+    np.testing.assert_allclose(np.asarray(ds), s_new - s_old, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dc), c_new - c_old, atol=1e-4)
 
 
 def test_delta_kernel_weights_and_mind_flag(rng):

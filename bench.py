@@ -456,6 +456,11 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
             print(f"  production-default plan (update='auto'): {plan}",
                   file=sys.stderr)
 
+    if n_dev > 1 and update == "hamerly":
+        raise ValueError(
+            "--update hamerly is single-device (no sharded body); run on "
+            "one chip or use delta/full"
+        )
     if n_dev > 1:
         from kmeans_tpu.parallel import make_mesh
         from kmeans_tpu.parallel.engine import (_dp_delta_local_pass,
@@ -520,6 +525,36 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
             )
             step = jax.jit(lambda x, c, w: step_sm(x, c, w)[0])
             args = (w,)
+    elif update == "hamerly":
+        from kmeans_tpu.ops.delta import default_cap
+        from kmeans_tpu.ops.hamerly import (hamerly_pass,
+                                            resolve_hamerly_backend,
+                                            row_norms)
+        from kmeans_tpu.ops.update import apply_update
+
+        rno_h = row_norms(x, compute_dtype="bfloat16")
+        cap = default_cap(n)
+        eff, backend_ran = resolve_hamerly_backend(
+            backend, x, k, compute_dtype="bfloat16")
+
+        @jax.jit
+        def step(x, state):
+            c, lab, sums, counts, sb, slb, c_cd, csq = state
+            lab, sums, counts, sb, slb, c_cd, csq, _ = hamerly_pass(
+                x, c, lab, sums, counts, sb, slb, c_cd, csq, rno_h,
+                cap=cap, chunk_size=chunk_size, compute_dtype="bfloat16",
+                backend=eff)
+            return (apply_update(c, sums, counts), lab, sums, counts, sb,
+                    slb, c_cd, csq)
+
+        state0 = (c0, jnp.full((n,), -1, jnp.int32),
+                  jnp.zeros((k, d), jnp.float32),
+                  jnp.zeros((k,), jnp.float32),
+                  jnp.zeros((n,), jnp.float32),
+                  jnp.zeros((n,), jnp.float32),
+                  c0.astype(jnp.bfloat16),
+                  jnp.zeros((k,), jnp.float32))
+
     elif update == "delta":
         from kmeans_tpu.ops.delta import (default_cap, delta_pass,
                                           resolve_delta_backend)
@@ -577,7 +612,7 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
                 state = step(x, state, w)
             jax.block_until_ready(state)
             dt = min(dt, time.perf_counter() - t0)
-    elif n_dev <= 1 and update == "delta":
+    elif n_dev <= 1 and update in ("delta", "hamerly"):
         # State-carrying loop.  Warm-up runs TWO sweeps: the first is the
         # all-rows-changed full reduction (sentinel labels), the second is
         # the one-time ~78%-churn reshuffle right after the first centroid
@@ -585,8 +620,10 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
         # windows then measure the sustained incremental sweeps (~5-10%
         # churn), which is what the production update="delta" fit loop
         # runs for every iteration past its second.
-        state = (c0, jnp.full((n,), -1, jnp.int32),
-                 jnp.zeros((k, d), jnp.float32), jnp.zeros((k,), jnp.float32))
+        state = (state0 if update == "hamerly" else
+                 (c0, jnp.full((n,), -1, jnp.int32),
+                  jnp.zeros((k, d), jnp.float32),
+                  jnp.zeros((k,), jnp.float32)))
         state = step(x, state)
         state = step(x, state)
         jax.block_until_ready(state)
@@ -616,7 +653,7 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
     # shared ops.delta.resolve_delta_backend); everything else runs the
     # classic resolution.
     bench_lloyd_iters_per_s.last_backend = (
-        backend_ran if update == "delta" else backend)
+        backend_ran if update in ("delta", "hamerly") else backend)
     if verbose:
         # Both FLOP conventions, so the peak fraction stays honest: payload
         # = the distance matmul alone (2NdK); classic-equivalent counts the
@@ -658,7 +695,10 @@ def bench_wallclock_to_converge(n=1_280_000, d=2048, k=1000, *, tol=1e-4,
     x = _make_data(n, d, k_gen=k)
     cfg = KMeansConfig(k=k, chunk_size=chunk_size, compute_dtype="bfloat16",
                        backend=backend, max_iter=max_iter,
-                       update="delta" if update == "delta" else "matmul")
+                       # The bench flavor maps straight onto the fit's
+                       # update (only "full" renames): the converge number
+                       # must measure the path its artifact labels.
+                       update="matmul" if update == "full" else update)
 
     sub = min(n, max(64 * k, 65536))
     xs = x[:sub]  # rows are iid by construction (_make_data)
@@ -860,10 +900,16 @@ def main():
                     choices=("auto", "xla", "pallas"),
                     help="fused-pass backend (auto = pallas on TPU when "
                          "supported)")
-    ap.add_argument("--update", default="delta", choices=("delta", "full"),
+    ap.add_argument("--update", default="delta",
+                    choices=("delta", "full", "hamerly"),
                     help="headline update flavor: incremental (delta, "
-                         "changed rows only) or the classic dense one-hot "
-                         "reduction every sweep")
+                         "changed rows only), the classic dense one-hot "
+                         "reduction every sweep (full), or the "
+                         "bound-pruned exact sweep (hamerly; "
+                         "single-device, win is data-dependent — at the "
+                         "synthetic headline config k=1000 quantizes 64 "
+                         "generator blobs, score gaps are tiny and delta "
+                         "wins)")
     ap.add_argument("--watchdog-s", type=float, default=2700.0,
                     help="whole-run hang backstop: if the benches have not "
                          "finished after this many seconds (tunnel death "

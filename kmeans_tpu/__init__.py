@@ -56,6 +56,7 @@ from kmeans_tpu.models import (
     GMeans,
     XMeans,
     fit_lloyd,
+    fit_plan,
     fit_lloyd_accelerated,
     fit_minibatch,
     fit_spectral,
@@ -93,6 +94,7 @@ __all__ = [
     "GMeans",
     "XMeans",
     "fit_lloyd",
+    "fit_plan",
     "fit_lloyd_accelerated",
     "fit_minibatch",
     "fit_spectral",

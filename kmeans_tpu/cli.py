@@ -163,11 +163,11 @@ def _cmd_train(args) -> int:
             return 2
         runner_flags = bool(args.progress or args.checkpoint
                             or args.resume or args.profile)
-        if args.update == "delta" and model != "lloyd":
-            print("error: --update delta (the incremental sweep) runs only "
-                  "in the lloyd family; accelerated/spherical/trimmed use "
-                  "the dense reduction (or --update auto to let the policy "
-                  "decide)", file=sys.stderr)
+        if args.update in ("delta", "hamerly") and model != "lloyd":
+            print(f"error: --update {args.update} (the incremental sweep) "
+                  "runs only in the lloyd family; accelerated/spherical/"
+                  "trimmed use the dense reduction (or --update auto to "
+                  "let the policy decide)", file=sys.stderr)
             return 2
         if args.update == "delta" and runner_flags and args.mesh \
                 and args.mesh > 1:
@@ -176,6 +176,12 @@ def _cmd_train(args) -> int:
                   "only; the mesh runner steps the dense reduction — drop "
                   "--mesh or the runner flags, or use --update auto",
                   file=sys.stderr)
+            return 2
+        if args.update == "hamerly" and (runner_flags or (
+                args.mesh and args.mesh > 1)):
+            print("error: --update hamerly runs the single-device "
+                  "fit_lloyd loop only (no runner/mesh body); drop those "
+                  "flags or use --update auto", file=sys.stderr)
             return 2
 
     if args.steps is not None and args.steps < 1:
@@ -608,12 +614,16 @@ def main(argv=None) -> int:
     t.add_argument("--batch-size", type=int, default=None,
                    help="minibatch/stream batch size (default 8192)")
     t.add_argument("--update", default=None,
-                   choices=["auto", "matmul", "segment", "delta"],
+                   choices=["auto", "matmul", "segment", "delta",
+                            "hamerly"],
                    help="Lloyd centroid-update reduction (default auto: the "
                         "incremental 'delta' sweep wherever its gates pass "
                         "— single-device and DP-mesh lloyd fits with exact "
-                        "weights — else the dense reduction); explicit "
-                        "'delta' errors where unsupported")
+                        "weights — else the dense reduction); 'hamerly' "
+                        "additionally prunes the distance pass with exact "
+                        "score bounds (single-device lloyd, win is "
+                        "data-dependent); explicit choices error where "
+                        "unsupported")
     t.add_argument("--tol", type=float, default=1e-4)
     t.add_argument("--seed", type=int, default=None,
                    help="RNG seed (default 0; leaving it unset lets a "

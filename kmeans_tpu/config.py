@@ -106,7 +106,13 @@ class KMeansConfig:
     #: label changed since the previous sweep — ~2x fewer MXU FLOPs at
     #: steady-state churn, bit-exact labels; RAISES where unsupported, the
     #: same strictness contract as backend="pallas"; see
-    #: kmeans_tpu.ops.delta and kmeans_tpu.ops.lloyd.resolve_update).
+    #: kmeans_tpu.ops.delta and kmeans_tpu.ops.lloyd.resolve_update), or
+    #: "hamerly" (forced bound-pruned sweeps: rows whose carried score
+    #: bounds prove the argmin unchanged skip the distance matmul too —
+    #: exact labels, but the win is DATA-DEPENDENT: large on naturally
+    #: clustered data where first/second-centroid gaps are wide, absent
+    #: when k far exceeds the natural cluster count; single-device,
+    #: empty="keep" only; see kmeans_tpu.ops.hamerly).
     update: str = "auto"
     #: Empty-cluster policy: "keep" (retain old centroid) or "farthest"
     #: (reseed to the currently-worst-fit points).
@@ -126,7 +132,8 @@ class KMeansConfig:
             raise ValueError(f"k must be >= 1, got {self.k}")
         if self.init not in ("k-means++", "k-means||", "random", "given"):
             raise ValueError(f"unknown init {self.init!r}")
-        if self.update not in ("auto", "matmul", "segment", "delta"):
+        if self.update not in ("auto", "matmul", "segment", "delta",
+                               "hamerly"):
             raise ValueError(f"unknown update {self.update!r}")
         if self.empty not in ("keep", "farthest"):
             raise ValueError(f"unknown empty-cluster policy {self.empty!r}")

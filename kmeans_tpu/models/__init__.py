@@ -37,7 +37,8 @@ from kmeans_tpu.models.kernel import (
     kernel_assign,
     nystrom_features,
 )
-from kmeans_tpu.models.lloyd import KMeans, KMeansState, fit_lloyd
+from kmeans_tpu.models.lloyd import (KMeans, KMeansState, fit_lloyd,
+                                      fit_plan)
 from kmeans_tpu.models.minibatch import MiniBatchKMeans, fit_minibatch
 from kmeans_tpu.models.medoids import KMedoids, KMedoidsState, fit_kmedoids
 from kmeans_tpu.models.gmeans import GMeans, anderson_darling_normal, fit_gmeans
@@ -157,6 +158,7 @@ __all__ = [
     "KMeans",
     "KMeansState",
     "fit_lloyd",
+    "fit_plan",
     "fit_lloyd_accelerated",
     "MiniBatchKMeans",
     "fit_minibatch",

@@ -144,6 +144,61 @@ def _lloyd_loop(
         )
         centroids = lax.while_loop(cond, body, init)
         centroids, n_iter, shift_sq, converged = centroids[:4]
+    elif update == "hamerly":
+        # Bound-pruned exact loop (ops/hamerly): rows whose carried score
+        # bounds prove the argmin unchanged skip even the distance
+        # matmul.  Carries the delta state PLUS (sb, slb) score bounds
+        # and the previous sweep's centroid representation; the same
+        # sentinel-reset refresh cadence bounds f32 drift (a sentinel
+        # sweep recomputes every row and its delta over zero sums IS the
+        # full reduction).
+        from kmeans_tpu.ops.delta import DELTA_REFRESH, default_cap
+        from kmeans_tpu.ops.hamerly import hamerly_pass, row_norms
+
+        n, d = x.shape
+        k = centroids0.shape[0]
+        f32 = jnp.float32
+        cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+              else x.dtype)
+        rno = row_norms(x, compute_dtype=compute_dtype)   # static per fit
+        hkw = dict(
+            weights=weights, cap=default_cap(n), chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            backend="auto" if backend == "pallas" else backend,
+        )
+
+        def cond(s):
+            return (s[1] < max_iter) & ~s[3]
+
+        def body(s):
+            (c, it, _, _, lab, sums, counts, sb, slb, c_cd, csq) = s
+            refresh = (it % DELTA_REFRESH) == 0
+            lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
+            sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
+            counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
+            (lab, sums, counts, sb, slb, c_cd, csq, _) = hamerly_pass(
+                x, c, lab_e, sums_e, counts_e, sb, slb, c_cd, csq, rno,
+                **hkw)
+            new_c = apply_update(c, sums, counts)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol, lab, sums,
+                    counts, sb, slb, c_cd, csq)
+
+        init = (
+            centroids0.astype(f32),
+            jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, f32),
+            jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((k, d), f32),
+            jnp.zeros((k,), f32),
+            jnp.zeros((n,), f32),          # sb (sentinel sweep overwrites)
+            jnp.zeros((n,), f32),          # slb
+            centroids0.astype(cd),
+            jnp.zeros((k,), f32),          # csq_prev (unused on sentinel)
+        )
+        centroids = lax.while_loop(cond, body, init)
+        centroids, n_iter, shift_sq, converged = centroids[:4]
     else:
         def cond(s):
             c, it, shift_sq, done = s
@@ -199,6 +254,12 @@ def fit_lloyd(
     update = resolve_update(
         cfg.update, w_exact=weights_exact(cd, weights=weights),
     )
+    if update == "hamerly" and cfg.empty == "farthest":
+        raise ValueError(
+            "update='hamerly' prunes rows from the distance pass, so no "
+            "per-sweep min_d2 exists for the farthest-reseed policy; use "
+            "empty='keep' or update='auto'/'delta'"
+        )
     return _lloyd_loop(
         x,
         centroids0,
@@ -256,6 +317,19 @@ def fit_plan(
         # the same call the fit loop / runner / bench make, so this
         # report cannot drift from what delta_pass actually runs.
         _, delta_backend = resolve_delta_backend(
+            backend, x, k, weights=weights,
+            compute_dtype=cfg.compute_dtype,
+        )
+    elif update == "hamerly":
+        from kmeans_tpu.ops.hamerly import resolve_hamerly_backend
+
+        if cfg.empty == "farthest":
+            raise ValueError(
+                "update='hamerly' prunes rows from the distance pass, so "
+                "no per-sweep min_d2 exists for the farthest-reseed "
+                "policy; use empty='keep' or update='auto'/'delta'"
+            )
+        _, delta_backend = resolve_hamerly_backend(
             backend, x, k, weights=weights,
             compute_dtype=cfg.compute_dtype,
         )

@@ -88,6 +88,12 @@ class LloydRunner:
             # fit_lloyd's default takes), carried across step() calls so
             # the serve train stream runs the headline kernel too.
             self._update = resolve_update(cfg.update, w_exact=True)
+            if self._update == "hamerly":
+                raise ValueError(
+                    "LloydRunner steps the delta or dense loops; the "
+                    "bound-pruned hamerly loop runs through fit_lloyd "
+                    "(use update='auto' or 'delta' here)"
+                )
             self._backend = resolve_backend(
                 cfg.backend, self.x, k, compute_dtype=cfg.compute_dtype,
             )
@@ -153,7 +159,7 @@ class LloydRunner:
             # The step-wise mesh path runs the dense per-sweep reduction
             # (stateless shard bodies); the carried-state incremental loop
             # on a mesh is fit_lloyd_sharded's _build_lloyd_delta_run.
-            if self.cfg.update == "delta":
+            if self.cfg.update in ("delta", "hamerly"):
                 raise ValueError(
                     "LloydRunner on a mesh runs the dense per-sweep "
                     "reduction; use fit_lloyd_sharded(update='delta') for "

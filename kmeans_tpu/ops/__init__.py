@@ -2,7 +2,8 @@
 
 from kmeans_tpu.ops.delta import delta_pass
 from kmeans_tpu.ops.distance import assign, pairwise_sq_dists, sq_norms
-from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.hamerly import hamerly_pass
+from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_update
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = [
@@ -11,6 +12,8 @@ __all__ = [
     "sq_norms",
     "lloyd_pass",
     "delta_pass",
+    "hamerly_pass",
+    "resolve_update",
     "apply_update",
     "reseed_empty_farthest",
 ]

@@ -1,0 +1,323 @@
+"""Hamerly-pruned exact Lloyd sweep: skip the distance matmul for rows
+whose score bounds prove the argmin unchanged.
+
+The delta update (:mod:`kmeans_tpu.ops.delta`, round 4) removed the
+UPDATE matmul's n-dependence; the distance matmul — 2·n·d·k every sweep —
+remained, and its roofline caps the delta loop at ~38 iter/s at the
+north-star config.  This module removes most of the DISTANCE work too,
+with the classic two-bound pruning of Hamerly ("Making k-means even
+faster", SDM 2010), re-derived for the kernel's actual ranking function
+so labels stay bit-for-bit exact:
+
+The kernels rank rows by the computed score
+
+    s(r, c) = ||c||²_f32 − 2·dot_f32(x_r, bf16(c))
+
+(argmin_c s == argmin_c ||x_r − c||²; the row norm is a per-row constant).
+Carried per row: ``sb`` ≥ s(r, a_r) (upper bound on the assigned
+centroid's score) and ``slb`` ≤ min_{c≠a_r} s(r, c) (lower bound on the
+runner-up), plus the static row norms R_r = ||x_r||₂.  When centroids
+move c→c', the score moves by EXACTLY
+
+    s'(r, c) − s(r, c) = Δ_c − 2·⟨x_r, bf16(c') − bf16(c)⟩ + η
+
+with Δ_c = ||c'||²_f32 − ||c||²_f32 known, the inner product bounded via
+Cauchy-Schwarz by R_r·δ_c where δ_c = ||bf16(c') − bf16(c)||₂ is computed
+on the SAME bf16-rounded values the MXU dots against (so no rounding gap
+enters the inequality), and |η| the f32 dot-accumulation difference,
+bounded by 2·γ_d·R_r·max_c||c|| with γ_d ≈ d·2⁻²⁴.  Therefore
+
+    sb'  = sb  + Δ_{a_r} + 2·R_r·δ_{a_r}          (still an upper bound)
+    slb' = slb + min_c Δ_c − 2·R_r·max_c δ_c       (still a lower bound)
+
+and a row may SKIP recomputation whenever ``sb' + margin_r < slb'`` with
+``margin_r = HAMERLY_MARGIN_REL·(R_r·max_c||c|| + 1)`` — two orders of
+magnitude above the η bound, still orders below real score gaps.  Skipped
+rows provably keep their argmin under the exact arithmetic the kernel
+runs, so the trajectory equals the dense path's bit-for-bit (tested,
+including adversarial near-tie data where the margins force recomputes
+rather than permit errors).
+
+Exactness scope (the same contract the delta path carries): each sweep's
+labels are bit-exact GIVEN identical carried centroids, and fits match
+the dense path through convergence.  In a fit that never converges (a
+bf16 limit cycle, e.g. an unreachable tol), the incremental paths'
+centroids differ from the dense path's in f32 accumulation order, and
+near-tie rows may flip — measured on a 100-iteration limit cycle:
+delta diverges from matmul by ~4% of labels and hamerly by the same
+~4%, with identical inertia; at any tol the fit can actually reach,
+parity is exact (tests).
+
+Rows that fail the test recompute through
+:func:`kmeans_tpu.ops.pallas_lloyd.lloyd_hamerly_pallas` (TPU: in-tile
+MXU compaction, distances only on the compacted block) or the gathered
+XLA route below, refreshing their bounds with exact (best, second-best)
+scores; the centroid update folds the recomputed rows' signed one-hot
+directly from the same compacted block (the delta machinery).  At steady
+state centroid movement → 0, the recompute fraction collapses toward the
+label churn, and the sweep cost approaches the HBM floor (one read of x)
+instead of the MXU distance roofline.
+
+The reference has no analog (its assignment is human drag-and-drop,
+/root/reference/app.mjs:358-372); north-star numeric engine work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.ops.distance import matmul_precision, sq_norms
+from kmeans_tpu.ops.lloyd import _platform_of, weights_exact
+from kmeans_tpu.ops.pallas_lloyd import (hamerly_pallas_supported,
+                                         lloyd_hamerly_pallas, padded_d)
+
+__all__ = ["hamerly_pass", "hamerly_pallas_ok", "resolve_hamerly_backend",
+           "row_norms", "HAMERLY_MARGIN_REL"]
+
+#: Relative soundness margin over the f32 dot-accumulation error bound
+#: (γ_d ≈ d·2⁻²⁴ ≈ 1.2e-4 at d=2048; the bound enters twice per dot and
+#: twice per comparison, ~5e-4 worst-case).  1e-3 is ~2x that worst case;
+#: score gaps it must stay below are typically 1e3-1e4x larger.
+HAMERLY_MARGIN_REL = 1e-3
+
+
+#: Multiplicative inflation of the norms entering the Cauchy-Schwarz
+#: drift bound: covers the f32 rounding of the norm computations
+#: themselves (soundness requires OVER-estimates; relative f32 error of a
+#: d-term sum-of-squares is ~d·2⁻²⁴ ≈ 1.2e-4 at d=2048).
+_NORM_INFLATE = 1.0 + 1e-3
+
+
+def row_norms(x, *, compute_dtype=None, chunk_size: int = 65536) -> jax.Array:
+    """(n,) float32 upper bounds on ||x_r||₂ AS THE KERNEL SEES THE ROWS —
+    i.e. norms of ``x`` cast to ``compute_dtype`` (the MXU dots the cast
+    values; a norm of the f32 originals can UNDER-estimate the cast row's
+    norm by ~2⁻⁹ relative, which unsoundly tightens the drift bound), then
+    inflated by the f32 computation slack.  Chunked so no (n, d) f32
+    intermediate ever materializes (at the headline shape that
+    intermediate is ~10 GB).  One-time cost per fit; x is static."""
+    n, d = x.shape
+    cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+          else x.dtype)
+    pad = (-n) % chunk_size
+    xp = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)]) if pad else x
+
+    def body(_, xb):
+        xf = xb.astype(cd).astype(jnp.float32)
+        return None, jnp.sqrt(jnp.sum(xf * xf, axis=1))
+
+    _, out = lax.scan(body, None,
+                      xp.reshape(-1, chunk_size, d))
+    return out.reshape(-1)[:n] * _NORM_INFLATE
+
+
+def hamerly_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,
+                      compute_dtype=None, platform=None) -> bool:
+    """Dispatch gate for the fused Mosaic Hamerly kernel — THE one copy
+    (mirrors :func:`kmeans_tpu.ops.delta.delta_pallas_ok`)."""
+    from jax.dtypes import canonicalize_dtype
+
+    x_dtype = jnp.dtype(canonicalize_dtype(x.dtype))
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_dtype
+    n, d = x.shape
+    return (
+        weights_exact(cd, weights=weights,
+                      weights_are_binary=weights_are_binary)
+        and _platform_of(x, platform) == "tpu"
+        and hamerly_pallas_supported(n, d, k,
+                                     x_itemsize=x_dtype.itemsize,
+                                     cd_itemsize=cd.itemsize)
+    )
+
+
+def resolve_hamerly_backend(backend, x, k: int, *, weights=None,
+                            weights_are_binary=False, compute_dtype=None,
+                            platform=None):
+    """(effective_request, concrete_route) for the hamerly dispatch — THE
+    one copy (mirrors :func:`kmeans_tpu.ops.delta.resolve_delta_backend`):
+    ``fit_plan`` and the bench report from it, so prediction cannot drift
+    from :func:`hamerly_pass`'s dispatch."""
+    eff = "auto" if backend == "pallas" else backend
+    if eff == "pallas_interpret":
+        return eff, "pallas_interpret"
+    ok = hamerly_pallas_ok(x, k, weights=weights,
+                           weights_are_binary=weights_are_binary,
+                           compute_dtype=compute_dtype, platform=platform)
+    return eff, ("pallas" if (eff in ("auto", "pallas") and ok) else "xla")
+
+
+def _scores_chunked(x, centroids, csq, *, chunk_size, compute_dtype):
+    """(labels, best, second) computed scores per row, chunked — the XLA
+    route's scoring pass (and the oracle the kernel is tested against)."""
+    n, d = x.shape
+    k = centroids.shape[0]
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    ct = centroids.astype(cd).T
+    pad = (-n) % chunk_size
+    xp = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)]) if pad else x
+
+    def body(_, xb):
+        prod = jnp.matmul(xb.astype(cd), ct, preferred_element_type=f32,
+                          precision=matmul_precision(cd))
+        part = csq[None, :] - 2.0 * prod
+        m1 = jnp.min(part, axis=1)
+        cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+        labels = jnp.min(
+            jnp.where(part <= m1[:, None], cols, k), axis=1
+        ).astype(jnp.int32)
+        m2 = jnp.min(jnp.where(cols == labels[:, None], jnp.inf, part),
+                     axis=1)
+        return None, (labels, m1, m2)
+
+    _, (lab, m1, m2) = lax.scan(body, None,
+                                xp.reshape(-1, chunk_size, d))
+    return (lab.reshape(-1)[:n], m1.reshape(-1)[:n], m2.reshape(-1)[:n])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cap", "chunk_size", "compute_dtype", "backend",
+                     "weights_are_binary"),
+)
+def hamerly_pass(
+    x: jax.Array,
+    centroids: jax.Array,
+    labels_prev: jax.Array,
+    sums_prev: jax.Array,
+    counts_prev: jax.Array,
+    sb: jax.Array,
+    slb: jax.Array,
+    c_prev_cd: jax.Array,
+    csq_prev: jax.Array,
+    rno: jax.Array,
+    *,
+    weights: Optional[jax.Array] = None,
+    cap: int,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+    backend: str = "xla",
+    weights_are_binary: bool = False,
+) -> Tuple[jax.Array, ...]:
+    """One Hamerly-pruned Lloyd sweep.
+
+    Args mirror :func:`kmeans_tpu.ops.delta.delta_pass` plus the pruning
+    state: ``sb``/``slb`` the carried score bounds, ``c_prev_cd`` the
+    PREVIOUS sweep's centroids in the compute dtype (what the kernel
+    dotted against — drift is measured on these values so no rounding gap
+    enters the bound), ``csq_prev`` their f32 squared norms, ``rno`` the
+    static row norms (:func:`row_norms`).  A refresh sweep is requested
+    exactly as in the delta loop: sentinel ``labels_prev = -1`` with zero
+    ``sums_prev`` — sentinels force recomputation of every row, and the
+    signed fold over a sentinel IS the full reduction.
+
+    Returns ``(labels, sums, counts, sb', slb', c_cd, csq, n_recomputed)``
+    where ``c_cd``/``csq`` are THIS sweep's centroid representations, to
+    be carried as the next sweep's ``c_prev_cd``/``csq_prev``.
+    """
+    n, d = x.shape
+    k = centroids.shape[0]
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    c_cd = centroids.astype(cd)
+    c_cd_f32 = c_cd.astype(f32)
+    csq = sq_norms(centroids)
+    cprev_f32 = c_prev_cd.astype(f32)
+    # Inflated: δ must OVER-estimate ||Δc|| (f32 norm rounding slack).
+    delta_c = jnp.sqrt(jnp.maximum(
+        jnp.sum((c_cd_f32 - cprev_f32) ** 2, axis=1),
+        0.0)) * _NORM_INFLATE                                     # (k,)
+    big_d = csq - csq_prev                                        # (k,)
+    cmax = jnp.sqrt(jnp.maximum(jnp.max(csq), 0.0))
+
+    sentinel = labels_prev < 0
+    lab_safe = jnp.clip(labels_prev, 0, k - 1)
+    sb2 = sb + big_d[lab_safe] + 2.0 * rno * delta_c[lab_safe]
+    slb2 = slb + jnp.min(big_d) - 2.0 * rno * jnp.max(delta_c)
+    margin = HAMERLY_MARGIN_REL * (rno * cmax + 1.0)
+    w_all = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    need = (sb2 + margin >= slb2) | sentinel
+
+    use_pallas = False
+    if backend != "xla":
+        ok = hamerly_pallas_ok(
+            x, k, weights=weights, weights_are_binary=weights_are_binary,
+            compute_dtype=compute_dtype,
+        )
+        if backend == "pallas" and not ok:
+            raise ValueError(
+                "pallas hamerly pass unsupported here (needs TPU-shaped "
+                "VMEM at block_rows=1024, lane-alignable d, and binary "
+                "weights unless f32); use backend='auto' to fall back"
+            )
+        use_pallas = ok or backend == "pallas_interpret"
+
+    if use_pallas:
+        (labels, sb3, slb3, dsums, dcounts, n_rec, _dense) = \
+            lloyd_hamerly_pallas(
+                x, centroids, labels_prev, need, sb2, slb2,
+                weights=weights, compute_dtype=compute_dtype,
+                interpret=(backend == "pallas_interpret"),
+            )
+        sums = sums_prev + dsums
+        counts = counts_prev + dcounts
+        return (labels, sums, counts, sb3, slb3, c_cd, csq, n_rec)
+
+    # ---- XLA route: gather the needed rows, score them, scatter back.
+    n_rec = jnp.sum(need).astype(jnp.int32)
+    pred = n_rec <= cap
+
+    def incremental(_):
+        idx = jnp.nonzero(need, size=cap, fill_value=n)[0]
+        valid = idx < n
+        safe = jnp.where(valid, idx, 0)
+        rows = x[safe]
+        lab_r, m1_r, m2_r = _scores_chunked(
+            rows, centroids, csq, chunk_size=min(chunk_size, cap),
+            compute_dtype=compute_dtype)
+        lab_old_r = jnp.where(valid, labels_prev[safe], 0)
+        w_r = jnp.where(valid, w_all[safe], 0.0)
+        # Signed fold over CHANGED recomputed rows only (pre-zeroing the
+        # weight keeps unchanged rows' +w/-w from inexact cancellation).
+        ch = (lab_r != lab_old_r) & valid
+        wg = jnp.where(ch, w_r, 0.0)
+        lab_new_f = jnp.where(ch, lab_r, -1)
+        lab_old_f = jnp.where(ch & (lab_old_r >= 0), lab_old_r, -1)
+        from kmeans_tpu.ops.delta import _accumulate_xla
+
+        ds, dc = _accumulate_xla(
+            rows, lab_new_f, wg, lab_old_f, -wg, k,
+            chunk_size=min(chunk_size, cap), compute_dtype=compute_dtype)
+        # Scatter with the UNCLAMPED indices + mode="drop": a clamped
+        # fill slot would collide with a legitimate write at row 0.
+        labels = labels_prev.at[idx].set(lab_r, mode="drop")
+        sb_o = sb2.at[idx].set(m1_r, mode="drop")
+        slb_o = slb2.at[idx].set(m2_r, mode="drop")
+        return labels, sums_prev + ds, counts_prev + dc, sb_o, slb_o
+
+    def full(_):
+        lab_f, m1_f, m2_f = _scores_chunked(
+            x, centroids, csq, chunk_size=chunk_size,
+            compute_dtype=compute_dtype)
+        labels = jnp.where(need, lab_f, labels_prev)
+        sb_o = jnp.where(need, m1_f, sb2)
+        slb_o = jnp.where(need, m2_f, slb2)
+        ch = (labels != labels_prev) & (w_all > 0.0)
+        wg = jnp.where(ch, w_all, 0.0)
+        from kmeans_tpu.ops.delta import _accumulate_xla
+
+        ds, dc = _accumulate_xla(
+            x, jnp.where(ch, labels, -1), wg,
+            jnp.where(ch & (labels_prev >= 0), labels_prev, -1), -wg, k,
+            chunk_size=chunk_size, compute_dtype=compute_dtype)
+        return labels, sums_prev + ds, counts_prev + dc, sb_o, slb_o
+
+    labels, sums, counts, sb3, slb3 = lax.cond(pred, incremental, full,
+                                               None)
+    return (labels, sums, counts, sb3, slb3, c_cd, csq, n_rec)

@@ -139,22 +139,21 @@ def resolve_update(
         if w_exact and not sharded_axes:
             return "delta"
         return "matmul" if w_exact else "segment"
-    if update == "delta":
+    if update in ("delta", "hamerly"):
         if sharded_axes:
             raise ValueError(
-                "update='delta' carries per-shard (labels, sums, counts) "
-                "state over data-parallel rows; it does not compose with "
-                "model_axis/feature_axis sharding — use update='auto' to "
-                "fall back to the dense reduction"
+                f"update={update!r} carries per-shard row state; it does "
+                "not compose with model_axis/feature_axis sharding — use "
+                "update='auto' to fall back to the dense reduction"
             )
         if not w_exact:
             raise ValueError(
-                "update='delta' folds changed rows with signed ±w weights, "
-                "exact only for binary weights or float32 compute "
-                "(ops.lloyd.weights_exact); use update='auto' to fall back "
-                "or compute_dtype='float32' to keep delta"
+                f"update={update!r} folds changed rows with signed ±w "
+                "weights, exact only for binary weights or float32 "
+                "compute (ops.lloyd.weights_exact); use update='auto' to "
+                "fall back or compute_dtype='float32' to keep it"
             )
-        return "delta"
+        return update
     if update == "matmul" and not w_exact:
         return "segment"
     return update
@@ -202,12 +201,12 @@ def lloyd_pass(
     """
     if backend not in ("xla", "pallas", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
-    if update in ("auto", "delta"):
-        # "delta" is a LOOP-level structure (carried labels/sums state in
-        # fit_lloyd); a single stateless sweep's reduction is the dense
-        # matmul.  Accepting it — and the "auto" config default — here
-        # lets every model that forwards cfg.update (spherical, trimmed,
-        # accelerated, runner, ...) run under any KMeansConfig.
+    if update in ("auto", "delta", "hamerly"):
+        # "delta"/"hamerly" are LOOP-level structures (carried row state
+        # in fit_lloyd); a single stateless sweep's reduction is the
+        # dense matmul.  Accepting them — and the "auto" config default —
+        # here lets every model that forwards cfg.update (spherical,
+        # trimmed, accelerated, runner, ...) run under any KMeansConfig.
         update = "matmul"
     if backend != "xla":
         ok = _pallas_ok(

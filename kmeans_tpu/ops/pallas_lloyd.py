@@ -39,7 +39,8 @@ from jax.experimental.pallas import tpu as pltpu
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
 __all__ = ["lloyd_pass_pallas", "accumulate_pallas", "pallas_supported",
-           "lloyd_delta_pallas", "delta_pallas_supported"]
+           "lloyd_delta_pallas", "delta_pallas_supported",
+           "lloyd_hamerly_pallas", "hamerly_pallas_supported"]
 
 # Fallback VMEM budget when the device can't be queried (non-TPU default
 # backend, e.g. interpret-mode tests on the CPU mesh).  Calibrated
@@ -707,6 +708,314 @@ def lloyd_delta_pallas(
     inertia = jnp.sum(min_d2 * w[:n])
     return (labels, min_d2, sums[:k, :d_in], counts[0, :k], inertia,
             n_changed, dense_tiles)
+
+
+def hamerly_pallas_supported(n: int, d: int, k: int, *,
+                             block_rows: int = 1024, mc: int = 256,
+                             x_itemsize: int = 2,
+                             cd_itemsize: int = 2) -> bool:
+    """VMEM gate for :func:`lloyd_hamerly_pallas`: the delta gate's
+    operands (its dense branch and compaction machinery are shared) plus
+    the pruned path's (mc, k_pad) score tile and the (mc/t, LANE)
+    write-back pack."""
+    if not delta_pallas_supported(n, d, k, block_rows=block_rows, mc=mc,
+                                  x_itemsize=x_itemsize,
+                                  cd_itemsize=cd_itemsize):
+        return False
+    k_pad = _round_up(k, _LANE)
+    extra = mc * k_pad * 4                       # compacted score tile
+    extra += (mc + block_rows) * _LANE * 4       # pack + back
+    d_eff = padded_d(d)
+    est = _vmem_estimate(block_rows, d_eff, k_pad, x_itemsize, cd_itemsize)
+    est += block_rows * block_rows * cd_itemsize
+    est += mc * block_rows * (4 + cd_itemsize)
+    est += mc * d_eff * 4
+    est += mc * k_pad * (4 + cd_itemsize)
+    est += block_rows * k_pad * (4 + cd_itemsize)
+    return est + extra <= _vmem_budget()
+
+
+def _second_min_rows(part, labels):
+    """Row-wise min over the columns EXCLUDING each row's argmin column —
+    the Hamerly lower bound's seed.  Exact: masks the single winning
+    column to +inf and reduces again."""
+    cols = jax.lax.broadcasted_iota(jnp.int32, part.shape, 1)
+    return jnp.min(jnp.where(cols == labels[:, None], jnp.inf, part),
+                   axis=1)
+
+
+def _hamerly_kernel(x_ref, w_ref, prev_ref, need_ref, sbin_ref, slbin_ref,
+                    ct_ref, csq_ref, tri_ref,
+                    labels_ref, sb_ref, slb_ref, sums_ref, counts_ref,
+                    chc_ref, *, cd, mc, sub_split):
+    """Fused Hamerly-pruned Lloyd sweep (Hamerly 2010's two-bound pruning,
+    re-designed for TPU tiles): rows whose carried score bounds prove the
+    argmin unchanged SKIP the distance matmul entirely.
+
+    The caller (ops.hamerly.hamerly_pass) updates the per-row bounds for
+    centroid drift and hands in ``need`` — rows whose bounds could not
+    prove the label stable.  Per tile:
+
+    * needed rows compact via the same MXU permutation-matrix machinery
+      as the delta kernel (prefix sum = triangular matmul, gather = 0/1
+      matmul), and ONLY the compacted (mc, d) block runs the distance
+      matmul against (d, k_pad) — at 10% need that is ~10x fewer distance
+      FLOPs than a dense tile;
+    * argmin + exact second-min on the (mc, k_pad) score tile refresh the
+      recomputed rows' bounds; a 0/1 write-back matmul scatters
+      (label, best, second) to row order in one (mc, LANE)-packed product
+      (exact: one 1 per permutation column);
+    * the centroid update folds the recomputed rows' signed one-hot
+      directly from the SAME compacted block — changed rows are a subset
+      of recomputed rows, so no second gather exists;
+    * a tile with more needed rows than ``mc`` — first sweeps (sentinel
+      prev), refresh sweeps, high-drift phases — runs the DENSE branch:
+      full distance matmul (staged sub-tiles, as the classic kernel),
+      argmin + second-min, signed fold over all rows.  Exactly the
+      classic sweep's cost, never more.
+
+    Label exactness vs the dense path is an inequality argument, not a
+    heuristic: see ops.hamerly's module docstring for the bound algebra
+    and the f32-accumulation margin.
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    xb = x_ref[:]                                   # (T, d)
+    xb_c = xb.astype(cd)
+    w = w_ref[:][:, 0]
+    prev = prev_ref[:][:, 0]                        # (T,) int32
+    needf = need_ref[:][:, 0]                       # (T,) f32 {0,1}
+    t, _ = xb.shape
+    k_pad = ct_ref.shape[1]
+    ct = ct_ref[:]
+    csq = csq_ref[:]
+    need = needf > 0.0
+
+    # Prefix over the NEED mask (same MXU triangular trick as the delta
+    # kernel); last element = this tile's recompute count.
+    chf_rep = jnp.broadcast_to(needf.astype(cd)[:, None], (t, _LANE))
+    pos_incl = jax.lax.dot_general(
+        tri_ref[:], chf_rep,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=matmul_precision(cd),
+    )[:, 0]
+    chc_ref[:] = pos_incl[:, None]
+    count = jnp.max(pos_incl)
+    fits = count <= float(mc)
+
+    @pl.when(fits)
+    def _pruned():
+        pos = jnp.minimum(pos_incl - 1.0, float(mc)).astype(jnp.int32)
+        slot = jax.lax.broadcasted_iota(jnp.int32, (mc, t), 0)
+        p_mat = jnp.where((slot == pos[None, :]) & need[None, :], 1.0, 0.0)
+        x_c = jnp.dot(p_mat.astype(cd), xb_c,
+                      preferred_element_type=jnp.float32,
+                      precision=matmul_precision(cd))    # (mc, d)
+        prev_c = jnp.sum(p_mat * prev.astype(jnp.float32)[None, :],
+                         axis=1).astype(jnp.int32)
+        w_c = jnp.sum(p_mat * w[None, :], axis=1)        # 0 in empty slots
+        # Distances ONLY for the compacted rows — the pruning payoff.
+        part = csq - 2.0 * jnp.dot(
+            x_c.astype(cd), ct, preferred_element_type=jnp.float32,
+            precision=matmul_precision(cd))              # (mc, k_pad)
+        m1, lab_c, _ = _argmin_rows(part, k_pad)
+        m2 = _second_min_rows(part, lab_c)
+        # Write-back: VPU contractions against the 0/1 permutation matrix
+        # scatter (label, best, second) from slot order to row order —
+        # exact f32 copies (one 1 per column; a matmul here would route
+        # f32 values through the MXU's bf16-split emulation).
+        lab_b = jnp.sum(p_mat * lab_c.astype(jnp.float32)[:, None],
+                        axis=0)
+        m1_b = jnp.sum(p_mat * m1[:, None], axis=0)
+        m2_b = jnp.sum(p_mat * m2[:, None], axis=0)
+        labels_ref[:] = jnp.where(need, lab_b.astype(jnp.int32),
+                                  prev)[:, None]
+        sb_ref[:] = jnp.where(need, m1_b,
+                              sbin_ref[:][:, 0])[:, None]
+        slb_ref[:] = jnp.where(need, m2_b,
+                               slbin_ref[:][:, 0])[:, None]
+        # Fold: signed one-hot straight off the compacted block (changed
+        # rows are a subset of recomputed rows; unchanged rows cancel to
+        # an exact zero row BEFORE the matmul).
+        cols_k = jax.lax.broadcasted_iota(jnp.int32, (mc, k_pad), 1)
+        signed = (
+            jnp.where(lab_c[:, None] == cols_k, w_c[:, None], 0.0)
+            - jnp.where(prev_c[:, None] == cols_k, w_c[:, None], 0.0)
+        )
+        counts_ref[:] += jnp.sum(signed, axis=0, keepdims=True)
+        sums_ref[:] += jax.lax.dot_general(
+            signed.astype(cd), x_c.astype(cd),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(cd),
+        )
+
+    @pl.when(jnp.logical_not(fits))
+    def _dense():
+        ts = t // sub_split
+        subs = [slice(s * ts, (s + 1) * ts) for s in range(sub_split)]
+        prods = [
+            jnp.dot(xb_c[rows, :], ct, preferred_element_type=jnp.float32,
+                    precision=matmul_precision(cd))
+            for rows in subs
+        ]
+        for rows, prod in zip(subs, prods):
+            part = csq - 2.0 * prod
+            m1, lab_s, _ = _argmin_rows(part, k_pad)
+            m2 = _second_min_rows(part, lab_s)
+            labels_ref[rows, :] = lab_s[:, None]
+            sb_ref[rows, :] = m1[:, None]
+            slb_ref[rows, :] = m2[:, None]
+        lab = labels_ref[:][:, 0]
+        changed = (lab != prev) & (w > 0.0)
+        wch = w * changed.astype(jnp.float32)
+        cols_k = jax.lax.broadcasted_iota(jnp.int32, (t, k_pad), 1)
+        signed = (
+            jnp.where(lab[:, None] == cols_k, wch[:, None], 0.0)
+            - jnp.where(prev[:, None] == cols_k, wch[:, None], 0.0)
+        )
+        counts_ref[:] += jnp.sum(signed, axis=0, keepdims=True)
+        sums_ref[:] += jax.lax.dot_general(
+            signed.astype(cd), xb_c,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(cd),
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_rows", "mc", "compute_dtype", "interpret",
+                     "sub_split"),
+)
+def lloyd_hamerly_pallas(
+    x: jax.Array,
+    centroids: jax.Array,
+    labels_prev: jax.Array,
+    need: jax.Array,
+    sb_in: jax.Array,
+    slb_in: jax.Array,
+    *,
+    weights: Optional[jax.Array] = None,
+    block_rows: int = 1024,
+    mc: int = 256,
+    compute_dtype=None,
+    interpret: bool = False,
+    sub_split: int = 4,
+) -> Tuple[jax.Array, ...]:
+    """Fused Hamerly-pruned sweep (see :func:`_hamerly_kernel`).
+
+    Returns ``(labels, sb, slb, delta_sums, delta_counts, n_recomputed,
+    dense_tiles)``.  ``delta_sums``/``delta_counts`` are exact signed
+    corrections over ``labels_prev`` (valid on every sweep — over-budget
+    tiles fold densely); ``sb``/``slb`` are refreshed exact score bounds
+    for recomputed rows and pass-through of the caller's drift-updated
+    bounds elsewhere.  ``labels_prev`` sentinels (< 0) must arrive with
+    ``need`` forced True (the caller's rule) and route those rows through
+    recomputation; with zero ``sums_prev`` the delta IS the full
+    reduction.
+    """
+    n, d_in = x.shape
+    k = centroids.shape[0]
+    d = padded_d(d_in)
+    if not d:
+        raise ValueError(
+            f"pallas hamerly pass: d={d_in} is not lane-alignable within "
+            f"the {_PAD_INFLATION_CAP}x zero-padding cap"
+        )
+    if d != d_in:
+        x, centroids = _pad_d_inputs(d, x, centroids)
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    t = block_rows
+    if t % _LANE:
+        raise ValueError(
+            f"hamerly kernel block_rows must be a multiple of {_LANE}; "
+            f"got {t}"
+        )
+    if t % sub_split or (t // sub_split) % 8:
+        sub_split = 1
+    n_pad = _round_up(max(n, 1), t)
+    k_pad = _round_up(k, _LANE)
+
+    w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+    prev = labels_prev.astype(jnp.int32)
+    needf = need.astype(f32)
+    sb_in = sb_in.astype(f32)
+    slb_in = slb_in.astype(f32)
+    if n_pad != n:
+        x = jnp.concatenate([x, jnp.zeros((n_pad - n, d), x.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((n_pad - n,), f32)])
+        prev = jnp.concatenate(
+            [prev, jnp.zeros((n_pad - n,), jnp.int32)])
+        # Padding rows: never recomputed (need 0, prev 0 in-range), so
+        # they cost no slots and fold nothing (w = 0).
+        needf = jnp.concatenate([needf, jnp.zeros((n_pad - n,), f32)])
+        sb_in = jnp.concatenate([sb_in, jnp.zeros((n_pad - n,), f32)])
+        slb_in = jnp.concatenate([slb_in, jnp.zeros((n_pad - n,), f32)])
+    n_chunks = n_pad // t
+
+    c_t = centroids.astype(cd).T
+    c_sq = sq_norms(centroids)
+    if k_pad != k:
+        c_t = jnp.concatenate([c_t, jnp.zeros((d, k_pad - k), cd)], axis=1)
+        c_sq = jnp.concatenate(
+            [c_sq, jnp.full((k_pad - k,), jnp.inf, f32)])
+
+    tri = (jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+           >= jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)).astype(cd)
+    row_spec = pl.BlockSpec((t, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    kernel = functools.partial(_hamerly_kernel, cd=cd, mc=mc,
+                               sub_split=sub_split)
+    labels, sb, slb, sums, counts, chcount = pl.pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            row_spec, row_spec, row_spec, row_spec, row_spec,
+            pl.BlockSpec((d, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, t), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            row_spec, row_spec, row_spec,
+            pl.BlockSpec((k_pad, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            row_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), f32),
+            jax.ShapeDtypeStruct((n_pad, 1), f32),
+            jax.ShapeDtypeStruct((k_pad, d), f32),
+            jax.ShapeDtypeStruct((1, k_pad), f32),
+            jax.ShapeDtypeStruct((n_pad, 1), f32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(x, w[:, None], prev[:, None], needf[:, None], sb_in[:, None],
+      slb_in[:, None], c_t, c_sq[None, :], tri)
+
+    per_tile = chcount[:, 0].reshape(n_chunks, t)[:, t - 1]
+    dense_tiles = jnp.sum(per_tile > mc).astype(jnp.int32)
+    n_recomputed = jnp.sum(per_tile).astype(jnp.int32)
+    return (labels[:n, 0], sb[:n, 0], slb[:n, 0], sums[:k, :d_in],
+            counts[0, :k], n_recomputed, dense_tiles)
 
 
 def _acc_kernel(x_ref, w_ref, lab_ref, g_ref,

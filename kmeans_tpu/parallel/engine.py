@@ -851,6 +851,11 @@ def fit_lloyd_sharded(
         cfg.update, w_exact=w_exact,
         sharded_axes=bool(model_axis or feature_axis),
     )
+    if update == "hamerly":
+        raise ValueError(
+            "update='hamerly' is a single-device loop (no sharded body "
+            "yet); use update='auto' or 'delta' on a mesh"
+        )
     if model_axis and feature_axis:
         # No Mosaic body for the 3-axis composition (the XLA
         # partial-contraction + two-pmin body is the only lowering): the

@@ -1,0 +1,209 @@
+"""The Hamerly bound-pruned exact sweep (kmeans_tpu.ops.hamerly, round 5).
+
+The family's whole value is the EXACTNESS claim: pruned rows provably
+keep their argmin under the kernel's actual bf16/f32 arithmetic, so the
+trajectory equals the dense path bit-for-bit — on friendly data (wide
+first/second gaps, heavy pruning) AND adversarial data (near-ties, where
+the margins must force recomputes rather than permit errors).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.lloyd import fit_lloyd, fit_plan
+from kmeans_tpu.ops.delta import DELTA_REFRESH
+from kmeans_tpu.ops.hamerly import hamerly_pass, row_norms
+from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.update import apply_update
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(11)
+
+
+def _blobs(rng, n, d, k, sep=3.0):
+    centers = rng.normal(size=(k, d)).astype(np.float32) * sep
+    lab = rng.integers(0, k, n)
+    return (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+
+
+def _run_traj(x, c0, k, iters, backend, *, weights=None, cap=None,
+              chunk=512, refresh=DELTA_REFRESH):
+    """(labels_per_sweep, centroids, recompute_counts) of the hamerly
+    loop, sweeping by hand so every intermediate is assertable."""
+    n, d = x.shape
+    rno = row_norms(x, chunk_size=chunk)
+    c = c0
+    lab = jnp.full((n,), -1, jnp.int32)
+    sums = jnp.zeros((k, d), jnp.float32)
+    counts = jnp.zeros((k,), jnp.float32)
+    sb = jnp.zeros((n,), jnp.float32)
+    slb = jnp.zeros((n,), jnp.float32)
+    c_cd = c0
+    csq = jnp.zeros((k,), jnp.float32)
+    labs, recs = [], []
+    for i in range(iters):
+        if i % refresh == 0:
+            lab = jnp.full((n,), -1, jnp.int32)
+            sums = jnp.zeros((k, d), jnp.float32)
+            counts = jnp.zeros((k,), jnp.float32)
+        lab, sums, counts, sb, slb, c_cd, csq, nrec = hamerly_pass(
+            x, c, lab, sums, counts, sb, slb, c_cd, csq, rno,
+            weights=weights, cap=cap if cap is not None else n,
+            chunk_size=chunk, backend=backend)
+        labs.append(np.asarray(lab))
+        recs.append(int(nrec))
+        c = apply_update(c, sums, counts)
+    return labs, np.asarray(c), recs
+
+
+def _dense_traj(x, c0, k, iters, *, weights=None, chunk=512):
+    c = c0
+    labs = []
+    for _ in range(iters):
+        lab, _, sums, counts, _ = lloyd_pass(x, c, weights=weights,
+                                             chunk_size=chunk)
+        c = apply_update(c, sums, counts)
+        labs.append(np.asarray(lab))
+    return labs, np.asarray(c)
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas_interpret"])
+def test_matches_dense_trajectory_and_prunes(rng, backend):
+    n, d, k = 3000, 128, 10
+    x = jnp.asarray(_blobs(rng, n, d, k))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    want, c_want = _dense_traj(x, c0, k, 10)
+    got, c_got, recs = _run_traj(x, c0, k, 10, backend)
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert (a == b).all(), f"diverged at sweep {i}"
+    np.testing.assert_allclose(c_got, c_want, atol=1e-4)
+    # The point of the family: pruning must actually engage on blob data.
+    assert recs[-1] < n // 4, recs
+
+
+def test_adversarial_near_ties_stay_exact(rng):
+    """Uniform noise with k=24: first/second gaps are tiny, the margins
+    must force recomputation (poor pruning) and NEVER a wrong skip."""
+    n, d, k = 2500, 32, 24
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    want, _ = _dense_traj(x, c0, k, 8)
+    got, _, recs = _run_traj(x, c0, k, 8, "xla")
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert (a == b).all(), f"diverged at sweep {i}"
+    # Near-tie data: recomputes stay high — the honest cost of exactness.
+    assert recs[-1] > n // 2
+
+
+def test_weights_and_zero_weight_rows(rng):
+    n, d, k = 2000, 64, 8
+    x = jnp.asarray(_blobs(rng, n, d, k))
+    w = jnp.asarray((rng.random(n) > 0.3).astype(np.float32))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    want, c_want = _dense_traj(x, c0, k, 8, weights=w)
+    got, c_got, _ = _run_traj(x, c0, k, 8, "xla", weights=w)
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert (a == b).all(), f"diverged at sweep {i}"
+    np.testing.assert_allclose(c_got, c_want, atol=1e-4)
+
+
+def test_xla_cap_boundary_full_fallback(rng):
+    """More needed rows than cap -> the full branch recomputes everything
+    and the sums invariant still holds."""
+    n, d, k = 1500, 32, 6
+    x = jnp.asarray(_blobs(rng, n, d, k))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    # cap=8: the all-changed first sweep massively overflows.
+    got, c_got, recs = _run_traj(x, c0, k, 6, "xla", cap=8)
+    want, c_want = _dense_traj(x, c0, k, 6)
+    for a, b in zip(got, want):
+        assert (a == b).all()
+    np.testing.assert_allclose(c_got, c_want, atol=1e-4)
+
+
+def test_refresh_cadence_bounds_drift(rng):
+    """A 3-sweep refresh interval (vs the default 16) must not change
+    labels — refresh is a numerical hygiene knob, not a semantic one."""
+    n, d, k = 1600, 32, 6
+    x = jnp.asarray(_blobs(rng, n, d, k))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    a, _, _ = _run_traj(x, c0, k, 9, "xla", refresh=3)
+    b, _, _ = _run_traj(x, c0, k, 9, "xla", refresh=DELTA_REFRESH)
+    for i, (u, v) in enumerate(zip(a, b)):
+        assert (u == v).all(), f"refresh cadence changed labels at {i}"
+
+
+# ------------------------------------------------------------ fit-level
+
+def test_fit_lloyd_hamerly_matches_matmul(rng):
+    x = jnp.asarray(_blobs(rng, 2500, 64, 8))
+    kw = dict(k=8, tol=1e-10, max_iter=30, backend="xla")
+    s_h = fit_lloyd(x, 8, key=jax.random.key(3),
+                    config=KMeansConfig(update="hamerly", **kw))
+    s_m = fit_lloyd(x, 8, key=jax.random.key(3),
+                    config=KMeansConfig(update="matmul", **kw))
+    np.testing.assert_array_equal(np.asarray(s_h.labels),
+                                  np.asarray(s_m.labels))
+    assert int(s_h.n_iter) == int(s_m.n_iter)
+    np.testing.assert_allclose(np.asarray(s_h.centroids),
+                               np.asarray(s_m.centroids), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fit_plan_reports_hamerly_route(rng):
+    x = jnp.asarray(_blobs(rng, 1000, 64, 5))
+    plan = fit_plan(x, 5, config=KMeansConfig(k=5, update="hamerly"))
+    assert plan["update"] == "hamerly"
+    assert plan["delta_backend"] == "xla"       # CPU test mesh
+
+
+def test_unsupported_combinations_raise(rng, cpu_devices):
+    x = jnp.asarray(_blobs(rng, 1000, 32, 5))
+    with pytest.raises(ValueError, match="farthest"):
+        fit_lloyd(x, 5, key=jax.random.key(0),
+                  config=KMeansConfig(k=5, update="hamerly",
+                                      empty="farthest"))
+    # fit_plan raises exactly where fit_lloyd would (its contract).
+    with pytest.raises(ValueError, match="farthest"):
+        fit_plan(x, 5, config=KMeansConfig(k=5, update="hamerly",
+                                           empty="farthest"))
+    w = jnp.asarray(rng.uniform(0.5, 1.5, 1000).astype(np.float32))
+    with pytest.raises(ValueError, match="signed"):
+        fit_lloyd(x, 5, key=jax.random.key(0), weights=w,
+                  config=KMeansConfig(k=5, update="hamerly",
+                                      compute_dtype="bfloat16"))
+    from kmeans_tpu.parallel import make_mesh
+    from kmeans_tpu.parallel.engine import fit_lloyd_sharded
+
+    mesh = make_mesh((8, 1), ("data", "model"), devices=cpu_devices)
+    with pytest.raises(ValueError, match="single-device"):
+        fit_lloyd_sharded(np.asarray(x), 5, mesh=mesh,
+                          key=jax.random.key(0),
+                          config=KMeansConfig(k=5, update="hamerly"))
+    from kmeans_tpu.models.runner import LloydRunner
+
+    with pytest.raises(ValueError, match="hamerly"):
+        LloydRunner(np.asarray(x), 5,
+                    config=KMeansConfig(k=5, update="hamerly"))
+
+
+def test_cli_hamerly_guards(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
+               "--update", "hamerly", "--max-iter", "10"])
+    assert rc == 0, capsys.readouterr().err
+    capsys.readouterr()
+    rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
+               "--update", "hamerly", "--mesh", "2"])
+    assert rc == 2
+    assert "single-device" in capsys.readouterr().err
+    rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
+               "--update", "hamerly", "--progress"])
+    assert rc == 2
+    assert "single-device" in capsys.readouterr().err

@@ -458,8 +458,10 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
 
     if n_dev > 1 and update == "hamerly":
         raise ValueError(
-            "--update hamerly is single-device (no sharded body); run on "
-            "one chip or use delta/full"
+            "the bench does not build the multi-chip hamerly loop (the "
+            "engine supports it via fit_lloyd_sharded, but the headline "
+            "flavor on any chip count is delta); run on one chip or use "
+            "delta/full"
         )
     if n_dev > 1:
         from kmeans_tpu.parallel import make_mesh

@@ -177,11 +177,11 @@ def _cmd_train(args) -> int:
                   "--mesh or the runner flags, or use --update auto",
                   file=sys.stderr)
             return 2
-        if args.update == "hamerly" and (runner_flags or (
-                args.mesh and args.mesh > 1)):
-            print("error: --update hamerly runs the single-device "
-                  "fit_lloyd loop only (no runner/mesh body); drop those "
-                  "flags or use --update auto", file=sys.stderr)
+        if args.update == "hamerly" and runner_flags:
+            print("error: --update hamerly runs the fit_lloyd loops "
+                  "(single-device or DP mesh), not the step-wise runner; "
+                  "drop --progress/--checkpoint/--resume/--profile or use "
+                  "--update auto", file=sys.stderr)
             return 2
 
     if args.steps is not None and args.steps < 1:

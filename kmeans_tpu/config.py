@@ -111,8 +111,8 @@ class KMeansConfig:
     #: bounds prove the argmin unchanged skip the distance matmul too —
     #: exact labels, but the win is DATA-DEPENDENT: large on naturally
     #: clustered data where first/second-centroid gaps are wide, absent
-    #: when k far exceeds the natural cluster count; single-device,
-    #: empty="keep" only; see kmeans_tpu.ops.hamerly).
+    #: when k far exceeds the natural cluster count; single-device and
+    #: DP-mesh Lloyd fits, empty="keep" only; see kmeans_tpu.ops.hamerly).
     update: str = "auto"
     #: Empty-cluster policy: "keep" (retain old centroid) or "farthest"
     #: (reseed to the currently-worst-fit points).

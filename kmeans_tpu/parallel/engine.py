@@ -851,10 +851,13 @@ def fit_lloyd_sharded(
         cfg.update, w_exact=w_exact,
         sharded_axes=bool(model_axis or feature_axis),
     )
-    if update == "hamerly":
+    if update == "hamerly" and (cfg.empty != "keep"
+                                or center_update != "mean"):
         raise ValueError(
-            "update='hamerly' is a single-device loop (no sharded body "
-            "yet); use update='auto' or 'delta' on a mesh"
+            "update='hamerly' prunes rows from the distance pass (no "
+            "per-sweep min_d2 for farthest-reseed, mean updates only); "
+            "use empty='keep' with the default center update, or "
+            "update='auto'/'delta'"
         )
     if model_axis and feature_axis:
         # No Mosaic body for the 3-axis composition (the XLA
@@ -886,6 +889,15 @@ def fit_lloyd_sharded(
         run = _build_lloyd_delta_run(
             mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, max_it,
             backend, cfg.empty, center_update,
+        )
+    elif update == "hamerly":
+        # DP bound-pruned loop (round 5): per-shard carried
+        # (labels, sums, counts, sb, slb) — score bounds are row state,
+        # so the shard story equals the delta loop's plus two carried
+        # vectors; one psum per sweep.
+        run = _build_lloyd_hamerly_run(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, max_it,
+            backend,
         )
     else:
         run = _build_lloyd_run(
@@ -1125,6 +1137,119 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
 
     return run
 
+
+def _dp_hamerly_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
+                           sb, slb, c_cd, csq_prev, rno_loc, *, data_axis,
+                           chunk_size, compute_dtype, backend):
+    """DP shard body for the Hamerly bound-pruned update: each shard runs
+    :func:`kmeans_tpu.ops.hamerly.hamerly_pass` on its rows, carrying ITS
+    OWN (labels, sums, counts, sb, slb) — the score bounds are per-row
+    state, so the shard story is identical to the delta body's
+    (:func:`_dp_delta_local_pass`): one psum of the per-shard
+    (sums, counts) merges the update, and the replicated centroid
+    representations (c_cd, csq) come back identical from every shard
+    (deterministic math on replicated inputs)."""
+    from kmeans_tpu.ops.delta import default_cap
+    from kmeans_tpu.ops.hamerly import hamerly_pass
+
+    n_loc = x_loc.shape[0]
+    (labels, sums_new, counts_new, sb2, slb2, c_cd2, csq2, _) = hamerly_pass(
+        x_loc, c, lab_prev, sums_loc, counts_loc, sb, slb, c_cd, csq_prev,
+        rno_loc, weights=w_loc, cap=default_cap(n_loc),
+        chunk_size=chunk_size, compute_dtype=compute_dtype,
+        backend="auto" if backend == "pallas" else backend,
+        weights_are_binary=True,
+    )
+    g_sums = lax.psum(sums_new, data_axis)
+    g_counts = lax.psum(counts_new, data_axis)
+    new_c = apply_update(c, g_sums, g_counts)
+    return (new_c, labels, sums_new, counts_new, sb2, slb2, c_cd2, csq2)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
+                             max_it, backend):
+    """Jitted whole-fit program for the DP ``update="hamerly"`` path:
+    like :func:`_build_lloyd_delta_run` but the carried per-shard state
+    additionally holds the (sb, slb) score bounds, and the refresh
+    cadence resets via the sentinel trick OUTSIDE the shard body
+    (elementwise on the sharded arrays — no collectives)."""
+    from kmeans_tpu.ops.delta import DELTA_REFRESH
+    from kmeans_tpu.ops.hamerly import row_norms
+
+    local = functools.partial(
+        _dp_hamerly_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, backend=backend,
+    )
+    step = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis), P(data_axis),
+                  P(data_axis), P(data_axis), P(data_axis), P(data_axis),
+                  P(), P(), P(data_axis)),
+        out_specs=(P(), P(data_axis), P(data_axis), P(data_axis),
+                   P(data_axis), P(data_axis), P(), P()),
+        check_vma=False,
+    )
+    rno_sm = jax.shard_map(
+        functools.partial(row_norms, compute_dtype=compute_dtype,
+                          chunk_size=chunk_size),
+        mesh=mesh, in_specs=(P(data_axis),), out_specs=P(data_axis),
+        check_vma=False,
+    )
+    final_local = functools.partial(
+        _dp_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update="matmul", backend=backend,
+        with_labels=True, empty="keep", center_update="mean",
+    )
+    final = jax.shard_map(
+        final_local, mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P(), P(data_axis)),
+        check_vma=False,
+    )
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+    cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+          else None)
+
+    @jax.jit
+    def run(x, w, c0, tol_v):
+        n = x.shape[0]
+        k, d = c0.shape
+        f32 = jnp.float32
+        rno = rno_sm(x)
+        c_cd0 = c0.astype(cd if cd is not None else x.dtype)
+
+        def cond(s):
+            return (s[1] < max_it) & ~s[3]
+
+        def body(s):
+            (c, it, _, _, lab, sums, counts, sb, slb, c_cd, csq) = s
+            refresh = (it % DELTA_REFRESH) == 0
+            lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
+            sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
+            counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
+            (new_c, lab, sums, counts, sb, slb, c_cd, csq) = step(
+                x, c, w, lab_e, sums_e, counts_e, sb, slb, c_cd, csq, rno)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
+                    counts, sb, slb, c_cd, csq)
+
+        init = (
+            c0, jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((dp * k, d), f32),       # per-shard sums, stacked
+            jnp.zeros((dp * k,), f32),
+            jnp.zeros((n,), f32),              # sb
+            jnp.zeros((n,), f32),              # slb
+            c_cd0,
+            jnp.zeros((k,), f32),
+        )
+        c, n_iter, _, converged = lax.while_loop(cond, body, init)[:4]
+        _, inertia, counts, labels = final(x, c, w)
+        return c, labels, inertia, n_iter, converged, counts
+
+    return run
 
 
 @functools.lru_cache(maxsize=32)

@@ -162,6 +162,30 @@ def test_fit_plan_reports_hamerly_route(rng):
     assert plan["delta_backend"] == "xla"       # CPU test mesh
 
 
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2)])
+def test_sharded_hamerly_matches_single_device(rng, cpu_devices, shape):
+    """The DP hamerly loop (per-shard carried bounds, one psum per
+    sweep) reproduces the single-device hamerly fit — which itself
+    matches dense — label-exactly, on uneven rows."""
+    from kmeans_tpu.parallel import make_mesh
+    from kmeans_tpu.parallel.engine import fit_lloyd_sharded
+
+    n, d, k = 2107, 32, 6              # uneven rows: pad path exercised
+    x = _blobs(rng, n, d, k)
+    mesh = make_mesh(shape, ("data", "model"),
+                     devices=cpu_devices[: shape[0] * shape[1]])
+    cfg = KMeansConfig(k=k, update="hamerly", tol=1e-10, max_iter=25,
+                       backend="xla")
+    got = fit_lloyd_sharded(x, k, mesh=mesh, key=jax.random.key(5),
+                            config=cfg)
+    want = fit_lloyd(jnp.asarray(x), k, key=jax.random.key(5),
+                     config=KMeansConfig(k=k, update="matmul", tol=1e-10,
+                                         max_iter=25, backend="xla"))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    assert int(got.n_iter) == int(want.n_iter)
+
+
 def test_unsupported_combinations_raise(rng, cpu_devices):
     x = jnp.asarray(_blobs(rng, 1000, 32, 5))
     with pytest.raises(ValueError, match="farthest"):
@@ -181,9 +205,15 @@ def test_unsupported_combinations_raise(rng, cpu_devices):
     from kmeans_tpu.parallel.engine import fit_lloyd_sharded
 
     mesh = make_mesh((8, 1), ("data", "model"), devices=cpu_devices)
-    with pytest.raises(ValueError, match="single-device"):
+    with pytest.raises(ValueError, match="farthest|min_d2"):
         fit_lloyd_sharded(np.asarray(x), 5, mesh=mesh,
                           key=jax.random.key(0),
+                          config=KMeansConfig(k=5, update="hamerly",
+                                              empty="farthest"))
+    mesh2 = make_mesh((4, 2), ("data", "model"), devices=cpu_devices)
+    with pytest.raises(ValueError, match="model_axis"):
+        fit_lloyd_sharded(np.asarray(x), 5, mesh=mesh2,
+                          key=jax.random.key(0), model_axis="model",
                           config=KMeansConfig(k=5, update="hamerly"))
     from kmeans_tpu.models.runner import LloydRunner
 
@@ -199,11 +229,12 @@ def test_cli_hamerly_guards(capsys):
                "--update", "hamerly", "--max-iter", "10"])
     assert rc == 0, capsys.readouterr().err
     capsys.readouterr()
+    # DP mesh hamerly is supported since the sharded body landed.
     rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
                "--update", "hamerly", "--mesh", "2"])
-    assert rc == 2
-    assert "single-device" in capsys.readouterr().err
+    assert rc == 0, capsys.readouterr().err
+    capsys.readouterr()
     rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
                "--update", "hamerly", "--progress"])
     assert rc == 2
-    assert "single-device" in capsys.readouterr().err
+    assert "runner" in capsys.readouterr().err

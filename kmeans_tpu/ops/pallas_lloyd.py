@@ -158,6 +158,16 @@ def delta_pallas_supported(n: int, d: int, k: int, *,
     return est <= _vmem_budget()
 
 
+def _neg2_ct(centroids, cd):
+    """Resident (d, k) score operand, pre-scaled by -2 — THE one copy of
+    the convention every kernel's score site relies on ("part = csq +
+    prod").  EXACT: x2 is an exponent shift on the already-cast values,
+    so each dot partial and each f32 partial sum is exactly -2x the
+    unscaled one, and csq + prod equals csq - 2*dot bit-for-bit (the XLA
+    route keeps the explicit form; labels stay bit-identical)."""
+    return (centroids.astype(cd) * jnp.asarray(-2, cd)).T
+
+
 def _fold_tile(sums_ref, counts_ref, labels, w, xb_c, cols, *, cd):
     """Fold one tile into the (sums, counts) accumulators: one-hot from
     ``labels`` (any value outside the column range matches nothing), counts
@@ -246,7 +256,7 @@ def _kernel(x_ref, w_ref, ct_ref, csq_ref,
     for rows, prod in zip(subs, prods):
         # argmin_k ||x-c||² == argmin_k (||c||² - 2 x·c); padded columns
         # carry csq=+inf so they can never win.
-        part = csq - 2.0 * prod              # (1,k)+(ts,k) -> (ts, k_pad)
+        part = csq + prod                    # ct carries the -2x
         part_min, labels, cols = _argmin_rows(part, k_pad)
         if raw_scores:
             # The un-normalised, un-clamped score min_k(||c||² - 2x·c):
@@ -332,7 +342,7 @@ def lloyd_pass_pallas(
         w = jnp.concatenate([w, jnp.zeros((n_pad - n,), f32)])
     n_chunks = n_pad // t
 
-    c_t = centroids.astype(cd).T                   # (d, k)
+    c_t = _neg2_ct(centroids, cd)              # (d, k), -2x resident
     c_sq = sq_norms(centroids)                     # (k,) f32
     if valid_cols is not None:
         c_sq = jnp.where(valid_cols, c_sq, jnp.inf)
@@ -448,7 +458,7 @@ def _delta_kernel(x_ref, w_ref, prev_ref, ct_ref, csq_ref, tri_ref,
         for rows in subs
     ]
     for rows, prod in zip(subs, prods):
-        part = csq - 2.0 * prod
+        part = csq + prod                    # ct carries the -2x
         part_min, labels, _ = _argmin_rows(part, k_pad)
         labels_ref[rows, :] = labels[:, None]
         if with_mind:
@@ -645,7 +655,7 @@ def lloyd_delta_pallas(
         )
     n_chunks = n_pad // t
 
-    c_t = centroids.astype(cd).T
+    c_t = _neg2_ct(centroids, cd)
     c_sq = sq_norms(centroids)
     if k_pad != k:
         c_t = jnp.concatenate([c_t, jnp.zeros((d, k_pad - k), cd)], axis=1)
@@ -821,9 +831,9 @@ def _hamerly_kernel(x_ref, w_ref, prev_ref, need_ref, sbin_ref, slbin_ref,
                          axis=1).astype(jnp.int32)
         w_c = jnp.sum(p_mat * w[None, :], axis=1)        # 0 in empty slots
         # Distances ONLY for the compacted rows — the pruning payoff.
-        part = csq - 2.0 * jnp.dot(
+        part = csq + jnp.dot(
             x_c.astype(cd), ct, preferred_element_type=jnp.float32,
-            precision=matmul_precision(cd))              # (mc, k_pad)
+            precision=matmul_precision(cd))   # (mc, k_pad); ct carries -2x
         m1, lab_c, _ = _argmin_rows(part, k_pad)
         m2 = _second_min_rows(part, lab_c)
         # Write-back: VPU contractions against the 0/1 permutation matrix
@@ -866,7 +876,7 @@ def _hamerly_kernel(x_ref, w_ref, prev_ref, need_ref, sbin_ref, slbin_ref,
             for rows in subs
         ]
         for rows, prod in zip(subs, prods):
-            part = csq - 2.0 * prod
+            part = csq + prod                # ct carries the -2x
             m1, lab_s, _ = _argmin_rows(part, k_pad)
             m2 = _second_min_rows(part, lab_s)
             labels_ref[rows, :] = lab_s[:, None]
@@ -962,7 +972,7 @@ def lloyd_hamerly_pallas(
         slb_in = jnp.concatenate([slb_in, jnp.zeros((n_pad - n,), f32)])
     n_chunks = n_pad // t
 
-    c_t = centroids.astype(cd).T
+    c_t = _neg2_ct(centroids, cd)
     c_sq = sq_norms(centroids)
     if k_pad != k:
         c_t = jnp.concatenate([c_t, jnp.zeros((d, k_pad - k), cd)], axis=1)

@@ -239,6 +239,24 @@ class TestDeltaPass:
         assert (np.asarray(s_d.labels) == np.asarray(s_m.labels)).all()
         assert int(s_d.n_iter) == int(s_m.n_iter)
 
+    def test_with_mind_false_poisons_uniformly(self, rng):
+        """with_mind=False returns NaN min_d2/inertia on EVERY backend —
+        no caller can consume raw scores as distances (ADVICE r4)."""
+        from kmeans_tpu.ops.delta import delta_pass
+        from kmeans_tpu.ops.lloyd import lloyd_pass
+
+        n, d, k = 1024, 128, 8
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+        lab, _, sums, counts, _ = lloyd_pass(x, c, chunk_size=256)
+        for backend in ("xla", "pallas_interpret"):
+            lab2, mind, _, _, inertia, _ = delta_pass(
+                x, c, lab, sums, counts, cap=n // 4, chunk_size=256,
+                backend=backend, with_mind=False)
+            assert np.isnan(np.asarray(mind)).all(), backend
+            assert np.isnan(float(inertia)), backend
+            assert (np.asarray(lab2) == np.asarray(lab)).all()
+
     def test_force_full_refresh(self, rng):
         from kmeans_tpu.ops.delta import delta_pass
         from kmeans_tpu.ops.lloyd import lloyd_pass

@@ -34,11 +34,24 @@ def main():
 
     # 1b. Same fit, incremental (delta) update: the one-hot reduction only
     # touches rows whose label changed — ~2x fewer MXU FLOPs at steady
-    # churn, bit-identical labels (this is the TPU bench's headline path).
+    # churn, bit-identical labels (this is the TPU bench's headline path,
+    # and what the default update="auto" resolves to; fit_plan reports
+    # the resolved plan so what-will-run is a queryable fact).
+    plan = kmeans_tpu.fit_plan(x, 5)
     kd = kmeans_tpu.KMeans(n_clusters=5, n_init=3, seed=0,
                            update="delta").fit(x)
     print(f"delta       labels==dense: "
-          f"{bool(np.array_equal(kd.labels_, km.labels_))}")
+          f"{bool(np.array_equal(kd.labels_, km.labels_))} "
+          f"auto-plan={plan['update']}/{plan['delta_backend']}")
+
+    # 1b'. Bound-pruned exact sweeps (Hamerly 2010): rows whose carried
+    # score bounds prove the argmin unchanged skip even the distance
+    # matmul — exact labels; the win is data-dependent (big when k is
+    # near the natural cluster count, as here).
+    kh = kmeans_tpu.KMeans(n_clusters=5, n_init=3, seed=0,
+                           update="hamerly").fit(x)
+    print(f"hamerly     labels==dense: "
+          f"{bool(np.array_equal(kh.labels_, km.labels_))}")
 
     # 1c. Soft clustering: Gaussian mixture with a shared (tied) covariance
     # — sklearn's covariance_type='tied', the (d, d)-honest middle between

@@ -14,6 +14,19 @@ import sys
 import time
 
 
+def _load_npy(path):
+    """``np.load`` with the CLI's one-line-error contract: a missing file
+    or a corrupt/short ``.npy`` prints one actionable line and returns
+    None (callers exit 2) instead of dumping a traceback."""
+    import numpy as np
+
+    try:
+        return np.load(path)
+    except (OSError, ValueError, EOFError) as e:
+        print(f"error: cannot load {path!r}: {e}", file=sys.stderr)
+        return None
+
+
 def _cmd_train(args) -> int:
     import jax
     import numpy as np
@@ -76,11 +89,16 @@ def _cmd_train(args) -> int:
 
             try:
                 x = load_mmap(args.input)
-            except ValueError as e:
-                print(f"error: {e}", file=sys.stderr)
+            except (OSError, ValueError, EOFError) as e:
+                # A missing path, a corrupt/truncated .npy, or a non-2-D
+                # array all report as one actionable line, not a traceback.
+                print(f"error: cannot load {args.input!r}: {e}",
+                      file=sys.stderr)
                 return 2
         else:
-            x = np.load(args.input)
+            x = _load_npy(args.input)
+            if x is None:
+                return 2
         if x.ndim != 2:
             print(f"error: {args.input} must be a 2-D array", file=sys.stderr)
             return 2
@@ -300,7 +318,17 @@ def _cmd_train(args) -> int:
 
         runner = LloydRunner(np.asarray(x), k, config=kcfg, mesh=mesh)
         if args.resume:
-            step = runner.resume(args.resume)
+            from kmeans_tpu.utils.checkpoint import CorruptCheckpointError
+
+            try:
+                step = runner.resume(args.resume)
+            except (FileNotFoundError, CorruptCheckpointError) as e:
+                # Same one-line contract as the streamed resume path: a
+                # missing or torn checkpoint dir is an actionable error,
+                # not a traceback.
+                print(f"error: cannot resume from {args.resume!r}: {e}",
+                      file=sys.stderr)
+                return 2
             print(f"resumed from {args.resume} at iteration {step}",
                   file=sys.stderr)
         else:
@@ -315,8 +343,14 @@ def _cmd_train(args) -> int:
         with ctx:
             state = runner.run(
                 callback=progress,
-                checkpoint_path=args.checkpoint,
+                # A --resume run without --checkpoint keeps saving (and
+                # cuts its preemption checkpoint) into the resume dir; an
+                # explicit --checkpoint still wins.  (The streamed path
+                # instead REJECTS mismatched --resume/--checkpoint — one
+                # dir carries its step counter.)
+                checkpoint_path=args.checkpoint or args.resume,
                 checkpoint_every=args.checkpoint_every,
+                checkpoint_keep=args.checkpoint_keep,
             )
     elif mesh is not None and not args.stream and model in (
             "xmeans", "gmeans", "spectral", "bisecting"):
@@ -361,6 +395,7 @@ def _cmd_train(args) -> int:
                     return 2
             ckpt_kw = {"checkpoint_path": args.resume or args.checkpoint,
                        "checkpoint_every": args.checkpoint_every,
+                       "checkpoint_keep": args.checkpoint_keep,
                        "resume": bool(args.resume)}
         # Explicit flags pass through as explicit arguments (None when the
         # user typed nothing), so the library's refuse-explicit-
@@ -372,6 +407,8 @@ def _cmd_train(args) -> int:
         fit_stream = (models.fit_gmm_stream if model == "gmm"
                       else models.fit_minibatch_stream)
         stream_kw |= gmm_kw
+        from kmeans_tpu.utils.retry import RetryError
+
         try:
             state = fit_stream(x, k, config=kcfg, **stream_kw)
         except ValueError as e:
@@ -380,6 +417,20 @@ def _cmd_train(args) -> int:
             # validation failure instead of a traceback.
             print(f"error: {e}", file=sys.stderr)
             return 2
+        except RetryError as e:
+            # A permanent host-read fault: the retry budget is exhausted,
+            # the error is one line, and the last periodic checkpoint (if
+            # any) is resumable once the storage recovers.
+            print(f"error: streamed fit failed after retries: {e}",
+                  file=sys.stderr)
+            if stream_ckpt:
+                from kmeans_tpu.utils.checkpoint import latest_step
+
+                ckpt = args.resume or args.checkpoint
+                if latest_step(ckpt) is not None:
+                    print(f"the last checkpoint at {ckpt!r} remains "
+                          "resumable with --resume", file=sys.stderr)
+            return 1
     else:
         fit = {
             "lloyd": models.fit_lloyd,
@@ -491,7 +542,9 @@ def _cmd_sweep(args) -> int:
         return 2
 
     if args.input:
-        x = np.load(args.input)
+        x = _load_npy(args.input)
+        if x is None:
+            return 2
         if x.ndim != 2:
             print(f"error: {args.input} must be a 2-D array", file=sys.stderr)
             return 2
@@ -638,6 +691,9 @@ def main(argv=None) -> int:
     t.add_argument("--checkpoint", help="checkpoint directory (periodic "
                    "saves; Lloyd runner or --stream paths)")
     t.add_argument("--checkpoint-every", type=int, default=10)
+    t.add_argument("--checkpoint-keep", type=int, default=0,
+                   help="retain up to N displaced checkpoints as step-"
+                        "tagged siblings (rolling history; 0 = none)")
     t.add_argument("--resume", help="resume from this checkpoint directory "
                    "(a streamed resume keeps saving into the same dir)")
     t.add_argument("--profile", help="write a jax.profiler trace to this dir")
@@ -687,7 +743,18 @@ def main(argv=None) -> int:
     b.set_defaults(fn=_cmd_bench)
 
     args = p.parse_args(argv)
-    return args.fn(args)
+    from kmeans_tpu.utils.preempt import Preempted
+
+    try:
+        return args.fn(args)
+    except Preempted as e:
+        # SIGTERM/SIGINT during a long fit: the loop already cut a final
+        # checkpoint; report the resumable state and exit with a distinct
+        # code (3 = preempted; 2 = usage error).
+        print(f"preempted: {e}", file=sys.stderr)
+        if e.path:
+            print(f"resume with: --resume {e.path}", file=sys.stderr)
+        return 3
 
 
 if __name__ == "__main__":

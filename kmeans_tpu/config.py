@@ -182,6 +182,10 @@ class ServeConfig:
     #: Server-wide bound on concurrent `train` worker threads (the per-room
     #: train_lock alone would let many rooms stack unbounded jobs).
     max_concurrent_train: int = 2
+    #: ``Retry-After`` seconds advertised on 503 capacity responses (train
+    #: slots exhausted, room table full).  The bundled browser client
+    #: honors it with backoff instead of failing the request.
+    retry_after_s: int = 5
     #: Request-body byte cap for /api/import (and the general POST body
     #: guard): one unauthenticated POST must not be able to stuff an
     #: unbounded board into memory — metrics snapshots are O(n²) per

@@ -22,13 +22,30 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 from typing import Iterable, Iterator, Optional
 
 import jax
 import numpy as np
 
+from kmeans_tpu.utils import faults
+from kmeans_tpu.utils.retry import RetryPolicy
+
 __all__ = ["load_mmap", "sample_batches", "prefetch_to_device",
-           "foreach_chunk"]
+           "foreach_chunk", "READ_RETRY"]
+
+#: Transient host-read policy for the streamed loaders: a memmap page-in
+#: against networked or flaky storage can throw a one-off ``OSError``; a
+#: bounded retry with short backoff absorbs it without changing the batch
+#: sequence (reads are pure functions of (seed, step), so a retried read
+#: returns identical bytes).  Exhaustion raises
+#: :class:`~kmeans_tpu.utils.retry.RetryError` — a permanent fault stays
+#: loud.
+READ_RETRY = RetryPolicy(max_attempts=4, base_delay=0.05, max_delay=1.0)
+
+#: Bounded producer join at generator teardown (seconds); see
+#: :func:`_prefetch_background`.
+_JOIN_TIMEOUT = 2.0
 
 
 def load_mmap(path: str) -> np.ndarray:
@@ -47,6 +64,7 @@ def sample_batches(
     seed: int = 0,
     start_step: int = 0,
     to_bf16: bool = False,
+    retry: Optional[RetryPolicy] = None,
 ) -> Iterator[np.ndarray]:
     """Yield batches ``start_step..steps-1``, with-replacement sampled from
     host ``data``.
@@ -61,19 +79,32 @@ def sample_batches(
     The gather goes through the native loader when available (threaded
     memcpy, GIL released); ``to_bf16`` fuses the f32→bf16 conversion into
     it so each batch crosses PCIe at half width.
+
+    Each read runs under ``retry`` (default :data:`READ_RETRY`): transient
+    ``OSError``-family failures are absorbed with jittered backoff, and
+    because the read is a pure function of (seed, step) the retried batch
+    is bit-identical — a retried run produces the same fit as an
+    undisturbed one.  The read is also the ``stream.read`` fault-injection
+    site (:mod:`kmeans_tpu.utils.faults`).
     """
     from kmeans_tpu.native import gather_rows
 
+    policy = retry if retry is not None else READ_RETRY
     n = data.shape[0]
     if batch_size < 1 or steps < 0 or not 0 <= start_step <= steps:
         raise ValueError(
             f"bad batch_size={batch_size} / steps={steps} / "
             f"start_step={start_step}"
         )
+
+    def read(idx):
+        faults.check("stream.read")
+        return gather_rows(data, idx, to_bf16=to_bf16)
+
     for step in range(start_step, steps):
         rng = np.random.default_rng((seed, step))
         idx = np.sort(rng.integers(0, n, size=batch_size))
-        yield gather_rows(data, idx, to_bf16=to_bf16)
+        yield policy.call(read, idx)
 
 
 def prefetch_to_device(
@@ -129,9 +160,13 @@ def foreach_chunk(data, chunk_size: int, fn) -> None:
     shared by the k-means and GMM labeling passes."""
     n = data.shape[0]
 
+    def read(lo):
+        faults.check("stream.read")
+        return np.ascontiguousarray(data[lo:lo + chunk_size])
+
     def chunks():
         for lo in range(0, n, chunk_size):
-            yield np.ascontiguousarray(data[lo:lo + chunk_size])
+            yield READ_RETRY.call(read, lo)
 
     lo = 0
     for xb in prefetch_to_device(chunks()):
@@ -183,6 +218,18 @@ def _prefetch_background(batches, depth, device):
         # native code (device_put / the GIL-free gather) while the caller
         # unwinds — a thread still inside native code at interpreter or
         # test teardown is a use-after-free waiting to happen.  stop is
-        # polled every 0.1 s, so 2 s covers any exit path.
-        t.join(timeout=2.0)
+        # polled every 0.1 s, so _JOIN_TIMEOUT covers any cooperative
+        # exit path; a producer stuck past it (a stalled upstream
+        # iterator, a hung read) leaks a live daemon thread, which must
+        # be NAMED and loud, not silent.
+        t.join(timeout=_JOIN_TIMEOUT)
+        if t.is_alive():
+            warnings.warn(
+                f"prefetch producer thread {t.name!r} still alive "
+                f"{_JOIN_TIMEOUT:.1f}s after teardown (stalled batch "
+                "source?); it runs as a daemon and may hold the data "
+                "source open",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 

@@ -175,6 +175,7 @@ def fit_gmm_stream(
     final_pass: bool = True,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 100,
+    checkpoint_keep: int = 0,
     resume: bool = False,
     mesh=None,
     data_axis: str = "data",
@@ -225,6 +226,14 @@ def fit_gmm_stream(
           if mesh is not None else 0)
     n_steps = steps if steps is not None else cfg.steps
     host_seed = seed if seed is not None else cfg.seed
+
+    # 0 is the documented final/preempt-saves-only mode (PeriodicSaver
+    # treats every < 1 as never-on-cadence; forced saves still land), but
+    # a negative cadence is always a caller bug — reject it up front.
+    if checkpoint_path and checkpoint_every < 0:
+        raise ValueError(
+            f"checkpoint_every must be >= 0, got {checkpoint_every}"
+        )
 
     start_step = 0
     params = None
@@ -338,6 +347,7 @@ def fit_gmm_stream(
                    "t0": float(t0), "covariance_type": covariance_type,
                    "reg_covar": float(reg_covar),
                    "total_steps": int(n_steps), "mesh_dp": int(dp)},
+            keep=checkpoint_keep,
         )
 
     reg = jnp.asarray(reg_covar, jnp.float32)
@@ -358,17 +368,45 @@ def fit_gmm_stream(
         step_fn = functools.partial(
             _gmm_stream_step, covariance_type=covariance_type,
             compute_dtype=cfg.compute_dtype)
+    from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
+
     batches = sample_batches(data, bs_eff, n_steps, seed=host_seed,
                              start_step=start_step)
     step = start_step
-    for xb in prefetch_to_device(batches, depth=prefetch_depth,
-                                 background=background_prefetch,
-                                 device=place):
-        rho = jnp.asarray((step + t0) ** (-kappa), jnp.float32)
-        params, stats, _ = step_fn(params, stats, xb, rho, reg)
-        step += 1
-        saver.maybe(step, lambda p=params, s=stats, t=step: save(p, s, t))
-    saver.maybe(step, lambda: save(params, stats, step), force=True)
+    # Same preemption contract as fit_minibatch_stream: signal latches a
+    # flag, the loop cuts one final checkpoint at the next step boundary
+    # and exits resumable.
+    with PreemptionGuard() as guard:
+        for xb in prefetch_to_device(batches, depth=prefetch_depth,
+                                     background=background_prefetch,
+                                     device=place):
+            rho = jnp.asarray((step + t0) ** (-kappa), jnp.float32)
+            params, stats, _ = step_fn(params, stats, xb, rho, reg)
+            step += 1
+            saver.maybe(step, lambda p=params, s=stats, t=step:
+                        save(p, s, t))
+            if guard.triggered and step < n_steps:
+                saver.maybe(step, lambda p=params, s=stats, t=step:
+                            save(p, s, t), force=True)
+                raise Preempted.during(
+                    f"fit_gmm_stream preempted by signal at step "
+                    f"{step}/{n_steps}",
+                    path=checkpoint_path, step=step,
+                )
+        saver.maybe(step, lambda: save(params, stats, step), force=True)
+        # A signal during the LAST step lands here with the loop complete.
+        # Same post-loop policy as fit_minibatch_stream: with a checkpoint
+        # exit resumable (the resumed run skips straight to the final
+        # pass); with NO checkpoint_path raising would discard the whole
+        # finished streamed phase, so finish instead.
+        if guard.triggered and checkpoint_path is not None:
+            raise Preempted.during(
+                f"fit_gmm_stream preempted by signal after the final "
+                f"step ({step}/{n_steps})" + (
+                    "; only the final pass remains" if final_pass
+                    else "; streamed phase complete and checkpointed"),
+                path=checkpoint_path, step=step,
+            )
 
     if final_pass:
         labels_np, ll, soft = gmm_assign_stream(

@@ -249,6 +249,7 @@ class LloydRunner:
         callback: Optional[Callable[[IterInfo], None]] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 10,
+        checkpoint_keep: int = 0,
     ) -> KMeansState:
         """Iterate until convergence; fire ``callback`` each iteration."""
         if self.centroids is None:
@@ -260,44 +261,78 @@ class LloydRunner:
         max_iter = max_iter if max_iter is not None else self.cfg.max_iter
         tol = tol if tol is not None else self.cfg.tol
 
-        converged = False
-        for _ in range(max_iter):
-            t0 = time.perf_counter()
-            if self.mesh is None and self._update == "delta":
-                # Incremental loop: full refresh on the first sweep after
-                # (re)init/resume and every DELTA_REFRESH-th iteration
-                # (drift bound, same cadence as fit_lloyd's fused loop),
-                # the carried-state delta sweep otherwise.
-                from kmeans_tpu.ops.delta import DELTA_REFRESH
+        from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
 
-                if (self._dstate is None
-                        or self.iteration % DELTA_REFRESH == 0):
-                    new_c, inertia, shift_sq, lab, sums, counts = \
-                        self._step(self.x, self.centroids)
+        converged = False
+        saved = False
+
+        def preempt_exit():
+            if checkpoint_path and not saved:
+                self.checkpoint(checkpoint_path, keep=checkpoint_keep)
+            raise Preempted.during(
+                f"LloydRunner preempted by signal at iteration "
+                f"{self.iteration}",
+                path=checkpoint_path, step=self.iteration,
+            )
+
+        # Preemption safety: SIGTERM/SIGINT latches a flag in the guard;
+        # the loop cuts one final checkpoint at the next iteration
+        # boundary and raises Preempted with a resumable state.
+        with PreemptionGuard() as guard:
+            for it in range(max_iter):
+                t0 = time.perf_counter()
+                if self.mesh is None and self._update == "delta":
+                    # Incremental loop: full refresh on the first sweep after
+                    # (re)init/resume and every DELTA_REFRESH-th iteration
+                    # (drift bound, same cadence as fit_lloyd's fused loop),
+                    # the carried-state delta sweep otherwise.
+                    from kmeans_tpu.ops.delta import DELTA_REFRESH
+
+                    if (self._dstate is None
+                            or self.iteration % DELTA_REFRESH == 0):
+                        new_c, inertia, shift_sq, lab, sums, counts = \
+                            self._step(self.x, self.centroids)
+                    else:
+                        new_c, inertia, shift_sq, lab, sums, counts = \
+                            self._step_delta(self.x, self.centroids,
+                                             *self._dstate)
+                    self._dstate = (lab, sums, counts)
                 else:
-                    new_c, inertia, shift_sq, lab, sums, counts = \
-                        self._step_delta(self.x, self.centroids,
-                                         *self._dstate)
-                self._dstate = (lab, sums, counts)
-            else:
-                new_c, inertia, shift_sq = self._step(self.x, self.centroids)
-            new_c.block_until_ready()
-            dt = time.perf_counter() - t0
-            self.centroids = new_c
-            self.iteration += 1
-            self.last_inertia = float(inertia)
-            converged = float(shift_sq) <= tol
-            if callback:
-                callback(IterInfo(
-                    self.iteration, float(inertia), float(shift_sq), dt,
-                    converged,
-                ))
-            if checkpoint_path and (
-                self.iteration % checkpoint_every == 0 or converged
-            ):
-                self.checkpoint(checkpoint_path)
-            if converged:
-                break
+                    new_c, inertia, shift_sq = self._step(
+                        self.x, self.centroids)
+                new_c.block_until_ready()
+                dt = time.perf_counter() - t0
+                self.centroids = new_c
+                self.iteration += 1
+                self.last_inertia = float(inertia)
+                converged = float(shift_sq) <= tol
+                if callback:
+                    callback(IterInfo(
+                        self.iteration, float(inertia), float(shift_sq), dt,
+                        converged,
+                    ))
+                saved = bool(checkpoint_path) and (
+                    self.iteration % checkpoint_every == 0 or converged
+                )
+                if saved:
+                    self.checkpoint(checkpoint_path, keep=checkpoint_keep)
+                if converged:
+                    break
+                # Mid-loop, exit promptly — running more iterations only
+                # races the grace window.  On the LAST iteration the loop
+                # is over either way; fall through to the post-loop
+                # policy, which knows whether anything was saved.
+                if guard.triggered and it < max_iter - 1:
+                    preempt_exit()
+            # The sweep loop is complete (converged or max_iter); only
+            # finalize()'s full labeling pass remains, which on a big
+            # dataset can blow the preemption grace window.  With a
+            # checkpoint, exit resumable now — the resumed run finalizes
+            # immediately.  With nothing saved, raising would discard the
+            # whole finished fit, while finishing risks only the finalize
+            # time the kill would cost anyway.
+            if guard.triggered and checkpoint_path is not None:
+                preempt_exit()
         return self.finalize(converged=converged)
 
     def finalize(self, *, converged: bool = False) -> KMeansState:
@@ -333,7 +368,7 @@ class LloydRunner:
         )
 
     # --------------------------------------------------------- checkpointing
-    def checkpoint(self, path: str) -> str:
+    def checkpoint(self, path: str, *, keep: int = 0) -> str:
         from kmeans_tpu.utils.checkpoint import save_checkpoint
 
         state = KMeansState(
@@ -346,6 +381,7 @@ class LloydRunner:
         )
         return save_checkpoint(
             path, state, step=self.iteration, config=self.cfg, key=self.key,
+            keep=keep,
         )
 
     def resume(self, path: str) -> int:

@@ -121,6 +121,7 @@ def fit_minibatch_stream(
     final_pass: bool = True,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 100,
+    checkpoint_keep: int = 0,
     resume: bool = False,
     mesh=None,
     data_axis: str = "data",
@@ -195,6 +196,14 @@ def fit_minibatch_stream(
             and data_is_f32)
     )
     transfer_width = "bfloat16" if to_bf16 else "float32"
+
+    # 0 is the documented final/preempt-saves-only mode (PeriodicSaver
+    # treats every < 1 as never-on-cadence; forced saves still land), but
+    # a negative cadence is always a caller bug — reject it up front.
+    if checkpoint_path and checkpoint_every < 0:
+        raise ValueError(
+            f"checkpoint_every must be >= 0, got {checkpoint_every}"
+        )
 
     start_step = 0
     c0 = None
@@ -309,6 +318,7 @@ def fit_minibatch_stream(
             extra={"stream": True, "host_seed": int(host_seed),
                    "batch_size": int(bs), "total_steps": int(n_steps),
                    "transfer_width": transfer_width, "mesh_dp": int(dp)},
+            keep=checkpoint_keep,
         )
 
     # Round AFTER resume resolution and WITHOUT rebinding bs: checkpoints
@@ -330,17 +340,49 @@ def fit_minibatch_stream(
         place = None
         step_fn = functools.partial(_stream_step,
                                     compute_dtype=cfg.compute_dtype)
+    from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
+
     batches = sample_batches(data, bs_eff, n_steps, seed=host_seed,
                              start_step=start_step, to_bf16=to_bf16)
     step = start_step
-    for xb in prefetch_to_device(batches, depth=prefetch_depth,
-                                 background=background_prefetch,
-                                 device=place):
-        c, n_seen = step_fn(c, n_seen, xb)
-        step += 1
-        saver.maybe(step, lambda c=c, ns=n_seen, t=step:
-                    checkpoint_now(c, ns, t))
-    saver.maybe(step, lambda: checkpoint_now(c, n_seen, step), force=True)
+    # Preemption safety: SIGTERM/SIGINT latches a flag; the loop notices
+    # at the next step boundary, cuts one final checkpoint (PeriodicSaver
+    # dedups against a cadence save at the same step), and exits with a
+    # resumable state — losing at most the step in flight, not the
+    # checkpoint_every window.
+    with PreemptionGuard() as guard:
+        for xb in prefetch_to_device(batches, depth=prefetch_depth,
+                                     background=background_prefetch,
+                                     device=place):
+            c, n_seen = step_fn(c, n_seen, xb)
+            step += 1
+            saver.maybe(step, lambda c=c, ns=n_seen, t=step:
+                        checkpoint_now(c, ns, t))
+            if guard.triggered and step < n_steps:
+                saver.maybe(step, lambda c=c, ns=n_seen, t=step:
+                            checkpoint_now(c, ns, t), force=True)
+                raise Preempted.during(
+                    f"fit_minibatch_stream preempted by signal at step "
+                    f"{step}/{n_steps}",
+                    path=checkpoint_path, step=step,
+                )
+        saver.maybe(step, lambda: checkpoint_now(c, n_seen, step),
+                    force=True)
+        # A signal during the LAST step lands here with the loop complete.
+        # With a checkpoint, exit resumable — with final_pass pending that
+        # pass can blow the preemption grace window on out-of-core data,
+        # and without it the state is already checkpointed so a resume
+        # completes trivially.  With NO checkpoint_path, raising would
+        # discard the whole finished streamed phase (nothing saved it) —
+        # finish instead, same post-loop policy as LloydRunner.run.
+        if guard.triggered and checkpoint_path is not None:
+            raise Preempted.during(
+                f"fit_minibatch_stream preempted by signal after the "
+                f"final step ({step}/{n_steps})" + (
+                    "; only the final labeling pass remains" if final_pass
+                    else "; streamed phase complete and checkpointed"),
+                path=checkpoint_path, step=step,
+            )
 
     if final_pass:
         labels_np, inertia = assign_stream(

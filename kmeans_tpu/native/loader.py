@@ -24,7 +24,30 @@ from typing import Optional
 
 import numpy as np
 
+from kmeans_tpu.utils import faults
+from kmeans_tpu.utils.retry import RetryError, RetryPolicy
+
 __all__ = ["gather_rows", "native_available", "to_bfloat16"]
+
+#: The g++ spawn can fail transiently (fork/ENOMEM pressure) — retry the
+#: SPAWN a couple of times before falling back to numpy.  A nonzero
+#: compiler exit is a deterministic source problem and is never retried,
+#: and neither is :class:`subprocess.TimeoutExpired`: a compile that blew
+#: the 120 s cap signals a slow environment where re-running would block
+#: ``gather_rows`` callers behind the module lock for minutes — fall
+#: straight back to the numpy path instead.
+_COMPILE_RETRY = RetryPolicy(
+    max_attempts=3, base_delay=0.1, max_delay=1.0,
+    retryable=lambda e: (
+        isinstance(e, (OSError, subprocess.SubprocessError))
+        # Deterministic failures must not burn backoff sleeps under the
+        # module lock: a blown 120 s cap signals a slow environment, and
+        # FileNotFoundError means g++ isn't installed at all — the
+        # common no-compiler host goes straight to the numpy fallback.
+        and not isinstance(e, (subprocess.TimeoutExpired,
+                               FileNotFoundError))
+    ),
+)
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "rowgather.cpp")
@@ -72,13 +95,18 @@ def _build() -> Optional[str]:
         os.close(fd)
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
                _SRC, "-o", tmp]
-        res = subprocess.run(cmd, capture_output=True, timeout=120)
+
+        def compile_once():
+            faults.check("native.compile")
+            return subprocess.run(cmd, capture_output=True, timeout=120)
+
+        res = _COMPILE_RETRY.call(compile_once)
         if res.returncode != 0:
             return None
         os.replace(tmp, so_path)
         tmp = None
         return so_path
-    except (OSError, subprocess.SubprocessError):
+    except (OSError, subprocess.SubprocessError, RetryError):
         return None
     finally:
         if tmp is not None:

@@ -19,9 +19,44 @@ from typing import Optional
 
 import jax
 
+from kmeans_tpu.utils import faults
+from kmeans_tpu.utils.retry import RetryPolicy
+
 __all__ = ["ensure_initialized", "is_multiprocess", "process_info"]
 
 _initialized = False
+
+def _transient_init_error(e: BaseException) -> bool:
+    """Retry only the bootstrap race, never a real config problem.
+
+    ``jax.distributed.initialize`` is not idempotent and wraps most
+    failures in ``RuntimeError``, so a blanket RuntimeError retry would
+    (a) re-dial after a partially-successful init and fail every retry
+    with "already initialized", and (b) burn the whole backoff budget on
+    a permanent misconfiguration.  Only connection-flavored messages —
+    the coordinator not listening yet — are transient.
+    """
+    if isinstance(e, (ConnectionError, OSError)):
+        return True
+    msg = str(e).lower()
+    if "already initialized" in msg:
+        return False
+    return isinstance(e, RuntimeError) and any(
+        s in msg for s in ("unavailable", "deadline", "connection",
+                           "refused", "timed out", "timeout", "reset")
+    )
+
+
+#: Multi-host bootstrap races: hosts start at slightly different times and
+#: the coordinator may not be listening yet when a worker dials in —
+#: ``jax.distributed.initialize`` then fails with a connection-flavored
+#: ``RuntimeError``/``OSError``.  A patient bounded retry turns the race
+#: into a rendezvous; exhaustion raises
+#: :class:`~kmeans_tpu.utils.retry.RetryError` with the last cause chained.
+_INIT_RETRY = RetryPolicy(
+    max_attempts=5, base_delay=0.5, max_delay=8.0, deadline=60.0,
+    retryable=_transient_init_error,
+)
 
 
 def ensure_initialized(
@@ -49,11 +84,39 @@ def ensure_initialized(
         # Single-process run — nothing to join.
         _initialized = True
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    def init_once():
+        faults.check("dist.init")
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    def reset_partial_init(attempt, exc):
+        # jax's State.initialize assigns client (and, on process 0, the
+        # service) BEFORE connect() and does not undo that on failure, so
+        # without a shutdown() every re-dial would die on jax's "should
+        # only be called once" guard instead of retrying the connect.
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # allow-silent-except: best-effort teardown of a half-dead client; if it refuses to shut down the next attempt fails loudly with jax's own error
+            pass
+
+    try:
+        _INIT_RETRY.call(init_once, on_retry=reset_partial_init)
+    except BaseException as e:
+        # on_retry only fires BETWEEN attempts — after the final failure
+        # (or a non-retryable one) the torn client is still assigned, and
+        # leaving it would make every later ensure_initialized() die on
+        # jax's "only be called once" guard instead of re-dialing once
+        # the coordinator comes back.  EXCEPT when the failure IS that
+        # guard on the very first attempt: then the live runtime belongs
+        # to an external jax.distributed.initialize() call and tearing it
+        # down would disconnect the whole process.
+        msg = str(e).lower()
+        if not ("only be called once" in msg or "already initialized" in msg):
+            reset_partial_init(0, None)
+        raise
     _initialized = True
 
 

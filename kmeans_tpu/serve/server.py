@@ -50,6 +50,7 @@ from kmeans_tpu.session import (
     suggestion_from_counts,
     trait_counts_for,
 )
+from kmeans_tpu.utils import faults
 from kmeans_tpu.utils.rooms import code4
 
 __all__ = ["KMeansServer", "serve"]
@@ -117,6 +118,16 @@ _MAX_ROOMS = 256
 
 class RoomTableFullError(RuntimeError):
     pass
+
+
+class CapacityError(RuntimeError):
+    """Server-wide train capacity exhausted -> 503 with ``Retry-After``.
+
+    The retry contract's server half: the handler surfaces this as HTTP
+    503 plus a ``Retry-After`` header, and the bundled client backs off
+    and retries instead of failing the train request (the client half of
+    the :mod:`kmeans_tpu.utils.retry` story).
+    """
 
 
 class PayloadTooLargeError(ValueError):
@@ -549,7 +560,10 @@ class KMeansServer:
         # One training per room AND a server-wide concurrency bound, so many
         # rooms can't stack unbounded worker threads.
         if not self._train_sem.acquire(blocking=False):
-            raise ValueError("server training capacity exhausted; retry later")
+            raise CapacityError(
+                "server training capacity exhausted; retry after "
+                f"{self.config.retry_after_s}s"
+            )
         if not room.train_lock.acquire(blocking=False):
             self._train_sem.release()
             raise ValueError("training already running in this room")
@@ -681,18 +695,32 @@ class KMeansServer:
                     self.send_header("Content-Length", str(length))
                 self.end_headers()
 
-            def _json(self, obj, status=HTTPStatus.OK):
+            def _json(self, obj, status=HTTPStatus.OK, extra=None):
                 body = json.dumps(obj).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 for k, v in _SECURITY_HEADERS.items():
                     self.send_header(k, v)
+                if extra:
+                    for k, v in extra.items():
+                        self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _error(self, msg, status=HTTPStatus.BAD_REQUEST):
-                self._json({"error": str(msg)}, status=status)
+            def _error(self, msg, status=HTTPStatus.BAD_REQUEST,
+                       extra=None):
+                self._json({"error": str(msg)}, status=status, extra=extra)
+
+            def _busy(self, msg):
+                """503 + Retry-After: the server-side half of the retry
+                contract — tell the client WHEN to come back, not just
+                that it failed."""
+                ra = int(server.config.retry_after_s)
+                self._error(
+                    msg, HTTPStatus.SERVICE_UNAVAILABLE,
+                    extra={"Retry-After": str(ra)},
+                )
 
             def _query(self):
                 return dict(urllib.parse.parse_qsl(
@@ -727,7 +755,7 @@ class KMeansServer:
                 try:
                     return self._do_get(path, q)
                 except RoomTableFullError as e:
-                    return self._error(e, HTTPStatus.SERVICE_UNAVAILABLE)
+                    return self._busy(e)
 
             def _do_get(self, path, q):
                 if path in ("/", "/index.html"):
@@ -802,6 +830,10 @@ class KMeansServer:
                             ev = {"type": "ping",
                                   "version": room.doc.version,
                                   "peers": max(0, room.peer_count() - 1)}
+                        # Injection site for the fault harness: an
+                        # InjectedFault is an OSError, so it exercises the
+                        # same unsubscribe path a torn client socket does.
+                        faults.check("serve.sse_emit")
                         self.wfile.write(
                             f"data: {json.dumps(ev)}\n\n".encode()
                         )
@@ -853,8 +885,8 @@ class KMeansServer:
                     self._error(e, HTTPStatus.REQUEST_ENTITY_TOO_LARGE)
                 except CentroidLimitError as e:
                     self._error(str(e), HTTPStatus.CONFLICT)
-                except RoomTableFullError as e:
-                    self._error(e, HTTPStatus.SERVICE_UNAVAILABLE)
+                except (RoomTableFullError, CapacityError) as e:
+                    self._busy(e)
                 except (KeyError, ValueError, TypeError) as e:
                     self._error(e)
 

@@ -119,7 +119,8 @@ async function maybeRestoreCache() {
     restoringCache = false;
   }
 }
-async function mutate(op, args = {}) {
+const MUTATE_MAX_RETRIES = 4;
+async function mutate(op, args = {}, attempt = 0) {
   if (degraded) {
     alert("Server unreachable — showing the cached board read-only.");
     return null;
@@ -138,7 +139,32 @@ async function mutate(op, args = {}) {
     return null;
   }
   const out = await r.json();
-  if (!r.ok) { alert(out.error || "Request failed"); return null; }
+  const t = $id("trainStatus");
+  if (r.status === 503 && op === "train" && attempt < MUTATE_MAX_RETRIES) {
+    // Train capacity exhausted: the server says WHEN to come back via
+    // Retry-After — honor it with a growing backoff instead of failing
+    // the request on the user.  Only the train op retries: it has a
+    // status line to narrate the wait, while a silent multi-second stall
+    // on a board mutation would read as a dead click.
+    const ra = parseFloat(r.headers.get("Retry-After")) || 2;
+    const waitS = ra * (attempt + 1);
+    if (t) {
+      // The chip ships display:none and is normally unhidden by the
+      // first train SSE event — which hasn't happened when the very
+      // first click hits capacity, so unhide it here too.
+      t.style.display = "";
+      t.textContent = `server busy — retrying in ${waitS}s…`;
+    }
+    await new Promise((res) => setTimeout(res, waitS * 1000));
+    return mutate(op, args, attempt + 1);
+  }
+  if (!r.ok) {
+    // Don't leave a stale "retrying…" line contradicting the alert when
+    // the retry budget is exhausted.
+    if (t && attempt > 0) { t.textContent = ""; t.style.display = "none"; }
+    alert(out.error || "Request failed");
+    return null;
+  }
   // The versioned SSE "change" event triggers exactly one state fetch per
   // version bump — but only while the stream is open; during a reconnect
   // window a successful mutation must still render.

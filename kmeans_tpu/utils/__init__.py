@@ -1,17 +1,25 @@
-"""Shared helpers: room codes/ids, checkpointing, profiling."""
+"""Shared helpers: room codes/ids, checkpointing, retries, faults, profiling."""
 
 from kmeans_tpu.utils.checkpoint import (
+    CorruptCheckpointError,
     latest_step,
     load_checkpoint,
     save_checkpoint,
 )
+from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
 from kmeans_tpu.utils.profiling import Timer, trace
+from kmeans_tpu.utils.retry import RetryError, RetryPolicy
 from kmeans_tpu.utils.rooms import code4, initials, new_card_id, new_centroid_id
 
 __all__ = [
+    "CorruptCheckpointError",
     "latest_step",
     "load_checkpoint",
     "save_checkpoint",
+    "Preempted",
+    "PreemptionGuard",
+    "RetryError",
+    "RetryPolicy",
     "Timer",
     "trace",
     "code4",

@@ -8,23 +8,44 @@ format works in minimal environments.
 
 Layout (a directory):
     <path>/arrays/...        orbax PyTree (or arrays.npz)
-    <path>/meta.json         step, config, rng key data, format tag
+    <path>/meta.json         step, config, rng key data, format tag,
+                             per-array SHA-256 digests (format v2)
+
+Failure model (docs/RESILIENCE.md): saves are atomic (tmp dir + rename
+swap), loads are *verified* — every array is re-hashed against the digest
+manifest in ``meta.json``, and a final dir that is corrupt (not merely
+missing) falls back to the ``.old`` dir kept during the swap and then to
+the ``keep=N`` step-tagged retention dirs, newest first.  Pre-digest (v1)
+checkpoints have no manifest and load unverified, exactly as before.
+Every write-side step carries a named fault-injection site
+(:mod:`kmeans_tpu.utils.faults`), and tests/test_faults.py kills the
+process at each one to prove a complete checkpoint always survives.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import glob
+import hashlib
 import json
 import os
+import shutil
+import sys
 from typing import Any, Optional, Tuple
 
 import numpy as np
 
 from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.utils import faults
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
            "save_array_checkpoint", "load_array_checkpoint",
-           "resolve_resume_params", "PeriodicSaver"]
+           "resolve_resume_params", "PeriodicSaver",
+           "CorruptCheckpointError"]
+
+
+class CorruptCheckpointError(ValueError):
+    """Checkpoint data exists at the path but no candidate dir verifies."""
 
 
 def resolve_resume_params(ck: dict, specs) -> dict:
@@ -87,6 +108,17 @@ def _state_arrays(state) -> dict:
     }
 
 
+def _digest(v: np.ndarray) -> str:
+    """SHA-256 over (dtype, shape, bytes) — torn or bit-flipped array data
+    cannot verify, and neither can a shape/dtype reinterpretation."""
+    v = np.ascontiguousarray(v)
+    h = hashlib.sha256()
+    h.update(str(v.dtype).encode())
+    h.update(str(v.shape).encode())
+    h.update(v.tobytes())
+    return h.hexdigest()
+
+
 def save_checkpoint(
     path: str,
     state,
@@ -95,6 +127,7 @@ def save_checkpoint(
     config: Optional[KMeansConfig] = None,
     key=None,
     extra: Optional[dict] = None,
+    keep: int = 0,
 ) -> str:
     """Write a resumable KMeansState checkpoint; returns ``path``.
 
@@ -103,8 +136,29 @@ def save_checkpoint(
     """
     return save_array_checkpoint(
         path, _state_arrays(state), step=step, config=config, key=key,
-        extra=extra,
+        extra=extra, keep=keep,
     )
+
+
+def _step_dirs(path: str) -> list:
+    """Step-tagged retention dirs for ``path``, newest step first."""
+    out = []
+    # glob.escape: a checkpoint path containing glob metacharacters
+    # ("run[1]/ck") must not silently disable retention/fallback.
+    for p in glob.glob(glob.escape(path) + ".step-*"):
+        try:
+            out.append((int(p.rsplit(".step-", 1)[1]), p))
+        except ValueError:
+            continue
+    return [p for _, p in sorted(out, reverse=True)]
+
+
+def _meta_step(dirpath: str) -> Optional[int]:
+    try:
+        with open(os.path.join(dirpath, _META), "r", encoding="utf-8") as f:
+            return int(json.load(f)["step"])
+    except (OSError, ValueError, KeyError):
+        return None
 
 
 def save_array_checkpoint(
@@ -115,19 +169,28 @@ def save_array_checkpoint(
     config: Optional[KMeansConfig] = None,
     key=None,
     extra: Optional[dict] = None,
+    keep: int = 0,
 ) -> str:
     """Write a resumable checkpoint of an arbitrary flat array dict.
 
     Atomic against crashes: everything is written into ``<path>.tmp`` first,
     then swapped into place, so ``<path>`` always holds a complete,
     self-consistent (arrays, meta) pair (SURVEY.md §5.3 failure recovery).
+    ``meta.json`` carries a SHA-256 digest per array (format v2), so a
+    torn or bit-rotted dir is *detected* at load and the previous good
+    state wins instead.
+
+    With ``keep >= 1`` the displaced previous checkpoint is retained as a
+    step-tagged sibling (``<path>.step-<NNNNNNNN>``) and at most ``keep``
+    such dirs survive, newest first — a rolling history for workloads
+    where the newest checkpoint being corrupt must not mean starting over.
     """
     final_path = path
     path = path + ".tmp"
-    import shutil
 
     shutil.rmtree(path, ignore_errors=True)
     os.makedirs(path, exist_ok=True)
+    faults.check("ckpt.pre_write")
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
     # Orbax refuses zero-size arrays (e.g. the runner's empty labels in
     # periodic checkpoints) — record their shapes/dtypes in the metadata and
@@ -151,6 +214,7 @@ def save_array_checkpoint(
     except Exception:
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
 
+    faults.check("ckpt.pre_meta")
     key_data = None
     if key is not None:
         import jax
@@ -158,55 +222,159 @@ def save_array_checkpoint(
         key_data = np.asarray(jax.random.key_data(key)).tolist()
     meta = {
         "format": fmt,
+        "version": 2,
         "step": int(step),
         "config": dataclasses.asdict(config) if config else None,
         "key_data": key_data,
         "empty_arrays": empty,
+        "digests": {k: _digest(v) for k, v in arrays.items()},
         "extra": extra or {},
     }
     with open(os.path.join(path, _META), "w", encoding="utf-8") as f:
         json.dump(meta, f, indent=2)
 
     # Swap the finished tmp dir into place.  A crash mid-swap can leave
-    # <path>.old / .tmp litter but never a torn <path>.
+    # <path>.old / .tmp / .step-* litter but never a torn <path>: the
+    # load side resolves final -> .old -> step-tagged, each digest-
+    # verified, so every kill point leaves a complete loadable state.
     old = final_path + ".old"
-    shutil.rmtree(old, ignore_errors=True)
+    faults.check("ckpt.pre_rename")
     if os.path.exists(final_path):
-        os.rename(final_path, old)
+        prev_step = _meta_step(final_path) if keep > 0 else None
+        if prev_step is not None:
+            dest = f"{final_path}.step-{prev_step:08d}"
+            shutil.rmtree(dest, ignore_errors=True)
+            os.rename(final_path, dest)
+        else:
+            # Clear stale .old only here, where the displaced final
+            # immediately replaces it.  When final_path does NOT exist
+            # (a prior crash at ckpt.mid_swap left .old as the ONLY good
+            # copy) the .old dir must survive until the new final lands —
+            # deleting it up front would make a second crash in the
+            # pre_rename..mid_swap window lose everything.
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(final_path, old)
+    faults.check("ckpt.mid_swap")
     os.rename(path, final_path)
+    faults.check("ckpt.post_rename")
     shutil.rmtree(old, ignore_errors=True)
+    if keep > 0:
+        for stale in _step_dirs(final_path)[keep:]:
+            shutil.rmtree(stale, ignore_errors=True)
     return final_path
 
 
-def _resolve_dir(path: str) -> str:
-    """The checkpoint dir to read: ``<path>``, else the ``<path>.old`` kept
-    during the save swap.  A crash between the two renames in
-    :func:`save_checkpoint` leaves only ``.old`` — which holds the previous
-    complete checkpoint, so resuming from it is always safe."""
-    if os.path.exists(os.path.join(path, _META)):
-        return path
-    old = path + ".old"
-    if os.path.exists(os.path.join(old, _META)):
-        return old
-    return path
-
-
-def load_array_checkpoint(path: str) -> Tuple[dict, dict]:
-    """Returns ``(arrays, meta)`` — arrays as jnp arrays; ``meta['key']``
-    is a rebuilt PRNG key when one was saved.  Falls back to ``<path>.old``
-    when a crash during a save swap left no directory at ``<path>``."""
-    path = _resolve_dir(path)
-    with open(os.path.join(path, _META), "r", encoding="utf-8") as f:
+def _load_raw(dirpath: str) -> Tuple[dict, dict]:
+    """``(np arrays, meta)`` from one candidate dir; raises on any problem."""
+    with open(os.path.join(dirpath, _META), "r", encoding="utf-8") as f:
         meta = json.load(f)
-
     if meta["format"] == "orbax":
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
-        arrays = ckptr.restore(os.path.join(os.path.abspath(path), "arrays"))
+        arrays = ckptr.restore(os.path.join(os.path.abspath(dirpath),
+                                            "arrays"))
     else:
-        with np.load(os.path.join(path, "arrays.npz")) as z:
+        with np.load(os.path.join(dirpath, "arrays.npz")) as z:
             arrays = {k: z[k] for k in z.files}
+    return {k: np.asarray(v) for k, v in arrays.items()}, meta
+
+
+def _read_verified(dirpath: str) -> Optional[Tuple[dict, dict]]:
+    """Load + digest-verify one candidate dir; None when absent/corrupt.
+
+    A v1 checkpoint (no ``digests`` manifest) loads unverified — backward
+    compatibility is part of the format contract.
+    """
+    if not os.path.exists(os.path.join(dirpath, _META)):
+        return None
+    try:
+        arrays, meta = _load_raw(dirpath)
+        digests = meta.get("digests")
+        if digests is not None:
+            if set(digests) != set(arrays):
+                raise CorruptCheckpointError(
+                    f"{dirpath}: array set {sorted(arrays)} does not match "
+                    f"the digest manifest {sorted(digests)}"
+                )
+            for name, want in digests.items():
+                got = _digest(arrays[name])
+                if got != want:
+                    raise CorruptCheckpointError(
+                        f"{dirpath}: array {name!r} digest mismatch"
+                    )
+        return arrays, meta
+    except ImportError:
+        # A missing backend (orbax checkpoint read on a host without
+        # orbax) is an ENVIRONMENT problem, not data corruption — calling
+        # it corrupt would silently fall back to stale state or report
+        # "all copies torn" for perfectly good data.
+        raise
+    except Exception as e:
+        # Any read/parse/verify failure means THIS candidate is torn or
+        # rotted; the caller falls back to the next one (and reports which
+        # candidate actually served the load).  Name the reason here —
+        # when EVERY copy is bad this line is the only diagnosis the
+        # user gets of which array/file actually failed.
+        print(f"kmeans_tpu.checkpoint: candidate {dirpath!r} failed "
+              f"verification: {e}", file=sys.stderr)
+        return None
+
+
+def _candidates(path: str) -> list:
+    """Load-resolution order: every candidate (final, the ``.old`` kept
+    during the save swap, step-tagged retention dirs), newest recorded
+    step first; ties keep final → ``.old`` → step-tagged precedence.
+
+    Ordering by the (cheap) ``meta.json`` step rather than by role
+    matters after stacked crashes: a stale ``.old`` from an older run's
+    swap window must not outrank a newer step-tagged retention dir and
+    silently roll a resume back further than necessary.  A candidate
+    with no readable step sorts last — verification would reject it
+    anyway."""
+    cands = [path, path + ".old"] + _step_dirs(path)
+    steps = {c: s for c in cands if (s := _meta_step(c)) is not None}
+    return sorted(cands, key=lambda c: -steps.get(c, -1))
+
+
+def load_array_checkpoint(path: str) -> Tuple[dict, dict]:
+    """Returns ``(arrays, meta)`` — arrays as jnp arrays; ``meta['key']``
+    is a rebuilt PRNG key when one was saved.
+
+    Verify-on-load: every candidate dir (``<path>``, ``<path>.old``,
+    step-tagged retention), newest recorded step first, is digest-checked
+    and the first *complete* one wins — a present-but-corrupt final dir
+    falls back instead of loading blind.  Raises
+    :class:`FileNotFoundError` when nothing exists at the path,
+    :class:`CorruptCheckpointError` when data exists but no candidate
+    verifies.
+    """
+    chosen = None
+    for cand in _candidates(path):
+        got = _read_verified(cand)
+        if got is not None:
+            chosen = (cand, got)
+            break
+    if chosen is None:
+        # Checkpoint DATA means a meta.json somewhere — a bare pre-created
+        # dir (mkdir before --resume, or --resume pointed at a plain data
+        # dir) is "no checkpoint was ever written here", not "your
+        # checkpoint is corrupt".
+        if not any(os.path.exists(os.path.join(c, _META))
+                   for c in _candidates(path)):
+            raise FileNotFoundError(
+                f"no checkpoint at {path!r} (nor .old / step-tagged "
+                "fallbacks)"
+            )
+        raise CorruptCheckpointError(
+            f"checkpoint at {path!r} exists but no candidate dir passes "
+            "digest verification — all copies are torn or corrupt"
+        )
+    cand, (arrays, meta) = chosen
+    if cand != path:
+        print(f"kmeans_tpu.checkpoint: {path!r} is missing or corrupt; "
+              f"loaded verified fallback {cand!r} (step {meta.get('step')})",
+              file=sys.stderr)
     for name, spec in (meta.get("empty_arrays") or {}).items():
         arrays[name] = np.zeros(spec["shape"], dtype=spec["dtype"])
 
@@ -242,10 +410,15 @@ def load_checkpoint(path: str) -> Tuple[Any, dict]:
 
 
 def latest_step(path: str) -> Optional[int]:
-    try:
-        with open(
-            os.path.join(_resolve_dir(path), _META), "r", encoding="utf-8"
-        ) as f:
-            return int(json.load(f)["step"])
-    except (OSError, ValueError, KeyError):
-        return None
+    """Step of the first candidate dir with readable metadata, or None.
+
+    Deliberately cheap (metadata only, no array hashing): callers use it
+    as an existence probe before committing to a resume;
+    :func:`load_array_checkpoint` does the full digest-verified
+    resolution.
+    """
+    for cand in _candidates(path):
+        step = _meta_step(cand)
+        if step is not None:
+            return step
+    return None

@@ -25,7 +25,7 @@ import jax  # noqa: E402
 
 try:
     jax.config.update("jax_platforms", "cpu")
-except Exception:  # already initialized with cpu available — fall through
+except Exception:  # allow-silent-except: already initialized with cpu available — fall through
     pass
 
 # NOTE: do NOT enable jax's persistent compilation cache for this suite.

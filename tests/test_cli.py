@@ -542,3 +542,112 @@ def test_cli_gmm_covariance_type(capsys):
                "--covariance-type", "tied"])       # lloyd ignores it
     assert rc == 2
     assert "--covariance-type" in capsys.readouterr().err
+
+
+def test_cli_missing_input_one_line_error(tmp_path, capsys):
+    """A missing --input path is one actionable line + exit 2, never a
+    traceback (ISSUE 1 CLI contract)."""
+    missing = str(tmp_path / "missing.npy")
+    for extra in ([], ["--stream", "--model", "minibatch", "--steps", "2"]):
+        rc, _, err = _run(capsys, [
+            "train", "--input", missing, "--k", "3", *extra,
+        ])
+        assert rc == 2, extra
+        assert "Traceback" not in err
+        assert "error: cannot load" in err and "missing.npy" in err
+
+
+def test_cli_corrupt_npy_one_line_error(tmp_path, capsys):
+    garbage = tmp_path / "garbage.npy"
+    garbage.write_bytes(b"this is not an npy file at all")
+    rc, _, err = _run(capsys, [
+        "train", "--input", str(garbage), "--k", "3",
+    ])
+    assert rc == 2
+    assert "Traceback" not in err
+    assert "error: cannot load" in err
+
+
+def test_cli_truncated_npy_one_line_error(tmp_path, capsys):
+    """A short/truncated .npy (torn download, partial write) reports the
+    same one-line contract on both the in-memory and --stream paths."""
+    trunc = tmp_path / "trunc.npy"
+    np.save(trunc, np.zeros((100, 10), np.float32))
+    with open(trunc, "r+b") as f:
+        f.truncate(200)
+    for extra in ([], ["--stream", "--model", "minibatch", "--steps", "2"]):
+        rc, _, err = _run(capsys, [
+            "train", "--input", str(trunc), "--k", "3", *extra,
+        ])
+        assert rc == 2, extra
+        assert "Traceback" not in err
+        assert "error: cannot load" in err
+
+
+def test_cli_runner_resume_corrupt_one_line_error(tmp_path, capsys):
+    """The Lloyd-runner --resume path shares the one-line contract: a
+    torn checkpoint dir is 'error: cannot resume ...' + exit 2, and a
+    missing one reports the same way, never a traceback."""
+    data = tmp_path / "x.npy"
+    np.save(data, np.random.default_rng(0).normal(
+        size=(200, 4)).astype(np.float32))
+    torn = tmp_path / "ck"
+    torn.mkdir()
+    (torn / "meta.json").write_text("{torn")
+    for resume in (str(torn), str(tmp_path / "nope")):
+        rc, _, err = _run(capsys, [
+            "train", "--input", str(data), "--k", "3", "--max-iter", "2",
+            "--resume", resume,
+        ])
+        assert rc == 2, resume
+        assert "Traceback" not in err
+        assert "error: cannot resume" in err
+
+
+def test_cli_checkpoint_keep_creates_step_dirs(tmp_path, capsys):
+    """--checkpoint-keep reaches the streamed fits end to end: displaced
+    checkpoints survive as step-tagged siblings, pruned to N."""
+    import os
+
+    data = tmp_path / "x.npy"
+    np.save(data, np.random.default_rng(0).normal(
+        size=(400, 4)).astype(np.float32))
+    rc, _, _ = _run(capsys, [
+        "train", "--input", str(data), "--k", "3", "--stream",
+        "--model", "minibatch", "--steps", "4", "--batch-size", "64",
+        "--checkpoint", str(tmp_path / "ck"), "--checkpoint-every", "1",
+        "--checkpoint-keep", "2",
+    ])
+    assert rc == 0
+    tagged = sorted(p for p in os.listdir(tmp_path)
+                    if p.startswith("ck.step-"))
+    assert tagged == ["ck.step-00000002", "ck.step-00000003"]
+
+
+def test_cli_checkpoint_keep_reaches_lloyd_runner(tmp_path, capsys):
+    """--checkpoint-keep also reaches the non-stream LloydRunner path."""
+    import os
+
+    data = tmp_path / "x.npy"
+    np.save(data, np.random.default_rng(0).normal(
+        size=(400, 4)).astype(np.float32))
+    rc, _, _ = _run(capsys, [
+        "train", "--input", str(data), "--k", "3", "--max-iter", "4",
+        "--tol", "0",
+        "--checkpoint", str(tmp_path / "ck"), "--checkpoint-every", "1",
+        "--checkpoint-keep", "2",
+    ])
+    assert rc == 0
+    tagged = [p for p in os.listdir(tmp_path) if p.startswith("ck.step-")]
+    assert len(tagged) == 2
+
+
+def test_cli_sweep_corrupt_input_one_line_error(tmp_path, capsys):
+    garbage = tmp_path / "garbage.npy"
+    garbage.write_bytes(b"\x00" * 16)
+    rc, _, err = _run(capsys, [
+        "sweep", "--input", str(garbage), "--k-min", "2", "--k-max", "3",
+    ])
+    assert rc == 2
+    assert "Traceback" not in err
+    assert "error: cannot load" in err

@@ -58,3 +58,99 @@ def test_ensure_initialized_noop_without_config():
     # No coordinator configured: must be a harmless no-op (and idempotent).
     ensure_initialized()
     ensure_initialized()
+
+
+def test_ensure_initialized_retries_and_resets_partial_init(monkeypatch):
+    """A failed connect leaves jax's global client assigned (State.initialize
+    sets it BEFORE connect() with no cleanup), so each re-dial must be
+    preceded by a shutdown() or it dies on jax's "only be called once"
+    guard instead of retrying the bootstrap race."""
+    import jax
+
+    from kmeans_tpu.parallel import distributed as D
+    from kmeans_tpu.utils.retry import RetryPolicy
+
+    calls = {"init": 0, "shutdown": 0}
+
+    def fake_init(**kw):
+        calls["init"] += 1
+        if calls["shutdown"] < calls["init"] - 1:
+            # A re-dial without the cleanup in between: reproduce jax's
+            # non-retryable guard so a missing shutdown() fails the test.
+            raise RuntimeError(
+                "distributed.initialize should only be called once.")
+        if calls["init"] < 3:
+            raise RuntimeError("connection refused: coordinator unavailable")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: calls.__setitem__(
+                            "shutdown", calls["shutdown"] + 1))
+    monkeypatch.setattr(D, "_initialized", False)
+    monkeypatch.setattr(D, "_INIT_RETRY", RetryPolicy(
+        max_attempts=4, base_delay=0.01, max_delay=0.02,
+        retryable=D._transient_init_error,
+    ))
+    D.ensure_initialized("127.0.0.1:1", 2, 1)
+    assert calls == {"init": 3, "shutdown": 2}
+    assert D._initialized
+
+
+def test_ensure_initialized_cleans_up_after_exhaustion(monkeypatch):
+    """on_retry only fires BETWEEN attempts — the final failure must also
+    tear down the half-dead client, or every later ensure_initialized()
+    dies on jax's "only be called once" guard instead of re-dialing."""
+    import jax
+
+    from kmeans_tpu.parallel import distributed as D
+    from kmeans_tpu.utils.retry import RetryError, RetryPolicy
+
+    calls = {"init": 0, "shutdown": 0}
+
+    def fake_init(**kw):
+        calls["init"] += 1
+        if calls["shutdown"] < calls["init"] - 1:
+            raise RuntimeError(
+                "distributed.initialize should only be called once.")
+        if calls["init"] <= 2:
+            raise RuntimeError("connection refused: coordinator unavailable")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: calls.__setitem__(
+                            "shutdown", calls["shutdown"] + 1))
+    monkeypatch.setattr(D, "_initialized", False)
+    monkeypatch.setattr(D, "_INIT_RETRY", RetryPolicy(
+        max_attempts=2, base_delay=0.01, max_delay=0.02,
+        retryable=D._transient_init_error,
+    ))
+    with pytest.raises(RetryError):
+        D.ensure_initialized("127.0.0.1:1", 2, 1)
+    assert calls == {"init": 2, "shutdown": 2}   # between + after-final
+    assert not D._initialized
+    # The coordinator comes back: the SAME process can now rendezvous.
+    D.ensure_initialized("127.0.0.1:1", 2, 1)
+    assert D._initialized and calls["init"] == 3
+
+
+def test_ensure_initialized_leaves_foreign_init_intact(monkeypatch):
+    """When jax.distributed was initialized OUTSIDE this module, the
+    failure-path cleanup must not tear down the live runtime."""
+    import jax
+
+    from kmeans_tpu.parallel import distributed as D
+
+    calls = {"shutdown": 0}
+
+    def fake_init(**kw):
+        raise RuntimeError(
+            "distributed.initialize should only be called once.")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: calls.__setitem__(
+                            "shutdown", calls["shutdown"] + 1))
+    monkeypatch.setattr(D, "_initialized", False)
+    with pytest.raises(RuntimeError, match="only be called once"):
+        D.ensure_initialized("127.0.0.1:1", 2, 1)
+    assert calls["shutdown"] == 0

@@ -1,0 +1,723 @@
+"""Fault-injection crash matrix (docs/RESILIENCE.md).
+
+The hardened failure paths are only trustworthy because this file drives
+them: the process is KILLED at every checkpoint-write injection site and
+the checkpoint must still load digest-verified; transient stream-read
+faults must be absorbed by the retry policy with bit-identical results;
+SIGTERM mid-fit must end in a resumable checkpoint; corrupt data must
+fall back to the previous good copy, and pre-digest (v1) checkpoints
+must keep loading.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.utils import faults
+from kmeans_tpu.utils.checkpoint import (
+    CorruptCheckpointError,
+    latest_step,
+    load_array_checkpoint,
+    save_array_checkpoint,
+)
+from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
+from kmeans_tpu.utils.retry import RetryError, RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """A test that dies mid-``faults.active`` must not poison the rest of
+    the suite with a live plan."""
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar / plan mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    plan = faults.parse_spec(
+        "ckpt.mid_swap:kill@2;stream.read:raise@3x2;io.slow:stall=0.5;"
+        "seed=42;flaky.*:raise?0.25"
+    )
+    assert plan.seed == 42
+    r = {x.site: x for x in plan.rules}
+    assert r["ckpt.mid_swap"].action == "kill"
+    assert r["ckpt.mid_swap"].nth == 2
+    assert (r["stream.read"].nth, r["stream.read"].count) == (3, 2)
+    assert r["io.slow"].action == "stall" and r["io.slow"].param == 0.5
+    assert r["flaky.*"].prob == 0.25
+
+
+def test_parse_spec_count_without_nth():
+    # The documented permanent-fault form "x0" needs no @NTH.
+    r = faults.parse_spec("s:raisex0").rules[0]
+    assert (r.action, r.nth, r.count) == ("raise", 1, 0)
+    r = faults.parse_spec("s:stall=0.5x3").rules[0]
+    assert (r.action, r.param, r.count) == ("stall", 0.5, 3)
+
+
+@pytest.mark.parametrize("bad", [
+    "no-colon-here", "site:unknown_action", "s:raise@0", "s:raise?1.5",
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_nth_window_and_permanent():
+    # @2x2: hits 2 and 3 fire, 1 and 4 don't.
+    plan = faults.parse_spec("a:raise@2x2")
+    with faults.active(plan):
+        faults.check("a")                       # hit 1: quiet
+        for _ in range(2):                      # hits 2, 3: fire
+            with pytest.raises(faults.InjectedFault):
+                faults.check("a")
+        faults.check("a")                       # hit 4: quiet again
+    # x0 = permanent from NTH on.
+    with faults.active("b:raise@1x0"):
+        for _ in range(5):
+            with pytest.raises(faults.InjectedFault):
+                faults.check("b")
+
+
+def test_glob_sites_and_hit_counter():
+    with faults.active("ckpt.*:raise@3") as plan:
+        faults.check("ckpt.pre_write")
+        faults.check("ckpt.pre_meta")
+        with pytest.raises(faults.InjectedFault):
+            faults.check("ckpt.pre_rename")
+        assert plan.hits("ckpt.pre_rename") == 3   # shared glob counter
+    # inactive => zero-cost no-op
+    faults.check("ckpt.pre_write")
+
+
+def test_injected_fault_is_oserror():
+    # The retry default treats OSError as transient; the injected fault
+    # must ride that path.
+    assert issubclass(faults.InjectedFault, OSError)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_absorbs_transient_then_succeeds():
+    calls = []
+    seen = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=4, base_delay=0.001)
+    assert p.call(flaky, on_retry=lambda a, e: seen.append(a)) == "ok"
+    assert len(calls) == 3
+    assert seen == [1, 2]
+
+
+def test_retry_exhaustion_raises_retryerror_with_cause():
+    p = RetryPolicy(max_attempts=3, base_delay=0.001)
+    with pytest.raises(RetryError) as ei:
+        p.call(lambda: (_ for _ in ()).throw(OSError("always")))
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_retry_nonretryable_propagates_immediately():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise KeyError("permanent")
+
+    with pytest.raises(KeyError):
+        RetryPolicy(max_attempts=5, base_delay=0.001).call(boom)
+    assert len(calls) == 1
+
+
+def test_retry_predicate_form():
+    p = RetryPolicy(max_attempts=2, base_delay=0.001,
+                    retryable=lambda e: "yes" in str(e))
+    with pytest.raises(RetryError):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("yes retry")))
+    with pytest.raises(ValueError):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("no")))
+
+
+def test_retry_schedule_bounded_by_max_delay():
+    p = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.2,
+                    multiplier=2.0)
+    assert list(p.delays()) == [0.1, 0.2, 0.2, 0.2]
+
+
+def test_retry_deadline_cuts_budget_short():
+    p = RetryPolicy(max_attempts=50, base_delay=0.2, jitter=0.0,
+                    deadline=0.05)
+    with pytest.raises(RetryError) as ei:
+        p.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    # The first backoff (0.2s) already overshoots the 0.05s deadline:
+    # exactly one attempt runs, no sleep is paid.
+    assert ei.value.attempts == 1
+
+
+def test_retry_jitter_decorrelated_across_calls(monkeypatch):
+    """Two call()s on ONE policy must not sleep identical schedules —
+    lockstep "jitter" across N racing hosts is the thundering herd the
+    jitter exists to break."""
+    import time
+
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    p = RetryPolicy(max_attempts=4, base_delay=0.1, jitter=0.5)
+    for _ in range(2):
+        with pytest.raises(RetryError):
+            p.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    assert len(sleeps) == 6
+    assert sleeps[:3] != sleeps[3:]
+
+
+# ---------------------------------------------------------------------------
+# Crash matrix: kill the process at every checkpoint-write site
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import os, sys
+# Force the npz path: the orbax import costs seconds per subprocess and
+# the swap/rename machinery under test is format-agnostic.
+sys.modules["orbax"] = None
+sys.modules["orbax.checkpoint"] = None
+import numpy as np
+from kmeans_tpu.utils.checkpoint import save_array_checkpoint
+path, keep = sys.argv[1], int(sys.argv[2])
+save_array_checkpoint(path, {"c": np.full((4, 3), 1.0, np.float32)},
+                      step=1, keep=keep)
+save_array_checkpoint(path, {"c": np.full((4, 3), 2.0, np.float32)},
+                      step=2, keep=keep)
+os._exit(7)
+"""
+
+
+def _run_child(path, *, keep=0, fault=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("KMEANS_TPU_FAULTS", None)
+    if fault:
+        env["KMEANS_TPU_FAULTS"] = fault
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD, str(path), str(keep)],
+        env=env, capture_output=True, timeout=120,
+    )
+
+
+def test_crash_matrix_harness_sanity(tmp_path):
+    """No fault installed: the child runs both saves and exits 7."""
+    path = str(tmp_path / "ck")
+    res = _run_child(path)
+    assert res.returncode == 7, res.stderr.decode()
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["step"] == 2 and meta["digests"]
+
+
+def test_bad_env_spec_is_one_line_error(tmp_path):
+    """A typo'd KMEANS_TPU_FAULTS must refuse to run — one actionable
+    line, no traceback, and definitely no silently-unfaulted drill."""
+    res = _run_child(str(tmp_path / "ck"), fault="ckpt.mid_swap:kil@2")
+    assert res.returncode == 1
+    err = res.stderr.decode()
+    assert "bad KMEANS_TPU_FAULTS spec" in err
+    assert "Traceback" not in err
+
+
+# Expected surviving step per kill site: anything before the final rename
+# preserves the step-1 checkpoint (mid_swap via the .old / step-tagged
+# fallback); a kill after it means step 2 already landed complete.
+_MATRIX = [
+    ("ckpt.pre_write", 1),
+    ("ckpt.pre_meta", 1),
+    ("ckpt.pre_rename", 1),
+    ("ckpt.mid_swap", 1),
+    ("ckpt.post_rename", 2),
+]
+
+
+@pytest.mark.parametrize("site,want_step", _MATRIX)
+def test_crash_matrix_kill_every_site(tmp_path, site, want_step):
+    path = str(tmp_path / "ck")
+    res = _run_child(path, keep=0, fault=f"{site}:kill@2")
+    assert res.returncode == 137, (site, res.stderr.decode())
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["step"] == want_step, site
+    np.testing.assert_array_equal(
+        np.asarray(arrays["c"]),
+        np.full((4, 3), float(want_step), np.float32),
+    )
+    assert latest_step(path) == want_step
+
+
+@pytest.mark.parametrize("site,want_step", [
+    ("ckpt.mid_swap", 1), ("ckpt.post_rename", 2),
+])
+def test_crash_matrix_kill_with_retention(tmp_path, site, want_step):
+    """The two sites whose recovery path changes under keep=N: mid_swap's
+    displaced previous checkpoint is a step-tagged dir (not .old), and
+    post_rename dies before retention pruning."""
+    path = str(tmp_path / "ck")
+    res = _run_child(path, keep=1, fault=f"{site}:kill@2")
+    assert res.returncode == 137, (site, res.stderr.decode())
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["step"] == want_step, site
+    np.testing.assert_array_equal(
+        np.asarray(arrays["c"]),
+        np.full((4, 3), float(want_step), np.float32),
+    )
+
+
+def test_crash_matrix_kill_during_first_save(tmp_path):
+    """A kill before any checkpoint ever landed: load reports not-found,
+    never a torn partial state."""
+    path = str(tmp_path / "ck")
+    res = _run_child(path, fault="ckpt.pre_meta:kill@1")
+    assert res.returncode == 137
+    with pytest.raises(FileNotFoundError):
+        load_array_checkpoint(path)
+    assert latest_step(path) is None
+
+
+# ---------------------------------------------------------------------------
+# Verify-on-load: corruption detection + fallback, v1 back-compat, keep=N
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def npz_format(monkeypatch):
+    """Force the npz checkpoint format so tests can corrupt known bytes."""
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+
+
+def _save(path, value, step, **kw):
+    save_array_checkpoint(
+        path, {"c": np.full((4, 3), float(value), np.float32)}, step=step,
+        **kw,
+    )
+
+
+def test_corrupt_final_falls_back_to_old(tmp_path, npz_format, capsys):
+    path = str(tmp_path / "ck")
+    _save(path, 1, 1)
+    stash = str(tmp_path / "stash")
+    shutil.copytree(path, stash)
+    _save(path, 2, 2)
+    # Recreate the swap window's .old (a completed save removes it), then
+    # rot the final dir's array data.
+    shutil.copytree(stash, path + ".old")
+    with open(os.path.join(path, "arrays.npz"), "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef")
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(arrays["c"]), np.full((4, 3), 1.0, np.float32)
+    )
+    assert "fallback" in capsys.readouterr().err
+
+
+def test_digest_mismatch_detected_not_loaded_blind(tmp_path, npz_format):
+    """Bit-rot that np.load happily parses (valid npz, wrong values) is
+    caught by the digest manifest — the pre-v2 loader would return it."""
+    path = str(tmp_path / "ck")
+    _save(path, 1, 1)
+    np.savez(os.path.join(path, "arrays.npz"),
+             c=np.full((4, 3), 9.0, np.float32))
+    with pytest.raises(CorruptCheckpointError):
+        load_array_checkpoint(path)
+
+
+def test_all_candidates_corrupt_raises_corrupt_error(tmp_path, npz_format):
+    path = str(tmp_path / "ck")
+    _save(path, 1, 1)
+    shutil.copytree(path, path + ".old")
+    for d in (path, path + ".old"):
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            f.write("{torn")
+    with pytest.raises(CorruptCheckpointError):
+        load_array_checkpoint(path)
+
+
+def test_missing_checkpoint_raises_filenotfound(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_array_checkpoint(str(tmp_path / "nope"))
+
+
+def test_empty_precreated_dir_reports_not_found_not_corrupt(tmp_path):
+    """mkdir before --resume (or --resume at a plain data dir): no
+    meta.json anywhere means no checkpoint was ever written — that must
+    report not-found, not 'all copies are torn or corrupt'."""
+    path = tmp_path / "ck"
+    path.mkdir()
+    (path / "unrelated.txt").write_text("not a checkpoint")
+    with pytest.raises(FileNotFoundError):
+        load_array_checkpoint(str(path))
+    assert latest_step(str(path)) is None
+
+
+def test_stale_old_does_not_outrank_newer_step_dir(tmp_path, npz_format):
+    """Stacked-crash window: a keep=0 crash leaves .old at step 10; a
+    later keep>0 save displaces final to .step-15 and dies mid-swap.
+    Resolution must serve the NEWEST verified copy (step 15), not roll
+    back to the stale .old just because of its role."""
+    path = str(tmp_path / "ck")
+    _save(path, 10, 10)
+    stash = str(tmp_path / "stash10")
+    shutil.copytree(path, stash)
+    _save(path, 15, 15)
+    shutil.copytree(stash, path + ".old")         # stale swap-window relic
+    os.rename(path, path + ".step-00000015")      # keep>0 displace...
+    # ...and the crash hits before <path>.tmp lands: final missing.
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["step"] == 15
+    np.testing.assert_array_equal(
+        np.asarray(arrays["c"]), np.full((4, 3), 15.0, np.float32)
+    )
+    assert latest_step(path) == 15
+
+
+def test_v1_digestless_checkpoint_still_loads(tmp_path, npz_format):
+    """Pre-digest checkpoints have no manifest: they load unverified,
+    exactly as before the format bump."""
+    path = str(tmp_path / "ck")
+    _save(path, 3, 5)
+    mp = os.path.join(path, "meta.json")
+    with open(mp) as f:
+        meta = json.load(f)
+    del meta["digests"]
+    del meta["version"]
+    with open(mp, "w") as f:
+        json.dump(meta, f)
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["step"] == 5
+    np.testing.assert_array_equal(
+        np.asarray(arrays["c"]), np.full((4, 3), 3.0, np.float32)
+    )
+
+
+def test_checkpoint_path_with_glob_metachars(tmp_path, npz_format):
+    """Retention and fallback must survive a path containing glob
+    metacharacters ("run[1]/ck") — the step-dir scan escapes the path."""
+    base = tmp_path / "run[1]"
+    base.mkdir()
+    path = str(base / "ck")
+    for step in (1, 2, 3):
+        _save(path, step, step, keep=2)
+    assert latest_step(path) == 3
+    tagged = sorted(p for p in os.listdir(base) if p.startswith("ck.step-"))
+    assert tagged == ["ck.step-00000001", "ck.step-00000002"]
+    with open(os.path.join(path, "arrays.npz"), "r+b") as f:
+        f.write(b"\x00\x00\x00\x00")
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["step"] == 2
+
+
+def test_keep_retention_and_fallback_chain(tmp_path, npz_format):
+    path = str(tmp_path / "ck")
+    for step in (1, 2, 3, 4):
+        _save(path, step, step, keep=2)
+    # keep=2: only the two newest displaced checkpoints survive.
+    tagged = sorted(p for p in os.listdir(tmp_path)
+                    if p.startswith("ck.step-"))
+    assert tagged == ["ck.step-00000002", "ck.step-00000003"]
+    assert latest_step(path) == 4
+    # Corrupt the final dir: the newest step-tagged dir serves the load.
+    with open(os.path.join(path, "arrays.npz"), "r+b") as f:
+        f.write(b"\x00\x00\x00\x00")
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["step"] == 3
+    np.testing.assert_array_equal(
+        np.asarray(arrays["c"]), np.full((4, 3), 3.0, np.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Transient stream faults: absorbed by the retry policy, bit-identical
+# ---------------------------------------------------------------------------
+
+_FAST_RETRY = RetryPolicy(max_attempts=4, base_delay=0.001, max_delay=0.01)
+
+
+@pytest.fixture(scope="module")
+def blob_data():
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 8)).astype(np.float32) * 4
+    lab = rng.integers(0, 4, size=(600,))
+    return (centers[lab] + rng.normal(size=(600, 8))).astype(np.float32)
+
+
+def test_stream_read_transient_fault_bit_identical(blob_data):
+    from kmeans_tpu.data.stream import sample_batches
+
+    clean = list(sample_batches(blob_data, 64, 6, seed=3))
+    with faults.active("stream.read:raise@2x2") as plan:
+        faulty = list(sample_batches(blob_data, 64, 6, seed=3,
+                                     retry=_FAST_RETRY))
+        assert plan.hits("stream.read") > 6   # the retries really happened
+    assert len(faulty) == len(clean)
+    for a, b in zip(clean, faulty):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stream_read_permanent_fault_raises_retryerror(blob_data):
+    from kmeans_tpu.data.stream import sample_batches
+
+    with faults.active("stream.read:raise@1x0"):
+        with pytest.raises(RetryError):
+            list(sample_batches(blob_data, 64, 3, seed=3,
+                                retry=_FAST_RETRY))
+
+
+def test_fit_under_transient_faults_matches_clean(blob_data):
+    from kmeans_tpu.models import fit_minibatch_stream
+
+    kw = dict(init=blob_data[:4], batch_size=128, steps=12, seed=5,
+              background_prefetch=False, final_pass=False)
+    clean = fit_minibatch_stream(blob_data, 4, **kw)
+    with faults.active(
+        faults.FaultPlan([faults.FaultRule(site="stream.read",
+                                           action="raise", nth=3, count=2)])
+    ):
+        # READ_RETRY (4 attempts) absorbs the 2-hit burst; the retried
+        # reads are pure functions of (seed, step) so the trajectory is
+        # bit-identical.
+        faulty = fit_minibatch_stream(blob_data, 4, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(clean.centroids), np.asarray(faulty.centroids)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Preemption: SIGTERM mid-fit -> final checkpoint -> resumable
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_guard_latches_and_restores():
+    import time
+
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as g:
+        assert not g.triggered
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not g.triggered and time.monotonic() < deadline:
+            time.sleep(0.005)   # handler runs at the next bytecode check
+        assert g.triggered
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_streaming_fit_preempted_resumes_bit_identical(blob_data, tmp_path):
+    from kmeans_tpu.models import fit_minibatch_stream
+
+    path = str(tmp_path / "ck")
+    kw = dict(batch_size=128, steps=24, seed=5,
+              background_prefetch=False, final_pass=False)
+    clean = fit_minibatch_stream(blob_data, 4, init=blob_data[:4], **kw)
+
+    # SIGTERM delivered from inside a host read (the 7th); the loop cuts
+    # one final checkpoint at the step boundary and raises Preempted.
+    with faults.active("stream.read:sigterm@7"):
+        with pytest.raises(Preempted) as ei:
+            fit_minibatch_stream(
+                blob_data, 4, init=blob_data[:4],
+                checkpoint_path=path, checkpoint_every=10 ** 9, **kw,
+            )
+    assert ei.value.path == path
+    assert 0 < ei.value.step < 24
+    assert latest_step(path) == ei.value.step
+
+    resumed = fit_minibatch_stream(
+        blob_data, 4, checkpoint_path=path, resume=True, **kw,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(clean.centroids), np.asarray(resumed.centroids)
+    )
+
+
+def test_streaming_fit_preempted_on_final_step_exits_resumable(
+        blob_data, tmp_path):
+    """A signal during the LAST step must not be silently swallowed when
+    the expensive final labeling pass is still pending: the fit exits
+    resumable, and the resumed run (only the final pass remains) matches
+    an undisturbed one bit-for-bit."""
+    from kmeans_tpu.models import fit_minibatch_stream
+
+    path = str(tmp_path / "ck")
+    kw = dict(batch_size=128, steps=6, seed=5, background_prefetch=False,
+              final_pass=True)
+    clean = fit_minibatch_stream(blob_data, 4, init=blob_data[:4], **kw)
+    # checkpoint_every=1 makes the 6th ckpt.pre_write hit the step-6 save:
+    # the signal latches during the final step's checkpoint, after which
+    # only the final pass remains.
+    with faults.active("ckpt.pre_write:sigterm@6"):
+        with pytest.raises(Preempted) as ei:
+            fit_minibatch_stream(
+                blob_data, 4, init=blob_data[:4],
+                checkpoint_path=path, checkpoint_every=1, **kw,
+            )
+    assert ei.value.step == 6
+    assert latest_step(path) == 6
+    resumed = fit_minibatch_stream(
+        blob_data, 4, checkpoint_path=path, resume=True, **kw,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(clean.centroids), np.asarray(resumed.centroids)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(clean.labels), np.asarray(resumed.labels)
+    )
+
+
+def test_streaming_fit_signal_on_last_step_raises_without_final_pass(
+        blob_data, tmp_path):
+    """final_pass=False must not turn a last-step signal into a silent
+    swallow: the guard's contract is that an arrived signal always
+    surfaces, even when nothing but the return remains."""
+    from kmeans_tpu.models import fit_minibatch_stream
+
+    path = str(tmp_path / "ck")
+    with faults.active("ckpt.pre_write:sigterm@6"):
+        with pytest.raises(Preempted) as ei:
+            fit_minibatch_stream(
+                blob_data, 4, init=blob_data[:4], batch_size=128, steps=6,
+                seed=5, background_prefetch=False, final_pass=False,
+                checkpoint_path=path, checkpoint_every=1,
+            )
+    assert ei.value.step == 6
+    assert latest_step(path) == 6
+
+
+def test_streaming_fit_signal_on_last_step_without_checkpoint_returns(
+        blob_data):
+    """With NO checkpoint_path, a signal landing on the last step must
+    not throw away the finished streamed phase: nothing saved it, so
+    raising Preempted would lose strictly more than returning — same
+    post-loop policy as the runner's uncheckpointed convergence case."""
+    from kmeans_tpu.models import fit_minibatch_stream
+
+    # steps=1 + sigterm on the first read: the signal latches during the
+    # prefetch fill, the loop still completes its only step (1 < 1 fails
+    # the mid-loop gate), and control reaches the post-loop window with
+    # the guard triggered and nothing checkpointed.
+    with faults.active("stream.read:sigterm@1"):
+        out = fit_minibatch_stream(
+            blob_data, 4, init=blob_data[:4], batch_size=128, steps=1,
+            seed=5, background_prefetch=False, final_pass=False,
+        )
+    assert np.isfinite(np.asarray(out.centroids)).all()
+
+
+def test_runner_preempted_on_last_iteration_exits_resumable(
+        blob_data, tmp_path):
+    """finalize()'s full labeling pass is still pending when the signal
+    lands on the last allowed iteration — the runner must exit resumable
+    instead of swallowing the signal and labeling anyway."""
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import LloydRunner
+
+    path = str(tmp_path / "ck")
+
+    def send_sigterm(info):
+        if info.iteration == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    r = LloydRunner(blob_data, 4, config=KMeansConfig(k=4, seed=7))
+    r.init(blob_data[:4])
+    with pytest.raises(Preempted) as ei:
+        r.run(max_iter=3, tol=0.0, checkpoint_path=path,
+              checkpoint_every=10 ** 6, callback=send_sigterm)
+    assert ei.value.step == 3
+    assert latest_step(path) == 3
+
+
+def test_gmm_stream_fit_preempted_resumes(blob_data, tmp_path):
+    from kmeans_tpu.models import fit_gmm_stream
+
+    path = str(tmp_path / "ck")
+    kw = dict(batch_size=128, steps=20, seed=5, background_prefetch=False,
+              final_pass=False)
+    with faults.active("stream.read:sigterm@5"):
+        with pytest.raises(Preempted) as ei:
+            fit_gmm_stream(blob_data, 3, checkpoint_path=path,
+                           checkpoint_every=10 ** 9, **kw)
+    assert latest_step(path) == ei.value.step
+    out = fit_gmm_stream(blob_data, 3, checkpoint_path=path, resume=True,
+                         **kw)
+    assert np.isfinite(np.asarray(out.means)).all()
+
+
+def test_runner_preempted_cuts_checkpoint_and_resumes(blob_data, tmp_path):
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import LloydRunner
+
+    path = str(tmp_path / "ck")
+
+    def send_sigterm(info):
+        if info.iteration == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    r1 = LloydRunner(blob_data, 4, config=KMeansConfig(k=4, seed=7))
+    r1.init(blob_data[:4])
+    with pytest.raises(Preempted) as ei:
+        r1.run(max_iter=50, tol=0.0, checkpoint_path=path,
+               checkpoint_every=10 ** 6, callback=send_sigterm)
+    assert ei.value.step == 2
+    assert latest_step(path) == 2
+
+    r2 = LloydRunner(blob_data, 4, config=KMeansConfig(k=4, seed=7))
+    assert r2.resume(path) == 2
+    np.testing.assert_array_equal(
+        np.asarray(r2.centroids), np.asarray(r1.centroids)
+    )
+    state = r2.run(max_iter=50, tol=1e-10)
+    assert bool(state.converged)
+
+
+def test_runner_signal_on_converged_run_without_checkpoint_returns(
+        blob_data):
+    """A signal landing on the converging iteration of an UNcheckpointed
+    run must not discard the finished fit: nothing saved it, so raising
+    Preempted would lose strictly more than finishing finalize()."""
+    import time
+
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import LloydRunner
+
+    def send_sigterm(info):
+        if info.converged:
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.01)   # let the latching handler run
+
+    r = LloydRunner(blob_data, 4, config=KMeansConfig(k=4, seed=7))
+    r.init(blob_data[:4])
+    state = r.run(max_iter=100, tol=1e-8, callback=send_sigterm)
+    assert bool(state.converged)
+
+
+def test_compile_retry_skips_deterministic_failures():
+    """Missing g++ / a blown compile cap are permanent: no backoff burn
+    under the native loader's module lock."""
+    from kmeans_tpu.native.loader import _COMPILE_RETRY
+
+    assert not _COMPILE_RETRY.retryable(FileNotFoundError("g++"))
+    assert not _COMPILE_RETRY.retryable(
+        subprocess.TimeoutExpired("g++", 120))
+    assert _COMPILE_RETRY.retryable(BlockingIOError("fork pressure"))
+    assert _COMPILE_RETRY.retryable(subprocess.SubprocessError("spawn"))

@@ -1,0 +1,65 @@
+"""Failure paths stay loud: the tools/check_excepts.py lint, run in-suite.
+
+A silent ``except Exception: pass`` anywhere in the tree would quietly
+undo the resilience contract (docs/RESILIENCE.md) — so the lint both runs
+against the real repo here and has its own detector unit tests.
+"""
+
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import check_excepts  # noqa: E402
+
+
+def test_repo_has_no_silent_failure_paths():
+    violations = check_excepts.run(_ROOT)
+    assert not violations, "\n".join(
+        f"{rel}:{line}: {msg}" for rel, line, msg in violations
+    )
+
+
+def _scan(tmp_path, src):
+    p = tmp_path / "sample.py"
+    p.write_text(src)
+    return check_excepts.scan_file(str(p))
+
+
+def test_detects_bare_except(tmp_path):
+    out = _scan(tmp_path, "try:\n    x()\nexcept:\n    handle()\n")
+    assert len(out) == 1 and "bare" in out[0][1]
+
+
+@pytest.mark.parametrize("exc", ["Exception", "BaseException",
+                                 "(ValueError, Exception)"])
+def test_detects_silent_broad_except(tmp_path, exc):
+    out = _scan(tmp_path, f"try:\n    x()\nexcept {exc}:\n    pass\n")
+    assert len(out) == 1 and "silently" in out[0][1]
+
+
+def test_allowlist_marker_suppresses(tmp_path):
+    out = _scan(
+        tmp_path,
+        "try:\n    x()\n"
+        "except Exception:  # allow-silent-except: best-effort cleanup\n"
+        "    pass\n",
+    )
+    assert out == []
+
+
+def test_handled_broad_except_is_fine(tmp_path):
+    out = _scan(
+        tmp_path,
+        "try:\n    x()\nexcept Exception as e:\n    log(e)\n",
+    )
+    assert out == []
+
+
+def test_narrow_silent_except_is_fine(tmp_path):
+    # Swallowing a NAMED exception is a deliberate, reviewable choice.
+    out = _scan(tmp_path, "try:\n    x()\nexcept KeyError:\n    pass\n")
+    assert out == []

@@ -972,3 +972,73 @@ def test_sse_soak_slow_clients_burst_no_leak(server):
     assert server.room(room).peer_count() == 0
     assert threading.active_count() <= threads_before + 1, (
         threads_before, threading.active_count())
+
+
+def _post_with_headers(server, path, obj):
+    """Like _post but also returns the response headers — the 503 retry
+    contract lives in a header (Retry-After)."""
+    req = urllib.request.Request(
+        server.base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_train_capacity_exhausted_503_retry_after(server):
+    """ISSUE 1 satellite: the capacity path is a 503 with Retry-After —
+    machine-readable backoff, not a generic failure."""
+    cap = server.config.max_concurrent_train
+    for _ in range(cap):
+        assert server._train_sem.acquire(blocking=False)
+    try:
+        st, headers, out = _post_with_headers(
+            server, "/api/mutate?room=CAPA",
+            {"op": "train", "args": {"n": 100, "d": 2, "k": 2}},
+        )
+        assert st == 503
+        assert headers["Retry-After"] == str(server.config.retry_after_s)
+        assert "capacity" in out["error"]
+    finally:
+        for _ in range(cap):
+            server._train_sem.release()
+    # With capacity back, the same request is accepted.
+    st, _, out = _post_with_headers(
+        server, "/api/mutate?room=CAPA",
+        {"op": "train", "args": {"n": 100, "d": 2, "k": 2}},
+    )
+    assert st == 200 and out["started"]
+    # Drain the accepted train before returning: a worker thread still
+    # inside the jax fit at interpreter teardown aborts the pytest
+    # process (exit 134) even with every test green.  The worker releases
+    # train_lock in its finally, so reacquiring it means the fit is done.
+    import time as _time
+
+    deadline = _time.time() + 30
+    while not server.room("CAPA").train_lock.acquire(blocking=False):
+        assert _time.time() < deadline, "accepted train never finished"
+        _time.sleep(0.05)
+    server.room("CAPA").train_lock.release()
+
+
+def test_room_table_full_503_retry_after(server):
+    """Both capacity paths share the 503 + Retry-After contract."""
+    from kmeans_tpu.serve.server import _MAX_ROOMS
+
+    import queue as _queue
+
+    for i in range(_MAX_ROOMS):
+        room = server.room(f"T{i}")
+        room.subscribers[-1] = _queue.Queue()   # pin: undiscardable room
+    try:
+        st, headers, _ = _post_with_headers(
+            server, "/api/hello?room=ZFUL", {"name": "Ada"})
+        assert st == 503
+        assert headers["Retry-After"] == str(server.config.retry_after_s)
+    finally:
+        for i in range(_MAX_ROOMS):
+            if f"T{i}" in server.rooms:
+                server.rooms[f"T{i}"].subscribers.clear()

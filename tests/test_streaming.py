@@ -435,3 +435,55 @@ def test_gmm_stream_mesh_kill9_resume_matches(tmp_path):
     np.testing.assert_allclose(np.asarray(got.mix_weights),
                                np.asarray(want.mix_weights),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_prefetch_background_stalled_producer_warns(monkeypatch):
+    """A producer wedged in the upstream iterator can't poll the stop
+    flag; teardown must name the leaked thread loudly instead of
+    silently abandoning it (ISSUE 1 satellite)."""
+    import threading
+    import warnings
+
+    from kmeans_tpu.data import stream
+
+    monkeypatch.setattr(stream, "_JOIN_TIMEOUT", 0.3)
+    never = threading.Event()
+
+    def stalling_batches():
+        yield np.zeros((4, 2), np.float32)
+        never.wait()   # wedged mid-next(): unreachable by the stop flag
+
+    gen = prefetch_to_device(stalling_batches(), depth=1, background=True)
+    next(gen)
+    with pytest.warns(RuntimeWarning, match="kt-prefetch.*still alive"):
+        gen.close()
+    never.set()        # unwedge so the daemon thread exits promptly
+
+
+def test_prefetch_background_clean_teardown_no_warning():
+    """The complement: a cooperative producer joins inside the timeout
+    and teardown stays silent."""
+    import warnings
+
+    gen = prefetch_to_device(
+        iter([np.zeros((4, 2), np.float32)] * 3), depth=1, background=True,
+    )
+    next(gen)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        gen.close()
+
+
+@pytest.mark.parametrize("gmm", [False, True])
+def test_stream_checkpoint_every_negative_rejected(tmp_path, gmm):
+    """A negative cadence is always a caller bug and is rejected up
+    front; 0 stays the documented final/preempt-saves-only mode (see
+    test_stream_resume_with_missing_checkpoint_starts_fresh)."""
+    from kmeans_tpu.models import fit_gmm_stream
+
+    x = np.random.default_rng(0).normal(size=(256, 4)).astype(np.float32)
+    fit = fit_gmm_stream if gmm else fit_minibatch_stream
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        fit(x, 3, batch_size=64, steps=2, final_pass=False,
+            background_prefetch=False,
+            checkpoint_path=str(tmp_path / "ck"), checkpoint_every=-1)

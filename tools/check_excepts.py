@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Lint: failure paths must stay loud.
+
+Scans the repo's Python sources and reports
+
+1. bare ``except:`` handlers (they swallow ``KeyboardInterrupt`` and
+   ``SystemExit`` — never acceptable), and
+2. ``except Exception`` / ``except BaseException`` handlers whose body is
+   ONLY ``pass`` / ``...`` — a silently-eaten failure.
+
+Case 2 may be allowlisted where the swallow is genuinely deliberate by
+putting the marker comment on the ``except`` line::
+
+    except Exception:  # allow-silent-except: <why this must be silent>
+        pass
+
+The marker forces the *reason* into the diff, which is the point: the
+resilience work (docs/RESILIENCE.md) depends on failures surfacing, and
+this lint keeps new silent handlers from creeping in.  Run directly
+(``python tools/check_excepts.py``) or via the test suite
+(tests/test_lint_excepts.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: Directories / files scanned, relative to the repo root.
+SCAN = ["kmeans_tpu", "tools", "tests", "docs", "bench.py",
+        "__graft_entry__.py"]
+
+ALLOW_MARKER = "allow-silent-except:"
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(node) -> bool:
+    """True for ``Exception``/``BaseException`` or a tuple containing one."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in _BROAD
+    if isinstance(node, ast.Tuple):
+        return any(_is_broad(e) for e in node.elts)
+    return False
+
+
+def _is_silent(body) -> bool:
+    return all(
+        isinstance(stmt, ast.Pass)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+        for stmt in body
+    )
+
+
+def scan_file(path: str) -> list:
+    """Violations in one file as ``(lineno, message)`` tuples."""
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append((node.lineno,
+                        "bare `except:` — name the exceptions (it also "
+                        "catches KeyboardInterrupt/SystemExit)"))
+            continue
+        if _is_broad(node.type) and _is_silent(node.body):
+            line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if ALLOW_MARKER not in line:
+                out.append((
+                    node.lineno,
+                    "`except Exception: pass` swallows failures silently — "
+                    "handle, log, or annotate the except line with "
+                    f"`# {ALLOW_MARKER} <reason>`",
+                ))
+    return out
+
+
+def iter_sources(root: str):
+    for entry in SCAN:
+        path = os.path.join(root, entry)
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for dirpath, _dirnames, filenames in os.walk(path):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def run(root: str) -> list:
+    """All violations under ``root`` as ``(relpath, lineno, msg)``."""
+    out = []
+    for path in iter_sources(root):
+        for lineno, msg in scan_file(path):
+            out.append((os.path.relpath(path, root), lineno, msg))
+    return out
+
+
+def main(argv=None) -> int:
+    root = (argv or sys.argv[1:] or
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))])[0]
+    violations = run(root)
+    for rel, lineno, msg in violations:
+        print(f"{rel}:{lineno}: {msg}")
+    if violations:
+        print(f"{len(violations)} silent failure path(s); see "
+              "tools/check_excepts.py for the contract", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -411,9 +411,27 @@ def check_pallas_vs_xla(n=65_536, d=2048, k=1000, *, verbose=False):
     return res
 
 
+def _emit_window(telemetry, window_s, iters, *, n, d, k, update, backend):
+    """One telemetry event per timed window, in the engine's ``iter``
+    schema (docs/OBSERVABILITY.md): ``seconds`` is the per-iteration wall
+    time this window sustained, so ``min_s`` over the stream reproduces
+    the bench's best-of-N headline exactly
+    (kmeans_tpu.obs.summarize_events is the shared derivation)."""
+    if telemetry is None:
+        return
+    import jax
+
+    telemetry.event(
+        "iter", seconds=window_s / iters, model="bench_lloyd",
+        device=jax.devices()[0].platform,
+        phase="step", iters_per_window=iters, n=n, d=d, k=k,
+        update=update, backend=backend,
+    )
+
+
 def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
                             chunk_size=65536, verbose=False, backend="auto",
-                            update="delta"):
+                            update="delta", telemetry=None):
     """One Lloyd iteration rate, using ALL local devices (DP-sharded when
     more than one chip is present, so iter/s ÷ n_chips is honest).
 
@@ -421,7 +439,10 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
     (kmeans_tpu.ops.delta): every sweep runs the full distance matmul, but
     the one-hot update only covers rows whose label changed — the
     production update="delta" fit path.  ``update="full"`` measures the
-    classic fused pass (both matmuls every sweep).
+    classic fused pass (both matmuls every sweep).  ``telemetry``
+    (a :class:`kmeans_tpu.obs.TelemetryWriter`) receives one ``iter``
+    event per timed window — the same stream the production fits emit,
+    so bench and production report identical numbers.
     """
     import functools
 
@@ -613,7 +634,10 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
             for _ in range(iters):
                 state = step(x, state, w)
             jax.block_until_ready(state)
-            dt = min(dt, time.perf_counter() - t0)
+            w_dt = time.perf_counter() - t0
+            _emit_window(telemetry, w_dt, iters, n=n, d=d, k=k,
+                         update=update, backend=backend)
+            dt = min(dt, w_dt)
     elif n_dev <= 1 and update in ("delta", "hamerly"):
         # State-carrying loop.  Warm-up runs TWO sweeps: the first is the
         # all-rows-changed full reduction (sentinel labels), the second is
@@ -635,7 +659,10 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
             for _ in range(iters):
                 state = step(x, state)
             jax.block_until_ready(state)
-            dt = min(dt, time.perf_counter() - t0)
+            w_dt = time.perf_counter() - t0
+            _emit_window(telemetry, w_dt, iters, n=n, d=d, k=k,
+                         update=update, backend=backend)
+            dt = min(dt, w_dt)
     else:
         # Warm-up / compile.
         c = step(x, c0, *args)
@@ -647,7 +674,10 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
             for _ in range(iters):
                 c = step(x, c, *args)
             c.block_until_ready()
-            dt = min(dt, time.perf_counter() - t0)
+            w_dt = time.perf_counter() - t0
+            _emit_window(telemetry, w_dt, iters, n=n, d=d, k=k,
+                         update=update, backend=backend)
+            dt = min(dt, w_dt)
     rate = iters / dt
     bench_lloyd_iters_per_s.last_update = update    # what actually ran
     # The backend the timed sweeps ACTUALLY ran: the delta branches
@@ -912,6 +942,12 @@ def main():
                          "synthetic headline config k=1000 quantizes 64 "
                          "generator blobs, score gaps are tiny and delta "
                          "wins)")
+    ap.add_argument("--telemetry", default=None, metavar="OUT.jsonl",
+                    help="write one 'iter' telemetry event per timed "
+                         "window to this JSONL file — the same event "
+                         "schema the production fits emit "
+                         "(docs/OBSERVABILITY.md); render with "
+                         "tools/bench_table.py --telemetry")
     ap.add_argument("--watchdog-s", type=float, default=2700.0,
                     help="whole-run hang backstop: if the benches have not "
                          "finished after this many seconds (tunnel death "
@@ -963,6 +999,12 @@ def main():
     fresh = {}
     run_watchdog = _arm_watchdog(metric, unit, args.watchdog_s, "bench run",
                                  args.update, fresh)
+    tw = None
+    if args.telemetry:
+        from kmeans_tpu.obs import TelemetryWriter
+
+        tw = TelemetryWriter(args.telemetry, common={"metric": metric})
+    args._telemetry_writer = tw
     try:
         line = _run_benches(args, metric, unit, fresh)
     except Exception as e:
@@ -973,6 +1015,9 @@ def main():
         # The converge half may have measured fresh this run before the
         # headline raised — report it over any stale carried value.
         _merge_fresh_conv(line, fresh, unit)
+    finally:
+        if tw is not None:
+            tw.close()
     run_watchdog.set()
     print(json.dumps(line), flush=True)
 
@@ -987,6 +1032,7 @@ def _run_benches(args, metric, unit, fresh=None):
     """
     if fresh is None:
         fresh = {}
+    tw = getattr(args, "_telemetry_writer", None)
     init_watchdog = _arm_watchdog(metric, unit, 180.0, "jax backend init",
                                   args.update)
     import jax
@@ -1105,7 +1151,7 @@ def _run_benches(args, metric, unit, fresh=None):
         # CI/CPU fallback: scaled-down shape so the line still prints.
         rate = bench_lloyd_iters_per_s(
             20_000, 256, 64, iters=args.iters, verbose=True,
-            backend=args.backend,
+            backend=args.backend, telemetry=tw,
         )
         line = {
             "metric": "lloyd_iters_per_sec_per_chip_cpu_fallback_20k_256_64",
@@ -1117,7 +1163,7 @@ def _run_benches(args, metric, unit, fresh=None):
         try:
             rate = bench_lloyd_iters_per_s(iters=args.iters, verbose=True,
                                            backend=args.backend,
-                                           update=args.update)
+                                           update=args.update, telemetry=tw)
         except Exception as e:
             # Round 3's fatal path: an OOM here escaped and the artifact
             # was empty.  Free whatever the earlier halves left on the
@@ -1130,7 +1176,7 @@ def _run_benches(args, metric, unit, fresh=None):
             _free_device_buffers()
             rate = bench_lloyd_iters_per_s(iters=args.iters, verbose=True,
                                            backend=args.backend,
-                                           update=args.update)
+                                           update=args.update, telemetry=tw)
         per_chip = rate / max(1, n_chips)
         line = {
             "metric": "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000",

@@ -11,7 +11,15 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import kmeans_tpu  # noqa: E402
-from kmeans_tpu import config, data, metrics, models, ops, parallel  # noqa: E402
+from kmeans_tpu import (  # noqa: E402
+    config,
+    data,
+    metrics,
+    models,
+    obs,
+    ops,
+    parallel,
+)
 
 print("""# Public API index
 
@@ -36,6 +44,7 @@ for title, mod in (
     ("`kmeans_tpu.ops`", ops),
     ("`kmeans_tpu.data`", data),
     ("`kmeans_tpu.metrics`", metrics),
+    ("`kmeans_tpu.obs`", obs),
     ("`kmeans_tpu.config`", config),
 ):
     pub = getattr(mod, "__all__", None) or sorted(

@@ -180,7 +180,8 @@ def _cmd_train(args) -> int:
                   f"{' --stream' if args.stream else ''}", file=sys.stderr)
             return 2
         runner_flags = bool(args.progress or args.checkpoint
-                            or args.resume or args.profile)
+                            or args.resume or args.profile
+                            or args.telemetry)
         if args.update in ("delta", "hamerly") and model != "lloyd":
             print(f"error: --update {args.update} (the incremental sweep) "
                   "runs only in the lloyd family; accelerated/spherical/"
@@ -190,16 +191,16 @@ def _cmd_train(args) -> int:
         if args.update == "delta" and runner_flags and args.mesh \
                 and args.mesh > 1:
             print("error: --update delta with runner flags (--progress/"
-                  "--checkpoint/--resume/--profile) runs single-device "
-                  "only; the mesh runner steps the dense reduction — drop "
-                  "--mesh or the runner flags, or use --update auto",
-                  file=sys.stderr)
+                  "--checkpoint/--resume/--profile/--telemetry) runs "
+                  "single-device only; the mesh runner steps the dense "
+                  "reduction — drop --mesh or the runner flags, or use "
+                  "--update auto", file=sys.stderr)
             return 2
         if args.update == "hamerly" and runner_flags:
             print("error: --update hamerly runs the fit_lloyd loops "
                   "(single-device or DP mesh), not the step-wise runner; "
-                  "drop --progress/--checkpoint/--resume/--profile or use "
-                  "--update auto", file=sys.stderr)
+                  "drop --progress/--checkpoint/--resume/--profile/"
+                  "--telemetry or use --update auto", file=sys.stderr)
             return 2
 
     if args.steps is not None and args.steps < 1:
@@ -230,21 +231,25 @@ def _cmd_train(args) -> int:
 
     # --checkpoint/--resume ride the step-wise Lloyd runner OR the streamed
     # fits (both checkpoint natively); --progress/--profile are
-    # runner-only.
+    # runner-only.  --telemetry needs a step-paced loop (runner or
+    # streamed) — the one-shot fused fits have no iteration boundary to
+    # emit events at.
     stream_ckpt = args.stream and (args.checkpoint or args.resume)
     want_runner = not args.stream and bool(
         args.progress or args.checkpoint or args.resume or args.profile
+        or args.telemetry
     )
     if args.stream and (args.progress or args.profile):
         print("error: --progress/--profile require the full-batch Lloyd "
-              "runner; the streamed paths support --checkpoint/--resume",
-              file=sys.stderr)
+              "runner; the streamed paths support --checkpoint/--resume/"
+              "--telemetry", file=sys.stderr)
         return 2
     if want_runner and model != "lloyd":
         print(
-            "error: --progress/--checkpoint/--resume/--profile require the "
-            "full-batch Lloyd path (they would be silently ignored "
-            f"with --model {model}); use --model lloyd or drop those flags",
+            "error: --progress/--checkpoint/--resume/--profile/--telemetry "
+            "require a step-paced loop (they would be silently ignored "
+            f"with the one-shot --model {model}); use --model lloyd, "
+            "--stream, or drop those flags",
             file=sys.stderr,
         )
         return 2
@@ -339,19 +344,43 @@ def _cmd_train(args) -> int:
                 print(json.dumps({"event": "iter", **info.as_dict()}),
                       file=sys.stderr)
 
+        tw = None
+        if args.telemetry:
+            # Opened AFTER resume validation: TelemetryWriter truncates
+            # its output file, and a failed --resume must not destroy a
+            # previous run's telemetry on its way to exit 2.  An
+            # unwritable path still reports as one line + exit 2 before
+            # any fit work starts.
+            from kmeans_tpu.obs import TelemetryWriter
+
+            try:
+                tw = TelemetryWriter(args.telemetry)
+            except OSError as e:
+                print(f"error: cannot write telemetry to "
+                      f"{args.telemetry!r}: {e}", file=sys.stderr)
+                return 2
+
         ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
-        with ctx:
-            state = runner.run(
-                callback=progress,
-                # A --resume run without --checkpoint keeps saving (and
-                # cuts its preemption checkpoint) into the resume dir; an
-                # explicit --checkpoint still wins.  (The streamed path
-                # instead REJECTS mismatched --resume/--checkpoint — one
-                # dir carries its step counter.)
-                checkpoint_path=args.checkpoint or args.resume,
-                checkpoint_every=args.checkpoint_every,
-                checkpoint_keep=args.checkpoint_keep,
-            )
+        try:
+            with ctx:
+                state = runner.run(
+                    callback=progress,
+                    # A --resume run without --checkpoint keeps saving
+                    # (and cuts its preemption checkpoint) into the
+                    # resume dir; an explicit --checkpoint still wins.
+                    # (The streamed path instead REJECTS mismatched
+                    # --resume/--checkpoint — one dir carries its step
+                    # counter.)
+                    checkpoint_path=args.checkpoint or args.resume,
+                    checkpoint_every=args.checkpoint_every,
+                    checkpoint_keep=args.checkpoint_keep,
+                    # One JSONL event per iteration
+                    # (docs/OBSERVABILITY.md).
+                    telemetry=tw,
+                )
+        finally:
+            if tw is not None:
+                tw.close()
     elif mesh is not None and not args.stream and model in (
             "xmeans", "gmeans", "spectral", "bisecting"):
         # Models-level entries that take mesh directly: auto-k and
@@ -407,30 +436,75 @@ def _cmd_train(args) -> int:
         fit_stream = (models.fit_gmm_stream if model == "gmm"
                       else models.fit_minibatch_stream)
         stream_kw |= gmm_kw
+        tw_box = [None]
+        if args.telemetry:
+            # Streamed telemetry: one "iter" event per step via the fits'
+            # IterInfo callback (syncs the stream per step — see the
+            # fits' docstrings).
+            from kmeans_tpu.obs import TelemetryWriter
+
+            try:
+                # Writability probe that does NOT truncate: the streamed
+                # resume params are validated inside fit_stream, and a
+                # failed --resume must not destroy a previous run's
+                # telemetry on its way to exit 2 (same contract as the
+                # runner path).  The real writer opens lazily on the
+                # first event — i.e. only once a step actually ran.
+                with open(args.telemetry, "a", encoding="utf-8"):
+                    pass
+            except OSError as e:
+                print(f"error: cannot write telemetry to "
+                      f"{args.telemetry!r}: {e}", file=sys.stderr)
+                return 2
+            model_label = ("gmm_stream" if model == "gmm"
+                           else "minibatch_stream")
+            stepped = [False]      # one-element latch, O(1) for any steps
+
+            def _stream_event(info):
+                tw = tw_box[0]
+                if tw is None:
+                    import jax
+
+                    tw = tw_box[0] = TelemetryWriter(args.telemetry, common={
+                        "model": model_label,
+                        "device": jax.devices()[0].platform,
+                    })
+                # The first step this process dispatches compiles the
+                # jitted program — same phase contract as the runner.
+                phase = "step" if stepped[0] else "compile+step"
+                stepped[0] = True
+                tw.iteration(info, phase=phase)
+
+            stream_kw["callback"] = _stream_event
         from kmeans_tpu.utils.retry import RetryError
 
         try:
-            state = fit_stream(x, k, config=kcfg, **stream_kw)
-        except ValueError as e:
-            # Predictable user errors (cross-family resume, contradicted
-            # sampling params, step mismatch) report like every other CLI
-            # validation failure instead of a traceback.
-            print(f"error: {e}", file=sys.stderr)
-            return 2
-        except RetryError as e:
-            # A permanent host-read fault: the retry budget is exhausted,
-            # the error is one line, and the last periodic checkpoint (if
-            # any) is resumable once the storage recovers.
-            print(f"error: streamed fit failed after retries: {e}",
-                  file=sys.stderr)
-            if stream_ckpt:
-                from kmeans_tpu.utils.checkpoint import latest_step
+            try:
+                state = fit_stream(x, k, config=kcfg, **stream_kw)
+            except ValueError as e:
+                # Predictable user errors (cross-family resume,
+                # contradicted sampling params, step mismatch) report like
+                # every other CLI validation failure, not a traceback.
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            except RetryError as e:
+                # A permanent host-read fault: the retry budget is
+                # exhausted, the error is one line, and the last periodic
+                # checkpoint (if any) is resumable once the storage
+                # recovers.
+                print(f"error: streamed fit failed after retries: {e}",
+                      file=sys.stderr)
+                if stream_ckpt:
+                    from kmeans_tpu.utils.checkpoint import latest_step
 
-                ckpt = args.resume or args.checkpoint
-                if latest_step(ckpt) is not None:
-                    print(f"the last checkpoint at {ckpt!r} remains "
-                          "resumable with --resume", file=sys.stderr)
-            return 1
+                    ckpt = args.resume or args.checkpoint
+                    if latest_step(ckpt) is not None:
+                        print(f"the last checkpoint at {ckpt!r} remains "
+                              "resumable with --resume", file=sys.stderr)
+                return 1
+        finally:
+            if tw_box[0] is not None:
+                tw_box[0].close()
     else:
         fit = {
             "lloyd": models.fit_lloyd,
@@ -593,9 +667,13 @@ def _cmd_serve(args) -> int:
 
     print(f"serving on http://{args.host}:{args.port}/ (Ctrl-C to stop)",
           file=sys.stderr)
+    if args.metrics:
+        print(f"metrics on http://{args.host}:{args.port}/metrics",
+              file=sys.stderr)
     try:
         serve(args.host, args.port, background=False,
-              persist_dir=args.persist_dir or None)
+              persist_dir=args.persist_dir or None,
+              metrics=args.metrics)
     except KeyboardInterrupt:
         pass
     return 0
@@ -613,7 +691,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kmeans_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    t = sub.add_parser("train", help="fit k-means and optionally export JSON")
+    t = sub.add_parser("train", aliases=["fit"],
+                       help="fit k-means and optionally export JSON")
     t.add_argument("--config", choices=[
         "blobs2d", "mnist", "glove", "cifar10", "imagenet"
     ], help="named BASELINE config (synthetic data at its shape)")
@@ -697,6 +776,12 @@ def main(argv=None) -> int:
     t.add_argument("--resume", help="resume from this checkpoint directory "
                    "(a streamed resume keeps saving into the same dir)")
     t.add_argument("--profile", help="write a jax.profiler trace to this dir")
+    t.add_argument("--telemetry", metavar="OUT.jsonl",
+                   help="write one JSON telemetry event per iteration/step "
+                        "to this file (inertia, shift, seconds, device, "
+                        "compile-vs-step phase; docs/OBSERVABILITY.md); "
+                        "runs the step-wise Lloyd runner, or rides the "
+                        "streamed fits with --stream")
     t.set_defaults(fn=_cmd_train)
 
     w = sub.add_parser("sweep", help="sweep k, score fits, suggest a k")
@@ -736,6 +821,11 @@ def main(argv=None) -> int:
     s.add_argument("--persist-dir", default=".kmeans_rooms", metavar="DIR",
                    help="directory for durable rooms (reloaded on restart; "
                         "pass '' to disable)")
+    s.add_argument("--metrics", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="serve GET /metrics (Prometheus text exposition "
+                        "of the process metrics registry; default on — "
+                        "--no-metrics hides the endpoint)")
     s.set_defaults(fn=_cmd_serve)
 
     b = sub.add_parser("bench", help="run the benchmark (one JSON line)")

@@ -199,6 +199,11 @@ class ServeConfig:
     persist_dir: Optional[str] = None
     #: Seconds of quiet after a version bump before the room is written.
     persist_debounce_s: float = 0.5
+    #: Serve ``GET /metrics`` (Prometheus text exposition of the process
+    #: metrics registry; docs/OBSERVABILITY.md).  Off hides the endpoint
+    #: (404) — for deployments that must not expose internals on the
+    #: same origin the board is served from.
+    metrics: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

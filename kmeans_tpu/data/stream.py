@@ -28,11 +28,31 @@ from typing import Iterable, Iterator, Optional
 import jax
 import numpy as np
 
+from kmeans_tpu.obs import counter as _obs_counter, gauge as _obs_gauge
 from kmeans_tpu.utils import faults
 from kmeans_tpu.utils.retry import RetryPolicy
 
 __all__ = ["load_mmap", "sample_batches", "prefetch_to_device",
            "foreach_chunk", "READ_RETRY"]
+
+#: Prefetch-pipeline observability (docs/OBSERVABILITY.md), complementing
+#: the leaked-thread warning below: the queue-depth gauge says whether
+#: the producer keeps ahead of the consumer (depth pinned at 0 = the
+#: device is starving on host reads), and the stall counter counts the
+#: times the producer blocked on a FULL queue (depth pinned at max =
+#: the host is ahead; harmless, but a hint that prefetch depth or
+#: batch size could drop).  One gauge per process, last-writer-wins
+#: across concurrent streams — a per-stream label would be unbounded.
+_PREFETCH_DEPTH = _obs_gauge(
+    "kmeans_tpu_prefetch_queue_depth",
+    "Batches currently buffered in the background prefetch queue "
+    "(last stream to touch the queue wins)",
+)
+_PREFETCH_STALLS_TOTAL = _obs_counter(
+    "kmeans_tpu_prefetch_producer_stalls_total",
+    "Times the prefetch producer blocked because the queue was full "
+    "(consumer slower than host gather + transfer)",
+)
 
 #: Transient host-read policy for the streamed loaders: a memmap page-in
 #: against networked or flaky storage can throw a one-off ``OSError``; a
@@ -104,7 +124,7 @@ def sample_batches(
     for step in range(start_step, steps):
         rng = np.random.default_rng((seed, step))
         idx = np.sort(rng.integers(0, n, size=batch_size))
-        yield policy.call(read, idx)
+        yield policy.call(read, idx, site="stream.read")
 
 
 def prefetch_to_device(
@@ -166,7 +186,7 @@ def foreach_chunk(data, chunk_size: int, fn) -> None:
 
     def chunks():
         for lo in range(0, n, chunk_size):
-            yield READ_RETRY.call(read, lo)
+            yield READ_RETRY.call(read, lo, site="stream.read")
 
     lo = 0
     for xb in prefetch_to_device(chunks()):
@@ -186,11 +206,18 @@ def _prefetch_background(batches, depth, device):
                 if stop.is_set():
                     return
                 arr = jax.device_put(b, device)
+                stalled = False
                 while not stop.is_set():
                     try:
                         q.put(arr, timeout=0.1)
+                        _PREFETCH_DEPTH.set(q.qsize())
                         break
                     except queue.Full:
+                        if not stalled:
+                            # Count each batch's stall once, however many
+                            # 0.1 s put timeouts it spans.
+                            stalled = True
+                            _PREFETCH_STALLS_TOTAL.inc()
                         continue
         except BaseException as e:  # re-raised in the consumer
             err.append(e)
@@ -207,6 +234,7 @@ def _prefetch_background(batches, depth, device):
     try:
         while True:
             item = q.get()
+            _PREFETCH_DEPTH.set(q.qsize())
             if item is done:
                 break
             yield item

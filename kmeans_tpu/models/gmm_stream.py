@@ -22,7 +22,7 @@ threaded gather, background prefetch), and are a pure function of
 from __future__ import annotations
 
 import functools
-from typing import Optional, Union
+from typing import Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -179,8 +179,18 @@ def fit_gmm_stream(
     resume: bool = False,
     mesh=None,
     data_axis: str = "data",
+    callback: Optional[Callable] = None,
 ) -> GMMState:
     """Online EM over host/disk data of unbounded size.
+
+    ``callback`` (an :class:`~kmeans_tpu.models.runner.IterInfo`
+    consumer, same contract as ``LloydRunner.run``) fires once per
+    streamed step with (step, negative mean batch log-likelihood as the
+    lower-is-better "inertia", shift=None, seconds, converged=False).
+    Reading the batch log-likelihood forces a device sync every step;
+    leave callback None for maximum overlap.  Step wall times also land
+    in the ``kmeans_tpu_iteration_seconds{model="gmm_stream"}`` registry
+    histogram either way (dispatch-paced when no callback syncs).
 
     With ``mesh`` each host batch lands row-sharded over ``data_axis``
     straight off PCIe and the E-step's soft moments merge with one
@@ -373,18 +383,28 @@ def fit_gmm_stream(
     batches = sample_batches(data, bs_eff, n_steps, seed=host_seed,
                              start_step=start_step)
     step = start_step
+    from kmeans_tpu.models.runner import StepObserver
+
+    rec = StepObserver("gmm_stream", callback)
     # Same preemption contract as fit_minibatch_stream: signal latches a
     # flag, the loop cuts one final checkpoint at the next step boundary
     # and exits resumable.
     with PreemptionGuard() as guard:
+        rec.start()
         for xb in prefetch_to_device(batches, depth=prefetch_depth,
                                      background=background_prefetch,
                                      device=place):
             rho = jnp.asarray((step + t0) ** (-kappa), jnp.float32)
-            params, stats, _ = step_fn(params, stats, xb, rho, reg)
+            params, stats, mean_ll = step_fn(params, stats, xb, rho, reg)
             step += 1
+            # The ll read syncs the stream to the device (see the
+            # docstring); the negated mean ll keeps "inertia"
+            # lower-is-better.
+            neg_ll = -float(mean_ll) if rec.wants_sync else None
+            rec.step(step, inertia=neg_ll)
             saver.maybe(step, lambda p=params, s=stats, t=step:
                         save(p, s, t))
+            rec.exclude()    # checkpoint write time is not step time
             if guard.triggered and step < n_steps:
                 saver.maybe(step, lambda p=params, s=stats, t=step:
                             save(p, s, t), force=True)

@@ -25,10 +25,80 @@ import numpy as np
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.obs import (
+    counter as _obs_counter,
+    histogram as _obs_histogram,
+)
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend, resolve_update
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = ["LloydRunner", "IterInfo"]
+
+#: THE per-iteration metric family (docs/OBSERVABILITY.md): every
+#: step-paced fit (this runner, the streamed fits) observes its
+#: iteration wall time here under its own ``model`` label, so the serve
+#: layer's ``GET /metrics`` shows one iteration-latency histogram for
+#: the whole engine.  Handles are module-level: the get-or-create and
+#: label lookups happen at import time, not in the hot loop.
+ITER_SECONDS = _obs_histogram(
+    "kmeans_tpu_iteration_seconds",
+    "Wall time of one training iteration/step",
+    labels=("model",),
+)
+ITERS_TOTAL = _obs_counter(
+    "kmeans_tpu_iterations_total",
+    "Training iterations/steps completed",
+    labels=("model",),
+)
+
+# Pre-seed the engine's model labels: a labeled family with no children
+# exposes no samples, and ``GET /metrics`` should show the iteration
+# histograms (zeroed) from process start, not only after the first fit.
+for _model in ("lloyd", "minibatch_stream", "gmm_stream"):
+    ITER_SECONDS.labels(model=_model)
+    ITERS_TOTAL.labels(model=_model)
+del _model
+
+
+class StepObserver:
+    """THE one copy of the streamed fits' per-step bookkeeping: wall
+    clock between steps, the :data:`ITER_SECONDS`/:data:`ITERS_TOTAL`
+    records, and the :class:`IterInfo` callback emit.
+
+    Usage: ``start()`` right before the loop, ``step(...)`` once per
+    step, and ``exclude()`` after any off-loop work (checkpoint writes)
+    so its cost is not attributed to the next step's seconds — the
+    runner times only the sweep, and the streamed histograms must mean
+    the same thing.
+    """
+
+    def __init__(self, model: str, callback=None):
+        self._hist = ITER_SECONDS.labels(model=model)
+        self._total = ITERS_TOTAL.labels(model=model)
+        self._callback = callback
+        self._t_last = time.perf_counter()
+
+    @property
+    def wants_sync(self) -> bool:
+        """Whether the caller should pay a per-step device sync to feed
+        the callback real values (no callback → keep full overlap)."""
+        return self._callback is not None
+
+    def start(self) -> None:
+        self._t_last = time.perf_counter()
+
+    def exclude(self) -> None:
+        """Re-arm the clock after work that must not count as step time."""
+        self._t_last = time.perf_counter()
+
+    def step(self, iteration: int, *, inertia=None, shift_sq=None) -> None:
+        now = time.perf_counter()
+        dt, self._t_last = now - self._t_last, now
+        self._hist.observe(dt)
+        self._total.inc()
+        if self._callback is not None:
+            self._callback(IterInfo(iteration, inertia, shift_sq, dt,
+                                    False))
 
 
 class IterInfo:
@@ -74,6 +144,14 @@ class LloydRunner:
         self.iteration = 0
         self.centroids: Optional[jax.Array] = None
         self.last_inertia: Optional[float] = None
+        #: False until the corresponding jitted program has run once —
+        #: a program's first call includes its XLA compile, and the
+        #: telemetry stream marks that event ``phase="compile+step"``.
+        #: Two flags because the delta update runs TWO programs: the
+        #: full-refresh sweep (``_step``, iteration 1) and the carried-
+        #: state delta sweep (``_step_delta``, first at iteration 2).
+        self._stepped = False
+        self._stepped_delta = False
 
         # Carried (labels, sums, counts) of the incremental update between
         # step() calls; None = next sweep must be a full refresh (fresh
@@ -250,8 +328,20 @@ class LloydRunner:
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 10,
         checkpoint_keep: int = 0,
+        telemetry=None,
     ) -> KMeansState:
-        """Iterate until convergence; fire ``callback`` each iteration."""
+        """Iterate until convergence; fire ``callback`` each iteration.
+
+        ``telemetry`` is a :class:`kmeans_tpu.obs.TelemetryWriter` (or a
+        path, opened and closed by this call): one ``iter`` JSONL event
+        per iteration — the :class:`IterInfo` fields plus model, device,
+        and ``phase`` (``compile+step`` for the first step this
+        instance's jitted program runs, ``step`` after) — bracketed by
+        ``run_start`` / ``run_done`` events.  Independent of
+        ``telemetry``, every iteration's wall time lands in the
+        :data:`ITER_SECONDS` registry histogram (one no-op check per
+        iteration when the registry is disabled).
+        """
         if self.centroids is None:
             self.init()
         if checkpoint_path and checkpoint_every < 1:
@@ -261,10 +351,35 @@ class LloydRunner:
         max_iter = max_iter if max_iter is not None else self.cfg.max_iter
         tol = tol if tol is not None else self.cfg.tol
 
+        tw = telemetry
+        own_tw = False
+        if isinstance(telemetry, str):
+            from kmeans_tpu.obs import TelemetryWriter
+
+            tw = TelemetryWriter(telemetry)
+            own_tw = True
+        if self.mesh is not None:
+            device = self.mesh.devices.flat[0].platform
+        else:
+            device = next(iter(self.x.devices())).platform
+        hist = ITER_SECONDS.labels(model="lloyd")
+        iters_total = ITERS_TOTAL.labels(model="lloyd")
+        if tw is not None:
+            # On a mesh self.x carries zero padding rows; _n is the true
+            # dataset size (only defined on the mesh path).
+            n_true = self._n if self.mesh is not None else self.x.shape[0]
+            tw.event(
+                "run_start", model="lloyd", device=device,
+                n=int(n_true), d=int(self.x.shape[1]), k=self.k,
+                update=self._update, max_iter=int(max_iter),
+                tol=float(tol), start_iteration=self.iteration,
+            )
+
         from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
 
         converged = False
         saved = False
+        t_run0 = time.perf_counter()
 
         def preempt_exit():
             if checkpoint_path and not saved:
@@ -278,61 +393,93 @@ class LloydRunner:
         # Preemption safety: SIGTERM/SIGINT latches a flag in the guard;
         # the loop cuts one final checkpoint at the next iteration
         # boundary and raises Preempted with a resumable state.
-        with PreemptionGuard() as guard:
-            for it in range(max_iter):
-                t0 = time.perf_counter()
-                if self.mesh is None and self._update == "delta":
-                    # Incremental loop: full refresh on the first sweep after
-                    # (re)init/resume and every DELTA_REFRESH-th iteration
-                    # (drift bound, same cadence as fit_lloyd's fused loop),
-                    # the carried-state delta sweep otherwise.
-                    from kmeans_tpu.ops.delta import DELTA_REFRESH
+        try:
+            with PreemptionGuard() as guard:
+                for it in range(max_iter):
+                    t0 = time.perf_counter()
+                    ran_delta = False
+                    if self.mesh is None and self._update == "delta":
+                        # Incremental loop: full refresh on the first sweep
+                        # after (re)init/resume and every DELTA_REFRESH-th
+                        # iteration (drift bound, same cadence as
+                        # fit_lloyd's fused loop), the carried-state delta
+                        # sweep otherwise.
+                        from kmeans_tpu.ops.delta import DELTA_REFRESH
 
-                    if (self._dstate is None
-                            or self.iteration % DELTA_REFRESH == 0):
-                        new_c, inertia, shift_sq, lab, sums, counts = \
-                            self._step(self.x, self.centroids)
+                        if (self._dstate is None
+                                or self.iteration % DELTA_REFRESH == 0):
+                            new_c, inertia, shift_sq, lab, sums, counts = \
+                                self._step(self.x, self.centroids)
+                        else:
+                            ran_delta = True
+                            new_c, inertia, shift_sq, lab, sums, counts = \
+                                self._step_delta(self.x, self.centroids,
+                                                 *self._dstate)
+                        self._dstate = (lab, sums, counts)
                     else:
-                        new_c, inertia, shift_sq, lab, sums, counts = \
-                            self._step_delta(self.x, self.centroids,
-                                             *self._dstate)
-                    self._dstate = (lab, sums, counts)
-                else:
-                    new_c, inertia, shift_sq = self._step(
-                        self.x, self.centroids)
-                new_c.block_until_ready()
-                dt = time.perf_counter() - t0
-                self.centroids = new_c
-                self.iteration += 1
-                self.last_inertia = float(inertia)
-                converged = float(shift_sq) <= tol
-                if callback:
-                    callback(IterInfo(
+                        new_c, inertia, shift_sq = self._step(
+                            self.x, self.centroids)
+                    new_c.block_until_ready()
+                    dt = time.perf_counter() - t0
+                    # Per-program first-call flags: the delta update runs
+                    # a second jitted program whose own compile lands in
+                    # its first call's wall time (iteration 2).
+                    if ran_delta:
+                        phase = ("step" if self._stepped_delta
+                                 else "compile+step")
+                        self._stepped_delta = True
+                    else:
+                        phase = "step" if self._stepped else "compile+step"
+                        self._stepped = True
+                    self.centroids = new_c
+                    self.iteration += 1
+                    self.last_inertia = float(inertia)
+                    converged = float(shift_sq) <= tol
+                    hist.observe(dt)
+                    iters_total.inc()
+                    info = IterInfo(
                         self.iteration, float(inertia), float(shift_sq), dt,
                         converged,
-                    ))
-                saved = bool(checkpoint_path) and (
-                    self.iteration % checkpoint_every == 0 or converged
-                )
-                if saved:
-                    self.checkpoint(checkpoint_path, keep=checkpoint_keep)
-                if converged:
-                    break
-                # Mid-loop, exit promptly — running more iterations only
-                # races the grace window.  On the LAST iteration the loop
-                # is over either way; fall through to the post-loop
-                # policy, which knows whether anything was saved.
-                if guard.triggered and it < max_iter - 1:
+                    )
+                    if tw is not None:
+                        tw.iteration(info, model="lloyd", device=device,
+                                     phase=phase)
+                    if callback:
+                        callback(info)
+                    saved = bool(checkpoint_path) and (
+                        self.iteration % checkpoint_every == 0 or converged
+                    )
+                    if saved:
+                        self.checkpoint(checkpoint_path,
+                                        keep=checkpoint_keep)
+                    if converged:
+                        break
+                    # Mid-loop, exit promptly — running more iterations
+                    # only races the grace window.  On the LAST iteration
+                    # the loop is over either way; fall through to the
+                    # post-loop policy, which knows whether anything was
+                    # saved.
+                    if guard.triggered and it < max_iter - 1:
+                        preempt_exit()
+                # The sweep loop is complete (converged or max_iter); only
+                # finalize()'s full labeling pass remains, which on a big
+                # dataset can blow the preemption grace window.  With a
+                # checkpoint, exit resumable now — the resumed run
+                # finalizes immediately.  With nothing saved, raising
+                # would discard the whole finished fit, while finishing
+                # risks only the finalize time the kill would cost anyway.
+                if guard.triggered and checkpoint_path is not None:
                     preempt_exit()
-            # The sweep loop is complete (converged or max_iter); only
-            # finalize()'s full labeling pass remains, which on a big
-            # dataset can blow the preemption grace window.  With a
-            # checkpoint, exit resumable now — the resumed run finalizes
-            # immediately.  With nothing saved, raising would discard the
-            # whole finished fit, while finishing risks only the finalize
-            # time the kill would cost anyway.
-            if guard.triggered and checkpoint_path is not None:
-                preempt_exit()
+            if tw is not None:
+                tw.event(
+                    "run_done", model="lloyd", device=device,
+                    iterations=self.iteration, converged=bool(converged),
+                    inertia=self.last_inertia,
+                    seconds=time.perf_counter() - t_run0,
+                )
+        finally:
+            if own_tw:
+                tw.close()
         return self.finalize(converged=converged)
 
     def finalize(self, *, converged: bool = False) -> KMeansState:

@@ -16,7 +16,7 @@ with-replacement uniform, and neither is deterministic w.r.t. the other.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple, Union
+from typing import Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -125,8 +125,20 @@ def fit_minibatch_stream(
     resume: bool = False,
     mesh=None,
     data_axis: str = "data",
+    callback: Optional[Callable] = None,
 ) -> KMeansState:
     """Minibatch k-means over host/disk data of unbounded size.
+
+    ``callback`` (an :class:`~kmeans_tpu.models.runner.IterInfo`
+    consumer, same contract as ``LloydRunner.run``) fires once per
+    streamed step with (step, inertia=None, squared centroid shift,
+    seconds, converged=False) — the per-step telemetry hook the CLI's
+    ``--telemetry`` rides.  Computing the shift forces a device sync
+    every step, pacing the stream to the device; leave it None for
+    maximum overlap.  Step wall times also land in the
+    ``kmeans_tpu_iteration_seconds{model="minibatch_stream"}`` registry
+    histogram either way (dispatch-paced — async under the hood — when
+    no callback syncs).
 
     With ``mesh`` (a ``jax.sharding.Mesh``), each host batch lands
     row-sharded over ``data_axis`` straight off PCIe and the update runs
@@ -345,19 +357,31 @@ def fit_minibatch_stream(
     batches = sample_batches(data, bs_eff, n_steps, seed=host_seed,
                              start_step=start_step, to_bf16=to_bf16)
     step = start_step
+    from kmeans_tpu.models.runner import StepObserver
+
+    rec = StepObserver("minibatch_stream", callback)
     # Preemption safety: SIGTERM/SIGINT latches a flag; the loop notices
     # at the next step boundary, cuts one final checkpoint (PeriodicSaver
     # dedups against a cadence save at the same step), and exits with a
     # resumable state — losing at most the step in flight, not the
     # checkpoint_every window.
     with PreemptionGuard() as guard:
+        rec.start()
         for xb in prefetch_to_device(batches, depth=prefetch_depth,
                                      background=background_prefetch,
                                      device=place):
+            c_prev = c if rec.wants_sync else None
             c, n_seen = step_fn(c, n_seen, xb)
             step += 1
+            # The shift read syncs the stream to the device, so the
+            # reported seconds are true per-step wall time (no callback
+            # → no sync, timings are dispatch-paced).
+            shift_sq = (float(jnp.sum((c - c_prev) ** 2))
+                        if rec.wants_sync else None)
+            rec.step(step, shift_sq=shift_sq)
             saver.maybe(step, lambda c=c, ns=n_seen, t=step:
                         checkpoint_now(c, ns, t))
+            rec.exclude()    # checkpoint write time is not step time
             if guard.triggered and step < n_steps:
                 saver.maybe(step, lambda c=c, ns=n_seen, t=step:
                             checkpoint_now(c, ns, t), force=True)

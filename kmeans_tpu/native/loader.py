@@ -100,7 +100,7 @@ def _build() -> Optional[str]:
             faults.check("native.compile")
             return subprocess.run(cmd, capture_output=True, timeout=120)
 
-        res = _COMPILE_RETRY.call(compile_once)
+        res = _COMPILE_RETRY.call(compile_once, site="native.compile")
         if res.returncode != 0:
             return None
         os.replace(tmp, so_path)

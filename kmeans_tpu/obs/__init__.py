@@ -1,0 +1,66 @@
+"""Observability: metrics registry + structured run telemetry.
+
+Two complementary halves (docs/OBSERVABILITY.md has the full catalog and
+naming convention):
+
+* :mod:`kmeans_tpu.obs.registry` — a zero-dependency, thread-safe
+  Prometheus-style metrics registry (counters / gauges / histograms with
+  labels).  Subsystems register metrics at import time into the global
+  :data:`REGISTRY`; the serve layer exposes it at ``GET /metrics``.
+  ``disable()`` turns every mutation into a near-free no-op so hot loops
+  keep their instrumentation unconditionally.
+* :mod:`kmeans_tpu.obs.telemetry` — per-run JSONL event streams (one
+  event per iteration: inertia, shift, seconds, device, compile-vs-step
+  phase), shared by ``fit --telemetry``, the serve train stream, and
+  ``bench.py --telemetry``.
+"""
+
+from kmeans_tpu.obs.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    counter,
+    gauge,
+    histogram,
+)
+from kmeans_tpu.obs.telemetry import (
+    TelemetryWriter,
+    read_events,
+    summarize_events,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "counter",
+    "gauge",
+    "histogram",
+    "TelemetryWriter",
+    "read_events",
+    "summarize_events",
+    "enable",
+    "disable",
+    "enabled",
+]
+
+
+def enable() -> None:
+    """Enable the default registry (mutations record again)."""
+    REGISTRY.enable()
+
+
+def disable() -> None:
+    """Disable the default registry: every inc/set/observe becomes one
+    attribute check + return (the hot-loop off switch)."""
+    REGISTRY.disable()
+
+
+def enabled() -> bool:
+    return REGISTRY.enabled

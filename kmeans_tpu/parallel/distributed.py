@@ -103,7 +103,8 @@ def ensure_initialized(
             pass
 
     try:
-        _INIT_RETRY.call(init_once, on_retry=reset_partial_init)
+        _INIT_RETRY.call(init_once, on_retry=reset_partial_init,
+                         site="distributed.init")
     except BaseException as e:
         # on_retry only fires BETWEEN attempts — after the final failure
         # (or a non-retryable one) the torn client is still assigned, and

@@ -25,6 +25,7 @@ over the stepped ``shard_map`` — one compiled program for the whole fit.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -36,6 +37,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids, resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.obs import (
+    REGISTRY as _OBS_REGISTRY,
+    counter as _obs_counter,
+    gauge as _obs_gauge,
+    histogram as _obs_histogram,
+)
 from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import (
     lloyd_pass,
@@ -49,6 +56,53 @@ from kmeans_tpu.ops.pallas_lloyd import (
     pallas_supported,
 )
 from kmeans_tpu.ops.update import apply_update
+
+#: Sharded-engine observability (docs/OBSERVABILITY.md).  A sharded fit
+#: is ONE fused XLA program (the while_loop over the shard_map), so
+#: per-iteration host timestamps don't exist — what the engine can
+#: measure honestly is the whole-fit wall time and the derived mean
+#: sweep time (wall / sweeps, every shard in lockstep at each psum).
+#: ``layout`` is "dp<N>[.tp<M>][.fp<F>]", a closed set per deployment.
+_ENGINE_FIT_SECONDS = _obs_histogram(
+    "kmeans_tpu_engine_fit_seconds",
+    "Wall time of one sharded fit (compile excluded on cache hits only)",
+    labels=("kind", "backend", "layout"),
+)
+_ENGINE_SWEEP_SECONDS = _obs_histogram(
+    "kmeans_tpu_engine_sweep_seconds",
+    "Mean per-sweep wall time of a sharded fit (fit wall time / sweeps; "
+    "shards run each sweep in lockstep between psums)",
+    labels=("kind", "backend", "layout"),
+)
+_ENGINE_FITS_TOTAL = _obs_counter(
+    "kmeans_tpu_engine_fits_total",
+    "Sharded fits completed",
+    labels=("kind", "backend", "layout"),
+)
+_ENGINE_SHARDS = _obs_gauge(
+    "kmeans_tpu_engine_shards",
+    "Device count of the most recent sharded fit's mesh",
+)
+
+
+def _mesh_layout(dp: int, mp: int, fp: int) -> str:
+    parts = [f"dp{dp}"]
+    if mp > 1:
+        parts.append(f"tp{mp}")
+    if fp > 1:
+        parts.append(f"fp{fp}")
+    return ".".join(parts)
+
+
+def _observe_sharded_fit(kind: str, backend: str, layout: str,
+                         shards: int, seconds: float, sweeps: int) -> None:
+    """Record one finished sharded fit in the engine metric family."""
+    labels = dict(kind=kind, backend=backend, layout=layout)
+    _ENGINE_FIT_SECONDS.labels(**labels).observe(seconds)
+    _ENGINE_SWEEP_SECONDS.labels(**labels).observe(
+        seconds / max(1, sweeps))
+    _ENGINE_FITS_TOTAL.labels(**labels).inc()
+    _ENGINE_SHARDS.set(shards)
 
 
 def _init_centroids_on_mesh(key, x, k, *, mesh, data_axis, method, w, cfg):
@@ -910,7 +964,19 @@ def fit_lloyd_sharded(
             weights_binary if not (model_axis or feature_axis) else True,
             center_update,
         )
+    t_run0 = time.perf_counter()
     c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
+    if _OBS_REGISTRY.enabled:
+        # int() blocks until the fused program finishes, so the recorded
+        # wall time covers the whole fit (the caller reads the state right
+        # after anyway; the sweep count itself is needed for the
+        # mean-sweep metric).  Skipped entirely when the registry is
+        # disabled — no forced sync on the no-observability path.
+        n_sweeps = int(n_iter)
+        _observe_sharded_fit(
+            f"lloyd.{update}", backend, _mesh_layout(dp, mp, fp),
+            dp * mp * fp, time.perf_counter() - t_run0, n_sweeps,
+        )
     return KMeansState(
         c[:k, :d_real], labels[:n], inertia, n_iter, converged, counts[:k]
     )

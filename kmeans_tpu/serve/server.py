@@ -50,12 +50,68 @@ from kmeans_tpu.session import (
     suggestion_from_counts,
     trait_counts_for,
 )
+from kmeans_tpu import obs
 from kmeans_tpu.utils import faults
 from kmeans_tpu.utils.rooms import code4
 
 __all__ = ["KMeansServer", "serve"]
 
 _STATIC = Path(__file__).parent / "static"
+
+# ---------------------------------------------------------------------------
+# HTTP observability (docs/OBSERVABILITY.md).  ``route`` is normalized to
+# the known endpoint set (arbitrary request paths must not mint unbounded
+# label values); ``/api/events`` is excluded from the latency histogram —
+# an SSE "request" lasts as long as the subscription, which would drown
+# the real request latencies.
+# ---------------------------------------------------------------------------
+_HTTP_REQUESTS_TOTAL = obs.counter(
+    "kmeans_tpu_http_requests_total",
+    "HTTP requests handled by the serve layer",
+    labels=("method", "route", "status"),
+)
+_HTTP_REQUEST_SECONDS = obs.histogram(
+    "kmeans_tpu_http_request_seconds",
+    "HTTP request handling wall time (SSE subscriptions excluded)",
+    labels=("method", "route"),
+)
+_HTTP_503_TOTAL = obs.counter(
+    "kmeans_tpu_http_503_total",
+    "Capacity rejections (503 + Retry-After: train slots or room table "
+    "exhausted)",
+)
+_TRAIN_STARTED_TOTAL = obs.counter(
+    "kmeans_tpu_train_started_total",
+    "Training jobs accepted by the serve layer",
+    labels=("model",),
+)
+_TRAIN_ERRORS_TOTAL = obs.counter(
+    "kmeans_tpu_train_errors_total",
+    "Training jobs that ended in a train_error event",
+)
+_ROOMS_GAUGE = obs.gauge(
+    "kmeans_tpu_rooms",
+    "Rooms currently resident in the server's room table",
+)
+_TRAIN_SLOTS_IN_USE = obs.gauge(
+    "kmeans_tpu_train_slots_in_use",
+    "Training worker slots currently held (the training-queue depth "
+    "against ServeConfig.max_concurrent_train)",
+)
+_SSE_SUBSCRIBERS = obs.gauge(
+    "kmeans_tpu_sse_subscribers",
+    "Live SSE subscriber connections across all rooms",
+)
+
+_KNOWN_ROUTES = frozenset((
+    "/", "/index.html", "/app.js", "/api/state", "/api/export",
+    "/api/events", "/api/mutate", "/api/hello", "/api/import",
+    "/healthz", "/metrics",
+))
+
+
+def _route_label(path: str) -> str:
+    return path if path in _KNOWN_ROUTES else "other"
 
 #: One-shot model families the train op can run (lloyd streams per-iteration
 #: via LloydRunner instead).  The one source of truth for validation AND
@@ -242,11 +298,25 @@ class KMeansServer:
         self._train_sem = threading.BoundedSemaphore(
             self.config.max_concurrent_train
         )
+        #: Train slots currently held — tracked explicitly beside the
+        #: semaphore (not via its private _value) so the queue-depth
+        #: gauge never depends on CPython internals.
+        self._train_inflight = 0
+        self._train_inflight_lock = threading.Lock()
         self.rooms: Dict[str, _Room] = {}
         self._save_locks: Dict[str, threading.Lock] = {}
         self._save_locks_guard = threading.Lock()
         self._lock = threading.Lock()
         self.httpd: Optional[ThreadingHTTPServer] = None
+        # Scrape-time gauges: evaluated on GET /metrics, so they always
+        # reflect the live table/semaphore.  Process-global registry +
+        # per-server callbacks means the LAST server constructed in a
+        # process owns these gauges (one server per process in
+        # production; tests construct sequentially).
+        _ROOMS_GAUGE.set_function(lambda: len(self.rooms))
+        _TRAIN_SLOTS_IN_USE.set_function(lambda: self._train_inflight)
+        _SSE_SUBSCRIBERS.set_function(
+            lambda: sum(r.peer_count() for r in list(self.rooms.values())))
         if self.config.persist_dir:
             os.makedirs(self.config.persist_dir, exist_ok=True)
             self._load_persisted_rooms()
@@ -491,6 +561,18 @@ class KMeansServer:
         raise ValueError(f"unknown op {op!r}")
 
     # ------------------------------------------------------- live training
+    def _train_slot_acquire(self) -> bool:
+        if not self._train_sem.acquire(blocking=False):
+            return False
+        with self._train_inflight_lock:
+            self._train_inflight += 1
+        return True
+
+    def _train_slot_release(self) -> None:
+        with self._train_inflight_lock:
+            self._train_inflight -= 1
+        self._train_sem.release()
+
     def _start_training(self, room: _Room, args: dict) -> dict:
         """Run a Lloyd fit in a worker thread, streaming one SSE ``train``
         event per iteration (the numeric analog of the reference's
@@ -559,14 +641,15 @@ class KMeansServer:
                 )
         # One training per room AND a server-wide concurrency bound, so many
         # rooms can't stack unbounded worker threads.
-        if not self._train_sem.acquire(blocking=False):
+        if not self._train_slot_acquire():
             raise CapacityError(
                 "server training capacity exhausted; retry after "
                 f"{self.config.retry_after_s}s"
             )
         if not room.train_lock.acquire(blocking=False):
-            self._train_sem.release()
+            self._train_slot_release()
             raise ValueError("training already running in this room")
+        _TRAIN_STARTED_TOTAL.labels(model=model).inc()
 
         def work():
             try:
@@ -664,10 +747,11 @@ class KMeansServer:
                     "k": int(_state_k(state)),
                 })
             except Exception as e:   # stream the failure, don't kill the room
+                _TRAIN_ERRORS_TOTAL.inc()
                 room.broadcast_event({"type": "train_error", "error": str(e)})
             finally:
                 room.train_lock.release()
-                self._train_sem.release()
+                self._train_slot_release()
 
         threading.Thread(target=work, daemon=True).start()
         return {"started": True, "n": n, "d": d, "k": k}
@@ -683,6 +767,22 @@ class KMeansServer:
                 pass
 
             # -- plumbing --------------------------------------------------
+            def send_response(self, code, message=None):
+                # Every response path funnels through here — the one
+                # place the request metrics can learn the status code.
+                self._obs_status = int(code)
+                super().send_response(code, message)
+
+            def _observe_request(self, method, path, t0):
+                route = _route_label(path)
+                _HTTP_REQUESTS_TOTAL.labels(
+                    method=method, route=route,
+                    status=str(getattr(self, "_obs_status", 0)),
+                ).inc()
+                if route != "/api/events":
+                    _HTTP_REQUEST_SECONDS.labels(
+                        method=method, route=route,
+                    ).observe(time.perf_counter() - t0)
             def _headers_for(self, ctype, extra=None, length=None):
                 self.send_response(HTTPStatus.OK)
                 self.send_header("Content-Type", ctype)
@@ -716,6 +816,7 @@ class KMeansServer:
                 """503 + Retry-After: the server-side half of the retry
                 contract — tell the client WHEN to come back, not just
                 that it failed."""
+                _HTTP_503_TOTAL.inc()
                 ra = int(server.config.retry_after_s)
                 self._error(
                     msg, HTTPStatus.SERVICE_UNAVAILABLE,
@@ -752,10 +853,13 @@ class KMeansServer:
             def do_GET(self):
                 path = urllib.parse.urlparse(self.path).path
                 q = self._query()
+                t0 = time.perf_counter()
                 try:
                     return self._do_get(path, q)
                 except RoomTableFullError as e:
                     return self._busy(e)
+                finally:
+                    self._observe_request("GET", path, t0)
 
             def _do_get(self, path, q):
                 if path in ("/", "/index.html"):
@@ -795,6 +899,21 @@ class KMeansServer:
                     return self._sse(server.room(q.get("room")))
                 if path == "/healthz":
                     return self._json({"ok": True, "rooms": len(server.rooms)})
+                if path == "/metrics":
+                    # Prometheus text exposition of the whole process
+                    # registry: engine iteration histograms, retry /
+                    # checkpoint / prefetch counters, and the HTTP
+                    # metrics around this very request.
+                    if not server.config.metrics:
+                        return self._error("metrics disabled",
+                                           HTTPStatus.NOT_FOUND)
+                    body = obs.REGISTRY.expose().encode()
+                    self._headers_for(
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        length=len(body),
+                    )
+                    self.wfile.write(body)
+                    return
                 self._error("not found", HTTPStatus.NOT_FOUND)
 
             def _static(self, name, ctype):
@@ -847,6 +966,13 @@ class KMeansServer:
             def do_POST(self):
                 path = urllib.parse.urlparse(self.path).path
                 q = self._query()
+                t0 = time.perf_counter()
+                try:
+                    return self._do_post(path, q)
+                finally:
+                    self._observe_request("POST", path, t0)
+
+            def _do_post(self, path, q):
                 try:
                     if path == "/api/mutate":
                         room = server.room(q.get("room"))
@@ -912,9 +1038,11 @@ class KMeansServer:
 
 def serve(host: str = "127.0.0.1", port: int = 8787, *,
           background: bool = False,
-          persist_dir: Optional[str] = None) -> KMeansServer:
+          persist_dir: Optional[str] = None,
+          metrics: bool = True) -> KMeansServer:
     s = KMeansServer(ServeConfig(host=host, port=port,
-                                 persist_dir=persist_dir))
+                                 persist_dir=persist_dir,
+                                 metrics=metrics))
     try:
         s.start(background=background)
     except KeyboardInterrupt:

@@ -36,7 +36,38 @@ from typing import Any, Optional, Tuple
 import numpy as np
 
 from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.obs import counter as _obs_counter
 from kmeans_tpu.utils import faults
+
+#: Checkpoint observability (docs/OBSERVABILITY.md): the verify-on-load /
+#: fallback machinery works silently when it works — these counters make
+#: "how often are we actually eating corruption" a scrapeable number.
+#: ``role`` classifies the candidate dir: final, the ``.old`` swap
+#: survivor, or a step-tagged retention sibling.
+_CKPT_SAVES_TOTAL = _obs_counter(
+    "kmeans_tpu_checkpoint_saves_total",
+    "Checkpoints written (atomic tmp+rename swaps completed)",
+)
+_CKPT_VERIFY_FAILURES_TOTAL = _obs_counter(
+    "kmeans_tpu_checkpoint_verify_failures_total",
+    "Candidate checkpoint dirs rejected at load (torn/corrupt/unreadable)",
+    labels=("role",),
+)
+_CKPT_FALLBACK_LOADS_TOTAL = _obs_counter(
+    "kmeans_tpu_checkpoint_fallback_loads_total",
+    "Loads served by a fallback dir because the final dir was missing "
+    "or corrupt",
+    labels=("role",),
+)
+
+
+def _candidate_role(dirpath: str) -> str:
+    """final | old | step — the metrics label for one candidate dir."""
+    if dirpath.endswith(".old"):
+        return "old"
+    if ".step-" in os.path.basename(dirpath):
+        return "step"
+    return "final"
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
            "save_array_checkpoint", "load_array_checkpoint",
@@ -261,6 +292,7 @@ def save_array_checkpoint(
     if keep > 0:
         for stale in _step_dirs(final_path)[keep:]:
             shutil.rmtree(stale, ignore_errors=True)
+    _CKPT_SAVES_TOTAL.inc()
     return final_path
 
 
@@ -316,6 +348,8 @@ def _read_verified(dirpath: str) -> Optional[Tuple[dict, dict]]:
         # candidate actually served the load).  Name the reason here —
         # when EVERY copy is bad this line is the only diagnosis the
         # user gets of which array/file actually failed.
+        _CKPT_VERIFY_FAILURES_TOTAL.labels(
+            role=_candidate_role(dirpath)).inc()
         print(f"kmeans_tpu.checkpoint: candidate {dirpath!r} failed "
               f"verification: {e}", file=sys.stderr)
         return None
@@ -372,6 +406,7 @@ def load_array_checkpoint(path: str) -> Tuple[dict, dict]:
         )
     cand, (arrays, meta) = chosen
     if cand != path:
+        _CKPT_FALLBACK_LOADS_TOTAL.labels(role=_candidate_role(cand)).inc()
         print(f"kmeans_tpu.checkpoint: {path!r} is missing or corrupt; "
               f"loaded verified fallback {cand!r} (step {meta.get('step')})",
               file=sys.stderr)

@@ -28,7 +28,26 @@ import random
 import time
 from typing import Callable, Optional, Tuple, Type, Union
 
+from kmeans_tpu.obs import counter as _obs_counter
+
 __all__ = ["RetryPolicy", "RetryError"]
+
+#: Per-site retry observability (docs/OBSERVABILITY.md): every absorbed
+#: transient failure and every exhausted budget increments here, so the
+#: "invisible" retries PR 1 introduced show up on ``GET /metrics``.
+#: ``site`` is the caller-supplied callsite tag (``stream.read``,
+#: ``native.compile``, ``distributed.init``, ...), a closed set in
+#: practice — cardinality stays bounded.
+_RETRIES_TOTAL = _obs_counter(
+    "kmeans_tpu_retry_attempts_total",
+    "Transient failures absorbed by RetryPolicy (one per retried attempt)",
+    labels=("site",),
+)
+_RETRY_EXHAUSTED_TOTAL = _obs_counter(
+    "kmeans_tpu_retry_exhausted_total",
+    "RetryPolicy budgets exhausted (RetryError raised)",
+    labels=("site",),
+)
 
 #: Per-process call sequence mixed into each call()'s jitter seed: N hosts
 #: (or N prefetch threads) sharing one policy must NOT sleep identical
@@ -94,16 +113,22 @@ class RetryPolicy:
 
     def call(self, fn: Callable, *args,
              on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             site: str = "unlabeled",
              **kwargs):
         """Run ``fn(*args, **kwargs)``, retrying transient failures.
 
         ``on_retry(attempt, exc)`` fires before each backoff sleep (attempt
         is the 1-based attempt that just failed) — the observability hook
-        the callers use to log what was absorbed.
+        the callers use to log what was absorbed.  ``site`` tags the
+        callsite in the retry metrics
+        (``kmeans_tpu_retry_attempts_total{site=...}`` /
+        ``kmeans_tpu_retry_exhausted_total{site=...}``) so per-site retry
+        pressure is visible on ``GET /metrics``.
         """
         rng = random.Random(
             self.seed * 1_000_003 + os.getpid() * 7919 + next(_CALL_SEQ)
         )
+        retried = _RETRIES_TOTAL.labels(site=site)
         start = time.monotonic()
         schedule = list(self.delays())
         last: Optional[BaseException] = None
@@ -123,9 +148,11 @@ class RetryPolicy:
                     time.monotonic() - start + delay > self.deadline
                 ):
                     break
+                retried.inc()
                 if on_retry is not None:
                     on_retry(attempt, e)
                 time.sleep(delay)
+        _RETRY_EXHAUSTED_TOTAL.labels(site=site).inc()
         raise RetryError(
             f"gave up after {attempt} attempt(s): {last}", attempts=attempt,
         ) from last

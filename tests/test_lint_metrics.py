@@ -1,0 +1,45 @@
+"""The metric catalog cannot drift: tools/check_metrics.py, run in-suite
+(same contract as tests/test_lint_excepts.py for silent excepts).
+
+The lint imports every metric-registering module, reads the real
+registry, and cross-checks docs/OBSERVABILITY.md — a registered-but-
+undocumented metric, a stale doc row, or a naming-convention violation
+is a red test, not a review finding.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import check_metrics  # noqa: E402
+
+
+def test_repo_metric_catalog_is_consistent():
+    violations = check_metrics.run(_ROOT)
+    assert not violations, "\n".join(violations)
+
+
+def test_detects_undocumented_metric():
+    out = check_metrics.check(
+        {"kmeans_tpu_new_total": ("counter", (), "new")}, set())
+    assert len(out) == 1 and "missing from" in out[0]
+
+
+def test_detects_stale_doc_row():
+    out = check_metrics.check({}, {"kmeans_tpu_gone_total"})
+    assert len(out) == 1 and "not registered" in out[0]
+
+
+def test_detects_naming_convention_violation():
+    out = check_metrics.check(
+        {"foo_requests_total": ("counter", (), "")}, {"foo_requests_total"})
+    assert len(out) == 1 and "naming convention" in out[0]
+
+
+def test_exposition_suffixes_in_doc_are_fine():
+    registered = {"kmeans_tpu_h_seconds": ("histogram", ("m",), "h")}
+    documented = {"kmeans_tpu_h_seconds", "kmeans_tpu_h_seconds_bucket",
+                  "kmeans_tpu_h_seconds_count"}
+    assert check_metrics.check(registered, documented) == []

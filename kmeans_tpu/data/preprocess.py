@@ -215,11 +215,15 @@ def _chunk_mean(xb):
     return jnp.mean(xb.astype(jnp.float32), axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("compute_dtype",))
+@functools.partial(jax.jit, static_argnames=("compute_dtype",),
+                   donate_argnums=(0, 1))
 def _accumulate_moments(s, ss, xb, mu0, *, compute_dtype):
     """One chunk's contribution to the streamed centered (sum, second-
     moment) accumulators.  Module-level so the jit cache persists across
-    calls."""
+    calls.  ``s``/``ss`` are donated: the caller's loop overwrites its
+    carry with the returns every chunk, so the old (d,)+(d, d) buffers
+    are dead — XLA reuses them for the outputs instead of holding both
+    generations live."""
     f32 = jnp.float32
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else xb.dtype
     y = xb.astype(f32) - mu0

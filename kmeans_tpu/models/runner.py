@@ -15,6 +15,7 @@ serve layer and long jobs use:
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Callable, Optional
 
@@ -209,7 +210,13 @@ class LloydRunner:
                     with_mind=True,
                 )
 
-                @jax.jit
+                # The carried (labels, sums, counts) are donated: run()
+                # overwrites self._dstate with the returns every step,
+                # so the previous generation's buffers are dead on entry
+                # — donation lets XLA write the new state in place
+                # instead of holding 2x the carried-state memory
+                # (docs/ANALYSIS.md, DON301).
+                @functools.partial(jax.jit, donate_argnums=(2, 3, 4))
                 def step_delta(x, c, lab, sums, counts):
                     labels, min_d2, sums, counts, inertia, _ = delta_pass(
                         x, c, lab, sums, counts, **dkw)
@@ -224,8 +231,6 @@ class LloydRunner:
 
             self._step = step
         else:
-            import functools
-
             from jax.sharding import NamedSharding, PartitionSpec as P
             from kmeans_tpu.parallel.engine import (
                 _dp_local_pass,

@@ -34,7 +34,13 @@ from kmeans_tpu.models.lloyd import KMeansState
 __all__ = ["fit_minibatch_stream", "assign_stream"]
 
 
-@functools.partial(jax.jit, static_argnames=("compute_dtype",))
+# ``centroids`` is deliberately NOT donated: the fit loop keeps the
+# previous generation alive to compute the per-step shift for its
+# callback (`c_prev` in fit_minibatch_stream) — donating it would leave
+# c_prev pointing at a reused buffer.  ``n_seen`` has no such reader.
+@functools.partial(jax.jit, static_argnames=("compute_dtype",),
+                   donate_argnums=(1,))
+# analyze: disable=DON301 -- centroids can't donate: the loop's c_prev shift callback reads the pre-step buffer
 def _stream_step(centroids, n_seen, xb, *, compute_dtype):
     """One streamed update: :func:`kmeans_tpu.models.minibatch.batch_update`
     (the single copy of the rule) with the batch as a fed argument instead

@@ -188,6 +188,7 @@ def _accumulate_xla(x, lab_a, w_a, lab_b, w_b, k, *, chunk_size,
     static_argnames=("cap", "chunk_size", "compute_dtype", "backend",
                      "weights_are_binary", "with_mind"),
 )
+# analyze: disable=DON301 -- public eager entry: callers legitimately reuse labels_prev/sums_prev after the call (tests/test_ops.py backend sweeps); donation lives in the loop-level jits (LloydRunner.step_delta, _accumulate_moments)
 def delta_pass(
     x: jax.Array,
     centroids: jax.Array,

@@ -185,6 +185,7 @@ def _scores_chunked(x, centroids, csq, *, chunk_size, compute_dtype):
     static_argnames=("cap", "chunk_size", "compute_dtype", "backend",
                      "weights_are_binary"),
 )
+# analyze: disable=DON301 -- public eager entry, same contract as ops.delta.delta_pass: callers may reuse the carried state after the call; the jitted fit loops carry it internally
 def hamerly_pass(
     x: jax.Array,
     centroids: jax.Array,

@@ -2253,6 +2253,28 @@ def fit_gmm_sharded(
     )
 
 
+@functools.lru_cache(maxsize=32)
+def _build_assign(mesh, data_axis, chunk_size, compute_dtype, backend):
+    """Jitted sharded assignment, cached like every other ``_build_*``
+    builder: the previous inline ``jax.jit(f)(x, ...)`` minted a fresh
+    callable — and therefore a full XLA recompile — on EVERY
+    sharded_assign call (the runner's finalize pays it once per fit;
+    repeated same-shaped assigns paid it every time)."""
+    def local(x_loc, c):
+        labels, mind, _, _, _ = lloyd_pass(
+            x_loc, c, chunk_size=chunk_size, compute_dtype=compute_dtype,
+            with_update=False, backend=backend,
+        )
+        return labels, mind
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P()),
+        out_specs=(P(data_axis), P(data_axis)),
+        check_vma=False,
+    ))
+
+
 def sharded_assign(
     x,
     centroids,
@@ -2271,21 +2293,8 @@ def sharded_assign(
         compute_dtype=compute_dtype,
         platform=mesh.devices.flat[0].platform,
     )
-
-    def local(x_loc, c):
-        labels, mind, _, _, _ = lloyd_pass(
-            x_loc, c, chunk_size=chunk_size, compute_dtype=compute_dtype,
-            with_update=False, backend=backend,
-        )
-        return labels, mind
-
-    f = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(data_axis), P()),
-        out_specs=(P(data_axis), P(data_axis)),
-        check_vma=False,
-    )
-    labels, mind = jax.jit(f)(x, jnp.asarray(centroids, jnp.float32))
+    f = _build_assign(mesh, data_axis, chunk_size, compute_dtype, backend)
+    labels, mind = f(x, jnp.asarray(centroids, jnp.float32))
     return labels[:n], mind[:n]
 
 

@@ -369,7 +369,11 @@ class KMeansServer:
                 continue                      # foreign file, not ours
             room = self._revive_or_create(code)
             self._wire_persistence(room)
-            self.rooms[code] = room
+            # Boot runs before the HTTP threads exist, but the room
+            # table's lock discipline stays uniform: every writer holds
+            # self._lock (tools/analyze, LCK401).
+            with self._lock:
+                self.rooms[code] = room
 
     def _wire_persistence(self, room: _Room) -> None:
         if not self.config.persist_dir:
@@ -427,6 +431,7 @@ class KMeansServer:
                 path = self._room_path(room.code)
                 tmp = (f"{path}.tmp.{os.getpid()}."
                        f"{threading.get_ident()}")
+                # analyze: disable=LCK402 -- serializing writers around this I/O is the per-code save lock's entire purpose (torn-file prevention); only save paths for THIS room code contend here
                 with open(tmp, "w", encoding="utf-8") as f:
                     f.write(text)
                 os.replace(tmp, path)         # atomic: never a torn file

@@ -1,0 +1,28 @@
+"""donation BAD fixture: carried-state step jits with no donate clause."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def accumulate(sums, counts, delta, dcounts):          # DON301
+    return sums + delta, counts + dcounts
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def scatter_update(c, idx, v, *, k):                   # DON301 (.at form)
+    return c.at[idx % k].add(v)
+
+
+@jax.jit
+def cond_update(c, sums, force):                       # DON301 (branch fn)
+    def incremental(_):
+        return sums + 1.0
+
+    def full(_):
+        return jnp.zeros_like(sums)
+
+    return c, lax.cond(force, full, incremental, None)

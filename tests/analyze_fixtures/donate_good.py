@@ -1,0 +1,30 @@
+"""donation GOOD fixture: the same steps with the dead inputs donated
+(or, for the annotated case, a recorded reason not to)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def accumulate(sums, counts, delta, dcounts):
+    return sums + delta, counts + dcounts
+
+
+@functools.partial(jax.jit, static_argnames=("k",), donate_argnums=(0,))
+def scatter_update(c, idx, v, *, k):
+    return c.at[idx % k].add(v)
+
+
+@jax.jit
+# analyze: disable=DON301 -- fixture: callers reuse `sums` after the call
+def annotated_update(sums, delta):
+    return sums + delta
+
+
+@jax.jit
+def pure_producer(x, c):
+    # Derived outputs (no argument-shaped passthrough): nothing to donate.
+    d2 = jnp.sum((x[:, None] - c[None]) ** 2, -1)
+    return jnp.argmin(d2, 1), jnp.min(d2, 1)

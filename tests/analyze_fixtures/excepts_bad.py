@@ -1,0 +1,15 @@
+"""silent-excepts BAD fixture: both defect classes."""
+
+
+def swallow_everything(op):
+    try:
+        return op()
+    except:                                            # EXC501
+        return None
+
+
+def eat_silently(op):
+    try:
+        return op()
+    except Exception:                                  # EXC502
+        pass

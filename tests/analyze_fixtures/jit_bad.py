@@ -1,0 +1,27 @@
+"""jit-hygiene BAD fixture: every construct here is a deliberate
+violation — this file is scanned by tests, never imported/executed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_step(x, c):
+    d2 = jnp.sum((x[:, None, :] - c[None, :, :]) ** 2, axis=-1)
+    inertia = float(jnp.sum(jnp.min(d2, axis=1)))      # JIT102
+    hist = np.bincount(np.asarray(d2.argmin(1)))       # JIT103 (x2)
+    print("inertia", inertia)                          # JIT105
+    if jnp.any(d2 < 0):                                # JIT104
+        return c
+    return c, hist, d2.min(1).item()                   # JIT101
+
+
+def helper_reached_from_jit(v):
+    # Reached through bad_loop below -> still jitted code.
+    return v.tolist()                                  # JIT101
+
+
+@jax.jit
+def bad_loop(x):
+    return helper_reached_from_jit(x)

@@ -1,0 +1,27 @@
+"""jit-hygiene GOOD fixture: the paired clean version of jit_bad.py —
+host work stays outside the jit; traced control flow uses lax."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("with_update",),
+                   donate_argnums=(1,))
+def good_step(x, c, *, with_update=True):
+    d2 = jnp.sum((x[:, None, :] - c[None, :, :]) ** 2, axis=-1)
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    if with_update:                       # static Python bool: fine
+        c = c + 0.5 * jnp.mean(x, axis=0)
+    c = lax.cond(inertia < 0, lambda v: v, lambda v: v + 1.0, c)
+    jax.debug.print("inertia {i}", i=inertia)
+    return c, inertia
+
+
+def host_report(state):
+    # NOT reached from any jit: host conversions are fine here.
+    print("inertia", float(state[1]))
+    return np.asarray(state[0]).tolist()

@@ -1,0 +1,35 @@
+"""lock-discipline BAD fixture: mixed locking + blocking under a lock."""
+
+import threading
+import time
+
+
+class LeakyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+        self.names = {}
+
+    def bump(self):
+        with self._lock:
+            self.value += 1                            # locked writer
+
+    def reset(self):
+        self.value = 0                                 # LCK401
+
+    def remember(self, name):
+        with self._lock:
+            self.names[name] = time.time()
+
+    def forget(self, name):
+        self.names.pop(name, None)                     # LCK401
+
+    def slow_bump(self):
+        with self._lock:
+            time.sleep(0.1)                            # LCK402
+            self.value += 1
+
+    def persist(self, path):
+        with self._lock:
+            with open(path, "w") as f:                 # LCK402
+                f.write(str(self.value))

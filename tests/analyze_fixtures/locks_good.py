@@ -1,0 +1,34 @@
+"""lock-discipline GOOD fixture: uniform locking; I/O outside the
+critical section."""
+
+import threading
+import time
+
+
+class TidyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+        self.names = {}
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def reset(self):
+        with self._lock:
+            self.value = 0
+
+    def remember(self, name):
+        with self._lock:
+            self.names[name] = time.time()
+
+    def forget(self, name):
+        with self._lock:
+            self.names.pop(name, None)
+
+    def persist(self, path):
+        with self._lock:
+            snapshot = self.value
+        with open(path, "w") as f:      # I/O after the lock is released
+            f.write(str(snapshot))

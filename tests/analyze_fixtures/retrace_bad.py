@@ -1,0 +1,35 @@
+"""retrace-risk BAD fixture: cache-defeating jit call sites."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def assign_every_call(x, c):
+    def local(xb, cb):
+        return jnp.argmin(jnp.sum((xb[:, None] - cb[None]) ** 2, -1), 1)
+
+    return jax.jit(local)(x, c)                        # RET201 (immediate)
+
+
+def build_step_uncached(chunk):
+    def step(x, c):
+        return x[:chunk] @ c.T
+
+    return jax.jit(step)                               # RET201 (escapes)
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def step_with_mutable_static(x, opts=[1, 2]):          # RET203
+    return x * opts[0]
+
+
+def make_closure_step(scale_value):
+    scale = jnp.asarray(scale_value)
+
+    @jax.jit                                           # RET202 + RET204
+    def step(x):
+        return x * scale
+
+    return step

@@ -1,0 +1,29 @@
+"""retrace-risk GOOD fixture: the cached/hoisted versions."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def assign(x, c):
+    return jnp.argmin(jnp.sum((x[:, None] - c[None]) ** 2, -1), 1)
+
+
+@functools.lru_cache(maxsize=8)
+def build_step_cached(chunk):
+    def step(x, c):
+        return x[:chunk] @ c.T
+
+    return jax.jit(step)
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def step_with_hashable_static(x, opts=(1, 2)):
+    return x * opts[0]
+
+
+@jax.jit
+def step_takes_scale(x, scale):
+    return x * scale

@@ -1,0 +1,311 @@
+"""The static-analysis framework, run in-suite (tier-1).
+
+Covers the acceptance contract of tools/analyze (docs/ANALYSIS.md):
+
+* the repo itself scans clean modulo the committed baseline (the same
+  gate ``python -m tools.analyze`` enforces),
+* each analyzer catches its bad fixture and passes its good fixture
+  (tests/analyze_fixtures/ — deliberately-broken files excluded from
+  repo walks),
+* inline suppressions (`# analyze: disable=RULE -- reason`) and the
+  baseline file round-trip,
+* the ``--changed`` fast mode scans exactly the git-dirty set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.analyze import (all_analyzers, load_baseline,  # noqa: E402
+                           run_analysis, write_baseline, BASELINE_REL)
+from tools.analyze.__main__ import changed_files, main  # noqa: E402
+from tools.analyze.walker import Repo  # noqa: E402
+
+FIXTURES = "tests/analyze_fixtures"
+
+
+def _run(files=None, analyzers=None, baseline=None, root=_ROOT):
+    return run_analysis(root, analyzers or all_analyzers(),
+                        files=files, baseline=baseline)
+
+
+def _one(name):
+    return [a for a in all_analyzers() if a.name == name]
+
+
+# ------------------------------------------------------------ self-scan
+
+def test_repo_is_clean_modulo_committed_baseline():
+    """THE gate: the full pass over the real repo, exactly as
+    ``python -m tools.analyze`` runs it in CI."""
+    baseline = load_baseline(os.path.join(_ROOT, BASELINE_REL))
+    report = _run(baseline=baseline)
+    assert not report.failing, "\n".join(
+        f.format() for f in report.failing)
+
+
+def test_fixtures_are_excluded_from_repo_walks():
+    repo = Repo(_ROOT)
+    assert repo.get(f"{FIXTURES}/excepts_bad.py") is None
+    # ... but an explicit file list overrides the exclusion.
+    repo = Repo(_ROOT, files=[f"{FIXTURES}/excepts_bad.py"])
+    assert repo.get(f"{FIXTURES}/excepts_bad.py") is not None
+
+
+def test_legacy_excepts_shim_skips_fixtures():
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    try:
+        import check_excepts
+    finally:
+        sys.path.pop(0)
+    rels = {rel for rel, _, _ in check_excepts.run(_ROOT)}
+    assert not any("analyze_fixtures" in r for r in rels)
+
+
+# ----------------------------------------------- per-analyzer fixtures
+
+CASES = [
+    ("jit-hygiene", "jit",
+     {"JIT101", "JIT102", "JIT103", "JIT104", "JIT105"}),
+    ("retrace-risk", "retrace", {"RET201", "RET202", "RET203", "RET204"}),
+    ("donation", "donate", {"DON301"}),
+    ("lock-discipline", "locks", {"LCK401", "LCK402"}),
+    ("silent-excepts", "excepts", {"EXC501", "EXC502"}),
+]
+
+
+@pytest.mark.parametrize("analyzer,stem,rules", CASES,
+                         ids=[c[0] for c in CASES])
+def test_analyzer_catches_bad_fixture(analyzer, stem, rules):
+    report = _run(files=[f"{FIXTURES}/{stem}_bad.py"],
+                  analyzers=_one(analyzer))
+    got = {f.rule for f in report.findings}
+    assert rules <= got, f"missing rules: {rules - got}"
+
+
+@pytest.mark.parametrize("analyzer,stem,rules", CASES,
+                         ids=[c[0] for c in CASES])
+def test_analyzer_passes_good_fixture(analyzer, stem, rules):
+    report = _run(files=[f"{FIXTURES}/{stem}_good.py"],
+                  analyzers=_one(analyzer))
+    assert not report.findings, "\n".join(
+        f.format() for f in report.findings)
+
+
+@pytest.mark.parametrize("stem", [c[1] for c in CASES])
+def test_cli_exits_nonzero_on_bad_fixture(stem, capsys):
+    assert main([f"{FIXTURES}/{stem}_bad.py", "--no-baseline"]) == 1
+    assert main([f"{FIXTURES}/{stem}_good.py", "--no-baseline"]) == 0
+    capsys.readouterr()
+
+
+# ------------------------------------------------ suppressions/baseline
+
+_BAD_SNIPPET = "try:\n    x()\nexcept Exception:\n    pass\n"
+
+
+def _tmp_source(tmp_path, body):
+    p = tmp_path / "mod.py"
+    p.write_text(body)
+    return str(tmp_path), ["mod.py"]
+
+
+def test_suppression_marker_silences_with_reason(tmp_path):
+    root, files = _tmp_source(
+        tmp_path,
+        "try:\n    x()\n"
+        "except Exception:  # analyze: disable=EXC502 -- test cleanup\n"
+        "    pass\n",
+    )
+    report = _run(files=files, analyzers=_one("silent-excepts"),
+                  root=root)
+    assert not report.findings and report.suppressed == 1
+
+
+def test_suppression_marker_on_preceding_line(tmp_path):
+    root, files = _tmp_source(
+        tmp_path,
+        "try:\n    x()\n"
+        "# analyze: disable=EXC502 -- guarded from the line above\n"
+        "except Exception:\n    pass\n",
+    )
+    report = _run(files=files, analyzers=_one("silent-excepts"),
+                  root=root)
+    assert not report.findings and report.suppressed == 1
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    root, files = _tmp_source(
+        tmp_path,
+        "try:\n    x()\n"
+        "except Exception:  # analyze: disable=EXC502\n"
+        "    pass\n",
+    )
+    report = _run(files=files, analyzers=_one("silent-excepts"),
+                  root=root)
+    assert {f.rule for f in report.findings} == {"SUP001"}
+    assert report.suppressed == 1       # the EXC502 itself is silenced
+
+
+def test_suppression_of_other_rule_does_not_match(tmp_path):
+    root, files = _tmp_source(
+        tmp_path,
+        "try:\n    x()\n"
+        "except Exception:  # analyze: disable=JIT101 -- wrong rule\n"
+        "    pass\n",
+    )
+    report = _run(files=files, analyzers=_one("silent-excepts"),
+                  root=root)
+    assert {f.rule for f in report.findings} == {"EXC502"}
+
+
+def test_ret204_ignores_arrays_built_inside_the_closure(tmp_path):
+    """An array constructed INSIDE the jitted closure is a per-trace
+    local, not a baked closure constant — RET204 must not fire."""
+    root, files = _tmp_source(
+        tmp_path,
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "def make_step(k):\n"
+        "    @jax.jit\n"
+        "    def step(c):\n"
+        "        z = jnp.zeros((k,))\n"
+        "        return c + z\n"
+        "    return step\n",
+    )
+    report = _run(files=files, analyzers=_one("retrace-risk"), root=root)
+    assert not any(f.rule == "RET204" for f in report.findings), \
+        "\n".join(f.format() for f in report.findings)
+
+
+def test_sup001_reported_in_otherwise_clean_file(tmp_path):
+    root, files = _tmp_source(
+        tmp_path, "x = 1  # analyze: disable=JIT103\n")
+    report = _run(files=files, analyzers=_one("silent-excepts"),
+                  root=root)
+    assert {f.rule for f in report.findings} == {"SUP001"}
+
+
+def test_baseline_round_trip(tmp_path):
+    root, files = _tmp_source(tmp_path, _BAD_SNIPPET)
+    report = _run(files=files, analyzers=_one("silent-excepts"),
+                  root=root)
+    assert report.failing
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), report.failing)
+    report2 = _run(files=files, analyzers=_one("silent-excepts"),
+                   root=root, baseline=load_baseline(str(bl)))
+    assert not report2.findings and report2.baselined == 1
+
+
+def test_cli_write_baseline_full_scan_round_trip(tmp_path, capsys):
+    # A tmp root with one violation in a scanned location: write the
+    # baseline on a FULL scan, then the same scan is clean.
+    (tmp_path / "bench.py").write_text(_BAD_SNIPPET)
+    bl = str(tmp_path / "bl.json")
+    root = str(tmp_path)
+    assert main(["--root", root, "--baseline", bl,
+                 "--write-baseline"]) == 0
+    assert main(["--root", root, "--baseline", bl]) == 0
+    assert main(["--root", root, "--no-baseline"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_refuses_partial_scans(tmp_path, capsys):
+    """A partial scan must never clobber the committed baseline with its
+    subset (it would erase every unscanned file's recorded debt)."""
+    bl = str(tmp_path / "bl.json")
+    assert main([f"{FIXTURES}/excepts_bad.py", "--baseline", bl,
+                 "--write-baseline"]) == 2
+    assert not os.path.exists(bl)
+    capsys.readouterr()
+
+
+def test_cli_json_output(capsys):
+    assert main([f"{FIXTURES}/locks_bad.py", "--no-baseline",
+                 "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    rules = {f["rule"] for f in out["findings"]}
+    assert "LCK401" in rules and out["counts"]["error"] >= 1
+
+
+def test_cli_rules_listing(capsys):
+    assert main(["--rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("JIT101", "RET201", "DON301", "LCK401", "EXC501",
+                 "MET601"):
+        assert rule in out
+
+
+# --------------------------------------------------------- --changed
+
+def _git(cwd, *args):
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    *args], cwd=cwd, check=True, capture_output=True)
+
+
+def test_changed_mode_scans_only_dirty_files(tmp_path, capsys):
+    root = str(tmp_path)
+    _git(root, "init", "-q")
+    clean = tmp_path / "clean.py"
+    clean.write_text("def ok():\n    return 1\n")
+    _git(root, "add", "clean.py")
+    _git(root, "commit", "-q", "-m", "seed")
+    # No dirty files: fast mode is a no-op success.
+    assert main(["--root", root, "--changed", "--no-baseline"]) == 0
+    # An untracked violation enters the scan set...
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD_SNIPPET)
+    assert changed_files(root) == ["bad.py"]
+    assert main(["--root", root, "--changed", "--no-baseline"]) == 1
+    # ...and a tracked-but-modified file does too.
+    _git(root, "add", "bad.py")
+    _git(root, "commit", "-q", "-m", "bad")
+    clean.write_text(_BAD_SNIPPET)
+    assert changed_files(root) == ["clean.py"]
+    capsys.readouterr()
+
+
+def test_changed_mode_keeps_analyzer_scopes(tmp_path, capsys):
+    """--changed is a SUBSET of the full gate: a dirty out-of-scope file
+    (tests/) must not face the kmeans_tpu/-scoped analyzers, while an
+    explicit positional path runs everything on purpose."""
+    root = str(tmp_path)
+    _git(root, "init", "-q")
+    (tmp_path / "seed.py").write_text("ok = 1\n")
+    _git(root, "add", "seed.py")
+    _git(root, "commit", "-q", "-m", "seed")
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    # RET201 pattern (kmeans_tpu/-scoped rule) in a tests/ file.
+    (tdir / "helper.py").write_text(
+        "import jax\n\n"
+        "def lower(f, x):\n"
+        "    return jax.jit(f)(x)\n")
+    assert main(["--root", root, "--changed", "--no-baseline"]) == 0
+    assert main(["--root", root, "tests/helper.py",
+                 "--no-baseline"]) == 1
+    capsys.readouterr()
+
+
+def test_changed_mode_excludes_fixture_paths(tmp_path, capsys):
+    """A dirty analyzer fixture must not fail the pre-commit scan —
+    containing deliberate violations is the fixture's job."""
+    root = str(tmp_path)
+    _git(root, "init", "-q")
+    (tmp_path / "seed.py").write_text("ok = 1\n")
+    _git(root, "add", "seed.py")
+    _git(root, "commit", "-q", "-m", "seed")
+    fx = tmp_path / "tests" / "analyze_fixtures"
+    fx.mkdir(parents=True)
+    (fx / "broken.py").write_text(_BAD_SNIPPET)
+    assert main(["--root", root, "--changed", "--no-baseline"]) == 0
+    capsys.readouterr()

@@ -1,0 +1,4 @@
+"""Repo tooling: the static-analysis framework (tools.analyze), its
+thin legacy shims (check_excepts, check_metrics) and bench rendering
+(bench_table).  A package so ``python -m tools.analyze`` works from the
+repo root."""

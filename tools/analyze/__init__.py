@@ -1,0 +1,26 @@
+"""Plugin-based static analysis for the repo (docs/ANALYSIS.md).
+
+One walk, one parse per file, six analyzers::
+
+    python -m tools.analyze              # full repo scan
+    python -m tools.analyze --changed    # only files touched vs HEAD
+    python -m tools.analyze path.py ...  # explicit files/dirs
+
+Exit 0 when the tree is clean modulo the committed baseline
+(tools/analyze/baseline.json); non-zero otherwise.  Inline suppression:
+``# analyze: disable=RULE -- reason``.
+"""
+
+from __future__ import annotations
+
+from tools.analyze.core import (Analyzer, Finding, Report, Rule,
+                                load_baseline, run_analysis,
+                                write_baseline, BASELINE_REL)
+from tools.analyze.plugins import all_analyzers
+from tools.analyze.walker import Repo, Source
+
+__all__ = [
+    "Analyzer", "Finding", "Report", "Rule", "Repo", "Source",
+    "all_analyzers", "load_baseline", "run_analysis", "write_baseline",
+    "BASELINE_REL",
+]

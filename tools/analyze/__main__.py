@@ -1,0 +1,169 @@
+"""CLI for the static-analysis framework — see docs/ANALYSIS.md.
+
+Text output is ``path:line: RULE severity: message``; ``--json`` emits
+the same findings machine-readably.  Exit status: 0 clean (modulo the
+committed baseline and inline suppressions), 1 findings, 2 usage/
+internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def changed_files(root: str) -> List[str]:
+    """Repo-relative .py files changed vs HEAD plus untracked ones —
+    the fast pre-commit scan set."""
+    out: List[str] = []
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(args, cwd=root, capture_output=True,
+                              text=True, check=False)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(args)} failed: {proc.stderr.strip()}")
+        out.extend(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    seen = []
+    for rel in out:
+        if rel.endswith(".py") and rel not in seen and \
+                os.path.exists(os.path.join(root, rel)):
+            seen.append(rel)
+    return seen
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="Static analysis: jit hygiene, retrace risk, buffer "
+                    "donation, lock discipline, silent excepts, metrics "
+                    "catalog.")
+    p.add_argument("paths", nargs="*",
+                   help="restrict the scan to these files/dirs "
+                        "(repo-relative)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: this checkout)")
+    p.add_argument("--changed", action="store_true",
+                   help="scan only files changed vs HEAD (+ untracked)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--rules", action="store_true",
+                   help="list every rule and exit")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: committed "
+                        "tools/analyze/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report all findings)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record current findings as the new baseline")
+    args = p.parse_args(argv)
+
+    from tools.analyze import (all_analyzers, load_baseline,
+                               run_analysis, write_baseline,
+                               BASELINE_REL)
+
+    analyzers = all_analyzers()
+    if args.rules:
+        for an in analyzers:
+            print(f"[{an.name}]")
+            for r in an.rules:
+                print(f"  {r.id}  {r.severity:<7}  {r.summary}")
+        return 0
+
+    root = os.path.abspath(args.root or repo_root())
+    files: Optional[List[str]] = None
+    respect_scopes = False
+    if args.changed and args.paths:
+        print("--changed and explicit paths are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    if args.changed:
+        from tools.analyze.walker import _is_excluded
+
+        try:
+            # The repo-walk exclusions (fixtures, __pycache__) apply to
+            # the git-dirty set too: a touched bad-fixture must not fail
+            # the pre-commit scan — being broken is the fixture's job.
+            files = [f for f in changed_files(root)
+                     if not _is_excluded(f)]
+            # The fast mode must stay a SUBSET of the full gate: keep
+            # each analyzer's scope cut (a dirty tests/ file must not
+            # suddenly face the kmeans_tpu/-scoped analyzers).
+            respect_scopes = True
+        except (RuntimeError, OSError) as e:
+            print(f"--changed needs a git checkout: {e}", file=sys.stderr)
+            return 2
+        if not files:
+            print("analyze: no changed .py files")
+            return 0
+    elif args.paths:
+        # A relative path is tried against --root first (so explicit
+        # paths compose with --root from any cwd), then against cwd.
+        files = []
+        for p in args.paths:
+            if not os.path.isabs(p) and \
+                    os.path.exists(os.path.join(root, p)):
+                files.append(p.replace(os.sep, "/"))
+            else:
+                files.append(os.path.relpath(os.path.abspath(p), root)
+                             .replace(os.sep, "/"))
+
+    if args.write_baseline and files is not None:
+        # A partial scan would overwrite the whole baseline with its
+        # subset, silently erasing every unscanned file's recorded debt.
+        print("--write-baseline requires a full scan (no explicit "
+              "paths / --changed)", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_REL)
+    baseline = (set() if (args.no_baseline or args.write_baseline)
+                else load_baseline(baseline_path))
+
+    report = run_analysis(root, analyzers, files=files,
+                          respect_scopes=respect_scopes,
+                          baseline=baseline)
+
+    if args.write_baseline:
+        n = write_baseline(baseline_path, report.failing)
+        print(f"analyze: baseline written: {n} finding(s) -> "
+              f"{os.path.relpath(baseline_path, root)}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in report.findings],
+            "counts": {
+                "error": sum(f.severity == "error"
+                             for f in report.findings),
+                "warning": sum(f.severity == "warning"
+                               for f in report.findings),
+                "info": sum(f.severity == "info"
+                            for f in report.findings),
+                "suppressed": report.suppressed,
+                "baselined": report.baselined,
+            },
+        }, indent=2))
+    else:
+        for f in report.findings:
+            print(f.format())
+        n_err = sum(f.severity == "error" for f in report.findings)
+        n_warn = sum(f.severity == "warning" for f in report.findings)
+        n_info = sum(f.severity == "info" for f in report.findings)
+        print(f"analyze: {n_err} error(s), {n_warn} warning(s), "
+              f"{n_info} info, {report.suppressed} suppressed, "
+              f"{report.baselined} baselined")
+    return 1 if report.failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+"""AST helpers shared by the JAX-aware plugins.
+
+Everything here is module-local, alias-aware name resolution: which
+names mean numpy / jax.numpy / lax / jax in THIS file, which functions
+are jit roots (decorated, wrapped, or referenced from a ``jax.jit`` /
+``jax.shard_map`` call), and which functions those roots reach through
+same-module calls.  Cross-module reach is deliberately out of scope —
+the jitted leaf modules (ops/) carry their own decorations, so
+module-local analysis covers the tree without a global call graph's
+false-positive surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+
+def attr_root(node: ast.AST) -> Optional[str]:
+    """Base Name id of an attribute chain (``jnp.sum`` -> ``jnp``),
+    or None when the base is not a plain name."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a pure Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class ModuleNames:
+    """Per-module alias sets from the import statements."""
+
+    def __init__(self, tree: ast.Module):
+        self.numpy: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.lax: Set[str] = set()
+        self.jax: Set[str] = set()
+        self.jit: Set[str] = set()          # `from jax import jit as j`
+        self.shard_map: Set[str] = set()
+        self.partial: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        self.numpy.add(a.asname or "numpy")
+                    elif a.name == "jax.numpy":
+                        # `import jax.numpy as jnp` binds jnp; a bare
+                        # `import jax.numpy` binds jax.
+                        if a.asname:
+                            self.jnp.add(a.asname)
+                        else:
+                            self.jax.add("jax")
+                    elif a.name == "jax":
+                        self.jax.add(a.asname or "jax")
+                    elif a.name == "jax.lax":
+                        if a.asname:
+                            self.lax.add(a.asname)
+                        else:
+                            self.jax.add("jax")
+                    elif a.name == "functools":
+                        self.partial.add((a.asname or "functools")
+                                         + ".partial")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if mod == "jax":
+                        if a.name == "numpy":
+                            self.jnp.add(bound)
+                        elif a.name == "lax":
+                            self.lax.add(bound)
+                        elif a.name == "jit":
+                            self.jit.add(bound)
+                        elif a.name == "shard_map":
+                            self.shard_map.add(bound)
+                    elif mod == "functools" and a.name == "partial":
+                        self.partial.add(bound)
+                    elif mod == "numpy":
+                        pass        # from numpy import X: not a np root
+                    elif mod in ("jax.numpy",):
+                        pass        # from jax.numpy import X: rare; skip
+                    elif mod == "jax.experimental.shard_map" and \
+                            a.name == "shard_map":
+                        self.shard_map.add(bound)
+
+    @property
+    def traced_roots(self) -> Set[str]:
+        """Names whose attribute calls produce / consume traced values."""
+        return self.jnp | self.lax | self.jax
+
+    def is_jit_expr(self, node: ast.AST) -> bool:
+        """Whether ``node`` denotes ``jax.jit`` (or an imported alias)."""
+        d = dotted(node)
+        if d is None:
+            return False
+        if d in self.jit:
+            return True
+        return any(d == f"{j}.jit" for j in self.jax)
+
+    def is_shard_map_expr(self, node: ast.AST) -> bool:
+        d = dotted(node)
+        if d is None:
+            return False
+        if d in self.shard_map:
+            return True
+        return any(d in (f"{j}.shard_map", f"{j}.experimental.shard_map")
+                   for j in self.jax)
+
+    def is_partial_expr(self, node: ast.AST) -> bool:
+        d = dotted(node)
+        return d is not None and (d in self.partial or d == "partial")
+
+
+FuncNode = ast.FunctionDef  # (async defs don't occur in jitted numerics)
+
+
+def iter_functions(tree: ast.Module) -> Iterator[FuncNode]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            yield node
+
+
+def jit_decoration(fn: FuncNode, names: ModuleNames
+                   ) -> Optional[ast.expr]:
+    """The decorator that jits ``fn`` (``@jax.jit``,
+    ``@partial(jax.jit, ...)``, ``@functools.partial(jax.jit, ...)``),
+    or None."""
+    for dec in fn.decorator_list:
+        if names.is_jit_expr(dec):
+            return dec
+        if isinstance(dec, ast.Call):
+            if names.is_jit_expr(dec.func):
+                return dec
+            if names.is_partial_expr(dec.func) and dec.args and \
+                    names.is_jit_expr(dec.args[0]):
+                return dec
+    return None
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class JitReach:
+    """Which functions in a module are traced under jit.
+
+    Roots: jit-decorated functions, local functions referenced inside a
+    ``jax.jit(...)`` or ``jax.shard_map(...)`` call's argument subtree
+    (wrapped form, shard-map bodies), and — transitively — any local
+    function a reached function references.  Functions defined lexically
+    inside a reached function are reached (closures trace with their
+    parent).
+    """
+
+    def __init__(self, tree: ast.Module, names: ModuleNames):
+        self.names = names
+        self.functions: List[FuncNode] = list(iter_functions(tree))
+        by_name: Dict[str, List[FuncNode]] = {}
+        for fn in self.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        self._by_name = by_name
+
+        reached: Set[FuncNode] = set()
+        work: List[FuncNode] = []
+
+        def mark(fn: FuncNode):
+            if fn not in reached:
+                reached.add(fn)
+                work.append(fn)
+
+        for fn in self.functions:
+            if jit_decoration(fn, names) is not None:
+                mark(fn)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and (
+                    names.is_jit_expr(node.func)
+                    or names.is_shard_map_expr(node.func)):
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    for ref in names_in(arg):
+                        for fn in by_name.get(ref, ()):
+                            mark(fn)
+
+        while work:
+            fn = work.pop()
+            # Nested defs trace with their parent.
+            for inner in ast.walk(fn):
+                if isinstance(inner, ast.FunctionDef) and inner is not fn:
+                    mark(inner)
+            # Same-module references from the body.
+            for ref in names_in(fn):
+                for target in by_name.get(ref, ()):
+                    mark(target)
+        self.reached = reached
+
+    def reached_functions(self) -> List[FuncNode]:
+        return [fn for fn in self.functions if fn in self.reached]
+
+
+def own_body(fn: FuncNode) -> Iterator[ast.AST]:
+    """Walk ``fn``'s statements WITHOUT descending into nested function
+    definitions (each nested def is analyzed as its own unit)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def call_rooted_at(node: ast.AST, roots: Set[str]) -> Optional[ast.Call]:
+    """First Call in ``node``'s subtree whose func chain is rooted at one
+    of ``roots`` (``jnp.sum(...)`` for roots={'jnp'}), or None.  Does not
+    descend into nested lambdas/defs."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            root = attr_root(sub.func)
+            if root in roots:
+                return sub
+    return None

@@ -1,0 +1,231 @@
+"""Framework core: findings, rules, inline suppressions, baseline.
+
+The contract every plugin shares (docs/ANALYSIS.md):
+
+* a **rule** has a stable id (``JIT101``), a severity, and a rationale;
+* a **finding** anchors a rule to ``path:line`` with a message;
+* an inline marker suppresses a finding where the code is deliberately
+  doing the flagged thing::
+
+      risky_thing()  # analyze: disable=JIT103 -- why this is intended
+
+  The reason after ``--`` is mandatory (a bare disable is itself a
+  finding, SUP001) — same philosophy as the original excepts lint's
+  ``allow-silent-except:`` marker: the *why* must enter the diff;
+* the **baseline** (tools/analyze/baseline.json, committed) holds
+  pre-existing findings so a new analyzer can land with real debt
+  recorded instead of blocking CI; ``--write-baseline`` refreshes it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analyze.walker import Repo, Source
+
+SEVERITIES = ("error", "warning", "info")
+
+#: Severities that fail the run (info is advisory only).
+FAILING = ("error", "warning")
+
+#: Default committed baseline location, relative to the repo root.
+BASELINE_REL = "tools/analyze/baseline.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    summary: str
+    rationale: str = ""
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"{self.id}: bad severity {self.severity!r}")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str           # repo-relative, '/'-separated
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, int]:
+        return (self.rule, self.path, self.line)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"{self.severity}: {self.message}")
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class Analyzer:
+    """Base class for file-based plugins: declare ``name``, ``rules``,
+    an optional ``scope`` (relpath prefixes), and implement
+    :meth:`check_source`.  Repo-level plugins (the metrics catalog)
+    override :meth:`run` instead and set ``file_based = False``."""
+
+    name: str = "analyzer"
+    rules: Sequence[Rule] = ()
+    scope: Optional[Tuple[str, ...]] = None
+    file_based: bool = True
+
+    def run(self, repo: Repo) -> List[Finding]:
+        out: List[Finding] = []
+        for src in repo.sources(self.scope):
+            if src.tree is None:
+                continue        # syntax errors are reported by the driver
+            out.extend(self.check_source(src))
+        return out
+
+    def check_source(self, src: Source) -> List[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------- suppressions
+
+SUPPRESS_RE = re.compile(
+    r"#\s*analyze:\s*disable=([A-Za-z0-9_*,\s]+?)"
+    r"(?:\s*--\s*(\S.*))?\s*$"
+)
+
+SUP_NO_REASON = Rule(
+    "SUP001", "error",
+    "`# analyze: disable=...` without a reason",
+    "The marker exists to force the WHY into the diff; a bare disable "
+    "is indistinguishable from silencing noise.",
+)
+
+
+class Suppressions:
+    """Per-file table of ``# analyze: disable=RULE[,RULE...] -- reason``
+    markers.  A marker suppresses matching findings on its own line and
+    on the line directly below (so a standalone comment line can guard a
+    statement).  ``disable=*`` matches every rule."""
+
+    def __init__(self, src: Source):
+        self._by_line: Dict[int, Set[str]] = {}
+        self.bare: List[int] = []       # markers missing a reason
+        for i, line in enumerate(src.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if not m.group(2):
+                self.bare.append(i)
+            self._by_line[i] = rules
+
+    def matches(self, rule: str, line: int) -> bool:
+        for at in (line, line - 1):
+            rules = self._by_line.get(at)
+            if rules and (rule in rules or "*" in rules):
+                return True
+        return False
+
+
+# ------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> Set[Tuple[str, str, int]]:
+    """The committed finding keys, or empty when the file is absent."""
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {(e["rule"], e["path"], int(e["line"]))
+            for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> int:
+    entries = sorted(
+        ({"rule": f.rule, "path": f.path, "line": f.line,
+          "message": f.message} for f in findings),
+        key=lambda e: (e["path"], e["line"], e["rule"]),
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=2)
+        f.write("\n")
+    return len(entries)
+
+
+# --------------------------------------------------------------- driver
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]          # live (reported) findings
+    suppressed: int
+    baselined: int
+
+    @property
+    def failing(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity in FAILING]
+
+
+def run_analysis(
+    root: str,
+    analyzers: Sequence[Analyzer],
+    *,
+    files: Optional[Sequence[str]] = None,
+    respect_scopes: bool = False,
+    baseline: Optional[Set[Tuple[str, str, int]]] = None,
+) -> Report:
+    """Run ``analyzers`` over ``root`` and fold in suppressions and the
+    baseline.  ``files`` restricts to explicit relative paths (repo-level
+    plugins are skipped then — a partial scan cannot judge whole-repo
+    invariants); ``respect_scopes`` keeps analyzer scope prefixes in
+    force for that list (the ``--changed`` mode — see walker.Repo)."""
+    repo = Repo(root, files=files, respect_scopes=respect_scopes)
+    raw: List[Finding] = []
+    for src in repo.sources():
+        if src.tree is None and src.syntax_error is not None:
+            lineno, msg = src.syntax_error
+            raw.append(Finding("SYNTAX", "error", src.rel, lineno, msg))
+    for an in analyzers:
+        if not an.file_based and files is not None:
+            continue
+        raw.extend(an.run(repo))
+
+    sup_tables: Dict[str, Suppressions] = {}
+
+    def table(rel: str) -> Optional[Suppressions]:
+        if rel not in sup_tables:
+            src = repo.get(rel)
+            sup_tables[rel] = Suppressions(src) if src is not None else None
+        return sup_tables[rel]
+
+    live: List[Finding] = []
+    suppressed = 0
+    baselined = 0
+    baseline = baseline or set()
+    for f in raw:
+        t = table(f.path)
+        if t is not None and t.matches(f.rule, f.line):
+            suppressed += 1
+            continue
+        if f.key() in baseline:
+            baselined += 1
+            continue
+        live.append(f)
+    # Bare disables (marker without reason) are findings themselves — in
+    # EVERY scanned file, including ones with no other findings (whose
+    # suppression tables were never needed above).
+    for src in repo.sources():
+        table(src.rel)
+    for rel, t in sorted(sup_tables.items()):
+        if t is None:
+            continue
+        for lineno in t.bare:
+            live.append(Finding(
+                SUP_NO_REASON.id, SUP_NO_REASON.severity, rel, lineno,
+                "suppression marker has no reason — write "
+                "`# analyze: disable=RULE -- <why>`",
+            ))
+    live.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(live, suppressed, baselined)

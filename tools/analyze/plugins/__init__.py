@@ -1,0 +1,26 @@
+"""Analyzer plugins.  ``all_analyzers()`` is the registry the CLI and
+the in-suite test run; adding a plugin means adding it here."""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.analyze.core import Analyzer
+
+
+def all_analyzers() -> List[Analyzer]:
+    from tools.analyze.plugins.donation import DonationAnalyzer
+    from tools.analyze.plugins.excepts import ExceptsAnalyzer
+    from tools.analyze.plugins.jit_hygiene import JitHygieneAnalyzer
+    from tools.analyze.plugins.locks import LockDisciplineAnalyzer
+    from tools.analyze.plugins.metrics_catalog import MetricsCatalogAnalyzer
+    from tools.analyze.plugins.retrace import RetraceAnalyzer
+
+    return [
+        JitHygieneAnalyzer(),
+        RetraceAnalyzer(),
+        DonationAnalyzer(),
+        LockDisciplineAnalyzer(),
+        ExceptsAnalyzer(),
+        MetricsCatalogAnalyzer(),
+    ]

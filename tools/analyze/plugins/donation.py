@@ -1,0 +1,148 @@
+"""donation: jitted step functions that copy instead of reusing buffers.
+
+A jitted function that takes a carried state array and returns its
+updated successor (``sums_prev + delta``, ``c.at[i].set(v)``, a bare
+passthrough) allocates a fresh output buffer while the input buffer
+stays live until the call returns — the classic 2x memory tax on
+Lloyd/delta update loops.  ``donate_argnums``/``donate_argnames`` lets
+XLA alias the output onto the input allocation.
+
+Heuristic: a jitted function where some returned expression (in the
+function or a nested branch function — ``lax.cond`` branches count) is
+an update of a parameter NOT covered by the donate clause:
+
+* a parameter name verbatim,
+* ``param + x`` / ``param - x`` (an elementwise shape-preserving
+  update),
+* ``param.at[...]`` functional update, or
+* a local whose assignment matches one of the above,
+
+is flagged.  Donation is NOT always the fix: a public entry point whose
+callers reuse the input after the call must not donate — annotate those
+with the reason instead (see ops/delta.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.analyze.astutil import jit_decoration, ModuleNames
+from tools.analyze.core import Analyzer, Finding, Rule
+
+RULES = [
+    Rule("DON301", "warning",
+         "jitted step returns an argument-shaped update without "
+         "donate_argnums",
+         "Input and output buffers are both live across the call — 2x "
+         "memory for the carried state; donate the dead input, or "
+         "annotate why the caller still needs it."),
+]
+
+
+def _donated_params(dec: ast.expr, fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names covered by donate_argnums/donate_argnames."""
+    if not isinstance(dec, ast.Call):
+        return set()
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: Set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        v = kw.value
+        items = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for it in items:
+            if not isinstance(it, ast.Constant):
+                continue
+            if kw.arg == "donate_argnames" and isinstance(it.value, str):
+                out.add(it.value)
+            elif kw.arg == "donate_argnums" and \
+                    isinstance(it.value, int) and \
+                    0 <= it.value < len(pos):
+                out.add(pos[it.value])
+    return out
+
+
+def _param_update(node: ast.expr, params: Set[str],
+                  donated: Set[str]) -> Optional[str]:
+    """The non-donated parameter an expression is an in-place-style
+    update of.  ``donated + increment`` is satisfied donation — the
+    increment operand is not the carried buffer."""
+    if isinstance(node, ast.Name) and node.id in params:
+        return node.id
+    # Elementwise +/- keeps the argument's shape; * is excluded — the
+    # common `tile * scale` broadcast is not an argument-shaped update.
+    if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                  (ast.Add, ast.Sub)):
+        sides = [s for s in (node.left, node.right)
+                 if isinstance(s, ast.Name)]
+        if any(s.id in donated for s in sides):
+            return None
+        for side in sides:
+            if side.id in params:
+                return side.id
+    # param.at[...].set/add/...(...)
+    cur = node
+    while isinstance(cur, (ast.Call, ast.Attribute, ast.Subscript)):
+        if isinstance(cur, ast.Subscript) and \
+                isinstance(cur.value, ast.Attribute) and \
+                cur.value.attr == "at" and \
+                isinstance(cur.value.value, ast.Name) and \
+                cur.value.value.id in params:
+            return cur.value.value.id
+        cur = getattr(cur, "func", None) or getattr(cur, "value", None)
+    return None
+
+
+class DonationAnalyzer(Analyzer):
+    name = "donation"
+    rules = RULES
+    scope = ("kmeans_tpu/",)
+
+    def check_source(self, src) -> List[Finding]:
+        tree = src.tree
+        names = ModuleNames(tree)
+        out: List[Finding] = []
+        for fn in (n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)):
+            dec = jit_decoration(fn, names)
+            if dec is None:
+                continue
+            donated = _donated_params(dec, fn)
+            params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                      + fn.args.kwonlyargs} - donated
+            # Last simple assignment of each local, for one-hop
+            # derivations (sums = sums_prev + ds; ...; return sums).
+            assigns: Dict[str, ast.expr] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            assigns[t.id] = node.value
+
+            hits: Dict[str, int] = {}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                elems = (node.value.elts
+                         if isinstance(node.value, ast.Tuple)
+                         else [node.value])
+                for el in elems:
+                    expr = el
+                    if isinstance(el, ast.Name) and el.id in assigns \
+                            and el.id not in params:
+                        expr = assigns[el.id]
+                    p = _param_update(expr, params, donated)
+                    if p is not None:
+                        hits.setdefault(p, node.lineno)
+            if hits:
+                plist = ", ".join(sorted(hits))
+                out.append(Finding(
+                    RULES[0].id, RULES[0].severity, src.rel, fn.lineno,
+                    f"jitted `{fn.name}` returns an update of "
+                    f"argument(s) {plist} without donate_argnums — the "
+                    "old buffer stays live (2x carried-state memory); "
+                    "donate if callers never reuse the input, else "
+                    "annotate why",
+                ))
+        return out

@@ -1,0 +1,88 @@
+"""silent-excepts: failure paths must stay loud (re-homed lint).
+
+The original ``tools/check_excepts.py`` logic on the shared walker:
+bare ``except:`` (swallows KeyboardInterrupt/SystemExit) and
+``except Exception/BaseException`` bodies that are only ``pass``/``...``.
+The legacy ``# allow-silent-except: <reason>`` marker keeps working
+alongside the framework's ``# analyze: disable=EXC502 -- <reason>`` —
+both force the reason into the diff (docs/RESILIENCE.md contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from tools.analyze.core import Analyzer, Finding, Rule
+
+RULES = [
+    Rule("EXC501", "error", "bare `except:`",
+         "Also catches KeyboardInterrupt/SystemExit — name the "
+         "exceptions."),
+    Rule("EXC502", "error", "`except Exception: pass`",
+         "A silently-eaten failure; handle, log, or annotate with the "
+         "reason."),
+]
+
+ALLOW_MARKER = "allow-silent-except:"
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(node) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in _BROAD
+    if isinstance(node, ast.Tuple):
+        return any(_is_broad(e) for e in node.elts)
+    return False
+
+
+def _is_silent(body) -> bool:
+    return all(
+        isinstance(stmt, ast.Pass)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+        for stmt in body
+    )
+
+
+def scan_tree(tree: ast.AST, lines: List[str]
+              ) -> List[Tuple[str, int, str]]:
+    """``(rule_id, lineno, message)`` violations in one parsed module."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append((
+                "EXC501", node.lineno,
+                "bare `except:` — name the exceptions (it also catches "
+                "KeyboardInterrupt/SystemExit)",
+            ))
+            continue
+        if _is_broad(node.type) and _is_silent(node.body):
+            line = (lines[node.lineno - 1]
+                    if node.lineno <= len(lines) else "")
+            if ALLOW_MARKER not in line:
+                out.append((
+                    "EXC502", node.lineno,
+                    "`except Exception: pass` swallows failures silently "
+                    "— handle, log, or annotate the except line with "
+                    f"`# {ALLOW_MARKER} <reason>`",
+                ))
+    return out
+
+
+class ExceptsAnalyzer(Analyzer):
+    name = "silent-excepts"
+    rules = RULES
+    scope = None          # whole scanned tree, same as the original lint
+
+    def check_source(self, src) -> List[Finding]:
+        sev = {r.id: r.severity for r in RULES}
+        return [Finding(rule_id, sev[rule_id], src.rel, lineno, msg)
+                for rule_id, lineno, msg in scan_tree(src.tree,
+                                                      src.lines)]

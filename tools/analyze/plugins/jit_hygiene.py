@@ -1,0 +1,109 @@
+"""jit-hygiene: host syncs and trace breaks inside jitted code.
+
+Scope: functions reached from a ``jax.jit`` decoration (or referenced
+inside a ``jax.jit(...)``/``jax.shard_map(...)`` wrap) in the same
+module.  These constructs either force a device->host sync in the hot
+loop or silently bake a traced value into the compiled program:
+
+* ``.item()`` / ``.tolist()`` block until the device value is ready;
+* ``float()/int()/bool()`` on a traced expression raises a
+  ConcretizationTypeError at trace time — or, on a first call with
+  concrete inputs, hides a sync;
+* ``np.*`` calls on traced values fall back to host numpy (sync) or
+  fail; on constants they bake silently (usually fine, hence warning);
+* Python ``if``/``while`` on a traced boolean is a trace-time error —
+  the branch must be ``lax.cond``/``lax.while_loop`` or ``jnp.where``;
+* ``print`` fires at TRACE time only (once per compile), which is never
+  what the author meant — ``jax.debug.print`` runs per step.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analyze.astutil import (JitReach, ModuleNames, attr_root,
+                                   call_rooted_at, own_body)
+from tools.analyze.core import Analyzer, Finding, Rule
+
+RULES = [
+    Rule("JIT101", "error", "host sync (.item()/.tolist()) in jitted code",
+         "Blocks dispatch until the device catches up — serializes the "
+         "hot loop."),
+    Rule("JIT102", "error", "float()/int()/bool() of a traced value",
+         "Concretizes a tracer: trace-time error or hidden host sync."),
+    Rule("JIT103", "warning", "numpy call inside jitted code",
+         "np.* on a traced value syncs or fails; on constants it bakes "
+         "silently — use jnp, or hoist the constant out of the jit."),
+    Rule("JIT104", "error", "Python if/while on a traced boolean",
+         "Trace-time branching on device values must be lax.cond / "
+         "lax.while_loop / jnp.where."),
+    Rule("JIT105", "warning", "print() inside jitted code",
+         "Fires once at trace time, not per step — use "
+         "jax.debug.print."),
+]
+
+
+class JitHygieneAnalyzer(Analyzer):
+    name = "jit-hygiene"
+    rules = RULES
+    scope = ("kmeans_tpu/",)
+
+    def check_source(self, src) -> List[Finding]:
+        tree = src.tree
+        names = ModuleNames(tree)
+        reach = JitReach(tree, names)
+        traced = names.traced_roots
+        out: List[Finding] = []
+
+        def hit(rule_id: str, node: ast.AST, msg: str):
+            rule = next(r for r in RULES if r.id == rule_id)
+            out.append(Finding(rule.id, rule.severity, src.rel,
+                               node.lineno, msg))
+
+        for fn in reach.reached_functions():
+            for node in own_body(fn):
+                if isinstance(node, ast.Call):
+                    self._check_call(node, fn, names, traced, hit)
+                elif isinstance(node, (ast.If, ast.While)):
+                    call = call_rooted_at(node.test, traced)
+                    if call is not None:
+                        kind = ("if" if isinstance(node, ast.If)
+                                else "while")
+                        hit("JIT104", node,
+                            f"`{kind}` in jit-reached `{fn.name}` tests "
+                            f"`{ast.unparse(call)[:60]}` — a traced "
+                            "boolean cannot drive Python control flow; "
+                            "use lax.cond/lax.while_loop or jnp.where")
+        return out
+
+    def _check_call(self, node: ast.Call, fn, names: ModuleNames,
+                    traced, hit) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in ("item",
+                                                             "tolist"):
+            hit("JIT101", node,
+                f"`.{func.attr}()` in jit-reached `{fn.name}` forces a "
+                "device->host sync; return the array and convert "
+                "outside the jit")
+            return
+        if isinstance(func, ast.Name):
+            if func.id == "print":
+                hit("JIT105", node,
+                    f"print() in jit-reached `{fn.name}` runs at trace "
+                    "time only; use jax.debug.print for per-step output")
+                return
+            if func.id in ("float", "int", "bool") and node.args:
+                call = call_rooted_at(node.args[0], traced)
+                if call is not None:
+                    hit("JIT102", node,
+                        f"`{func.id}(...)` of traced "
+                        f"`{ast.unparse(call)[:60]}` in jit-reached "
+                        f"`{fn.name}` concretizes a tracer")
+                return
+        root = attr_root(func)
+        if root in names.numpy:
+            hit("JIT103", node,
+                f"`{ast.unparse(func)}(...)` in jit-reached `{fn.name}` "
+                "is host numpy — traced values sync or fail here; use "
+                "jnp or hoist the constant")

@@ -1,0 +1,209 @@
+"""lock-discipline: shared state mutated on both sides of a lock, and
+blocking calls made while holding one.
+
+The serve/obs layers are the repo's threaded surface: request handler
+threads, training workers, debounce timers and scrape-time gauges all
+touch the same objects.  Two defect classes this pass catches:
+
+* **LCK401 mixed locking** — an attribute written both inside a
+  ``with <obj>.<lock>:`` block and outside one (``__init__`` excluded:
+  pre-publication writes are single-threaded by construction).  Half-
+  locked state is worse than unlocked: the lock documents an invariant
+  the unlocked writer silently breaks.
+* **LCK402 blocking under a lock** — ``time.sleep``, ``open``, socket
+  ops, ``subprocess``/``requests`` calls or a future's ``.result()``
+  while a lock is held turns every other thread contending for that
+  lock into a convoy behind I/O.
+
+Mutation tracking is aggregated per (class, object-expression, attr):
+``self.x`` across all methods of a class, but also ``room.presence``
+style cross-object writes inside a server method.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.astutil import attr_root, dotted
+from tools.analyze.core import Analyzer, Finding, Rule
+
+RULES = [
+    Rule("LCK401", "error",
+         "attribute mutated both inside and outside its lock",
+         "A with-lock writer documents an invariant; the unlocked "
+         "writer races it."),
+    Rule("LCK402", "warning", "blocking call while holding a lock",
+         "I/O or sleeps under a lock convoy every contending thread."),
+]
+
+_MUTATORS = frozenset({"append", "add", "remove", "clear", "update",
+                       "pop", "popitem", "setdefault", "extend",
+                       "insert", "discard"})
+
+_BLOCKING_BASES = frozenset({"subprocess", "requests", "socket",
+                             "urllib"})
+_BLOCKING_ATTRS = frozenset({"sleep", "result", "recv", "accept",
+                             "connect", "sendall"})
+
+
+def _lock_ctx(item: ast.withitem) -> Optional[str]:
+    """The guarded object's source text when a with-item acquires a
+    lock (``with self._lock:``, ``with room._lock:``,
+    ``with self._code_save_lock(code):``, ``with doc.read_lock():``),
+    else None."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        try:
+            return ast.unparse(expr.value)
+        except Exception:  # pragma: no cover - unparse is total on exprs
+            return None
+    return None
+
+
+def _write_target(node: ast.AST) -> Optional[Tuple[str, str, int]]:
+    """(object-name, attr, lineno) for a mutation of ``<name>.<attr>``:
+    assignment, augmented assignment, subscript store, del, or a
+    mutating method call."""
+    def of_attr(a: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(a, ast.Attribute) and isinstance(a.value, ast.Name):
+            return a.value.id, a.attr
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            got = of_attr(t)
+            if got:
+                return got[0], got[1], node.lineno
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            got = of_attr(t)
+            if got:
+                return got[0], got[1], node.lineno
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS:
+        got = of_attr(node.func.value)
+        if got:
+            return got[0], got[1], node.lineno
+    return None
+
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if isinstance(func, ast.Name) and func.id == "open":
+        return "open(...)"
+    if isinstance(func, ast.Attribute):
+        if func.attr in _BLOCKING_ATTRS:
+            return dotted(func) or f"<expr>.{func.attr}"
+        root = attr_root(func)
+        if root in _BLOCKING_BASES:
+            return dotted(func) or root
+    return None
+
+
+class _ClassScan(ast.NodeVisitor):
+    """One class body: per (obj, attr) locked/unlocked write sites, plus
+    blocking calls under any lock."""
+
+    def __init__(self):
+        self.locked: Dict[Tuple[str, str], List[Tuple[int, str]]] = {}
+        self.unlocked: Dict[Tuple[str, str], List[Tuple[int, str]]] = {}
+        self.blocking: List[Tuple[int, str, str]] = []
+        self._lock_depth = 0
+        self._method = "?"
+
+    def scan_method(self, fn: ast.FunctionDef):
+        self._method = fn.name
+        for stmt in fn.body:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # Nested defs (callbacks, workers) run on their own thread/time;
+        # their bodies are scanned as part of the same method for
+        # mutation bookkeeping but drop any held-lock context (the
+        # closure does not inherit the caller's lock at run time).
+        saved = self._lock_depth
+        self._lock_depth = 0
+        self.generic_visit(node)
+        self._lock_depth = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        saved = self._lock_depth
+        self._lock_depth = 0
+        self.generic_visit(node)
+        self._lock_depth = saved
+
+    def visit_With(self, node: ast.With):
+        held = [it for it in node.items if _lock_ctx(it) is not None]
+        self._lock_depth += len(held)
+        # Non-lock with-items (the `open` of `with open(...)`) are still
+        # expressions evaluated under any OUTER lock.
+        for it in node.items:
+            self.visit(it.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._lock_depth -= len(held)
+
+    def generic_visit(self, node):
+        got = _write_target(node)
+        if got:
+            obj, attr, lineno = got
+            # Writes to the locks themselves are setup, not state.
+            if "lock" not in attr.lower():
+                book = self.locked if self._lock_depth else self.unlocked
+                book.setdefault((obj, attr), []).append(
+                    (lineno, self._method))
+        if isinstance(node, ast.Call) and self._lock_depth:
+            blk = _blocking_call(node)
+            if blk:
+                self.blocking.append((node.lineno, blk, self._method))
+        super().generic_visit(node)
+
+
+class LockDisciplineAnalyzer(Analyzer):
+    name = "lock-discipline"
+    rules = RULES
+    scope = ("kmeans_tpu/",)
+
+    def check_source(self, src) -> List[Finding]:
+        out: List[Finding] = []
+        for cls in (n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)):
+            scan = _ClassScan()
+            for item in cls.body:
+                if isinstance(item, ast.FunctionDef) and \
+                        item.name not in ("__init__", "__new__"):
+                    scan.scan_method(item)
+            for key, sites in sorted(scan.unlocked.items()):
+                if key not in scan.locked:
+                    continue
+                obj, attr = key
+                lk_lines = sorted({ln for ln, _ in scan.locked[key]})
+                for lineno, method in sites:
+                    out.append(Finding(
+                        RULES[0].id, RULES[0].severity, src.rel, lineno,
+                        f"`{obj}.{attr}` is written here "
+                        f"(`{cls.name}.{method}`) without the lock that "
+                        f"guards its other writers (locked at line(s) "
+                        f"{', '.join(map(str, lk_lines))})",
+                    ))
+            for lineno, what, method in scan.blocking:
+                out.append(Finding(
+                    RULES[1].id, RULES[1].severity, src.rel, lineno,
+                    f"`{what}` called while holding a lock in "
+                    f"`{cls.name}.{method}` — contending threads convoy "
+                    "behind this I/O; move it outside the critical "
+                    "section or annotate why it must serialize",
+                ))
+        return out

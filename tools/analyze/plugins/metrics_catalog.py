@@ -1,0 +1,128 @@
+"""metrics-catalog: the docs/OBSERVABILITY.md catalog cannot drift from
+the registry (re-homed lint).
+
+Repo-level plugin (``file_based = False``): imports every module that
+registers metrics, reads the default registry's real contents, and
+cross-checks the documented catalog — naming convention, undocumented
+metrics, stale doc rows.  The pure :func:`check` core is unit-testable
+without imports or files; the legacy ``tools/check_metrics.py`` shim
+re-exports it.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.analyze.core import Analyzer, Finding, Rule
+
+RULES = [
+    Rule("MET601", "error", "metric name violates the convention",
+         "Every metric is kmeans_tpu_<subsystem>_<noun>[_<unit>|_total] "
+         "(docs/OBSERVABILITY.md)."),
+    Rule("MET602", "error", "registered metric missing from the catalog",
+         "An undocumented metric is invisible to operators."),
+    Rule("MET603", "error", "documented metric not registered",
+         "A stale doc row (or a registering module missing from "
+         "MODULES)."),
+]
+
+#: Every module that registers metrics at import time.  A new
+#: instrumented module MUST be added here, or its metrics escape the
+#: catalog check.
+MODULES = [
+    "kmeans_tpu.obs",
+    "kmeans_tpu.utils.retry",
+    "kmeans_tpu.utils.checkpoint",
+    "kmeans_tpu.data.stream",
+    "kmeans_tpu.models.runner",
+    "kmeans_tpu.models.streaming",
+    "kmeans_tpu.models.gmm_stream",
+    "kmeans_tpu.parallel.engine",
+    "kmeans_tpu.serve.server",
+]
+
+DOC = os.path.join("docs", "OBSERVABILITY.md")
+PREFIX = "kmeans_tpu_"
+
+#: Exposition-level suffixes a doc example may legitimately mention
+#: without them being registered families of their own.
+_EXPO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+_DOC_NAME_RE = re.compile(r"`(kmeans_tpu_[a-zA-Z0-9_]+)`")
+
+
+def registered_metrics() -> Dict[str, Tuple[str, Tuple[str, ...], str]]:
+    """``{name: (kind, labelnames, help)}`` after importing MODULES."""
+    for mod in MODULES:
+        importlib.import_module(mod)
+    from kmeans_tpu.obs import REGISTRY
+
+    return REGISTRY.describe()
+
+
+def documented_names(doc_text: str) -> Set[str]:
+    return set(_DOC_NAME_RE.findall(doc_text))
+
+
+def check(registered: Dict[str, Tuple[str, Tuple[str, ...], str]],
+          documented: Iterable[str]) -> List[Tuple[str, str]]:
+    """``(rule_id, message)`` violations for one (registry view, doc
+    names) pair — the pure core, unit-testable without imports."""
+    documented = set(documented)
+    out = []
+    for name in sorted(registered):
+        if not name.startswith(PREFIX):
+            out.append((
+                "MET601",
+                f"{name}: violates the naming convention (must start "
+                f"with {PREFIX!r}; docs/OBSERVABILITY.md)",
+            ))
+        if name not in documented:
+            out.append((
+                "MET602",
+                f"{name}: registered but missing from the "
+                f"{DOC} catalog — document it",
+            ))
+    for name in sorted(documented):
+        if name in registered:
+            continue
+        base = next((name[: -len(sfx)] for sfx in _EXPO_SUFFIXES
+                     if name.endswith(sfx)), None)
+        if base in registered:
+            continue               # exposition sample of a real family
+        out.append((
+            "MET603",
+            f"{name}: documented in {DOC} but not registered — stale "
+            "doc row (or the registering module is missing from "
+            "tools/analyze/plugins/metrics_catalog.py MODULES)",
+        ))
+    return out
+
+
+def run_repo(root: str) -> List[Tuple[str, str]]:
+    """All ``(rule_id, message)`` violations for the real repo."""
+    doc_path = os.path.join(root, DOC)
+    if not os.path.exists(doc_path):
+        return [("MET603",
+                 f"{DOC}: missing — the metric catalog must exist")]
+    with open(doc_path, "r", encoding="utf-8") as f:
+        doc = f.read()
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    return check(registered_metrics(), documented_names(doc))
+
+
+class MetricsCatalogAnalyzer(Analyzer):
+    name = "metrics-catalog"
+    rules = RULES
+    file_based = False
+
+    def run(self, repo) -> List[Finding]:
+        sev = {r.id: r.severity for r in RULES}
+        doc_rel = DOC.replace(os.sep, "/")
+        return [Finding(rule_id, sev[rule_id], doc_rel, 1, msg)
+                for rule_id, msg in run_repo(repo.root)]

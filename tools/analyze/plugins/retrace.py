@@ -1,0 +1,218 @@
+"""retrace-risk: jit call sites that recompile more than they compute.
+
+``jax.jit`` caches by *function identity* plus static-argument values.
+Three patterns defeat the cache:
+
+* **fresh jit per call** — ``jax.jit(f)(x)`` inside an uncached
+  function builds a new jitted callable (new identity) every time the
+  enclosing function runs: every call is a full XLA compile.  The
+  repo's convention is an ``functools.lru_cache``'d ``_build_*``
+  builder (parallel/engine.py) so identical shapes reuse the
+  executable;
+* **per-call jit construction** — a jit-decorated function *defined*
+  inside an uncached function recompiles once per outer call too; this
+  is sometimes deliberate (one compile amortized over a long fit, e.g.
+  LloydRunner's per-instance steps), so it reports at info severity;
+* **unhashable statics** — a parameter named in ``static_argnums`` /
+  ``static_argnames`` whose default is a list/dict/set raises
+  ``TypeError: unhashable`` at the first call that uses the default —
+  and a mutable static invites exactly the aliasing bug static args
+  exist to prevent;
+* **closure-captured arrays** — a jitted closure referencing an array
+  built in the enclosing scope bakes it as a constant: a new enclosing
+  call means a new constant means a recompile (and the array is
+  embedded in the executable).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.analyze.astutil import (ModuleNames, attr_root, dotted,
+                                   jit_decoration, names_in, own_body)
+from tools.analyze.core import Analyzer, Finding, Rule
+
+RULES = [
+    Rule("RET201", "error", "fresh jax.jit(...) built per call",
+         "A new jitted callable has a new cache identity: every call "
+         "recompiles.  Cache the builder (functools.lru_cache) or hoist "
+         "the jit to module level."),
+    Rule("RET202", "info", "jit-decorated function defined per call",
+         "Each outer call compiles anew; fine when one compile is "
+         "amortized over many steps, wasteful otherwise."),
+    Rule("RET203", "error", "static argument with a mutable default",
+         "static_argnums/static_argnames values must be hashable; a "
+         "list/dict/set default raises at call time."),
+    Rule("RET204", "warning", "jitted closure captures an enclosing-scope "
+         "array",
+         "The array is baked into the executable as a constant — a new "
+         "enclosing call recompiles; pass it as an argument instead."),
+]
+
+_CACHING = ("lru_cache", "cache")
+
+#: Enclosing-scope assignments that mark a name as an array value for
+#: RET204 (conservative: only explicit array constructors count).
+_ARRAY_MAKERS = ("asarray", "array", "zeros", "ones", "full", "arange",
+                 "linspace", "device_put")
+
+
+def _rule(rule_id: str) -> Rule:
+    return next(r for r in RULES if r.id == rule_id)
+
+
+def _has_caching_decorator(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        d = dotted(dec.func if isinstance(dec, ast.Call) else dec)
+        if d and d.split(".")[-1] in _CACHING:
+            return True
+    return False
+
+
+def _static_param_names(call: ast.Call, fn_args: ast.arguments
+                        ) -> Set[str]:
+    """Parameter names selected by static_argnums/static_argnames in a
+    jit decoration, resolved against the decorated function."""
+    pos = [a.arg for a in fn_args.posonlyargs + fn_args.args]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        v = kw.value
+        if kw.arg == "static_argnames":
+            items = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for it in items:
+                if isinstance(it, ast.Constant) and isinstance(it.value,
+                                                               str):
+                    out.add(it.value)
+        elif kw.arg == "static_argnums":
+            items = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for it in items:
+                if isinstance(it, ast.Constant) and isinstance(it.value,
+                                                               int):
+                    if 0 <= it.value < len(pos):
+                        out.add(pos[it.value])
+    return out
+
+
+def _mutable_default(fn: ast.FunctionDef, param: str
+                     ) -> Optional[ast.expr]:
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    defaults = args.defaults
+    for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+        if a.arg == param and isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            return d
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None and a.arg == param and \
+                isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            return d
+    return None
+
+
+class RetraceAnalyzer(Analyzer):
+    name = "retrace-risk"
+    rules = RULES
+    scope = ("kmeans_tpu/",)
+
+    def check_source(self, src) -> List[Finding]:
+        tree = src.tree
+        names = ModuleNames(tree)
+        out: List[Finding] = []
+
+        def hit(rule_id: str, node: ast.AST, msg: str):
+            r = _rule(rule_id)
+            out.append(Finding(r.id, r.severity, src.rel, node.lineno,
+                               msg))
+
+        # Parent links for "is this jit call inside an uncached def".
+        parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def enclosing_function(node) -> Optional[ast.FunctionDef]:
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, ast.FunctionDef):
+                    return cur
+                cur = parents.get(cur)
+            return None
+
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and names.is_jit_expr(node.func)):
+                continue
+            # Decorator positions are handled below (RET202/RET203).
+            parent = parents.get(node)
+            enclosing = enclosing_function(node)
+            if isinstance(parent, (ast.FunctionDef,)) and \
+                    node in parent.decorator_list:
+                continue
+            if isinstance(parent, ast.Call) and parent.func is node:
+                # jax.jit(f)(x): built AND invoked per call — always a
+                # recompile, caching decorators can't help.
+                hit("RET201", node,
+                    "`jax.jit(...)(...)` builds and calls a fresh jitted "
+                    "callable — every invocation recompiles; build once "
+                    "(module level or an lru_cache'd builder) and reuse")
+                continue
+            if enclosing is not None and \
+                    not _has_caching_decorator(enclosing):
+                hit("RET201", node,
+                    f"`jax.jit(...)` inside `{enclosing.name}` (no "
+                    "lru_cache): each call returns a new callable with "
+                    "a cold compile cache — cache the builder")
+
+        for fn in (n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)):
+            dec = jit_decoration(fn, names)
+            if dec is None:
+                continue
+            # RET203: mutable default on a static parameter.
+            if isinstance(dec, ast.Call):
+                for param in _static_param_names(dec, fn.args):
+                    d = _mutable_default(fn, param)
+                    if d is not None:
+                        hit("RET203", d,
+                            f"static argument `{param}` of jitted "
+                            f"`{fn.name}` defaults to a "
+                            f"{type(d).__name__.lower()} — unhashable "
+                            "at call time; use a tuple / frozenset / "
+                            "None sentinel")
+            enclosing = enclosing_function(fn)
+            if enclosing is None or _has_caching_decorator(enclosing):
+                continue
+            # RET202: per-call jit construction.
+            hit("RET202", fn,
+                f"jitted `{fn.name}` is defined inside "
+                f"`{enclosing.name}` without caching — each "
+                f"`{enclosing.name}` call compiles anew (deliberate "
+                "for long-lived per-instance steps; annotate or cache "
+                "otherwise)")
+            # RET204: closure-captured arrays.  Only assignments in the
+            # ENCLOSING function's own body count — an array built
+            # inside the jitted closure itself is a per-trace local, not
+            # a baked constant (own_body skips nested defs).
+            local_names = {a.arg for a in fn.args.posonlyargs
+                           + fn.args.args + fn.args.kwonlyargs}
+            assigned_arrays = {}
+            for stmt in own_body(enclosing):
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Call):
+                    root = attr_root(stmt.value.func)
+                    attr = (stmt.value.func.attr
+                            if isinstance(stmt.value.func, ast.Attribute)
+                            else None)
+                    if root in (names.jnp | names.numpy | names.jax) and \
+                            attr in _ARRAY_MAKERS:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                assigned_arrays[t.id] = stmt.value
+            free = names_in(fn) - local_names - {fn.name}
+            for ref in sorted(free & set(assigned_arrays)):
+                hit("RET204", fn,
+                    f"jitted `{fn.name}` closes over array `{ref}` from "
+                    f"`{enclosing.name}` — baked as a compile-time "
+                    "constant (recompile per outer call); pass it as an "
+                    "argument")
+        return out

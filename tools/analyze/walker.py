@@ -1,0 +1,158 @@
+"""Shared source walker + AST cache for every analyzer plugin.
+
+Before the framework existed each lint walked the tree and parsed every
+file independently (tools/check_excepts.py had its own ``iter_sources``);
+with five AST analyzers that would be five walks and five parses per
+file.  ``Repo`` walks once, lazily parses each file once, and hands the
+same :class:`Source` objects (text, lines, AST, suppression table) to
+every plugin.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Directories / files scanned, relative to the repo root — the same set
+#: the original excepts lint covered, so re-homing it changes nothing.
+SCAN: Tuple[str, ...] = ("kmeans_tpu", "tools", "tests", "docs",
+                         "bench.py", "__graft_entry__.py")
+
+#: Path *parts* never scanned.
+EXCLUDE_PARTS = frozenset({"__pycache__"})
+
+#: Relative prefixes never scanned on a repo walk: the analyzer fixtures
+#: contain deliberate violations (that is their job) and must not fail
+#: the repo's own self-scan.  Explicit path arguments override this.
+EXCLUDE_PREFIXES: Tuple[str, ...] = ("tests/analyze_fixtures",)
+
+
+class Source:
+    """One Python source file: path, text, lines, cached AST."""
+
+    def __init__(self, root: str, path: str):
+        self.root = root
+        self.path = path
+        self.rel = os.path.relpath(path, root).replace(os.sep, "/")
+        self._text: Optional[str] = None
+        self._lines: Optional[List[str]] = None
+        self._tree: Optional[ast.AST] = None
+        self._parsed = False
+        #: (lineno, message) when the file does not parse.
+        self.syntax_error: Optional[Tuple[int, str]] = None
+
+    @property
+    def text(self) -> str:
+        if self._text is None:
+            with open(self.path, "r", encoding="utf-8") as f:
+                self._text = f.read()
+        return self._text
+
+    @property
+    def lines(self) -> List[str]:
+        if self._lines is None:
+            self._lines = self.text.splitlines()
+        return self._lines
+
+    def line(self, lineno: int) -> str:
+        """1-based physical line (empty string when out of range)."""
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        """The parsed module, or ``None`` on a syntax error (recorded in
+        :attr:`syntax_error`) — parsed at most once per process."""
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.text, filename=self.path)
+            except SyntaxError as e:
+                self.syntax_error = (e.lineno or 0,
+                                     f"syntax error: {e.msg}")
+                self._tree = None
+        return self._tree
+
+
+def _is_excluded(rel: str) -> bool:
+    if any(part in EXCLUDE_PARTS for part in rel.split("/")):
+        return True
+    return any(rel == p or rel.startswith(p + "/")
+               for p in EXCLUDE_PREFIXES)
+
+
+class Repo:
+    """The walked (and cached) source set of one repository root.
+
+    ``files`` restricts the walk to an explicit relative-path list (the
+    CLI's positional arguments and ``--changed`` mode); explicit files
+    bypass the fixture exclusion so the fixtures themselves can be
+    scanned on purpose.
+
+    ``respect_scopes`` keeps per-analyzer scope prefixes in force even
+    though ``files`` was given — the ``--changed`` pre-commit mode uses
+    it so the fast scan stays a SUBSET of the full CI gate (a scoped
+    analyzer must not suddenly apply to out-of-scope dirty files).
+    User-typed positional paths leave it False: "run everything on this
+    file" is the point there.
+    """
+
+    def __init__(self, root: str,
+                 files: Optional[Sequence[str]] = None,
+                 respect_scopes: bool = False):
+        self.root = os.path.abspath(root)
+        self._explicit = files is not None and not respect_scopes
+        self._sources: Dict[str, Source] = {}
+        for path in self._walk(files):
+            src = Source(self.root, path)
+            self._sources[src.rel] = src
+
+    def _walk(self, files: Optional[Sequence[str]]) -> Iterable[str]:
+        if files is not None:
+            for rel in files:
+                path = os.path.join(self.root, rel)
+                if os.path.isdir(path):
+                    yield from self._walk_dir(path, explicit=True)
+                elif os.path.isfile(path) and path.endswith(".py"):
+                    yield path
+            return
+        for entry in SCAN:
+            path = os.path.join(self.root, entry)
+            if os.path.isfile(path):
+                yield path
+            elif os.path.isdir(path):
+                yield from self._walk_dir(path, explicit=False)
+
+    def _walk_dir(self, top: str, *, explicit: bool) -> Iterable[str]:
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames.sort()
+            dirnames[:] = [d for d in dirnames if d not in EXCLUDE_PARTS]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                if not explicit and _is_excluded(rel):
+                    continue
+                yield path
+
+    def sources(self, under: Optional[Tuple[str, ...]] = None
+                ) -> List[Source]:
+        """All sources, or only those whose relpath starts with one of
+        the ``under`` prefixes (an analyzer's scope).  Scopes are a
+        repo-walk noise/speed cut; an EXPLICIT file list overrides them
+        — `python -m tools.analyze some/file.py` means "run everything
+        on this file", fixtures included."""
+        out = []
+        for rel in sorted(self._sources):
+            if under is not None and not self._explicit and not any(
+                    rel == u or rel.startswith(u)
+                    for u in under):
+                continue
+            out.append(self._sources[rel])
+        return out
+
+    def get(self, rel: str) -> Optional[Source]:
+        return self._sources.get(rel)

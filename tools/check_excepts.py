@@ -1,24 +1,13 @@
 #!/usr/bin/env python
-"""Lint: failure paths must stay loud.
+"""Lint: failure paths must stay loud — THIN SHIM.
 
-Scans the repo's Python sources and reports
-
-1. bare ``except:`` handlers (they swallow ``KeyboardInterrupt`` and
-   ``SystemExit`` — never acceptable), and
-2. ``except Exception`` / ``except BaseException`` handlers whose body is
-   ONLY ``pass`` / ``...`` — a silently-eaten failure.
-
-Case 2 may be allowlisted where the swallow is genuinely deliberate by
-putting the marker comment on the ``except`` line::
-
-    except Exception:  # allow-silent-except: <why this must be silent>
-        pass
-
-The marker forces the *reason* into the diff, which is the point: the
-resilience work (docs/RESILIENCE.md) depends on failures surfacing, and
-this lint keeps new silent handlers from creeping in.  Run directly
-(``python tools/check_excepts.py``) or via the test suite
-(tests/test_lint_excepts.py).
+The detector now lives in the static-analysis framework as the
+``silent-excepts`` plugin (tools/analyze/plugins/excepts.py, rules
+EXC501/EXC502; run everything with ``python -m tools.analyze``).  This
+module keeps the original command-line and Python surface —
+``scan_file``, ``run``, ``main``, ``SCAN``, ``ALLOW_MARKER`` — so
+tests/test_lint_excepts.py and any scripts invoking
+``python tools/check_excepts.py`` work unchanged.
 """
 
 from __future__ import annotations
@@ -27,34 +16,18 @@ import ast
 import os
 import sys
 
-#: Directories / files scanned, relative to the repo root.
-SCAN = ["kmeans_tpu", "tools", "tests", "docs", "bench.py",
-        "__graft_entry__.py"]
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-ALLOW_MARKER = "allow-silent-except:"
+from tools.analyze.plugins.excepts import ALLOW_MARKER, scan_tree  # noqa: E402
+from tools.analyze.walker import SCAN as _SCAN, Repo  # noqa: E402
 
-_BROAD = ("Exception", "BaseException")
+#: Directories / files scanned, relative to the repo root (the shared
+#: walker's set — one copy).
+SCAN = list(_SCAN)
 
-
-def _is_broad(node) -> bool:
-    """True for ``Exception``/``BaseException`` or a tuple containing one."""
-    if node is None:
-        return False
-    if isinstance(node, ast.Name):
-        return node.id in _BROAD
-    if isinstance(node, ast.Tuple):
-        return any(_is_broad(e) for e in node.elts)
-    return False
-
-
-def _is_silent(body) -> bool:
-    return all(
-        isinstance(stmt, ast.Pass)
-        or (isinstance(stmt, ast.Expr)
-            and isinstance(stmt.value, ast.Constant)
-            and stmt.value.value is Ellipsis)
-        for stmt in body
-    )
+__all__ = ["SCAN", "ALLOW_MARKER", "scan_file", "run", "main"]
 
 
 def scan_file(path: str) -> list:
@@ -65,58 +38,34 @@ def scan_file(path: str) -> list:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
         return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = src.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if node.type is None:
-            out.append((node.lineno,
-                        "bare `except:` — name the exceptions (it also "
-                        "catches KeyboardInterrupt/SystemExit)"))
-            continue
-        if _is_broad(node.type) and _is_silent(node.body):
-            line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-            if ALLOW_MARKER not in line:
-                out.append((
-                    node.lineno,
-                    "`except Exception: pass` swallows failures silently — "
-                    "handle, log, or annotate the except line with "
-                    f"`# {ALLOW_MARKER} <reason>`",
-                ))
-    return out
-
-
-def iter_sources(root: str):
-    for entry in SCAN:
-        path = os.path.join(root, entry)
-        if os.path.isfile(path):
-            yield path
-        elif os.path.isdir(path):
-            for dirpath, _dirnames, filenames in os.walk(path):
-                for fn in sorted(filenames):
-                    if fn.endswith(".py"):
-                        yield os.path.join(dirpath, fn)
+    return [(lineno, msg)
+            for _rule, lineno, msg in scan_tree(tree, src.splitlines())]
 
 
 def run(root: str) -> list:
-    """All violations under ``root`` as ``(relpath, lineno, msg)``."""
+    """All violations under ``root`` as ``(relpath, lineno, msg)`` —
+    one shared walk + parse (tools/analyze/walker.py)."""
     out = []
-    for path in iter_sources(root):
-        for lineno, msg in scan_file(path):
-            out.append((os.path.relpath(path, root), lineno, msg))
+    for source in Repo(root).sources():
+        if source.tree is None:
+            if source.syntax_error is not None:
+                lineno, msg = source.syntax_error
+                out.append((source.rel.replace("/", os.sep), lineno, msg))
+            continue
+        for _rule, lineno, msg in scan_tree(source.tree, source.lines):
+            out.append((source.rel.replace("/", os.sep), lineno, msg))
     return out
 
 
 def main(argv=None) -> int:
-    root = (argv or sys.argv[1:] or
-            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))])[0]
+    root = (argv or sys.argv[1:] or [_ROOT])[0]
     violations = run(root)
     for rel, lineno, msg in violations:
         print(f"{rel}:{lineno}: {msg}")
     if violations:
         print(f"{len(violations)} silent failure path(s); see "
-              "tools/check_excepts.py for the contract", file=sys.stderr)
+              "tools/analyze/plugins/excepts.py for the contract",
+              file=sys.stderr)
         return 1
     return 0
 

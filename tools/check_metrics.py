@@ -1,122 +1,59 @@
 #!/usr/bin/env python
-"""Lint: the metrics catalog cannot drift from the code.
+"""Lint: the metrics catalog cannot drift from the code — THIN SHIM.
 
-Imports every module that registers metrics, reads the default
-registry's actual contents, and cross-checks docs/OBSERVABILITY.md's
-catalog:
-
-1. every registered metric name follows the ``kmeans_tpu_`` naming
-   convention (docs/OBSERVABILITY.md),
-2. every registered metric is documented in the catalog, and
-3. every documented metric is actually registered (no stale doc rows).
-
-Name *uniqueness* is enforced at registration time by the registry
-itself (re-registering a name with a different kind or label set
-raises), so a collision surfaces here as an import failure rather than
-a silent shadow.  Run directly (``python tools/check_metrics.py``) or
-via the test suite (tests/test_lint_metrics.py) — same contract as
-tools/check_excepts.py.
+The checker now lives in the static-analysis framework as the
+``metrics-catalog`` plugin (tools/analyze/plugins/metrics_catalog.py,
+rules MET601-MET603; run everything with ``python -m tools.analyze``).
+This module keeps the original surface — ``MODULES``, ``check``,
+``registered_metrics``, ``documented_names``, ``run``, ``main`` — so
+tests/test_lint_metrics.py and direct ``python tools/check_metrics.py``
+invocations work unchanged.  ``check``/``run`` return plain message
+strings exactly as before (the plugin's rule ids are stripped).
 """
 
 from __future__ import annotations
 
-import importlib
 import os
-import re
 import sys
 from typing import Dict, Iterable, List, Set, Tuple
 
-#: Every module that registers metrics at import time.  A new
-#: instrumented module MUST be added here, or its metrics escape the
-#: catalog check.
-MODULES = [
-    "kmeans_tpu.obs",
-    "kmeans_tpu.utils.retry",
-    "kmeans_tpu.utils.checkpoint",
-    "kmeans_tpu.data.stream",
-    "kmeans_tpu.models.runner",
-    "kmeans_tpu.models.streaming",
-    "kmeans_tpu.models.gmm_stream",
-    "kmeans_tpu.parallel.engine",
-    "kmeans_tpu.serve.server",
-]
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-DOC = os.path.join("docs", "OBSERVABILITY.md")
-PREFIX = "kmeans_tpu_"
+from tools.analyze.plugins import metrics_catalog as _plugin  # noqa: E402
+from tools.analyze.plugins.metrics_catalog import (  # noqa: E402,F401
+    DOC,
+    MODULES,
+    PREFIX,
+    documented_names,
+    registered_metrics,
+)
 
-#: Exposition-level suffixes a doc example may legitimately mention
-#: without them being registered families of their own.
-_EXPO_SUFFIXES = ("_bucket", "_sum", "_count")
-
-_DOC_NAME_RE = re.compile(r"`(kmeans_tpu_[a-zA-Z0-9_]+)`")
-
-
-def registered_metrics() -> Dict[str, Tuple[str, Tuple[str, ...], str]]:
-    """``{name: (kind, labelnames, help)}`` after importing MODULES."""
-    for mod in MODULES:
-        importlib.import_module(mod)
-    from kmeans_tpu.obs import REGISTRY
-
-    return REGISTRY.describe()
-
-
-def documented_names(doc_text: str) -> Set[str]:
-    return set(_DOC_NAME_RE.findall(doc_text))
+__all__ = ["MODULES", "DOC", "PREFIX", "registered_metrics",
+           "documented_names", "check", "run", "main"]
 
 
 def check(registered: Dict[str, Tuple[str, Tuple[str, ...], str]],
           documented: Iterable[str]) -> List[str]:
-    """Violation messages for one (registry view, doc names) pair —
-    the pure core, unit-testable without imports or files."""
-    documented = set(documented)
-    out = []
-    for name in sorted(registered):
-        if not name.startswith(PREFIX):
-            out.append(
-                f"{name}: violates the naming convention (must start "
-                f"with {PREFIX!r}; docs/OBSERVABILITY.md)"
-            )
-        if name not in documented:
-            out.append(
-                f"{name}: registered but missing from the "
-                f"{DOC} catalog — document it"
-            )
-    for name in sorted(documented):
-        if name in registered:
-            continue
-        base = next((name[: -len(sfx)] for sfx in _EXPO_SUFFIXES
-                     if name.endswith(sfx)), None)
-        if base in registered:
-            continue               # exposition sample of a real family
-        out.append(
-            f"{name}: documented in {DOC} but not registered — stale "
-            "doc row (or the registering module is missing from "
-            "tools/check_metrics.py MODULES)"
-        )
-    return out
+    """Violation messages for one (registry view, doc names) pair."""
+    return [msg for _rule, msg in _plugin.check(registered, documented)]
 
 
 def run(root: str) -> List[str]:
     """All violations for the real repo at ``root``."""
-    doc_path = os.path.join(root, DOC)
-    if not os.path.exists(doc_path):
-        return [f"{DOC}: missing — the metric catalog must exist"]
-    with open(doc_path, "r", encoding="utf-8") as f:
-        doc = f.read()
-    if root not in sys.path:
-        sys.path.insert(0, root)
-    return check(registered_metrics(), documented_names(doc))
+    return [msg for _rule, msg in _plugin.run_repo(root)]
 
 
 def main(argv=None) -> int:
-    root = (argv or sys.argv[1:] or
-            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))])[0]
+    root = (argv or sys.argv[1:] or [_ROOT])[0]
     violations = run(root)
     for msg in violations:
         print(msg)
     if violations:
         print(f"{len(violations)} metric catalog violation(s); see "
-              "tools/check_metrics.py for the contract", file=sys.stderr)
+              "tools/analyze/plugins/metrics_catalog.py for the "
+              "contract", file=sys.stderr)
         return 1
     print(f"metric catalog OK ({len(registered_metrics())} metrics)")
     return 0

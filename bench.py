@@ -450,6 +450,7 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from kmeans_tpu.obs import tracing as _obs_tracing
     from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
     from kmeans_tpu.ops.update import apply_update
 
@@ -629,12 +630,14 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
         state = step(x, state, w)
         jax.block_until_ready(state)
         dt = float("inf")
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                state = step(x, state, w)
-            jax.block_until_ready(state)
-            w_dt = time.perf_counter() - t0
+        for wi in range(windows):
+            with _obs_tracing.span("window", category="iteration",
+                                   window=wi + 1, iters=iters):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    state = step(x, state, w)
+                jax.block_until_ready(state)
+                w_dt = time.perf_counter() - t0
             _emit_window(telemetry, w_dt, iters, n=n, d=d, k=k,
                          update=update, backend=backend)
             dt = min(dt, w_dt)
@@ -654,12 +657,14 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
         state = step(x, state)
         jax.block_until_ready(state)
         dt = float("inf")
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                state = step(x, state)
-            jax.block_until_ready(state)
-            w_dt = time.perf_counter() - t0
+        for wi in range(windows):
+            with _obs_tracing.span("window", category="iteration",
+                                   window=wi + 1, iters=iters):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    state = step(x, state)
+                jax.block_until_ready(state)
+                w_dt = time.perf_counter() - t0
             _emit_window(telemetry, w_dt, iters, n=n, d=d, k=k,
                          update=update, backend=backend)
             dt = min(dt, w_dt)
@@ -669,12 +674,14 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
         c.block_until_ready()
 
         dt = float("inf")
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                c = step(x, c, *args)
-            c.block_until_ready()
-            w_dt = time.perf_counter() - t0
+        for wi in range(windows):
+            with _obs_tracing.span("window", category="iteration",
+                                   window=wi + 1, iters=iters):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    c = step(x, c, *args)
+                c.block_until_ready()
+                w_dt = time.perf_counter() - t0
             _emit_window(telemetry, w_dt, iters, n=n, d=d, k=k,
                          update=update, backend=backend)
             dt = min(dt, w_dt)
@@ -948,6 +955,11 @@ def main():
                          "schema the production fits emit "
                          "(docs/OBSERVABILITY.md); render with "
                          "tools/bench_table.py --telemetry")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="write the bench's host span timeline (one span "
+                         "per timed window) as Chrome trace-event JSON — "
+                         "the same tracer the production fits use; load "
+                         "in Perfetto or render with tools/trace_view.py")
     ap.add_argument("--watchdog-s", type=float, default=2700.0,
                     help="whole-run hang backstop: if the benches have not "
                          "finished after this many seconds (tunnel death "
@@ -956,6 +968,18 @@ def main():
     args = ap.parse_args()
     if args.input is not None and args.k is None:
         ap.error("--input requires --k")
+    if args.trace:
+        # Probe writability BEFORE any measurement: the span export only
+        # opens the file at capture exit, and an OSError there would land
+        # in the generic carry-forward handler and throw away a finished
+        # (up to ~45-min) bench run.  Nothing has been measured yet, so a
+        # usage-style exit is still safe here.
+        from kmeans_tpu.obs import probe_writable
+
+        try:
+            probe_writable(args.trace)
+        except OSError as e:
+            ap.error(f"cannot write --trace to {args.trace!r}: {e}")
 
     # The failure line carries the metric name this invocation was asked
     # to produce, so a parse-last-line driver records the artifact in the
@@ -1005,8 +1029,17 @@ def main():
 
         tw = TelemetryWriter(args.telemetry, common={"metric": metric})
     args._telemetry_writer = tw
+    if args.trace:
+        from kmeans_tpu.utils.profiling import capture
+
+        trace_cm = capture(args.trace, name="bench")
+    else:
+        import contextlib
+
+        trace_cm = contextlib.nullcontext()
     try:
-        line = _run_benches(args, metric, unit, fresh)
+        with trace_cm:
+            line = _run_benches(args, metric, unit, fresh)
     except Exception as e:
         line = _carry_forward_line(
             metric, unit,
@@ -1040,6 +1073,16 @@ def _run_benches(args, metric, unit, fresh=None):
     dev = jax.devices()[0]
     n_chips = len(jax.devices())
     init_watchdog.set()          # backend is alive — disarm
+    try:
+        # Best-effort: the gauge must never decide whether a benchmark
+        # artifact gets produced (the resilience tests run this whole
+        # path with jax stubbed out, which makes the import itself
+        # fail).
+        from kmeans_tpu import obs as _obs
+
+        _obs.record_build_info()     # kmeans_tpu_build_info{...}
+    except Exception as e:
+        print(f"build-info gauge unavailable: {e}", file=sys.stderr)
     print(f"platform={dev.platform} devices={n_chips}", file=sys.stderr)
 
     if args.input is not None:

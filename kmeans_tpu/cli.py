@@ -32,6 +32,7 @@ def _cmd_train(args) -> int:
     import numpy as np
 
     import kmeans_tpu.models as models
+    from kmeans_tpu import obs
     from kmeans_tpu.config import KMeansConfig
     from kmeans_tpu.data import bench_config, make_blobs
     from kmeans_tpu.session import dataset_to_document, export_json
@@ -181,7 +182,8 @@ def _cmd_train(args) -> int:
             return 2
         runner_flags = bool(args.progress or args.checkpoint
                             or args.resume or args.profile
-                            or args.telemetry)
+                            or args.telemetry or args.trace
+                            or args.xla_trace)
         if args.update in ("delta", "hamerly") and model != "lloyd":
             print(f"error: --update {args.update} (the incremental sweep) "
                   "runs only in the lloyd family; accelerated/spherical/"
@@ -191,17 +193,27 @@ def _cmd_train(args) -> int:
         if args.update == "delta" and runner_flags and args.mesh \
                 and args.mesh > 1:
             print("error: --update delta with runner flags (--progress/"
-                  "--checkpoint/--resume/--profile/--telemetry) runs "
-                  "single-device only; the mesh runner steps the dense "
-                  "reduction — drop --mesh or the runner flags, or use "
-                  "--update auto", file=sys.stderr)
+                  "--checkpoint/--resume/--profile/--telemetry/--trace/"
+                  "--xla-trace) runs single-device only; the mesh runner "
+                  "steps the dense reduction — drop --mesh or the runner "
+                  "flags, or use --update auto", file=sys.stderr)
             return 2
         if args.update == "hamerly" and runner_flags:
             print("error: --update hamerly runs the fit_lloyd loops "
                   "(single-device or DP mesh), not the step-wise runner; "
                   "drop --progress/--checkpoint/--resume/--profile/"
-                  "--telemetry or use --update auto", file=sys.stderr)
+                  "--telemetry/--trace/--xla-trace or use --update auto",
+                  file=sys.stderr)
             return 2
+
+    if args.profile and args.xla_trace and args.profile != args.xla_trace:
+        # --profile is the legacy spelling of --xla-trace; two different
+        # directories would silently drop one — reject the ambiguity
+        # (the CLI's contradictory-flag convention).
+        print("error: --profile is the legacy spelling of --xla-trace; "
+              "passing both with different directories is ambiguous — "
+              "drop one", file=sys.stderr)
+        return 2
 
     if args.steps is not None and args.steps < 1:
         print("error: --steps must be positive", file=sys.stderr)
@@ -231,22 +243,23 @@ def _cmd_train(args) -> int:
 
     # --checkpoint/--resume ride the step-wise Lloyd runner OR the streamed
     # fits (both checkpoint natively); --progress/--profile are
-    # runner-only.  --telemetry needs a step-paced loop (runner or
-    # streamed) — the one-shot fused fits have no iteration boundary to
-    # emit events at.
+    # runner-only.  --telemetry and --trace/--xla-trace need a step-paced
+    # loop (runner or streamed) — the one-shot fused fits have no
+    # iteration boundary to emit events or spans at.
     stream_ckpt = args.stream and (args.checkpoint or args.resume)
     want_runner = not args.stream and bool(
         args.progress or args.checkpoint or args.resume or args.profile
-        or args.telemetry
+        or args.telemetry or args.trace or args.xla_trace
     )
     if args.stream and (args.progress or args.profile):
         print("error: --progress/--profile require the full-batch Lloyd "
               "runner; the streamed paths support --checkpoint/--resume/"
-              "--telemetry", file=sys.stderr)
+              "--telemetry/--trace/--xla-trace", file=sys.stderr)
         return 2
     if want_runner and model != "lloyd":
         print(
-            "error: --progress/--checkpoint/--resume/--profile/--telemetry "
+            "error: --progress/--checkpoint/--resume/--profile/--telemetry/"
+            "--trace/--xla-trace "
             "require a step-paced loop (they would be silently ignored "
             f"with the one-shot --model {model}); use --model lloyd, "
             "--stream, or drop those flags",
@@ -307,6 +320,22 @@ def _cmd_train(args) -> int:
             )
             return 2
 
+    # Past every flag validation — a usage error must report instantly
+    # and leave NOTHING behind: record_build_info initializes the jax
+    # runtime (claims the device), which only the fit below is entitled
+    # to do, and the --trace probe creates the file if absent (same
+    # contract as --telemetry: an unwritable span-export path is one
+    # actionable line + exit 2 before any fit work, because the export
+    # only opens the file at capture exit — after the whole fit).
+    if args.trace:
+        try:
+            obs.probe_writable(args.trace)
+        except OSError as e:
+            print(f"error: cannot write trace to {args.trace!r}: {e}",
+                  file=sys.stderr)
+            return 2
+    obs.record_build_info()     # kmeans_tpu_build_info{version,backend}
+
     t0 = time.perf_counter()
     if args.coreset is not None:
         from kmeans_tpu.data import lightweight_coreset
@@ -319,7 +348,7 @@ def _cmd_train(args) -> int:
         from kmeans_tpu.models import LloydRunner
         import contextlib
 
-        from kmeans_tpu.utils import trace
+        from kmeans_tpu.utils import capture
 
         runner = LloydRunner(np.asarray(x), k, config=kcfg, mesh=mesh)
         if args.resume:
@@ -360,7 +389,14 @@ def _cmd_train(args) -> int:
                       f"{args.telemetry!r}: {e}", file=sys.stderr)
                 return 2
 
-        ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
+        # One flag captures both timelines (docs/OBSERVABILITY.md):
+        # --trace writes the host span timeline as Chrome trace-event
+        # JSON (Perfetto; tools/trace_view.py renders text), --xla-trace
+        # (or the legacy --profile) adds the jax.profiler device trace
+        # over the same window.
+        xla_dir = args.xla_trace or args.profile
+        ctx = (capture(args.trace, xla_dir=xla_dir, name="cli.fit")
+               if (args.trace or xla_dir) else contextlib.nullcontext())
         try:
             with ctx:
                 state = runner.run(
@@ -450,8 +486,7 @@ def _cmd_train(args) -> int:
                 # telemetry on its way to exit 2 (same contract as the
                 # runner path).  The real writer opens lazily on the
                 # first event — i.e. only once a step actually ran.
-                with open(args.telemetry, "a", encoding="utf-8"):
-                    pass
+                obs.probe_writable(args.telemetry)
             except OSError as e:
                 print(f"error: cannot write telemetry to "
                       f"{args.telemetry!r}: {e}", file=sys.stderr)
@@ -478,9 +513,20 @@ def _cmd_train(args) -> int:
             stream_kw["callback"] = _stream_event
         from kmeans_tpu.utils.retry import RetryError
 
+        import contextlib
+
+        from kmeans_tpu.utils import capture
+
+        # Same one-flag capture as the runner path: the streamed fits
+        # open per-step spans, so --trace works out-of-core too.
+        trace_ctx = (capture(args.trace, xla_dir=args.xla_trace,
+                             name="cli.train_stream")
+                     if (args.trace or args.xla_trace)
+                     else contextlib.nullcontext())
         try:
             try:
-                state = fit_stream(x, k, config=kcfg, **stream_kw)
+                with trace_ctx:
+                    state = fit_stream(x, k, config=kcfg, **stream_kw)
             except ValueError as e:
                 # Predictable user errors (cross-family resume,
                 # contradicted sampling params, step mismatch) report like
@@ -673,9 +719,15 @@ def _cmd_serve(args) -> int:
     try:
         serve(args.host, args.port, background=False,
               persist_dir=args.persist_dir or None,
-              metrics=args.metrics)
+              metrics=args.metrics,
+              telemetry_path=args.telemetry)
     except KeyboardInterrupt:
         pass
+    except ValueError as e:
+        # Config mistakes surface at construction (unwritable
+        # --telemetry path): one actionable line, not a traceback.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     return 0
 
 
@@ -776,6 +828,18 @@ def main(argv=None) -> int:
     t.add_argument("--resume", help="resume from this checkpoint directory "
                    "(a streamed resume keeps saving into the same dir)")
     t.add_argument("--profile", help="write a jax.profiler trace to this dir")
+    t.add_argument("--trace", metavar="OUT.json",
+                   help="write the run's host span timeline (compile / "
+                        "assign sweep / update / host sync / checkpoint "
+                        "phases) as Chrome trace-event JSON — load it in "
+                        "Perfetto (ui.perfetto.dev) or render a text "
+                        "flamegraph with tools/trace_view.py; runs the "
+                        "step-wise Lloyd runner, or rides --stream "
+                        "(docs/OBSERVABILITY.md)")
+    t.add_argument("--xla-trace", metavar="DIR",
+                   help="capture the jax.profiler device timeline into "
+                        "DIR over the same window as --trace (composable; "
+                        "--profile is the runner-only legacy spelling)")
     t.add_argument("--telemetry", metavar="OUT.jsonl",
                    help="write one JSON telemetry event per iteration/step "
                         "to this file (inertia, shift, seconds, device, "
@@ -826,6 +890,11 @@ def main(argv=None) -> int:
                    help="serve GET /metrics (Prometheus text exposition "
                         "of the process metrics registry; default on — "
                         "--no-metrics hides the endpoint)")
+    s.add_argument("--telemetry", metavar="OUT.jsonl", default=None,
+                   help="append every train job's JSONL telemetry "
+                        "(run_id/trace_id-stamped, so concurrent jobs "
+                        "stay separable) to this file "
+                        "(docs/OBSERVABILITY.md)")
     s.set_defaults(fn=_cmd_serve)
 
     b = sub.add_parser("bench", help="run the benchmark (one JSON line)")

@@ -204,6 +204,17 @@ class ServeConfig:
     #: (404) — for deployments that must not expose internals on the
     #: same origin the board is served from.
     metrics: bool = True
+    #: Structured tracing (docs/OBSERVABILITY.md): enable the process
+    #: span tracer at server construction and serve ``GET /api/trace``
+    #: (the bounded span ring as Chrome trace-event JSON, Perfetto-
+    #: loadable).  Off keeps the tracer switch untouched and hides the
+    #: endpoint; the ``X-Trace-Id`` request/response header contract
+    #: stays active either way (IDs still mint, spans just no-op).
+    tracing: bool = True
+    #: Append every train job's JSONL telemetry (run_start / iter /
+    #: run_done events, run_id + trace_id stamped, so concurrent jobs
+    #: stay separable) to this file.  None disables.
+    telemetry_path: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)

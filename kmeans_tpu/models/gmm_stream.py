@@ -384,23 +384,42 @@ def fit_gmm_stream(
                              start_step=start_step)
     step = start_step
     from kmeans_tpu.models.runner import StepObserver
+    from kmeans_tpu.obs import tracing as _tracing
 
     rec = StepObserver("gmm_stream", callback)
+    # Whole-fit + per-step spans, same taxonomy as fit_minibatch_stream
+    # (docs/OBSERVABILITY.md): the first step's dispatch compiles, so
+    # its sweep span is category "compile".
+    fit_span = _tracing.span("fit_gmm_stream", category="run",
+                             model="gmm_stream", k=k, steps=int(n_steps))
     # Same preemption contract as fit_minibatch_stream: signal latches a
     # flag, the loop cuts one final checkpoint at the next step boundary
-    # and exits resumable.
-    with PreemptionGuard() as guard:
+    # and exits resumable.  The fit span encloses the final pass too
+    # (one span owns the whole fit's time); the GUARD must not — a
+    # signal during the final pass keeps its default handling.
+    with fit_span:
+      with PreemptionGuard() as guard:
         rec.start()
         for xb in prefetch_to_device(batches, depth=prefetch_depth,
                                      background=background_prefetch,
                                      device=place):
+          with _tracing.span("step", category="iteration", step=step + 1):
             rho = jnp.asarray((step + t0) ** (-kappa), jnp.float32)
-            params, stats, mean_ll = step_fn(params, stats, xb, rho, reg)
+            with _tracing.span(
+                    "sweep",
+                    category="compile" if step == start_step else "assign"):
+                params, stats, mean_ll = step_fn(params, stats, xb, rho,
+                                                 reg)
             step += 1
             # The ll read syncs the stream to the device (see the
             # docstring); the negated mean ll keeps "inertia"
-            # lower-is-better.
-            neg_ll = -float(mean_ll) if rec.wants_sync else None
+            # lower-is-better.  No callback → no sync, and no span
+            # either: a host_sync span must mean a sync happened.
+            if rec.wants_sync:
+                with _tracing.span("host_sync", category="host_sync"):
+                    neg_ll = -float(mean_ll)
+            else:
+                neg_ll = None
             rec.step(step, inertia=neg_ll)
             saver.maybe(step, lambda p=params, s=stats, t=step:
                         save(p, s, t))
@@ -428,26 +447,28 @@ def fit_gmm_stream(
                 path=checkpoint_path, step=step,
             )
 
-    if final_pass:
-        labels_np, ll, soft = gmm_assign_stream(
-            data, params, chunk_size=max(cfg.chunk_size, 8192),
-            compute_dtype=cfg.compute_dtype,
-        )
+      if final_pass:
+        with _tracing.span("final_pass", category="assign",
+                           model="gmm_stream"):
+            labels_np, ll, soft = gmm_assign_stream(
+                data, params, chunk_size=max(cfg.chunk_size, 8192),
+                compute_dtype=cfg.compute_dtype,
+            )
         labels = jnp.asarray(labels_np)
         ll_v = jnp.asarray(ll, jnp.float32)
         counts = jnp.asarray(soft)
-    else:
+      else:
         labels = jnp.zeros((0,), jnp.int32)
         ll_v = jnp.zeros((), jnp.float32)
         counts = jnp.zeros((k,), jnp.float32)
 
-    return GMMState(
-        means=params.means,
-        covariances=params.variances,
-        mix_weights=jnp.exp(params.log_pi),
-        labels=labels,
-        log_likelihood=ll_v,
-        n_iter=jnp.asarray(step, jnp.int32),
-        converged=jnp.asarray(False),
-        resp_counts=counts,
-    )
+      return GMMState(
+          means=params.means,
+          covariances=params.variances,
+          mix_weights=jnp.exp(params.log_pi),
+          labels=labels,
+          log_likelihood=ll_v,
+          n_iter=jnp.asarray(step, jnp.int32),
+          converged=jnp.asarray(False),
+          resp_counts=counts,
+      )

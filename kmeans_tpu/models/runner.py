@@ -29,6 +29,7 @@ from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.obs import (
     counter as _obs_counter,
     histogram as _obs_histogram,
+    tracing as _tracing,
 )
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend, resolve_update
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
@@ -334,6 +335,7 @@ class LloydRunner:
         checkpoint_every: int = 10,
         checkpoint_keep: int = 0,
         telemetry=None,
+        run_id: Optional[str] = None,
     ) -> KMeansState:
         """Iterate until convergence; fire ``callback`` each iteration.
 
@@ -346,6 +348,11 @@ class LloydRunner:
         ``telemetry``, every iteration's wall time lands in the
         :data:`ITER_SECONDS` registry histogram (one no-op check per
         iteration when the registry is disabled).
+
+        ``run_id`` pins the id stamped into this run's spans (the serve
+        layer passes its train-job id so spans, SSE events, and
+        telemetry all cross-reference); default: the telemetry writer's
+        id, or a fresh one.
         """
         if self.centroids is None:
             self.init()
@@ -369,22 +376,19 @@ class LloydRunner:
             device = next(iter(self.x.devices())).platform
         hist = ITER_SECONDS.labels(model="lloyd")
         iters_total = ITERS_TOTAL.labels(model="lloyd")
-        if tw is not None:
-            # On a mesh self.x carries zero padding rows; _n is the true
-            # dataset size (only defined on the mesh path).
-            n_true = self._n if self.mesh is not None else self.x.shape[0]
-            tw.event(
-                "run_start", model="lloyd", device=device,
-                n=int(n_true), d=int(self.x.shape[1]), k=self.k,
-                update=self._update, max_iter=int(max_iter),
-                tol=float(tol), start_iteration=self.iteration,
-            )
 
         from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
 
         converged = False
         saved = False
         t_run0 = time.perf_counter()
+        # One run id for the whole fit: an explicit ``run_id`` wins (the
+        # serve layer passes its job id so the train_job span, the SSE
+        # events, and these spans all agree), else the TelemetryWriter's
+        # (so JSONL events and spans agree), else a fresh one.  Spans
+        # are no-ops while tracing is disabled.
+        if run_id is None:
+            run_id = tw.run_id if tw is not None else _tracing.new_run_id()
 
         def preempt_exit():
             if checkpoint_path and not saved:
@@ -399,8 +403,27 @@ class LloydRunner:
         # the loop cuts one final checkpoint at the next iteration
         # boundary and raises Preempted with a resumable state.
         try:
+          # The run span is the trace root of a CLI fit (under the serve
+          # layer it nests below the request's train_job span), so every
+          # iteration/sweep/update child and every telemetry event share
+          # one trace id (docs/OBSERVABILITY.md span taxonomy).
+          with _tracing.span("lloyd.run", category="run", model="lloyd",
+                             run_id=run_id, k=self.k, update=self._update):
+            if tw is not None:
+                # On a mesh self.x carries zero padding rows; _n is the
+                # true dataset size (only defined on the mesh path).
+                n_true = self._n if self.mesh is not None \
+                    else self.x.shape[0]
+                tw.event(
+                    "run_start", model="lloyd", device=device,
+                    n=int(n_true), d=int(self.x.shape[1]), k=self.k,
+                    update=self._update, max_iter=int(max_iter),
+                    tol=float(tol), start_iteration=self.iteration,
+                )
             with PreemptionGuard() as guard:
                 for it in range(max_iter):
+                  with _tracing.span("iteration", category="iteration",
+                                     iteration=self.iteration + 1):
                     t0 = time.perf_counter()
                     ran_delta = False
                     if self.mesh is None and self._update == "delta":
@@ -411,20 +434,40 @@ class LloydRunner:
                         # sweep otherwise.
                         from kmeans_tpu.ops.delta import DELTA_REFRESH
 
-                        if (self._dstate is None
-                                or self.iteration % DELTA_REFRESH == 0):
-                            new_c, inertia, shift_sq, lab, sums, counts = \
-                                self._step(self.x, self.centroids)
-                        else:
-                            ran_delta = True
-                            new_c, inertia, shift_sq, lab, sums, counts = \
-                                self._step_delta(self.x, self.centroids,
-                                                 *self._dstate)
+                        ran_delta = not (
+                            self._dstate is None
+                            or self.iteration % DELTA_REFRESH == 0)
+                        # A program's first call includes its XLA compile
+                        # — that sweep's span is category "compile", the
+                        # steady-state ones "assign" (the span twin of
+                        # the telemetry phase tag).
+                        first = not (self._stepped_delta if ran_delta
+                                     else self._stepped)
+                        with _tracing.span(
+                                "sweep",
+                                category="compile" if first else "assign",
+                                sweep="delta" if ran_delta else "refresh"):
+                            if ran_delta:
+                                new_c, inertia, shift_sq, lab, sums, \
+                                    counts = self._step_delta(
+                                        self.x, self.centroids,
+                                        *self._dstate)
+                            else:
+                                new_c, inertia, shift_sq, lab, sums, \
+                                    counts = self._step(
+                                        self.x, self.centroids)
                         self._dstate = (lab, sums, counts)
                     else:
-                        new_c, inertia, shift_sq = self._step(
-                            self.x, self.centroids)
-                    new_c.block_until_ready()
+                        first = not self._stepped
+                        with _tracing.span(
+                                "sweep",
+                                category="compile" if first else "assign",
+                                sweep=self._update):
+                            new_c, inertia, shift_sq = self._step(
+                                self.x, self.centroids)
+                    with _tracing.span("host_sync",
+                                       category="host_sync"):
+                        new_c.block_until_ready()
                     dt = time.perf_counter() - t0
                     # Per-program first-call flags: the delta update runs
                     # a second jitted program whose own compile lands in
@@ -436,25 +479,29 @@ class LloydRunner:
                     else:
                         phase = "step" if self._stepped else "compile+step"
                         self._stepped = True
-                    self.centroids = new_c
-                    self.iteration += 1
-                    self.last_inertia = float(inertia)
-                    converged = float(shift_sq) <= tol
-                    hist.observe(dt)
-                    iters_total.inc()
-                    info = IterInfo(
-                        self.iteration, float(inertia), float(shift_sq), dt,
-                        converged,
-                    )
-                    if tw is not None:
-                        tw.iteration(info, model="lloyd", device=device,
-                                     phase=phase)
-                    if callback:
-                        callback(info)
+                    with _tracing.span("update", category="update"):
+                        self.centroids = new_c
+                        self.iteration += 1
+                        self.last_inertia = float(inertia)
+                        converged = float(shift_sq) <= tol
+                        hist.observe(dt)
+                        iters_total.inc()
+                        info = IterInfo(
+                            self.iteration, float(inertia),
+                            float(shift_sq), dt, converged,
+                        )
+                        if tw is not None:
+                            tw.iteration(info, model="lloyd",
+                                         device=device, phase=phase)
+                        if callback:
+                            callback(info)
                     saved = bool(checkpoint_path) and (
                         self.iteration % checkpoint_every == 0 or converged
                     )
                     if saved:
+                        # save_array_checkpoint opens the
+                        # "checkpoint_save" span (shared with the
+                        # streamed fits' periodic saves).
                         self.checkpoint(checkpoint_path,
                                         keep=checkpoint_keep)
                     if converged:
@@ -482,10 +529,11 @@ class LloydRunner:
                     inertia=self.last_inertia,
                     seconds=time.perf_counter() - t_run0,
                 )
+            with _tracing.span("finalize", category="assign"):
+                return self.finalize(converged=converged)
         finally:
             if own_tw:
                 tw.close()
-        return self.finalize(converged=converged)
 
     def finalize(self, *, converged: bool = False) -> KMeansState:
         """Labels/inertia/counts at the current centroids."""

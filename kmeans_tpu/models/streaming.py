@@ -364,27 +364,49 @@ def fit_minibatch_stream(
                              start_step=start_step, to_bf16=to_bf16)
     step = start_step
     from kmeans_tpu.models.runner import StepObserver
+    from kmeans_tpu.obs import tracing as _tracing
 
     rec = StepObserver("minibatch_stream", callback)
+    # Whole-fit span (trace root standalone; a child under the serve/CLI
+    # trace otherwise) + one span per streamed step: the first step's
+    # dispatch compiles the jitted program, so its span is category
+    # "compile" — the span twin of the telemetry phase tag.
+    fit_span = _tracing.span("fit_minibatch_stream", category="run",
+                             model="minibatch_stream", k=k,
+                             steps=int(n_steps))
     # Preemption safety: SIGTERM/SIGINT latches a flag; the loop notices
     # at the next step boundary, cuts one final checkpoint (PeriodicSaver
     # dedups against a cadence save at the same step), and exits with a
     # resumable state — losing at most the step in flight, not the
-    # checkpoint_every window.
-    with PreemptionGuard() as guard:
+    # checkpoint_every window.  The fit span encloses the final pass too
+    # (so the whole fit's time attributes under one span, matching
+    # LloydRunner's finalize-inside-run), but the GUARD must not: a
+    # signal during the final pass keeps its default handling.
+    with fit_span:
+      with PreemptionGuard() as guard:
         rec.start()
         for xb in prefetch_to_device(batches, depth=prefetch_depth,
                                      background=background_prefetch,
                                      device=place):
+          with _tracing.span("step", category="iteration", step=step + 1):
             c_prev = c if rec.wants_sync else None
-            c, n_seen = step_fn(c, n_seen, xb)
+            with _tracing.span(
+                    "sweep",
+                    category="compile" if step == start_step else "assign"):
+                c, n_seen = step_fn(c, n_seen, xb)
             step += 1
             # The shift read syncs the stream to the device, so the
             # reported seconds are true per-step wall time (no callback
-            # → no sync, timings are dispatch-paced).
-            shift_sq = (float(jnp.sum((c - c_prev) ** 2))
-                        if rec.wants_sync else None)
+            # → no sync, timings are dispatch-paced — and no span: a
+            # host_sync span must mean a sync actually happened).
+            if rec.wants_sync:
+                with _tracing.span("host_sync", category="host_sync"):
+                    shift_sq = float(jnp.sum((c - c_prev) ** 2))
+            else:
+                shift_sq = None
             rec.step(step, shift_sq=shift_sq)
+            # An actual save opens its own "checkpoint_save" span inside
+            # save_array_checkpoint; the no-save steps stay span-free.
             saver.maybe(step, lambda c=c, ns=n_seen, t=step:
                         checkpoint_now(c, ns, t))
             rec.exclude()    # checkpoint write time is not step time
@@ -414,26 +436,28 @@ def fit_minibatch_stream(
                 path=checkpoint_path, step=step,
             )
 
-    if final_pass:
-        labels_np, inertia = assign_stream(
-            data, c, chunk_size=max(cfg.chunk_size, 8192),
-            compute_dtype=cfg.compute_dtype,
-        )
+      if final_pass:
+        with _tracing.span("final_pass", category="assign",
+                           model="minibatch_stream"):
+            labels_np, inertia = assign_stream(
+                data, c, chunk_size=max(cfg.chunk_size, 8192),
+                compute_dtype=cfg.compute_dtype,
+            )
         labels = jnp.asarray(labels_np)
         counts = jnp.asarray(
             np.bincount(labels_np, minlength=k).astype(np.float32)
         )
         inertia_v = jnp.asarray(inertia, jnp.float32)
-    else:
+      else:
         labels = jnp.zeros((0,), jnp.int32)
         counts = jnp.zeros((k,), jnp.float32)
         inertia_v = jnp.zeros((), jnp.float32)
 
-    return KMeansState(
-        centroids=c,
-        labels=labels,
-        inertia=inertia_v,
-        n_iter=jnp.asarray(step, jnp.int32),
-        converged=jnp.asarray(False),
-        counts=counts,
-    )
+      return KMeansState(
+          centroids=c,
+          labels=labels,
+          inertia=inertia_v,
+          n_iter=jnp.asarray(step, jnp.int32),
+          converged=jnp.asarray(False),
+          counts=counts,
+      )

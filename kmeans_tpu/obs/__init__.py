@@ -1,7 +1,7 @@
-"""Observability: metrics registry + structured run telemetry.
+"""Observability: metrics registry + structured run telemetry + tracing.
 
-Two complementary halves (docs/OBSERVABILITY.md has the full catalog and
-naming convention):
+Three complementary parts (docs/OBSERVABILITY.md has the full catalog,
+span taxonomy and naming convention):
 
 * :mod:`kmeans_tpu.obs.registry` — a zero-dependency, thread-safe
   Prometheus-style metrics registry (counters / gauges / histograms with
@@ -11,10 +11,21 @@ naming convention):
   keep their instrumentation unconditionally.
 * :mod:`kmeans_tpu.obs.telemetry` — per-run JSONL event streams (one
   event per iteration: inertia, shift, seconds, device, compile-vs-step
-  phase), shared by ``fit --telemetry``, the serve train stream, and
-  ``bench.py --telemetry``.
+  phase, ``run_id``/``trace_id``), shared by ``fit --telemetry``, the
+  serve train stream, and ``bench.py --telemetry``.
+* :mod:`kmeans_tpu.obs.tracing` — a thread-safe span tracer with
+  process-wide trace/span IDs, parent linkage, explicit cross-thread
+  context propagation, and Chrome trace-event JSON export loadable in
+  Perfetto (``fit --trace out.json``; ``tools/trace_view.py`` renders a
+  text flamegraph).  Off by default, near-free while off.
+
+``obs.enable()`` / ``obs.disable()`` toggle the METRICS registry (the
+historical meaning); the span tracer has its own independent switch
+(``obs.tracing.enable()``) because spans cost more per call than a
+counter bump and default OFF.
 """
 
+from kmeans_tpu.obs import tracing
 from kmeans_tpu.obs.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -29,6 +40,7 @@ from kmeans_tpu.obs.registry import (
 from kmeans_tpu.obs.telemetry import (
     TelemetryWriter,
     read_events,
+    summarize_by_run,
     summarize_events,
 )
 
@@ -45,10 +57,74 @@ __all__ = [
     "TelemetryWriter",
     "read_events",
     "summarize_events",
+    "summarize_by_run",
+    "tracing",
     "enable",
     "disable",
     "enabled",
+    "probe_writable",
+    "record_build_info",
+    "BUILD_INFO",
+    "SCRAPE_SECONDS",
 ]
+
+#: Build/runtime identity, Prometheus build-info convention: the value is
+#: always 1, the information lives in the labels.  The family registers
+#: at import (so the docs catalog check sees it); the child appears once
+#: :func:`record_build_info` runs — serve startup, the CLI and bench do —
+#: because the ``backend`` label needs jax, which this package must not
+#: import.
+BUILD_INFO = gauge(
+    "kmeans_tpu_build_info",
+    "Build/runtime identity (value is always 1; see the labels)",
+    labels=("version", "backend"),
+)
+
+#: Self-observation: how long one ``GET /metrics`` exposition render
+#: takes (observed by the serve handler around ``REGISTRY.expose()``, so
+#: each scrape reports the cost of the previous ones).
+SCRAPE_SECONDS = histogram(
+    "kmeans_tpu_metrics_scrape_seconds",
+    "Wall time of one /metrics text-exposition render",
+)
+
+
+def probe_writable(path: str) -> None:
+    """Open ``path`` for append and close it — raises ``OSError`` when
+    an observability output path (telemetry JSONL, span-trace JSON)
+    cannot be written.  Appends nothing and never truncates.  THE one
+    copy of the up-front writability probe: callers turn the OSError
+    into their surface's failure shape (CLI one-line error + exit 2,
+    bench argparse error, serve construction ValueError) — an
+    unwritable log path must fail before hours of fit work, not after.
+    """
+    with open(path, "a", encoding="utf-8"):
+        pass
+
+
+def record_build_info(backend: str = None) -> None:
+    """Seed the :data:`BUILD_INFO` child for this process.  ``backend``
+    defaults to ``jax.default_backend()`` (``"none"`` when jax is
+    unavailable — the gauge must never be the reason a process dies).
+
+    NOTE: resolving the default backend INITIALIZES the jax runtime
+    (claims the accelerator), so callers invoke this where the device
+    is being used anyway — the CLI fit path, the bench harness, a serve
+    train worker — never at import time or in a device-free process.
+    """
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:   # pragma: no cover - jax is baked into the image
+            backend = "none"
+    import kmeans_tpu
+
+    BUILD_INFO.labels(
+        version=getattr(kmeans_tpu, "__version__", "unknown"),
+        backend=str(backend),
+    ).set(1)
 
 
 def enable() -> None:

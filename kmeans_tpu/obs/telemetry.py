@@ -25,6 +25,14 @@ numbers (tools/bench_table.py ``--telemetry`` renders either).
 
 Non-finite floats (a diverged fit's NaN inertia) are written as JSON
 ``null`` — every line stays strictly parseable JSON.
+
+Every event additionally carries a ``run_id`` (minted per writer unless
+the caller supplies one in ``common``), so multiple runs appended to
+one JSONL file stay separable (:func:`summarize_by_run` groups them
+back apart), and a ``trace_id`` whenever a span context is active at
+event time — the cross-reference key between the JSONL stream, the
+span tracer's Perfetto export, and the serve layer's ``X-Trace-Id``
+response header (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -35,7 +43,10 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Union
 
-__all__ = ["TelemetryWriter", "read_events", "summarize_events"]
+from kmeans_tpu.obs import tracing as _tracing
+
+__all__ = ["TelemetryWriter", "read_events", "summarize_events",
+           "summarize_by_run"]
 
 
 def _clean(obj: Any) -> Any:
@@ -64,9 +75,10 @@ class TelemetryWriter:
 
     ``sink`` is a path (opened for write, or append with ``append=True``)
     or any object with ``write``/``flush``.  ``common`` fields are merged
-    into every event (run id, model, device).  Each event is flushed as
-    one line, so a concurrently-tailing consumer (or a crash) always
-    sees whole events.
+    into every event (run id, model, device); a ``run_id`` is minted
+    when ``common`` doesn't carry one, so every stream is separable
+    after concatenation.  Each event is flushed as one line, so a
+    concurrently-tailing consumer (or a crash) always sees whole events.
     """
 
     def __init__(self, sink: Union[str, Any], *,
@@ -79,13 +91,28 @@ class TelemetryWriter:
             self._f = sink
             self._owns = False
         self._common = dict(common or {})
+        self._common.setdefault("run_id", _tracing.new_run_id())
         self._lock = threading.Lock()
         self._closed = False
 
+    @property
+    def run_id(self) -> str:
+        """The run id stamped into every event of this stream."""
+        return self._common["run_id"]
+
     def event(self, kind: str, **fields) -> Dict[str, Any]:
-        """Write one event; returns the record that was written."""
+        """Write one event; returns the record that was written.
+
+        A ``trace_id`` is stamped from the ambient span context when one
+        is active (and the caller didn't set one explicitly) — the
+        JSONL/span/HTTP cross-reference key.
+        """
         rec = {"event": str(kind), "ts": round(time.time(), 6),
                **self._common, **fields}
+        if "trace_id" not in rec:
+            tid = _tracing.current_trace_id()
+            if tid is not None:
+                rec["trace_id"] = tid
         rec = _clean(rec)
         line = json.dumps(rec, allow_nan=False, separators=(",", ":"))
         with self._lock:
@@ -169,3 +196,19 @@ def summarize_events(events: Iterable[Dict[str, Any]], *,
         "max_s": max(timed) if timed else None,
         "rate_per_s": (len(timed) / total) if total > 0 else None,
     }
+
+
+def summarize_by_run(events: Iterable[Dict[str, Any]], *,
+                     kind: str = "iter",
+                     seconds_key: str = "seconds") -> Dict[Any, Dict]:
+    """Per-run :func:`summarize_events`: ``{run_id: summary}`` in first-
+    seen order (events missing ``run_id`` — pre-tracing streams — group
+    under ``None``) — so appended runs never blend into one bogus
+    aggregate.  Finer groupings (``tools/bench_table.py --telemetry``
+    splits by (run, model)) filter first and feed the same
+    :func:`summarize_events` derivation per group."""
+    by_run: Dict[Any, List[Dict[str, Any]]] = {}
+    for ev in events:
+        by_run.setdefault(ev.get("run_id"), []).append(ev)
+    return {run: summarize_events(evs, kind=kind, seconds_key=seconds_key)
+            for run, evs in by_run.items()}

@@ -42,6 +42,7 @@ from kmeans_tpu.obs import (
     counter as _obs_counter,
     gauge as _obs_gauge,
     histogram as _obs_histogram,
+    tracing as _tracing,
 )
 from kmeans_tpu.ops.distance import chunk_tiles, matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import (
@@ -964,19 +965,31 @@ def fit_lloyd_sharded(
             weights_binary if not (model_axis or feature_axis) else True,
             center_update,
         )
-    t_run0 = time.perf_counter()
-    c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
-    if _OBS_REGISTRY.enabled:
-        # int() blocks until the fused program finishes, so the recorded
-        # wall time covers the whole fit (the caller reads the state right
-        # after anyway; the sweep count itself is needed for the
-        # mean-sweep metric).  Skipped entirely when the registry is
-        # disabled — no forced sync on the no-observability path.
-        n_sweeps = int(n_iter)
-        _observe_sharded_fit(
-            f"lloyd.{update}", backend, _mesh_layout(dp, mp, fp),
-            dp * mp * fp, time.perf_counter() - t_run0, n_sweeps,
-        )
+    layout = _mesh_layout(dp, mp, fp)
+    # Whole-fit span with a child per phase the host can see: the fused
+    # program has no per-sweep host boundary, so "fused_run" covers
+    # dispatch(+first-call XLA compile) and "host_sync" the blocking
+    # n_iter read (docs/OBSERVABILITY.md span taxonomy).
+    with _tracing.span("fit_lloyd_sharded", category="fit",
+                       kind=f"lloyd.{update}", backend=backend,
+                       layout=layout):
+        t_run0 = time.perf_counter()
+        with _tracing.span("fused_run", category="assign"):
+            c, labels, inertia, n_iter, converged, counts = run(
+                x, w, c0, tol_v)
+        if _OBS_REGISTRY.enabled:
+            # int() blocks until the fused program finishes, so the
+            # recorded wall time covers the whole fit (the caller reads
+            # the state right after anyway; the sweep count itself is
+            # needed for the mean-sweep metric).  Skipped entirely when
+            # the registry is disabled — no forced sync on the
+            # no-observability path.
+            with _tracing.span("host_sync", category="host_sync"):
+                n_sweeps = int(n_iter)
+            _observe_sharded_fit(
+                f"lloyd.{update}", backend, layout,
+                dp * mp * fp, time.perf_counter() - t_run0, n_sweeps,
+            )
     return KMeansState(
         c[:k, :d_real], labels[:n], inertia, n_iter, converged, counts[:k]
     )

@@ -51,6 +51,7 @@ from kmeans_tpu.session import (
     trait_counts_for,
 )
 from kmeans_tpu import obs
+from kmeans_tpu.obs import tracing as _tracing
 from kmeans_tpu.utils import faults
 from kmeans_tpu.utils.rooms import code4
 
@@ -106,7 +107,7 @@ _SSE_SUBSCRIBERS = obs.gauge(
 _KNOWN_ROUTES = frozenset((
     "/", "/index.html", "/app.js", "/api/state", "/api/export",
     "/api/events", "/api/mutate", "/api/hello", "/api/import",
-    "/healthz", "/metrics",
+    "/healthz", "/metrics", "/api/trace",
 ))
 
 
@@ -165,6 +166,15 @@ _SECURITY_HEADERS = {
 }
 
 _PRESENCE_TTL_S = 30.0
+
+#: Refcounted holds on the process-global span tracer: overlapping
+#: server lifetimes (tests, embedders) must not let the FIRST stop()
+#: switch tracing off under a still-running second server.  The switch
+#: state observed before the first hold is restored when the last hold
+#: releases.
+_TRACER_HOLDS_LOCK = threading.Lock()
+_TRACER_HOLDS = [0]
+_TRACER_PRIOR = [False]
 
 import re as _re
 
@@ -317,6 +327,31 @@ class KMeansServer:
         _TRAIN_SLOTS_IN_USE.set_function(lambda: self._train_inflight)
         _SSE_SUBSCRIBERS.set_function(
             lambda: sum(r.peer_count() for r in list(self.rooms.values())))
+        if self.config.telemetry_path:
+            # Fail at construction, not as a train_error on every job:
+            # an unwritable log path is a config mistake, and surfacing
+            # it per-request would make TRAINING look broken.  Validated
+            # BEFORE any process-global state changes below, so a failed
+            # construction leaves nothing behind.
+            try:
+                obs.probe_writable(self.config.telemetry_path)
+            except OSError as e:
+                raise ValueError(
+                    f"telemetry_path {self.config.telemetry_path!r} is "
+                    f"not writable: {e}"
+                ) from e
+        # Tracing: the serve layer is THE place traces pay for themselves
+        # (where did this request's 400 ms go?), so the span tracer turns
+        # on with the server; the ring buffer bounds its memory and
+        # GET /api/trace exports it (docs/OBSERVABILITY.md).  The hold is
+        # refcounted: stop() restores the pre-first-hold switch state
+        # only when the LAST live server releases, so neither an embedder
+        # nor overlapping test servers leak — or prematurely kill — the
+        # process-global tracer.  (The build-info gauge seeds in the
+        # first train worker instead — resolving the backend label
+        # initializes the jax runtime, which a board-only serve process
+        # must not do.)
+        self._tracer_held = False
         if self.config.persist_dir:
             os.makedirs(self.config.persist_dir, exist_ok=True)
             self._load_persisted_rooms()
@@ -656,8 +691,30 @@ class KMeansServer:
             raise ValueError("training already running in this room")
         _TRAIN_STARTED_TOTAL.labels(model=model).inc()
 
+        # Trace-context propagation (docs/OBSERVABILITY.md): the request
+        # thread's span context is captured HERE (while the HTTP span is
+        # still active) and re-activated inside the worker thread, so the
+        # train job's spans — and the runner's iteration/sweep children —
+        # chain back to the request that started them.  run_id/trace_id
+        # are stamped into every train_* SSE event and telemetry event,
+        # the cross-reference keys against the X-Trace-Id response header.
+        trace_ctx = _tracing.current_context()
+        trace_id = trace_ctx.trace_id if trace_ctx is not None else None
+        run_id = _tracing.new_run_id()
+
+        def _stamp(ev: dict) -> dict:
+            ev["run_id"] = run_id
+            if trace_id is not None:
+                ev["trace_id"] = trace_id
+            return ev
+
         def work():
+            tw = None
             try:
+              with _tracing.use_context(trace_ctx), \
+                   _tracing.span("train_job", category="train",
+                                 model=model, run_id=run_id,
+                                 room=room.code):
                 import jax
 
                 import kmeans_tpu.models as models
@@ -666,6 +723,19 @@ class KMeansServer:
 
                 from kmeans_tpu.data import make_blobs
 
+                # The worker owns the accelerator anyway — the right
+                # place to seed the backend-labeled gauge (idempotent).
+                obs.record_build_info()
+                if self.config.telemetry_path:
+                    # One appended JSONL stream per job, its own writer:
+                    # whole-line appends interleave safely and run_id
+                    # keeps concurrent jobs separable.
+                    from kmeans_tpu.obs import TelemetryWriter
+
+                    tw = TelemetryWriter(
+                        self.config.telemetry_path, append=True,
+                        common={"run_id": run_id, "room": room.code},
+                    )
                 x, _, _ = make_blobs(
                     jax.random.key(seed), n, d, k, cluster_std=0.6
                 )
@@ -692,7 +762,7 @@ class KMeansServer:
                     span = np.maximum(xs_np.max(axis=0) - lo, 1e-9)
 
                     def cb(info):
-                        ev = {"type": "train", **info.as_dict()}
+                        ev = _stamp({"type": "train", **info.as_dict()})
                         if d == 2 and k <= 64:
                             cpos = (np.asarray(runner.centroids) - lo) / span
                             ev["centroids"] = [
@@ -701,12 +771,13 @@ class KMeansServer:
                             ]
                         room.broadcast_event(ev)
 
-                    state = runner.run(max_iter=max_iter, callback=cb)
+                    state = runner.run(max_iter=max_iter, callback=cb,
+                                       telemetry=tw, run_id=run_id)
                 else:
                     # Other families fit as one compiled program — stream a
                     # start marker, then the result.
-                    room.broadcast_event({"type": "train", "model": model,
-                                          "iteration": 0})
+                    room.broadcast_event(_stamp(
+                        {"type": "train", "model": model, "iteration": 0}))
                     fit = getattr(models, _TRAIN_FITS[model])
                     fit_kw = ({"trim_fraction": trim_fraction}
                               if model == "trimmed" else {})
@@ -738,7 +809,7 @@ class KMeansServer:
                     )
                     import_json(room.doc, to_plain(viz))
                 objective = models.state_objective(state)
-                room.broadcast_event({
+                done = _stamp({
                     "type": "train_done",
                     "model": model,
                     "inertia": float(objective),
@@ -751,15 +822,31 @@ class KMeansServer:
                     # per-cluster counts carry its k.
                     "k": int(_state_k(state)),
                 })
+                if tw is not None and model != "lloyd":
+                    # The runner path already wrote run_start/iter/
+                    # run_done; the one-shot families record their result
+                    # as a single event in the same stream.
+                    tw.event("train_done", model=model,
+                             inertia=float(objective),
+                             n_iter=int(state.n_iter),
+                             converged=bool(state.converged))
+                room.broadcast_event(done)
             except Exception as e:   # stream the failure, don't kill the room
                 _TRAIN_ERRORS_TOTAL.inc()
-                room.broadcast_event({"type": "train_error", "error": str(e)})
+                room.broadcast_event(_stamp({"type": "train_error",
+                                             "error": str(e)}))
             finally:
+                if tw is not None:
+                    tw.close()
                 room.train_lock.release()
                 self._train_slot_release()
 
         threading.Thread(target=work, daemon=True).start()
-        return {"started": True, "n": n, "d": d, "k": k}
+        started = {"started": True, "n": n, "d": d, "k": k,
+                   "run_id": run_id}
+        if trace_id is not None:
+            started["trace_id"] = trace_id
+        return started
 
     # -------------------------------------------------------------- serve
     def make_handler(self):
@@ -788,11 +875,27 @@ class KMeansServer:
                     _HTTP_REQUEST_SECONDS.labels(
                         method=method, route=route,
                     ).observe(time.perf_counter() - t0)
+
+            def _request_trace_id(self):
+                """Adopt a well-formed incoming ``X-Trace-Id`` (the
+                propagation contract: an upstream proxy or test harness
+                may own the trace), mint otherwise.  Arbitrary header
+                strings never flow into spans/telemetry."""
+                hdr = self.headers.get("X-Trace-Id")
+                return hdr if _tracing.is_trace_id(hdr) \
+                    else _tracing.new_trace_id()
+
+            def _trace_header(self):
+                tid = getattr(self, "_trace_id", None)
+                if tid:
+                    self.send_header("X-Trace-Id", tid)
+
             def _headers_for(self, ctype, extra=None, length=None):
                 self.send_response(HTTPStatus.OK)
                 self.send_header("Content-Type", ctype)
                 for k, v in _SECURITY_HEADERS.items():
                     self.send_header(k, v)
+                self._trace_header()
                 if extra:
                     for k, v in extra.items():
                         self.send_header(k, v)
@@ -806,6 +909,7 @@ class KMeansServer:
                 self.send_header("Content-Type", "application/json")
                 for k, v in _SECURITY_HEADERS.items():
                     self.send_header(k, v)
+                self._trace_header()
                 if extra:
                     for k, v in extra.items():
                         self.send_header(k, v)
@@ -859,8 +963,16 @@ class KMeansServer:
                 path = urllib.parse.urlparse(self.path).path
                 q = self._query()
                 t0 = time.perf_counter()
+                self._trace_id = self._request_trace_id()
                 try:
-                    return self._do_get(path, q)
+                    # The request span is the trace ROOT of everything
+                    # this request causes (the train worker chains off it
+                    # via the captured context); the adopted/minted id is
+                    # echoed as X-Trace-Id on every response.
+                    with _tracing.span("GET " + _route_label(path),
+                                       category="http",
+                                       trace_id=self._trace_id):
+                        return self._do_get(path, q)
                 except RoomTableFullError as e:
                     return self._busy(e)
                 finally:
@@ -912,11 +1024,28 @@ class KMeansServer:
                     if not server.config.metrics:
                         return self._error("metrics disabled",
                                            HTTPStatus.NOT_FOUND)
+                    # Self-observation: each scrape reports the render
+                    # cost of the scrapes before it (observing after the
+                    # render keeps the current exposition consistent).
+                    t_sc = time.perf_counter()
                     body = obs.REGISTRY.expose().encode()
+                    obs.SCRAPE_SECONDS.observe(time.perf_counter() - t_sc)
                     self._headers_for(
                         "text/plain; version=0.0.4; charset=utf-8",
                         length=len(body),
                     )
+                    self.wfile.write(body)
+                    return
+                if path == "/api/trace":
+                    # The span ring as Chrome trace-event JSON — download
+                    # and load in Perfetto (https://ui.perfetto.dev), or
+                    # pipe into tools/trace_view.py for a text
+                    # flamegraph (docs/OBSERVABILITY.md).
+                    if not server.config.tracing:
+                        return self._error("tracing disabled",
+                                           HTTPStatus.NOT_FOUND)
+                    body = _tracing.TRACER.export_chrome_trace().encode()
+                    self._headers_for("application/json", length=len(body))
                     self.wfile.write(body)
                     return
                 self._error("not found", HTTPStatus.NOT_FOUND)
@@ -938,6 +1067,7 @@ class KMeansServer:
                     for k, v in _SECURITY_HEADERS.items():
                         if k not in ("Cache-Control", "Content-Security-Policy"):
                             self.send_header(k, v)
+                    self._trace_header()
                     self.end_headers()
                     hello = {"type": "hello", "version": room.doc.version,
                              "peers": max(0, room.peer_count() - 1)}
@@ -972,8 +1102,12 @@ class KMeansServer:
                 path = urllib.parse.urlparse(self.path).path
                 q = self._query()
                 t0 = time.perf_counter()
+                self._trace_id = self._request_trace_id()
                 try:
-                    return self._do_post(path, q)
+                    with _tracing.span("POST " + _route_label(path),
+                                       category="http",
+                                       trace_id=self._trace_id):
+                        return self._do_post(path, q)
                 finally:
                     self._observe_request("POST", path, t0)
 
@@ -1027,6 +1161,19 @@ class KMeansServer:
         self.httpd = ThreadingHTTPServer(
             (self.config.host, self.config.port), self.make_handler()
         )
+        # The tracer hold rides start()/stop(), NOT construction (a
+        # never-started server — room-table logic driven directly —
+        # must not flip process-global state it has no stop() to undo),
+        # and is taken only AFTER the socket bind: a failed bind
+        # (EADDRINUSE) propagates without stop() ever running, which
+        # would leak the refcount forever.
+        if self.config.tracing and not self._tracer_held:
+            with _TRACER_HOLDS_LOCK:
+                if _TRACER_HOLDS[0] == 0:
+                    _TRACER_PRIOR[0] = _tracing.TRACER.enabled
+                _TRACER_HOLDS[0] += 1
+                self._tracer_held = True
+                _tracing.TRACER.enable()
         if background:
             t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
             t.start()
@@ -1039,15 +1186,23 @@ class KMeansServer:
         if self.httpd:
             self.httpd.shutdown()
             self.httpd.server_close()
+        if self._tracer_held:        # idempotent: one release per server
+            self._tracer_held = False
+            with _TRACER_HOLDS_LOCK:
+                _TRACER_HOLDS[0] -= 1
+                if _TRACER_HOLDS[0] == 0:
+                    _tracing.TRACER.enabled = _TRACER_PRIOR[0]
 
 
 def serve(host: str = "127.0.0.1", port: int = 8787, *,
           background: bool = False,
           persist_dir: Optional[str] = None,
-          metrics: bool = True) -> KMeansServer:
+          metrics: bool = True,
+          telemetry_path: Optional[str] = None) -> KMeansServer:
     s = KMeansServer(ServeConfig(host=host, port=port,
                                  persist_dir=persist_dir,
-                                 metrics=metrics))
+                                 metrics=metrics,
+                                 telemetry_path=telemetry_path))
     try:
         s.start(background=background)
     except KeyboardInterrupt:

@@ -7,7 +7,7 @@ from kmeans_tpu.utils.checkpoint import (
     save_checkpoint,
 )
 from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
-from kmeans_tpu.utils.profiling import Timer, trace
+from kmeans_tpu.utils.profiling import Timer, capture, trace
 from kmeans_tpu.utils.retry import RetryError, RetryPolicy
 from kmeans_tpu.utils.rooms import code4, initials, new_card_id, new_centroid_id
 
@@ -21,6 +21,7 @@ __all__ = [
     "RetryError",
     "RetryPolicy",
     "Timer",
+    "capture",
     "trace",
     "code4",
     "initials",

@@ -215,7 +215,23 @@ def save_array_checkpoint(
     step-tagged sibling (``<path>.step-<NNNNNNNN>``) and at most ``keep``
     such dirs survive, newest first — a rolling history for workloads
     where the newest checkpoint being corrupt must not mean starting over.
+
+    The whole write+swap runs under one ``checkpoint_save`` span — THE
+    checkpoint-phase span every producer (runner, streamed fits, serve
+    train jobs) shares, so trace exports attribute save cost uniformly
+    (docs/OBSERVABILITY.md span taxonomy).
     """
+    from kmeans_tpu.obs import tracing as _tracing
+
+    with _tracing.span("checkpoint_save", category="checkpoint",
+                       step=int(step)):
+        return _save_array_checkpoint(path, arrays, step=step,
+                                      config=config, key=key, extra=extra,
+                                      keep=keep)
+
+
+def _save_array_checkpoint(path, arrays, *, step, config, key, extra,
+                           keep) -> str:
     final_path = path
     path = path + ".tmp"
 

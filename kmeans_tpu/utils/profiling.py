@@ -1,33 +1,116 @@
 """Tracing / profiling hooks (SURVEY.md §5.1).
 
 The reference's only diagnostics are two ``console.warn`` sites
-(app.mjs:79,117).  The TPU build gets real tools:
+(app.mjs:79,117).  The TPU build gets real tools, layered on the span
+tracer (:mod:`kmeans_tpu.obs.tracing`):
 
-* :func:`trace` — context manager around ``jax.profiler.trace`` writing a
-  TensorBoard-loadable trace directory (kernel timeline, HBM, MXU util).
-* :class:`Timer` — lightweight named wall-clock sections with a summary,
-  used by the CLI and benchmarks.
+* :func:`capture` — ONE context manager for "where did the time go":
+  enables the span tracer and writes its Chrome trace-event JSON
+  (Perfetto-loadable) on exit, optionally composed with
+  ``jax.profiler.trace`` so a single flag captures both the host span
+  timeline and the device/XLA timeline (the CLI's ``--trace out.json
+  [--xla-trace dir]``).
+* :func:`trace` — the raw ``jax.profiler.trace`` wrapper writing a
+  TensorBoard-loadable trace directory (kernel timeline, HBM, MXU
+  util).  Exception-safe (a failed ``start_trace`` never triggers a
+  spurious ``stop_trace``) and non-reentrant (nested activation is an
+  error: jax keeps ONE global trace, and a nested block would silently
+  stop the outer one's capture).
+* :class:`Timer` — lightweight named wall-clock sections with a
+  summary, used by the CLI and benchmarks.  Each section also opens a
+  ``timer``-category span, so Timer users appear in trace exports for
+  free (and pay one no-op call when tracing is off).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, List, Optional
 
-__all__ = ["trace", "Timer"]
+from kmeans_tpu.obs import tracing as _tracing
+
+__all__ = ["trace", "capture", "Timer"]
+
+_TRACE_LOCK = threading.Lock()
+_TRACE_ACTIVE = False
 
 
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
-    """Profile everything inside the block into ``logdir``."""
+    """Profile everything inside the block into ``logdir``
+    (``jax.profiler.trace``; view in TensorBoard or Perfetto).
+
+    * If ``start_trace`` itself raises (bad logdir, a profiler already
+      running inside jax), the error propagates WITHOUT calling
+      ``stop_trace`` — there is nothing to stop, and stopping would
+      mask the real failure with jax's "no trace running" error.
+    * Nested/concurrent activation raises ``RuntimeError`` up front:
+      jax keeps one process-global trace, so the inner block would
+      silently terminate the outer capture.
+    """
+    global _TRACE_ACTIVE
     import jax
 
-    jax.profiler.start_trace(logdir)
+    with _TRACE_LOCK:
+        if _TRACE_ACTIVE:
+            raise RuntimeError(
+                "profiling.trace is already active in this process; "
+                "jax.profiler keeps ONE global trace, so nested or "
+                "concurrent activation would silently truncate the "
+                "outer capture"
+            )
+        _TRACE_ACTIVE = True
+    started = False
     try:
+        jax.profiler.start_trace(logdir)
+        started = True
         yield
     finally:
-        jax.profiler.stop_trace()
+        with _TRACE_LOCK:
+            _TRACE_ACTIVE = False
+        if started:
+            jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def capture(trace_path: Optional[str] = None, *,
+            xla_dir: Optional[str] = None,
+            name: str = "capture") -> Iterator[None]:
+    """Host spans and/or the device timeline under one flag.
+
+    With ``trace_path``: enables the process span tracer for the block
+    (restoring its previous switch state after), wraps the block in a
+    root ``capture``-category span, and writes the tracer's Chrome
+    trace-event JSON to ``trace_path`` on exit — including on the error
+    path, so a crashed run still leaves its partial timeline behind.
+    With ``xla_dir``: also runs :func:`trace` around the block, so the
+    Perfetto host spans and the XLA device profile cover the same
+    window.  With neither, a plain no-op.
+    """
+    with contextlib.ExitStack() as stack:
+        if xla_dir:
+            stack.enter_context(trace(xla_dir))
+        if trace_path:
+            was_enabled = _tracing.TRACER.enabled
+            if not was_enabled:
+                # A capture starting from a disabled tracer owns the
+                # buffer: clear stale spans from earlier captures in
+                # this process so the export is THIS run's timeline.
+                # (Composing with an already-enabled tracer — the serve
+                # layer — appends instead of clobbering it.)
+                _tracing.TRACER.clear()
+            _tracing.TRACER.enable()
+
+            def _export():
+                _tracing.TRACER.enabled = was_enabled
+                _tracing.TRACER.export_chrome_trace(trace_path)
+
+            stack.callback(_export)
+            stack.enter_context(
+                _tracing.span(name, category="capture"))
+        yield
 
 
 class Timer:
@@ -39,12 +122,13 @@ class Timer:
     @contextlib.contextmanager
     def section(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.sections.setdefault(name, []).append(
-                time.perf_counter() - t0
-            )
+        with _tracing.span(name, category="timer"):
+            try:
+                yield
+            finally:
+                self.sections.setdefault(name, []).append(
+                    time.perf_counter() - t0
+                )
 
     def summary(self) -> Dict[str, dict]:
         out = {}

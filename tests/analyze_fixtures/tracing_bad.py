@@ -1,0 +1,20 @@
+"""Deliberate TRC701/TRC702 violations (tracing-spans fixture)."""
+
+from kmeans_tpu.obs import tracing
+
+
+def leaks_discarded_span():
+    # TRC701: the Span is dropped on the floor — it never ends, so it
+    # never reaches the export.
+    tracing.span("assign", category="assign")
+
+
+def leaks_discarded_start(tracer):
+    # TRC701 via the attribute spelling.
+    tracer.start_span("train_job", category="train")
+
+
+def leaks_unended_binding():
+    s = tracing.start_span("sweep", category="assign")   # TRC702
+    do_work = 1 + 1
+    return do_work

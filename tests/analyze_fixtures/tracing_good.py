@@ -1,0 +1,42 @@
+"""The same operations written correctly (tracing-spans fixture) —
+clean under EVERY analyzer."""
+
+from kmeans_tpu.obs import tracing
+
+
+def context_managed():
+    with tracing.span("assign", category="assign"):
+        return 1 + 1
+
+
+def explicit_end():
+    s = tracing.start_span("train_job", category="train")
+    try:
+        return 1 + 1
+    finally:
+        s.end()
+
+
+def with_on_binding():
+    s = tracing.span("sweep", category="assign")
+    with s:
+        return 1 + 1
+
+
+def escapes_to_caller():
+    # The caller owns the lifecycle — not a leak.
+    return tracing.start_span("job", category="train")
+
+
+def escapes_as_argument(consumer):
+    s = tracing.start_span("job", category="train")
+    consumer(s)
+
+
+def ended_in_nested_callback(schedule):
+    s = tracing.start_span("job", category="train")
+
+    def done():
+        s.end()
+
+    schedule(done)

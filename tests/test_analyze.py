@@ -79,6 +79,7 @@ CASES = [
     ("retrace-risk", "retrace", {"RET201", "RET202", "RET203", "RET204"}),
     ("donation", "donate", {"DON301"}),
     ("lock-discipline", "locks", {"LCK401", "LCK402"}),
+    ("tracing-spans", "tracing", {"TRC701", "TRC702"}),
     ("silent-excepts", "excepts", {"EXC501", "EXC502"}),
 ]
 
@@ -240,8 +241,8 @@ def test_cli_json_output(capsys):
 def test_cli_rules_listing(capsys):
     assert main(["--rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("JIT101", "RET201", "DON301", "LCK401", "EXC501",
-                 "MET601"):
+    for rule in ("JIT101", "RET201", "DON301", "LCK401", "TRC701",
+                 "EXC501", "MET601"):
         assert rule in out
 
 

@@ -1,0 +1,683 @@
+"""Structured tracing tests (docs/OBSERVABILITY.md, tracing section).
+
+Covers the span tracer (nesting, explicit cross-thread propagation,
+thread-safe export validity, ring-buffer eviction, the disabled path's
+near-zero cost — the twin of test_obs.py's registry overhead guard),
+the profiling absorption (Timer-over-spans, exception-safe
+``utils.profiling.trace``), the run_id/trace_id telemetry stamps, the
+CLI ``fit --trace`` acceptance path, the serve layer's ``X-Trace-Id``
+propagation contract, the build-info / scrape-seconds metrics, and
+``tools/trace_view.py``.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import obs
+from kmeans_tpu.obs import tracing
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_global_tracer():
+    """Every test here starts from a disabled, empty GLOBAL tracer —
+    earlier test files may have constructed a KMeansServer (which
+    enables it process-wide) and left spans in the ring."""
+    was = tracing.TRACER.enabled
+    tracing.TRACER.disable()
+    tracing.TRACER.clear()
+    yield
+    tracing.TRACER.enabled = was
+
+
+# ---------------------------------------------------------------------------
+# Span model
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_ids_and_parent_linkage():
+    t = tracing.Tracer(enabled=True)
+    with t.span("outer", category="run") as outer:
+        with t.span("inner", category="assign") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        # sibling after the inner closed: still a child of outer
+        with t.span("inner2", category="update") as inner2:
+            assert inner2.parent_id == outer.span_id
+    spans = t.snapshot()
+    # children complete before parents
+    assert [s.name for s in spans] == ["inner", "inner2", "outer"]
+    assert outer.parent_id is None
+    assert tracing.current_context() is None    # context fully restored
+
+
+def test_explicit_trace_id_roots_the_span():
+    t = tracing.Tracer(enabled=True)
+    with t.span("req", category="http", trace_id="abc123def4567890") as s:
+        assert s.trace_id == "abc123def4567890"
+        assert s.parent_id is None
+
+
+def test_cross_thread_context_handoff():
+    t = tracing.Tracer(enabled=True)
+    seen = {}
+
+    def worker(ctx):
+        with tracing.use_context(ctx):
+            with t.span("train_job", category="train") as s:
+                seen["trace"] = s.trace_id
+                seen["parent"] = s.parent_id
+
+    with t.span("request", category="http") as root:
+        ctx = tracing.current_context()
+        th = threading.Thread(target=worker, args=(ctx,))
+        th.start()
+        th.join()
+    assert seen["trace"] == root.trace_id
+    assert seen["parent"] == root.span_id
+    # a fresh thread with NO handoff starts its own trace
+    def orphan():
+        with t.span("alone") as s:
+            seen["orphan"] = (s.trace_id, s.parent_id)
+
+    th = threading.Thread(target=orphan)
+    th.start()
+    th.join()
+    assert seen["orphan"][0] != root.trace_id
+    assert seen["orphan"][1] is None
+
+
+def test_start_span_does_not_touch_ambient_context():
+    t = tracing.Tracer(enabled=True)
+    with t.span("outer") as outer:
+        s = t.start_span("async_child")
+        assert tracing.current_context().span_id == outer.span_id
+        assert s.parent_id == outer.span_id
+        s.end()
+        s.end()                      # idempotent
+    names = [sp.name for sp in t.snapshot()]
+    assert names.count("async_child") == 1
+
+
+def test_concurrent_threads_export_strict_json():
+    t = tracing.Tracer(enabled=True)
+    n_threads, n_iters = 8, 40
+
+    def work(i):
+        for j in range(n_iters):
+            with t.span("iteration", category="iteration", thread=i,
+                        iteration=j):
+                with t.span("sweep", category="assign"):
+                    pass
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    text = t.export_chrome_trace()
+    doc = json.loads(text)           # strict: raises on any malformation
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == n_threads * n_iters * 2
+    # per-thread containment: within one tid, spans nest or follow
+    by_tid = {}
+    for e in evs:
+        by_tid.setdefault(e["tid"], []).append(e)
+    assert len(by_tid) == n_threads
+    for tid, es in by_tid.items():
+        es.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for e in es:
+            while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"] \
+                    - 1e-3:
+                stack.pop()
+            if stack:
+                assert e["ts"] + e["dur"] <= \
+                    stack[-1]["ts"] + stack[-1]["dur"] + 1e-3
+            stack.append(e)
+
+
+def test_ring_buffer_eviction_keeps_export_consistent():
+    t = tracing.Tracer(capacity=8, enabled=True)
+    for i in range(40):
+        with t.span("outer", category="run", i=i):
+            with t.span("inner", category="assign", i=i):
+                pass
+    assert len(t) == 8
+    doc = json.loads(t.export_chrome_trace())
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == 8
+    by_id = {e["args"]["span_id"]: e for e in evs}
+    for e in evs:
+        parent = e["args"].get("parent_id")
+        if parent is None or parent not in by_id:
+            continue                 # evicted ancestor: allowed, not torn
+        p = by_id[parent]
+        assert p["ts"] <= e["ts"] + 1e-3
+        assert e["ts"] + e["dur"] <= p["ts"] + p["dur"] + 1e-3
+
+
+def test_export_writes_file_and_metadata(tmp_path):
+    t = tracing.Tracer(enabled=True)
+    with t.span("root", category="run", answer=42, bad=float("nan")):
+        pass
+    path = str(tmp_path / "trace.json")
+    t.export_chrome_trace(path)
+    doc = json.loads(open(path, encoding="utf-8").read())
+    assert doc["displayTimeUnit"] == "ms"
+    metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    assert any(e["name"] == "process_name" for e in metas)
+    (root,) = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert root["args"]["answer"] == 42
+    assert root["args"]["bad"] is None      # non-finite stays parseable
+    assert root["cat"] == "run" and root["dur"] >= 0
+
+
+def test_disabled_tracer_records_nothing_and_is_near_free():
+    """The overhead guard, mirroring test_obs.py: a disabled span()
+    callsite costs one attribute check + a shared no-op span — bound it
+    at 5 µs/op so hot loops keep their callsites unconditionally."""
+    t = tracing.Tracer(enabled=False)
+    with t.span("x", category="run"):
+        pass
+    assert len(t) == 0
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with t.span("x"):
+            pass
+    dt = time.perf_counter() - t0
+    assert dt < n * 5e-6, f"{dt / n * 1e6:.2f} µs per disabled span"
+
+
+def test_trace_id_validation():
+    assert tracing.is_trace_id("abcdef0123456789")
+    assert tracing.is_trace_id("a" * 8)
+    assert not tracing.is_trace_id("short")
+    assert not tracing.is_trace_id("not hex chars!!!")
+    assert not tracing.is_trace_id(None)
+    assert not tracing.is_trace_id("a" * 65)
+
+
+# ---------------------------------------------------------------------------
+# Profiling absorption: Timer over spans, exception-safe trace()
+# ---------------------------------------------------------------------------
+
+def test_timer_sections_summarize_and_emit_spans():
+    from kmeans_tpu.utils.profiling import Timer
+
+    tracing.TRACER.clear()
+    tracing.enable()
+    try:
+        tm = Timer()
+        with tm.section("assign"):
+            pass
+        with tm.section("assign"):
+            pass
+        s = tm.summary()["assign"]
+        assert s["count"] == 2 and s["total_s"] >= 0
+        names = [(sp.name, sp.category) for sp in tracing.TRACER.snapshot()]
+        assert names.count(("assign", "timer")) == 2
+    finally:
+        tracing.disable()
+        tracing.TRACER.clear()
+
+
+def test_profiling_trace_safe_when_start_raises(monkeypatch):
+    import jax
+
+    from kmeans_tpu.utils.profiling import trace
+
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda logdir: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append("stop"))
+    with pytest.raises(RuntimeError, match="boom"):
+        with trace("/tmp/nonexistent-trace-dir"):
+            pass
+    # stop_trace must NOT run for a trace that never started
+    assert calls == []
+    # ...and the failed activation released the guard: a later trace works
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda logdir: calls.append("start"))
+    with trace("/tmp/nonexistent-trace-dir"):
+        pass
+    assert calls == ["start", "stop"]
+
+
+def test_profiling_trace_rejects_nested_activation(monkeypatch, tmp_path):
+    import jax
+
+    from kmeans_tpu.utils.profiling import trace
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda logdir: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    with trace(str(tmp_path)):
+        with pytest.raises(RuntimeError, match="already active"):
+            with trace(str(tmp_path)):
+                pass
+    # the outer exit released the guard
+    with trace(str(tmp_path)):
+        pass
+
+
+def test_capture_restores_tracer_state_and_exports(tmp_path):
+    from kmeans_tpu.utils.profiling import capture
+
+    tracing.TRACER.clear()
+    assert not tracing.enabled()
+    out = str(tmp_path / "cap.json")
+    with capture(out, name="test_capture"):
+        assert tracing.enabled()
+        with tracing.span("work", category="assign"):
+            pass
+    assert not tracing.enabled()            # restored
+    doc = json.loads(open(out, encoding="utf-8").read())
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert {"test_capture", "work"} <= names
+    tracing.TRACER.clear()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry stamps: run_id per writer, trace_id from ambient context
+# ---------------------------------------------------------------------------
+
+def test_telemetry_run_id_separates_appended_runs(tmp_path):
+    import jax
+
+    from kmeans_tpu.models.runner import LloydRunner
+    from kmeans_tpu.obs import TelemetryWriter, read_events, \
+        summarize_by_run
+
+    x = np.random.default_rng(0).normal(size=(300, 2)).astype(np.float32)
+    path = str(tmp_path / "runs.jsonl")
+    for i, append in enumerate((False, True)):
+        r = LloydRunner(x, 3, key=jax.random.key(i))
+        r.init()
+        with TelemetryWriter(path, append=append) as tw:
+            r.run(max_iter=3, telemetry=tw)
+    events = read_events(path)
+    runs = {e["run_id"] for e in events}
+    assert len(runs) == 2
+    by_run = summarize_by_run(events)
+    assert set(by_run) == runs
+    for summary in by_run.values():
+        assert summary["count"] == 3
+
+
+def test_telemetry_trace_id_stamped_from_ambient_span(tmp_path):
+    import io
+
+    from kmeans_tpu.obs import TelemetryWriter
+
+    tracing.enable()
+    try:
+        buf = io.StringIO()
+        with TelemetryWriter(buf) as tw:
+            with tracing.span("run", category="run") as s:
+                tw.event("iter", seconds=0.1)
+            tw.event("outside")
+        lines = [json.loads(ln) for ln in
+                 buf.getvalue().strip().splitlines()]
+        assert lines[0]["trace_id"] == s.trace_id
+        assert "trace_id" not in lines[1]
+        assert lines[0]["run_id"] == lines[1]["run_id"]
+    finally:
+        tracing.disable()
+        tracing.TRACER.clear()
+
+
+# ---------------------------------------------------------------------------
+# CLI: the acceptance criterion
+# ---------------------------------------------------------------------------
+
+def test_cli_fit_trace_writes_perfetto_json_with_phase_categories(
+        tmp_path):
+    """Acceptance: ``fit --trace out.json`` writes valid Chrome
+    trace-event JSON containing at least compile, iteration, and update
+    span categories."""
+    from kmeans_tpu import cli
+
+    out = str(tmp_path / "out.json")
+    rc = cli.main(["fit", "--n", "2000", "--d", "8", "--k", "3",
+                   "--trace", out])
+    assert rc == 0
+    doc = json.loads(open(out, encoding="utf-8").read())   # strict
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    cats = {e["cat"] for e in evs}
+    assert {"compile", "iteration", "update"} <= cats, cats
+    assert {"run", "host_sync"} <= cats
+    # every span of the run shares ONE trace id
+    assert len({e["args"]["trace_id"] for e in evs}) == 1
+    assert not tracing.enabled()     # the capture restored the switch
+
+
+def test_cli_fit_trace_and_telemetry_cross_reference(tmp_path):
+    from kmeans_tpu import cli
+    from kmeans_tpu.obs import read_events
+
+    out = str(tmp_path / "out.json")
+    tel = str(tmp_path / "run.jsonl")
+    rc = cli.main(["fit", "--n", "1500", "--d", "4", "--k", "3",
+                   "--trace", out, "--telemetry", tel])
+    assert rc == 0
+    doc = json.loads(open(out, encoding="utf-8").read())
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    trace_ids = {e["args"]["trace_id"] for e in evs}
+    (run_span,) = [e for e in evs if e["name"] == "lloyd.run"]
+    events = read_events(tel)
+    iters = [e for e in events if e["event"] == "iter"]
+    assert iters
+    for e in events:
+        # every telemetry event cross-references the span export
+        assert e["trace_id"] in trace_ids
+        assert e["run_id"] == run_span["args"]["run_id"]
+
+
+def test_cli_stream_trace_rides_streamed_fit(tmp_path):
+    from kmeans_tpu import cli
+
+    data = np.random.default_rng(0).normal(size=(1000, 3)) \
+        .astype(np.float32)
+    npy = str(tmp_path / "x.npy")
+    np.save(npy, data)
+    out = str(tmp_path / "stream.json")
+    rc = cli.main(["train", "--stream", "--input", npy, "--k", "2",
+                   "--steps", "4", "--batch-size", "128",
+                   "--trace", out])
+    assert rc == 0
+    doc = json.loads(open(out, encoding="utf-8").read())
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in evs}
+    assert "fit_minibatch_stream" in names
+    steps = [e for e in evs if e["name"] == "step"]
+    assert len(steps) == 4
+    cats = {e["cat"] for e in evs}
+    assert "compile" in cats         # the first step's dispatch
+    # the run span owns the WHOLE fit's time: steps AND the final
+    # labeling pass nest inside it (matching LloydRunner's
+    # finalize-inside-run)
+    (fit,) = [e for e in evs if e["name"] == "fit_minibatch_stream"]
+    (final,) = [e for e in evs if e["name"] == "final_pass"]
+    for child in steps + [final]:
+        assert fit["ts"] <= child["ts"] + 1e-3
+        assert child["ts"] + child["dur"] <= fit["ts"] + fit["dur"] + 1e-3
+        assert child["args"]["trace_id"] == fit["args"]["trace_id"]
+
+
+def test_cli_trace_requires_step_paced_loop(tmp_path, capsys):
+    from kmeans_tpu import cli
+
+    rc = cli.main(["fit", "--model", "gmm", "--n", "100", "--d", "2",
+                   "--k", "2", "--trace", str(tmp_path / "x.json")])
+    assert rc == 2
+    assert "step-paced" in capsys.readouterr().err
+
+
+def test_cli_trace_unwritable_path_fails_before_fit(tmp_path, capsys):
+    """Same contract as --telemetry: an unwritable --trace path is one
+    actionable line + exit 2 BEFORE any fit work (the export only opens
+    the file at capture exit, which would discard a finished fit)."""
+    from kmeans_tpu import cli
+
+    rc = cli.main(["fit", "--n", "300", "--d", "2", "--k", "2",
+                   "--trace", str(tmp_path / "no_such_dir" / "out.json")])
+    assert rc == 2
+    assert "cannot write trace" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# tools/trace_view.py
+# ---------------------------------------------------------------------------
+
+def test_trace_view_renders_flamegraph_and_flat(tmp_path, capsys):
+    from tools import trace_view
+
+    t = tracing.Tracer(enabled=True)
+    for i in range(3):
+        with t.span("iteration", category="iteration", i=i):
+            with t.span("sweep", category="assign"):
+                pass
+    path = str(tmp_path / "t.json")
+    t.export_chrome_trace(path)
+    assert trace_view.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "iteration [iteration] ×3" in out
+    assert "sweep [assign]" in out
+    assert trace_view.main([path, "--flat"]) == 0
+    out = capsys.readouterr().out
+    assert "iteration" in out and "assign" in out
+    # malformed input: one actionable line, exit 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{torn", encoding="utf-8")
+    assert trace_view.main([str(bad)]) == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Serve: X-Trace-Id propagation, /api/trace, build-info + scrape metrics
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def server(tmp_path):
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.serve import KMeansServer
+
+    was = tracing.enabled()
+    s = KMeansServer(ServeConfig(
+        host="127.0.0.1", port=0,
+        telemetry_path=str(tmp_path / "trains.jsonl")))
+    httpd = s.start(background=True)
+    s.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    s.telemetry_file = str(tmp_path / "trains.jsonl")
+    yield s
+    s.stop()
+    tracing.TRACER.enabled = was
+
+
+def _get(server, path, headers=None):
+    req = urllib.request.Request(server.base + path,
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _post(server, path, obj):
+    req = urllib.request.Request(
+        server.base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def test_every_response_carries_a_trace_id(server):
+    _, headers, _ = _get(server, "/api/state?room=TRCA")
+    assert tracing.is_trace_id(headers["X-Trace-Id"])
+
+
+def test_wellformed_incoming_trace_id_is_adopted(server):
+    mine = "feedfacecafe0123"
+    _, headers, _ = _get(server, "/api/state?room=TRCA",
+                         headers={"X-Trace-Id": mine})
+    assert headers["X-Trace-Id"] == mine
+    # garbage is replaced, never echoed
+    _, headers, _ = _get(server, "/api/state?room=TRCA",
+                         headers={"X-Trace-Id": "<script>alert(1)"})
+    assert headers["X-Trace-Id"] != "<script>alert(1)"
+    assert tracing.is_trace_id(headers["X-Trace-Id"])
+
+
+def test_server_stop_restores_tracer_switch():
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.serve import KMeansServer
+
+    assert not tracing.enabled()     # the autouse fixture disabled it
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0))
+    httpd = s.start(background=True)
+    assert tracing.enabled()
+    s.stop()
+    assert not tracing.enabled()     # no leaked process-global switch
+    del httpd
+
+
+def test_overlapping_servers_refcount_the_tracer():
+    """The first stop() must not kill tracing under a still-running
+    second server; the LAST release restores the pre-first-hold state."""
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.serve import KMeansServer
+
+    a = KMeansServer(ServeConfig(host="127.0.0.1", port=0))
+    a.start(background=True)
+    b = KMeansServer(ServeConfig(host="127.0.0.1", port=0))
+    b.start(background=True)
+    assert tracing.enabled()
+    a.stop()
+    assert tracing.enabled()         # b still holds the tracer
+    b.stop()
+    assert not tracing.enabled()
+
+
+def test_unstarted_server_does_not_touch_the_tracer():
+    """Construct-only use (driving the room table directly) must not
+    flip process-global tracer state it has no stop() to undo."""
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.serve import KMeansServer
+
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0))
+    s.room("NOPE")
+    assert not tracing.enabled()
+    s.stop()                         # harmless without a start
+    assert not tracing.enabled()
+    del s
+
+
+def test_failed_server_construction_leaves_no_tracer_state(tmp_path):
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.serve import KMeansServer
+
+    with pytest.raises(ValueError, match="not writable"):
+        KMeansServer(ServeConfig(
+            host="127.0.0.1", port=0,
+            telemetry_path=str(tmp_path / "no_dir" / "t.jsonl")))
+    assert not tracing.enabled()     # nothing leaked from the failure
+
+
+def test_train_request_trace_id_joins_telemetry_and_spans(server):
+    """Acceptance: the train response's X-Trace-Id appears in the run's
+    telemetry JSONL and in the exported spans."""
+    room = "TRCB"
+    status, headers, body = _post(
+        server, f"/api/mutate?room={room}",
+        {"op": "train", "args": {"n": 1500, "d": 2, "k": 3,
+                                 "max_iter": 6, "seed": 7}})
+    assert status == 200 and body["started"] is True
+    tid = headers["X-Trace-Id"]
+    assert body["trace_id"] == tid
+    run_id = body["run_id"]
+
+    deadline = time.time() + 120.0
+    while time.time() < deadline:
+        if not server.rooms[room].train_lock.locked() and \
+                os.path.exists(server.telemetry_file):
+            break
+        time.sleep(0.05)
+    assert not server.rooms[room].train_lock.locked(), "train never ended"
+
+    from kmeans_tpu.obs import read_events
+
+    events = read_events(server.telemetry_file)
+    mine = [e for e in events if e.get("run_id") == run_id]
+    assert mine, "train job wrote no telemetry"
+    assert any(e["event"] == "run_done" for e in mine)
+    assert all(e.get("trace_id") == tid for e in mine)
+
+    # the same id appears in the span export (GET /api/trace)
+    _, _, raw = _get(server, "/api/trace")
+    doc = json.loads(raw.decode())
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    job = [e for e in evs if e["args"].get("trace_id") == tid]
+    cats = {e["cat"] for e in job}
+    assert {"train", "iteration"} <= cats, cats
+    (train_span,) = [e for e in job if e["cat"] == "train"]
+    assert train_span["args"]["run_id"] == run_id
+
+
+def test_train_sse_events_carry_run_and_trace_ids(server):
+    room = "TRCC"
+    sub_room = server.room(room)
+    sid, q = sub_room.subscribe()
+    try:
+        _, headers, body = _post(
+            server, f"/api/mutate?room={room}",
+            {"op": "train", "args": {"n": 800, "d": 2, "k": 2,
+                                     "max_iter": 4, "seed": 1}})
+        tid, run_id = headers["X-Trace-Id"], body["run_id"]
+        deadline = time.time() + 120.0
+        saw_done = False
+        while time.time() < deadline and not saw_done:
+            try:
+                ev = q.get(timeout=1.0)
+            except Exception:
+                continue
+            if ev.get("type", "").startswith("train"):
+                assert ev["run_id"] == run_id
+                assert ev["trace_id"] == tid
+                saw_done = ev["type"] in ("train_done", "train_error")
+        assert saw_done, "no train_done/train_error event observed"
+    finally:
+        sub_room.unsubscribe(sid)
+
+
+def test_metrics_exposes_build_info_and_scrape_histogram(server):
+    # The build-info child seeds in the first TRAIN worker (resolving
+    # the backend label initializes the jax runtime, which a board-only
+    # serve process must not do at construction) — run one tiny job.
+    room = "TRCM"
+    _post(server, f"/api/mutate?room={room}",
+          {"op": "train", "args": {"n": 300, "d": 2, "k": 2,
+                                   "max_iter": 2, "seed": 0}})
+    deadline = time.time() + 120.0
+    while time.time() < deadline and server.rooms[room].train_lock.locked():
+        time.sleep(0.05)
+    _get(server, "/metrics")         # first scrape observes nothing yet
+    _, _, raw = _get(server, "/metrics")
+    text = raw.decode()
+    assert "kmeans_tpu_build_info{" in text
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("kmeans_tpu_build_info{")][0]
+    assert 'version="' in line and 'backend="' in line
+    assert line.rstrip().endswith(" 1")
+    count = [ln for ln in text.splitlines()
+             if ln.startswith("kmeans_tpu_metrics_scrape_seconds_count")]
+    assert count and float(count[0].split()[-1]) >= 1
+
+
+def test_api_trace_can_be_disabled(tmp_path):
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.serve import KMeansServer
+
+    was = tracing.enabled()
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0, tracing=False))
+    httpd = s.start(background=True)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.server_address[1]}/api/trace",
+                timeout=10)
+        assert ei.value.code == 404
+    finally:
+        s.stop()
+        tracing.TRACER.enabled = was

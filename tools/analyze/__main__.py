@@ -46,8 +46,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tools.analyze",
         description="Static analysis: jit hygiene, retrace risk, buffer "
-                    "donation, lock discipline, silent excepts, metrics "
-                    "catalog.")
+                    "donation, lock discipline, span leaks, silent "
+                    "excepts, metrics catalog.")
     p.add_argument("paths", nargs="*",
                    help="restrict the scan to these files/dirs "
                         "(repo-relative)")

@@ -15,12 +15,14 @@ def all_analyzers() -> List[Analyzer]:
     from tools.analyze.plugins.locks import LockDisciplineAnalyzer
     from tools.analyze.plugins.metrics_catalog import MetricsCatalogAnalyzer
     from tools.analyze.plugins.retrace import RetraceAnalyzer
+    from tools.analyze.plugins.tracing_spans import TracingSpansAnalyzer
 
     return [
         JitHygieneAnalyzer(),
         RetraceAnalyzer(),
         DonationAnalyzer(),
         LockDisciplineAnalyzer(),
+        TracingSpansAnalyzer(),
         ExceptsAnalyzer(),
         MetricsCatalogAnalyzer(),
     ]

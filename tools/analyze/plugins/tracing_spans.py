@@ -1,0 +1,158 @@
+"""tracing-spans: spans must end (the TRC70x span-leak lint).
+
+A :mod:`kmeans_tpu.obs.tracing` span only reaches the ring buffer (and
+therefore the Perfetto export) when it ENDS — a ``span(...)`` whose
+result is discarded, or a ``start_span(...)`` that is never ``.end()``-ed
+and never escapes the function, times nothing and silently punches a
+hole in the trace.  Worse, a ``with``-less ``span()`` that IS entered
+manually would leak its ambient-context token.
+
+* TRC701 — ``span(...)`` / ``start_span(...)`` called as a bare
+  expression statement: the Span is dropped on the floor.  Use
+  ``with span(...):`` or assign it and ``.end()`` it.
+* TRC702 — a name bound to ``span(...)`` / ``start_span(...)`` with no
+  reachable ``<name>.end()``, ``with <name>`` use, or escape (returned /
+  yielded / passed as an argument / stored on an object or container /
+  aliased) in the enclosing function.
+
+Matching is by callee name (``span`` / ``start_span``, bare or as an
+attribute — ``tracing.span``, ``TRACER.start_span``), the same
+convention the codebase uses; a module defining an unrelated ``span``
+function can suppress with the standard marker.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from tools.analyze.core import Analyzer, Finding, Rule
+
+RULES = [
+    Rule("TRC701", "error",
+         "span(...) result discarded (not a context manager)",
+         "A dropped Span never ends, so it never reaches the trace "
+         "export — use `with span(...):` or assign and `.end()` it."),
+    Rule("TRC702", "error",
+         "start_span(...)/span(...) bound to a name that is never "
+         "ended",
+         "A started span with no reachable `.end()` (and no escape "
+         "out of the function) is a span leak: it times nothing and "
+         "vanishes from the export."),
+]
+
+_SPAN_CALLEES = ("span", "start_span")
+
+
+def _callee(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_span_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and _callee(node) in _SPAN_CALLEES)
+
+
+def _bindings_by_scope(tree: ast.AST):
+    """``(scope, assign)`` pairs: every simple-name span binding with its
+    NEAREST enclosing function (each binding judged exactly once; the
+    liveness search still sees nested closures, so an ``.end()`` inside
+    a callback defined in the same function counts)."""
+    out = []
+
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, child)
+                continue
+            if (scope is not None and isinstance(child, ast.Assign)
+                    and len(child.targets) == 1
+                    and isinstance(child.targets[0], ast.Name)
+                    and _is_span_call(child.value)):
+                out.append((scope, child))
+            visit(child, scope)
+
+    visit(tree, None)
+    return out
+
+
+def _name_is_ended_or_escapes(scope: ast.AST, name: str,
+                              binding: ast.Assign) -> bool:
+    """Whether ``name`` (bound to a span at ``binding``) is ended, used
+    as a context manager, or escapes the scope — any of which makes the
+    binding fine."""
+    for node in ast.walk(scope):
+        # <name>.end()
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "end"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name):
+            return True
+        # with <name>: ...   (Span.__exit__ ends it)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Name) \
+                        and item.context_expr.id == name:
+                    return True
+        # escapes: returned / yielded / argument / stored / aliased
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and node.value is not None:
+            if any(isinstance(n, ast.Name) and n.id == name
+                   for n in ast.walk(node.value)):
+                return True
+        if isinstance(node, ast.Call) and node is not binding.value:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if any(isinstance(n, ast.Name) and n.id == name
+                       for n in ast.walk(arg)):
+                    return True
+        if isinstance(node, ast.Assign) and node is not binding:
+            if any(isinstance(n, ast.Name) and n.id == name
+                   for n in ast.walk(node.value)):
+                return True           # aliased / stored in a container
+    return False
+
+
+def scan_tree(tree: ast.AST) -> List[Tuple[str, int, str]]:
+    """``(rule_id, lineno, message)`` span leaks in one parsed module."""
+    out: List[Tuple[str, int, str]] = []
+    # TRC701: bare expression statements anywhere (module level too).
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) and _is_span_call(node.value):
+            out.append((
+                "TRC701", node.lineno,
+                f"`{_callee(node.value)}(...)` result discarded — the "
+                "span never ends and never reaches the trace export; "
+                "use `with ...:` or assign and `.end()` it",
+            ))
+    # TRC702: per-function liveness of simple-name span bindings.
+    # (Module-level and attribute-target bindings are long-lived by
+    # design — a process-wide span a signal handler ends — and skipped.)
+    for scope, node in _bindings_by_scope(tree):
+        name = node.targets[0].id
+        if not _name_is_ended_or_escapes(scope, name, node):
+            out.append((
+                "TRC702", node.lineno,
+                f"span bound to `{name}` is never ended — no "
+                f"`{name}.end()`, `with {name}:`, or escape in "
+                f"`{scope.name}` (span leak)",
+            ))
+    return out
+
+
+class TracingSpansAnalyzer(Analyzer):
+    name = "tracing-spans"
+    rules = RULES
+    #: Where spans live: the engine package and the bench harness
+    #: (tools/trace_view.py only READS exports).
+    scope = ("kmeans_tpu/", "bench.py")
+
+    def check_source(self, src) -> List[Finding]:
+        sev = {r.id: r.severity for r in RULES}
+        return [Finding(rule_id, sev[rule_id], src.rel, lineno, msg)
+                for rule_id, lineno, msg in scan_tree(src.tree)]

@@ -1,0 +1,187 @@
+"""Compact text flamegraph for Chrome trace-event JSON.
+
+Renders the span timelines written by ``kmeans_tpu.cli fit --trace``,
+``bench.py --trace``, and the serve layer's ``GET /api/trace`` (all
+produced by :mod:`kmeans_tpu.obs.tracing`) without leaving the
+terminal — Perfetto (https://ui.perfetto.dev) remains the interactive
+viewer; this is the grep-able one.
+
+Spans nest by time containment per (pid, tid), exactly as Perfetto
+draws them, and repeated siblings with the same (name, category)
+collapse into one line with a count — a 200-iteration fit reads as four
+lines, not eight hundred.  A span whose parent was evicted from the
+tracer's ring buffer simply surfaces as a root; nothing dangles.
+
+Usage:
+    python tools/trace_view.py out.json               # flamegraph
+    python tools/trace_view.py out.json --flat        # per-category totals
+    python tools/trace_view.py out.json --min-us 500  # hide tiny spans
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_events", "build_forest", "aggregate", "render",
+           "render_flat"]
+
+
+def load_events(path: str) -> List[dict]:
+    """The ``ph == "X"`` complete events of one trace file (bare-list
+    and ``{"traceEvents": [...]}`` layouts both accepted)."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome trace-event document")
+    return [e for e in events if isinstance(e, dict) and e.get("ph") == "X"]
+
+
+class Node:
+    __slots__ = ("name", "cat", "ts", "dur", "children")
+
+    def __init__(self, name: str, cat: str, ts: float, dur: float):
+        self.name = name
+        self.cat = cat
+        self.ts = ts
+        self.dur = dur
+        self.children: List["Node"] = []
+
+
+def build_forest(events: List[dict]) -> Dict[Tuple, List[Node]]:
+    """``{(pid, tid): [root nodes]}`` nested by time containment.
+
+    Within one thread, spans either nest or follow each other (the
+    tracer's spans come from ``with`` blocks / start-end pairs), so a
+    containment stack reconstructs the tree without parent pointers —
+    which also makes ring-buffer eviction harmless here.
+    """
+    by_thread: Dict[Tuple, List[dict]] = {}
+    for e in events:
+        by_thread.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    forest: Dict[Tuple, List[Node]] = {}
+    for key, evs in sorted(by_thread.items(), key=lambda kv: str(kv[0])):
+        evs.sort(key=lambda e: (float(e.get("ts", 0)),
+                                -float(e.get("dur", 0))))
+        roots: List[Node] = []
+        stack: List[Node] = []
+        for e in evs:
+            node = Node(str(e.get("name", "?")), str(e.get("cat", "?")),
+                        float(e.get("ts", 0)), float(e.get("dur", 0)))
+            while stack and node.ts >= stack[-1].ts + stack[-1].dur:
+                stack.pop()
+            (stack[-1].children if stack else roots).append(node)
+            stack.append(node)
+        forest[key] = roots
+    return forest
+
+
+class Agg:
+    __slots__ = ("name", "cat", "count", "total", "max", "children")
+
+    def __init__(self, name: str, cat: str):
+        self.name = name
+        self.cat = cat
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.children: Dict[Tuple[str, str], "Agg"] = {}
+
+
+def aggregate(nodes: List[Node],
+              into: Optional[Dict[Tuple[str, str], Agg]] = None
+              ) -> Dict[Tuple[str, str], Agg]:
+    """Collapse sibling nodes by (name, cat), recursively."""
+    table = {} if into is None else into
+    for n in nodes:
+        a = table.get((n.name, n.cat))
+        if a is None:
+            a = table[(n.name, n.cat)] = Agg(n.name, n.cat)
+        a.count += 1
+        a.total += n.dur
+        a.max = max(a.max, n.dur)
+        aggregate(n.children, a.children)
+    return table
+
+
+def _fmt_us(us: float) -> str:
+    if us >= 1e6:
+        return f"{us / 1e6:.3f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.2f}ms"
+    return f"{us:.0f}µs"
+
+
+def render(forest: Dict[Tuple, List[Node]], *, min_us: float = 0.0,
+           out=None) -> None:
+    out = out or sys.stdout
+    for (pid, tid), roots in forest.items():
+        print(f"=== pid {pid} tid {tid} ===", file=out)
+        _render_aggs(aggregate(roots), 0, min_us, out)
+
+
+def _render_aggs(table: Dict[Tuple[str, str], Agg], depth: int,
+                 min_us: float, out) -> None:
+    rows = sorted(table.values(), key=lambda a: -a.total)
+    for a in rows:
+        if a.total < min_us:
+            continue
+        mult = f" ×{a.count}" if a.count > 1 else ""
+        peak = f" (max {_fmt_us(a.max)})" if a.count > 1 else ""
+        print(f"{'  ' * depth}{a.name} [{a.cat}]{mult}  "
+              f"{_fmt_us(a.total)}{peak}", file=out)
+        _render_aggs(a.children, depth + 1, min_us, out)
+
+
+def render_flat(events: List[dict], *, out=None) -> None:
+    """Total/count per category — the "which phase ate the time" table
+    (categories are the span taxonomy: compile / assign / update /
+    host_sync / checkpoint / ...; docs/OBSERVABILITY.md)."""
+    out = out or sys.stdout
+    totals: Dict[str, List[float]] = {}
+    for e in events:
+        t = totals.setdefault(str(e.get("cat", "?")), [0.0, 0.0])
+        t[0] += float(e.get("dur", 0))
+        t[1] += 1
+    width = max((len(c) for c in totals), default=8)
+    print(f"{'category'.ljust(width)}  {'total':>10}  {'count':>6}",
+          file=out)
+    for cat, (total, count) in sorted(totals.items(),
+                                      key=lambda kv: -kv[1][0]):
+        print(f"{cat.ljust(width)}  {_fmt_us(total):>10}  {int(count):>6}",
+              file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/trace_view.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("path", help="Chrome trace-event JSON "
+                                "(fit --trace / bench --trace / "
+                                "GET /api/trace)")
+    p.add_argument("--min-us", type=float, default=0.0,
+                   help="hide aggregated rows totalling under this many "
+                        "microseconds")
+    p.add_argument("--flat", action="store_true",
+                   help="per-category totals instead of the flamegraph")
+    args = p.parse_args(argv)
+    try:
+        events = load_events(args.path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.path!r}: {e}", file=sys.stderr)
+        return 2
+    if not events:
+        print("(no spans in trace)", file=sys.stderr)
+        return 0
+    if args.flat:
+        render_flat(events)
+    else:
+        render(build_forest(events), min_us=args.min_us)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

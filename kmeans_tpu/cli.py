@@ -708,6 +708,134 @@ def _cmd_sweep(args) -> int:
     return 0
 
 
+def _cmd_continuous(args) -> int:
+    """Run the drift-aware continuous pipeline (docs/RESILIENCE.md).
+
+    Emits one JSON line per generation publish on stdout (the soak
+    driver's wire format) and a final ``done`` line; ``--progress`` adds
+    one line per batch on stderr.  Exit 3 on preemption with the resume
+    hint, like every long-running fit.
+    """
+    import functools
+
+    import numpy as np
+
+    from kmeans_tpu.continuous import (
+        ContinuousConfig,
+        ContinuousPipeline,
+        ModelRegistry,
+        drift_batch,
+    )
+
+    if args.resume and not args.model_dir:
+        print("error: --resume requires --model-dir (the registry "
+              "checkpoint directory)", file=sys.stderr)
+        return 2
+    if args.batches < 1:
+        print("error: --batches must be >= 1", file=sys.stderr)
+        return 2
+
+    if args.input:
+        x = _load_npy(args.input)
+        if x is None:
+            return 2
+        if x.ndim != 2:
+            print(f"error: {args.input} must be a 2-D array",
+                  file=sys.stderr)
+            return 2
+        n = x.shape[0]
+        if n < args.batch_n:
+            print(f"error: {args.input} has {n} rows < --batch-n "
+                  f"{args.batch_n}", file=sys.stderr)
+            return 2
+
+        def source(t, _x=x, _n=n):
+            # Sequential chunks, cycling — batch t is a pure function of
+            # t, so --resume replays the stream exactly.
+            lo = (t * args.batch_n) % _n
+            idx = (np.arange(args.batch_n) + lo) % _n
+            return np.ascontiguousarray(_x[idx], dtype=np.float32)
+    else:
+        source = functools.partial(
+            drift_batch, n=args.batch_n, d=args.d,
+            k=args.stream_k if args.stream_k is not None else args.k,
+            seed=args.stream_seed, drift_at=args.drift_at,
+            drift=args.drift, drift_len=args.drift_len,
+            cluster_std=args.cluster_std,
+        )
+
+    cfg = ContinuousConfig(
+        k=args.k, window_batches=args.window_batches,
+        compact_above=args.compact_above, coreset_size=args.coreset,
+        refit_iters=args.refit_iters, drift_ratio=args.drift_ratio,
+        ewma_alpha=args.ewma_alpha, ewma_k_sigma=args.ewma_k_sigma,
+        min_refit_batches=args.min_refit_batches,
+        refit_every=args.refit_every,
+        warmup_batches=args.warmup_batches, seed=args.seed,
+    )
+    try:
+        cfg.validate()
+        registry = ModelRegistry(path=args.model_dir or None,
+                                 keep=args.checkpoint_keep)
+        pipe = ContinuousPipeline(source, cfg, registry=registry,
+                                  resume=args.resume)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.resume:
+        # The soak driver's recovery clock stops at this line: the
+        # verified generation is restored and serving could resume.
+        print(json.dumps({
+            "event": "resumed", "generation": registry.generation,
+            "batch_idx": pipe.batch_idx, "ts": round(time.time(), 6),
+        }), flush=True)
+
+    seen = [registry.generation]
+
+    def on_batch(info):
+        if registry.generation != seen[0]:
+            seen[0] = registry.generation
+            print(json.dumps({
+                "event": "generation", "generation": seen[0],
+                "trigger": info.refit, "batch": info.batch,
+                "inertia_pp": info.inertia_pp,
+                "ts": round(time.time(), 6),
+            }), flush=True)
+        if args.progress:
+            print(json.dumps({"event": "batch", **info.as_dict()}),
+                  file=sys.stderr)
+
+    tw = None
+    if args.telemetry:
+        from kmeans_tpu import obs
+
+        try:
+            obs.probe_writable(args.telemetry)
+        except OSError as e:
+            print(f"error: cannot write telemetry to {args.telemetry!r}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        from kmeans_tpu.obs import TelemetryWriter
+
+        tw = TelemetryWriter(args.telemetry, append=True)
+    try:
+        try:
+            gen = pipe.run(args.batches, callback=on_batch, telemetry=tw)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    finally:
+        if tw is not None:
+            tw.close()
+    print(json.dumps({
+        "event": "done", "batches": pipe.batch_idx,
+        "generation": registry.generation,
+        "trigger": gen.trigger if gen is not None else None,
+        "ts": round(time.time(), 6),
+    }), flush=True)
+    return 0
+
+
 def _cmd_serve(args) -> int:
     from kmeans_tpu.serve import serve
 
@@ -720,7 +848,8 @@ def _cmd_serve(args) -> int:
         serve(args.host, args.port, background=False,
               persist_dir=args.persist_dir or None,
               metrics=args.metrics,
-              telemetry_path=args.telemetry)
+              telemetry_path=args.telemetry,
+              model_dir=args.model_dir or None)
     except KeyboardInterrupt:
         pass
     except ValueError as e:
@@ -879,6 +1008,66 @@ def main(argv=None) -> int:
     w.add_argument("--silhouette-sample", type=int, default=10_000)
     w.set_defaults(fn=_cmd_sweep)
 
+    c = sub.add_parser(
+        "continuous",
+        help="run the drift-aware continuous clustering pipeline",
+    )
+    c.add_argument("--k", type=int, default=4)
+    c.add_argument("--batches", type=int, default=60,
+                   help="total stream length in batches (absolute; a "
+                        "--resume continues from the checkpointed "
+                        "position toward this total)")
+    c.add_argument("--model-dir", default=None, metavar="DIR",
+                   help="model-registry checkpoint directory (verified "
+                        "v2; each generation publishes here atomically); "
+                        "serve --model-dir points at the same directory")
+    c.add_argument("--resume", action="store_true",
+                   help="restore the newest verified generation from "
+                        "--model-dir and replay the stream from its "
+                        "recorded position")
+    c.add_argument("--input", help="path to a .npy (n, d) matrix streamed "
+                                   "as cycling sequential chunks (default: "
+                                   "synthetic drifting blobs)")
+    c.add_argument("--batch-n", type=int, default=512,
+                   help="rows per stream batch")
+    c.add_argument("--d", type=int, default=8)
+    c.add_argument("--stream-k", type=int, default=None,
+                   help="generating cluster count of the synthetic "
+                        "stream (default: --k)")
+    c.add_argument("--stream-seed", type=int, default=0)
+    c.add_argument("--drift-at", type=int, default=30,
+                   help="batch index where the synthetic centers drift")
+    c.add_argument("--drift", type=float, default=6.0,
+                   help="drift offset norm per center")
+    c.add_argument("--drift-len", type=int, default=0,
+                   help="batches the drift glides over (0 = abrupt)")
+    c.add_argument("--cluster-std", type=float, default=0.6)
+    c.add_argument("--window-batches", type=int, default=8)
+    c.add_argument("--compact-above", type=int, default=32768,
+                   help="window point count that triggers coreset "
+                        "compaction")
+    c.add_argument("--coreset", type=int, default=4096,
+                   help="compacted window coreset size")
+    c.add_argument("--refit-iters", type=int, default=25)
+    c.add_argument("--drift-ratio", type=float, default=0.25)
+    c.add_argument("--ewma-alpha", type=float, default=0.3)
+    c.add_argument("--ewma-k-sigma", type=float, default=6.0)
+    c.add_argument("--min-refit-batches", type=int, default=2)
+    c.add_argument("--refit-every", type=int, default=10,
+                   help="scheduled refit cadence in batches since the "
+                        "last refit (0 disables; drift triggers still "
+                        "fire)")
+    c.add_argument("--warmup-batches", type=int, default=2)
+    c.add_argument("--checkpoint-keep", type=int, default=2,
+                   help="step-tagged retention dirs kept per generation "
+                        "checkpoint")
+    c.add_argument("--seed", type=int, default=0)
+    c.add_argument("--progress", action="store_true",
+                   help="print one JSON line per batch to stderr")
+    c.add_argument("--telemetry", metavar="OUT.jsonl",
+                   help="append one JSON telemetry event per batch")
+    c.set_defaults(fn=_cmd_continuous)
+
     s = sub.add_parser("serve", help="run the HTTP/SSE visualizer server")
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=8787)
@@ -895,6 +1084,12 @@ def main(argv=None) -> int:
                         "(run_id/trace_id-stamped, so concurrent jobs "
                         "stay separable) to this file "
                         "(docs/OBSERVABILITY.md)")
+    s.add_argument("--model-dir", default=None, metavar="DIR",
+                   help="serve /api/assign from the model-registry "
+                        "checkpoints in DIR (the continuous "
+                        "subcommand's --model-dir; newest verified "
+                        "generation restored at boot, POST "
+                        "/api/model/reload picks up new ones)")
     s.set_defaults(fn=_cmd_serve)
 
     b = sub.add_parser("bench", help="run the benchmark (one JSON line)")
@@ -911,8 +1106,11 @@ def main(argv=None) -> int:
         # checkpoint; report the resumable state and exit with a distinct
         # code (3 = preempted; 2 = usage error).
         print(f"preempted: {e}", file=sys.stderr)
-        if e.path:
-            print(f"resume with: --resume {e.path}", file=sys.stderr)
+        if e.resume_hint:
+            # The raiser supplies its surface's flag shape (the
+            # continuous pipeline's --resume is a bare flag with the
+            # path in --model-dir) — this handler stays generic.
+            print(f"resume with: {e.resume_hint}", file=sys.stderr)
         return 3
 
 

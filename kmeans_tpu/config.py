@@ -183,9 +183,22 @@ class ServeConfig:
     #: train_lock alone would let many rooms stack unbounded jobs).
     max_concurrent_train: int = 2
     #: ``Retry-After`` seconds advertised on 503 capacity responses (train
-    #: slots exhausted, room table full).  The bundled browser client
-    #: honors it with backoff instead of failing the request.
+    #: slots exhausted, room table full, model registry empty).  The
+    #: bundled browser client honors it with backoff instead of failing
+    #: the request.
     retry_after_s: int = 5
+    #: Bounded uniform jitter ADDED to ``Retry-After`` per response, so a
+    #: capacity dip doesn't teach every rejected client the same comeback
+    #: time (the thundering herd the retry layer's jitter exists to
+    #: break, applied to the HTTP half of the contract).  0 disables —
+    #: the header is then the exact integer ``retry_after_s``.
+    retry_after_jitter_s: float = 2.0
+    #: Fitted-model registry (kmeans_tpu.continuous.registry): checkpoint
+    #: directory the registry restores its newest verified generation
+    #: from at boot and re-loads on ``POST /api/model/reload``.  None
+    #: leaves the registry in-memory only (a continuous pipeline sharing
+    #: the process can still publish into it).
+    model_dir: Optional[str] = None
     #: Request-body byte cap for /api/import (and the general POST body
     #: guard): one unauthenticated POST must not be able to stuff an
     #: unbounded board into memory — metrics snapshots are O(n²) per

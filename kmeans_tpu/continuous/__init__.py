@@ -1,0 +1,55 @@
+"""Drift-aware continuous clustering over unbounded streams (ROADMAP item 5).
+
+The platform substrate — deterministic fault injection, verified
+checkpoints, preemption guards, retries, metrics, spans — exists so a
+long-running workload can survive kills and keep serving.  This package
+is that workload:
+
+* :mod:`kmeans_tpu.continuous.drift` — threshold + EWMA drift detectors
+  over the per-batch inertia telemetry.
+* :mod:`kmeans_tpu.continuous.window` — sliding-window storage with
+  lightweight-coreset compaction, so the "recent data" the refits see is
+  memory-bounded no matter how long the stream runs.
+* :mod:`kmeans_tpu.continuous.registry` — the fitted-model registry:
+  generations publish atomically (readers never see a torn model) and
+  persist as verified v2 checkpoints, so a killed process resumes at its
+  last verified generation.
+* :mod:`kmeans_tpu.continuous.pipeline` — the loop that composes them:
+  watch inertia, compact the window, trigger partial refits (warm-start
+  weighted Lloyd on the window), publish each generation.
+* :mod:`kmeans_tpu.continuous.synth` — a deterministic drifting-blob
+  stream (batch t is a pure function of ``(seed, t)``), the replayable
+  workload the soak drills and tests run against.
+
+Recovery drills live in ``tools/soak.py`` (docs/RESILIENCE.md has the
+site table, the RTO definition, and the soak recipe).
+"""
+
+from kmeans_tpu.continuous.drift import (
+    DriftMonitor,
+    EWMADetector,
+    ThresholdDetector,
+)
+from kmeans_tpu.continuous.pipeline import (
+    BatchInfo,
+    ContinuousConfig,
+    ContinuousPipeline,
+)
+from kmeans_tpu.continuous.registry import Generation, ModelRegistry
+from kmeans_tpu.continuous.synth import drift_batch, drift_stream, true_centers
+from kmeans_tpu.continuous.window import SlidingWindow
+
+__all__ = [
+    "BatchInfo",
+    "ContinuousConfig",
+    "ContinuousPipeline",
+    "DriftMonitor",
+    "EWMADetector",
+    "Generation",
+    "ModelRegistry",
+    "SlidingWindow",
+    "ThresholdDetector",
+    "drift_batch",
+    "drift_stream",
+    "true_centers",
+]

@@ -1,0 +1,247 @@
+"""Fitted-model registry: atomic hot-swap publish + verified persistence.
+
+The serving side of continuous clustering.  A :class:`ModelRegistry`
+holds the *current* :class:`Generation` — an immutable snapshot of a
+fitted model (centroids + metadata).  Publishing a new generation is one
+reference swap under a lock, so a reader that grabbed ``current()`` a
+microsecond before the swap finishes its request on the old generation
+and the next request sees the new one — no reader ever observes a torn
+model, and nothing blocks while a swap happens (the serve layer's
+``/api/assign`` handler does exactly this).
+
+Persistence rides the verified checkpoint v2 format
+(:mod:`kmeans_tpu.utils.checkpoint`): every publish writes an atomic,
+digest-manifested checkpoint *before* the in-memory swap, so the
+crash-ordering invariant is "disk is never behind memory" — a process
+killed at any point (including the ``registry.swap`` fault-injection
+site between persist and swap) restarts at a generation at least as new
+as anything a reader ever saw.  ``load_latest`` restores the newest
+*verified* generation, riding the checkpoint layer's ``.old``/
+step-tagged fallback chain when the final dir is torn.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from kmeans_tpu.obs import counter as _obs_counter, gauge as _obs_gauge
+from kmeans_tpu.utils import faults
+
+__all__ = ["Generation", "ModelRegistry"]
+
+_REGISTRY_GENERATION = _obs_gauge(
+    "kmeans_tpu_registry_generation",
+    "Generation number of the model currently served by the registry "
+    "(0 = no model published yet)",
+)
+_REGISTRY_SWAPS_TOTAL = _obs_counter(
+    "kmeans_tpu_registry_swaps_total",
+    "Model generations published (atomic hot-swaps completed)",
+    labels=("trigger",),
+)
+
+
+class Generation:
+    """One immutable published model: read freely from any thread.
+
+    The centroid array is copied at construction and never mutated — a
+    reader holding a generation across a swap keeps exactly the model it
+    started with.
+    """
+
+    __slots__ = ("centroids", "generation", "trigger", "created_ts", "meta")
+
+    def __init__(self, centroids, generation: int, *,
+                 trigger: str = "publish",
+                 meta: Optional[Dict[str, Any]] = None,
+                 created_ts: Optional[float] = None):
+        self.centroids = np.array(centroids, np.float32, copy=True)
+        if self.centroids.ndim != 2:
+            raise ValueError(
+                f"centroids must be (k, d); got {self.centroids.shape}"
+            )
+        self.generation = int(generation)
+        self.trigger = str(trigger)
+        self.created_ts = (time.time() if created_ts is None
+                           else float(created_ts))
+        self.meta = dict(meta or {})
+
+    @property
+    def k(self) -> int:
+        return int(self.centroids.shape[0])
+
+    @property
+    def d(self) -> int:
+        return int(self.centroids.shape[1])
+
+    def describe(self) -> Dict[str, Any]:
+        """JSON-safe metadata payload (the ``/api/model`` body)."""
+        return {
+            "generation": self.generation,
+            "k": self.k,
+            "d": self.d,
+            "trigger": self.trigger,
+            "created_ts": round(self.created_ts, 6),
+            "meta": {k: v for k, v in self.meta.items()
+                     if isinstance(v, (str, int, float, bool, type(None)))},
+        }
+
+
+class ModelRegistry:
+    """Current-generation holder with persist-then-swap publishes.
+
+    ``path`` is the checkpoint directory (None = in-memory only, for
+    tests and embedders that persist elsewhere); ``keep`` step-tagged
+    retention dirs survive per the checkpoint layer's contract.
+    """
+
+    def __init__(self, path: Optional[str] = None, *, keep: int = 2):
+        self.path = path
+        self.keep = int(keep)
+        self._cond = threading.Condition()
+        self._current: Optional[Generation] = None
+
+    # ------------------------------------------------------------- readers
+    def current(self) -> Optional[Generation]:
+        """The served generation (None before the first publish).
+
+        Deliberately lock-free: a reference read is atomic, the object
+        behind it immutable — this is the whole hot-swap contract, and
+        it keeps the serve layer's request path contention-free.
+        """
+        return self._current
+
+    @property
+    def generation(self) -> int:
+        gen = self._current
+        return gen.generation if gen is not None else 0
+
+    def wait_for(self, generation: int, timeout: Optional[float] = None
+                 ) -> bool:
+        """Block until ``self.generation >= generation`` (drills/tests)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self.generation >= generation, timeout=timeout,
+            )
+
+    # ----------------------------------------------------------- publishers
+    def publish(self, centroids, *, trigger: str = "publish",
+                meta: Optional[Dict[str, Any]] = None,
+                extra_arrays: Optional[Dict[str, np.ndarray]] = None,
+                generation: Optional[int] = None) -> Generation:
+        """Persist (when ``path`` is set) then atomically install a new
+        generation; returns it.
+
+        ``extra_arrays`` ride the same verified checkpoint (the pipeline
+        stores its compacted window there so resume restores it);
+        ``meta`` lands in the checkpoint's ``extra`` dict and the
+        generation's metadata.  Persist-before-swap plus the checkpoint
+        layer's atomic rename means a kill anywhere in here (the
+        ``registry.swap`` site sits between the two halves) never loses
+        a generation a reader could have seen.
+        """
+        gen_no = (self.generation + 1 if generation is None
+                  else int(generation))
+        gen = Generation(centroids, gen_no, trigger=trigger, meta=meta)
+        if self.path and self._current is None:
+            # First publish of a FRESH registry over a dir that already
+            # holds a newer generation (a previous run's final dir, or
+            # its .old/.step-* retention siblings surviving an rm of the
+            # final dir alone): publishing generation 1 under it would
+            # lose every future load to the stale higher step — refuse
+            # with the remedy instead of poisoning resume resolution.
+            from kmeans_tpu.utils.checkpoint import latest_step
+
+            # Strictly greater on purpose: an equal step is THIS publish's
+            # own checkpoint from a retried attempt (persisted, then a
+            # transient fault before the in-memory install) — the rerun
+            # must sail through, or REFIT_RETRY turns an absorbed fault
+            # into a fatal error.
+            prior = latest_step(self.path)
+            if prior is not None and prior > gen_no:
+                raise ValueError(
+                    f"model dir {self.path!r} already holds generation "
+                    f"{prior} (final or retention siblings); resume from "
+                    "it (load_latest / --resume) or remove "
+                    f"{self.path!r}, {self.path!r}.old and "
+                    f"{self.path!r}.step-* to start fresh"
+                )
+        if self.path:
+            from kmeans_tpu.utils.checkpoint import save_array_checkpoint
+
+            arrays = {"centroids": gen.centroids}
+            for name, arr in (extra_arrays or {}).items():
+                if name in arrays:
+                    raise ValueError(f"extra array name {name!r} collides")
+                arrays[name] = np.asarray(arr)
+            save_array_checkpoint(
+                self.path, arrays, step=gen_no,
+                extra={"continuous_model": True, "trigger": gen.trigger,
+                       "created_ts": gen.created_ts, **gen.meta},
+                keep=self.keep,
+            )
+        # The swap site: a kill here leaves disk one generation AHEAD of
+        # memory — the safe direction (resume serves the newer model).
+        faults.check("registry.swap")
+        self._install(gen)
+        return gen
+
+    def _install(self, gen: Generation) -> None:
+        from kmeans_tpu.obs import tracing as _tracing
+
+        with _tracing.span("registry.swap", category="swap",
+                           generation=gen.generation, trigger=gen.trigger):
+            with self._cond:
+                cur = self._current
+                if cur is not None and gen.generation <= cur.generation:
+                    if gen.generation == cur.generation:
+                        return        # reload of what is already served
+                    raise ValueError(
+                        f"generation {gen.generation} does not advance the "
+                        f"registry (current {cur.generation})"
+                    )
+                self._current = gen
+                self._cond.notify_all()
+        _REGISTRY_GENERATION.set(gen.generation)
+        _REGISTRY_SWAPS_TOTAL.labels(trigger=gen.trigger).inc()
+
+    # -------------------------------------------------------------- resume
+    def load_latest(self) -> Optional[Tuple[Generation, dict, dict]]:
+        """Restore the newest verified generation from ``path``.
+
+        Returns ``(generation, arrays, meta)`` — arrays/meta are the raw
+        checkpoint contents (the pipeline reads its window snapshot and
+        drift state back out of them) — or None when no checkpoint
+        exists.  A checkpoint that exists but fails verification
+        propagates :class:`~kmeans_tpu.utils.checkpoint.
+        CorruptCheckpointError` — serving a silently-wrong model is the
+        one thing this layer must never do.
+        """
+        if not self.path:
+            return None
+        from kmeans_tpu.utils.checkpoint import load_array_checkpoint
+
+        try:
+            arrays, meta = load_array_checkpoint(self.path)
+        except FileNotFoundError:
+            return None
+        extra = dict(meta.get("extra") or {})
+        if not extra.pop("continuous_model", False):
+            raise ValueError(
+                f"checkpoint at {self.path!r} is not a model-registry "
+                "checkpoint (no continuous_model tag) — refusing to serve "
+                "arbitrary arrays as a model"
+            )
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        gen = Generation(
+            arrays["centroids"], int(meta["step"]),
+            trigger=str(extra.pop("trigger", "resume")),
+            created_ts=extra.pop("created_ts", None),
+            meta=extra,
+        )
+        self._install(gen)
+        return gen, arrays, meta

@@ -21,9 +21,11 @@ adapted to same-origin serving: no remote CDNs or trackers in connect-src.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import queue
+import random
 import sys
 import threading
 import time
@@ -103,11 +105,16 @@ _SSE_SUBSCRIBERS = obs.gauge(
     "kmeans_tpu_sse_subscribers",
     "Live SSE subscriber connections across all rooms",
 )
+_ASSIGN_POINTS_TOTAL = obs.counter(
+    "kmeans_tpu_assign_points_total",
+    "Points labeled by the /api/assign nearest-centroid endpoint",
+)
 
 _KNOWN_ROUTES = frozenset((
     "/", "/index.html", "/app.js", "/api/state", "/api/export",
     "/api/events", "/api/mutate", "/api/hello", "/api/import",
-    "/healthz", "/metrics", "/api/trace",
+    "/healthz", "/metrics", "/api/trace", "/api/assign", "/api/model",
+    "/api/model/reload",
 ))
 
 
@@ -167,6 +174,18 @@ _SECURITY_HEADERS = {
 
 _PRESENCE_TTL_S = 30.0
 
+#: Per-room SSE event ring: numbered events a reconnecting subscriber can
+#: replay with ``Last-Event-ID`` (soak runs must not lose ``train_*``
+#: events to a dropped connection).  512 events comfortably covers a
+#: 100-iteration train stream plus board chatter across a reconnect.
+_EVENT_RING = 512
+
+#: SSE liveness cadence: a ``: keepalive`` comment every idle interval
+#: keeps middleboxes from reaping quiet connections; every third idle
+#: interval the full ping event (version + peers) rides instead, keeping
+#: the original 15 s self-heal cadence.
+_SSE_IDLE_S = 5.0
+
 #: Refcounted holds on the process-global span tracer: overlapping
 #: server lifetimes (tests, embedders) must not let the FIRST stop()
 #: switch tracing off under a still-running second server.  The switch
@@ -207,6 +226,11 @@ class _Room:
         self.subscribers: Dict[int, queue.Queue] = {}
         self.presence: Dict[str, float] = {}     # name -> last heartbeat
         self.last_active = time.time()
+        #: (event_id, event) ring for Last-Event-ID replay; ids are
+        #: per-room, monotonically increasing, never reused.
+        self.events: "collections.deque" = collections.deque(
+            maxlen=_EVENT_RING)
+        self._next_event_id = 1
         self._next_sub = 0
         self._lock = threading.Lock()
         self.train_lock = threading.Lock()
@@ -251,11 +275,23 @@ class _Room:
 
     def broadcast_event(self, event: dict) -> None:
         with self._lock:
+            eid = self._next_event_id
+            self._next_event_id += 1
+            self.events.append((eid, event))
             for q in self.subscribers.values():
                 try:
-                    q.put_nowait(event)
+                    q.put_nowait((eid, event))
                 except queue.Full:
                     pass   # slow client refetches state on next event anyway
+
+    def events_since(self, last_id: int) -> list:
+        """Ring events newer than ``last_id`` (Last-Event-ID replay).
+        A reconnect whose id has already fallen off the ring gets
+        whatever the ring still holds — the versioned hello/ping
+        self-heal covers the board state; only the replayable tail of
+        train events can be served."""
+        with self._lock:
+            return [(i, e) for i, e in self.events if i > last_id]
 
     def peer_count(self) -> int:
         with self._lock:
@@ -301,10 +337,30 @@ class _Room:
 
 
 class KMeansServer:
-    """All rooms + the HTTP server object."""
+    """All rooms + the HTTP server object.
 
-    def __init__(self, config: Optional[ServeConfig] = None):
+    ``registry`` injects a live
+    :class:`~kmeans_tpu.continuous.registry.ModelRegistry` (an in-process
+    continuous pipeline publishing into the same object gives zero-
+    downtime hot-swap on ``/api/assign``); with ``config.model_dir`` and
+    no injected registry, one is built over that checkpoint directory
+    and the newest verified generation is restored at construction.
+    """
+
+    def __init__(self, config: Optional[ServeConfig] = None, *,
+                 registry=None):
         self.config = config or ServeConfig()
+        self.model_registry = registry
+        if self.model_registry is None and self.config.model_dir:
+            from kmeans_tpu.continuous.registry import ModelRegistry
+
+            self.model_registry = ModelRegistry(path=self.config.model_dir)
+            # Boot-restore: a missing checkpoint is a fresh deployment
+            # (serve 503s on /api/assign until a generation lands); a
+            # CORRUPT one propagates — silently serving nothing when a
+            # model should exist is exactly what the verified format
+            # forbids.
+            self.model_registry.load_latest()
         self._train_sem = threading.BoundedSemaphore(
             self.config.max_concurrent_train
         )
@@ -481,6 +537,12 @@ class KMeansServer:
             return
         for room in list(self.rooms.values()):
             self._flush_pending_save(room)
+
+    def current_model(self):
+        """The registry's current generation, or None (no registry /
+        nothing published) — the one read the /api/assign path does."""
+        reg = self.model_registry
+        return reg.current() if reg is not None else None
 
     def room(self, code: Optional[str]) -> _Room:
         # Restrict to the reference's room-code alphabet shape (app.mjs:19):
@@ -924,12 +986,22 @@ class KMeansServer:
             def _busy(self, msg):
                 """503 + Retry-After: the server-side half of the retry
                 contract — tell the client WHEN to come back, not just
-                that it failed."""
+                that it failed.  Bounded jitter decorrelates the comeback
+                times a capacity dip hands out, so the rejected cohort
+                doesn't return as one thundering herd (the same reason
+                RetryPolicy jitters its backoff)."""
                 _HTTP_503_TOTAL.inc()
-                ra = int(server.config.retry_after_s)
+                ra = float(server.config.retry_after_s)
+                jit = float(server.config.retry_after_jitter_s)
+                if jit > 0:
+                    ra += random.uniform(0.0, jit)
+                # RFC 9110 delay-seconds is integer-only: a decimal here
+                # makes strict clients (urllib3's Retry) error instead of
+                # backing off.  int() keeps the jitter's decorrelation at
+                # whole-second granularity.
                 self._error(
                     msg, HTTPStatus.SERVICE_UNAVAILABLE,
-                    extra={"Retry-After": str(ra)},
+                    extra={"Retry-After": str(int(ra))},
                 )
 
             def _query(self):
@@ -1013,7 +1085,26 @@ class KMeansServer:
                     self.wfile.write(body)
                     return
                 if path == "/api/events":
-                    return self._sse(server.room(q.get("room")))
+                    # Last-Event-ID arrives as the standard header on an
+                    # EventSource reconnect; the query-param form serves
+                    # clients (and tests) that can't set headers.
+                    raw = (self.headers.get("Last-Event-ID")
+                           or q.get("lastEventId") or "").strip()
+                    last = int(raw) if raw.isdigit() else None
+                    return self._sse(server.room(q.get("room")),
+                                     last_event_id=last)
+                if path == "/api/model":
+                    if server.model_registry is None:
+                        # No registry AT ALL can never resolve by waiting
+                        # — 404, not the retryable 503 (matching
+                        # /api/model/reload).
+                        return self._error("no model registry configured",
+                                           HTTPStatus.NOT_FOUND)
+                    gen = server.current_model()
+                    if gen is None:
+                        return self._busy("no model generation published "
+                                          "yet; retry shortly")
+                    return self._json(gen.describe())
                 if path == "/healthz":
                     return self._json({"ok": True, "rooms": len(server.rooms)})
                 if path == "/metrics":
@@ -1058,8 +1149,23 @@ class KMeansServer:
                 self._headers_for(ctype, length=len(body))
                 self.wfile.write(body)
 
-            def _sse(self, room):
+            def _sse(self, room, last_event_id=None):
                 sid, q = room.subscribe()
+
+                def emit(ev, eid=None):
+                    # Injection site for the fault harness: an
+                    # InjectedFault is an OSError, so it exercises the
+                    # same unsubscribe path a torn client socket does.
+                    faults.check("serve.sse_emit")
+                    frame = f"data: {json.dumps(ev)}\n\n"
+                    if eid is not None:
+                        # Numbered events update the browser's
+                        # Last-Event-ID, so EventSource's automatic
+                        # reconnect replays whatever the drop skipped.
+                        frame = f"id: {eid}\n" + frame
+                    self.wfile.write(frame.encode())
+                    self.wfile.flush()
+
                 try:
                     self.send_response(HTTPStatus.OK)
                     self.send_header("Content-Type", "text/event-stream")
@@ -1069,29 +1175,42 @@ class KMeansServer:
                             self.send_header(k, v)
                     self._trace_header()
                     self.end_headers()
-                    hello = {"type": "hello", "version": room.doc.version,
-                             "peers": max(0, room.peer_count() - 1)}
-                    self.wfile.write(
-                        f"data: {json.dumps(hello)}\n\n".encode()
-                    )
-                    self.wfile.flush()
+                    emit({"type": "hello", "version": room.doc.version,
+                          "peers": max(0, room.peer_count() - 1)})
+                    # Last-Event-ID replay AFTER subscribing: an event
+                    # racing the reconnect lands in both the ring slice
+                    # and the queue; the replayed high-water mark dedups
+                    # the queued copy below.
+                    replayed = 0
+                    if last_event_id is not None:
+                        for eid, ev in room.events_since(last_event_id):
+                            emit(ev, eid)
+                            replayed = eid
+                    idle = 0
                     while True:
                         try:
-                            ev = q.get(timeout=15.0)
+                            eid, ev = q.get(timeout=_SSE_IDLE_S)
                         except queue.Empty:
-                            # version rides the ping so a change event
-                            # dropped on a full queue self-heals client-side.
-                            ev = {"type": "ping",
-                                  "version": room.doc.version,
-                                  "peers": max(0, room.peer_count() - 1)}
-                        # Injection site for the fault harness: an
-                        # InjectedFault is an OSError, so it exercises the
-                        # same unsubscribe path a torn client socket does.
-                        faults.check("serve.sse_emit")
-                        self.wfile.write(
-                            f"data: {json.dumps(ev)}\n\n".encode()
-                        )
-                        self.wfile.flush()
+                            idle += 1
+                            if idle % 3 == 0:
+                                # version rides the ping so a change event
+                                # dropped on a full queue self-heals
+                                # client-side.
+                                emit({"type": "ping",
+                                      "version": room.doc.version,
+                                      "peers": max(0, room.peer_count() - 1)})
+                            else:
+                                # Comment frame: ignored by EventSource,
+                                # but keeps proxies/LBs from reaping the
+                                # idle connection mid-soak.
+                                faults.check("serve.sse_emit")
+                                self.wfile.write(b": keepalive\n\n")
+                                self.wfile.flush()
+                            continue
+                        idle = 0
+                        if eid <= replayed:
+                            continue          # already served by replay
+                        emit(ev, eid)
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
                 finally:
@@ -1124,6 +1243,21 @@ class KMeansServer:
                         room = server.room(q.get("room"))
                         room.hello(str(self._body().get("name", "")).strip())
                         return self._json({"roster": room.roster()})
+                    if path == "/api/assign":
+                        return self._assign()
+                    if path == "/api/model/reload":
+                        if server.model_registry is None:
+                            return self._error("no model registry "
+                                               "configured",
+                                               HTTPStatus.NOT_FOUND)
+                        loaded = server.model_registry.load_latest()
+                        if loaded is None and \
+                                server.model_registry.current() is None:
+                            return self._busy("no model checkpoint to "
+                                              "load yet; retry shortly")
+                        return self._json({
+                            "generation": server.model_registry.generation,
+                        })
                     if path == "/api/import":
                         room = server.room(q.get("room"))
                         from kmeans_tpu.session.schema import parse_import
@@ -1154,6 +1288,62 @@ class KMeansServer:
                     self._busy(e)
                 except (KeyError, ValueError, TypeError) as e:
                     self._error(e)
+
+            def _assign(self):
+                """Nearest-centroid labels against the CURRENT generation.
+
+                The hot-swap contract in one handler: the generation
+                reference is read once, every distance below uses that
+                immutable snapshot, and a registry swap mid-request
+                changes nothing this request sees — in-flight requests
+                finish on the old model, the next request gets the new
+                one, nothing is ever dropped for a swap.
+                """
+                import numpy as np
+
+                if server.model_registry is None:
+                    # A server with no registry configured will NEVER have
+                    # a model — advertising a retry would poll forever.
+                    return self._error("no model registry configured",
+                                       HTTPStatus.NOT_FOUND)
+                gen = server.current_model()
+                if gen is None:
+                    # Retryable-by-contract: the pipeline hasn't published
+                    # its first generation yet (or a fresh boot hasn't
+                    # loaded one) — same 503 + Retry-After shape as the
+                    # capacity paths, so clients back off instead of
+                    # erroring.
+                    return self._busy("no model generation published yet; "
+                                      "retry shortly")
+                body = self._body()
+                pts = body.get("points")
+                if not isinstance(pts, list) or not pts:
+                    raise ValueError("points must be a non-empty list of "
+                                     "rows")
+                if len(pts) > 4096:
+                    raise PayloadTooLargeError(
+                        f"assign accepts at most 4096 points per request, "
+                        f"got {len(pts)}"
+                    )
+                x = np.asarray(pts, np.float32)
+                if x.ndim != 2 or x.shape[1] != gen.d:
+                    raise ValueError(
+                        f"points must be (n, {gen.d}) for generation "
+                        f"{gen.generation}; got shape {tuple(x.shape)}"
+                    )
+                c = gen.centroids
+                # Plain numpy on purpose: k·d is registry-scale (one
+                # model, not a dataset), and the serve process must not
+                # initialize the jax runtime to label a few rows.
+                d2 = ((x * x).sum(1)[:, None] - 2.0 * (x @ c.T)
+                      + (c * c).sum(1)[None, :])
+                labels = d2.argmin(1)
+                _ASSIGN_POINTS_TOTAL.inc(x.shape[0])
+                return self._json({
+                    "labels": [int(v) for v in labels],
+                    "generation": gen.generation,
+                    "k": gen.k,
+                })
 
         return Handler
 
@@ -1198,11 +1388,13 @@ def serve(host: str = "127.0.0.1", port: int = 8787, *,
           background: bool = False,
           persist_dir: Optional[str] = None,
           metrics: bool = True,
-          telemetry_path: Optional[str] = None) -> KMeansServer:
+          telemetry_path: Optional[str] = None,
+          model_dir: Optional[str] = None) -> KMeansServer:
     s = KMeansServer(ServeConfig(host=host, port=port,
                                  persist_dir=persist_dir,
                                  metrics=metrics,
-                                 telemetry_path=telemetry_path))
+                                 telemetry_path=telemetry_path,
+                                 model_dir=model_dir))
     try:
         s.start(background=background)
     except KeyboardInterrupt:

@@ -146,14 +146,19 @@ async function mutate(op, args = {}, attempt = 0) {
     // the request on the user.  Only the train op retries: it has a
     // status line to narrate the wait, while a silent multi-second stall
     // on a board mutation would read as a dead click.
+    // The server already jitters the header (ServeConfig.retry_after_jitter_s,
+    // whole seconds — RFC 9110 delay-seconds is integer-only); a bounded
+    // client-side jitter on top decorrelates tabs that received the SAME
+    // response via a shared cache — no cohort of rejected clients ever
+    // returns in lockstep.
     const ra = parseFloat(r.headers.get("Retry-After")) || 2;
-    const waitS = ra * (attempt + 1);
+    const waitS = (ra + Math.random() * 0.5) * (attempt + 1);
     if (t) {
       // The chip ships display:none and is normally unhidden by the
       // first train SSE event — which hasn't happened when the very
       // first click hits capacity, so unhide it here too.
       t.style.display = "";
-      t.textContent = `server busy — retrying in ${waitS}s…`;
+      t.textContent = `server busy — retrying in ${waitS.toFixed(1)}s…`;
     }
     await new Promise((res) => setTimeout(res, waitS * 1000));
     return mutate(op, args, attempt + 1);
@@ -182,6 +187,11 @@ async function hello() {
 let es = null;
 
 function connectEvents() {
+  // Server events carry id: fields, so EventSource's automatic reconnect
+  // sends Last-Event-ID and the server replays whatever the drop skipped
+  // from its per-room event ring — a reconnect during a train stream
+  // loses no train_* events.  Between events the server emits periodic
+  // ": keepalive" comments so idle connections survive proxies.
   es = new EventSource(api("/api/events"));
   es.onmessage = (ev) => {
     const msg = JSON.parse(ev.data);

@@ -128,6 +128,32 @@ class PeriodicSaver:
 _META = "meta.json"
 
 
+def _fsync_path(path: str) -> None:
+    """fsync one file or directory by path (crash durability).
+
+    The atomic-rename swap only guarantees *ordering*; without fsync the
+    OS may flush the rename's directory entry before the renamed dir's
+    CONTENTS, so a power cut (or a kill racing writeback) could leave a
+    verified-looking ``<path>`` whose arrays or manifest are empty — the
+    exact torn state the digest manifest exists to catch, minted by the
+    save side itself.  Every completed write is therefore fsynced, the
+    tmp dir is fsynced before the rename, and the parent dir after it.
+    Directory fsync is best-effort: some filesystems (and all of
+    Windows) refuse O_RDONLY directory fds, and a checkpoint must not
+    die on a platform quirk the rename itself survives.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:   # allow-silent-except: directory fsync unsupported on this filesystem; the rename ordering still holds
+        pass
+    finally:
+        os.close(fd)
+
+
 def _state_arrays(state) -> dict:
     return {
         "centroids": np.asarray(state.centroids),
@@ -260,6 +286,7 @@ def _save_array_checkpoint(path, arrays, *, step, config, key, extra,
         fmt = "orbax"
     except Exception:
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
+        _fsync_path(os.path.join(path, "arrays.npz"))
 
     faults.check("ckpt.pre_meta")
     key_data = None
@@ -279,6 +306,15 @@ def _save_array_checkpoint(path, arrays, *, step, config, key, extra,
     }
     with open(os.path.join(path, _META), "w", encoding="utf-8") as f:
         json.dump(meta, f, indent=2)
+        f.flush()
+        # The manifest is the arbiter of the whole dir's integrity —
+        # an unsynced manifest turning up empty after a crash would
+        # read as "all copies torn" for perfectly good arrays.
+        os.fsync(f.fileno())
+    # Contents durable BEFORE the rename publishes the dir: a kill at
+    # ckpt.pre_rename (or a power cut racing writeback) must never
+    # produce a final dir whose entries exist but whose bytes don't.
+    _fsync_path(path)
 
     # Swap the finished tmp dir into place.  A crash mid-swap can leave
     # <path>.old / .tmp / .step-* litter but never a torn <path>: the
@@ -303,6 +339,9 @@ def _save_array_checkpoint(path, arrays, *, step, config, key, extra,
             os.rename(final_path, old)
     faults.check("ckpt.mid_swap")
     os.rename(path, final_path)
+    # The renames themselves are directory-entry writes in the PARENT;
+    # syncing it makes the swap durable (not merely ordered).
+    _fsync_path(os.path.dirname(os.path.abspath(final_path)))
     faults.check("ckpt.post_rename")
     shutil.rmtree(old, ignore_errors=True)
     if keep > 0:

@@ -12,17 +12,20 @@ same way every run.
 
 Site catalog (see docs/RESILIENCE.md for the authoritative list):
 
-=====================  =====================================================
-``ckpt.pre_write``     checkpoint tmp dir created, nothing written yet
-``ckpt.pre_meta``      arrays written, ``meta.json`` not yet
-``ckpt.pre_rename``    tmp dir complete, final dir untouched
-``ckpt.mid_swap``      between the two renames (final displaced, tmp not in)
-``ckpt.post_rename``   final dir in place, retention/cleanup pending
-``stream.read``        one host batch/chunk read in the streaming loader
-``native.compile``     the native loader's g++ invocation
-``dist.init``          ``jax.distributed.initialize`` attempt
-``serve.sse_emit``     one SSE event write in the serve layer
-=====================  =====================================================
+======================  ====================================================
+``ckpt.pre_write``      checkpoint tmp dir created, nothing written yet
+``ckpt.pre_meta``       arrays written, ``meta.json`` not yet
+``ckpt.pre_rename``     tmp dir complete, final dir untouched
+``ckpt.mid_swap``       between the two renames (final displaced, tmp not in)
+``ckpt.post_rename``    final dir in place, retention/cleanup pending
+``stream.read``         one host batch/chunk read in the streaming loader
+``native.compile``      the native loader's g++ invocation
+``dist.init``           ``jax.distributed.initialize`` attempt
+``serve.sse_emit``      one SSE event write in the serve layer
+``continuous.compact``  sliding-window coreset compaction, pre-mutation
+``continuous.refit``    continuous-pipeline refit, before the fit runs
+``registry.swap``       model generation persisted, in-memory swap pending
+======================  ====================================================
 
 Activation is programmatic (``faults.install(plan)`` / ``faults.active``)
 or environment-driven for CLI-level tests::

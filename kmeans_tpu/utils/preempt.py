@@ -33,19 +33,28 @@ class Preempted(RuntimeError):
     """
 
     def __init__(self, msg: str, *, path: Optional[str] = None,
-                 step: Optional[int] = None):
+                 step: Optional[int] = None,
+                 resume_hint: Optional[str] = None):
         super().__init__(msg)
         self.path = path
         self.step = step
+        #: Copy-pasteable CLI flags that resume this state ("--resume
+        #: <path>" by default) — the RAISER knows its surface's flag
+        #: shape (the continuous pipeline's --resume is a bare flag with
+        #: the path in --model-dir), so the shared CLI handler must not.
+        self.resume_hint = resume_hint or (
+            f"--resume {path}" if path else None)
 
     @classmethod
     def during(cls, what: str, *, path: Optional[str] = None,
-               step: Optional[int] = None) -> "Preempted":
+               step: Optional[int] = None,
+               resume_hint: Optional[str] = None) -> "Preempted":
         """``what`` + the one resume-hint suffix every fit loop needs —
         the single copy of the checkpoint-or-lost phrasing."""
         hint = (f"; resumable checkpoint at {path!r}" if path
                 else " (no checkpoint_path — progress not saved)")
-        return cls(what + hint, path=path, step=step)
+        return cls(what + hint, path=path, step=step,
+                   resume_hint=resume_hint)
 
 
 class PreemptionGuard:

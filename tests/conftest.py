@@ -39,6 +39,24 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "soak: long-running kill/resume recovery drills (tools/soak.py); "
+        "excluded from tier-1 exactly like slow")
+
+
+def pytest_collection_modifyitems(config, items):
+    # The tier-1 gate is the FIXED expression `-m 'not slow'` (ROADMAP),
+    # so the soak marker must imply slow — one marker for humans to grep,
+    # one mechanism for the gate to exclude.
+    for item in items:
+        if "soak" in item.keywords and "slow" not in item.keywords:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Free compiled executables between test modules.

@@ -651,3 +651,45 @@ def test_cli_sweep_corrupt_input_one_line_error(tmp_path, capsys):
     assert rc == 2
     assert "Traceback" not in err
     assert "error: cannot load" in err
+
+
+def test_continuous_synthetic_stream(tmp_path, capsys):
+    rc = main([
+        "continuous", "--k", "3", "--batches", "12", "--d", "3",
+        "--batch-n", "128", "--drift-at", "5", "--drift", "8",
+        "--warmup-batches", "2", "--window-batches", "3",
+        "--compact-above", "2048", "--coreset", "512",
+        "--refit-iters", "8", "--refit-every", "4",
+        "--model-dir", str(tmp_path / "m"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = [json.loads(line) for line in out.splitlines()]
+    done = lines[-1]
+    assert done["event"] == "done"
+    assert done["batches"] == 12 and done["generation"] >= 2
+    gens = [ev for ev in lines if ev["event"] == "generation"]
+    assert gens[0]["trigger"] == "initial"
+
+
+def test_continuous_resume_requires_model_dir(capsys):
+    rc = main(["continuous", "--resume"])
+    assert rc == 2
+    assert "requires --model-dir" in capsys.readouterr().err
+
+
+def test_continuous_resume_round_trip(tmp_path, capsys):
+    model_dir = str(tmp_path / "m")
+    base = ["continuous", "--k", "2", "--d", "3", "--batch-n", "128",
+            "--drift-at", "4", "--drift", "8", "--warmup-batches", "2",
+            "--window-batches", "3", "--compact-above", "2048",
+            "--coreset", "512", "--refit-iters", "8", "--refit-every",
+            "4", "--model-dir", model_dir]
+    assert main(base + ["--batches", "6"]) == 0
+    capsys.readouterr()
+    rc = main(base + ["--batches", "12", "--resume"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = [json.loads(line) for line in out.splitlines()]
+    assert lines[0]["event"] == "resumed" and lines[0]["generation"] >= 1
+    assert lines[-1]["event"] == "done" and lines[-1]["batches"] == 12

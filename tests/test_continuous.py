@@ -1,0 +1,565 @@
+"""Continuous clustering: drift detection, window compaction, registry
+hot-swap, pipeline refits, resume, and the soak drill's fast twin.
+
+The kill-the-process crash matrix for the continuous sites lives in
+tests/test_faults.py (with the other subprocess drills); this file
+covers the in-process behavior those drills compose.
+"""
+
+import functools
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.continuous import (
+    ContinuousConfig,
+    ContinuousPipeline,
+    DriftMonitor,
+    EWMADetector,
+    ModelRegistry,
+    SlidingWindow,
+    ThresholdDetector,
+    drift_batch,
+    true_centers,
+)
+from kmeans_tpu.utils import faults
+from kmeans_tpu.utils.preempt import Preempted
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.clear()
+
+
+#: One small, fast stream shared by the pipeline tests: drift at batch 8.
+_SRC = functools.partial(drift_batch, n=192, d=4, k=3, seed=11,
+                         drift_at=8, drift=8.0)
+
+_CFG = dict(k=3, warmup_batches=2, window_batches=4, compact_above=4096,
+            coreset_size=1024, refit_iters=12, ewma_warmup=3,
+            min_refit_batches=1, refit_every=5)
+
+
+# ---------------------------------------------------------------------------
+# Drift detectors
+# ---------------------------------------------------------------------------
+
+
+def test_threshold_detector_silent_until_rebased():
+    d = ThresholdDetector(ratio=0.5)
+    assert not d.update(100.0)          # no baseline yet: silent
+    d.rebase(10.0)
+    assert not d.update(14.9)           # within 1.5x
+    assert d.update(15.1)               # beyond 1.5x
+    assert not d.update(12.0)           # back in band
+
+
+def test_ewma_detector_fires_on_spike_not_on_noise():
+    d = EWMADetector(alpha=0.3, k_sigma=4.0, warmup=3)
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        assert not d.update(10.0 + rng.normal() * 0.1)
+    assert d.update(30.0)               # a spike far outside the band
+    # The spike must NOT have been absorbed into the band.
+    assert d.mean < 11.0
+
+
+def test_ewma_warmup_blocks_early_firing():
+    d = EWMADetector(alpha=0.5, k_sigma=1.0, warmup=5)
+    assert not d.update(1.0)
+    assert not d.update(100.0)          # count < warmup: silent
+
+
+def test_monitor_state_round_trip():
+    m = DriftMonitor(ratio=0.3)
+    m.rebase(5.0)
+    for v in (5.1, 5.2, 4.9):
+        m.update(v)
+    state = json.loads(json.dumps(m.state()))   # must be JSON-safe
+    m2 = DriftMonitor(ratio=0.3)
+    m2.restore(state)
+    assert m2.threshold.baseline == m.threshold.baseline
+    assert m2.ewma.mean == pytest.approx(m.ewma.mean)
+    assert m2.ewma.count == m.ewma.count
+
+
+# ---------------------------------------------------------------------------
+# Synthetic stream
+# ---------------------------------------------------------------------------
+
+
+def test_drift_batch_is_pure_function_of_seed_and_t():
+    a = drift_batch(7, n=64, d=3, k=2, seed=5)
+    b = drift_batch(7, n=64, d=3, k=2, seed=5)
+    np.testing.assert_array_equal(a, b)
+    c = drift_batch(8, n=64, d=3, k=2, seed=5)
+    assert not np.array_equal(a, c)
+
+
+def test_true_centers_move_at_drift_point():
+    pre = true_centers(9, seed=1, k=3, d=4, drift_at=10, drift=6.0)
+    post = true_centers(10, seed=1, k=3, d=4, drift_at=10, drift=6.0)
+    shifts = np.linalg.norm(post - pre, axis=1)
+    np.testing.assert_allclose(shifts, 6.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Sliding window
+# ---------------------------------------------------------------------------
+
+
+def test_window_slides_and_compacts_bounded():
+    w = SlidingWindow(max_batches=8, compact_above=1000, coreset_size=200)
+    rng = np.random.default_rng(0)
+    for _ in range(24):
+        w.push(rng.normal(size=(128, 4)).astype(np.float32))
+    # 24 * 128 = 3072 points pushed; the window never exceeds its caps
+    # (compact_above plus at most one incoming batch before compaction).
+    assert w.n_points <= 1000 + 128
+    assert w.n_batches <= 8
+    assert w.compactions >= 1
+    pts, wts = w.snapshot()
+    assert pts.shape[1] == 4 and wts.shape == (pts.shape[0],)
+    assert np.isfinite(wts).all() and (wts > 0).all()
+
+
+def test_window_forgets_old_regime_after_sliding():
+    """The slide must genuinely FORGET: after max_batches pushes from a
+    new regime, nothing of the old regime remains in the window."""
+    w = SlidingWindow(max_batches=3, compact_above=10_000,
+                      coreset_size=100)
+    for _ in range(3):
+        w.push(np.zeros((16, 2), np.float32))          # old regime at 0
+    for _ in range(3):
+        w.push(np.full((16, 2), 50.0, np.float32))     # new regime at 50
+    pts, _ = w.snapshot()
+    assert float(pts.min()) == 50.0
+
+
+def test_window_compaction_preserves_mass():
+    w = SlidingWindow(max_batches=12, compact_above=1000,
+                      coreset_size=300)
+    rng = np.random.default_rng(2)
+    for _ in range(8):
+        w.push(rng.normal(size=(128, 4)).astype(np.float32))
+    # 1024 points crossed compact_above exactly once: the coreset is an
+    # unbiased mass estimator of the 1024 resident points.
+    assert w.compactions == 1
+    _, wts = w.snapshot()
+    assert 0.6 * 1024 < float(wts.sum()) < 1.6 * 1024
+
+
+def test_window_compact_transient_fault_absorbed_then_retried():
+    """A transient compaction failure must not kill the stream: the
+    window stays intact (over its soft cap), and the next push retries
+    the compaction successfully."""
+    w = SlidingWindow(max_batches=4, compact_above=300, coreset_size=100)
+    rng = np.random.default_rng(1)
+    with faults.active("continuous.compact:raise@1"):
+        for _ in range(3):                # third push trips the soft cap
+            w.push(rng.normal(size=(128, 3)).astype(np.float32))
+        assert w.compactions == 0 and w.n_points > 300   # absorbed
+        w.push(rng.normal(size=(128, 3)).astype(np.float32))
+    assert w.compactions == 1             # the next push retried it
+    assert w.n_points <= 300
+
+
+def test_window_compact_permanent_fault_surfaces_at_hard_cap():
+    w = SlidingWindow(max_batches=16, compact_above=300, coreset_size=100)
+    rng = np.random.default_rng(1)
+    with faults.active("continuous.compact:raise@1x0"):
+        with pytest.raises(faults.InjectedFault):
+            for _ in range(8):             # 2x the soft cap arrives here
+                w.push(rng.normal(size=(128, 3)).astype(np.float32))
+
+
+def test_pipeline_absorbs_transient_refit_and_swap_faults():
+    """One-off injected faults at continuous.refit and registry.swap ride
+    the unified RetryPolicy; the run completes as if undisturbed."""
+    clean_events = []
+    _run_pipeline(14, callback=lambda i: clean_events.append(i.as_dict()))
+    events = []
+    with faults.active("continuous.refit:raise@2;registry.swap:raise@2"):
+        pipe, gen = _run_pipeline(14,
+                                  callback=lambda i:
+                                  events.append(i.as_dict()))
+    assert gen is not None and gen.generation >= 2
+    assert ([e["generation"] for e in events]
+            == [e["generation"] for e in clean_events])
+
+
+def test_window_restore_round_trip_preserves_entry_structure():
+    w = SlidingWindow(max_batches=4, compact_above=10_000,
+                      coreset_size=100)
+    for v in (1.0, 2.0, 3.0):
+        w.push(np.full((8, 3), v, np.float32))
+    pts, wts, splits = w.snapshot_parts()
+    w2 = SlidingWindow(max_batches=4, compact_above=10_000,
+                       coreset_size=100)
+    w2.restore(pts, wts, splits=splits)
+    assert w2.n_batches == 3               # entry boundaries survived
+    pts2, wts2 = w2.snapshot()
+    np.testing.assert_array_equal(pts, pts2)
+    np.testing.assert_array_equal(wts, wts2)
+    # The restored window SLIDES like the original: one more push drops
+    # the v=1.0 entry in both.
+    w.push(np.full((8, 3), 4.0, np.float32))
+    w2.push(np.full((8, 3), 4.0, np.float32))
+    np.testing.assert_array_equal(w.snapshot()[0], w2.snapshot()[0])
+    assert float(w2.snapshot()[0].min()) == 1.0   # max_batches=4 keeps it
+    w.push(np.full((8, 3), 5.0, np.float32))
+    w2.push(np.full((8, 3), 5.0, np.float32))
+    assert float(w2.snapshot()[0].min()) == 2.0   # now it slid out
+
+
+# ---------------------------------------------------------------------------
+# Model registry: hot-swap atomicity + verified persistence
+# ---------------------------------------------------------------------------
+
+
+def test_registry_publish_advances_and_snapshots_are_immutable():
+    reg = ModelRegistry()
+    src = np.zeros((2, 3), np.float32)
+    gen1 = reg.publish(src, trigger="initial")
+    src[:] = 99.0                        # publisher mutates its buffer...
+    assert float(gen1.centroids.max()) == 0.0   # ...the generation is a copy
+    gen2 = reg.publish(np.ones((2, 3)), trigger="drift")
+    assert (gen1.generation, gen2.generation) == (1, 2)
+    assert reg.current() is gen2
+
+
+def test_registry_readers_never_see_torn_state_during_swaps():
+    reg = ModelRegistry()
+    reg.publish(np.full((4, 2), 1.0), trigger="initial")
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        last = 0
+        while not stop.is_set():
+            gen = reg.current()
+            c = gen.centroids
+            # Every generation is constant-valued == its number: a torn
+            # read (mixed generations, resized array) can't pass this.
+            if c.shape != (4, 2) or not np.all(c == c.flat[0]) \
+                    or int(c.flat[0]) != gen.generation \
+                    or gen.generation < last:
+                bad.append((gen.generation, c.copy()))
+                return
+            last = gen.generation
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for g in range(2, 60):
+        reg.publish(np.full((4, 2), float(g)), trigger="drift")
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not bad, bad[:3]
+
+
+def test_registry_persist_then_swap_order_under_fault(tmp_path):
+    """A fault AT registry.swap: the checkpoint landed, memory did not —
+    disk ahead of memory, the safe direction; load_latest catches up."""
+    path = str(tmp_path / "model")
+    reg = ModelRegistry(path=path)
+    reg.publish(np.zeros((2, 2)), trigger="initial")
+    with faults.active("registry.swap:raise@1"):
+        with pytest.raises(faults.InjectedFault):
+            reg.publish(np.ones((2, 2)), trigger="drift")
+    assert reg.generation == 1           # memory untouched
+    loaded = reg.load_latest()
+    assert loaded is not None
+    assert reg.generation == 2           # disk had the newer generation
+    np.testing.assert_array_equal(reg.current().centroids,
+                                  np.ones((2, 2), np.float32))
+
+
+def test_registry_load_latest_refuses_foreign_checkpoint(tmp_path):
+    from kmeans_tpu.utils.checkpoint import save_array_checkpoint
+
+    path = str(tmp_path / "notamodel")
+    save_array_checkpoint(path, {"centroids": np.ones((2, 2))}, step=1)
+    reg = ModelRegistry(path=path)
+    with pytest.raises(ValueError, match="continuous_model"):
+        reg.load_latest()
+
+
+def test_registry_reload_of_same_generation_is_noop(tmp_path):
+    path = str(tmp_path / "model")
+    reg = ModelRegistry(path=path)
+    reg.publish(np.zeros((2, 2)), trigger="initial")
+    loaded = reg.load_latest()           # disk == memory: quiet no-op
+    assert loaded is not None and reg.generation == 1
+
+
+# ---------------------------------------------------------------------------
+# Pipeline: initial fit, drift refit, recovery, resume, preemption
+# ---------------------------------------------------------------------------
+
+
+def _run_pipeline(steps, *, registry=None, resume=False, callback=None):
+    pipe = ContinuousPipeline(_SRC, ContinuousConfig(**_CFG),
+                              registry=registry, resume=resume)
+    gen = pipe.run(steps, callback=callback)
+    return pipe, gen
+
+
+def test_pipeline_initial_fit_then_drift_refit_recovers():
+    events = []
+    pipe, gen = _run_pipeline(24, callback=lambda i:
+                              events.append(i.as_dict()))
+    refits = [e for e in events if e["refit"]]
+    assert refits[0]["refit"] == "initial"
+    drift_refits = [e for e in refits if e["refit"] == "drift"]
+    assert drift_refits, "drift never triggered a refit"
+    assert min(e["batch"] for e in drift_refits) >= 8   # not before drift
+    # Recovery: the window slid fully onto the new regime and a refit
+    # landed there, so the last batches' inertia is back at the
+    # pre-drift level.
+    pre = [e["inertia_pp"] for e in events
+           if e["inertia_pp"] is not None and e["batch"] < 8]
+    tail = [e["inertia_pp"] for e in events if e["batch"] >= 20]
+    assert np.mean(tail) < 2.0 * np.mean(pre), (np.mean(tail),
+                                                np.mean(pre))
+    assert gen.generation >= 2
+
+
+def test_pipeline_resume_replays_identically(tmp_path):
+    """Kill-free twin of the crash drills: stop at batch 10, resume from
+    the published checkpoint, and the resumed trajectory must match an
+    undisturbed run — the synthetic stream is a pure function of (seed,
+    t) and every piece of pipeline state rides the checkpoint."""
+    undisturbed_reg = ModelRegistry(path=str(tmp_path / "a"))
+    _, gen_a = _run_pipeline(24, registry=undisturbed_reg)
+
+    reg_b = ModelRegistry(path=str(tmp_path / "b"))
+    _run_pipeline(10, registry=reg_b)
+    reg_b2 = ModelRegistry(path=str(tmp_path / "b"))
+    _, gen_b = _run_pipeline(24, registry=reg_b2, resume=True)
+
+    np.testing.assert_allclose(gen_a.centroids, gen_b.centroids,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_resume_k_mismatch_refused(tmp_path):
+    reg = ModelRegistry(path=str(tmp_path / "m"))
+    _run_pipeline(6, registry=reg)
+    cfg = dict(_CFG, k=5)
+    with pytest.raises(ValueError, match="contradicts"):
+        ContinuousPipeline(_SRC, ContinuousConfig(**cfg),
+                           registry=ModelRegistry(path=str(tmp_path / "m")),
+                           resume=True)
+
+
+def test_pipeline_sigterm_mid_refit_exits_resumable(tmp_path):
+    """SIGTERM delivered INSIDE a refit: the guard latches, the batch
+    boundary publishes a preempt generation carrying the exact stream
+    position, and the resumed pipeline completes with zero lost
+    batches."""
+    path = str(tmp_path / "m")
+    reg = ModelRegistry(path=path)
+    with faults.active("continuous.refit:sigterm@2"):
+        with pytest.raises(Preempted) as ei:
+            _run_pipeline(24, registry=reg)
+    assert ei.value.path == path
+    assert 0 < ei.value.step < 24
+    reg2 = ModelRegistry(path=path)
+    pipe, gen = _run_pipeline(24, registry=reg2, resume=True)
+    assert pipe.batch_idx == 24
+    assert gen is not None and gen.generation > 0
+    # The preempt generation recorded the position the resume started at.
+    assert any(".step-" in p or p == "m"
+               for p in os.listdir(tmp_path))
+
+
+def test_pipeline_partial_refit_within_5pct_of_scratch():
+    """The acceptance gate's fast twin (tools/soak.py runs the full
+    version): warm-start refit inertia on the post-drift window lands
+    within 5% of a from-scratch refit on the same window."""
+    import jax
+
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models.lloyd import fit_lloyd
+
+    pipe, gen = _run_pipeline(24)
+    pts, w = pipe.window.snapshot()
+    total_w = max(float(np.sum(w)), 1e-9)
+
+    def fit_pp(init):
+        state = fit_lloyd(
+            pts, 3, key=jax.random.key(7),
+            config=KMeansConfig(k=3, max_iter=100, empty="farthest"),
+            init=init, weights=w)
+        return float(state.inertia) / total_w
+
+    partial = fit_pp(gen.centroids)
+    scratch = fit_pp("k-means++")
+    assert partial <= 1.05 * scratch, (partial, scratch)
+
+
+# ---------------------------------------------------------------------------
+# Static analysis polices the new package from day one
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_clean_over_continuous_package():
+    import glob
+
+    from tools.analyze import all_analyzers, run_analysis
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = sorted(glob.glob(os.path.join(root, "kmeans_tpu", "continuous",
+                                          "*.py")))
+    files += [os.path.join(root, "tools", "soak.py")]
+    assert files, "continuous package not found"
+    report = run_analysis(root, all_analyzers(), files=files)
+    assert not report.findings, [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# Soak drills: the fast deterministic mini-soak runs in tier-1; the full
+# tools/soak.py drill is soak-marked (excluded from tier-1 like slow).
+# ---------------------------------------------------------------------------
+
+
+def test_soak_marker_implies_slow():
+    """The tier-1 gate is the fixed `-m 'not slow'` expression, so the
+    soak marker must imply slow (conftest aliases it)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import pytest\n"
+        "@pytest.mark.soak\n"
+        "def test_drill(): raise AssertionError('must not run in tier-1')\n"
+    )
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    probe = os.path.join(root, "tests", "_soak_probe_tmp.py")
+    with open(probe, "w") as f:
+        f.write(code)
+    try:
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", probe, "-q", "-m", "not slow",
+             "-p", "no:cacheprovider", "--no-header"],
+            capture_output=True, text=True, cwd=root, timeout=120,
+        )
+        assert "1 deselected" in res.stdout, res.stdout
+    finally:
+        os.remove(probe)
+
+
+def test_mini_soak_hot_swap_zero_drops():
+    """Deterministic in-process mini-soak (the full drill is
+    tools/soak.py): serve + pipeline share a registry; a client hammer
+    rides through every generation swap with zero dropped requests."""
+    from tools.soak import default_params, phase_hot_swap
+
+    p = dict(default_params(quick=True), batches=12, hammer_threads=2)
+    hot = phase_hot_swap(p)
+    assert hot["requests"] > 0
+    assert hot["dropped"] == 0, hot["errors"]
+    assert hot["generations"] >= 2
+    # Requests were actually served across a swap boundary.
+    assert len(hot["generations_served"]) >= 1
+
+
+@pytest.mark.soak
+def test_full_soak_drill(tmp_path):
+    """The complete tools/soak.py drill (quick size): hot-swap integrity,
+    kill/resume RTO per site, SIGTERM drill, drift recovery — writes a
+    soak artifact and must pass every acceptance gate."""
+    from tools import soak
+
+    out = str(tmp_path / "BENCH_SOAK.json")
+    rc = soak.main(["--quick", "--out", out,
+                    "--workdir", str(tmp_path / "work")])
+    with open(out) as f:
+        report = json.load(f)
+    assert rc == 0, report.get("failures")
+    assert report["hot_swap"]["dropped"] == 0
+    assert all(r.get("ok") for r in report["kill_resume"])
+    assert report["sigterm"]["ok"]
+    assert report["drift_recovery"]["ratio"] <= 1.05
+
+
+def test_preempt_resume_restores_refit_schedule(tmp_path):
+    """since_refit is replay state: a resume from a preempt generation
+    must restore the refit-schedule counter, or the scheduled cadence
+    and the min_refit_batches gate drift off the undisturbed run's
+    schedule."""
+    path = str(tmp_path / "m")
+    reg = ModelRegistry(path=path)
+    pipe = ContinuousPipeline(_SRC, ContinuousConfig(**_CFG), registry=reg)
+    pipe.run(10)                # drift refit at batch 8, one batch after
+    live_since = pipe._since_refit
+    assert live_since > 0
+    try:
+        pipe._preempt_exit(10)  # what the guard does at a batch boundary
+    except Preempted:
+        pass
+    pipe2 = ContinuousPipeline(_SRC, ContinuousConfig(**_CFG),
+                               registry=ModelRegistry(path=path),
+                               resume=True)
+    assert pipe2._since_refit == live_since
+    assert pipe2.batch_idx == pipe.batch_idx
+
+
+def test_fresh_registry_refuses_stale_newer_checkpoint(tmp_path):
+    """A fresh registry publishing generation 1 over a dir whose final or
+    retention siblings hold a NEWER generation would lose every future
+    load to the stale step — refuse with the remedy instead."""
+    path = str(tmp_path / "m")
+    old = ModelRegistry(path=path, keep=2)
+    for g in range(5):
+        old.publish(np.full((2, 2), float(g), np.float32))
+    # Operator "cleans" only the final dir; .step-* siblings survive.
+    import shutil
+
+    shutil.rmtree(path)
+    fresh = ModelRegistry(path=path)
+    with pytest.raises(ValueError, match="already holds generation"):
+        fresh.publish(np.zeros((2, 2), np.float32), trigger="initial")
+    # The documented remedies both work: resume...
+    resumed = ModelRegistry(path=path)
+    assert resumed.load_latest() is not None
+    assert resumed.generation >= 3          # a retained sibling served it
+    # ...or a genuinely clean path.
+    clean = ModelRegistry(path=str(tmp_path / "m2"))
+    assert clean.publish(np.zeros((2, 2))).generation == 1
+
+
+def test_transient_swap_fault_on_initial_publish_absorbed(tmp_path):
+    """REFIT_RETRY's rerun of the INITIAL publish must sail through the
+    fresh-registry stale-checkpoint guard: attempt 1 persisted the step-1
+    checkpoint before the fault, so the rerun sees its own step on disk
+    (equal, not newer) and proceeds."""
+    reg = ModelRegistry(path=str(tmp_path / "m"))
+    with faults.active("registry.swap:raise@1"):
+        pipe, gen = _run_pipeline(6, registry=reg)
+    assert gen is not None and reg.generation >= 1
+
+
+def test_pipeline_signal_on_final_batch_surfaces_without_path():
+    """A signal landing on the FINAL batch of an in-memory-registry run
+    must still raise (the guard's never-swallowed contract) — raising
+    discards nothing, the product lives in the registry object."""
+    import signal
+    import time as _time
+
+    pipe = ContinuousPipeline(_SRC, ContinuousConfig(**_CFG))
+
+    def cb(info):
+        if info.batch == 7:
+            os.kill(os.getpid(), signal.SIGTERM)
+            _time.sleep(0.01)          # let the latching handler run
+
+    with pytest.raises(Preempted) as ei:
+        pipe.run(8, callback=cb)
+    assert ei.value.path is None and ei.value.resume_hint is None
+    assert pipe.registry.current() is not None     # product not lost

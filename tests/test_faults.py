@@ -711,6 +711,85 @@ def test_runner_signal_on_converged_run_without_checkpoint_returns(
     assert bool(state.converged)
 
 
+# ---------------------------------------------------------------------------
+# Continuous-pipeline crash matrix: kill at every continuous-loop site,
+# resume must restore the last VERIFIED generation and finish the stream
+# (docs/RESILIENCE.md "Continuous clustering & recovery drills").
+# ---------------------------------------------------------------------------
+
+_CONT_CHILD = r"""
+import sys
+sys.modules["orbax"] = None
+sys.modules["orbax.checkpoint"] = None
+import functools
+from kmeans_tpu.continuous import (ContinuousConfig, ContinuousPipeline,
+                                   ModelRegistry, drift_batch)
+path, resume = sys.argv[1], sys.argv[2] == "1"
+src = functools.partial(drift_batch, n=128, d=3, k=2, seed=3, drift_at=4,
+                        drift=8.0)
+cfg = ContinuousConfig(k=2, warmup_batches=2, window_batches=3,
+                       compact_above=300, coreset_size=128, refit_iters=8,
+                       ewma_warmup=3, min_refit_batches=1, refit_every=4)
+reg = ModelRegistry(path=path)
+pipe = ContinuousPipeline(src, cfg, registry=reg, resume=resume)
+pipe.run(14)
+print("GEN", reg.generation, "BATCH", pipe.batch_idx)
+"""
+
+
+def _run_cont_child(path, *, resume=False, fault=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("KMEANS_TPU_FAULTS", None)
+    if fault:
+        env["KMEANS_TPU_FAULTS"] = fault
+    return subprocess.run(
+        [sys.executable, "-c", _CONT_CHILD, str(path),
+         "1" if resume else "0"],
+        env=env, capture_output=True, timeout=300,
+    )
+
+
+# Every site is killed on a hit that has at least one published
+# generation behind it, so resume always has a verified model to restore.
+_CONT_MATRIX = ["continuous.refit:kill@2", "registry.swap:kill@2",
+                "continuous.compact:kill@2"]
+
+
+@pytest.mark.parametrize("fault", _CONT_MATRIX)
+def test_continuous_crash_matrix_kill_then_resume(tmp_path, fault):
+    path = str(tmp_path / "model")
+    res = _run_cont_child(path, fault=fault)
+    assert res.returncode == 137, (fault, res.stderr.decode())
+    # The registry checkpoint left behind must be digest-verified loadable
+    # — the last verified generation survives every kill point.
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["extra"]["continuous_model"]
+    assert meta["digests"] and meta["step"] >= 1
+    killed_gen = meta["step"]
+    res = _run_cont_child(path, resume=True)
+    assert res.returncode == 0, (fault, res.stderr.decode())
+    out = res.stdout.decode().split()
+    gen, batch = int(out[1]), int(out[3])
+    assert batch == 14, (fault, res.stdout)
+    assert gen >= killed_gen, (fault, res.stdout)
+
+
+def test_continuous_sigterm_mid_refit_then_resume(tmp_path):
+    """The graceful half of the drill: SIGTERM during a refit exits via
+    Preempted (a preempt generation carrying the exact stream position),
+    and the resume completes the stream."""
+    path = str(tmp_path / "model")
+    res = _run_cont_child(path, fault="continuous.refit:sigterm@2")
+    err = res.stderr.decode()
+    assert res.returncode == 1 and "Preempted" in err, (res.returncode,
+                                                        err)
+    arrays, meta = load_array_checkpoint(path)
+    assert meta["extra"]["trigger"] == "preempt"
+    res = _run_cont_child(path, resume=True)
+    assert res.returncode == 0, res.stderr.decode()
+    assert res.stdout.decode().split()[3] == "14"
+
+
 def test_compile_retry_skips_deterministic_failures():
     """Missing g++ / a blown compile cap are permanent: no backoff burn
     under the native loader's module lock."""

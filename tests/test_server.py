@@ -974,6 +974,17 @@ def test_sse_soak_slow_clients_burst_no_leak(server):
         threads_before, threading.active_count())
 
 
+def _assert_retry_after(server, headers):
+    """The 503 contract: Retry-After = retry_after_s plus bounded jitter
+    (a capacity dip must not teach every rejected client the same
+    comeback second), as RFC 9110 integer delay-seconds — strict clients
+    (urllib3 Retry) reject decimals."""
+    raw = headers["Retry-After"]
+    assert raw.isdigit(), raw
+    lo = int(server.config.retry_after_s)
+    assert lo <= int(raw) <= lo + int(server.config.retry_after_jitter_s)
+
+
 def _post_with_headers(server, path, obj):
     """Like _post but also returns the response headers — the 503 retry
     contract lives in a header (Retry-After)."""
@@ -1000,7 +1011,7 @@ def test_train_capacity_exhausted_503_retry_after(server):
             {"op": "train", "args": {"n": 100, "d": 2, "k": 2}},
         )
         assert st == 503
-        assert headers["Retry-After"] == str(server.config.retry_after_s)
+        _assert_retry_after(server, headers)
         assert "capacity" in out["error"]
     finally:
         for _ in range(cap):
@@ -1037,8 +1048,227 @@ def test_room_table_full_503_retry_after(server):
         st, headers, _ = _post_with_headers(
             server, "/api/hello?room=ZFUL", {"name": "Ada"})
         assert st == 503
-        assert headers["Retry-After"] == str(server.config.retry_after_s)
+        _assert_retry_after(server, headers)
     finally:
         for i in range(_MAX_ROOMS):
             if f"T{i}" in server.rooms:
                 server.rooms[f"T{i}"].subscribers.clear()
+
+
+# ---------------------------------------------------------------------------
+# Model registry serving: /api/assign hot-swap, /api/model, reload
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def model_server(tmp_path):
+    import numpy as np
+
+    from kmeans_tpu.continuous import ModelRegistry
+
+    reg = ModelRegistry(path=str(tmp_path / "model"))
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0), registry=reg)
+    httpd = s.start(background=True)
+    s.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    s.reg = reg
+    s.np = np
+    yield s
+    s.stop()
+
+
+def test_assign_before_any_model_is_retryable_503(model_server):
+    st, headers, out = _post_with_headers(
+        model_server, "/api/assign", {"points": [[0.0, 0.0]]})
+    assert st == 503
+    _assert_retry_after(model_server, headers)
+    assert "no model" in out["error"]
+
+
+def test_assign_and_model_metadata_after_publish(model_server):
+    np = model_server.np
+    model_server.reg.publish(
+        np.array([[0.0, 0.0], [10.0, 10.0]], np.float32),
+        trigger="initial")
+    st, out = _post(model_server, "/api/assign",
+                    {"points": [[1, 1], [9, 9]]})
+    assert st == 200
+    assert out == {"labels": [0, 1], "generation": 1, "k": 2}
+    with urllib.request.urlopen(model_server.base + "/api/model",
+                                timeout=5) as r:
+        meta = json.loads(r.read())
+    assert meta["generation"] == 1 and meta["k"] == 2 and meta["d"] == 2
+    assert meta["trigger"] == "initial"
+
+
+def test_assign_validates_shape_and_caps_rows(model_server):
+    np = model_server.np
+    model_server.reg.publish(np.zeros((2, 3), np.float32))
+    st, out = _post(model_server, "/api/assign", {"points": [[1, 2]]})
+    assert st == 400 and "(n, 3)" in out["error"]
+    st, out = _post(model_server, "/api/assign", {"points": []})
+    assert st == 400
+    st, out = _post(model_server, "/api/assign",
+                    {"points": [[0, 0, 0]] * 4097})
+    assert st == 413
+
+
+def test_assign_hot_swap_zero_dropped_requests(model_server):
+    """The tentpole's serving contract in miniature: requests hammering
+    /api/assign across many generation swaps all land; every response is
+    internally consistent (labels computed against the generation it
+    reports)."""
+    np = model_server.np
+    model_server.reg.publish(np.zeros((2, 2), np.float32))
+    stop = threading.Event()
+    results = {"n": 0, "dropped": 0, "bad": []}
+    lock = threading.Lock()
+
+    def hammer():
+        while not stop.is_set():
+            st, out = _post(model_server, "/api/assign",
+                            {"points": [[0.0, 0.0]]})
+            with lock:
+                results["n"] += 1
+                if st != 200:
+                    results["dropped"] += 1
+                    results["bad"].append(out)
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for g in range(2, 40):
+        model_server.reg.publish(
+            np.full((2, 2), float(g), np.float32), trigger="drift")
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert results["n"] > 0
+    assert results["dropped"] == 0, results["bad"][:3]
+
+
+def test_model_reload_picks_up_new_checkpoint(model_server):
+    """Cross-process publish: another process writes a newer generation
+    checkpoint; POST /api/model/reload swaps it in without a restart."""
+    import numpy as np
+
+    from kmeans_tpu.continuous import ModelRegistry
+
+    model_server.reg.publish(np.zeros((2, 2), np.float32))
+    # A second registry over the same dir stands in for the pipeline
+    # process: publish generation 2 behind the server's back.
+    other = ModelRegistry(path=model_server.reg.path)
+    other.load_latest()
+    other.publish(np.ones((2, 2), np.float32), trigger="drift")
+    assert model_server.reg.generation == 1       # server still on gen 1
+    st, out = _post(model_server, "/api/model/reload", {})
+    assert st == 200 and out["generation"] == 2
+    st, out = _post(model_server, "/api/assign", {"points": [[1, 1]]})
+    assert out["generation"] == 2
+
+
+def test_model_dir_boot_restore(tmp_path):
+    """A server constructed over a model_dir serves the newest verified
+    generation from boot — the kill/resume drill's serving half."""
+    import numpy as np
+
+    from kmeans_tpu.continuous import ModelRegistry
+
+    path = str(tmp_path / "model")
+    ModelRegistry(path=path).publish(
+        np.array([[5.0, 5.0]], np.float32), trigger="initial")
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0,
+                                 model_dir=path))
+    httpd = s.start(background=True)
+    s.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        st, out = _post(s, "/api/assign", {"points": [[5, 5]]})
+        assert st == 200 and out["generation"] == 1
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# SSE robustness: event ids, Last-Event-ID replay, keepalive comments
+# ---------------------------------------------------------------------------
+
+
+def _read_sse_lines(resp, *, want, timeout_s=12):
+    got = []
+    deadline = time.time() + timeout_s
+    while time.time() < deadline and len(got) < want:
+        line = resp.fp.readline().decode().rstrip("\n")
+        if line:
+            got.append(line)
+    return got
+
+
+def test_sse_last_event_id_replays_missed_train_events(server):
+    room = server.room("RPLY")
+    for i in (1, 2, 3):
+        room.broadcast_event({"type": "train", "iteration": i})
+    req = urllib.request.Request(
+        server.base + "/api/events?room=RPLY&lastEventId=1")
+    resp = urllib.request.urlopen(req, timeout=12)
+    try:
+        lines = _read_sse_lines(resp, want=5)
+    finally:
+        resp.close()
+    # hello (unnumbered), then the two missed events with their ids.
+    assert lines[0].startswith("data: ") and "hello" in lines[0]
+    assert lines[1] == "id: 2"
+    assert json.loads(lines[2][len("data: "):])["iteration"] == 2
+    assert lines[3] == "id: 3"
+    assert json.loads(lines[4][len("data: "):])["iteration"] == 3
+
+
+def test_sse_header_form_of_last_event_id(server):
+    room = server.room("RPLH")
+    room.broadcast_event({"type": "train", "iteration": 7})
+    req = urllib.request.Request(
+        server.base + "/api/events?room=RPLH",
+        headers={"Last-Event-ID": "0"})
+    resp = urllib.request.urlopen(req, timeout=12)
+    try:
+        lines = _read_sse_lines(resp, want=3)
+    finally:
+        resp.close()
+    assert lines[1] == "id: 1"
+    assert json.loads(lines[2][len("data: "):])["iteration"] == 7
+
+
+def test_sse_keepalive_comments_on_idle_stream(server):
+    resp = urllib.request.urlopen(
+        server.base + "/api/events?room=KEEP", timeout=12)
+    try:
+        lines = _read_sse_lines(resp, want=2, timeout_s=9)
+    finally:
+        resp.close()
+    assert "hello" in lines[0]
+    assert lines[1] == ": keepalive"     # ignored by EventSource, keeps
+                                         # middleboxes from reaping us
+
+
+def test_sse_live_events_carry_ids(server):
+    resp = urllib.request.urlopen(
+        server.base + "/api/events?room=LIVE", timeout=12)
+    try:
+        _read_sse_lines(resp, want=1)            # hello
+        server.room("LIVE").broadcast_event({"type": "train",
+                                             "iteration": 42})
+        lines = _read_sse_lines(resp, want=2)
+    finally:
+        resp.close()
+    assert lines[0].startswith("id: ")
+    assert json.loads(lines[1][len("data: "):])["iteration"] == 42
+
+
+def test_assign_without_registry_is_404_not_retryable(server):
+    """A server with NO registry configured can never produce a model —
+    it must 404 (like /api/model/reload), not advertise a retry that
+    would poll forever."""
+    for path, method in (("/api/assign", "post"), ("/api/model", "get")):
+        if method == "post":
+            st, out = _post(server, path, {"points": [[0.0, 0.0]]})
+        else:
+            st, out = _post(server, path, {})  # POST to GET route -> 404 too
+        assert st == 404, (path, st, out)

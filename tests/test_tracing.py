@@ -629,7 +629,9 @@ def test_train_sse_events_carry_run_and_trace_ids(server):
         saw_done = False
         while time.time() < deadline and not saw_done:
             try:
-                ev = q.get(timeout=1.0)
+                # Queue items are (event_id, event): the id feeds the SSE
+                # ring's Last-Event-ID replay (docs/RESILIENCE.md).
+                _eid, ev = q.get(timeout=1.0)
             except Exception:
                 continue
             if ev.get("type", "").startswith("train"):

@@ -43,6 +43,10 @@ MODULES = [
     "kmeans_tpu.models.gmm_stream",
     "kmeans_tpu.parallel.engine",
     "kmeans_tpu.serve.server",
+    "kmeans_tpu.continuous.drift",
+    "kmeans_tpu.continuous.window",
+    "kmeans_tpu.continuous.pipeline",
+    "kmeans_tpu.continuous.registry",
 ]
 
 DOC = os.path.join("docs", "OBSERVABILITY.md")

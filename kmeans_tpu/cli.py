@@ -849,7 +849,13 @@ def _cmd_serve(args) -> int:
               persist_dir=args.persist_dir or None,
               metrics=args.metrics,
               telemetry_path=args.telemetry,
-              model_dir=args.model_dir or None)
+              model_dir=args.model_dir or None,
+              assign_batching=args.assign_batching,
+              assign_max_delay_s=(args.assign_max_delay_ms / 1000.0
+                                  if args.assign_max_delay_ms is not None
+                                  else None),
+              assign_max_batch_rows=args.assign_max_batch,
+              assign_max_points=args.assign_max_points)
     except KeyboardInterrupt:
         pass
     except ValueError as e:
@@ -1090,6 +1096,25 @@ def main(argv=None) -> int:
                         "subcommand's --model-dir; newest verified "
                         "generation restored at boot, POST "
                         "/api/model/reload picks up new ones)")
+    s.add_argument("--assign-batching",
+                   action=argparse.BooleanOptionalAction, default=None,
+                   help="adaptive micro-batching on /api/assign "
+                        "(docs/SERVING.md; default on — "
+                        "--no-assign-batching keeps the per-request "
+                        "NumPy path and never initializes jax)")
+    s.add_argument("--assign-max-delay-ms", type=float, default=None,
+                   metavar="MS",
+                   help="hard ceiling on queue delay the batcher may "
+                        "add to coalesce a batch (default 2)")
+    s.add_argument("--assign-max-batch", type=int, default=None,
+                   metavar="ROWS",
+                   help="row cap on one coalesced assign batch "
+                        "(default 8192; shapes bucket to powers of two "
+                        "below it)")
+    s.add_argument("--assign-max-points", type=int, default=None,
+                   metavar="N",
+                   help="per-request point cap on POST /api/assign "
+                        "(default 4096)")
     s.set_defaults(fn=_cmd_serve)
 
     b = sub.add_parser("bench", help="run the benchmark (one JSON line)")

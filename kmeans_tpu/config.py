@@ -228,6 +228,62 @@ class ServeConfig:
     #: run_done events, run_id + trace_id stamped, so concurrent jobs
     #: stay separable) to this file.  None disables.
     telemetry_path: Optional[str] = None
+    #: Per-request row cap on ``POST /api/assign`` (was a hardcoded
+    #: 4096).  One unauthenticated request must not demand an unbounded
+    #: distance computation; larger workloads split client-side (the
+    #: micro-batcher re-coalesces them anyway).
+    assign_max_points: int = 4096
+    #: Adaptive micro-batching on ``/api/assign`` (docs/SERVING.md):
+    #: concurrent requests coalesce into one jitted batch against a
+    #: single immutable model generation.  Off = the plain per-request
+    #: NumPy path (no background thread, jax runtime never initialized
+    #: — the right mode for a board-only deployment).
+    assign_batching: bool = True
+    #: Upper bound on how long the batcher holds the OLDEST queued
+    #: request open to coalesce arrivals behind it.  The adaptive policy
+    #: usually dispatches far sooner (it stops waiting as soon as the
+    #: observed arrival gap says nothing more is coming); this is the
+    #: hard ceiling on added queue delay.
+    assign_max_delay_s: float = 0.002
+    #: Row cap on one coalesced batch.  Together with
+    #: ``assign_min_bucket`` it fixes the closed set of compiled batch
+    #: shapes: rows pad up to the next power of two between the two
+    #: bounds, so the per-model compiled-shape cache holds at most
+    #: log2(max/min)+1 programs per kernel (retrace-free under the RET
+    #: analyzers' rules).
+    assign_max_batch_rows: int = 8192
+    #: Smallest padded batch shape (floor of the bucket ladder).
+    assign_min_bucket: int = 64
+    #: Pending-request cap on the batcher queue; beyond it requests get
+    #: the standard 503 + Retry-After backpressure instead of unbounded
+    #: queueing.
+    assign_pending_limit: int = 512
+    #: Seconds a request waits for its batch result before giving up
+    #: with a 503 (pathological kernel stall; generous on purpose —
+    #: a timeout here is a dropped request, which the serving contract
+    #: treats as a last resort, not a tuning knob).
+    assign_timeout_s: float = 30.0
+    #: Use the closure-pruned distance kernel (candidate centroid lists
+    #: via :func:`kmeans_tpu.ops.hamerly.closure_candidates`) when the
+    #: served model's k is at least this.  0 disables pruning (every
+    #: batch scores all k centroids).  Pruning is exact: rows whose
+    #: triangle-inequality certificate fails fall back to the dense
+    #: kernel.
+    assign_prune_min_k: int = 256
+    #: Dispatcher worker threads draining the micro-batch queue.  More
+    #: workers = more parallel batches but SMALLER ones (closed-loop
+    #: clients bound the coalescable backlog), and the grouped kernel's
+    #: efficiency falls with rows-per-group — measured on CPU, one
+    #: dispatcher with intra-kernel parallelism (below) beats four
+    #: dispatchers shredding the queue.
+    assign_workers: int = 1
+    #: Intra-kernel parallelism of the pruned grouped GEMM: group
+    #: ranges (row-balanced) fan out over this many threads per batch
+    #: (the GEMMs release the GIL, so this is real parallelism).  1
+    #: disables the pool — the right default where BLAS multithreads
+    #: its own GEMMs (measured faster on this host); raise it for
+    #: single-threaded-BLAS deployments (OPENBLAS_NUM_THREADS=1).
+    assign_kernel_threads: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

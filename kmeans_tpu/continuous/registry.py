@@ -53,13 +53,15 @@ class Generation:
     started with.
     """
 
-    __slots__ = ("centroids", "generation", "trigger", "created_ts", "meta")
+    __slots__ = ("centroids", "generation", "trigger", "created_ts", "meta",
+                 "_sq_norms")
 
     def __init__(self, centroids, generation: int, *,
                  trigger: str = "publish",
                  meta: Optional[Dict[str, Any]] = None,
                  created_ts: Optional[float] = None):
         self.centroids = np.array(centroids, np.float32, copy=True)
+        self._sq_norms: Optional[np.ndarray] = None
         if self.centroids.ndim != 2:
             raise ValueError(
                 f"centroids must be (k, d); got {self.centroids.shape}"
@@ -77,6 +79,20 @@ class Generation:
     @property
     def d(self) -> int:
         return int(self.centroids.shape[1])
+
+    def sq_norms(self) -> np.ndarray:
+        """(k,) float32 squared centroid norms, computed ONCE per
+        generation and cached — the ``(c*c).sum(1)`` term every
+        nearest-centroid request needs, hoisted out of the request path
+        (both the serve layer's NumPy fallback and the batched kernels
+        read this).  Benign race: concurrent first readers compute the
+        same value; the slot assignment is atomic."""
+        sq = self._sq_norms
+        if sq is None:
+            c = self.centroids
+            sq = np.einsum("kd,kd->k", c, c).astype(np.float32)
+            self._sq_norms = sq
+        return sq
 
     def describe(self) -> Dict[str, Any]:
         """JSON-safe metadata payload (the ``/api/model`` body)."""

@@ -77,7 +77,7 @@ from kmeans_tpu.ops.pallas_lloyd import (hamerly_pallas_supported,
                                          lloyd_hamerly_pallas, padded_d)
 
 __all__ = ["hamerly_pass", "hamerly_pallas_ok", "resolve_hamerly_backend",
-           "row_norms", "HAMERLY_MARGIN_REL"]
+           "row_norms", "HAMERLY_MARGIN_REL", "closure_candidates"]
 
 #: Relative soundness margin over the f32 dot-accumulation error bound
 #: (γ_d ≈ d·2⁻²⁴ ≈ 1.2e-4 at d=2048; the bound enters twice per dot and
@@ -114,6 +114,96 @@ def row_norms(x, *, compute_dtype=None, chunk_size: int = 65536) -> jax.Array:
     _, out = lax.scan(body, None,
                       xp.reshape(-1, chunk_size, d))
     return out.reshape(-1)[:n] * _NORM_INFLATE
+
+
+def closure_candidates(centroids, *, n_groups: Optional[int] = None,
+                       cand_len: Optional[int] = None, seed: int = 0,
+                       iters: int = 8):
+    """Cluster-closure candidate tables for serve-time pruned assignment
+    (Fast Approximate K-Means via Cluster Closures, PAPERS.md — made
+    EXACT with a Hamerly-style runtime certificate).
+
+    Groups the *centroids* (not the data) with a tiny NumPy k-means,
+    then for each group records the ``cand_len`` centroids nearest to
+    its center plus a threshold: the distance from the group center to
+    the nearest NON-candidate centroid.  At serve time a point ``x``
+    whose nearest group center is ``g`` (at distance ``Dg``) scores only
+    the candidates; with best candidate distance ``b``, every excluded
+    centroid ``c`` satisfies ``||x−c|| ≥ ||c−μ_g|| − Dg ≥ thr_g − Dg``,
+    so ``b ≤ thr_g − Dg`` certifies the pruned argmin is the exact one
+    (rows failing the certificate rescore densely).  Same triangle-
+    inequality discipline as the training-side bounds above, applied to
+    the k·d model instead of the n·d data — built once per published
+    generation, pure NumPy (the serve process must not need a device to
+    prepare a model).
+
+    Returns ``(group_centers (G, d) f32, cand_idx (G, m) int32,
+    thresholds (G,) f32)``; ``thresholds`` is ``+inf`` where a group's
+    candidate list already covers all k centroids.
+    """
+    import numpy as np
+
+    c = np.asarray(centroids, np.float32)
+    if c.ndim != 2:
+        raise ValueError(f"centroids must be (k, d); got {c.shape}")
+    k, d = c.shape
+    g_n = int(n_groups) if n_groups else max(1, int(round(k ** 0.5)))
+    g_n = min(g_n, k)
+    # Default candidate width: ~3 average groups' worth of centroids,
+    # floored so tiny models never over-prune.  Cost/benefit: the pruned
+    # kernel's FLOPs scale with (G+m)/k, the fallback rate shrinks as m
+    # grows — 3x measures as the knee on clustered models (zero
+    # certificate failures at k=1000 with ~10x fewer FLOPs).
+    m = int(cand_len) if cand_len else min(k, max(16, 3 * -(-k // g_n)))
+    m = max(1, min(m, k))
+    rng = np.random.RandomState(seed)
+    csq = np.einsum("kd,kd->k", c, c)
+    # Farthest-point (maxmin) init: the certificate's slack is
+    # ``thr_g − ||x − μ_g||``, so group centers must land ON the
+    # centroid set's natural clusters — a random pick leaves empty
+    # groups and merged clusters, which blows up ``||x − μ_g||`` and
+    # with it the dense-fallback rate (measured: 16% vs ~0 at k=1000).
+    first = int(rng.randint(k))
+    picks = [first]
+    mind = np.maximum(csq + csq[first] - 2.0 * (c @ c[first]), 0.0)
+    for _ in range(g_n - 1):
+        nxt = int(mind.argmax())
+        picks.append(nxt)
+        mind = np.minimum(
+            mind, np.maximum(csq + csq[nxt] - 2.0 * (c @ c[nxt]), 0.0))
+    mu = c[picks].copy()
+    for _ in range(max(1, int(iters))):
+        musq = np.einsum("gd,gd->g", mu, mu)
+        d2 = csq[:, None] - 2.0 * (c @ mu.T) + musq[None, :]
+        lab = d2.argmin(axis=1)
+        # Reseed order for groups emptied THIS iteration: centroids by
+        # decreasing distance to their assigned center, each taken at
+        # most once — two empty groups must not reseed to the same
+        # centroid (they would stay duplicates forever, silently
+        # shrinking the effective group count).
+        far_order = np.argsort(-np.take_along_axis(
+            d2, lab[:, None], axis=1)[:, 0])
+        reseed_at = 0
+        for g in range(g_n):
+            members = c[lab == g]
+            if members.shape[0]:
+                mu[g] = members.mean(axis=0)
+            else:
+                # The fits' empty="farthest" policy, in miniature.
+                mu[g] = c[int(far_order[min(reseed_at, k - 1)])]
+                reseed_at += 1
+    musq = np.einsum("gd,gd->g", mu, mu)
+    # (G, k) exact distances group-center -> centroid (f64 sqrt of a
+    # clamped f32 quadratic: thresholds must not go negative-fuzzy).
+    d2 = np.maximum(musq[:, None] - 2.0 * (mu @ c.T) + csq[None, :], 0.0)
+    order = np.argsort(d2, axis=1, kind="stable")
+    cand = order[:, :m].astype(np.int32)
+    if m < k:
+        thr = np.sqrt(np.take_along_axis(d2, order[:, m:m + 1], axis=1)
+                      )[:, 0].astype(np.float32)
+    else:
+        thr = np.full((g_n,), np.inf, np.float32)
+    return mu.astype(np.float32), cand, thr
 
 
 def hamerly_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,

@@ -1,5 +1,7 @@
-"""Serving shim: HTTP/SSE server + browser front-end."""
+"""Serving shim: HTTP/SSE server + browser front-end, plus the
+high-QPS assignment engine (:mod:`kmeans_tpu.serve.assign`)."""
 
+from kmeans_tpu.serve.assign import AssignEngine, assign_direct
 from kmeans_tpu.serve.server import KMeansServer, serve
 
-__all__ = ["KMeansServer", "serve"]
+__all__ = ["KMeansServer", "serve", "AssignEngine", "assign_direct"]

@@ -1,0 +1,712 @@
+"""High-QPS assignment engine: adaptive micro-batching over jitted,
+bucket-shaped nearest-centroid kernels (docs/SERVING.md).
+
+The serve layer's hot inference path (ROADMAP item 2).  PR 6 shipped a
+correct-but-naive ``/api/assign`` — plain per-request NumPy against the
+registry's current generation.  This module turns that into serving
+throughput without touching the hot-swap contract:
+
+* **Adaptive micro-batcher** — concurrent requests coalesce into one
+  batch.  The oldest queued request bounds the added delay
+  (``ServeConfig.assign_max_delay_s``, default 2 ms); an EWMA of the
+  observed inter-arrival gap lets the batcher dispatch *immediately*
+  when traffic is sparse (no pointless 2 ms tax on a lone request) and
+  coalesce aggressively when it is not.
+* **Bucketed compiled shapes** — batch rows pad up to a power-of-two
+  ladder between ``assign_min_bucket`` and ``assign_max_batch_rows``,
+  so the per-model compiled-shape cache holds at most
+  ``log2(max/min)+1`` programs per kernel kind.  The jit builders are
+  module-level ``lru_cache`` functions (the RET201 idiom — never a
+  fresh ``jax.jit`` per call), and the engine accounts hits/misses
+  (``kmeans_tpu_assign_shape_cache_total``).
+* **Per-generation prepared models** — device-resident centroids,
+  squared norms computed once (:meth:`Generation.sq_norms`), and for
+  large k the cluster-closure candidate tables
+  (:func:`kmeans_tpu.ops.hamerly.closure_candidates`) — all built once
+  when a generation is first served, cached across batches, evicted a
+  few generations after a swap.
+* **Closure-pruned kernel** — for ``k >= assign_prune_min_k`` each row
+  scores only its group's candidate centroids (m ≪ k) plus the G group
+  centers; a triangle-inequality certificate proves the pruned argmin
+  exact, and rows failing it rescore densely
+  (``kmeans_tpu_assign_pruned_fallback_rows_total``).  FLOPs per row
+  drop from 2·k·d to 2·(G+m)·d — ~8× at k=1000.  The pruned stage runs
+  as a *grouped BLAS GEMM on the host* (rows argsorted by group, one
+  contiguous ``(rows_g, d) @ (d, m)`` product per group): the obvious
+  on-device formulations lose badly on XLA:CPU — the per-row candidate
+  gather (``c[cand[g]]`` + batched einsum) measures 17× slower than the
+  dense matmul it was meant to beat, and ``lax.ragged_dot`` 10× slower
+  (memory-bound gather / poor CPU lowering), while grouped BLAS beats
+  dense by ~2.7× and the per-request baseline by ~7× in points/s.  An
+  accelerator-resident grouped kernel is the natural next step
+  (ROADMAP); the dispatch seam is one function.
+
+Hot-swap contract (PR 6, preserved exactly): the registry generation is
+read ONCE per coalesced batch; every request in the batch is answered
+from that immutable snapshot and reports its number.  A swap mid-queue
+means the next batch sees the new model; nothing is ever dropped for a
+swap.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kmeans_tpu import obs
+from kmeans_tpu.obs import tracing as _tracing
+
+__all__ = [
+    "AssignEngine",
+    "PreparedModel",
+    "assign_direct",
+    "NoModelError",
+    "QueueFullError",
+    "AssignTimeoutError",
+]
+
+# ---------------------------------------------------------------------------
+# Observability (docs/OBSERVABILITY.md catalog).  Sub-ms buckets: the
+# whole point of the engine is single-digit-ms request latency, which
+# the default 1 ms+ ladder could not resolve.
+# ---------------------------------------------------------------------------
+_MS_BUCKETS = (0.0002, 0.0005, 0.001, 0.002, 0.004, 0.008, 0.016,
+               0.032, 0.064, 0.128, 0.256, 1.0, 5.0, 30.0)
+
+ASSIGN_REQUEST_SECONDS = obs.histogram(
+    "kmeans_tpu_assign_request_seconds",
+    "POST /api/assign wall time per request (queue wait + kernel "
+    "included); path = batched | direct",
+    labels=("path",), buckets=_MS_BUCKETS,
+)
+_QUEUE_DELAY_SECONDS = obs.histogram(
+    "kmeans_tpu_assign_queue_delay_seconds",
+    "Queue delay of the OLDEST request in each dispatched micro-batch "
+    "— the quantity ServeConfig.assign_max_delay_s bounds (plus at "
+    "most one in-flight batch ahead of it)",
+    buckets=_MS_BUCKETS,
+)
+_BATCH_ROWS = obs.histogram(
+    "kmeans_tpu_assign_batch_rows",
+    "Coalesced rows per dispatched micro-batch (pre-padding; the "
+    "batch-size distribution of the serving load)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+             8192, 16384),
+)
+_BATCHES_TOTAL = obs.counter(
+    "kmeans_tpu_assign_batches_total",
+    "Micro-batches dispatched, by kernel kind (pruned = closure-"
+    "candidate scoring; dense = all-k scoring)",
+    labels=("kernel",),
+)
+_SHAPE_CACHE_TOTAL = obs.counter(
+    "kmeans_tpu_assign_shape_cache_total",
+    "Compiled-shape cache lookups by the micro-batcher (event = hit | "
+    "miss; misses are bounded by the bucket ladder x kernel kinds per "
+    "model shape — a growing miss count under steady shapes means "
+    "retracing, which the RET analyzers forbid)",
+    labels=("event",),
+)
+_FALLBACK_ROWS_TOTAL = obs.counter(
+    "kmeans_tpu_assign_pruned_fallback_rows_total",
+    "Rows whose closure-pruning exactness certificate failed and were "
+    "rescored by the dense kernel (pruning stays exact; this counts "
+    "what it cost)",
+)
+
+#: Relative certificate margin: the pruned kernel's f32 distance error
+#: is ~1e-6·d relative; 1e-3 follows the same two-orders-of-magnitude
+#: soundness discipline as ops.hamerly.HAMERLY_MARGIN_REL.
+_CERT_MARGIN_REL = 1e-3
+
+
+class NoModelError(RuntimeError):
+    """No generation published (or the engine is stopping) — the serve
+    layer's retryable 503, same contract as before batching existed."""
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the pending-request queue is at
+    ``assign_pending_limit`` — 503 + Retry-After, never unbounded
+    queueing."""
+
+
+class AssignTimeoutError(RuntimeError):
+    """A request outlived ``assign_timeout_s`` waiting for its batch —
+    pathological (a stalled kernel), surfaced as a 503."""
+
+
+# ---------------------------------------------------------------------------
+# Jitted kernels: ONE builder per (shape, kind), module-level lru_cache
+# (the RET201/202 idiom — parallel/engine.py's _build_* pattern).  jax
+# imports stay inside the builders so a board-only serve process (or the
+# direct NumPy path) never initializes the jax runtime.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _build_dense(rows: int, k: int, d: int):
+    """Jitted dense nearest-centroid labels for one padded batch shape.
+
+    Scores ``csq - 2·x@c.T`` (the row norm is an argmin-invariant
+    per-row constant, so it is never computed — the same ranking
+    function the training kernels use)."""
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x, c, csq):
+        prod = jnp.matmul(x, c.T, preferred_element_type=jnp.float32)
+        return jnp.argmin(csq[None, :] - 2.0 * prod,
+                          axis=1).astype(jnp.int32)
+
+    return jax.jit(kernel)
+
+
+def _score_groups(xs, bounds, prep, s_out, g_lo, g_hi):
+    """GEMM the rows routed to groups ``[g_lo, g_hi)`` — one contiguous
+    ``(rows_g, d) @ (d, m)`` BLAS product per non-empty group, writing
+    into disjoint slices of the shared score matrix.  Deliberately
+    NOTHING but GEMMs: BLAS releases the GIL, so group ranges
+    parallelize for real; every elementwise op happens once, vectorized
+    over the whole batch, outside this loop."""
+    for gg in range(g_lo, g_hi):
+        lo, hi = bounds[gg], bounds[gg + 1]
+        if lo == hi:
+            continue
+        np.matmul(xs[lo:hi], prep.cand_mats2[gg], out=s_out[lo:hi])
+
+
+def _group_splits(bounds: np.ndarray, g_n: int, chunks: int):
+    """Partition groups into ``chunks`` contiguous ranges of roughly
+    equal ROW count (groups are unequal; splitting by group index alone
+    would leave one worker with most of the rows)."""
+    total = int(bounds[-1])
+    splits, target = [0], total / chunks
+    for i in range(1, chunks):
+        splits.append(int(np.searchsorted(bounds, target * i)))
+    splits.append(g_n)
+    return [(lo, hi) for lo, hi in zip(splits, splits[1:]) if hi > lo]
+
+
+def _pruned_host(x: np.ndarray, prep: "PreparedModel", pool=None,
+                 chunks: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """Closure-pruned labels + per-row exactness certificate, as a
+    grouped BLAS GEMM (see the module docstring for why this is a host
+    kernel on CPU).
+
+    Route each row to its nearest of G group centers, argsort rows by
+    group, then one contiguous ``(rows_g, d) @ (d, m)`` product per
+    non-empty group against that group's prepacked candidate matrix —
+    fanned out over ``pool`` in ``chunks`` row-balanced group ranges
+    when given.  Returns ``(labels, ok)``; a row with ``ok`` False has
+    a candidate list its certificate could not prove complete and must
+    rescore densely."""
+    n = x.shape[0]
+    g_n = prep.gc.shape[0]
+    sg = x @ prep.gc2                                          # (B, G)
+    sg += prep.gsq[None, :]
+    g = sg.argmin(axis=1)
+    order = np.argsort(g, kind="stable")
+    xs = x[order]
+    gso = g[order]
+    bounds = np.searchsorted(gso, np.arange(g_n + 1))
+    s = np.empty((n, prep.m), np.float32)
+    if pool is not None and chunks > 1 and n >= 256:
+        ranges = _group_splits(bounds, g_n, chunks)
+        futs = [pool.submit(_score_groups, xs, bounds, prep, s, lo, hi)
+                for lo, hi in ranges[1:]]
+        _score_groups(xs, bounds, prep, s, *ranges[0])
+        for f in futs:
+            f.result()
+    else:
+        _score_groups(xs, bounds, prep, s, 0, g_n)
+    s += prep.csq_cand[gso]
+    j = s.argmin(axis=1)
+    labels_s = np.take_along_axis(prep.cand[gso], j[:, None],
+                                  axis=1)[:, 0]
+    s_best = np.take_along_axis(s, j[:, None], axis=1)[:, 0]
+    xsq = np.einsum("bd,bd->b", xs, xs)
+    dg = np.sqrt(np.maximum(
+        xsq + np.take_along_axis(sg[order], gso[:, None], axis=1)[:, 0],
+        0.0))
+    b = np.sqrt(np.maximum(xsq + s_best, 0.0))
+    # Exact iff the best candidate provably beats every excluded
+    # centroid: ||x - c_excl|| >= thr[g] - dg (triangle inequality).
+    ok_s = b + _CERT_MARGIN_REL * (b + dg + 1.0) <= prep.thr[gso] - dg
+    labels = np.empty(n, np.int32)
+    ok = np.empty(n, bool)
+    labels[order] = labels_s
+    ok[order] = ok_s
+    return labels, ok
+
+
+def assign_direct(gen, x: np.ndarray) -> np.ndarray:
+    """The per-request NumPy path (``assign_batching=False``, and the
+    loadgen baseline): one immutable generation, squared norms cached on
+    it (:meth:`Generation.sq_norms` — no per-request ``(c*c).sum(1)``),
+    no jax runtime."""
+    c = gen.centroids
+    d2 = ((x * x).sum(1)[:, None] - 2.0 * (x @ c.T)
+          + gen.sq_norms()[None, :])
+    return d2.argmin(1)
+
+
+class PreparedModel:
+    """Everything serving needs about ONE generation, built once.
+
+    The cached squared norms, the closure candidate tables (when k
+    clears ``prune_min_k``: group centers, per-group candidate index
+    lists, prepacked contiguous ``(d, m)`` candidate matrices for the
+    grouped GEMM, and the exactness thresholds), and — for the jitted
+    dense path — device-resident centroid arrays, materialized lazily
+    so a model served entirely by the host-pruned path never touches
+    the jax runtime.  Immutable after construction, like the
+    generation it wraps (the lazy device pair is build-once; only the
+    single dispatcher thread touches it).
+    """
+
+    __slots__ = ("gen", "k", "d", "csq", "pruned", "g_n", "m",
+                 "gc", "gc2", "gsq", "cand", "csq_cand", "thr",
+                 "cand_mats2", "_dev")
+
+    def __init__(self, gen, *, prune_min_k: int = 256):
+        self.gen = gen
+        self.k, self.d = gen.k, gen.d
+        self.csq = gen.sq_norms()
+        self._dev = None
+        self.pruned = bool(prune_min_k) and gen.k >= int(prune_min_k)
+        if self.pruned:
+            from kmeans_tpu.ops.hamerly import closure_candidates
+
+            c = gen.centroids
+            gc, cand, thr = closure_candidates(c)
+            self.g_n, self.m = int(cand.shape[0]), int(cand.shape[1])
+            self.gc = gc
+            # The -2x folds into the prepacked operands so the batch
+            # path's elementwise work is two adds and an argmin.
+            self.gc2 = np.ascontiguousarray(-2.0 * gc.T)
+            self.gsq = np.einsum("gd,gd->g", gc, gc).astype(np.float32)
+            self.cand = cand
+            self.csq_cand = self.csq[cand]
+            self.thr = thr
+            self.cand_mats2 = np.stack([
+                np.ascontiguousarray(-2.0 * c[cand[g]].T)
+                for g in range(self.g_n)])
+        else:
+            self.g_n = self.m = 0
+
+    def dense_dev(self):
+        """``(centroids, csq)`` on device for the jitted dense kernel —
+        transferred once per generation, not once per batch."""
+        if self._dev is None:
+            import jax.numpy as jnp
+
+            self._dev = (jnp.asarray(self.gen.centroids),
+                         jnp.asarray(self.csq))
+        return self._dev
+
+
+class _Pending:
+    """One enqueued request: rows in, labels + generation out."""
+
+    __slots__ = ("points", "n", "event", "labels", "gen", "error",
+                 "t_enq", "ctx")
+
+    def __init__(self, points: np.ndarray):
+        self.points = points
+        self.n = int(points.shape[0])
+        self.event = threading.Event()
+        self.labels: Optional[np.ndarray] = None
+        self.gen = None
+        self.error: Optional[Exception] = None
+        self.t_enq = time.perf_counter()
+        self.ctx = _tracing.current_context()
+
+
+_SHUTDOWN = object()
+
+#: Floor/ceiling on the adaptive inter-arrival estimate: the floor stops
+#: one dense burst from convincing the batcher that requests arrive
+#: every 0 s forever; the ceiling keeps one quiet night from making it
+#: sluggish at the next burst's front edge.
+_GAP_MIN_S, _GAP_MAX_S = 1e-5, 1.0
+
+
+class AssignEngine:
+    """The micro-batcher: a bounded queue drained by
+    ``assign_workers`` dispatcher threads, each coalescing its own
+    batch (batches are independent — every batch reads its own
+    generation snapshot — so they parallelize across BLAS streams).
+
+    ``current_model`` is a zero-arg callable returning the registry's
+    current :class:`Generation` (or None) — a dispatcher reads it once
+    per batch, which IS the hot-swap contract.  Worker threads start
+    lazily on the first :meth:`submit`, so constructing a server with
+    batching enabled costs nothing until ``/api/assign`` traffic
+    actually arrives (and a board-only process never touches jax).
+    """
+
+    #: Prepared generations kept after a swap: in-flight batches finish
+    #: on the old model while the next batch warms the new one.
+    _PREP_KEEP = 4
+
+    def __init__(self, current_model: Callable[[], object], config):
+        self.cfg = config
+        self._current_model = current_model
+        self._max_rows = max(int(config.assign_max_batch_rows),
+                             int(config.assign_max_points))
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=max(1, int(config.assign_pending_limit)))
+        self._n_workers = max(1, int(getattr(config, "assign_workers", 1)))
+        self._kernel_threads = max(
+            1, int(getattr(config, "assign_kernel_threads", 1)))
+        self._pool = None               # lazy, with the worker threads
+        self._closed = False            # stop() is permanent
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._thread_lock = threading.Lock()
+        self._gap_lock = threading.Lock()
+        self._gap_ewma = _GAP_MAX_S     # optimistic: sparse until proven
+        self._last_enq = None
+        # Shared across dispatcher workers; batch-granularity mutations
+        # under _stats_lock (cheap next to a kernel call).
+        self._stats_lock = threading.Lock()
+        self._prep: "collections.OrderedDict[int, PreparedModel]" = \
+            collections.OrderedDict()
+        self._n_batches = 0
+        self._n_rows = 0
+        self._n_requests = 0
+        self._n_fallback_rows = 0
+        self._shape_hits = 0
+        self._shape_misses = 0
+        self._bucket_counts: collections.Counter = collections.Counter()
+
+    # ------------------------------------------------------------ client
+    def submit(self, points: np.ndarray):
+        """Label ``points`` (n, d) float32 against one immutable
+        generation; returns ``(labels, generation)``.  Raises
+        :class:`NoModelError` / :class:`QueueFullError` /
+        :class:`AssignTimeoutError` (all -> 503 at the HTTP layer)."""
+        self._ensure_thread()
+        if not (isinstance(points, np.ndarray)
+                and points.dtype == np.float32
+                and points.flags.c_contiguous):
+            points = np.ascontiguousarray(points, np.float32)
+        if points.ndim != 2:
+            # Validated HERE, not only at the HTTP layer: a malformed
+            # in-process submit must fail alone, not poison the whole
+            # coalesced batch it would have joined.
+            raise ValueError(
+                f"points must be (n, d); got shape {points.shape}")
+        p = _Pending(points)
+        now = p.t_enq
+        with self._gap_lock:
+            if self._last_enq is not None:
+                gap = min(max(now - self._last_enq, _GAP_MIN_S),
+                          _GAP_MAX_S)
+                self._gap_ewma = 0.8 * self._gap_ewma + 0.2 * gap
+            self._last_enq = now
+        try:
+            self._q.put_nowait(p)
+        except queue.Full:
+            raise QueueFullError(
+                f"assign queue full ({self.cfg.assign_pending_limit} "
+                "pending requests); retry shortly") from None
+        if self._closed:
+            # Covers the enqueue-vs-stop() race: if stop()'s drain ran
+            # before this put landed, nobody else will fail it — drain
+            # again so this request gets its immediate 503 instead of
+            # the full timeout.
+            self._drain_pending()
+        with _tracing.span("assign.queue", category="serve_queue",
+                           rows=p.n):
+            done = p.event.wait(float(self.cfg.assign_timeout_s))
+        if not done:
+            raise AssignTimeoutError(
+                f"assign batch did not complete within "
+                f"{self.cfg.assign_timeout_s}s")
+        if p.error is not None:
+            raise p.error
+        return p.labels, p.gen
+
+    # ------------------------------------------------------------ control
+    def _ensure_thread(self) -> None:
+        if self._closed:
+            raise NoModelError("assign engine stopped")
+        if any(t.is_alive() for t in self._threads):
+            return
+        with self._thread_lock:
+            if self._closed:
+                raise NoModelError("assign engine stopped")
+            if any(t.is_alive() for t in self._threads):
+                return
+            self._stop.clear()
+            if self._pool is None and self._kernel_threads > 1:
+                import concurrent.futures
+
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self._kernel_threads - 1,
+                    thread_name_prefix="assign-kernel")
+            self._threads = [
+                threading.Thread(target=self._loop, daemon=True,
+                                 name=f"assign-batcher-{i}")
+                for i in range(self._n_workers)]
+            for t in self._threads:
+                t.start()
+
+    def stop(self) -> None:
+        """Stop the dispatchers — permanently — and fail anything still
+        queued (a stopping server answers 503, it does not hang
+        clients; a later submit cannot resurrect worker threads)."""
+        with self._thread_lock:
+            self._closed = True
+        self._stop.set()
+        live = [t for t in self._threads if t.is_alive()]
+        for _ in live:
+            try:
+                self._q.put_nowait(_SHUTDOWN)
+            except queue.Full:
+                break   # loops notice _stop at their next poll timeout
+        for t in live:
+            t.join(timeout=10.0)
+        with self._thread_lock:        # pairs with the start-side writer
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+        self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        while True:
+            try:
+                p = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if p is _SHUTDOWN:
+                continue
+            p.error = NoModelError("server stopping")
+            p.event.set()
+
+    def stats(self) -> Dict[str, object]:
+        """Snapshot of the engine counters (loadgen/tests)."""
+        with self._stats_lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> Dict[str, object]:
+        return {
+            "batches": self._n_batches,
+            "requests": self._n_requests,
+            "rows": self._n_rows,
+            "fallback_rows": self._n_fallback_rows,
+            "shape_cache_hits": self._shape_hits,
+            "shape_cache_misses": self._shape_misses,
+            "batch_rows_pow2": dict(self._bucket_counts),
+            "mean_batch_rows": (self._n_rows / self._n_batches
+                                if self._n_batches else 0.0),
+        }
+
+    # -------------------------------------------------------- dispatcher
+    def _loop(self) -> None:
+        carry = None
+        while not self._stop.is_set():
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            if first is _SHUTDOWN:
+                continue
+            batch = [first]
+            rows = first.n
+            # Phase 1 — greedy drain: everything ALREADY queued (it
+            # piled up while the previous batch was in the kernel)
+            # coalesces for free, no matter how old the oldest request
+            # is.  This is where batching comes from under sustained
+            # load: the kernel time of batch N is the coalescing window
+            # of batch N+1.
+            while rows < self._max_rows:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _SHUTDOWN:
+                    break
+                if rows + nxt.n > self._max_rows:
+                    carry = nxt          # opens the next batch instead
+                    break
+                batch.append(nxt)
+                rows += nxt.n
+            # Phase 2 — bounded wait for MORE: only while the oldest
+            # request's delay budget (assign_max_delay_s) lasts, and
+            # only while the observed arrival gap says another request
+            # plausibly lands inside it (the adaptive half: sparse
+            # traffic dispatches immediately, paying zero added delay).
+            deadline = first.t_enq + float(self.cfg.assign_max_delay_s)
+            while (carry is None and rows < self._max_rows
+                   and not self._stop.is_set()):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or self._gap_ewma > remaining:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _SHUTDOWN:
+                    break
+                if rows + nxt.n > self._max_rows:
+                    carry = nxt
+                    break
+                batch.append(nxt)
+                rows += nxt.n
+            try:
+                self._dispatch(batch)
+            except Exception as e:   # fail the batch, never the thread
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+        if carry is not None:
+            carry.error = NoModelError("server stopping")
+            carry.event.set()
+
+    def _prepared(self, gen) -> PreparedModel:
+        with self._stats_lock:
+            prep = self._prep.get(gen.generation)
+            if prep is not None and prep.gen is gen:
+                return prep
+        # Build OUTSIDE the lock (closure tables cost ~ms at k=1000);
+        # two workers racing a fresh generation build it twice, last
+        # writer wins — wasted work once per swap, never a wrong model.
+        prep = PreparedModel(
+            gen, prune_min_k=int(self.cfg.assign_prune_min_k))
+        with self._stats_lock:
+            self._prep[gen.generation] = prep
+            self._prep.move_to_end(gen.generation)
+            while len(self._prep) > self._PREP_KEEP:
+                self._prep.popitem(last=False)
+        return prep
+
+    def _bucket(self, rows: int) -> int:
+        b = max(1, int(self.cfg.assign_min_bucket))
+        while b < rows:
+            b <<= 1
+        return min(b, max(self._max_rows, rows))
+
+    def _dense_kernel(self, bucket: int, prep: PreparedModel):
+        # Accounting reads the REAL lru_cache, not a shadow set: if the
+        # builder cache ever evicts and retraces, that must show up as
+        # a miss (the whole point of the metric).  The before/after
+        # read is racy across concurrent dispatchers — at worst one
+        # batch's hit/miss attribution swaps, never the totals' drift.
+        before = _build_dense.cache_info().misses
+        fn = _build_dense(bucket, prep.k, prep.d)
+        hit = _build_dense.cache_info().misses == before
+        with self._stats_lock:
+            if hit:
+                self._shape_hits += 1
+            else:
+                self._shape_misses += 1
+        _SHAPE_CACHE_TOTAL.labels(event="hit" if hit else "miss").inc()
+        return fn
+
+    def _pad(self, x: np.ndarray, bucket: int) -> np.ndarray:
+        if x.shape[0] == bucket:
+            return x
+        xp = np.zeros((bucket, x.shape[1]), np.float32)
+        xp[: x.shape[0]] = x
+        return xp
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        # ONE generation per coalesced batch — the hot-swap contract.
+        gen = self._current_model()
+        if gen is None:
+            for p in batch:
+                p.error = NoModelError(
+                    "no model generation published yet; retry shortly")
+                p.event.set()
+            return
+        good = [p for p in batch if p.points.shape[1] == gen.d]
+        for p in batch:
+            if p.points.shape[1] != gen.d:
+                # The HTTP handler already validated this request's d
+                # against the generation it saw — reaching here means a
+                # swap CHANGED d mid-flight.  That is a model-lifecycle
+                # event, not a client mistake: retryable 503 (the
+                # client re-fetches /api/model and resubmits), never a
+                # terminal 400 for a request that was well-formed when
+                # sent.
+                p.error = NoModelError(
+                    f"model dimensionality changed mid-flight "
+                    f"(generation {gen.generation} expects d={gen.d}, "
+                    f"request has d={p.points.shape[1]}); retry")
+                p.event.set()
+        if not good:
+            return
+        t_disp = time.perf_counter()
+        rows = sum(p.n for p in good)
+        # One observation per batch, of the OLDEST member: that is the
+        # quantity assign_max_delay_s bounds (and 30 per-request
+        # observes per batch were measurable dispatcher overhead).
+        _QUEUE_DELAY_SECONDS.observe(
+            t_disp - min(p.t_enq for p in good))
+        prep = self._prepared(gen)
+        kind = "pruned" if prep.pruned else "dense"
+        # The batch span chains into the FIRST request's trace, so one
+        # trace shows the whole request -> queue -> batch -> kernel
+        # path; the request count rides as an attr.
+        ctx = next((p.ctx for p in good if p.ctx is not None), None)
+        with _tracing.use_context(ctx), \
+                _tracing.span("assign.batch", category="serve_batch",
+                              rows=rows, requests=len(good),
+                              kernel=kind, generation=gen.generation):
+            x = (good[0].points if len(good) == 1
+                 else np.concatenate([p.points for p in good]))
+            labels = self._run_kernel(kind, prep, x, rows)
+        with self._stats_lock:
+            self._n_batches += 1
+            self._n_requests += len(good)
+            self._n_rows += rows
+            # Pow2-ROUNDED rows, as a compact distribution summary for
+            # every batch — only the dense path actually pads to these
+            # shapes (the pruned host kernel takes raw rows).
+            self._bucket_counts[self._bucket(rows)] += 1
+        _BATCH_ROWS.observe(rows)
+        _BATCHES_TOTAL.labels(kernel=kind).inc()
+        off = 0
+        for p in good:
+            p.labels = labels[off:off + p.n]
+            p.gen = gen
+            off += p.n
+            p.event.set()
+
+    def _run_kernel(self, kind: str, prep: PreparedModel,
+                    x: np.ndarray, rows: int) -> np.ndarray:
+        with _tracing.span("assign.kernel", category="serve_kernel",
+                           kernel=kind, rows=rows):
+            if kind == "pruned":
+                labels, ok = _pruned_host(x, prep, pool=self._pool,
+                                          chunks=self._kernel_threads)
+                bad = np.flatnonzero(~ok)
+                if bad.size:
+                    # Certificate failures rescore densely: pruning is
+                    # an optimization, never an approximation.  Host
+                    # dense on purpose — failures are a small tail, and
+                    # a tiny BLAS GEMM beats a padded jit dispatch.
+                    with self._stats_lock:
+                        self._n_fallback_rows += int(bad.size)
+                    _FALLBACK_ROWS_TOTAL.inc(int(bad.size))
+                    sub = np.ascontiguousarray(x[bad])
+                    d2 = (-2.0 * (sub @ prep.gen.centroids.T)
+                          + prep.csq[None, :])
+                    labels[bad] = d2.argmin(axis=1).astype(np.int32)
+                return labels
+            bucket = self._bucket(rows)
+            fn = self._dense_kernel(bucket, prep)
+            c_dev, csq_dev = prep.dense_dev()
+            return np.asarray(fn(self._pad(x, bucket), c_dev,
+                                 csq_dev))[:rows]

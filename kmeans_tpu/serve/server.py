@@ -54,6 +54,7 @@ from kmeans_tpu.session import (
 )
 from kmeans_tpu import obs
 from kmeans_tpu.obs import tracing as _tracing
+from kmeans_tpu.serve import assign as serve_assign
 from kmeans_tpu.utils import faults
 from kmeans_tpu.utils.rooms import code4
 
@@ -361,6 +362,15 @@ class KMeansServer:
             # model should exist is exactly what the verified format
             # forbids.
             self.model_registry.load_latest()
+        # The high-QPS assignment engine (serve/assign.py): constructed
+        # up front (it is just a queue), but its dispatcher thread — and
+        # therefore the jax runtime — starts only on the first
+        # /api/assign submit, so a board-only deployment stays
+        # device-free.  assign_batching=False keeps the plain
+        # per-request NumPy path.
+        self.assign_engine = (
+            serve_assign.AssignEngine(self.current_model, self.config)
+            if self.config.assign_batching else None)
         self._train_sem = threading.BoundedSemaphore(
             self.config.max_concurrent_train
         )
@@ -543,6 +553,28 @@ class KMeansServer:
         nothing published) — the one read the /api/assign path does."""
         reg = self.model_registry
         return reg.current() if reg is not None else None
+
+    def assign_points(self, points):
+        """Label ``points`` (n, d) float32 — the one entry both the
+        HTTP handler and in-process drivers (tools/loadgen.py) use.
+
+        Returns ``(labels, generation, path)`` with ``path`` in
+        ``batched`` (micro-batcher + jitted kernels) / ``direct``
+        (per-request NumPy, ``assign_batching=False``).  Raises the
+        engine's retryable errors (-> 503) or ValueError (-> 400)."""
+        eng = self.assign_engine
+        if eng is not None:
+            labels, gen = eng.submit(points)
+            return labels, gen, "batched"
+        gen = self.current_model()
+        if gen is None:
+            raise serve_assign.NoModelError(
+                "no model generation published yet; retry shortly")
+        if points.ndim != 2 or points.shape[1] != gen.d:
+            raise ValueError(
+                f"points must be (n, {gen.d}) for generation "
+                f"{gen.generation}; got shape {tuple(points.shape)}")
+        return serve_assign.assign_direct(gen, points), gen, "direct"
 
     def room(self, code: Optional[str]) -> _Room:
         # Restrict to the reference's room-code alphabet shape (app.mjs:19):
@@ -1290,14 +1322,17 @@ class KMeansServer:
                     self._error(e)
 
             def _assign(self):
-                """Nearest-centroid labels against the CURRENT generation.
+                """Nearest-centroid labels against ONE immutable
+                generation (docs/SERVING.md).
 
-                The hot-swap contract in one handler: the generation
-                reference is read once, every distance below uses that
-                immutable snapshot, and a registry swap mid-request
-                changes nothing this request sees — in-flight requests
-                finish on the old model, the next request gets the new
-                one, nothing is ever dropped for a swap.
+                The hot-swap contract, preserved from the per-request
+                era: with batching on, the micro-batcher reads the
+                generation reference once per coalesced batch and every
+                request in it is answered from — and reports — that
+                snapshot; a registry swap mid-flight changes nothing a
+                queued request sees, and nothing is ever dropped for a
+                swap.  The direct path reads it once per request, as
+                before.
                 """
                 import numpy as np
 
@@ -1320,10 +1355,11 @@ class KMeansServer:
                 if not isinstance(pts, list) or not pts:
                     raise ValueError("points must be a non-empty list of "
                                      "rows")
-                if len(pts) > 4096:
+                cap = int(server.config.assign_max_points)
+                if len(pts) > cap:
                     raise PayloadTooLargeError(
-                        f"assign accepts at most 4096 points per request, "
-                        f"got {len(pts)}"
+                        f"assign accepts at most {cap} points per "
+                        f"request, got {len(pts)}"
                     )
                 x = np.asarray(pts, np.float32)
                 if x.ndim != 2 or x.shape[1] != gen.d:
@@ -1331,18 +1367,25 @@ class KMeansServer:
                         f"points must be (n, {gen.d}) for generation "
                         f"{gen.generation}; got shape {tuple(x.shape)}"
                     )
-                c = gen.centroids
-                # Plain numpy on purpose: k·d is registry-scale (one
-                # model, not a dataset), and the serve process must not
-                # initialize the jax runtime to label a few rows.
-                d2 = ((x * x).sum(1)[:, None] - 2.0 * (x @ c.T)
-                      + (c * c).sum(1)[None, :])
-                labels = d2.argmin(1)
+                if not np.isfinite(x).all():
+                    # Distances against NaN/Inf are meaningless; the old
+                    # path silently returned argmin-of-NaN labels.
+                    raise ValueError(
+                        "points must be finite (got NaN/Inf values)")
+                t0 = time.perf_counter()
+                try:
+                    labels, gen_used, path = server.assign_points(x)
+                except (serve_assign.NoModelError,
+                        serve_assign.QueueFullError,
+                        serve_assign.AssignTimeoutError) as e:
+                    return self._busy(e)
+                serve_assign.ASSIGN_REQUEST_SECONDS.labels(
+                    path=path).observe(time.perf_counter() - t0)
                 _ASSIGN_POINTS_TOTAL.inc(x.shape[0])
                 return self._json({
                     "labels": [int(v) for v in labels],
-                    "generation": gen.generation,
-                    "k": gen.k,
+                    "generation": gen_used.generation,
+                    "k": gen_used.k,
                 })
 
         return Handler
@@ -1376,6 +1419,10 @@ class KMeansServer:
         if self.httpd:
             self.httpd.shutdown()
             self.httpd.server_close()
+        if self.assign_engine is not None:
+            # AFTER the HTTP teardown: handler threads still waiting on
+            # a batch get their 503 from the drain instead of hanging.
+            self.assign_engine.stop()
         if self._tracer_held:        # idempotent: one release per server
             self._tracer_held = False
             with _TRACER_HOLDS_LOCK:
@@ -1389,12 +1436,25 @@ def serve(host: str = "127.0.0.1", port: int = 8787, *,
           persist_dir: Optional[str] = None,
           metrics: bool = True,
           telemetry_path: Optional[str] = None,
-          model_dir: Optional[str] = None) -> KMeansServer:
+          model_dir: Optional[str] = None,
+          assign_batching: Optional[bool] = None,
+          assign_max_delay_s: Optional[float] = None,
+          assign_max_batch_rows: Optional[int] = None,
+          assign_max_points: Optional[int] = None) -> KMeansServer:
+    # None = the ServeConfig default (one source of truth for knob
+    # defaults; the CLI passes through only what the user set).
+    extra = {k: v for k, v in (
+        ("assign_batching", assign_batching),
+        ("assign_max_delay_s", assign_max_delay_s),
+        ("assign_max_batch_rows", assign_max_batch_rows),
+        ("assign_max_points", assign_max_points),
+    ) if v is not None}
     s = KMeansServer(ServeConfig(host=host, port=port,
                                  persist_dir=persist_dir,
                                  metrics=metrics,
                                  telemetry_path=telemetry_path,
-                                 model_dir=model_dir))
+                                 model_dir=model_dir,
+                                 **extra))
     try:
         s.start(background=background)
     except KeyboardInterrupt:

@@ -1,0 +1,395 @@
+"""High-QPS assignment engine tests (kmeans_tpu/serve/assign.py):
+micro-batch coalescing, adaptive/bounded queue delay, compiled-shape
+cache accounting, closure-pruned exactness, hot-swap self-consistency
+under hammer, and the loadgen smoke acceptance (docs/SERVING.md)."""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import ServeConfig
+from kmeans_tpu.continuous.registry import Generation, ModelRegistry
+from kmeans_tpu.serve import KMeansServer
+from kmeans_tpu.serve import assign as A
+
+
+def _cfg(**kw):
+    return dataclasses.replace(
+        ServeConfig(host="127.0.0.1", port=0, tracing=False), **kw)
+
+
+def _engine(gen_or_fn, **kw):
+    fn = gen_or_fn if callable(gen_or_fn) else (lambda: gen_or_fn)
+    return A.AssignEngine(fn, _cfg(**kw))
+
+
+def _clustered(k, d, n, seed=0):
+    rng = np.random.RandomState(seed)
+    g = max(2, int(round(k ** 0.5)))
+    meta = rng.randn(g, d).astype(np.float32) * 10
+    c = (meta[rng.randint(g, size=k)]
+         + rng.randn(k, d).astype(np.float32))
+    x = (meta[rng.randint(g, size=n)]
+         + rng.randn(n, d).astype(np.float32) * 2)
+    return c.astype(np.float32), x.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: coalescing, delay bound, backpressure, shape cache
+# ---------------------------------------------------------------------------
+
+def _slow_kernel(engine, delay):
+    """Wrap _run_kernel with a sleep: holds the dispatcher in 'kernel'
+    long enough for followers to pile up (the coalescing window)."""
+    orig = engine._run_kernel
+
+    def slow(kind, prep, x, rows):
+        time.sleep(delay)
+        return orig(kind, prep, x, rows)
+
+    engine._run_kernel = slow
+    return engine
+
+
+def test_concurrent_requests_coalesce_into_fewer_batches():
+    gen = Generation(np.array([[0.0, 0.0], [10.0, 10.0]], np.float32), 1)
+    eng = _slow_kernel(_engine(gen), 0.05)
+    try:
+        results = []
+        lock = threading.Lock()
+
+        def go(i):
+            labels, g = eng.submit(
+                np.full((4, 2), float(i % 11), np.float32))
+            with lock:
+                results.append((labels, g.generation))
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 12
+        assert all(g == 1 and labels.shape == (4,)
+                   for labels, g in results)
+        st = eng.stats()
+        # Batch 1 takes whoever won the race; everyone arriving during
+        # its 50 ms kernel coalesces into batch 2 (maybe 3).
+        assert st["requests"] == 12
+        assert st["batches"] <= 4, st
+    finally:
+        eng.stop()
+
+
+def test_lone_request_dispatches_immediately_despite_large_delay_cap():
+    """The adaptive half: with no recent arrivals the batcher must not
+    tax a lone request the full assign_max_delay_s."""
+    gen = Generation(np.zeros((2, 2), np.float32), 1)
+    eng = _engine(gen, assign_max_delay_s=0.5)
+    try:
+        t0 = time.perf_counter()
+        eng.submit(np.ones((1, 2), np.float32))
+        assert time.perf_counter() - t0 < 0.25
+    finally:
+        eng.stop()
+
+
+def test_queue_delay_bounded_under_slow_batches():
+    """While one slow batch occupies the kernel, followers wait at most
+    kernel-time + assign_max_delay_s — the phase-2 wait cannot extend a
+    batch past its deadline even under a steady arrival trickle."""
+    gen = Generation(np.zeros((2, 2), np.float32), 1)
+    kernel_s, delay_s = 0.15, 0.02
+    eng = _slow_kernel(_engine(gen, assign_max_delay_s=delay_s),
+                       kernel_s)
+    try:
+        durations = []
+        lock = threading.Lock()
+
+        def go():
+            t0 = time.perf_counter()
+            eng.submit(np.ones((2, 2), np.float32))
+            with lock:
+                durations.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=go) for _ in range(8)]
+        for t in threads:
+            t.start()
+            time.sleep(0.01)         # steady trickle, not one burst
+        for t in threads:
+            t.join(timeout=10)
+        assert len(durations) == 8
+        # Worst case: a request lands just after batch N dispatches ->
+        # waits batch N's kernel, its own delay window, its own kernel.
+        assert max(durations) < 2 * kernel_s + delay_s + 0.2
+    finally:
+        eng.stop()
+
+
+def test_queue_full_backpressure():
+    gen = Generation(np.zeros((2, 2), np.float32), 1)
+    eng = _slow_kernel(_engine(gen, assign_pending_limit=2), 0.5)
+    try:
+        threads = [threading.Thread(
+            target=lambda: eng.submit(np.ones((1, 2), np.float32)))
+            for _ in range(3)]
+        threads[0].start()
+        time.sleep(0.15)   # dispatcher is mid-kernel with request 1...
+        for t in threads[1:]:
+            t.start()      # ...so these two fill the queue to its cap
+        time.sleep(0.15)
+        with pytest.raises(A.QueueFullError):
+            eng.submit(np.ones((1, 2), np.float32))
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        eng.stop()
+
+
+def test_no_model_is_retryable_error():
+    eng = _engine(lambda: None)
+    try:
+        with pytest.raises(A.NoModelError):
+            eng.submit(np.ones((1, 2), np.float32))
+    finally:
+        eng.stop()
+
+
+def test_shape_cache_accounting_across_generations():
+    """Same request shapes across a generation swap reuse the compiled
+    bucket programs: misses stay at the bucket ladder, hits grow —
+    retrace-free hot-swap, the RET analyzers' serving contract."""
+    reg = ModelRegistry()
+    reg.publish(np.zeros((4, 3), np.float32))
+    eng = _engine(reg.current)
+    try:
+        for _ in range(3):
+            eng.submit(np.ones((5, 3), np.float32))   # bucket 64
+        misses_before_swap = eng.stats()["shape_cache_misses"]
+        # <=1, not ==1: accounting reads the process-global builder
+        # lru, which another test in this process may have warmed.
+        assert misses_before_swap <= 1
+        reg.publish(np.ones((4, 3), np.float32))
+        for _ in range(3):
+            eng.submit(np.ones((7, 3), np.float32))   # same bucket
+        st = eng.stats()
+        assert st["shape_cache_misses"] == misses_before_swap
+        assert st["shape_cache_hits"] >= 4
+    finally:
+        eng.stop()
+
+
+def test_pruned_kernel_exact_and_fallback_safe():
+    """Closure pruning is an optimization, never an approximation:
+    clustered data (certificate passes) and adversarial uniform data
+    (certificate fails, dense fallback) must both match the dense
+    argmin."""
+    k, d = 512, 64
+    c, x = _clustered(k, d, 512, seed=3)
+    rng = np.random.RandomState(9)
+    x_uniform = (rng.randn(256, d).astype(np.float32) * 30)
+    gen = Generation(c, 1)
+    eng = _engine(gen)        # k=512 >= default prune_min_k=256
+    try:
+        for pts in (x, x_uniform):
+            labels, g = eng.submit(pts)
+            ref = A.assign_direct(gen, pts)
+            d_got = ((pts - c[labels]) ** 2).sum(1)
+            d_ref = ((pts - c[ref]) ** 2).sum(1)
+            # Distance-level equality (float ties may pick either).
+            np.testing.assert_allclose(d_got, d_ref, rtol=1e-4,
+                                       atol=1e-3)
+        st = eng.stats()
+        assert st["batches"] >= 2
+    finally:
+        eng.stop()
+
+
+def test_prepared_model_caches_per_generation():
+    reg = ModelRegistry()
+    reg.publish(_clustered(300, 8, 1)[0])
+    eng = _engine(reg.current)
+    try:
+        eng.submit(np.ones((3, 8), np.float32))
+        prep1 = next(iter(eng._prep.values()))
+        assert prep1.pruned and prep1.csq.shape == (300,)
+        eng.submit(np.ones((3, 8), np.float32))
+        assert next(iter(eng._prep.values())) is prep1   # reused
+        reg.publish(_clustered(300, 8, 1, seed=1)[0])
+        eng.submit(np.ones((3, 8), np.float32))
+        assert len(eng._prep) == 2                       # old kept
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# ops.hamerly.closure_candidates invariants
+# ---------------------------------------------------------------------------
+
+def test_closure_candidates_tables_are_sound():
+    from kmeans_tpu.ops.hamerly import closure_candidates
+
+    c, _ = _clustered(200, 16, 1, seed=5)
+    gc, cand, thr = closure_candidates(c, n_groups=8, cand_len=40)
+    assert gc.shape == (8, 16) and cand.shape == (8, 40)
+    for g in range(8):
+        dist = np.sqrt(((c - gc[g]) ** 2).sum(1))
+        inside = dist[cand[g]]
+        outside = np.delete(dist, cand[g])
+        # Candidates are the nearest, the threshold is the nearest
+        # EXCLUDED centroid — the triangle-inequality certificate's
+        # whole soundness rests on these two facts.
+        assert inside.max() <= outside.min() + 1e-4
+        assert abs(thr[g] - outside.min()) <= 1e-3 * (1 + outside.min())
+
+
+def test_closure_candidates_full_coverage_threshold_is_inf():
+    from kmeans_tpu.ops.hamerly import closure_candidates
+
+    c = np.random.RandomState(0).randn(10, 4).astype(np.float32)
+    _, cand, thr = closure_candidates(c, n_groups=2, cand_len=10)
+    assert np.isinf(thr).all() and cand.shape == (2, 10)
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer: validation, hammer-across-swaps, direct path
+# ---------------------------------------------------------------------------
+
+def _post(base, path, obj):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture()
+def swap_server():
+    reg = ModelRegistry()
+    s = KMeansServer(_cfg(), registry=reg)
+    httpd = s.start(background=True)
+    s.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    s.reg = reg
+    yield s
+    s.stop()
+
+
+def test_assign_rejects_nonfinite_points_with_400(swap_server):
+    swap_server.reg.publish(np.zeros((2, 2), np.float32))
+    for bad in (float("nan"), float("inf"), -float("inf")):
+        st, out = _post(swap_server.base, "/api/assign",
+                        {"points": [[bad, 0.0]]})
+        assert st == 400 and "finite" in out["error"]
+
+
+def test_assign_point_cap_is_configurable():
+    reg = ModelRegistry()
+    reg.publish(np.zeros((2, 2), np.float32))
+    s = KMeansServer(_cfg(assign_max_points=8), registry=reg)
+    httpd = s.start(background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        st, _ = _post(base, "/api/assign", {"points": [[0, 0]] * 8})
+        assert st == 200
+        st, out = _post(base, "/api/assign", {"points": [[0, 0]] * 9})
+        assert st == 413 and "8" in out["error"]
+    finally:
+        s.stop()
+
+
+def test_direct_path_when_batching_disabled():
+    reg = ModelRegistry()
+    reg.publish(np.array([[0.0, 0.0], [10.0, 10.0]], np.float32))
+    s = KMeansServer(_cfg(assign_batching=False), registry=reg)
+    assert s.assign_engine is None
+    httpd = s.start(background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        st, out = _post(base, "/api/assign",
+                        {"points": [[1, 1], [9, 9]]})
+        assert st == 200
+        assert out == {"labels": [0, 1], "generation": 1, "k": 2}
+    finally:
+        s.stop()
+
+
+def test_hammer_across_swaps_every_response_self_consistent(swap_server):
+    """The tentpole's serving contract: concurrent batched /api/assign
+    during repeated registry swaps — zero drops, and every response's
+    labels were computed against the generation it REPORTS (one
+    immutable generation per coalesced batch).  Generation g serves
+    centroids [[(-1)^g], [-(-1)^g]], so the correct label for point
+    [0.6] is determined by the generation number alone."""
+    def cents(g):
+        sign = 1.0 if g % 2 == 0 else -1.0
+        return np.array([[sign], [-sign]], np.float32)
+
+    swap_server.reg.publish(cents(1), generation=1)
+    stop = threading.Event()
+    bad, counts = [], [0]
+    lock = threading.Lock()
+
+    def hammer():
+        while not stop.is_set():
+            st, out = _post(swap_server.base, "/api/assign",
+                            {"points": [[0.6]]})
+            with lock:
+                counts[0] += 1
+                if st != 200:
+                    bad.append((st, out))
+                    continue
+                want = 0 if out["generation"] % 2 == 0 else 1
+                if out["labels"][0] != want:
+                    bad.append(("inconsistent", out))
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for g in range(2, 40):
+        swap_server.reg.publish(cents(g), generation=g)
+        time.sleep(0.008)
+    time.sleep(0.1)        # a post-swap tail so stragglers land too
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    # Floor is deliberately loose: on a loaded CI box 4 client threads
+    # may only push ~50 requests through the window — the property
+    # under test is consistency, not throughput.
+    assert counts[0] > 20
+    assert not bad, bad[:5]
+
+
+def test_engine_metrics_registered_and_exposed(swap_server):
+    swap_server.reg.publish(np.zeros((2, 2), np.float32))
+    _post(swap_server.base, "/api/assign", {"points": [[0.0, 0.0]]})
+    with urllib.request.urlopen(swap_server.base + "/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    for name in ("kmeans_tpu_assign_request_seconds",
+                 "kmeans_tpu_assign_batch_rows",
+                 "kmeans_tpu_assign_queue_delay_seconds",
+                 "kmeans_tpu_assign_batches_total",
+                 "kmeans_tpu_assign_shape_cache_total"):
+        assert name in text, name
+
+
+# ---------------------------------------------------------------------------
+# loadgen smoke (tier-1 acceptance: batched traffic + mid-load swap)
+# ---------------------------------------------------------------------------
+
+def test_loadgen_smoke(capsys):
+    from tools import loadgen
+
+    assert loadgen.main(["--smoke"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["smoke_ok"] and out["dropped"] == 0
+    assert out["batches"] > 0 and out["generations"] > 1

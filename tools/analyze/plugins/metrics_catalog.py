@@ -42,6 +42,7 @@ MODULES = [
     "kmeans_tpu.models.streaming",
     "kmeans_tpu.models.gmm_stream",
     "kmeans_tpu.parallel.engine",
+    "kmeans_tpu.serve.assign",
     "kmeans_tpu.serve.server",
     "kmeans_tpu.continuous.drift",
     "kmeans_tpu.continuous.window",

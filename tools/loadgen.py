@@ -1,0 +1,480 @@
+"""Load generator for the /api/assign serving path (docs/SERVING.md).
+
+Drives nearest-centroid assignment traffic at a :class:`KMeansServer`
+and reports sustained QPS + latency percentiles.  Two loops, two
+transports:
+
+* **closed loop** (``--concurrency C``): C workers send back-to-back —
+  measures the server's capacity (QPS at full load).
+* **open loop** (``--rate R``): requests depart on a fixed schedule
+  regardless of completions — measures latency at a *given* offered
+  load, the honest way (closed-loop latency self-throttles).  Workers
+  that fall behind the schedule are counted (``late``), so overload is
+  visible instead of silently stretching the schedule.
+* **transports**: ``inproc`` calls :meth:`KMeansServer.assign_points`
+  from worker threads (the engine's own cost, no socket/JSON overhead);
+  ``http`` POSTs real JSON over real sockets (add ``--base`` to aim at
+  an external server instead of the built-in one).
+
+``--bench`` runs the committed evidence protocol (ISSUE 7), closed
+loop at k=1000, d=300, all under the same harness:
+
+1. ``per_request_legacy`` — the PR 6 handler's math verbatim (one
+   generation read, then per-request NumPy *recomputing*
+   ``(c*c).sum(1)``): the "current per-request path" the acceptance
+   gate's 5x is measured against;
+2. ``per_request_cached`` — the satellite-1-fixed direct path
+   (``assign_batching=False``: cached squared norms, still one NumPy
+   call per request), reported so the micro-batcher's win is not
+   conflated with the norm-caching fix;
+3. ``batched`` — the engine;
+4. ``hot_swap`` — the engine under full load with a generation
+   published every 250 ms; zero dropped requests required.
+
+Writes ``BENCH_SERVE_latest.json``; render it with
+``python tools/bench_table.py --serve``.
+
+``--smoke`` is the tier-1-sized acceptance run (~2 s on CPU): batched
+in-process traffic plus one mid-load swap; exits non-zero on any drop
+or if the batcher never coalesced.
+
+Run it::
+
+    python -m tools.loadgen --concurrency 16 --duration 3
+    python -m tools.loadgen --rate 500 --duration 5 --transport http
+    python -m tools.loadgen --bench          # writes BENCH_SERVE_latest.json
+    python -m tools.loadgen --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: --bench acceptance gates (ISSUE 7): batched QPS >= GATE_SPEEDUP x
+#: per-request QPS at k=1000/d=300; zero drops across the hot-swap
+#: drill.
+GATE_SPEEDUP = 5.0
+GATE_MAX_DROPPED = 0
+
+
+def _make_data(k: int, d: int, n: int, seed: int = 0):
+    """Clustered synthetic model + query pool: k centroids scattered
+    around sqrt(k) meta-centers (serving pruning is data-dependent;
+    clustered is the realistic case the closure tables exist for), and
+    a pool of query rows drawn around the same meta-centers."""
+    rng = np.random.RandomState(seed)
+    g = max(2, int(round(k ** 0.5)))
+    meta = rng.randn(g, d).astype(np.float32) * 10.0
+    c = (meta[rng.randint(g, size=k)]
+         + rng.randn(k, d).astype(np.float32))
+    x = (meta[rng.randint(g, size=n)]
+         + rng.randn(n, d).astype(np.float32) * 2.0)
+    return c.astype(np.float32), x.astype(np.float32)
+
+
+def _make_server(k: int, d: int, *, batching: bool, seed: int = 0,
+                 http: bool = False):
+    """In-process server + in-memory registry with generation 1
+    published; returns (server, registry, base_url_or_None, queries)."""
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.continuous.registry import ModelRegistry
+    from kmeans_tpu.serve import KMeansServer
+
+    c, x = _make_data(k, d, n=8192, seed=seed)
+    reg = ModelRegistry()
+    reg.publish(c, trigger="initial")
+    cfg = ServeConfig(host="127.0.0.1", port=0, assign_batching=batching,
+                      tracing=False)
+    server = KMeansServer(cfg, registry=reg)
+    base = None
+    if http:
+        httpd = server.start(background=True)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    return server, reg, base, x
+
+
+class _Result:
+    """Shared accumulator: per-thread latency lists merged at the end
+    (no lock on the hot path)."""
+
+    def __init__(self):
+        self.lat_lists = []
+        self.ok = 0
+        self.dropped = 0
+        self.late = 0
+        self.errors = []
+        self._lock = threading.Lock()
+
+    def merge(self, lats, ok, dropped, late, errors):
+        with self._lock:
+            self.lat_lists.append(lats)
+            self.ok += ok
+            self.dropped += dropped
+            self.late += late
+            self.errors.extend(errors[:3])
+
+
+def _percentiles(lats: np.ndarray) -> dict:
+    if lats.size == 0:
+        return {"p50_ms": None, "p90_ms": None, "p99_ms": None,
+                "max_ms": None, "mean_ms": None}
+    q = np.percentile(lats, (50, 90, 99))
+    return {
+        "p50_ms": round(float(q[0]) * 1e3, 3),
+        "p90_ms": round(float(q[1]) * 1e3, 3),
+        "p99_ms": round(float(q[2]) * 1e3, 3),
+        "max_ms": round(float(lats.max()) * 1e3, 3),
+        "mean_ms": round(float(lats.mean()) * 1e3, 3),
+    }
+
+
+def _send_inproc(server, pts):
+    from kmeans_tpu.serve import assign as serve_assign
+
+    try:
+        server.assign_points(pts)
+        return "ok"
+    except (serve_assign.NoModelError, serve_assign.QueueFullError,
+            serve_assign.AssignTimeoutError) as e:
+        return f"unavailable: {e}"
+
+
+def _send_http(base, body):
+    req = urllib.request.Request(
+        base + "/api/assign", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+            return "ok" if r.status == 200 else f"status {r.status}"
+    except urllib.error.HTTPError as e:
+        e.read()
+        return f"status {e.code}"
+    except OSError as e:
+        return f"io: {e}"
+
+
+def legacy_sender(server):
+    """The PR 6 /api/assign math, verbatim: one generation read per
+    request, per-request NumPy with ``(c*c).sum(1)`` recomputed — the
+    bench's 'current per-request path' baseline."""
+    def send(pts):
+        gen = server.current_model()
+        if gen is None:
+            return "unavailable: no model"
+        c = gen.centroids
+        d2 = ((pts * pts).sum(1)[:, None] - 2.0 * (pts @ c.T)
+              + (c * c).sum(1)[None, :])
+        d2.argmin(1)
+        return "ok"
+
+    return send
+
+
+def _engine_stats_delta(before: dict, after: dict) -> dict:
+    """Per-window view of the engine's monotonic counters: the artifact
+    must describe THE MEASURED WINDOW, not everything since server
+    construction (warmup included)."""
+    out = {}
+    for key in ("batches", "requests", "rows", "fallback_rows",
+                "shape_cache_hits", "shape_cache_misses"):
+        out[key] = after.get(key, 0) - before.get(key, 0)
+    b0 = before.get("batch_rows_pow2", {})
+    out["batch_rows_pow2"] = {
+        k: v - b0.get(k, 0)
+        for k, v in after.get("batch_rows_pow2", {}).items()
+        if v - b0.get(k, 0) > 0}
+    out["mean_batch_rows"] = (out["rows"] / out["batches"]
+                              if out["batches"] else 0.0)
+    return out
+
+
+def run_load(server, base, queries, *, points: int, duration: float,
+             concurrency: int, rate: float = 0.0, sender=None) -> dict:
+    """One measured window; closed loop unless ``rate`` > 0.
+    ``sender`` overrides the default transport (a callable
+    ``pts -> "ok" | error-string``)."""
+    res = _Result()
+    if points > queries.shape[0]:
+        # Silently sending fewer rows than requested would overstate
+        # points/s (the accounting multiplies by `points`).
+        print(f"[loadgen] --points {points} exceeds the "
+              f"{queries.shape[0]}-row query pool; clamping",
+              file=sys.stderr)
+        points = queries.shape[0]
+    stop = time.perf_counter() + duration
+    t_start = time.perf_counter()
+    counter = [0]
+    counter_lock = threading.Lock()
+    pool = queries.shape[0] - points
+
+    def worker(wid: int):
+        rng = np.random.RandomState(1000 + wid)
+        lats, ok, dropped, late, errors = [], 0, 0, 0, []
+        body = None
+        while True:
+            now = time.perf_counter()
+            if now >= stop:
+                break
+            if rate > 0:
+                with counter_lock:
+                    i = counter[0]
+                    counter[0] += 1
+                t_sched = t_start + i / rate
+                if t_sched >= stop:
+                    break
+                delay = t_sched - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                else:
+                    late += 1
+            off = rng.randint(0, max(1, pool))
+            pts = queries[off:off + points]
+            if base is not None and sender is None:
+                # Serialize OUTSIDE the timed window: client-side
+                # json.dumps is loadgen cost, not server latency.
+                body = json.dumps({"points": pts.tolist()}).encode()
+            t0 = time.perf_counter()
+            if sender is not None:
+                out = sender(pts)
+            elif base is None:
+                out = _send_inproc(server, pts)
+            else:
+                out = _send_http(base, body)
+            lat = time.perf_counter() - t0
+            if out == "ok":
+                ok += 1
+                lats.append(lat)
+            else:
+                dropped += 1
+                errors.append(out)
+        res.merge(lats, ok, dropped, late, errors)
+
+    eng = getattr(server, "assign_engine", None)
+    stats_before = eng.stats() if eng is not None else None
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    lats = (np.concatenate([np.asarray(l) for l in res.lat_lists])
+            if any(len(l) for l in res.lat_lists) else np.empty(0))
+    out = {
+        "requests": res.ok + res.dropped,
+        "ok": res.ok,
+        "dropped": res.dropped,
+        "late": res.late,
+        "errors": res.errors[:5],
+        "wall_s": round(wall, 3),
+        "qps": round(res.ok / wall, 1) if wall > 0 else 0.0,
+        "points_per_s": round(res.ok * points / wall, 1) if wall else 0.0,
+        **_percentiles(lats),
+    }
+    if eng is not None:
+        out["engine"] = _engine_stats_delta(stats_before, eng.stats())
+    return out
+
+
+def _swap_thread(reg, interval: float, stop_evt: threading.Event,
+                 seed: int = 7):
+    """Publish a perturbed generation every ``interval`` s until told to
+    stop — the mid-load hot-swap the zero-drop gate hammers."""
+    rng = np.random.RandomState(seed)
+    base = reg.current().centroids
+
+    def loop():
+        while not stop_evt.wait(interval):
+            reg.publish(base + rng.randn(*base.shape).astype(np.float32)
+                        * 0.01, trigger="drift")
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+def run_bench(args) -> int:
+    """The committed evidence protocol -> BENCH_SERVE_latest.json."""
+    k, d, points = args.k, args.d, args.points
+    conc, dur = args.concurrency, args.duration
+    record = {
+        "bench": "serve",
+        "ts": round(time.time(), 3),
+        "params": {"k": k, "d": d, "points_per_request": points,
+                   "concurrency": conc, "duration_s": dur,
+                   "transport": "inproc",
+                   "swap_interval_s": args.swap_every},
+    }
+
+    print(f"[loadgen] legacy per-request baseline (PR 6 math): k={k} "
+          f"d={d} n/req={points} C={conc} {dur}s", file=sys.stderr)
+    server, _, _, x = _make_server(k, d, batching=False, seed=args.seed)
+    legacy = legacy_sender(server)
+    # Warmup outside the window (BLAS thread spin-up).
+    run_load(server, None, x, points=points, duration=0.5,
+             concurrency=conc, sender=legacy)
+    record["per_request_legacy"] = run_load(
+        server, None, x, points=points, duration=dur, concurrency=conc,
+        sender=legacy)
+
+    print("[loadgen] cached-norms per-request path (satellite fix)",
+          file=sys.stderr)
+    run_load(server, None, x, points=points, duration=0.5,
+             concurrency=conc)
+    record["per_request_cached"] = run_load(
+        server, None, x, points=points, duration=dur, concurrency=conc)
+    server.stop()
+
+    print("[loadgen] micro-batched engine, same load", file=sys.stderr)
+    server, reg, _, x = _make_server(k, d, batching=True, seed=args.seed)
+    run_load(server, None, x, points=points, duration=0.5,
+             concurrency=conc)        # warmup builds the closure tables
+    record["batched"] = run_load(server, None, x, points=points,
+                                 duration=dur, concurrency=conc)
+
+    print("[loadgen] hot-swap drill under batched load", file=sys.stderr)
+    stop_evt = threading.Event()
+    gen_before = reg.generation
+    _swap_thread(reg, args.swap_every, stop_evt)
+    record["hot_swap"] = run_load(server, None, x, points=points,
+                                  duration=dur, concurrency=conc)
+    stop_evt.set()
+    record["hot_swap"]["generations_published"] = \
+        reg.generation - gen_before
+    server.stop()
+
+    legacy_qps = record["per_request_legacy"]["qps"] or 1e-9
+    cached_qps = record["per_request_cached"]["qps"] or 1e-9
+    record["speedup"] = round(record["batched"]["qps"] / legacy_qps, 2)
+    record["speedup_vs_cached"] = round(
+        record["batched"]["qps"] / cached_qps, 2)
+    gates = {
+        "speedup_min": GATE_SPEEDUP,
+        "speedup_ok": record["speedup"] >= GATE_SPEEDUP,
+        "swap_dropped": record["hot_swap"]["dropped"],
+        "swap_ok": (record["hot_swap"]["dropped"] <= GATE_MAX_DROPPED
+                    and record["hot_swap"]["generations_published"] > 0),
+    }
+    record["gates"] = gates
+    out = args.out or os.path.join(_REPO, "BENCH_SERVE_latest.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "speedup": record["speedup"],
+        "speedup_vs_cached": record["speedup_vs_cached"],
+        "legacy_qps": record["per_request_legacy"]["qps"],
+        "cached_qps": record["per_request_cached"]["qps"],
+        "batched_qps": record["batched"]["qps"],
+        "batched_p99_ms": record["batched"]["p99_ms"],
+        "swap_dropped": gates["swap_dropped"],
+        "artifact": out}))
+    if not (gates["speedup_ok"] and gates["swap_ok"]):
+        print(f"[loadgen] GATES FAILED: {gates}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_smoke(args) -> int:
+    """Tier-1-sized acceptance: batched traffic + one mid-load swap,
+    zero drops, real coalescing."""
+    server, reg, base, x = _make_server(
+        32, 8, batching=True, seed=args.seed,
+        http=(args.transport == "http"))
+    try:
+        stop_evt = threading.Event()
+        _swap_thread(reg, 0.3, stop_evt)
+        out = run_load(server, base, x, points=8, duration=1.2,
+                       concurrency=4)
+        stop_evt.set()
+    finally:
+        server.stop()
+    eng = out.get("engine", {})
+    ok = (out["ok"] > 0 and out["dropped"] == 0
+          and eng.get("batches", 0) > 0
+          and reg.generation > 1)
+    print(json.dumps({"smoke_ok": ok, "qps": out["qps"],
+                      "ok": out["ok"], "dropped": out["dropped"],
+                      "batches": eng.get("batches"),
+                      "generations": reg.generation}))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="loadgen", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--transport", choices=("inproc", "http"),
+                   default="inproc")
+    p.add_argument("--base", default=None,
+                   help="aim at an external server (http transport) "
+                        "instead of the built-in one")
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--concurrency", type=int, default=48,
+                   help="closed-loop worker threads (also the open-"
+                        "loop pool size); capacity runs want enough "
+                        "outstanding requests to keep batches full")
+    p.add_argument("--rate", type=float, default=500.0,
+                   help="offered QPS in open-loop mode")
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--points", type=int, default=64,
+                   help="rows per request")
+    p.add_argument("--k", type=int, default=1000)
+    p.add_argument("--d", type=int, default=300)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--swap-every", type=float, default=0.25,
+                   help="hot-swap drill publish interval (--bench)")
+    p.add_argument("--no-batching", action="store_true",
+                   help="drive the per-request NumPy path instead")
+    p.add_argument("--out", default=None, help="artifact path (--bench)")
+    p.add_argument("--bench", action="store_true",
+                   help="run the evidence protocol and write "
+                        "BENCH_SERVE_latest.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1-sized acceptance run")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke(args)
+    if args.bench:
+        return run_bench(args)
+
+    if args.base is not None:
+        server, base, x = None, args.base, _make_data(
+            args.k, args.d, n=8192, seed=args.seed)[1]
+        if args.transport != "http":
+            print("--base requires --transport http", file=sys.stderr)
+            return 2
+    else:
+        server, _, base, x = _make_server(
+            args.k, args.d, batching=not args.no_batching,
+            seed=args.seed, http=(args.transport == "http"))
+    try:
+        out = run_load(
+            server, base if args.transport == "http" else None, x,
+            points=args.points, duration=args.duration,
+            concurrency=args.concurrency,
+            rate=(args.rate if args.mode == "open" else 0.0))
+    finally:
+        if server is not None:
+            server.stop()
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -322,21 +322,42 @@ def _free_device_buffers():
           file=sys.stderr)
 
 
-def _make_data(n, d, seed=0, dtype="bfloat16", tile=32768, k_gen=64):
+def _make_data(n, d, seed=0, dtype="bfloat16", tile=32768, k_gen=64,
+               cluster_std=1.0, latent_r=0):
     """Blob-ish synthetic features, generated on-device tile by tile.
 
     Tiled so no f32 (n, d) intermediate ever exists — at the headline config
     that intermediate alone would be ~10 GB, more than half of a v5e chip's
-    HBM.
+    HBM.  ``cluster_std`` scales the per-cluster noise: 1.0 (default) keeps
+    the historical well-separated blobs; larger values overlap the clusters
+    — the slow-convergence regime the --accel protocol measures.
+
+    ``latent_r > 0`` puts both centers and noise in a latent r-dim
+    subspace embedded by a fixed random (r, d) map: every flop still
+    happens at the full (n, d) shape, but the clustering geometry is
+    r-dimensional.  Isotropic full-rank noise at d ≳ 1000 concentrates
+    distances so hard that Lloyd converges in a handful of sweeps no
+    matter the overlap (measured: the d=2048 imagenet shape at std 3.5
+    converges in 7 sweeps isotropic vs 40 at latent_r=48) — and real
+    embedding matrices are low intrinsic dimension, not isotropic balls,
+    so the latent instance is both the hard case and the honest one.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     rng = np.random.default_rng(seed)
-    centers = jnp.asarray(rng.normal(size=(k_gen, d)).astype(np.float32) * 3)
-
-    n_pad = -(-n // tile) * tile
+    std = float(cluster_std)
+    if latent_r:
+        proj = rng.normal(size=(latent_r, d)).astype(np.float32)
+        proj /= np.linalg.norm(proj, axis=1, keepdims=True)
+        centers = jnp.asarray(
+            (rng.normal(size=(k_gen, latent_r)).astype(np.float32) * 3)
+            @ proj)
+        projj = jnp.asarray(proj)
+    else:
+        centers = jnp.asarray(
+            rng.normal(size=(k_gen, d)).astype(np.float32) * 3)
 
     @jax.jit
     def gen(key):
@@ -345,11 +366,17 @@ def _make_data(n, d, seed=0, dtype="bfloat16", tile=32768, k_gen=64):
         def one(key):
             kl, kn = jax.random.split(key)
             labels = jax.random.randint(kl, (tile,), 0, k_gen)
-            noise = jax.random.normal(kn, (tile, d), dtype=jnp.float32)
-            return (centers[labels] + noise).astype(dtype)
+            if latent_r:
+                z = jax.random.normal(kn, (tile, latent_r),
+                                      dtype=jnp.float32)
+                noise = z @ projj
+            else:
+                noise = jax.random.normal(kn, (tile, d), dtype=jnp.float32)
+            return (centers[labels] + std * noise).astype(dtype)
 
         return lax.map(one, keys).reshape(n_pad, d)
 
+    n_pad = -(-n // tile) * tile
     x = gen(jax.random.key(seed))[:n]
     x.block_until_ready()
     return x
@@ -710,7 +737,7 @@ def bench_lloyd_iters_per_s(n=1_280_000, d=2048, k=1000, *, iters=10,
 
 def bench_wallclock_to_converge(n=1_280_000, d=2048, k=1000, *, tol=1e-4,
                                 max_iter=300, chunk_size=65536, verbose=False,
-                                backend="auto", update="delta"):
+                                backend="auto", update="delta", sanity=True):
     """Wall-clock of a COMPLETE fit at the headline config: k-means||
     seeding over the FULL data (few large MXU matmul rounds; measured both
     faster to converge and lower final inertia than k-means++ on a 64·k
@@ -767,8 +794,10 @@ def bench_wallclock_to_converge(n=1_280_000, d=2048, k=1000, *, tol=1e-4,
         # at this scale is a measurement artifact (observed once on the
         # tunnel), not a result — re-measure once; if it reproduces,
         # raise so main()'s handler emits a carried artifact with the
-        # error instead of recording a bogus world record.
-        if t1 - t0 >= 0.1 and int(state.n_iter) >= 2:
+        # error instead of recording a bogus world record.  ``sanity=
+        # False`` for small configs (--all's per-config converge pass:
+        # blobs2d legitimately converges in milliseconds).
+        if not sanity or (t1 - t0 >= 0.1 and int(state.n_iter) >= 2):
             break
         msg = (f"implausible converge measurement ({t1 - t0:.3f}s, "
                f"{int(state.n_iter)} iters)")
@@ -793,6 +822,275 @@ def bench_wallclock_to_converge(n=1_280_000, d=2048, k=1000, *, tol=1e-4,
             file=sys.stderr,
         )
     return out
+
+
+#: --accel acceptance gates, on per-config MEDIANS over instance rows.
+#: These gate what the techniques MEASURABLY deliver at the bench's
+#: k=1000 shapes (the full regime study is ROADMAP item 3): anderson's
+#: safeguard guarantees final inertia within GATE_ACCEL_REL_INERTIA of
+#: plain Lloyd (one-sided: LOWER is always acceptable, and measured runs
+#: usually land equal-or-lower), and the nested schedule must cut
+#: seconds-to-converge on at least one config.  Iteration/epoch
+#: reductions are REPORTED per row and as medians — at k=1000 they are
+#: strongly data-dependent (plain Lloyd from a k-means++ start is a
+#: brutally strong baseline; see the ROADMAP honesty note) and are not
+#: gated.  The nested arm gets the looser NESTED_REL_INERTIA bound: a
+#: subsample-warm-started fit on overlapping data can settle a
+#: (slightly) different basin — a real, recorded trade, not noise.
+GATE_ACCEL_REL_INERTIA = 1e-3
+GATE_NESTED_REL_INERTIA = 1e-2
+
+
+def _record_accel_local(rec):
+    """Persist the --accel measurement (BENCH_ACCEL_latest.json — the
+    accelerated-convergence evidence artifact; provenance fields inside
+    say which platform/scale produced it)."""
+    tmp = os.path.join(_REPO, ".BENCH_ACCEL_latest.tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, os.path.join(_REPO, "BENCH_ACCEL_latest.json"))
+    except OSError as e:
+        print(f"  could not persist --accel record: {e}", file=sys.stderr)
+
+
+def bench_accel(config_names=("glove", "imagenet"), *, scale=1, tol=1e-4,
+                max_iter=500, seeds=(0, 1, 2), backend="auto", verbose=True,
+                cluster_std=3.5, latent_r=0):
+    """Convergence comparison: plain Lloyd vs Anderson vs nested schedule.
+
+    Per named BASELINE config (same k and d; ``scale`` divides n for
+    hosts that cannot hold the full shape — recorded in the artifact, so
+    a scaled row can never masquerade as the full config) and per
+    instance seed: generate a HARD instance of the shape — k_gen=k blobs
+    (a converged state must exist) with ``cluster_std`` overlap (default
+    3.5: within-cluster spread comparable to the between-center
+    distances; the separated std=1 recipe converges in a handful of
+    sweeps with nothing left to accelerate, and real embedding matrices
+    are not separable) — seed ONCE with k-means++ on a subsample (the
+    repo's standard large-n seeding, fit_minibatch's recipe; all arms
+    start from the same c0, because seeding differences must not pollute
+    a convergence comparison), then run each arm to the same
+    sklearn-semantics tolerance with a compile-warmup fit first.
+    ``latent_r > 0`` switches the instance family to the latent
+    low-intrinsic-dimension one (see :func:`_make_data`: isotropic
+    full-rank noise at d ≳ 1000 concentrates distances and converges in
+    a handful of sweeps regardless of overlap; real embedding matrices
+    are low intrinsic dimension) — recorded per row, ``--accel-latent-r``
+    on the CLI.
+
+    ``seeds`` controls the instance count per config: k-means
+    trajectories from warm starts are CHAOTIC (measured on one glove
+    instance pair: 1.6x fewer Anderson iterations on seed 0, 1.4x MORE
+    on seed 1, from near-identical setups), so the gates judge
+    per-config medians over independent data+seed instances and a
+    single-instance artifact is not evidence of anything.
+
+    Metrics per arm: iterations, seconds, final inertia.  The nested arm
+    (``fit_minibatch(schedule="nested")``: the subsample ladder promoting
+    into a PLAIN full-batch finish) additionally reports full-batch-
+    EQUIVALENT iterations ("epochs", Σ rows·iters/n over the ladder + the
+    full-batch loop's iterations): a quarter-sample sweep is not an
+    iteration in the same currency as a full one, and epochs is the
+    honest cost-normalized count (what seconds-to-converge tracks).
+
+    Why the nested arm finishes PLAIN rather than with Anderson:
+    measured at the glove shape, the Anderson loop run from the ladder's
+    warm start wandered (30 full-batch sweeps, exploring) where the
+    plain finish converged in 6 — extrapolation has nothing to
+    accelerate from a good warm start.  The two techniques are
+    alternatives tuned to different phases, not a free compound; the
+    artifact records each at its best.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.data import BENCH_CONFIGS
+    from kmeans_tpu.models import (fit_lloyd, fit_lloyd_accelerated,
+                                   fit_minibatch)
+    from kmeans_tpu.models.init import init_centroids
+
+    platform = jax.devices()[0].platform
+    # bf16 is the TPU MXU's element type; XLA:CPU emulates it slowly —
+    # measure each platform in its native fast dtype (recorded).
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    rows = []
+    for name, seed in ((c, s) for c in config_names for s in seeds):
+        cfgd = BENCH_CONFIGS[name]
+        d, k = cfgd["d"], cfgd["k"]
+        # scale may be one divisor for every config or a per-config dict
+        # (a CPU host can hold full-scale glove but not imagenet).
+        cfg_scale = (scale.get(name, 1) if isinstance(scale, dict)
+                     else max(1, scale))
+        n = max(8 * k, int(cfgd["n"] // cfg_scale))
+        chunk = min(65536, max(4096, n // 4))
+        if verbose:
+            print(f"  [{name}/seed{seed}] n={n} d={d} k={k} "
+                  f"(scale {cfg_scale}, {dtype}, std {cluster_std})",
+                  file=sys.stderr)
+        x = _make_data(n, d, seed=seed, k_gen=k, dtype=dtype,
+                       cluster_std=cluster_std, latent_r=latent_r)
+        sub = x[: min(n, max(64 * k, 65536))]
+        tol_abs = tol * float(jnp.mean(jnp.var(sub.astype(jnp.float32),
+                                               axis=0)))
+        kcfg = KMeansConfig(k=k, chunk_size=chunk, compute_dtype=dtype,
+                            backend=backend, max_iter=max_iter)
+        sub_n = min(n, max(4 * k * 16, 65536))     # fit_minibatch's recipe
+        c0 = init_centroids(jax.random.key(seed + 1), x[:sub_n], k,
+                            method="k-means++", compute_dtype=dtype,
+                            chunk_size=chunk)
+        c0.block_until_ready()
+
+        def run_arm(fn):
+            fn()                            # compile warm-up (same shapes)
+            t0 = time.perf_counter()
+            st = fn()
+            st.centroids.block_until_ready()
+            return st, time.perf_counter() - t0
+
+        plain, t_p = run_arm(lambda: fit_lloyd(
+            x, k, init=c0, tol=tol_abs, config=kcfg))
+        anders, t_a = run_arm(lambda: fit_lloyd_accelerated(
+            x, k, init=c0, tol=tol_abs, config=kcfg, accel="anderson"))
+        rung_box = {}
+
+        def nested_fn():
+            # return_ladder hands back the per-rung record from the very
+            # execution being timed — no second ladder run, no duplicated
+            # parameter defaults to drift.
+            st, rungs = fit_minibatch(
+                x, k, init=np.asarray(c0), tol=float(tol_abs), config=kcfg,
+                schedule="nested", return_ladder=True)
+            rung_box["rungs"] = rungs
+            return st
+
+        nested, t_n = run_arm(nested_fn)
+        rungs = rung_box["rungs"]
+        ladder_iters = sum(it for _, it in rungs)
+        full_iters = int(nested.n_iter) - ladder_iters
+        epochs = sum(b * it for b, it in rungs) / n + full_iters
+
+        fp = float(plain.inertia)
+
+        def arm(st, t):
+            fi = float(st.inertia)
+            return {"iters": int(st.n_iter), "seconds": round(t, 3),
+                    "inertia": fi, "converged": bool(st.converged),
+                    "rel_inertia_vs_plain": (fi - fp) / fp}
+
+        row = {
+            "config": name, "n": n, "d": d, "k": k, "scale": cfg_scale,
+            "dtype": dtype, "cluster_std": cluster_std,
+            "latent_r": latent_r, "seed": seed,
+            "tol_abs": tol_abs,
+            "plain": arm(plain, t_p),
+            "anderson": arm(anders, t_a),
+            "nested": {
+                **arm(nested, t_n),
+                "ladder_iters": ladder_iters,
+                "ladder_rungs": [[b, it] for b, it in rungs],
+                "full_batch_iters": full_iters,
+                "epochs_to_converge": round(epochs, 2),
+            },
+        }
+        row["anderson"]["iter_reduction_vs_plain"] = round(
+            int(plain.n_iter) / max(1, int(anders.n_iter)), 3)
+        row["nested"]["epoch_reduction_vs_plain"] = round(
+            int(plain.n_iter) / max(1e-9, epochs), 3)
+        row["nested"]["seconds_reduction_vs_plain"] = round(
+            t_p / max(1e-9, t_n), 3)
+        rows.append(row)
+        if verbose:
+            print(f"  [{name}/seed{seed}] plain {row['plain']['iters']} it "
+                  f"{t_p:.2f}s | anderson {row['anderson']['iters']} it "
+                  f"{t_a:.2f}s ({row['anderson']['iter_reduction_vs_plain']}x"
+                  f" fewer iters) | nested {epochs:.1f} epochs {t_n:.2f}s "
+                  f"({row['nested']['seconds_reduction_vs_plain']}x"
+                  " faster)", file=sys.stderr)
+
+    return {
+        "bench": "accel",
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ"),
+        "platform": platform,
+        "tol": tol,
+        "rows": rows,
+        "medians": accel_medians(rows),
+        "gates": accel_gates(rows),
+        "note": ("plain Lloyd vs Anderson-accelerated vs nested-schedule "
+                 "arms; within one row every arm starts from the SAME "
+                 "k-means++ subsample seed and converges to the same "
+                 "sklearn-semantics tolerance on a hard "
+                 "(overlapping-cluster) instance of the config's shape; "
+                 "multiple rows per config are independent "
+                 "data+seed instances and the gates judge per-config "
+                 "MEDIANS (k-means trajectories from warm starts are "
+                 "chaotic — single instances over/under-shoot); "
+                 "'epochs' = full-batch-equivalent iterations "
+                 "(sum rows*iters/n), the cost-normalized count a "
+                 "subsample ladder must be judged in; 'scale' divides "
+                 "the BASELINE config's n — scaled rows are CPU-host "
+                 "stand-ins, same k/d/recipe"),
+    }
+
+
+def _median(vals):
+    vals = sorted(vals)
+    mid = len(vals) // 2
+    return (vals[mid] if len(vals) % 2
+            else 0.5 * (vals[mid - 1] + vals[mid]))
+
+
+def accel_medians(rows):
+    """Per-config medians of the gate quantities over instance rows."""
+    out = {}
+    for name in dict.fromkeys(r["config"] for r in rows):
+        sub = [r for r in rows if r["config"] == name]
+        out[name] = {
+            "instances": len(sub),
+            "anderson_iter_reduction": round(_median(
+                [r["anderson"]["iter_reduction_vs_plain"]
+                 for r in sub]), 3),
+            "anderson_rel_inertia": _median(
+                [r["anderson"]["rel_inertia_vs_plain"] for r in sub]),
+            "nested_epoch_reduction": round(_median(
+                [r["nested"]["epoch_reduction_vs_plain"]
+                 for r in sub]), 3),
+            "nested_seconds_reduction": round(_median(
+                [r["nested"]["seconds_reduction_vs_plain"]
+                 for r in sub]), 3),
+            "nested_rel_inertia": _median(
+                [r["nested"]["rel_inertia_vs_plain"] for r in sub]),
+        }
+    return out
+
+
+def accel_gates(rows):
+    """The --accel acceptance booleans, judged on per-config medians —
+    THE one copy (bench_accel and any external row-merger both call
+    it, so a merged artifact cannot disagree with a one-shot run).
+
+    ``anderson_quality_ok`` is the safeguard's artifact-level face: at
+    full convergence the accelerated fit's inertia is within
+    :data:`GATE_ACCEL_REL_INERTIA` of plain Lloyd's on every config
+    (equal-or-lower in most measured runs).  ``nested_seconds_ok`` is
+    the schedule's wall-clock claim.  Iteration/epoch reductions stay
+    reported-not-gated — see the gate-constant comment."""
+    med = accel_medians(rows)
+    return {
+        "rel_inertia_max": GATE_ACCEL_REL_INERTIA,
+        "nested_rel_inertia_max": GATE_NESTED_REL_INERTIA,
+        "anderson_quality_ok": all(
+            m["anderson_rel_inertia"] <= GATE_ACCEL_REL_INERTIA
+            for m in med.values()),
+        "nested_quality_ok": all(
+            m["nested_rel_inertia"] <= GATE_NESTED_REL_INERTIA
+            for m in med.values()),
+        "nested_seconds_ok": any(
+            m["nested_seconds_reduction"] > 1.0 for m in med.values()),
+    }
 
 
 def _merge_fresh_conv(line, fresh, unit):
@@ -932,6 +1230,31 @@ def main():
     ap.add_argument("--converge", action="store_true",
                     help="only the wall-clock-of-a-full-fit metric "
                          "(k-means|| seeding + Lloyd to tol)")
+    ap.add_argument("--accel", action="store_true",
+                    help="accelerated-convergence evidence protocol: "
+                         "plain Lloyd vs Anderson vs Anderson+nested "
+                         "from one shared k-means|| seed per config, to "
+                         "the same sklearn tolerance; writes "
+                         "BENCH_ACCEL_latest.json (render with "
+                         "tools/bench_table.py --accel)")
+    ap.add_argument("--accel-scale", type=int, default=None,
+                    help="divide each config's n for hosts that cannot "
+                         "hold the full shape (recorded in the artifact; "
+                         "default 1 on TPU, 16 elsewhere)")
+    ap.add_argument("--accel-configs", default="glove,imagenet",
+                    help="comma-separated BASELINE config names for "
+                         "--accel (default: the two large ones the "
+                         "acceptance gate names)")
+    ap.add_argument("--accel-seeds", default="0,1,2",
+                    help="comma-separated instance seeds per config for "
+                         "--accel — gates judge per-config medians "
+                         "(warm-start trajectories are chaotic; one "
+                         "instance is not evidence)")
+    ap.add_argument("--accel-latent-r", type=int, default=0,
+                    help="latent intrinsic dimension of the --accel "
+                         "instances (0 = isotropic; >0 embeds clusters in "
+                         "an r-dim subspace — the slow-convergence family "
+                         "of the ROADMAP regime study, recorded per row)")
     ap.add_argument("--iters-only", action="store_true",
                     help="only the iter/s metric (skip the converge fit)")
     ap.add_argument("--iters", type=int, default=10)
@@ -989,6 +1312,9 @@ def main():
     if args.input is not None:
         metric = f"real_input_fit@{os.path.basename(args.input)},k={args.k}"
         unit = "s"
+    elif args.accel:
+        metric = f"accel_nested_seconds_reduction@{args.accel_configs}"
+        unit = "x"
     elif args.converge:
         metric, unit = "wallclock_to_converge_s@N=1.28M,d=2048,k=1000", "s"
     else:
@@ -1096,6 +1422,36 @@ def _run_benches(args, metric, unit, fresh=None):
             _record_input_local(out)
         return out
 
+    if args.accel:
+        # CPU-host defaults: sized so one run finishes in minutes at
+        # 3-400 GFLOP/s (full imagenet alone is ~10.5 TFLOP per sweep).
+        cfgs = tuple(s.strip() for s in args.accel_configs.split(",")
+                     if s.strip())
+        # CPU default covers EVERY requested config (the documented
+        # "16 elsewhere"), not just the two gate configs — an unknown
+        # name must not silently run at full scale on a laptop.
+        scale = args.accel_scale if args.accel_scale is not None \
+            else (1 if dev.platform == "tpu"
+                  else {c: {"glove": 2}.get(c, 16) for c in cfgs})
+        seeds = tuple(int(s) for s in args.accel_seeds.split(",")
+                      if s.strip())
+        rec = bench_accel(cfgs, scale=scale, backend=args.backend,
+                          seeds=seeds or (0,), verbose=True,
+                          latent_r=args.accel_latent_r)
+        _record_accel_local(rec)
+        # One parse-last-line summary: the best per-config median nested
+        # wall-clock reduction (the gate's binding quantity).
+        reductions = [m["nested_seconds_reduction"]
+                      for m in rec["medians"].values()]
+        return {
+            "metric": metric,
+            "value": max(reductions) if reductions else None,
+            "unit": unit,
+            "vs_baseline": None,
+            "gates": rec["gates"],
+            "artifact": "BENCH_ACCEL_latest.json",
+        }
+
     if args.all:
         from kmeans_tpu.data import BENCH_CONFIGS
 
@@ -1107,19 +1463,42 @@ def _run_benches(args, metric, unit, fresh=None):
                     verbose=True, backend=args.backend, update=args.update,
                 )
                 print(f"{name}: {r:.2f} Lloyd iter/s", file=sys.stderr)
-                all_rows.append({
+                row = {
                     "config": name, "n": cfg["n"], "d": cfg["d"],
                     "k": cfg["k"], "iters_per_s": round(r, 1),
                     "update": getattr(bench_lloyd_iters_per_s,
                                       "last_update", args.update),
                     "backend": getattr(bench_lloyd_iters_per_s,
                                        "last_backend", args.backend),
-                })
+                }
             except Exception as e:  # one config must not kill the table
                 print(f"{name}: ERROR {type(e).__name__}: {e}",
                       file=sys.stderr)
                 if _is_oom(e):
                     _free_device_buffers()
+                continue
+            if not args.iters_only:
+                # Convergence half per config (ISSUE 8 satellite): today
+                # only iter/s is visible, so convergence wins are
+                # unmeasurable.  One config's failure records null
+                # fields, not a dead table.
+                try:
+                    res = bench_wallclock_to_converge(
+                        cfg["n"], cfg["d"], cfg["k"], verbose=True,
+                        backend=args.backend, update=args.update,
+                        sanity=cfg["n"] * cfg["d"] >= 10_000_000,
+                    )
+                    row["iters_to_converge"] = res["n_iter"]
+                    row["seconds_to_converge"] = round(res["total_s"], 3)
+                    row["converged"] = res["converged"]
+                except Exception as e:
+                    print(f"{name}: converge ERROR {type(e).__name__}: "
+                          f"{e}", file=sys.stderr)
+                    row["iters_to_converge"] = None
+                    row["seconds_to_converge"] = None
+                    if _is_oom(e):
+                        _free_device_buffers()
+            all_rows.append(row)
         if dev.platform == "tpu" and len(all_rows) == len(BENCH_CONFIGS):
             # The 5-config table artifact: README's table is GENERATED
             # from this file (tools/bench_table.py) and a test pins the

@@ -59,6 +59,50 @@ for title, mod in (
         print(f"| `{n}` ({kind}) | {first_line(obj)} |")
 
 print("""
+## Accelerated fits (`fit_lloyd_accelerated`)
+
+Safeguarded extrapolation of the Lloyd fixed-point map, all inside ONE
+compiled `lax.while_loop`:
+
+* `accel="beta"` (default) — adaptive over-relaxation
+  `c ← T(c) + β·(T(c) − c)`; `beta_max=0` recovers plain Lloyd exactly.
+* `accel="anderson"` — depth-m Anderson mixing
+  (`kmeans_tpu.ops.anderson`): a ring of the last `anderson_m` iterates
+  and residuals is carried as `(m, k·d)` buffers (donated into the
+  loop) and the regularized constrained least-squares mixing is solved
+  on-device each step.  Three per-step outcomes, all counted into
+  `kmeans_tpu_accel_steps_total{outcome}`: **accepted** (extrapolation
+  used), **rejected** (the free-objective safeguard fired — k-means'
+  objective comes free at the next fused pass; the loop restarts from
+  the last safe plain-Lloyd iterate with history cleared), **fallback**
+  (plain step: warm-up history, ill-conditioned Gram, residual growth,
+  or the `MIX_FLOOR` settle switch near the tolerance).
+* `schedule="nested"` — the doubling nested-prefix subsample ladder
+  (`kmeans_tpu.models.minibatch.nested_ladder`, Nested Mini-Batch
+  K-Means): early iterations run on growing prefixes of `x`, each rung
+  promoting once its centroid shift falls below the sampling noise
+  floor, then the full-batch loop finishes from the warm start.  Also
+  available on `fit_minibatch(schedule="nested")`, where the exact
+  per-rung means ARE the paper's reuse-bias-corrected update.
+* The step-paced twin is `LloydRunner(accel="anderson")`: same
+  safeguard applied between jitted sweeps, with the per-iteration
+  outcome stamped into the telemetry stream (`accel` field) — and the
+  sharded twin `fit_lloyd_accelerated_sharded(accel="anderson")` runs
+  the identical arithmetic with the pass reduction distributed.
+
+Configuration: `KMeansConfig(accel=, anderson_m=, anderson_reg=,
+schedule=, nested_start=)`; CLI: `train --accel anderson --schedule
+nested`; evidence: `python bench.py --accel` →
+`BENCH_ACCEL_latest.json` (render: `python tools/bench_table.py
+--accel`).
+
+What to expect at production k: the anderson safeguard guarantees
+final inertia no worse than plain Lloyd and measured runs usually land
+equal-or-lower (a quality refinement); the nested schedule cuts
+wall-clock-to-converge (cheap subsample sweeps).  Iteration-count
+reductions are strongly data-dependent at k=1000 — see the ROADMAP
+item 3 regime study before expecting them.
+
 ---
 Regenerate: `python docs/gen_api.py > docs/API.md`.  The CLI
 (`python -m kmeans_tpu.cli --help`) and the HTTP surface

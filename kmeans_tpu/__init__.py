@@ -31,6 +31,7 @@ Layout:
 
 __version__ = "0.3.0"
 
+import kmeans_tpu.compat  # noqa: F401  (backfills jax API spellings; must run first)
 from kmeans_tpu.config import KMeansConfig, MeshConfig, RunConfig, ServeConfig
 from kmeans_tpu.models import (
     BalancedKMeans,

@@ -65,6 +65,9 @@ def _cmd_train(args) -> int:
               "path; --no-minibatch contradicts it (pass --model gmm for "
               "the streamed mixture)", file=sys.stderr)
         return 2
+    runner_flagged = bool(args.progress or args.checkpoint or args.resume
+                          or args.profile or args.telemetry or args.trace
+                          or args.xla_trace)
     if args.model is not None:
         model = args.model
     elif args.stream:
@@ -72,6 +75,13 @@ def _cmd_train(args) -> int:
     else:
         use_mb = args.minibatch if args.minibatch is not None else cfg_minibatch
         model = "minibatch" if use_mb else "lloyd"
+    if args.accel and args.model is None and model == "lloyd" \
+            and not runner_flagged:
+        # --accel names the accelerated family; without an explicit
+        # --model (and without the step-paced runner flags, which keep
+        # the lloyd runner and accelerate ITS steps) it selects the
+        # fused accelerated loop.
+        model = "accelerated"
     minibatch = model == "minibatch"
     stream_ok = ("minibatch", "gmm")
     if args.stream and model not in stream_ok:
@@ -143,10 +153,69 @@ def _cmd_train(args) -> int:
         x = pca_transform(pst, np.asarray(x))
         d = args.pca
 
+    # --accel / --schedule configure the accelerated-fit engine (ISSUE 8):
+    # --accel anderson|beta picks the fused accelerated loop's
+    # extrapolation (or, with runner flags, step-paced Anderson inside
+    # LloydRunner); --schedule nested prepends the doubling subsample
+    # ladder (also valid for the in-memory minibatch path, where it
+    # replaces the Sculley streaming loop).  Combinations that would be
+    # silently ignored are rejected (the CLI's contradictory-flag
+    # convention).
+    if args.anderson_m is not None and args.accel != "anderson":
+        print("error: --anderson-m tunes the Anderson history depth; it "
+              "requires --accel anderson", file=sys.stderr)
+        return 2
+    if args.accel:
+        if args.stream or model not in ("accelerated", "lloyd"):
+            print(f"error: --accel runs the accelerated Lloyd family; it "
+                  f"has no effect with --model {model}"
+                  f"{' --stream' if args.stream else ''} (use --model "
+                  "accelerated, or lloyd with the runner flags)",
+                  file=sys.stderr)
+            return 2
+        if model == "lloyd" and not runner_flagged:
+            print("error: --accel with --model lloyd needs the step-paced "
+                  "runner (--progress/--checkpoint/--telemetry/…); the "
+                  "fused loop is --model accelerated", file=sys.stderr)
+            return 2
+        if model == "lloyd" and args.accel != "anderson":
+            print("error: the runner's step-paced acceleration is "
+                  "anderson; --accel beta runs only the fused --model "
+                  "accelerated loop", file=sys.stderr)
+            return 2
+        if model == "lloyd" and args.mesh and args.mesh > 1:
+            print("error: --accel with runner flags steps single-device; "
+                  "the sharded loop is --model accelerated --mesh N",
+                  file=sys.stderr)
+            return 2
+    if args.schedule:
+        if args.stream or model not in ("accelerated", "minibatch"):
+            print(f"error: --schedule configures the in-memory "
+                  f"accelerated/minibatch fits; it has no effect with "
+                  f"--model {model}{' --stream' if args.stream else ''}",
+                  file=sys.stderr)
+            return 2
+        if args.schedule == "nested" and args.mesh and args.mesh > 1:
+            print("error: --schedule nested runs the single-device "
+                  "subsample ladder; drop --mesh or use --schedule full",
+                  file=sys.stderr)
+            return 2
+        if args.schedule == "nested" and model == "minibatch" and (
+                args.steps is not None or args.batch_size is not None):
+            print("error: --steps/--batch-size drive the Sculley "
+                  "streaming loop; --schedule nested is ladder-paced "
+                  "(promotes on the sampling noise floor, finishes "
+                  "full-batch to --tol)", file=sys.stderr)
+            return 2
+
     # --max-iter governs the Lloyd-family loop; the minibatch/stream path is
     # step-based.  Flags that would be silently ignored are rejected instead
     # (matching the CLI's other contradictory-flag guards; advisor r1).
-    step_based = minibatch or (args.stream and model == "gmm")
+    # A nested-schedule minibatch fit is ladder-paced (it honors
+    # --max-iter per rung and --tol at the full-batch finish), so it is
+    # NOT step-based.
+    step_based = (minibatch and args.schedule != "nested") \
+        or (args.stream and model == "gmm")
     if step_based and args.max_iter is not None:
         print("error: --max-iter has no effect with the step-based "
               "minibatch/stream paths; use --steps/--batch-size",
@@ -229,6 +298,12 @@ def _cmd_train(args) -> int:
         cfg_kw["batch_size"] = args.batch_size
     if getattr(args, "update", None):
         cfg_kw["update"] = args.update
+    if args.accel:
+        cfg_kw["accel"] = args.accel
+    if args.schedule:
+        cfg_kw["schedule"] = args.schedule
+    if args.anderson_m is not None:
+        cfg_kw["anderson_m"] = args.anderson_m
     kcfg = KMeansConfig(
         k=k, init=args.init,
         max_iter=args.max_iter if args.max_iter is not None else 100,
@@ -350,7 +425,10 @@ def _cmd_train(args) -> int:
 
         from kmeans_tpu.utils import capture
 
-        runner = LloydRunner(np.asarray(x), k, config=kcfg, mesh=mesh)
+        runner = LloydRunner(
+            np.asarray(x), k, config=kcfg, mesh=mesh,
+            accel="anderson" if args.accel == "anderson" else None,
+        )
         if args.resume:
             from kmeans_tpu.utils.checkpoint import CorruptCheckpointError
 
@@ -943,6 +1021,26 @@ def main(argv=None) -> int:
                         "score bounds (single-device lloyd, win is "
                         "data-dependent); explicit choices error where "
                         "unsupported")
+    t.add_argument("--accel", default=None, choices=["beta", "anderson"],
+                   help="accelerated-fit extrapolation (selects --model "
+                        "accelerated when no model is given): 'anderson' "
+                        "= depth-m Anderson mixing with the free-"
+                        "objective safeguard (ops/anderson), 'beta' = "
+                        "adaptive over-relaxation; with the runner flags "
+                        "(--progress/--telemetry/…) 'anderson' instead "
+                        "accelerates the step-paced lloyd runner and "
+                        "stamps per-iteration accept/reject outcomes "
+                        "into the telemetry")
+    t.add_argument("--schedule", default=None, choices=["full", "nested"],
+                   help="iteration schedule of the accelerated/minibatch "
+                        "in-memory fits: 'nested' runs the doubling "
+                        "nested-prefix subsample ladder (promoting on "
+                        "the sampling noise floor) before the full-batch "
+                        "loop — fewer full-batch sweeps, early ones "
+                        "cheaper (Nested Mini-Batch K-Means)")
+    t.add_argument("--anderson-m", type=int, default=None,
+                   help="Anderson history depth m (default 5; requires "
+                        "--accel anderson)")
     t.add_argument("--tol", type=float, default=1e-4)
     t.add_argument("--seed", type=int, default=None,
                    help="RNG seed (default 0; leaving it unset lets a "

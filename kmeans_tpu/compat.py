@@ -1,0 +1,40 @@
+"""Backfill newer-jax spellings on older installed jax releases.
+
+The tree targets the current jax API surface; the two symbols below are
+the ones we use whose spelling changed across the 0.4.x → 0.5+ boundary.
+Importing this module (kmeans_tpu/__init__.py does it first) makes one
+tree run on both sides:
+
+* ``jax.shard_map`` — lived at ``jax.experimental.shard_map.shard_map``
+  before graduating, with ``check_rep`` where the graduated API says
+  ``check_vma``.
+* ``pltpu.CompilerParams`` — spelled ``TPUCompilerParams`` before the
+  rename (aliased in ``ops/pallas_lloyd.py`` next to its import).
+
+Each patch is gated on the attribute being absent, so on a current jax
+this module is a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _experimental
+
+    @functools.wraps(_experimental)
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _experimental(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kw,
+        )
+
+    jax.shard_map = _shard_map
+
+
+_install_shard_map()

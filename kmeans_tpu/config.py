@@ -123,6 +123,31 @@ class KMeansConfig:
     #: (the kernel in interpreter mode — CPU-mesh tests only, slow).
     backend: str = "auto"
 
+    # Accelerated-fit engine (models/accelerated.py).
+    #: Extrapolation scheme of the accelerated Lloyd loop: "beta" (the
+    #: safeguarded single-direction over-relaxation c ← T(c) + β(T(c)−c))
+    #: or "anderson" (depth-m Anderson mixing over a carried history of
+    #: iterates/residuals, solved on-device each step; ops/anderson.py).
+    #: Both share the free-objective safeguard: a step that increased the
+    #: objective is rejected and iteration restarts from the last plain
+    #: Lloyd iterate.
+    accel: str = "beta"
+    #: Anderson history depth m (ring of (m, k·d) carried buffers; the
+    #: paper's sweet spot is ~5 — deeper histories mostly buy a worse-
+    #: conditioned Gram).
+    anderson_m: int = 5
+    #: Tikhonov ridge of the Gram solve, relative to tr(G)/m (scale-free).
+    anderson_reg: float = 1e-8
+    #: Iteration schedule of the accelerated/minibatch fits: "full" (every
+    #: iteration sees all n rows) or "nested" (a doubling ladder of nested
+    #: prefix subsamples — early iterations run on x[:b], b doubling once
+    #: the subsample centroid shift falls below the sampling noise floor,
+    #: then the fit promotes to the full-batch loop; Nested Mini-Batch
+    #: K-Means, PAPERS.md).
+    schedule: str = "full"
+    #: First rung size of the nested ladder (clamped to n).
+    nested_start: int = 8192
+
     # Minibatch engine.
     batch_size: int = 8192
     steps: int = 200
@@ -139,6 +164,18 @@ class KMeansConfig:
             raise ValueError(f"unknown empty-cluster policy {self.empty!r}")
         if self.backend not in ("auto", "xla", "pallas", "pallas_interpret"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.accel not in ("beta", "anderson"):
+            raise ValueError(f"unknown accel {self.accel!r}")
+        if not 2 <= self.anderson_m <= 64:
+            raise ValueError(
+                f"anderson_m must be in [2, 64], got {self.anderson_m}"
+            )
+        if self.anderson_reg <= 0.0:
+            raise ValueError("anderson_reg must be positive")
+        if self.schedule not in ("full", "nested"):
+            raise ValueError(f"unknown schedule {self.schedule!r}")
+        if self.nested_start < 1:
+            raise ValueError("nested_start must be positive")
         if self.chunk_size < 1:
             raise ValueError("chunk_size must be positive")
         if self.batch_size < 1:

@@ -1,24 +1,40 @@
-"""Accelerated Lloyd: over-relaxed fixed-point iteration with a safeguard.
+"""Accelerated Lloyd: safeguarded extrapolation of the fixed-point map.
 
 Lloyd's update is a fixed-point map ``c ← T(c)`` whose convergence is linear
 and often slow near the end (many iterations of tiny monotone improvements).
-Acceleration schemes for k-means (Anderson acceleration — see PAPERS.md,
-"Fast K-Means Clustering with Anderson Acceleration" — and classic
-over-relaxation) extrapolate along the update direction:
+Two extrapolation schemes share one safeguard here:
 
-    c_{t+1} = T(c_t) + β_t · (T(c_t) − c_t),        β_t ≥ 0
+* ``accel="beta"`` — classic over-relaxation along the update direction,
+  ``c_{t+1} = T(c_t) + β_t · (T(c_t) − c_t)`` with β_t adapted online;
+* ``accel="anderson"`` — depth-m Anderson mixing (PAPERS.md, "Fast K-Means
+  Clustering with Anderson Acceleration"): a ring of the last m iterates and
+  residuals is carried as ``(m, k·d)`` buffers and the regularized
+  least-squares mixing is solved on-device each step (normal equations on
+  the m×m Gram — O(m²·k·d) + O(m³) at m≈5, noise next to the fused pass;
+  :mod:`kmeans_tpu.ops.anderson`).
 
-with β_t adapted online and a *safeguard* so a bad extrapolation can never
-run away: k-means' objective is evaluated for free at the next iteration's
-fused pass (it already computes inertia), and if it increased, the step is
-rejected and iteration restarts from the last safe plain-Lloyd iterate.
-Accepted steps therefore cost exactly one fused pass — the same as plain
-Lloyd — and rejected steps (rare) cost one extra.
+The *safeguard* is the same for both: k-means' objective is evaluated for
+free at the next iteration's fused pass (it already computes inertia), and
+if it increased, the step is rejected and iteration restarts from the last
+safe plain-Lloyd iterate (history cleared, for Anderson).  Accepted steps
+therefore cost exactly one fused pass — the same as plain Lloyd — and
+rejected steps (rare) cost one extra.  A step whose Gram solve is
+ill-conditioned (or with under-filled history) falls back to the plain
+Lloyd step — the third outcome next to accepted/rejected, and all three are
+counted into ``kmeans_tpu_accel_steps_total{outcome}``.
+
+``schedule="nested"`` prepends the doubling nested-prefix subsample ladder
+(:func:`kmeans_tpu.models.minibatch.nested_ladder`, after Nested Mini-Batch
+K-Means, PAPERS.md): early iterations run on growing prefixes of ``x`` and
+the fit promotes to the full-batch accelerated loop once the subsample
+centroid shift falls below the sampling noise floor — fewer full-batch
+iterations, and the early ones cheaper.
 
 TPU-first: the whole accelerated fit is still ONE compiled program — a
 ``lax.while_loop`` whose body is the fused pass (XLA scan or the Pallas
-kernel) plus O(k·d) vector arithmetic; the accept/reject branch is a
-``jnp.where``, not host control flow.
+kernel) plus O(m·k·d) vector arithmetic; the accept/reject branch is a
+``jnp.where``, not host control flow, and the carried Anderson history
+buffers are donated into the loop (DON301's 2x-memory tax does not apply).
 """
 
 from __future__ import annotations
@@ -33,10 +49,71 @@ from jax import lax
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_inputs
 from kmeans_tpu.models.lloyd import KMeansState
-from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
+from kmeans_tpu.obs import counter as _obs_counter, enabled as _obs_enabled
+from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
+                                     anderson_reset)
+from kmeans_tpu.ops.lloyd import (lloyd_pass, resolve_backend,
+                                  resolve_update, weights_exact)
 from kmeans_tpu.ops.update import apply_update
 
-__all__ = ["fit_lloyd_accelerated"]
+__all__ = ["fit_lloyd_accelerated", "ACCEL_STEPS"]
+
+#: Extrapolation outcomes across every accelerated fit in the process
+#: (docs/OBSERVABILITY.md): ``accepted`` = the extrapolated iterate was
+#: used, ``rejected`` = the safeguard fired (objective grew; restarted
+#: from the last safe iterate), ``fallback`` = the plain Lloyd step ran
+#: because the mixing was unavailable (warm-up history) or its Gram
+#: solve was ill-conditioned.  The step-paced runner increments it live;
+#: the fused loops add their totals when the fit returns.
+ACCEL_STEPS = _obs_counter(
+    "kmeans_tpu_accel_steps_total",
+    "Accelerated-fit extrapolation steps by outcome",
+    labels=("outcome",),
+)
+for _o in ("accepted", "rejected", "fallback"):
+    ACCEL_STEPS.labels(outcome=_o)
+del _o
+
+#: Settle threshold of the Anderson loops: mixing turns off for good
+#: once the squared residual falls within this factor of the tolerance,
+#: and plain Lloyd polishes to the exact fixed point.  See the comment
+#: in ``_anderson_loop`` — near the floor, mixing dithers (and k-means'
+#: piecewise-constant map means the last stretch belongs to plain steps
+#: anyway: once labels freeze, ONE plain step lands on the fixed point).
+#: Swept on the bench protocol: 300 beat 30/100 on iterations-to-
+#: converge at equal final inertia.
+MIX_FLOOR = 300.0
+
+#: Stall guard, the settle switch's second trigger: if the residual sets
+#: no new minimum for this many consecutive iterations, mixing turns off
+#: for good.  Plain Lloyd's residual decays essentially monotonically;
+#: a stalled residual means the mixing keeps re-exciting label churn
+#: faster than the contraction damps it (observed: an overlapping
+#: random-seeded fit that plain finishes in 31 sweeps ran to max_iter
+#: without this guard).  Bounds the worst case at ~plain + MIX_STALL.
+MIX_STALL = 8
+
+#: Relative slack of the rejection test: reject only when
+#: ``f > f_prev·(1 + REJECT_SLACK)``.  The objective is an f32 sum of n
+#: terms — its sweep-to-sweep noise (ε·f, amplified by accumulation
+#: order) exceeds the TRUE per-step improvement on near-plateau
+#: stretches, and a noise-rejection is self-sustaining: the rewound
+#: safe iterate re-measures within noise of f_prev and "rejects" again
+#: (observed: 78 rejections in 120 sweeps on an overlapping k=1000
+#: fit).  A genuinely diverging extrapolation overshoots by orders of
+#: magnitude more than 1e-5, so the safeguard keeps its teeth.
+REJECT_SLACK = 1e-5
+
+
+def record_accel_steps(n_accepted: int, n_rejected: int,
+                       n_fallback: int) -> None:
+    """Fold one fit's outcome totals into :data:`ACCEL_STEPS` (shared by
+    the fused loops here and the sharded engine)."""
+    if not _obs_enabled():
+        return
+    ACCEL_STEPS.labels(outcome="accepted").inc(int(n_accepted))
+    ACCEL_STEPS.labels(outcome="rejected").inc(int(n_rejected))
+    ACCEL_STEPS.labels(outcome="fallback").inc(int(n_fallback))
 
 
 @functools.partial(
@@ -93,6 +170,159 @@ def _accelerated_loop(x, centroids0, weights, tol, *, max_iter, chunk_size,
     return KMeansState(c_final, labels, inertia, n_iter, converged, counts)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_iter", "chunk_size", "compute_dtype", "update",
+                     "backend", "inject_at"),
+    donate_argnames=("xs0", "rs0"),
+)
+def _anderson_loop(x, centroids0, weights, tol, xs0, rs0, reg, *, max_iter,
+                   chunk_size, compute_dtype, update, backend="xla",
+                   inject_at=None):
+    """Anderson-accelerated Lloyd as ONE compiled ``lax.while_loop``.
+
+    Carry: the usual (c, c_safe, f_prev, it, shift², done) safeguard state
+    plus the (m, k·d) iterate/residual ring, its slot counter, and the
+    int32 outcome counters.  ``xs0``/``rs0`` arrive dead (the caller just
+    built zeros) and are donated, so the loop's carried history reuses
+    their allocation instead of holding 2x.
+
+    ``inject_at`` is a deterministic drill hook (the fault-injection
+    culture of ``utils/faults.py``, reaching inside jit where the host
+    harness cannot): at that iteration the next iterate is displaced far
+    from the data so the objective must grow and the safeguard's reject
+    path demonstrably fires — tests assert "exactly once".
+
+    With ``update="delta"`` the sweeps ride the incremental update
+    (:mod:`kmeans_tpu.ops.delta`) exactly like ``fit_lloyd``'s loop —
+    carried (labels, sums, counts) with the periodic drift-bounding
+    refresh — so an accelerated iteration costs the same as the
+    production plain iteration.  The carried state's invariant
+    (``sums == Σ w·x·onehot(labels)``) never references where the
+    centroids ARE, so extrapolated jumps and safeguard rewinds compose:
+    the sweep after a jump just folds the larger label churn (falling
+    back to the full reduction past its cap — still exact).
+    """
+    kw = dict(weights=weights, chunk_size=chunk_size,
+              compute_dtype=compute_dtype, update=update, backend=backend)
+    f32 = jnp.float32
+    i32 = jnp.int32
+    n = x.shape[0]
+    k = centroids0.shape[0]
+    if update == "delta":
+        from kmeans_tpu.ops.delta import (DELTA_REFRESH, default_cap,
+                                          delta_pass)
+
+        dkw = dict(
+            weights=weights, cap=default_cap(n), chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            # resolve_backend gated "pallas" at the classic kernel's
+            # footprint; hand "auto" down so delta_pass re-gates at the
+            # delta kernel's own footprint (the fit_lloyd loop's idiom).
+            backend="auto" if backend == "pallas" else backend,
+            # The safeguard reads the objective EVERY sweep, so the
+            # raw-score shortcut is never safe here.
+            with_mind=True,
+        )
+
+    def sweep(c, it, lab, sums, counts):
+        """One fused pass at ``c``: returns the (labels, sums, counts)
+        reduction and the objective — via the carried-state delta sweep
+        (with its refresh cadence) or the classic dense pass."""
+        if update != "delta":
+            labels, _, s2, c2, f_c = lloyd_pass(x, c, **kw)
+            return labels, s2, c2, f_c
+
+        def refresh_sweep(_):
+            labels, _, s2, c2, f_c = lloyd_pass(x, c, **kw)
+            return labels, s2, c2, f_c
+
+        def delta_sweep(_):
+            labels, _, s2, c2, f_c, _ = delta_pass(
+                x, c, lab, sums, counts, **dkw)
+            return labels, s2, c2, f_c
+
+        return lax.cond((it % DELTA_REFRESH) == 0, refresh_sweep,
+                        delta_sweep, None)
+
+    def cond(s):
+        return (s[3] < max_iter) & ~s[5]
+
+    def body(s):
+        (c, c_safe, f_prev, it, r_prev, _, mix_on, r_best, stall,
+         xs, rs, hcount, n_acc, n_rej, n_fb, lab, sums, counts) = s
+        lab, sums, counts, f_c = sweep(c, it, lab, sums, counts)
+        tc = apply_update(c, sums, counts)
+        shift_sq = jnp.sum((tc - c) ** 2)
+
+        # The free-objective safeguard (noise-tolerant: REJECT_SLACK); a
+        # rejection also clears the history — directions measured
+        # through a diverged extrapolation would poison the restarted
+        # trajectory.
+        rejected = f_c > f_prev * (1.0 + REJECT_SLACK)
+        # Residual-growth fallback: ‖T(c)−c‖² growing means the last
+        # mixing pushed AWAY from the fixed point even though the
+        # objective didn't rise (near the floor the objective is flat to
+        # f32 while mixing can still wander) — take the plain
+        # contraction step until the residual decays again.
+        grew = shift_sq > r_prev
+        # Settle switch: mixing turns OFF for the rest of the fit once
+        # the residual is within MIX_FLOOR of the tolerance, or once it
+        # has stalled MIX_STALL iterations without a new minimum.
+        # Lloyd's fixed points are exact (labels freeze, then T(c) ≡ c),
+        # so the plain polishing phase terminates for ANY tol — while
+        # continued mixing can re-excite label churn forever and dither
+        # below the objective's f32 resolution without ever meeting the
+        # shift test.
+        improved = shift_sq < r_best
+        r_best = jnp.minimum(r_best, shift_sq)
+        stall = jnp.where(improved, 0, stall + 1)
+        mix_on = (mix_on & (shift_sq > MIX_FLOOR * tol)
+                  & (stall < MIX_STALL))
+
+        xs_p, rs_p, cnt_p = anderson_push(
+            xs, rs, hcount, c.reshape(-1), (tc - c).reshape(-1))
+        mixed, ok = anderson_mix(xs_p, rs_p, cnt_p, reg=reg)
+        use_mix = ok & ~grew & mix_on
+        c_acc = jnp.where(use_mix, mixed.reshape(tc.shape), tc)
+
+        c_next = jnp.where(rejected, c_safe, c_acc)
+        if inject_at is not None:
+            bad = c_next + 1e3 * (1.0 + jnp.abs(c_next))
+            c_next = jnp.where(it == inject_at, bad, c_next)
+        xs_n = jnp.where(rejected, 0.0, xs_p)
+        rs_n = jnp.where(rejected, 0.0, rs_p)
+        cnt_n = jnp.where(rejected, 0, cnt_p)
+        f_next = jnp.where(rejected, f_prev, f_c)
+        c_safe_next = jnp.where(rejected, c_safe, tc)
+        done = (shift_sq <= tol) & ~rejected
+        acc = (~rejected) & use_mix
+        return (c_next, c_safe_next, f_next, it + 1,
+                shift_sq, done, mix_on, r_best, stall,
+                xs_n, rs_n, cnt_n,
+                n_acc + acc, n_rej + rejected,
+                n_fb + ((~rejected) & ~use_mix), lab, sums, counts)
+
+    zero_i = jnp.zeros((), i32)
+    init = (
+        centroids0.astype(f32), centroids0.astype(f32),
+        jnp.asarray(jnp.inf, f32), zero_i,
+        jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
+        jnp.ones((), bool), jnp.asarray(jnp.inf, f32), zero_i,
+        xs0, rs0, zero_i, zero_i, zero_i, zero_i,
+        jnp.full((n,), -1, i32),           # sentinel → first sweep full
+        jnp.zeros((k, x.shape[1]), f32),
+        jnp.zeros((k,), f32),
+    )
+    out = lax.while_loop(cond, body, init)
+    (c, c_safe, _, n_iter, r_last, converged, _, _, _,
+     _, _, _, n_acc, n_rej, n_fb, _, _, _) = out
+    # Land on the safe iterate — the last mixed `c` was never checked.
+    labels, _, _, counts, inertia = lloyd_pass(x, c_safe, **kw)
+    return (KMeansState(c_safe, labels, inertia, n_iter, converged, counts),
+            (n_acc, n_rej, n_fb))
+
+
 def fit_lloyd_accelerated(
     x: jax.Array,
     k: int,
@@ -104,15 +334,47 @@ def fit_lloyd_accelerated(
     tol: Optional[float] = None,
     max_iter: Optional[int] = None,
     beta_max: float = 1.0,
+    accel: Optional[str] = None,
+    schedule: Optional[str] = None,
+    anderson_m: Optional[int] = None,
+    anderson_reg: Optional[float] = None,
+    inject_bad_step: Optional[int] = None,
 ) -> KMeansState:
-    """Full-batch Lloyd with safeguarded over-relaxation.
+    """Full-batch Lloyd with safeguarded extrapolation.
 
-    Same interface and result contract as :func:`fit_lloyd`; typically
-    converges in fewer iterations on slow-converging problems, and the
-    safeguard keeps the objective trajectory from diverging.  ``beta_max``
-    caps the extrapolation factor (0 recovers plain Lloyd exactly).
+    Same interface and result contract as :func:`fit_lloyd`; the
+    safeguard keeps the objective trajectory from diverging, so the
+    final inertia is never worse than plain Lloyd's and measured runs
+    usually land equal-or-lower.  Iteration-count reductions are
+    data-dependent at production k (ROADMAP item 3 has the regime
+    study) — treat this as a quality refinement, not a guaranteed
+    iteration cutter.
+
+    ``accel`` selects the scheme (default ``config.accel``, "beta"):
+    ``"beta"`` is the adaptive over-relaxation (``beta_max`` caps the
+    factor; 0 recovers plain Lloyd exactly), ``"anderson"`` the depth-m
+    mixing (``anderson_m``/``anderson_reg`` override the config).
+    ``schedule="nested"`` runs the doubling subsample ladder first and
+    promotes its warm start into the full-batch loop; the ladder's
+    iterations are included in the returned ``n_iter``.  NOTE the budget
+    semantics under the ladder: ``max_iter`` bounds each PHASE (every
+    rung, and the full-batch finish) separately, so the returned
+    ``n_iter`` can exceed ``max_iter`` — test ``converged``, not
+    ``n_iter >= max_iter``, to detect budget exhaustion.  (Subsample
+    sweeps cost 1/2ⁱ of a full one; a shared global budget would starve
+    the full-batch phase to save cheap rung sweeps.)
+
+    ``inject_bad_step`` is the deterministic safeguard drill (Anderson
+    only): force a diverging extrapolation at that iteration so the
+    reject path fires — for tests and recovery drills, not production.
     """
     cfg, key, c0 = resolve_fit_inputs(x, k, key, config, init, weights)
+    accel = accel if accel is not None else cfg.accel
+    schedule = schedule if schedule is not None else cfg.schedule
+    if accel not in ("beta", "anderson"):
+        raise ValueError(f"unknown accel {accel!r}")
+    if schedule not in ("full", "nested"):
+        raise ValueError(f"unknown schedule {schedule!r}")
     if cfg.empty == "farthest":
         raise NotImplementedError(
             "empty='farthest' is not supported by the accelerated loop "
@@ -122,10 +384,64 @@ def fit_lloyd_accelerated(
     backend = resolve_backend(
         cfg.backend, x, k, weights=weights, compute_dtype=cfg.compute_dtype,
     )
-    return _accelerated_loop(
-        x, c0, weights,
-        jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32),
-        max_iter=max_iter if max_iter is not None else cfg.max_iter,
-        chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
-        update=cfg.update, backend=backend, beta_max=beta_max,
-    )
+    tol_f = float(tol if tol is not None else cfg.tol)
+    max_it = max_iter if max_iter is not None else cfg.max_iter
+
+    ladder_iters = 0
+    if schedule == "nested":
+        if weights is not None:
+            raise ValueError(
+                "schedule='nested' subsamples nested row prefixes; "
+                "weighted rows would need weight-aware rung statistics — "
+                "use schedule='full' for weighted fits"
+            )
+        from kmeans_tpu.models.minibatch import nested_ladder
+
+        c0, ladder_iters, _ = nested_ladder(
+            x, c0, tol=tol_f, start=cfg.nested_start,
+            chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
+            backend=backend, max_iter=max_it,
+        )
+
+    tol_v = jnp.asarray(tol_f, jnp.float32)
+    if accel == "beta":
+        if inject_bad_step is not None:
+            raise ValueError(
+                "inject_bad_step is the Anderson safeguard drill; the "
+                "beta loop has no mixing step to corrupt"
+            )
+        state = _accelerated_loop(
+            x, c0, weights, tol_v,
+            max_iter=max_it, chunk_size=cfg.chunk_size,
+            compute_dtype=cfg.compute_dtype, update=cfg.update,
+            backend=backend, beta_max=beta_max,
+        )
+    else:
+        m = anderson_m if anderson_m is not None else cfg.anderson_m
+        reg = anderson_reg if anderson_reg is not None else cfg.anderson_reg
+        if not 2 <= m <= 64:
+            raise ValueError(f"anderson_m must be in [2, 64], got {m}")
+        # The Anderson loop carries the incremental-update state, so it
+        # resolves cfg.update exactly like fit_lloyd (the config default
+        # rides the headline delta sweep); the bound-pruned hamerly
+        # structure stays a fit_lloyd exclusive — dense here, the
+        # accelerated family's long-standing demotion.
+        cd = (jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype is not None
+              else jax.dtypes.canonicalize_dtype(x.dtype))
+        upd = resolve_update(cfg.update,
+                             w_exact=weights_exact(cd, weights=weights))
+        if upd == "hamerly":
+            upd = "matmul"
+        xs0, rs0, _ = anderson_reset(m, k * x.shape[1])
+        state, (n_acc, n_rej, n_fb) = _anderson_loop(
+            x, c0, weights, tol_v, xs0, rs0,
+            jnp.asarray(reg, jnp.float32),
+            max_iter=max_it, chunk_size=cfg.chunk_size,
+            compute_dtype=cfg.compute_dtype, update=upd,
+            backend=backend, inject_at=inject_bad_step,
+        )
+        record_accel_steps(n_acc, n_rej, n_fb)
+    if ladder_iters:
+        state = state._replace(
+            n_iter=state.n_iter + jnp.asarray(ladder_iters, jnp.int32))
+    return state

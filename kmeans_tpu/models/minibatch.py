@@ -26,8 +26,10 @@ from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState, NearestCentroidMixin
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend
+from kmeans_tpu.ops.update import apply_update
 
-__all__ = ["fit_minibatch", "MiniBatchKMeans", "batch_update"]
+__all__ = ["fit_minibatch", "MiniBatchKMeans", "batch_update",
+           "nested_ladder"]
 
 
 def batch_stats(centroids, xb, *, compute_dtype, row_weight=None):
@@ -87,6 +89,111 @@ def batch_update(centroids, n_seen, xb, *, compute_dtype):
 #: Jitted entry for eager per-batch callers (partial_fit); the scan-based
 #: loop below traces the same batch_update inline.
 _batch_update_jit = jax.jit(batch_update, static_argnames=("compute_dtype",))
+
+
+# ---------------------------------------------------------------------------
+# Nested mini-batch scheduling (Nested Mini-Batch K-Means, PAPERS.md)
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_iter", "chunk_size", "compute_dtype", "backend"),
+)
+def _nested_rung_loop(xb, c0, tol, *, max_iter, chunk_size, compute_dtype,
+                      backend="xla"):
+    """One ladder rung: exact Lloyd sweeps over the nested prefix ``xb``
+    until the centroid shift falls under the rung's sampling noise floor
+    (or ``tol``/``max_iter``).  One compiled ``lax.while_loop`` per rung
+    size — the ladder doubles, so a fit compiles at most
+    ``log2(n/start)`` of these and every later fit reuses them.
+
+    Each sweep recomputes the per-cluster means over the WHOLE prefix,
+    every point counted exactly once at its current assignment — this is
+    the paper's reuse-bias-corrected update in closed form: the nested
+    schedule reuses all earlier points in every later batch, and a
+    streaming 1/n_seen average (:func:`batch_update`) would count those
+    reused points once per appearance, biasing centroids toward the
+    early sample.  Recomputing the exact subsample mean pays one fused
+    pass per sweep — which is the cost the doubling ladder is bounding
+    anyway.
+
+    The promotion criterion is the paper's, in shift form: stop the rung
+    when the squared centroid shift drops below the sampling noise of
+    the subsample centroid estimate.  With ``Var(ĉ_j) ≈ I_j/count_j²``
+    per cluster (I_j = within-cluster inertia) and balanced clusters
+    (count_j ≈ b/k) that noise is ``Σ_j I_j/count_j² ≈ k·inertia/b²`` —
+    iterating a b-row rung below that floor polishes sampling noise, so
+    promote instead.
+    """
+    b = xb.shape[0]
+    k = c0.shape[0]
+    f32 = jnp.float32
+    kw = dict(chunk_size=chunk_size, compute_dtype=compute_dtype,
+              update="matmul", backend=backend)
+
+    def cond(s):
+        return (s[1] < max_iter) & ~s[2]
+
+    def body(s):
+        c, it, _ = s
+        _, _, sums, counts, f_c = lloyd_pass(xb, c, **kw)
+        tc = apply_update(c, sums, counts)
+        shift_sq = jnp.sum((tc - c) ** 2)
+        # Static Python-float coefficient (b² overflows int32 at 64k).
+        floor = f_c * (float(k) / (float(b) * float(b)))
+        done = shift_sq <= jnp.maximum(tol, floor)
+        return tc, it + 1, done
+
+    c, n_iter, _ = lax.while_loop(
+        cond, body,
+        (c0.astype(f32), jnp.zeros((), jnp.int32), jnp.zeros((), bool)),
+    )
+    return c, n_iter
+
+
+def nested_ladder(x, c0, *, tol, start=8192, chunk_size=4096,
+                  compute_dtype=None, backend="xla", max_iter=100):
+    """The doubling nested-prefix subsample ladder; returns
+    ``(c, ladder_iters, rungs)``: the warmed centroids, the total rung
+    iterations, and the per-rung ``[(rows, iterations), …]`` record —
+    the bench derives cost-normalized iteration counts (full-batch-
+    equivalent passes, Σ rows·iters/n) from it, since a 1/16-sample
+    sweep is not "an iteration" in the same currency as a full one.
+
+    Rungs run on ``x[:b]`` for b = start, 2·start, … while b < n — nested
+    prefixes, so every rung reuses all earlier rows.  The caller promotes
+    the result into its full-batch loop (plain, β, or Anderson); rows
+    should be i.i.d.-ordered (shuffled), as a prefix is the sample.
+
+    ``backend="pallas"`` is re-gated per rung shape (the repo's hand-down
+    idiom): the forced kernel was gated at the FULL shape, and a small
+    prefix re-resolves instead of raising.
+
+    The first rung is floored at 64·k rows (≥64 points per cluster):
+    converging a rung whose clusters hold a handful of points each locks
+    a large-k fit into a subsample artifact the full-batch phase then
+    pays dozens of sweeps to undo (measured at k=1000: an 8192-row first
+    rung cost 71 full-batch recovery sweeps and 2.5% final inertia; a
+    64·k first rung cut the full-batch phase to 6).  When 64·k ≥ n the
+    ladder is empty and the fit degenerates gracefully to full-batch.
+    """
+    n = x.shape[0]
+    k = c0.shape[0]
+    b = int(min(max(1, int(start), 64 * k), n))
+    rung_backend = "auto" if backend == "pallas" else backend
+    c = jnp.asarray(c0, jnp.float32)
+    tol_v = jnp.asarray(tol, jnp.float32)
+    total = 0
+    rungs = []
+    while b < n:
+        c, it = _nested_rung_loop(
+            x[:b], c, tol_v, max_iter=max_iter, chunk_size=chunk_size,
+            compute_dtype=compute_dtype, backend=rung_backend,
+        )
+        rungs.append((b, int(it)))
+        total += int(it)
+        b = min(2 * b, n)
+    return c, total, rungs
 
 
 @functools.partial(
@@ -210,6 +317,8 @@ def fit_minibatch(
     steps: Optional[int] = None,
     tol: Optional[float] = None,
     max_no_improvement: Optional[int] = None,
+    schedule: Optional[str] = None,
+    return_ladder: bool = False,
 ) -> KMeansState:
     """Fit minibatch k-means; see module docstring for the update rule.
 
@@ -217,6 +326,24 @@ def fit_minibatch(
     the EWA of batch inertia fails to improve that many batches running)
     enable sklearn-style early stopping; both default to off — ``steps`` is
     exact — because at TPU scale a fixed step budget is usually the point.
+
+    ``schedule`` (default ``config.schedule``) selects the sampling plan:
+    ``"full"`` is the classic Sculley loop above; ``"nested"`` runs the
+    doubling nested-prefix ladder (:func:`nested_ladder`, reuse-bias-
+    corrected — see its docstring) and finishes with a full-batch Lloyd
+    loop to ``tol``, so it converges to the exact k-means answer instead
+    of the streaming average's neighborhood of it.  The nested path runs
+    to convergence; ``steps``/``batch_size``/``max_no_improvement`` are
+    Sculley-loop knobs and are rejected when given explicitly.  Under the
+    ladder ``config.max_iter`` bounds each phase (rung / full-batch
+    finish) separately and the returned ``n_iter`` sums them, so it can
+    exceed ``max_iter`` — test ``converged`` to detect budget exhaustion.
+
+    ``return_ladder=True`` returns ``(state, rungs)`` where ``rungs`` is
+    the nested ladder's per-rung ``[(rows, iterations), …]`` record from
+    the very execution that produced ``state`` (empty under
+    ``schedule="full"``) — the bench derives full-batch-equivalent
+    iteration counts from it without re-running the ladder.
     """
     cfg = (config or KMeansConfig(k=k)).validate()
     if config is not None and config.k != k:
@@ -225,6 +352,17 @@ def fit_minibatch(
         )
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    schedule = schedule if schedule is not None else cfg.schedule
+    if schedule not in ("full", "nested"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "nested" and (steps is not None or batch_size is not None
+                                 or max_no_improvement is not None):
+        raise ValueError(
+            "steps/batch_size/max_no_improvement drive the Sculley "
+            "streaming loop; schedule='nested' is ladder-paced (it "
+            "promotes on the sampling noise floor and finishes full-batch "
+            "to tol) — drop them or use schedule='full'"
+        )
     if key is None:
         key = jax.random.key(cfg.seed)
     ikey, lkey = jax.random.split(key)
@@ -249,7 +387,27 @@ def fit_minibatch(
             ikey2, xs, k, method=method, compute_dtype=cfg.compute_dtype,
             chunk_size=cfg.chunk_size,
         )
-    return _minibatch_loop(
+    if schedule == "nested":
+        from kmeans_tpu.models.lloyd import fit_lloyd
+
+        backend = resolve_backend(
+            cfg.backend, x, k, compute_dtype=cfg.compute_dtype,
+        )
+        tol_f = float(tol if tol is not None else cfg.tol)
+        c_warm, ladder_iters, rungs = nested_ladder(
+            x, centroids0, tol=tol_f, start=cfg.nested_start,
+            chunk_size=cfg.chunk_size, compute_dtype=cfg.compute_dtype,
+            backend=backend, max_iter=cfg.max_iter,
+        )
+        # Full-batch finish through the production Lloyd door (the delta
+        # loop under the default update="auto"), warm-started at the
+        # ladder's output; ladder iterations ride the returned n_iter.
+        state = fit_lloyd(x, k, key=key, config=cfg, init=c_warm,
+                          tol=tol_f)
+        state = state._replace(
+            n_iter=state.n_iter + jnp.asarray(ladder_iters, jnp.int32))
+        return (state, rungs) if return_ladder else state
+    state = _minibatch_loop(
         x,
         centroids0,
         lkey,
@@ -263,6 +421,7 @@ def fit_minibatch(
         tol=tol,
         max_no_improvement=max_no_improvement,
     )
+    return (state, []) if return_ladder else state
 
 
 @dataclasses.dataclass

@@ -31,6 +31,8 @@ from kmeans_tpu.obs import (
     histogram as _obs_histogram,
     tracing as _tracing,
 )
+from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
+                                     anderson_reset)
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend, resolve_update
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
@@ -136,6 +138,7 @@ class LloydRunner:
         mesh=None,
         data_axis: str = "data",
         model_axis: Optional[str] = None,
+        accel: Optional[str] = None,
     ):
         self.cfg = (config or KMeansConfig(k=k)).validate()
         if config is not None and config.k != k:
@@ -159,6 +162,48 @@ class LloydRunner:
         # step() calls; None = next sweep must be a full refresh (fresh
         # runner, post-resume, post-init).
         self._dstate = None
+
+        # Step-paced Anderson acceleration (ops/anderson): the runner
+        # applies the safeguard + depth-m mixing BETWEEN jitted sweeps,
+        # so every iteration still surfaces its inertia/shift to the
+        # callback/telemetry — plus the step's extrapolation outcome.
+        self._accel_mix = None
+        if accel is not None:
+            if accel != "anderson":
+                raise ValueError(
+                    f"unknown accel {accel!r}; the runner's step-paced "
+                    "acceleration is 'anderson' (the fused β loop is "
+                    "fit_lloyd_accelerated)"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "accel='anderson' steps single-device; the sharded "
+                    "loop is fit_lloyd_accelerated_sharded(accel="
+                    "'anderson')"
+                )
+            if self.cfg.empty == "farthest":
+                raise ValueError(
+                    "empty='farthest' is not supported under "
+                    "acceleration (reseeding mid-extrapolation breaks "
+                    "the fixed-point safeguard)"
+                )
+            self._accel_m = self.cfg.anderson_m
+            self._accel_reg = jnp.asarray(self.cfg.anderson_reg,
+                                          jnp.float32)
+
+            # Per-instance jit (one compile amortized over the whole
+            # run, like the step programs above); the carried history
+            # ring is donated — the previous generation's buffers are
+            # dead once the push returns the new ones.
+            @functools.partial(jax.jit, donate_argnums=(2, 3, 4))
+            def accel_mix(c, tc, xs, rs, cnt, reg):
+                xs, rs, cnt = anderson_push(
+                    xs, rs, cnt, c.reshape(-1), (tc - c).reshape(-1))
+                mixed, ok = anderson_mix(xs, rs, cnt, reg=reg)
+                return (jnp.where(ok, mixed.reshape(tc.shape), tc),
+                        xs, rs, cnt, ok)
+
+            self._accel_mix = accel_mix
 
         if mesh is None:
             self.x = jnp.asarray(x)
@@ -382,6 +427,23 @@ class LloydRunner:
         converged = False
         saved = False
         t_run0 = time.perf_counter()
+        if self._accel_mix is not None:
+            from kmeans_tpu.models.accelerated import (ACCEL_STEPS,
+                                                       MIX_FLOOR, MIX_STALL,
+                                                       REJECT_SLACK)
+
+            accel_counters = {o: ACCEL_STEPS.labels(outcome=o)
+                              for o in ("accepted", "rejected", "fallback")}
+            # Host-paced safeguard state (reset per run; resume across a
+            # process boundary restarts the history like _dstate).
+            acc_xs, acc_rs, acc_cnt = anderson_reset(
+                self._accel_m, self.k * self.x.shape[1])
+            acc_f_prev = float("inf")
+            acc_r_prev = float("inf")
+            acc_r_best = float("inf")
+            acc_stall = 0
+            acc_mix_on = True
+            acc_c_safe = self.centroids
         # One run id for the whole fit: an explicit ``run_id`` wins (the
         # serve layer passes its job id so the train_job span, the SSE
         # events, and these spans all agree), else the TelemetryWriter's
@@ -480,19 +542,78 @@ class LloydRunner:
                         phase = "step" if self._stepped else "compile+step"
                         self._stepped = True
                     with _tracing.span("update", category="update"):
-                        self.centroids = new_c
+                        outcome = None
+                        if self._accel_mix is not None:
+                            # Safeguard first: the sweep's inertia is the
+                            # objective AT the pre-sweep iterate — if the
+                            # last extrapolation raised it, restart from
+                            # the safe plain output, history cleared.
+                            f_c = float(inertia)
+                            # Settle/stall bookkeeping runs every sweep,
+                            # rejected or not, and r_prev always carries
+                            # this sweep's residual — exactly the fused
+                            # loop's unconditional carries (and the f64
+                            # oracle's): skipping them on rejection
+                            # would leave the residual-growth gate
+                            # disabled (r_prev=inf) and the MIX_STALL
+                            # counter frozen through a reject-heavy
+                            # plateau, un-bounding the dither the
+                            # settle switch exists to bound.
+                            s_now = float(shift_sq)
+                            if s_now < acc_r_best:
+                                acc_r_best, acc_stall = s_now, 0
+                            else:
+                                acc_stall += 1
+                            acc_mix_on = (acc_mix_on
+                                          and s_now > MIX_FLOOR * tol
+                                          and acc_stall < MIX_STALL)
+                            if f_c > acc_f_prev * (1.0 + REJECT_SLACK):
+                                outcome = "rejected"
+                                self.centroids = acc_c_safe
+                                acc_xs, acc_rs, acc_cnt = anderson_reset(
+                                    self._accel_m,
+                                    self.k * self.x.shape[1])
+                                acc_r_prev = s_now
+                            else:
+                                mixed, acc_xs, acc_rs, acc_cnt, ok = \
+                                    self._accel_mix(
+                                        self.centroids, new_c, acc_xs,
+                                        acc_rs, acc_cnt, self._accel_reg)
+                                # Residual growth ⇒ plain fallback
+                                # (same gates as the fused loop: close
+                                # to the floor mixing can wander while
+                                # the objective is flat).
+                                use = bool(ok) and acc_mix_on and \
+                                    s_now <= acc_r_prev
+                                outcome = ("accepted" if use
+                                           else "fallback")
+                                acc_f_prev = f_c
+                                acc_r_prev = s_now
+                                acc_c_safe = new_c
+                                self.centroids = mixed if use else new_c
+                            accel_counters[outcome].inc()
+                        else:
+                            self.centroids = new_c
                         self.iteration += 1
                         self.last_inertia = float(inertia)
-                        converged = float(shift_sq) <= tol
+                        converged = (float(shift_sq) <= tol
+                                     and outcome != "rejected")
+                        if converged and outcome is not None:
+                            # Land on the safe plain output — the mixed
+                            # iterate was never objective-checked.
+                            self.centroids = new_c
                         hist.observe(dt)
                         iters_total.inc()
                         info = IterInfo(
                             self.iteration, float(inertia),
                             float(shift_sq), dt, converged,
                         )
+                        extra = ({} if outcome is None
+                                 else {"accel": outcome})
                         if tw is not None:
                             tw.iteration(info, model="lloyd",
-                                         device=device, phase=phase)
+                                         device=device, phase=phase,
+                                         **extra)
                         if callback:
                             callback(info)
                     saved = bool(checkpoint_path) and (

@@ -1,5 +1,7 @@
 """Numeric kernels: assignment, fused Lloyd pass, centroid update."""
 
+from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
+                                     anderson_reset)
 from kmeans_tpu.ops.delta import delta_pass
 from kmeans_tpu.ops.distance import assign, pairwise_sq_dists, sq_norms
 from kmeans_tpu.ops.hamerly import hamerly_pass
@@ -7,6 +9,9 @@ from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_update
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = [
+    "anderson_mix",
+    "anderson_push",
+    "anderson_reset",
     "assign",
     "pairwise_sq_dists",
     "sq_norms",

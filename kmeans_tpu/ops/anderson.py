@@ -1,0 +1,122 @@
+"""Depth-m Anderson mixing for fixed-point centroid iterations.
+
+Lloyd's update is a fixed-point map ``c ← T(c)``.  Anderson acceleration
+(PAPERS.md, "Fast K-Means Clustering with Anderson Acceleration") keeps
+the last m iterates x_i and residuals r_i = T(x_i) − x_i and proposes
+
+    c_next = Σ_i α_i · T(x_i),    α = argmin ‖Σ_i α_i r_i‖²  s.t. Σα = 1
+
+— the constrained (Type-II) formulation, whose optimum comes from the
+normal equations on the m×m Gram matrix G = R Rᵀ: solve G α ∝ 1, then
+normalize.  The constrained form is what the ring buffer wants: the
+solution is invariant to the ROW ORDER of the history, so a wrapping
+ring needs no rotation before the solve.
+
+Cost per step: O(m²·k·d) for the Gram + O(m³) for the solve + O(m·k·d)
+for the mix — at m≈5 this is noise next to the fused O(n·k·d) pass.
+
+Everything here is shape-static pure ``jnp`` designed to be traced
+INSIDE a ``lax.while_loop`` body (the accelerated fit stays one
+compiled program): the history is a pair of carried ``(m, k·d)``
+buffers plus an int32 slot counter, pushes are
+``lax.dynamic_update_slice`` ring writes, and "not enough history yet /
+ill-conditioned" comes back as a boolean the caller folds into its
+``jnp.where`` accept path — no host control flow anywhere.
+
+Safeguarding is the CALLER's half of the contract: the mixed iterate is
+an extrapolation with no descent guarantee, so the loop that consumes
+it must compare the objective (free at the next fused pass) and restart
+from the last plain-Lloyd iterate when it grew
+(:mod:`kmeans_tpu.models.accelerated`).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["anderson_reset", "anderson_push", "anderson_mix",
+           "ANDERSON_GAMMA_CAP"]
+
+#: Σ|α| above this means the Gram solve exploded (near-singular history,
+#: e.g. a stalled iterate pushed twice): the mixing "solution" is a wild
+#: cancellation of huge coefficients and the caller should take the
+#: plain Lloyd step instead.
+ANDERSON_GAMMA_CAP = 1e4
+
+
+def anderson_reset(m: int, kd: int) -> Tuple[jax.Array, jax.Array,
+                                             jax.Array]:
+    """Empty history: ``(xs (m, kd), rs (m, kd), count)`` all-zero.
+
+    Also the in-loop reset shape: a safeguard rejection zeroes the
+    carried buffers (``jnp.where(rejected, 0.0, xs)``) and the count, so
+    stale directions from a diverged extrapolation never contaminate the
+    restarted history.
+    """
+    f32 = jnp.float32
+    return (jnp.zeros((m, kd), f32), jnp.zeros((m, kd), f32),
+            jnp.zeros((), jnp.int32))
+
+
+def anderson_push(xs: jax.Array, rs: jax.Array, count: jax.Array,
+                  x_flat: jax.Array, r_flat: jax.Array):
+    """Ring-write one ``(iterate, residual)`` pair; returns the advanced
+    ``(xs, rs, count)``.  ``count`` grows without bound (the loop's
+    ``max_iter`` bounds it); the live row set is ``min(count, m)`` and
+    the write slot ``count % m`` — the constrained solve in
+    :func:`anderson_mix` is order-invariant, so wrapping needs no
+    rotation."""
+    m = xs.shape[0]
+    slot = jnp.mod(count, m)
+    xs = lax.dynamic_update_slice(xs, x_flat[None, :].astype(xs.dtype),
+                                  (slot, 0))
+    rs = lax.dynamic_update_slice(rs, r_flat[None, :].astype(rs.dtype),
+                                  (slot, 0))
+    return xs, rs, count + 1
+
+
+def anderson_mix(xs: jax.Array, rs: jax.Array, count: jax.Array, *,
+                 reg, gamma_cap: float = ANDERSON_GAMMA_CAP):
+    """Solve the regularized constrained least squares and mix.
+
+    Returns ``(mixed (kd,), ok)``: the proposed iterate
+    ``Σ α_i (x_i + r_i)`` and a scalar bool that is False whenever the
+    proposal must not be used — fewer than two history pairs (no
+    direction to mix yet), a non-finite solve, or coefficient mass over
+    ``gamma_cap`` (near-singular Gram).  Callers take the plain step on
+    ``~ok``; they never need to branch on WHY.
+
+    ``reg`` is the Tikhonov ridge relative to the Gram's mean diagonal
+    (``λ = reg·tr(G)/m_live``), so the conditioning guard is scale-free
+    in the data.
+    """
+    m = xs.shape[0]
+    f32 = jnp.float32
+    n_live = jnp.minimum(count, m)
+    valid = (jnp.arange(m) < n_live)
+    # Mask rows explicitly: after a ring wrap the "dead" slots below
+    # count may hold stale pairs from before a safeguard reset.
+    rs_v = rs * valid[:, None].astype(f32)
+    gram = rs_v @ rs_v.T                                    # (m, m) f32
+    # Invalid diagonal → 1 so the system stays well-posed; their α is
+    # forced to 0 after the solve either way.
+    eye = jnp.eye(m, dtype=f32)
+    gram = jnp.where(valid[:, None] & valid[None, :], gram, eye)
+    lam = reg * jnp.trace(gram) / jnp.maximum(n_live, 1).astype(f32)
+    alpha = jnp.linalg.solve(gram + lam * eye, valid.astype(f32))
+    alpha = jnp.where(valid, alpha, 0.0)
+    s = jnp.sum(alpha)
+    safe_s = jnp.where(jnp.abs(s) > 1e-12, s, 1.0)
+    alpha = alpha / safe_s
+    ok = (
+        (n_live >= 2)
+        & jnp.isfinite(s) & (jnp.abs(s) > 1e-12)
+        & jnp.all(jnp.isfinite(alpha))
+        & (jnp.sum(jnp.abs(alpha)) <= gamma_cap)
+    )
+    mixed = (alpha[None, :] @ (xs + rs))[0]                 # Σ α_i T(x_i)
+    return mixed, ok

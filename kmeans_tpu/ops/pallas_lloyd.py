@@ -36,6 +36,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Module-local alias, NOT a patch of the shared pltpu namespace: pre-rename
+# jax spells it TPUCompilerParams, and co-installed libraries may feature-
+# detect the new API via hasattr(pltpu, "CompilerParams").
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
 __all__ = ["lloyd_pass_pallas", "accumulate_pallas", "pallas_supported",
@@ -385,7 +391,7 @@ def lloyd_pass_pallas(
         # The default scoped-VMEM limit (16 MiB when this call is nested in a
         # larger program, e.g. the whole-fit while_loop) is below the budget
         # this kernel is gated on; raise it to budget + headroom explicitly.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -697,7 +703,7 @@ def lloyd_delta_pallas(
             jax.ShapeDtypeStruct((1, k_pad), f32),
             jax.ShapeDtypeStruct((n_pad, 1), f32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -1014,7 +1020,7 @@ def lloyd_hamerly_pallas(
             jax.ShapeDtypeStruct((1, k_pad), f32),
             jax.ShapeDtypeStruct((n_pad, 1), f32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -1138,7 +1144,7 @@ def accumulate_pallas(
             jax.ShapeDtypeStruct((1, k_pad), f32),
             jax.ShapeDtypeStruct((n_pad, 1), f32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
         ),
         interpret=interpret,

@@ -1334,15 +1334,19 @@ def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
 @functools.lru_cache(maxsize=32)
 def _build_accelerated_run(mesh, data_axis, chunk_size, compute_dtype,
                            update, max_it, backend, weights_binary,
-                           beta_max):
+                           beta_max, accel="beta", anderson_m=5):
     """Jitted sharded accelerated-Lloyd program (DP over points).
 
-    The over-relaxation scheme of
-    :func:`kmeans_tpu.models.accelerated.fit_lloyd_accelerated` — c ←
-    T(c) + β(T(c) − c) with the free-objective safeguard — needs only the
-    fused pass's (sums, counts, inertia), so the shard story is plain
-    DP: one psum of those three per iteration, extrapolation arithmetic
-    O(k·d) replicated.  The final labeling pass reuses the DP body."""
+    The extrapolation schemes of
+    :func:`kmeans_tpu.models.accelerated.fit_lloyd_accelerated` — β
+    over-relaxation or depth-m Anderson mixing, both under the
+    free-objective safeguard — need only the fused pass's
+    (sums, counts, inertia), so the shard story is plain DP: one psum of
+    those three per iteration, extrapolation arithmetic (O(k·d), plus
+    O(m²·k·d) for the Anderson Gram) replicated.  The Anderson history
+    ring is replicated carried state inside the while_loop, mirroring
+    the single-device ``_anderson_loop`` exactly.  The final labeling
+    pass reuses the DP body."""
 
     # THE one DP shard body serves both phases (no second copy of the
     # psum+update merge): step reads (T(c), f(c)) from its
@@ -1365,6 +1369,72 @@ def _build_accelerated_run(mesh, data_axis, chunk_size, compute_dtype,
         check_vma=False,
     )
     f32 = jnp.float32
+
+    if accel == "anderson":
+        from kmeans_tpu.models.accelerated import (MIX_FLOOR, MIX_STALL,
+                                                   REJECT_SLACK)
+        from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
+                                             anderson_reset)
+
+        @jax.jit
+        def run_anderson(x, w, c0, tol_v, reg_v):
+            kd = c0.shape[0] * c0.shape[1]
+
+            def cond(s):
+                return (s[3] < max_it) & ~s[5]
+
+            def body(s):
+                # Same accept/reject/fallback arithmetic (incl. the
+                # residual-growth gate and the MIX_FLOOR/MIX_STALL
+                # settle switch) as the single-device _anderson_loop
+                # (models/accelerated.py) — only the pass reduction is
+                # distributed; the history ring and the m×m Gram solve
+                # are replicated.
+                (c, c_safe, f_prev, it, r_prev, _, mix_on, r_best,
+                 stall, xs, rs, hcount, n_acc, n_rej, n_fb) = s
+                tc, f_c, _ = step(x, c, w)
+                shift_sq = jnp.sum((tc - c) ** 2)
+                rejected = f_c > f_prev * (1.0 + REJECT_SLACK)
+                grew = shift_sq > r_prev
+                improved = shift_sq < r_best
+                r_best = jnp.minimum(r_best, shift_sq)
+                stall = jnp.where(improved, 0, stall + 1)
+                mix_on = (mix_on & (shift_sq > MIX_FLOOR * tol_v)
+                          & (stall < MIX_STALL))
+                xs_p, rs_p, cnt_p = anderson_push(
+                    xs, rs, hcount, c.reshape(-1), (tc - c).reshape(-1))
+                mixed, ok = anderson_mix(xs_p, rs_p, cnt_p, reg=reg_v)
+                use_mix = ok & ~grew & mix_on
+                c_acc = jnp.where(use_mix, mixed.reshape(tc.shape), tc)
+                c_next = jnp.where(rejected, c_safe, c_acc)
+                xs_n = jnp.where(rejected, 0.0, xs_p)
+                rs_n = jnp.where(rejected, 0.0, rs_p)
+                cnt_n = jnp.where(rejected, 0, cnt_p)
+                f_next = jnp.where(rejected, f_prev, f_c)
+                c_safe_next = jnp.where(rejected, c_safe, tc)
+                done = (shift_sq <= tol_v) & ~rejected
+                acc = (~rejected) & use_mix
+                return (c_next, c_safe_next, f_next, it + 1, shift_sq,
+                        done, mix_on, r_best, stall, xs_n, rs_n, cnt_n,
+                        n_acc + acc, n_rej + rejected,
+                        n_fb + ((~rejected) & ~use_mix))
+
+            xs0, rs0, cnt0 = anderson_reset(anderson_m, kd)
+            zero_i = jnp.zeros((), jnp.int32)
+            init = (
+                c0.astype(f32), c0.astype(f32), jnp.asarray(jnp.inf, f32),
+                zero_i, jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
+                jnp.ones((), bool), jnp.asarray(jnp.inf, f32), zero_i,
+                xs0, rs0, cnt0, zero_i, zero_i, zero_i,
+            )
+            out = lax.while_loop(cond, body, init)
+            (c, c_safe, _, n_iter, _, converged, _, _, _,
+             _, _, _, n_acc, n_rej, n_fb) = out
+            _, inertia, counts, labels = final(x, c_safe, w)
+            return (c_safe, labels, inertia, n_iter, converged, counts,
+                    n_acc, n_rej, n_fb)
+
+        return run_anderson
 
     @jax.jit
     def run(x, w, c0, tol_v):
@@ -1418,14 +1488,29 @@ def fit_lloyd_accelerated_sharded(
     tol: Optional[float] = None,
     max_iter: Optional[int] = None,
     beta_max: float = 1.0,
+    accel: Optional[str] = None,
+    anderson_m: Optional[int] = None,
+    anderson_reg: Optional[float] = None,
 ) -> KMeansState:
-    """Safeguarded over-relaxed Lloyd on a device mesh (DP over points) —
+    """Safeguarded extrapolated Lloyd on a device mesh (DP over points) —
     the sharded counterpart of
     :func:`kmeans_tpu.models.fit_lloyd_accelerated`, completing the
-    mesh story for the last center-based family.  Same contract; DP only
-    (the extrapolation needs full centroids, which DP replicates anyway).
+    mesh story for the last center-based family.  Same contract
+    (``accel`` picks β over-relaxation or Anderson mixing, default
+    ``config.accel``); DP only — the extrapolation needs full centroids,
+    which DP replicates anyway, and the Anderson history/Gram solve is
+    O(m²·k·d) replicated arithmetic next to the sharded pass.
     """
     cfg, key = resolve_fit_config(k, key, config)
+    accel = accel if accel is not None else cfg.accel
+    if accel not in ("beta", "anderson"):
+        raise ValueError(f"unknown accel {accel!r}")
+    if cfg.schedule != "full":
+        raise NotImplementedError(
+            f"schedule={cfg.schedule!r} is not supported by the sharded "
+            "accelerated loop (the nested subsample ladder is single-device "
+            "today); use fit_lloyd_accelerated or schedule='full'"
+        )
     if cfg.empty == "farthest":
         raise NotImplementedError(
             "empty='farthest' is not supported by the accelerated loop "
@@ -1482,13 +1567,24 @@ def fit_lloyd_accelerated_sharded(
         weights=w_host, compute_dtype=cfg.compute_dtype,
         platform=mesh.devices.flat[0].platform,
     )
+    m = anderson_m if anderson_m is not None else cfg.anderson_m
     run = _build_accelerated_run(
         mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, update,
         max_iter if max_iter is not None else cfg.max_iter, backend,
-        weights_binary, float(beta_max),
+        weights_binary, float(beta_max), accel, m,
     )
     tol_v = jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32)
-    c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
+    if accel == "anderson":
+        from kmeans_tpu.models.accelerated import record_accel_steps
+
+        reg_v = jnp.asarray(
+            anderson_reg if anderson_reg is not None else cfg.anderson_reg,
+            jnp.float32)
+        (c, labels, inertia, n_iter, converged, counts,
+         n_acc, n_rej, n_fb) = run(x, w, c0, tol_v, reg_v)
+        record_accel_steps(n_acc, n_rej, n_fb)
+    else:
+        c, labels, inertia, n_iter, converged, counts = run(x, w, c0, tol_v)
     return KMeansState(c, labels[:n], inertia, n_iter, converged, counts)
 
 

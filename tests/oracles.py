@@ -56,6 +56,86 @@ def inertia(x: np.ndarray, c: np.ndarray, weights: np.ndarray | None = None):
     return float(np.sum(w * mind))
 
 
+def anderson_lloyd(x: np.ndarray, c0: np.ndarray, *, m: int = 5,
+                   reg: float = 1e-8, tol: float = 1e-4,
+                   max_iter: int = 300, gamma_cap: float = 1e4,
+                   mix_floor: float = 300.0, mix_stall: int = 8,
+                   reject_slack: float = 1e-5):
+    """Float64 oracle of the Anderson-accelerated Lloyd loop — the same
+    algorithm as ``kmeans_tpu.models.accelerated._anderson_loop`` (ring
+    history, constrained Gram solve, free-objective safeguard with
+    history clear, residual-growth fallback, MIX_FLOOR/MIX_STALL settle
+    switch) in naive NumPy.  Returns ``(c, n_iter, final_inertia,
+    (n_accepted, n_rejected, n_fallback))``.
+    """
+    k = len(c0)
+    c = c0.astype(np.float64).copy()
+    kd = c.size
+    xs = np.zeros((m, kd))
+    rs = np.zeros((m, kd))
+    cnt = 0
+    c_safe = c.copy()
+    f_prev = np.inf
+    r_prev = np.inf
+    r_best = np.inf
+    stall = 0
+    mix_on = True
+    n_acc = n_rej = n_fb = 0
+    n_iter = 0
+    for _ in range(max_iter):
+        n_iter += 1
+        labels, mind = assign(x, c)
+        f_c = float(mind.sum())
+        tc, _, _ = update(x, labels, k, c)
+        shift_sq = float(np.sum((tc - c) ** 2))
+        if shift_sq < r_best:                  # stall/settle bookkeeping
+            r_best, stall = shift_sq, 0        # runs every sweep, rejected
+        else:                                  # or not (mirrors the loop,
+            stall += 1                         # where mix_on/r_best/stall
+        mix_on = (mix_on and shift_sq > mix_floor * tol
+                  and stall < mix_stall)       # are carried unconditionally)
+        if f_c > f_prev * (1 + reject_slack):  # safeguard: reject + clear
+            n_rej += 1
+            c = c_safe.copy()
+            xs[:] = 0.0
+            rs[:] = 0.0
+            cnt = 0
+            r_prev = shift_sq
+            continue
+        grew = shift_sq > r_prev
+        xs[cnt % m] = c.ravel()
+        rs[cnt % m] = (tc - c).ravel()
+        cnt += 1
+        nl = min(cnt, m)
+        ok = nl >= 2
+        if ok:
+            r_live = rs[:nl]
+            gram = r_live @ r_live.T
+            lam = reg * np.trace(gram) / nl
+            alpha = np.linalg.solve(gram + lam * np.eye(nl), np.ones(nl))
+            s = alpha.sum()
+            ok = (np.isfinite(s) and abs(s) > 1e-12
+                  and np.isfinite(alpha).all())
+            if ok:
+                alpha = alpha / s
+                ok = np.abs(alpha).sum() <= gamma_cap
+        use_mix = ok and not grew and mix_on
+        if use_mix:
+            n_acc += 1
+            c_next = (alpha[None, :nl] @ (xs[:nl] + rs[:nl]))[0] \
+                .reshape(c.shape)
+        else:
+            n_fb += 1
+            c_next = tc
+        f_prev = f_c
+        c_safe = tc.copy()
+        r_prev = shift_sq
+        if shift_sq <= tol:
+            break
+        c = c_next
+    return c_safe, n_iter, inertia(x, c_safe), (n_acc, n_rej, n_fb)
+
+
 # ---------------------------------------------------------------------------
 # Cluster-quality metric oracles (naive O(n²) definitions)
 # ---------------------------------------------------------------------------

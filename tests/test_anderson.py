@@ -1,0 +1,360 @@
+"""Anderson-accelerated convergence: mixing ops, safeguard properties,
+nested mini-batch scheduling, oracle cross-check (ISSUE 8)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kmeans_tpu import fit_lloyd, fit_lloyd_accelerated, fit_minibatch
+from kmeans_tpu.data import make_blobs
+from kmeans_tpu.models.accelerated import ACCEL_STEPS
+from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
+                                     anderson_reset)
+
+import oracles
+
+
+def _outcomes():
+    return {o: ACCEL_STEPS.value(outcome=o)
+            for o in ("accepted", "rejected", "fallback")}
+
+
+def _outcome_delta(before):
+    after = _outcomes()
+    return {o: after[o] - before[o] for o in after}
+
+
+# ---------------------------------------------------------------------------
+# ops/anderson unit level
+# ---------------------------------------------------------------------------
+
+def test_mix_accelerates_linear_fixed_point():
+    """On a genuinely linear map x ← Ax + b (spectral radius ~0.99) the
+    constrained mixing must cut iterations severalfold — validates the
+    Gram solve independently of k-means' piecewise map."""
+    rng = np.random.default_rng(0)
+    n = 40
+    q, _ = np.linalg.qr(rng.normal(size=(n, n)))
+    a = (q * rng.uniform(0.5, 0.99, size=n)) @ q.T
+    b = rng.normal(size=n).astype(np.float32)
+    a = a.astype(np.float32)
+
+    def step(v):
+        return a @ v + b
+
+    def iters_to(tol, mix):
+        v = jnp.zeros((n,), jnp.float32)
+        xs, rs, cnt = anderson_reset(5, n)
+        reg = jnp.asarray(1e-8, jnp.float32)
+        for it in range(3000):
+            tv = step(v)
+            r = tv - v
+            if float(jnp.linalg.norm(r)) < tol:
+                return it + 1
+            if not mix:
+                v = tv
+                continue
+            xs, rs, cnt = anderson_push(xs, rs, cnt, v, r)
+            mixed, ok = anderson_mix(xs, rs, cnt, reg=reg)
+            v = mixed if bool(ok) else tv
+        return 3000
+
+    plain = iters_to(1e-3, mix=False)
+    accelerated = iters_to(1e-3, mix=True)
+    assert accelerated * 3 < plain, (plain, accelerated)
+
+
+def test_push_is_a_ring_and_mix_masks_warmup():
+    m, kd = 3, 4
+    xs, rs, cnt = anderson_reset(m, kd)
+    # Warm-up: with < 2 pairs the mix must refuse.
+    xs, rs, cnt = anderson_push(xs, rs, cnt,
+                                jnp.ones((kd,)), jnp.ones((kd,)))
+    _, ok = anderson_mix(xs, rs, cnt, reg=jnp.asarray(1e-8))
+    assert not bool(ok)
+    for i in range(2, m + 2):       # wrap past m
+        xs, rs, cnt = anderson_push(
+            xs, rs, cnt, jnp.full((kd,), float(i)),
+            jnp.full((kd,), float(i)))
+    assert int(cnt) == m + 1
+    # Slot 0 was overwritten by the (m+1)-th push (value m+1).
+    np.testing.assert_array_equal(np.asarray(xs[0]), np.full(kd, m + 1.0))
+    np.testing.assert_array_equal(np.asarray(xs[1]), np.full(kd, 2.0))
+
+
+def test_mix_exact_with_dim_plus_one_history():
+    """On an affine map in R², three (iterate, residual) pairs span the
+    residual space, so the constrained solve lands the EXACT fixed point
+    (the multisecant property; the paper's acceleration mechanism)."""
+    a = jnp.asarray([[0.9, 0.2], [0.0, 0.5]], jnp.float32)
+    b = jnp.asarray([1.0, 1.0], jnp.float32)
+    xstar = np.linalg.solve(np.eye(2) - np.asarray(a), np.asarray(b))
+    xs, rs, cnt = anderson_reset(3, 2)
+    v = jnp.zeros((2,), jnp.float32)
+    for _ in range(3):
+        tv = a @ v + b
+        xs, rs, cnt = anderson_push(xs, rs, cnt, v, tv - v)
+        v = tv
+    mixed, ok = anderson_mix(xs, rs, cnt, reg=jnp.asarray(1e-10))
+    assert bool(ok)
+    np.testing.assert_allclose(np.asarray(mixed), xstar, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Safeguard properties (fused loop)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def hard_blobs():
+    """Overlapping blobs — slow enough convergence that the safeguard
+    actually has work to do."""
+    x, _, _ = make_blobs(jax.random.key(3), 4000, 16, 8, cluster_std=2.5)
+    return np.asarray(x)
+
+
+def test_equal_budget_never_meaningfully_worse(hard_blobs):
+    """Property (a): at EQUAL iteration budgets the safeguarded Anderson
+    fit never ends with (meaningfully) higher inertia than plain Lloyd —
+    the safeguard lands every budget on the last safe plain-Lloyd
+    iterate, whose objective is monotone."""
+    x = hard_blobs
+    for seed in (0, 1, 2):
+        c0 = x[np.random.default_rng(seed).choice(len(x), 8,
+                                                  replace=False)]
+        for budget in (5, 15, 40):
+            plain = fit_lloyd(x, 8, init=c0, tol=1e-10, max_iter=budget)
+            acc = fit_lloyd_accelerated(x, 8, init=c0, tol=1e-10,
+                                        max_iter=budget, accel="anderson")
+            assert float(acc.inertia) <= float(plain.inertia) * 1.01, (
+                seed, budget)
+
+
+def test_forced_bad_extrapolation_rejects_exactly_once():
+    """Property (b): the inject_bad_step drill displaces one iterate far
+    from the data; the free-objective safeguard must fire on the next
+    pass — EXACTLY once — and the fit must recover to the same answer.
+
+    Seeded at the true centers (a near-fixed-point start), the clean
+    trajectory provably has zero natural rejections, so the drilled
+    run's single rejection is attributable to the injection alone."""
+    x, _, centers = make_blobs(jax.random.key(0), 4000, 16, 8,
+                               cluster_std=0.6)
+    x, c0 = np.asarray(x), np.asarray(centers)
+    kw = dict(tol=1e-5, max_iter=60, accel="anderson")
+    before = _outcomes()
+    clean = fit_lloyd_accelerated(x, 8, init=c0, **kw)
+    clean_delta = _outcome_delta(before)
+    assert clean_delta["rejected"] == 0
+    before = _outcomes()
+    drilled = fit_lloyd_accelerated(x, 8, init=c0, inject_bad_step=0, **kw)
+    drill_delta = _outcome_delta(before)
+    assert drill_delta["rejected"] == 1
+    assert bool(drilled.converged)
+    # The rewind recovers the clean answer (one extra iteration paid).
+    np.testing.assert_allclose(float(drilled.inertia),
+                               float(clean.inertia), rtol=1e-5)
+    assert int(drilled.n_iter) == int(clean.n_iter) + 1
+    # The drill is an Anderson-loop hook; the β loop rejects it.
+    with pytest.raises(ValueError, match="inject_bad_step"):
+        fit_lloyd_accelerated(x, 8, init=c0, accel="beta",
+                              inject_bad_step=3)
+
+
+def test_outcome_counters_cover_every_iteration(hard_blobs):
+    x = hard_blobs
+    c0 = x[np.random.default_rng(1).choice(len(x), 8, replace=False)]
+    before = _outcomes()
+    st = fit_lloyd_accelerated(x, 8, init=c0, tol=1e-4, max_iter=80,
+                               accel="anderson")
+    delta = _outcome_delta(before)
+    assert sum(delta.values()) == int(st.n_iter)
+    assert delta["fallback"] >= 1        # warm-up step is always plain
+
+
+def test_anderson_converges_to_lloyd_fixed_point(hard_blobs):
+    x = hard_blobs
+    c0 = x[np.random.default_rng(2).choice(len(x), 8, replace=False)]
+    acc = fit_lloyd_accelerated(x, 8, init=c0, tol=1e-6, max_iter=300,
+                                accel="anderson")
+    assert bool(acc.converged)
+    after = fit_lloyd(x, 8, init=np.asarray(acc.centroids), max_iter=1,
+                      tol=0.0)
+    shift = float(np.sum(
+        (np.asarray(after.centroids) - np.asarray(acc.centroids)) ** 2))
+    assert shift < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# Nested mini-batch scheduling
+# ---------------------------------------------------------------------------
+
+def test_nested_minibatch_matches_full_batch():
+    """Property (c): on well-separated blobs the nested schedule's final
+    inertia matches the full-batch fit within rtol (both converge to the
+    same solution; the ladder only warm-starts it)."""
+    x, _, _ = make_blobs(jax.random.key(5), 20_000, 12, 10,
+                         cluster_std=0.8)
+    x = np.asarray(x)
+    c0 = x[np.random.default_rng(5).choice(len(x), 10, replace=False)]
+    full = fit_lloyd(x, 10, init=c0, tol=1e-6, max_iter=200)
+    nested = fit_minibatch(x, 10, init=c0, schedule="nested", tol=1e-6)
+    np.testing.assert_allclose(float(nested.inertia), float(full.inertia),
+                               rtol=1e-3)
+    accel_nested = fit_lloyd_accelerated(x, 10, init=c0, tol=1e-6,
+                                         max_iter=200, accel="anderson",
+                                         schedule="nested")
+    np.testing.assert_allclose(float(accel_nested.inertia),
+                               float(full.inertia), rtol=1e-3)
+    # Ladder iterations ride n_iter: the nested run reports MORE
+    # iterations than its full-batch phase alone.
+    assert int(nested.n_iter) >= 1
+
+
+def test_nested_ladder_rungs_double_and_promote():
+    from kmeans_tpu.models.minibatch import nested_ladder
+
+    x, _, _ = make_blobs(jax.random.key(6), 40_000, 8, 6, cluster_std=1.0)
+    x = np.asarray(x)
+    c0 = x[np.random.default_rng(6).choice(len(x), 6, replace=False)]
+    c, total, rungs = nested_ladder(x, jnp.asarray(c0), tol=1e-6,
+                                    start=4096, chunk_size=4096)
+    assert [b for b, _ in rungs] == [4096, 8192, 16384, 32768]
+    assert total == sum(it for _, it in rungs)
+    assert all(it >= 1 for _, it in rungs)
+    assert c.shape == c0.shape
+    # start >= n → empty ladder, caller promotes immediately.
+    _, total0, rungs0 = nested_ladder(x[:1000], jnp.asarray(c0), tol=1e-6,
+                                      start=4096)
+    assert total0 == 0 and rungs0 == []
+
+
+def test_nested_rejects_sculley_knobs_and_weights():
+    x, _, _ = make_blobs(jax.random.key(7), 2000, 4, 3)
+    x = np.asarray(x)
+    with pytest.raises(ValueError, match="nested"):
+        fit_minibatch(x, 3, schedule="nested", steps=10)
+    with pytest.raises(ValueError, match="nested"):
+        fit_lloyd_accelerated(x, 3, schedule="nested",
+                              weights=np.ones(len(x), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Oracle cross-check
+# ---------------------------------------------------------------------------
+
+def test_anderson_oracle_cross_check():
+    """The float64 NumPy oracle (tests/oracles.py) runs the same
+    algorithm; both must converge to equal-quality solutions, and the
+    oracle validates the safeguard property independently of jax."""
+    rng = np.random.default_rng(11)
+    x, _, _ = make_blobs(jax.random.key(11), 1200, 8, 6, cluster_std=2.0)
+    x = np.asarray(x, np.float64)
+    c0 = x[rng.choice(len(x), 6, replace=False)]
+    tol = 1e-4 * float(x.var(axis=0).mean())
+
+    c_or, it_or, f_or, (na, nr, nf) = oracles.anderson_lloyd(
+        x, c0, m=5, reg=1e-8, tol=tol, max_iter=200)
+    assert na + nr + nf == it_or
+
+    st = fit_lloyd_accelerated(x.astype(np.float32), 6,
+                               init=c0.astype(np.float32), tol=tol,
+                               max_iter=200, accel="anderson")
+    np.testing.assert_allclose(float(st.inertia), f_or, rtol=1e-3)
+
+    # Safeguard property on the oracle itself: never meaningfully worse
+    # than the plain oracle at the same budget.
+    for budget in (5, 20):
+        c_p, _, f_p, _ = oracles.anderson_lloyd(
+            x, c0, m=2, reg=1e30, tol=0.0, max_iter=budget)  # reg→∞: plain
+        _, _, f_a, _ = oracles.anderson_lloyd(
+            x, c0, m=5, reg=1e-8, tol=0.0, max_iter=budget)
+        assert f_a <= f_p * 1.01
+
+
+# ---------------------------------------------------------------------------
+# Step-paced runner
+# ---------------------------------------------------------------------------
+
+def test_runner_anderson_stamps_outcomes_and_matches_quality():
+    import io
+    import json
+
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import LloydRunner
+    from kmeans_tpu.obs import TelemetryWriter
+
+    x, _, _ = make_blobs(jax.random.key(9), 4000, 12, 6, cluster_std=2.0)
+    x = np.asarray(x)
+    cfg = KMeansConfig(k=6, max_iter=80, tol=1e-4)
+
+    plain = LloydRunner(x, 6, config=cfg)
+    plain.init()
+    st_plain = plain.run()
+
+    before = _outcomes()
+    runner = LloydRunner(x, 6, config=cfg, accel="anderson")
+    runner.init()
+    buf = io.StringIO()
+    st = runner.run(telemetry=TelemetryWriter(buf))
+    delta = _outcome_delta(before)
+
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    iters = [e for e in events if e["event"] == "iter"]
+    assert len(iters) == int(st.n_iter)
+    assert all(e["accel"] in ("accepted", "rejected", "fallback")
+               for e in iters)
+    assert sum(delta.values()) == len(iters)
+    assert float(st.inertia) <= float(st_plain.inertia) * 1.01
+
+    # Plain runner events carry no accel field.
+    buf2 = io.StringIO()
+    p2 = LloydRunner(x, 6, config=cfg)
+    p2.init()
+    p2.run(telemetry=TelemetryWriter(buf2))
+    assert all("accel" not in json.loads(line)
+               for line in buf2.getvalue().splitlines()
+               if '"iter"' in line)
+
+
+def test_runner_rejects_bad_accel_combos():
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models import LloydRunner
+
+    x = np.random.default_rng(0).normal(size=(200, 4)).astype(np.float32)
+    with pytest.raises(ValueError, match="anderson"):
+        LloydRunner(x, 3, config=KMeansConfig(k=3), accel="beta")
+    with pytest.raises(ValueError, match="farthest"):
+        LloydRunner(x, 3, config=KMeansConfig(k=3, empty="farthest"),
+                    accel="anderson")
+
+
+# ---------------------------------------------------------------------------
+# Config / surface plumbing
+# ---------------------------------------------------------------------------
+
+def test_config_validates_accel_fields():
+    from kmeans_tpu.config import KMeansConfig
+
+    with pytest.raises(ValueError, match="accel"):
+        KMeansConfig(k=2, accel="nope").validate()
+    with pytest.raises(ValueError, match="anderson_m"):
+        KMeansConfig(k=2, anderson_m=1).validate()
+    with pytest.raises(ValueError, match="schedule"):
+        KMeansConfig(k=2, schedule="sometimes").validate()
+    cfg = KMeansConfig(k=2, accel="anderson", schedule="nested").validate()
+    assert cfg.anderson_m == 5
+
+
+def test_config_accel_flows_through_front_door(hard_blobs):
+    """accel/schedule resolve from the config when not passed
+    explicitly — the CLI's only plumbing is KMeansConfig."""
+    from kmeans_tpu.config import KMeansConfig
+
+    x = hard_blobs
+    c0 = x[np.random.default_rng(4).choice(len(x), 8, replace=False)]
+    cfg = KMeansConfig(k=8, accel="anderson", max_iter=60)
+    before = _outcomes()
+    st = fit_lloyd_accelerated(x, 8, init=c0, config=cfg)
+    assert sum(_outcome_delta(before).values()) == int(st.n_iter)

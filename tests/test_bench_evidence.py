@@ -60,6 +60,52 @@ def test_artifacts_are_well_formed():
         assert r["backend"] in ("pallas", "xla")
 
 
+def test_accel_artifact_is_well_formed():
+    """BENCH_ACCEL_latest.json (ISSUE 8): the accelerated-convergence
+    evidence — per-config plain/anderson/nested arms with
+    explicit provenance (platform + scale) and the quality bound."""
+    path = os.path.join(_REPO, "BENCH_ACCEL_latest.json")
+    with open(path) as f:
+        acc = json.load(f)
+    assert acc["bench"] == "accel"
+    assert acc["platform"]
+    names = [r["config"] for r in acc["rows"]]
+    assert "glove" in names and "imagenet" in names
+    for r in acc["rows"]:
+        assert r["scale"] >= 1          # provenance: scaled rows declare it
+        assert "seed" in r              # instance identity (medians need >1)
+        for arm in ("plain", "anderson", "nested"):
+            a = r[arm]
+            assert a["iters"] >= 1 and a["seconds"] > 0
+            assert a["converged"] is True
+        nst = r["nested"]
+        assert nst["epochs_to_converge"] > 0
+        assert nst["ladder_rungs"]
+        assert nst["full_batch_iters"] >= 0
+    # Gates judge per-config MEDIANS over instance rows (warm-start
+    # trajectories are chaotic; the artifact records every instance —
+    # and a single-instance config is not evidence of anything).
+    # The booleans must agree with a recomputation through THE one
+    # shared derivation — a hand-edited artifact fails here.
+    import bench
+
+    assert all(m["instances"] >= 3 for m in acc["medians"].values())
+    assert acc["gates"] == bench.accel_gates(acc["rows"])
+    assert acc["medians"] == bench.accel_medians(acc["rows"])
+    g = acc["gates"]
+    # What the techniques measurably deliver at these shapes (the full
+    # regime study is ROADMAP item 3): the anderson safeguard holds at
+    # the artifact level — median final inertia within 1e-3 relative of
+    # plain Lloyd on every config (usually equal-or-lower) — and the
+    # nested schedule cuts wall-clock-to-converge on ≥1 config.
+    # Iteration/epoch reductions are reported per row and as medians but
+    # NOT gated: at k=1000 they are strongly data-dependent and plain
+    # Lloyd from a k-means++ start is a brutally strong baseline.
+    assert g["anderson_quality_ok"] is True
+    assert g["nested_quality_ok"] is True
+    assert g["nested_seconds_ok"] is True
+
+
 def test_bench_multidev_delta_measures_the_delta_loop():
     """On >1 device the bench must run the DP carried-state delta loop
     (the multi-chip production default via update='auto'), not silently

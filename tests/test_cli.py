@@ -483,6 +483,80 @@ def test_train_bisecting_on_mesh(capsys):
     assert res["k"] == 4
 
 
+def test_train_accel_anderson_nested(capsys):
+    """--accel selects the accelerated model and threads accel/schedule
+    through KMeansConfig (ISSUE 8).  n=20000 > 2x the default
+    nested_start so the ladder actually runs rungs (8192, 16384) —
+    at n=4000 it is empty and the CLI path would only ever be smoked
+    in its degenerate full-batch form."""
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "20000", "--d", "8", "--k", "4",
+        "--accel", "anderson", "--schedule", "nested",
+        "--anderson-m", "4",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "accelerated"
+    assert np.isfinite(res["inertia"]) and res["n_iter"] >= 1
+
+
+def test_train_accel_flag_guards(capsys):
+    # --accel with a family that would silently ignore it.
+    rc, _, err = _run(capsys, [
+        "train", "--n", "200", "--d", "4", "--k", "3",
+        "--model", "gmm", "--accel", "anderson"])
+    assert rc == 2 and "--accel" in err
+    # --anderson-m without --accel anderson.
+    rc, _, err = _run(capsys, [
+        "train", "--n", "200", "--d", "4", "--k", "3",
+        "--anderson-m", "4"])
+    assert rc == 2 and "--anderson-m" in err
+    # --schedule on the streamed path.
+    rc, _, err = _run(capsys, [
+        "train", "--n", "200", "--d", "4", "--k", "3",
+        "--model", "kernel", "--schedule", "nested"])
+    assert rc == 2 and "--schedule" in err
+    # nested + Sculley knobs contradict.
+    rc, _, err = _run(capsys, [
+        "train", "--n", "200", "--d", "4", "--k", "3",
+        "--model", "minibatch", "--schedule", "nested", "--steps", "5"])
+    assert rc == 2 and "ladder" in err
+    # --accel beta is fused-loop only; the runner path is anderson.
+    rc, _, err = _run(capsys, [
+        "train", "--n", "200", "--d", "4", "--k", "3",
+        "--model", "lloyd", "--accel", "beta", "--progress"])
+    assert rc == 2 and "anderson" in err
+
+
+def test_train_accel_runner_telemetry(tmp_path, capsys):
+    """--accel anderson with runner flags steps the lloyd runner and
+    stamps per-iteration outcomes into the telemetry stream."""
+    tpath = str(tmp_path / "accel.jsonl")
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "3000", "--d", "6", "--k", "4",
+        "--model", "lloyd", "--accel", "anderson",
+        "--telemetry", tpath,
+    ])
+    assert rc in (0, None)
+    events = [json.loads(line) for line in open(tpath)]
+    iters = [e for e in events if e.get("event") == "iter"]
+    assert iters
+    assert all(e.get("accel") in ("accepted", "rejected", "fallback")
+               for e in iters)
+
+
+def test_train_minibatch_nested_schedule(capsys):
+    rc, out, _ = _run(capsys, [
+        "train", "--n", "4000", "--d", "6", "--k", "4",
+        "--model", "minibatch", "--schedule", "nested",
+        "--max-iter", "50",
+    ])
+    assert rc in (0, None)
+    res = json.loads(out.splitlines()[0])
+    assert res["mode"] == "minibatch"
+    assert np.isfinite(res["inertia"])
+
+
 def test_train_accelerated_on_mesh(capsys):
     rc, out, _ = _run(capsys, [
         "train", "--model", "accelerated", "--n", "400", "--d", "6",

@@ -393,3 +393,19 @@ def test_loadgen_smoke(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["smoke_ok"] and out["dropped"] == 0
     assert out["batches"] > 0 and out["generations"] > 1
+
+
+def test_loadgen_open_loop_slo_smoke(capsys):
+    """ROADMAP item 2c: the open-loop latency SLO smoke — fixed offered
+    rate (departures don't self-throttle on completions), tiny point
+    count, p99 under the loose bound, zero drops."""
+    from tools import loadgen
+
+    rc = loadgen.main(["--smoke", "--mode", "open"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, out
+    assert out["mode"] == "open" and out["smoke_ok"]
+    assert out["dropped"] == 0
+    assert out["p99_ms"] is not None
+    assert out["p99_ms"] <= loadgen.SMOKE_OPEN_P99_MS
+    assert out["slo_ok"]

@@ -39,6 +39,7 @@ MODULES = [
     "kmeans_tpu.utils.checkpoint",
     "kmeans_tpu.data.stream",
     "kmeans_tpu.models.runner",
+    "kmeans_tpu.models.accelerated",
     "kmeans_tpu.models.streaming",
     "kmeans_tpu.models.gmm_stream",
     "kmeans_tpu.parallel.engine",

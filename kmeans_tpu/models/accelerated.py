@@ -50,13 +50,19 @@ from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_inputs
 from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.obs import counter as _obs_counter, enabled as _obs_enabled
-from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
-                                     anderson_reset)
+from kmeans_tpu.obs.costmodel import observed
+from kmeans_tpu.ops.anderson import (MIX_FLOOR, MIX_STALL,  # noqa: F401
+                                     OUTCOME_REJECTED, REJECT_SLACK,
+                                     anderson_reset, anderson_state,
+                                     anderson_step)
 from kmeans_tpu.ops.lloyd import (lloyd_pass, resolve_backend,
                                   resolve_update, weights_exact)
 from kmeans_tpu.ops.update import apply_update
 
-__all__ = ["fit_lloyd_accelerated", "ACCEL_STEPS"]
+__all__ = ["fit_lloyd_accelerated", "ACCEL_STEPS",
+           # Historical homes of the safeguard constants — the values
+           # (and the step arithmetic) now live in ops/anderson.py.
+           "MIX_FLOOR", "MIX_STALL", "REJECT_SLACK"]
 
 #: Extrapolation outcomes across every accelerated fit in the process
 #: (docs/OBSERVABILITY.md): ``accepted`` = the extrapolated iterate was
@@ -74,35 +80,14 @@ for _o in ("accepted", "rejected", "fallback"):
     ACCEL_STEPS.labels(outcome=_o)
 del _o
 
-#: Settle threshold of the Anderson loops: mixing turns off for good
-#: once the squared residual falls within this factor of the tolerance,
-#: and plain Lloyd polishes to the exact fixed point.  See the comment
-#: in ``_anderson_loop`` — near the floor, mixing dithers (and k-means'
-#: piecewise-constant map means the last stretch belongs to plain steps
-#: anyway: once labels freeze, ONE plain step lands on the fixed point).
-#: Swept on the bench protocol: 300 beat 30/100 on iterations-to-
-#: converge at equal final inertia.
-MIX_FLOOR = 300.0
-
-#: Stall guard, the settle switch's second trigger: if the residual sets
-#: no new minimum for this many consecutive iterations, mixing turns off
-#: for good.  Plain Lloyd's residual decays essentially monotonically;
-#: a stalled residual means the mixing keeps re-exciting label churn
-#: faster than the contraction damps it (observed: an overlapping
-#: random-seeded fit that plain finishes in 31 sweeps ran to max_iter
-#: without this guard).  Bounds the worst case at ~plain + MIX_STALL.
-MIX_STALL = 8
-
-#: Relative slack of the rejection test: reject only when
-#: ``f > f_prev·(1 + REJECT_SLACK)``.  The objective is an f32 sum of n
-#: terms — its sweep-to-sweep noise (ε·f, amplified by accumulation
-#: order) exceeds the TRUE per-step improvement on near-plateau
-#: stretches, and a noise-rejection is self-sustaining: the rewound
-#: safe iterate re-measures within noise of f_prev and "rejects" again
-#: (observed: 78 rejections in 120 sweeps on an overlapping k=1000
-#: fit).  A genuinely diverging extrapolation overshoots by orders of
-#: magnitude more than 1e-5, so the safeguard keeps its teeth.
-REJECT_SLACK = 1e-5
+# The safeguard constants (MIX_FLOOR / MIX_STALL / REJECT_SLACK) and the
+# accept/reject/fallback arithmetic itself live in ops/anderson.py as
+# `anderson_step` — THE one copy all three production surfaces (this
+# fused loop, the sharded engine's DP loop, the step-paced runner) call,
+# retiring the PR 8 triplication debt.  Every in-repo importer now uses
+# ops.anderson directly; the names stay importable from this historical
+# home only for OUT-OF-TREE callers (the constants were documented
+# public tuning surface here since PR 8).
 
 
 def record_accel_steps(n_accepted: int, n_rejected: int,
@@ -116,6 +101,7 @@ def record_accel_steps(n_accepted: int, n_rejected: int,
     ACCEL_STEPS.labels(outcome="fallback").inc(int(n_fallback))
 
 
+@observed("models.accelerated_loop")
 @functools.partial(
     jax.jit,
     static_argnames=("max_iter", "chunk_size", "compute_dtype", "update",
@@ -170,6 +156,7 @@ def _accelerated_loop(x, centroids0, weights, tol, *, max_iter, chunk_size,
     return KMeansState(c_final, labels, inertia, n_iter, converged, counts)
 
 
+@observed("models.anderson_loop")
 @functools.partial(
     jax.jit,
     static_argnames=("max_iter", "chunk_size", "compute_dtype", "update",
@@ -246,81 +233,40 @@ def _anderson_loop(x, centroids0, weights, tol, xs0, rs0, reg, *, max_iter,
                         delta_sweep, None)
 
     def cond(s):
-        return (s[3] < max_iter) & ~s[5]
+        return (s[1] < max_iter) & ~s[2]
 
     def body(s):
-        (c, c_safe, f_prev, it, r_prev, _, mix_on, r_best, stall,
-         xs, rs, hcount, n_acc, n_rej, n_fb, lab, sums, counts) = s
+        c, it, _, st, lab, sums, counts = s
         lab, sums, counts, f_c = sweep(c, it, lab, sums, counts)
         tc = apply_update(c, sums, counts)
         shift_sq = jnp.sum((tc - c) ** 2)
-
-        # The free-objective safeguard (noise-tolerant: REJECT_SLACK); a
-        # rejection also clears the history — directions measured
-        # through a diverged extrapolation would poison the restarted
-        # trajectory.
-        rejected = f_c > f_prev * (1.0 + REJECT_SLACK)
-        # Residual-growth fallback: ‖T(c)−c‖² growing means the last
-        # mixing pushed AWAY from the fixed point even though the
-        # objective didn't rise (near the floor the objective is flat to
-        # f32 while mixing can still wander) — take the plain
-        # contraction step until the residual decays again.
-        grew = shift_sq > r_prev
-        # Settle switch: mixing turns OFF for the rest of the fit once
-        # the residual is within MIX_FLOOR of the tolerance, or once it
-        # has stalled MIX_STALL iterations without a new minimum.
-        # Lloyd's fixed points are exact (labels freeze, then T(c) ≡ c),
-        # so the plain polishing phase terminates for ANY tol — while
-        # continued mixing can re-excite label churn forever and dither
-        # below the objective's f32 resolution without ever meeting the
-        # shift test.
-        improved = shift_sq < r_best
-        r_best = jnp.minimum(r_best, shift_sq)
-        stall = jnp.where(improved, 0, stall + 1)
-        mix_on = (mix_on & (shift_sq > MIX_FLOOR * tol)
-                  & (stall < MIX_STALL))
-
-        xs_p, rs_p, cnt_p = anderson_push(
-            xs, rs, hcount, c.reshape(-1), (tc - c).reshape(-1))
-        mixed, ok = anderson_mix(xs_p, rs_p, cnt_p, reg=reg)
-        use_mix = ok & ~grew & mix_on
-        c_acc = jnp.where(use_mix, mixed.reshape(tc.shape), tc)
-
-        c_next = jnp.where(rejected, c_safe, c_acc)
+        # THE shared safeguarded decision (ops.anderson.anderson_step):
+        # free-objective rejection + residual-growth fallback +
+        # MIX_FLOOR/MIX_STALL settle switch + history-clearing rewind —
+        # identical by construction across this loop, the sharded
+        # engine's, and the step-paced runner.
+        c_next, st, outcome = anderson_step(c, tc, f_c, shift_sq, st,
+                                            tol=tol, reg=reg)
         if inject_at is not None:
             bad = c_next + 1e3 * (1.0 + jnp.abs(c_next))
             c_next = jnp.where(it == inject_at, bad, c_next)
-        xs_n = jnp.where(rejected, 0.0, xs_p)
-        rs_n = jnp.where(rejected, 0.0, rs_p)
-        cnt_n = jnp.where(rejected, 0, cnt_p)
-        f_next = jnp.where(rejected, f_prev, f_c)
-        c_safe_next = jnp.where(rejected, c_safe, tc)
-        done = (shift_sq <= tol) & ~rejected
-        acc = (~rejected) & use_mix
-        return (c_next, c_safe_next, f_next, it + 1,
-                shift_sq, done, mix_on, r_best, stall,
-                xs_n, rs_n, cnt_n,
-                n_acc + acc, n_rej + rejected,
-                n_fb + ((~rejected) & ~use_mix), lab, sums, counts)
+        done = (shift_sq <= tol) & (outcome != OUTCOME_REJECTED)
+        return (c_next, it + 1, done, st, lab, sums, counts)
 
     zero_i = jnp.zeros((), i32)
     init = (
-        centroids0.astype(f32), centroids0.astype(f32),
-        jnp.asarray(jnp.inf, f32), zero_i,
-        jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
-        jnp.ones((), bool), jnp.asarray(jnp.inf, f32), zero_i,
-        xs0, rs0, zero_i, zero_i, zero_i, zero_i,
+        centroids0.astype(f32), zero_i, jnp.zeros((), bool),
+        anderson_state(centroids0, xs0, rs0),
         jnp.full((n,), -1, i32),           # sentinel → first sweep full
         jnp.zeros((k, x.shape[1]), f32),
         jnp.zeros((k,), f32),
     )
-    out = lax.while_loop(cond, body, init)
-    (c, c_safe, _, n_iter, r_last, converged, _, _, _,
-     _, _, _, n_acc, n_rej, n_fb, _, _, _) = out
+    _, n_iter, converged, st, _, _, _ = lax.while_loop(cond, body, init)
     # Land on the safe iterate — the last mixed `c` was never checked.
-    labels, _, _, counts, inertia = lloyd_pass(x, c_safe, **kw)
-    return (KMeansState(c_safe, labels, inertia, n_iter, converged, counts),
-            (n_acc, n_rej, n_fb))
+    labels, _, _, counts, inertia = lloyd_pass(x, st.c_safe, **kw)
+    return (KMeansState(st.c_safe, labels, inertia, n_iter, converged,
+                        counts),
+            (st.n_acc, st.n_rej, st.n_fb))
 
 
 def fit_lloyd_accelerated(

@@ -23,6 +23,7 @@ from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_inputs
+from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.lloyd import (lloyd_pass, resolve_backend,
                                   resolve_update, weights_exact)
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
@@ -42,6 +43,7 @@ class KMeansState(NamedTuple):
     counts: jax.Array         # (k,) float32 cluster sizes at final labels
 
 
+@observed("models.lloyd_loop")
 @functools.partial(
     jax.jit,
     static_argnames=(

@@ -27,12 +27,14 @@ from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
 from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.obs import (
+    costmodel as _costmodel,
     counter as _obs_counter,
     histogram as _obs_histogram,
     tracing as _tracing,
 )
-from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
-                                     anderson_reset)
+from kmeans_tpu.ops.anderson import (OUTCOME_ACCEPTED, OUTCOME_REJECTED,
+                                     anderson_reset, anderson_state,
+                                     anderson_step)
 from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_backend, resolve_update
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
@@ -163,11 +165,12 @@ class LloydRunner:
         # runner, post-resume, post-init).
         self._dstate = None
 
-        # Step-paced Anderson acceleration (ops/anderson): the runner
-        # applies the safeguard + depth-m mixing BETWEEN jitted sweeps,
-        # so every iteration still surfaces its inertia/shift to the
-        # callback/telemetry — plus the step's extrapolation outcome.
-        self._accel_mix = None
+        # Step-paced Anderson acceleration: the runner applies the
+        # shared safeguarded decision (ops.anderson.anderson_step — THE
+        # one copy the fused and sharded loops also call) BETWEEN jitted
+        # sweeps, so every iteration still surfaces its inertia/shift to
+        # the callback/telemetry — plus the step's extrapolation outcome.
+        self._accel_step = None
         if accel is not None:
             if accel != "anderson":
                 raise ValueError(
@@ -191,19 +194,19 @@ class LloydRunner:
             self._accel_reg = jnp.asarray(self.cfg.anderson_reg,
                                           jnp.float32)
 
-            # Per-instance jit (one compile amortized over the whole
-            # run, like the step programs above); the carried history
-            # ring is donated — the previous generation's buffers are
-            # dead once the push returns the new ones.
-            @functools.partial(jax.jit, donate_argnums=(2, 3, 4))
-            def accel_mix(c, tc, xs, rs, cnt, reg):
-                xs, rs, cnt = anderson_push(
-                    xs, rs, cnt, c.reshape(-1), (tc - c).reshape(-1))
-                mixed, ok = anderson_mix(xs, rs, cnt, reg=reg)
-                return (jnp.where(ok, mixed.reshape(tc.shape), tc),
-                        xs, rs, cnt, ok)
+            # Per-instance jit of THE shared step (one compile amortized
+            # over the whole run, like the step programs above).  The
+            # carried state is deliberately NOT donated: its c_safe leaf
+            # aliases the live `c` argument on the first step (and can
+            # value-alias c_next after a rejection), which donation
+            # forbids — and the state is O(m·k·d), small next to x.
+            @jax.jit
+            def accel_step(c, tc, f_c, shift_sq, st, tol, reg):
+                return anderson_step(c, tc, f_c, shift_sq, st,
+                                     tol=tol, reg=reg)
 
-            self._accel_mix = accel_mix
+            self._accel_step = _costmodel.observe(
+                accel_step, name="runner.accel_step")
 
         if mesh is None:
             self.x = jnp.asarray(x)
@@ -273,9 +276,17 @@ class LloydRunner:
                     shift_sq = jnp.sum((new_c - c) ** 2)
                     return new_c, inertia, shift_sq, labels, sums, counts
 
-                self._step_delta = step_delta
+                self._step_delta = _costmodel.observe(
+                    step_delta, name="runner.step_delta")
 
-            self._step = step
+            # Compile-observed under a STABLE name: each runner instance
+            # compiles its own program, so a second instance re-tracing
+            # an already-seen signature is a visible retrace (the
+            # per-instance-jit cost the RET202 lint documents, now a
+            # metric); the wrapper's last_record also feeds the
+            # compile_s/flops telemetry stamp in run().
+            self._step = _costmodel.observe(step, name="runner.step")
+            self._step_prog = self._step
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from kmeans_tpu.parallel.engine import (
@@ -352,6 +363,8 @@ class LloydRunner:
                 shift_sq = jnp.sum((new_c - c) ** 2)
                 return new_c, inertia, shift_sq
 
+            step = _costmodel.observe(step, name="runner.step_mesh")
+            self._step_prog = step
             self._step = lambda x, c: step(x, c, self._w)
 
     # ------------------------------------------------------------------ API
@@ -427,23 +440,20 @@ class LloydRunner:
         converged = False
         saved = False
         t_run0 = time.perf_counter()
-        if self._accel_mix is not None:
-            from kmeans_tpu.models.accelerated import (ACCEL_STEPS,
-                                                       MIX_FLOOR, MIX_STALL,
-                                                       REJECT_SLACK)
+        if self._accel_step is not None:
+            from kmeans_tpu.models.accelerated import ACCEL_STEPS
 
             accel_counters = {o: ACCEL_STEPS.labels(outcome=o)
                               for o in ("accepted", "rejected", "fallback")}
-            # Host-paced safeguard state (reset per run; resume across a
-            # process boundary restarts the history like _dstate).
-            acc_xs, acc_rs, acc_cnt = anderson_reset(
+            # Carried safeguard+history state of the SHARED step (reset
+            # per run; resume across a process boundary restarts the
+            # history like _dstate).
+            acc_xs, acc_rs, _ = anderson_reset(
                 self._accel_m, self.k * self.x.shape[1])
-            acc_f_prev = float("inf")
-            acc_r_prev = float("inf")
-            acc_r_best = float("inf")
-            acc_stall = 0
-            acc_mix_on = True
-            acc_c_safe = self.centroids
+            acc_state = anderson_state(jnp.asarray(self.centroids,
+                                                   jnp.float32),
+                                       acc_xs, acc_rs)
+            acc_tol = jnp.asarray(tol, jnp.float32)
         # One run id for the whole fit: an explicit ``run_id`` wins (the
         # serve layer passes its job id so the train_job span, the SSE
         # events, and these spans all agree), else the TelemetryWriter's
@@ -541,56 +551,32 @@ class LloydRunner:
                     else:
                         phase = "step" if self._stepped else "compile+step"
                         self._stepped = True
+                    compile_extra = (self._compile_telemetry(ran_delta)
+                                     if phase == "compile+step" else {})
                     with _tracing.span("update", category="update"):
                         outcome = None
-                        if self._accel_mix is not None:
-                            # Safeguard first: the sweep's inertia is the
-                            # objective AT the pre-sweep iterate — if the
-                            # last extrapolation raised it, restart from
-                            # the safe plain output, history cleared.
-                            f_c = float(inertia)
-                            # Settle/stall bookkeeping runs every sweep,
-                            # rejected or not, and r_prev always carries
-                            # this sweep's residual — exactly the fused
-                            # loop's unconditional carries (and the f64
-                            # oracle's): skipping them on rejection
-                            # would leave the residual-growth gate
-                            # disabled (r_prev=inf) and the MIX_STALL
-                            # counter frozen through a reject-heavy
-                            # plateau, un-bounding the dither the
-                            # settle switch exists to bound.
-                            s_now = float(shift_sq)
-                            if s_now < acc_r_best:
-                                acc_r_best, acc_stall = s_now, 0
-                            else:
-                                acc_stall += 1
-                            acc_mix_on = (acc_mix_on
-                                          and s_now > MIX_FLOOR * tol
-                                          and acc_stall < MIX_STALL)
-                            if f_c > acc_f_prev * (1.0 + REJECT_SLACK):
-                                outcome = "rejected"
-                                self.centroids = acc_c_safe
-                                acc_xs, acc_rs, acc_cnt = anderson_reset(
-                                    self._accel_m,
-                                    self.k * self.x.shape[1])
-                                acc_r_prev = s_now
-                            else:
-                                mixed, acc_xs, acc_rs, acc_cnt, ok = \
-                                    self._accel_mix(
-                                        self.centroids, new_c, acc_xs,
-                                        acc_rs, acc_cnt, self._accel_reg)
-                                # Residual growth ⇒ plain fallback
-                                # (same gates as the fused loop: close
-                                # to the floor mixing can wander while
-                                # the objective is flat).
-                                use = bool(ok) and acc_mix_on and \
-                                    s_now <= acc_r_prev
-                                outcome = ("accepted" if use
-                                           else "fallback")
-                                acc_f_prev = f_c
-                                acc_r_prev = s_now
-                                acc_c_safe = new_c
-                                self.centroids = mixed if use else new_c
+                        if self._accel_step is not None:
+                            # THE shared safeguarded decision
+                            # (ops.anderson.anderson_step): the sweep's
+                            # inertia is the objective AT the pre-sweep
+                            # iterate — rejection rewinds to the safe
+                            # plain output with the history cleared,
+                            # residual growth / settle switch fall back
+                            # to the plain step, all with exactly the
+                            # fused loops' carries (skipping the
+                            # bookkeeping on rejection would disable the
+                            # residual-growth gate and freeze MIX_STALL
+                            # through reject-heavy plateaus).
+                            c_next, acc_state, code = self._accel_step(
+                                self.centroids, new_c, inertia, shift_sq,
+                                acc_state, acc_tol, self._accel_reg)
+                            code = int(code)
+                            outcome = ("accepted"
+                                       if code == OUTCOME_ACCEPTED
+                                       else "rejected"
+                                       if code == OUTCOME_REJECTED
+                                       else "fallback")
+                            self.centroids = c_next
                             accel_counters[outcome].inc()
                         else:
                             self.centroids = new_c
@@ -610,6 +596,7 @@ class LloydRunner:
                         )
                         extra = ({} if outcome is None
                                  else {"accel": outcome})
+                        extra.update(compile_extra)
                         if tw is not None:
                             tw.iteration(info, model="lloyd",
                                          device=device, phase=phase,
@@ -655,6 +642,40 @@ class LloydRunner:
         finally:
             if own_tw:
                 tw.close()
+
+    def _compile_telemetry(self, ran_delta: bool) -> dict:
+        """Telemetry fields of the sweep program that JUST compiled
+        (docs/OBSERVABILITY.md "Compile & cost"): ``compile_s`` from the
+        observatory's record of the first-call wall time, plus a
+        one-shot ``cost_analysis`` probe (FLOPs / bytes accessed — one
+        extra trace, no backend compile) stamped into the per-function
+        cost gauges and the event.  Best-effort: a cost probe must
+        never be the reason a fit dies."""
+        if not _costmodel.enabled():
+            # The disabled observatory must cost nothing and mutate
+            # nothing — including this probe's extra program trace.
+            return {}
+        wrapper = self._step_delta if ran_delta else self._step_prog
+        rec = getattr(wrapper, "last_record", None)
+        out = {}
+        if rec is not None:
+            out["compile_s"] = rec["seconds"]
+        try:
+            if ran_delta:
+                args = (self.x, self.centroids) + tuple(self._dstate)
+            elif self.mesh is not None:
+                args = (self.x, self.centroids, self._w)
+            else:
+                args = (self.x, self.centroids)
+            cost = _costmodel.cost_report(wrapper, *args)
+        except Exception:
+            return out
+        _costmodel.record_cost(wrapper.observatory_name, cost)
+        if cost.get("flops") is not None:
+            out["compile_flops"] = cost["flops"]
+        if cost.get("bytes_accessed") is not None:
+            out["compile_bytes"] = cost["bytes_accessed"]
+        return out
 
     def finalize(self, *, converged: bool = False) -> KMeansState:
         """Labels/inertia/counts at the current centroids."""

@@ -25,7 +25,7 @@ historical meaning); the span tracer has its own independent switch
 counter bump and default OFF.
 """
 
-from kmeans_tpu.obs import tracing
+from kmeans_tpu.obs import costmodel, tracing
 from kmeans_tpu.obs.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -58,6 +58,7 @@ __all__ = [
     "read_events",
     "summarize_events",
     "summarize_by_run",
+    "costmodel",
     "tracing",
     "enable",
     "disable",

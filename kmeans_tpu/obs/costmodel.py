@@ -1,0 +1,609 @@
+"""Compile/cost observatory: retraces, compile wall-time, FLOPs/VMEM.
+
+The observability layer's fourth part (docs/OBSERVABILITY.md "Compile &
+cost"): the registry answers "how is the process doing", telemetry "what
+did this run do", tracing "where did this request's time go" — this
+module answers **"what is XLA doing to my functions"**: how often each
+jitted entry point compiles, whether it is RE-compiling signatures it
+already compiled (the runtime twin of the RET201-204 AST lints — a
+per-call-jit regression now fires a metric, not just a lint), how long
+those compiles take, what the compiled program costs
+(``jax.stages.Lowered.cost_analysis()`` FLOPs/bytes,
+``Compiled.memory_analysis()`` peak memory), and why a (k, d, block)
+config does or does not fit the Pallas kernels' VMEM budget
+(:func:`vmem_report` — the k-tiling preflight of ROADMAP item 1).
+
+Design constraints mirror the registry's:
+
+* **zero import-time dependencies** — this module must import without
+  jax (the obs package's standing rule); every jax touch is lazy and
+  guarded;
+* **near-zero steady-state cost** — an observed function's hot path is
+  one enabled check, one tracer sniff, one signature tuple, one set
+  lookup (microseconds next to the millisecond kernels it wraps), and
+  :func:`disable` reduces it to one attribute check + delegation;
+* **thread-safe** — serve dispatchers, train workers, and the test
+  suite all call observed functions concurrently; per-wrapper seen-sets
+  and the global signature table hold their own locks.
+
+Semantics
+---------
+
+An **observed** function wraps a jitted callable under a stable name.
+Each call computes the abstract signature of its arguments — shapes +
+dtypes for arrays, values for hashable statics.  The first time a
+wrapper sees a signature, that call traces-and-compiles: its wall time
+lands in ``kmeans_tpu_compile_seconds{function}`` (trace + XLA compile
++ the dispatch of the first execution — an upper bound on compile, the
+same quantity the telemetry ``compile+step`` phase brackets) under a
+``jit_compile`` span, and ``kmeans_tpu_compiles_total{function}``
+increments.  If that (function, signature) pair was ALREADY compiled by
+a previous wrapper instance — a fresh ``jax.jit`` per call, a rebuilt
+per-instance program, a cache defeated by a closure constant — the
+compile counts as a **retrace**: ``kmeans_tpu_retraces_total{function}``
+fires.  Calls whose arguments are tracers (the function is being
+inlined into an enclosing jit) are invisible: they are not compile
+units of their own.
+
+``cost_report`` captures FLOPs / bytes-accessed from
+``Lowered.cost_analysis()`` (one extra trace, no backend compile) and —
+opt-in, because it pays a second full backend compile —
+``Compiled.memory_analysis()`` peak memory.  The Lloyd runner stamps
+the report into its telemetry stream and spans on the ``compile+step``
+iteration (docs/OBSERVABILITY.md telemetry schema).
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kmeans_tpu.obs import tracing as _tracing
+from kmeans_tpu.obs.registry import counter as _counter, gauge as _gauge, \
+    histogram as _histogram
+
+__all__ = [
+    "observe",
+    "observed",
+    "ObservedFunction",
+    "cost_report",
+    "record_cost",
+    "vmem_report",
+    "last_compile",
+    "compile_log",
+    "snapshot",
+    "enable",
+    "disable",
+    "enabled",
+    "reset_seen",
+    "COMPILES_TOTAL",
+    "RETRACES_TOTAL",
+    "COMPILE_SECONDS",
+    "COMPILE_SIGNATURES",
+    "COST_FLOPS",
+    "COST_BYTES",
+    "COST_PEAK_BYTES",
+]
+
+#: Compile-scale buckets: an XLA:CPU toy compiles in ~10 ms, the fused
+#: TPU loops in tens of seconds (the default request-latency ladder
+#: would dump every real compile into +Inf).
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                    30.0, 60.0, 120.0)
+
+COMPILES_TOTAL = _counter(
+    "kmeans_tpu_compiles_total",
+    "Traces/compiles of observed jitted functions (one per new "
+    "(function, abstract-shape signature) a wrapper dispatches)",
+    labels=("function",),
+)
+RETRACES_TOTAL = _counter(
+    "kmeans_tpu_retraces_total",
+    "Compiles of a (function, signature) pair that was ALREADY compiled "
+    "by a previous program instance — a defeated jit cache (per-call "
+    "jit, rebuilt builder, closure-constant churn); the runtime twin of "
+    "the RET201-204 lints and steady-state zero by contract",
+    labels=("function",),
+)
+COMPILE_SECONDS = _histogram(
+    "kmeans_tpu_compile_seconds",
+    "Wall time of the first call per (function, signature): trace + XLA "
+    "compile + first dispatch (the telemetry compile+step bracket)",
+    labels=("function",), buckets=_COMPILE_BUCKETS,
+)
+COMPILE_SIGNATURES = _gauge(
+    "kmeans_tpu_compile_signatures",
+    "Distinct abstract-shape signatures compiled per observed function "
+    "(growth under steady shapes means signature churn)",
+    labels=("function",),
+)
+COST_FLOPS = _gauge(
+    "kmeans_tpu_compile_cost_flops",
+    "XLA cost-analysis FLOPs of the most recently analyzed compile of "
+    "each observed function (jax.stages.Lowered.cost_analysis)",
+    labels=("function",),
+)
+COST_BYTES = _gauge(
+    "kmeans_tpu_compile_cost_bytes",
+    "XLA cost-analysis bytes accessed of the most recently analyzed "
+    "compile of each observed function",
+    labels=("function",),
+)
+COST_PEAK_BYTES = _gauge(
+    "kmeans_tpu_compile_cost_peak_bytes",
+    "Peak device memory (args + outputs + temps) of the most recently "
+    "memory-analyzed compile of each observed function "
+    "(Compiled.memory_analysis; captured only by explicit "
+    "cost_report(memory=True) — it pays a second backend compile)",
+    labels=("function",),
+)
+
+#: Completed-compile records kept for inspection/telemetry stamping.
+_LOG_CAPACITY = 1024
+
+
+class _State:
+    def __init__(self):
+        #: Plain attribute, same contract as the registry/tracer
+        #: switches: the disabled path must cost one attribute load.
+        self.enabled = True
+        self.lock = threading.Lock()
+        #: name -> set of signatures ever compiled by ANY wrapper.
+        self.seen: Dict[str, set] = {}
+        #: name -> most recent compile record.
+        self.last: Dict[str, Dict[str, Any]] = {}
+        self.log: deque = deque(maxlen=_LOG_CAPACITY)
+
+
+_STATE = _State()
+
+_TRACER_CLS: Tuple[type, ...] = ()
+
+
+def _tracer_classes() -> Tuple[type, ...]:
+    """The jax Tracer class(es), resolved lazily and only when jax is
+    already imported — an observed call before any jax import cannot be
+    carrying tracers."""
+    global _TRACER_CLS
+    if _TRACER_CLS:
+        return _TRACER_CLS
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return ()
+    try:
+        _TRACER_CLS = (jax.core.Tracer,)
+    except Exception:  # pragma: no cover - very old/new jax layouts
+        try:
+            from jax._src.core import Tracer
+
+            _TRACER_CLS = (Tracer,)
+        except Exception:
+            _TRACER_CLS = ()
+    return _TRACER_CLS
+
+
+def _any_tracer(values) -> bool:
+    cls = _tracer_classes()
+    if not cls:
+        return False
+    for v in values:
+        if isinstance(v, cls):
+            return True
+        if isinstance(v, (tuple, list)) and _any_tracer(v):
+            return True
+    return False
+
+
+def _sig_value(v) -> Any:
+    """One argument's contribution to the abstract signature: arrays by
+    (shape, dtype), containers recursively, hashable statics by value,
+    everything else by type name (conservative: two unhashable values of
+    one type share a signature slot — at worst one missed retrace, never
+    a spurious one... the reverse: at worst one missed NEW trace count;
+    correctness of dispatch is jax's, not ours)."""
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("A", tuple(shape), str(dtype))
+    if isinstance(v, (tuple, list)):
+        return ("T", tuple(_sig_value(i) for i in v))
+    try:
+        hash(v)
+    except TypeError:
+        return ("U", type(v).__name__)
+    return v
+
+
+def _signature(args, kwargs) -> Tuple:
+    return (tuple(_sig_value(a) for a in args),
+            tuple((k, _sig_value(v)) for k, v in sorted(kwargs.items())))
+
+
+class ObservedFunction:
+    """A jitted callable under compile observation (see the module
+    docstring for the exact accounting).  Transparent: ``*args/**kwargs``
+    forward verbatim (donation annotations keep their positions) and
+    unknown attributes (``.lower``, ``.clear_cache``) delegate to the
+    wrapped function, so AOT callers and the HLO-pin tests keep working.
+    """
+
+    def __init__(self, fn: Callable, name: str, *, cost: bool = False):
+        self._fn = fn
+        self.observatory_name = name
+        self._cost = cost
+        self._seen: set = set()
+        self._lock = threading.Lock()
+        #: Most recent compile record of THIS wrapper (None until it
+        #: traces) — per-program attribution where the global
+        #: :func:`last_compile` would blur concurrent instances.
+        self.last_record: Optional[Dict[str, Any]] = None
+        self.__wrapped__ = fn
+        for attr in ("__name__", "__qualname__", "__doc__", "__module__"):
+            try:
+                setattr(self, attr, getattr(fn, attr))
+            except (AttributeError, TypeError):
+                pass
+        # Pre-seed the label children so /metrics shows this function's
+        # zeroed counters from process start, not after its first fit.
+        for fam in (COMPILES_TOTAL, RETRACES_TOTAL, COMPILE_SECONDS,
+                    COMPILE_SIGNATURES):
+            fam.labels(function=name)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_fn"], item)
+
+    def __repr__(self) -> str:
+        return f"ObservedFunction({self.observatory_name!r}, {self._fn!r})"
+
+    def __call__(self, *args, **kwargs):
+        if not _STATE.enabled:
+            return self._fn(*args, **kwargs)
+        if _any_tracer(args) or (kwargs and _any_tracer(kwargs.values())):
+            # Inlined into an enclosing trace: not a compile unit.
+            return self._fn(*args, **kwargs)
+        try:
+            sig = _signature(args, kwargs)
+        except Exception:
+            return self._fn(*args, **kwargs)
+        # Atomic claim: exactly ONE thread owns the compile accounting
+        # for a (wrapper, signature) — a concurrent racer sees it
+        # claimed and takes the steady path, so two threads cold-calling
+        # the same kernel cannot double-count the compile or report a
+        # spurious retrace (the metric is steady-state zero by
+        # contract; a false alarm would defeat it).
+        with self._lock:
+            if sig in self._seen:
+                known = True
+            else:
+                self._seen.add(sig)
+                known = False
+        if known:
+            return self._fn(*args, **kwargs)
+        return self._compile_call(sig, args, kwargs)
+
+    def _compile_call(self, sig, args, kwargs):
+        name = self.observatory_name
+        cost = None
+        if self._cost:
+            # BEFORE the call: donated buffers are gone after it.
+            try:
+                cost = cost_report(self._fn, *args, **kwargs)
+            except Exception:
+                cost = None
+        # Global (cross-wrapper) signature table: claimed BEFORE the
+        # call, under the same one-owner discipline as the local set.
+        with _STATE.lock:
+            global_seen = _STATE.seen.setdefault(name, set())
+            retrace = sig in global_seen
+            global_seen.add(sig)
+            n_sigs = len(global_seen)
+        with _tracing.span("jit_compile", category="compile",
+                           function=name) as sp:
+            t0 = time.perf_counter()
+            try:
+                out = self._fn(*args, **kwargs)
+            except BaseException:
+                # A failed first call (compile OOM, interrupt) caches no
+                # executable in jax — unclaim the signature so the
+                # retry's REAL compile is accounted, not silently taken
+                # for a steady call.  (One-owner claim: nobody else
+                # could have added these entries meanwhile.)
+                with self._lock:
+                    self._seen.discard(sig)
+                if not retrace:
+                    with _STATE.lock:
+                        _STATE.seen.get(name, set()).discard(sig)
+                raise
+            dt = time.perf_counter() - t0
+        with self._lock:
+            n_local = len(self._seen)
+        COMPILES_TOTAL.labels(function=name).inc()
+        if retrace:
+            RETRACES_TOTAL.labels(function=name).inc()
+        COMPILE_SECONDS.labels(function=name).observe(dt)
+        COMPILE_SIGNATURES.labels(function=name).set(n_sigs)
+        rec = {
+            "function": name,
+            "seconds": dt,
+            "retrace": retrace,
+            "signatures": n_local,
+            "ts": time.time(),
+        }
+        if cost is not None:
+            rec.update({k: cost.get(k) for k in
+                        ("flops", "bytes_accessed", "peak_memory_bytes")})
+            record_cost(name, cost)
+        sp.set(seconds=dt, retrace=retrace,
+               **({k: rec.get(k) for k in ("flops", "bytes_accessed")}
+                  if cost is not None else {}))
+        self.last_record = rec
+        with _STATE.lock:
+            _STATE.last[name] = rec
+            _STATE.log.append(rec)
+        return out
+
+
+def observe(fn: Callable, *, name: str, cost: bool = False
+            ) -> ObservedFunction:
+    """Wrap a jitted callable for compile observation under ``name``.
+
+    ``name`` is the metric label — STABLE across program rebuilds by
+    design: a per-instance jit (the runner's steps, the engine's cached
+    builders) registers each new program under the same name, which is
+    exactly how a rebuilt program re-compiling an already-seen signature
+    becomes a visible retrace.  ``cost=True`` additionally captures
+    ``cost_analysis()`` FLOPs/bytes on every new signature (one extra
+    trace per compile — keep it off for the mega-loop programs whose
+    tracing is itself expensive).
+    """
+    return ObservedFunction(fn, name, cost=cost)
+
+
+def observed(name: str, *, cost: bool = False):
+    """Decorator form of :func:`observe` — stack ABOVE the jit
+    decoration::
+
+        @observed("ops.lloyd_pass_xla")
+        @functools.partial(jax.jit, static_argnames=(...))
+        def _lloyd_pass_xla(...): ...
+
+    The PERF801 analyzer (docs/ANALYSIS.md) checks that the hot jitted
+    entry points carry exactly this registration.
+    """
+    def wrap(fn):
+        return observe(fn, name=name, cost=cost)
+
+    return wrap
+
+
+# ------------------------------------------------------------- controls
+
+def enable() -> None:
+    _STATE.enabled = True
+
+
+def disable() -> None:
+    """Make every observed call a pure delegation (one attribute check)."""
+    _STATE.enabled = False
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def reset_seen() -> None:
+    """Forget the GLOBAL (function, signature) table and compile records
+    (tests): freshly-built wrappers start from a clean cross-instance
+    view.  Existing wrappers keep their own seen-sets (their programs
+    really are still cached), and metrics are monotonic — not rewound."""
+    with _STATE.lock:
+        _STATE.seen.clear()
+        _STATE.last.clear()
+        _STATE.log.clear()
+
+
+def last_compile(name: str) -> Optional[Dict[str, Any]]:
+    """The most recent compile record observed under ``name``."""
+    with _STATE.lock:
+        rec = _STATE.last.get(name)
+        return dict(rec) if rec is not None else None
+
+
+def compile_log(limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Recent compile records, oldest first (bounded ring)."""
+    with _STATE.lock:
+        out = [dict(r) for r in _STATE.log]
+    return out[-limit:] if limit else out
+
+
+def snapshot() -> Dict[str, Dict[str, Any]]:
+    """Per-function accounting view: ``{name: {signatures, compiles,
+    retraces}}`` (tests, debugging)."""
+    with _STATE.lock:
+        names = {n: len(s) for n, s in _STATE.seen.items()}
+    out = {}
+    for n, sigs in names.items():
+        out[n] = {
+            "signatures": sigs,
+            "compiles": COMPILES_TOTAL.value(function=n),
+            "retraces": RETRACES_TOTAL.value(function=n),
+        }
+    return out
+
+
+# ---------------------------------------------------------- cost probes
+
+def record_cost(name: str, cost: Dict[str, Any]) -> None:
+    """Stamp one cost report into the per-function gauges — the single
+    funnel every capture path (wrapper ``cost=True``, the runner's
+    explicit first-iteration probe, bench smokes) goes through."""
+    if cost.get("flops") is not None:
+        COST_FLOPS.labels(function=name).set(float(cost["flops"]))
+    if cost.get("bytes_accessed") is not None:
+        COST_BYTES.labels(function=name).set(float(cost["bytes_accessed"]))
+    if cost.get("peak_memory_bytes") is not None:
+        COST_PEAK_BYTES.labels(function=name).set(
+            float(cost["peak_memory_bytes"]))
+
+
+def cost_report(fn: Callable, *args, memory: bool = False,
+                **kwargs) -> Dict[str, Any]:
+    """FLOPs / bytes / (optionally) peak memory of ``fn`` at these
+    arguments, via the AOT stages API.
+
+    ``fn`` may be a jitted callable or an :class:`ObservedFunction`
+    (unwrapped automatically).  The base report costs one extra TRACE
+    (``fn.lower``) — no backend compile; ``memory=True`` additionally
+    runs ``lowered.compile()`` (a full backend compile that does NOT
+    share the jit cache) to read ``memory_analysis()`` — use it in
+    benches and preflights, not per-call paths.  Fields that the backend
+    cannot produce come back ``None``; the probe itself never raises
+    past its guard (a cost report must not be the reason a fit dies) —
+    callers get what was measurable.
+    """
+    # Unwrap ONLY the observatory's wrapper: jax.jit also sets
+    # __wrapped__ (to the raw Python function, which has no .lower).
+    target = fn.__wrapped__ if isinstance(fn, ObservedFunction) else fn
+    out: Dict[str, Any] = {"flops": None, "bytes_accessed": None,
+                           "peak_memory_bytes": None}
+    try:
+        lowered = target.lower(*args, **kwargs)
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    try:
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            if ca.get("flops") is not None:
+                out["flops"] = float(ca["flops"])
+            ba = ca.get("bytes accessed", ca.get("bytes_accessed"))
+            if ba is not None:
+                out["bytes_accessed"] = float(ba)
+    except Exception as e:  # analysis unavailable on this backend/version
+        out["cost_analysis_error"] = f"{type(e).__name__}: {e}"
+    if memory:
+        try:
+            compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            parts = {}
+            for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                         "temp_size_in_bytes", "alias_size_in_bytes",
+                         "generated_code_size_in_bytes"):
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    parts[attr] = int(v)
+            out["memory"] = parts
+            live = (parts.get("argument_size_in_bytes", 0)
+                    + parts.get("output_size_in_bytes", 0)
+                    + parts.get("temp_size_in_bytes", 0)
+                    - parts.get("alias_size_in_bytes", 0))
+            if parts:
+                out["peak_memory_bytes"] = max(0, live)
+        except Exception as e:
+            out["memory_analysis_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+# --------------------------------------------------------- VMEM preflight
+
+def _mib(b: float) -> float:
+    return b / (1024.0 * 1024.0)
+
+
+def vmem_report(d: int, k: int, *, kernel: str = "classic",
+                block_rows: Optional[int] = None, mc: Optional[int] = None,
+                x_itemsize: int = 2, cd_itemsize: int = 2
+                ) -> Dict[str, Any]:
+    """Analytic VMEM preflight for the Pallas Lloyd kernels: *whether* a
+    (k, d, block) config fits the budget — by construction the same
+    verdict as ``pallas_supported``/``delta_pallas_supported``/
+    ``hamerly_pallas_supported``, because both sum the ONE
+    :func:`kmeans_tpu.ops.pallas_lloyd.vmem_breakdown` — plus *why* and
+    *by how much*: per-operand byte terms, headroom or overflow, and the
+    k-tiling preflight ROADMAP item 1 needs (``max_k_tile``: the largest
+    lane-multiple centroid slice that WOULD fit at this d/block, i.e.
+    the tile size a k-tiled kernel should stream).
+
+    Imports jax/pallas lazily (this is an obs module); itemsizes default
+    to the production bf16 path.
+    """
+    from kmeans_tpu.ops.pallas_lloyd import (VMEM_KERNEL_DEFAULTS, _LANE,
+                                             _vmem_budget, padded_d,
+                                             vmem_breakdown)
+
+    if kernel not in VMEM_KERNEL_DEFAULTS:
+        raise ValueError(f"unknown kernel kind {kernel!r}; "
+                         f"have {sorted(VMEM_KERNEL_DEFAULTS)}")
+    t_def, mc_def = VMEM_KERNEL_DEFAULTS[kernel]
+    t = block_rows if block_rows is not None else t_def
+    mc_eff = mc if mc is not None else mc_def
+    budget = _vmem_budget()
+    base = {
+        "kernel": kernel, "d": d, "k": k, "block_rows": t, "mc": mc_eff,
+        "x_itemsize": x_itemsize, "cd_itemsize": cd_itemsize,
+        "budget_bytes": budget,
+    }
+    terms = vmem_breakdown(kernel, d=d, k=k, block_rows=t, mc=mc_eff,
+                           x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+    if terms is None:
+        return {**base, "supported": False, "terms": None,
+                "total_bytes": None, "headroom_bytes": None,
+                "d_padded": 0, "k_padded": None, "max_k_tile": None,
+                "why": (f"d={d} is not lane-alignable: the next multiple "
+                        f"of {_LANE} exceeds the zero-padding FLOP "
+                        "inflation cap — the kernel is unreachable at "
+                        "this feature width regardless of VMEM")}
+    total = sum(terms.values())
+    supported = total <= budget
+
+    def fits_at_k(kk: int) -> bool:
+        tt = vmem_breakdown(kernel, d=d, k=kk, block_rows=t, mc=mc_eff,
+                            x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+        return tt is not None and sum(tt.values()) <= budget
+
+    # Largest lane-multiple k-slice that fits (the k-tile preflight):
+    # binary search over multiples of the lane width, bounded by k.
+    max_k_tile = None
+    hi = -(-k // _LANE)                       # k_pad in lanes
+    if fits_at_k(min(k, _LANE)):
+        lo_l, hi_l = 1, hi
+        while lo_l < hi_l:
+            mid = (lo_l + hi_l + 1) // 2
+            if fits_at_k(min(k, mid * _LANE)):
+                lo_l = mid
+            else:
+                hi_l = mid - 1
+        max_k_tile = min(k, lo_l * _LANE)
+
+    ranked = sorted(terms.items(), key=lambda kv: kv[1], reverse=True)
+    top = ", ".join(f"{name} {_mib(b):.1f} MiB" for name, b in ranked[:3])
+    if supported:
+        why = (f"fits: {_mib(total):.1f} of {_mib(budget):.1f} MiB "
+               f"({100.0 * total / budget:.0f}% of budget; largest terms: "
+               f"{top})")
+    else:
+        why = (f"exceeds the {_mib(budget):.1f} MiB budget by "
+               f"{_mib(total - budget):.1f} MiB "
+               f"({_mib(total):.1f} MiB total; dominated by {top})")
+        if max_k_tile is not None and max_k_tile < k:
+            why += (f"; a k-tile of {max_k_tile} centroids would fit — "
+                    "stream centroid slices with a running argmin carry "
+                    "(ROADMAP item 1)")
+    return {
+        **base,
+        "supported": supported,
+        "d_padded": padded_d(d),
+        "k_padded": -(-k // _LANE) * _LANE,
+        "terms": dict(terms),
+        "total_bytes": total,
+        "headroom_bytes": budget - total,
+        "utilization": total / budget if budget else None,
+        "max_k_tile": max_k_tile,
+        "why": why,
+    }

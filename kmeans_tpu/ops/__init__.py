@@ -1,7 +1,8 @@
 """Numeric kernels: assignment, fused Lloyd pass, centroid update."""
 
-from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
-                                     anderson_reset)
+from kmeans_tpu.ops.anderson import (AndersonState, anderson_mix,
+                                     anderson_push, anderson_reset,
+                                     anderson_state, anderson_step)
 from kmeans_tpu.ops.delta import delta_pass
 from kmeans_tpu.ops.distance import assign, pairwise_sq_dists, sq_norms
 from kmeans_tpu.ops.hamerly import hamerly_pass
@@ -9,9 +10,12 @@ from kmeans_tpu.ops.lloyd import lloyd_pass, resolve_update
 from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = [
+    "AndersonState",
     "anderson_mix",
     "anderson_push",
     "anderson_reset",
+    "anderson_state",
+    "anderson_step",
     "assign",
     "pairwise_sq_dists",
     "sq_norms",

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.distance import matmul_precision
 from kmeans_tpu.ops.lloyd import _platform_of, lloyd_pass, weights_exact
 from kmeans_tpu.ops.pallas_lloyd import (accumulate_pallas,
@@ -183,6 +184,7 @@ def _accumulate_xla(x, lab_a, w_a, lab_b, w_b, k, *, chunk_size,
     return sums, counts
 
 
+@observed("ops.delta_pass")
 @functools.partial(
     jax.jit,
     static_argnames=("cap", "chunk_size", "compute_dtype", "backend",

@@ -71,6 +71,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import _platform_of, weights_exact
 from kmeans_tpu.ops.pallas_lloyd import (hamerly_pallas_supported,
@@ -270,6 +271,7 @@ def _scores_chunked(x, centroids, csq, *, chunk_size, compute_dtype):
     return (lab.reshape(-1)[:n], m1.reshape(-1)[:n], m2.reshape(-1)[:n])
 
 
+@observed("ops.hamerly_pass")
 @functools.partial(
     jax.jit,
     static_argnames=("cap", "chunk_size", "compute_dtype", "backend",

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.pallas_lloyd import lloyd_pass_pallas, pallas_supported
 
@@ -232,6 +233,10 @@ def lloyd_pass(
     )
 
 
+# cost=False: this entry point sees high signature churn (every model
+# family, every test shape) and the cost probe's extra trace per new
+# signature would tax it; the runner/bench capture cost explicitly.
+@observed("ops.lloyd_pass_xla")
 @functools.partial(
     jax.jit,
     static_argnames=(

@@ -42,11 +42,13 @@ from jax.experimental.pallas import tpu as pltpu
 CompilerParams = getattr(pltpu, "CompilerParams", None) \
     or pltpu.TPUCompilerParams
 
+from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 
 __all__ = ["lloyd_pass_pallas", "accumulate_pallas", "pallas_supported",
            "lloyd_delta_pallas", "delta_pallas_supported",
-           "lloyd_hamerly_pallas", "hamerly_pallas_supported"]
+           "lloyd_hamerly_pallas", "hamerly_pallas_supported",
+           "vmem_breakdown", "VMEM_KERNEL_DEFAULTS"]
 
 # Fallback VMEM budget when the device can't be queried (non-TPU default
 # backend, e.g. interpret-mode tests on the CPU mesh).  Calibrated
@@ -83,15 +85,68 @@ def _round_up(v: int, m: int) -> int:
     return -(-v // m) * m
 
 
-def _vmem_estimate(block_rows: int, d: int, k_pad: int, x_itemsize: int,
-                   cd_itemsize: int) -> int:
-    c_t = d * k_pad * cd_itemsize                 # resident (d, k) centroids
-    sums = k_pad * d * 4                          # resident f32 accumulator
-    counts = k_pad * 4
-    x_tile = 2 * block_rows * d * x_itemsize      # double-buffered stream
-    prod = block_rows * k_pad * 4                 # (T, k) distance tile
-    onehot = block_rows * k_pad * (4 + cd_itemsize)
-    return c_t + sums + counts + x_tile + prod + onehot
+#: Default (block_rows, mc) per kernel kind — the values the fit loops
+#: actually dispatch with; :func:`vmem_breakdown` and the ``*_supported``
+#: gates share them so the estimate always prices the real tiles.
+VMEM_KERNEL_DEFAULTS = {
+    "classic": (512, None),
+    "delta": (1024, 128),
+    "hamerly": (1024, 256),
+}
+
+
+def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
+                   block_rows: Optional[int] = None,
+                   mc: Optional[int] = None,
+                   x_itemsize: int = 2, cd_itemsize: int = 2):
+    """Named VMEM byte terms of one kernel's resident+streamed operands.
+
+    THE one copy of the footprint arithmetic: the ``*_supported`` gates
+    sum it against :func:`_vmem_budget`, and the compile observatory's
+    :func:`kmeans_tpu.obs.costmodel.vmem_report` renders it as the
+    *why/by-how-much* preflight for k-tiling (ROADMAP item 1) — the two
+    can never disagree because there is nothing else to agree with.
+
+    Returns an ordered ``{term: bytes}`` dict at the PADDED shapes
+    (``padded_d(d)``, ``k`` rounded to the 128 lane), or ``None`` when
+    ``d`` is not lane-alignable within the padding cap (the kernel is
+    unreachable no matter the budget).
+    """
+    if kind not in VMEM_KERNEL_DEFAULTS:
+        raise ValueError(f"unknown kernel kind {kind!r}; "
+                         f"have {sorted(VMEM_KERNEL_DEFAULTS)}")
+    t_def, mc_def = VMEM_KERNEL_DEFAULTS[kind]
+    t = block_rows if block_rows is not None else t_def
+    mc = mc if mc is not None else mc_def
+    d_eff = padded_d(d)
+    if not d_eff:
+        return None
+    k_pad = _round_up(k, _LANE)
+    terms = {
+        "centroids_ct": d_eff * k_pad * cd_itemsize,  # resident (d, k) -2x
+        "sums_acc": k_pad * d_eff * 4,                # resident f32 accum
+        "counts_acc": k_pad * 4,
+        "x_stream": 2 * t * d_eff * x_itemsize,       # double-buffered rows
+        "dist_tile": t * k_pad * 4,                   # (T, k) scores
+        "onehot_tile": t * k_pad * (4 + cd_itemsize),
+    }
+    if kind in ("delta", "hamerly"):
+        terms["tri_prefix"] = t * t * cd_itemsize     # resident (T, T) tri
+        terms["compaction"] = mc * t * (4 + cd_itemsize)   # p_mat + builds
+        terms["x_compact"] = mc * d_eff * 4           # gathered (mc, d)
+        terms["signed_onehot"] = mc * k_pad * (4 + cd_itemsize)
+        terms["dense_fold"] = t * k_pad * (4 + cd_itemsize)
+    if kind == "hamerly":
+        terms["score_tile"] = mc * k_pad * 4          # compacted (mc, k)
+        terms["writeback_pack"] = (mc + t) * _LANE * 4
+    return terms
+
+
+def _fits_budget(kind: str, d: int, k: int, *, block_rows, mc,
+                 x_itemsize: int, cd_itemsize: int) -> bool:
+    terms = vmem_breakdown(kind, d=d, k=k, block_rows=block_rows, mc=mc,
+                           x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+    return terms is not None and sum(terms.values()) <= _vmem_budget()
 
 
 #: Cap on the FLOP inflation the lane-padding of ``d`` may cost: d=300 ->
@@ -133,12 +188,8 @@ def pallas_supported(n: int, d: int, k: int, *, block_rows: int = 512,
     the padding themselves, so every caller (single-device dispatch, the
     TP/FP shard bodies, the sharded-backend gate) shares this one policy.
     """
-    d_eff = padded_d(d)
-    if not d_eff:
-        return False
-    k_pad = _round_up(k, _LANE)
-    est = _vmem_estimate(block_rows, d_eff, k_pad, x_itemsize, cd_itemsize)
-    return est <= _vmem_budget()
+    return _fits_budget("classic", d, k, block_rows=block_rows, mc=None,
+                        x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
 
 
 def delta_pallas_supported(n: int, d: int, k: int, *,
@@ -148,20 +199,12 @@ def delta_pallas_supported(n: int, d: int, k: int, *,
     """VMEM gate for :func:`lloyd_delta_pallas` — the classic estimate
     PLUS the delta kernel's own resident operands: the (T, T) triangular
     prefix matrix, the (mc, ·) compaction intermediates, and the dense
-    per-tile fallback's (T, k_pad) signed one-hot.  The classic gate
-    alone under-counts by ~5 MiB at the default tile, which matters on
+    per-tile fallback's (T, k_pad) signed one-hot (the named terms are
+    :func:`vmem_breakdown`'s ``"delta"`` kind).  The classic gate alone
+    under-counts by ~5 MiB at the default tile, which matters on
     small-VMEM generations and VMEM-marginal shapes."""
-    d_eff = padded_d(d)
-    if not d_eff:
-        return False
-    k_pad = _round_up(k, _LANE)
-    est = _vmem_estimate(block_rows, d_eff, k_pad, x_itemsize, cd_itemsize)
-    est += block_rows * block_rows * cd_itemsize        # resident tri
-    est += mc * block_rows * (4 + cd_itemsize)          # p_mat + builds
-    est += mc * d_eff * 4                               # x_c gather output
-    est += mc * k_pad * (4 + cd_itemsize)               # signed one-hot
-    est += block_rows * k_pad * (4 + cd_itemsize)       # dense-branch fold
-    return est <= _vmem_budget()
+    return _fits_budget("delta", d, k, block_rows=block_rows, mc=mc,
+                        x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
 
 
 def _neg2_ct(centroids, cd):
@@ -285,6 +328,7 @@ def _kernel(x_ref, w_ref, ct_ref, csq_ref,
                        cols, cd=cd)
 
 
+@observed("ops.lloyd_pass_pallas", cost=True)
 @functools.partial(
     jax.jit,
     static_argnames=("block_rows", "compute_dtype", "with_update",
@@ -583,6 +627,7 @@ def _delta_kernel(x_ref, w_ref, prev_ref, ct_ref, csq_ref, tri_ref,
         )
 
 
+@observed("ops.lloyd_delta_pallas", cost=True)
 @functools.partial(
     jax.jit,
     static_argnames=("block_rows", "mc", "compute_dtype", "interpret",
@@ -733,22 +778,11 @@ def hamerly_pallas_supported(n: int, d: int, k: int, *,
     """VMEM gate for :func:`lloyd_hamerly_pallas`: the delta gate's
     operands (its dense branch and compaction machinery are shared) plus
     the pruned path's (mc, k_pad) score tile and the (mc/t, LANE)
-    write-back pack."""
-    if not delta_pallas_supported(n, d, k, block_rows=block_rows, mc=mc,
-                                  x_itemsize=x_itemsize,
-                                  cd_itemsize=cd_itemsize):
-        return False
-    k_pad = _round_up(k, _LANE)
-    extra = mc * k_pad * 4                       # compacted score tile
-    extra += (mc + block_rows) * _LANE * 4       # pack + back
-    d_eff = padded_d(d)
-    est = _vmem_estimate(block_rows, d_eff, k_pad, x_itemsize, cd_itemsize)
-    est += block_rows * block_rows * cd_itemsize
-    est += mc * block_rows * (4 + cd_itemsize)
-    est += mc * d_eff * 4
-    est += mc * k_pad * (4 + cd_itemsize)
-    est += block_rows * k_pad * (4 + cd_itemsize)
-    return est + extra <= _vmem_budget()
+    write-back pack (:func:`vmem_breakdown`'s ``"hamerly"`` kind; the
+    extra terms are nonnegative, so this total subsumes the delta-gate
+    check the previous formulation ran first)."""
+    return _fits_budget("hamerly", d, k, block_rows=block_rows, mc=mc,
+                        x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
 
 
 def _second_min_rows(part, labels):
@@ -905,6 +939,7 @@ def _hamerly_kernel(x_ref, w_ref, prev_ref, need_ref, sbin_ref, slbin_ref,
         )
 
 
+@observed("ops.lloyd_hamerly_pallas", cost=True)
 @functools.partial(
     jax.jit,
     static_argnames=("block_rows", "mc", "compute_dtype", "interpret",
@@ -1059,6 +1094,7 @@ def _acc_kernel(x_ref, w_ref, lab_ref, g_ref,
     mind_ref[:] = jnp.maximum(g + _row_sq(xb), 0.0)[:, None]
 
 
+@observed("ops.accumulate_pallas", cost=True)
 @functools.partial(
     jax.jit,
     static_argnames=("k", "block_rows", "compute_dtype", "interpret"),

@@ -39,6 +39,7 @@ from kmeans_tpu.models.init import init_centroids, resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.obs import (
     REGISTRY as _OBS_REGISTRY,
+    costmodel,
     counter as _obs_counter,
     gauge as _obs_gauge,
     histogram as _obs_histogram,
@@ -1112,7 +1113,7 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
         _, inertia, counts, labels = final(x, c, w)
         return c, labels, inertia, n_iter, converged, counts
 
-    return run
+    return costmodel.observe(run, name="engine.lloyd_run")
 
 
 def _dp_delta_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
@@ -1214,7 +1215,7 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
         _, inertia, counts, labels = final(x, c, w)
         return c, labels, inertia, n_iter, converged, counts
 
-    return run
+    return costmodel.observe(run, name="engine.lloyd_delta_run")
 
 
 def _dp_hamerly_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
@@ -1328,7 +1329,7 @@ def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
         _, inertia, counts, labels = final(x, c, w)
         return c, labels, inertia, n_iter, converged, counts
 
-    return run
+    return costmodel.observe(run, name="engine.lloyd_hamerly_run")
 
 
 @functools.lru_cache(maxsize=32)
@@ -1371,70 +1372,41 @@ def _build_accelerated_run(mesh, data_axis, chunk_size, compute_dtype,
     f32 = jnp.float32
 
     if accel == "anderson":
-        from kmeans_tpu.models.accelerated import (MIX_FLOOR, MIX_STALL,
-                                                   REJECT_SLACK)
-        from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
-                                             anderson_reset)
+        from kmeans_tpu.ops.anderson import (OUTCOME_REJECTED,
+                                             anderson_reset,
+                                             anderson_state, anderson_step)
 
         @jax.jit
         def run_anderson(x, w, c0, tol_v, reg_v):
             kd = c0.shape[0] * c0.shape[1]
 
             def cond(s):
-                return (s[3] < max_it) & ~s[5]
+                return (s[1] < max_it) & ~s[2]
 
             def body(s):
-                # Same accept/reject/fallback arithmetic (incl. the
-                # residual-growth gate and the MIX_FLOOR/MIX_STALL
-                # settle switch) as the single-device _anderson_loop
-                # (models/accelerated.py) — only the pass reduction is
-                # distributed; the history ring and the m×m Gram solve
-                # are replicated.
-                (c, c_safe, f_prev, it, r_prev, _, mix_on, r_best,
-                 stall, xs, rs, hcount, n_acc, n_rej, n_fb) = s
+                # THE shared accept/reject/fallback arithmetic
+                # (ops.anderson.anderson_step — same callsite as the
+                # single-device _anderson_loop and the step-paced
+                # runner); only the pass reduction is distributed, the
+                # history ring and the m×m Gram solve are replicated.
+                c, it, _, st = s
                 tc, f_c, _ = step(x, c, w)
                 shift_sq = jnp.sum((tc - c) ** 2)
-                rejected = f_c > f_prev * (1.0 + REJECT_SLACK)
-                grew = shift_sq > r_prev
-                improved = shift_sq < r_best
-                r_best = jnp.minimum(r_best, shift_sq)
-                stall = jnp.where(improved, 0, stall + 1)
-                mix_on = (mix_on & (shift_sq > MIX_FLOOR * tol_v)
-                          & (stall < MIX_STALL))
-                xs_p, rs_p, cnt_p = anderson_push(
-                    xs, rs, hcount, c.reshape(-1), (tc - c).reshape(-1))
-                mixed, ok = anderson_mix(xs_p, rs_p, cnt_p, reg=reg_v)
-                use_mix = ok & ~grew & mix_on
-                c_acc = jnp.where(use_mix, mixed.reshape(tc.shape), tc)
-                c_next = jnp.where(rejected, c_safe, c_acc)
-                xs_n = jnp.where(rejected, 0.0, xs_p)
-                rs_n = jnp.where(rejected, 0.0, rs_p)
-                cnt_n = jnp.where(rejected, 0, cnt_p)
-                f_next = jnp.where(rejected, f_prev, f_c)
-                c_safe_next = jnp.where(rejected, c_safe, tc)
-                done = (shift_sq <= tol_v) & ~rejected
-                acc = (~rejected) & use_mix
-                return (c_next, c_safe_next, f_next, it + 1, shift_sq,
-                        done, mix_on, r_best, stall, xs_n, rs_n, cnt_n,
-                        n_acc + acc, n_rej + rejected,
-                        n_fb + ((~rejected) & ~use_mix))
+                c_next, st, outcome = anderson_step(
+                    c, tc, f_c, shift_sq, st, tol=tol_v, reg=reg_v)
+                done = (shift_sq <= tol_v) & (outcome != OUTCOME_REJECTED)
+                return (c_next, it + 1, done, st)
 
-            xs0, rs0, cnt0 = anderson_reset(anderson_m, kd)
-            zero_i = jnp.zeros((), jnp.int32)
-            init = (
-                c0.astype(f32), c0.astype(f32), jnp.asarray(jnp.inf, f32),
-                zero_i, jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
-                jnp.ones((), bool), jnp.asarray(jnp.inf, f32), zero_i,
-                xs0, rs0, cnt0, zero_i, zero_i, zero_i,
-            )
-            out = lax.while_loop(cond, body, init)
-            (c, c_safe, _, n_iter, _, converged, _, _, _,
-             _, _, _, n_acc, n_rej, n_fb) = out
-            _, inertia, counts, labels = final(x, c_safe, w)
-            return (c_safe, labels, inertia, n_iter, converged, counts,
-                    n_acc, n_rej, n_fb)
+            xs0, rs0, _ = anderson_reset(anderson_m, kd)
+            init = (c0.astype(f32), jnp.zeros((), jnp.int32),
+                    jnp.zeros((), bool), anderson_state(c0, xs0, rs0))
+            _, n_iter, converged, st = lax.while_loop(cond, body, init)
+            _, inertia, counts, labels = final(x, st.c_safe, w)
+            return (st.c_safe, labels, inertia, n_iter, converged, counts,
+                    st.n_acc, st.n_rej, st.n_fb)
 
-        return run_anderson
+        return costmodel.observe(run_anderson,
+                                 name="engine.accel_anderson_run")
 
     @jax.jit
     def run(x, w, c0, tol_v):
@@ -1472,7 +1444,7 @@ def _build_accelerated_run(mesh, data_axis, chunk_size, compute_dtype,
         _, inertia, counts, labels = final(x, c_safe, w)
         return c_safe, labels, inertia, n_iter, converged, counts
 
-    return run
+    return costmodel.observe(run, name="engine.accel_run")
 
 
 def fit_lloyd_accelerated_sharded(
@@ -1691,7 +1663,7 @@ def _build_fcm_run(mesh, data_axis, chunk_size, compute_dtype, m, max_it):
         _, obj, counts, labels = final(x, c, w)
         return c, labels, obj, n_iter, converged, counts
 
-    return run
+    return costmodel.observe(run, name="engine.fcm_run")
 
 
 def _trim_select_dp(d2m, *, m_loc, m, data_axis):
@@ -1808,7 +1780,7 @@ def _build_trimmed_run(mesh, data_axis, chunk_size, compute_dtype, update,
         inertia, counts, labels, out_mask = final(x, c, w)
         return c, labels, inertia, n_iter, converged, counts, out_mask
 
-    return run
+    return costmodel.observe(run, name="engine.trimmed_run")
 
 
 def fit_trimmed_sharded(
@@ -1987,9 +1959,10 @@ def _build_balanced_run(mesh, data_axis, compute_dtype, sweeps, max_it):
         inertia, counts, labels, col_masses = final(x, c, w, log_a, cap, eps)
         return c, labels, inertia, n_iter, converged, counts, col_masses
 
-    return run
+    return costmodel.observe(run, name="engine.balanced_run")
 
 
+@costmodel.observed("engine.mean_min_sq_dist")
 @functools.partial(jax.jit, static_argnames=("compute_dtype",))
 def _mean_min_sq_dist(x, c0, w, *, compute_dtype):
     """Same epsilon scale rule as models/balanced.py: mean NEAREST-seed
@@ -2162,6 +2135,7 @@ def fit_fuzzy_sharded(
     return FuzzyState(c, labels[:n], obj, n_iter, converged, counts)
 
 
+@costmodel.observed("engine.gmm_init_params")
 @functools.partial(jax.jit, static_argnames=("covariance_type",))
 def _gmm_init_params(x, w, c0, reg_covar, *, covariance_type):
     """Module-level (so the jit cache persists across fits) sharded analog
@@ -2278,7 +2252,7 @@ def _build_gmm_run(mesh, data_axis, chunk_size, compute_dtype,
             ll, n_iter, converged, N,
         )
 
-    return run
+    return costmodel.observe(run, name="engine.gmm_run")
 
 
 def fit_gmm_sharded(
@@ -2376,12 +2350,12 @@ def _build_assign(mesh, data_axis, chunk_size, compute_dtype, backend):
         )
         return labels, mind
 
-    return jax.jit(jax.shard_map(
+    return costmodel.observe(jax.jit(jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(data_axis), P()),
         out_specs=(P(data_axis), P(data_axis)),
         check_vma=False,
-    ))
+    )), name="engine.assign")
 
 
 def sharded_assign(
@@ -2465,7 +2439,7 @@ def _build_minibatch_run(mesh, data_axis, b_loc, steps, compute_dtype,
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(run)
+    return costmodel.observe(jax.jit(run), name="engine.minibatch_run")
 
 
 def fit_minibatch_sharded(

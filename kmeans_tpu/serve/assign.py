@@ -164,7 +164,14 @@ def _build_dense(rows: int, k: int, d: int):
         return jnp.argmin(csq[None, :] - 2.0 * prod,
                           axis=1).astype(jnp.int32)
 
-    return jax.jit(kernel)
+    # Compile-observed (docs/OBSERVABILITY.md "Compile & cost"): if this
+    # builder's lru_cache ever evicts and a bucket recompiles, the
+    # (function, signature) pair re-traces and
+    # kmeans_tpu_retraces_total{function="serve.assign_dense"} fires —
+    # the runtime twin of the shape-cache hit/miss accounting below.
+    from kmeans_tpu.obs import costmodel
+
+    return costmodel.observe(jax.jit(kernel), name="serve.assign_dense")
 
 
 def _score_groups(xs, bounds, prep, s_out, g_lo, g_hi):

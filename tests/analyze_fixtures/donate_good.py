@@ -8,22 +8,25 @@ import jax.numpy as jnp
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
+# analyze: disable=PERF801 -- fixture: observatory registration is perf_good.py's subject
 def accumulate(sums, counts, delta, dcounts):
     return sums + delta, counts + dcounts
 
 
 @functools.partial(jax.jit, static_argnames=("k",), donate_argnums=(0,))
+# analyze: disable=PERF801 -- fixture: observatory registration is perf_good.py's subject
 def scatter_update(c, idx, v, *, k):
     return c.at[idx % k].add(v)
 
 
 @jax.jit
-# analyze: disable=DON301 -- fixture: callers reuse `sums` after the call
+# analyze: disable=DON301,PERF801 -- fixture: callers reuse `sums` after the call; observatory registration is perf_good.py's subject
 def annotated_update(sums, delta):
     return sums + delta
 
 
 @jax.jit
+# analyze: disable=PERF801 -- fixture: observatory registration is perf_good.py's subject
 def pure_producer(x, c):
     # Derived outputs (no argument-shaped passthrough): nothing to donate.
     d2 = jnp.sum((x[:, None] - c[None]) ** 2, -1)

@@ -1,0 +1,22 @@
+"""perf-observatory BAD fixture: hot-path jits invisible to the
+compile observatory (PERF801)."""
+
+import functools
+
+import jax
+
+
+# PERF801: module-level jit with no @observed registration.
+@functools.partial(jax.jit, static_argnames=("k",))
+def unobserved_kernel(x, *, k):
+    return x * k
+
+
+# PERF801: builder returns a bare jax.jit(...) — the compiled program
+# never reaches the observatory.
+@functools.lru_cache(maxsize=8)
+def build_step(n):
+    def step(x):
+        return x + n
+
+    return jax.jit(step)

@@ -1,0 +1,36 @@
+"""perf-observatory GOOD fixture: every jit is registered with the
+compile observatory (docs/OBSERVABILITY.md "Compile & cost")."""
+
+import functools
+
+import jax
+
+from kmeans_tpu.obs import costmodel
+from kmeans_tpu.obs.costmodel import observed
+
+
+# Decorator registration above the jit decoration.
+@observed("fixture.kernel")
+@functools.partial(jax.jit, static_argnames=("k",))
+def observed_kernel(x, *, k):
+    return x * k
+
+
+# Builder idiom: the returned program is observe-wrapped inline.
+@functools.lru_cache(maxsize=8)
+def build_step(n):
+    def step(x):
+        return (x * n).sum()
+
+    return costmodel.observe(jax.jit(step), name="fixture.step")
+
+
+# Assignment-then-wrap idiom (the runner's per-instance programs).
+@functools.lru_cache(maxsize=8)
+def build_named(n):
+    @jax.jit
+    def run(x):
+        return (x - n).sum()
+
+    run = costmodel.observe(run, name="fixture.run")
+    return run

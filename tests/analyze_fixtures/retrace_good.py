@@ -7,6 +7,7 @@ import jax.numpy as jnp
 
 
 @jax.jit
+# analyze: disable=PERF801 -- fixture: observatory registration is perf_good.py's subject
 def assign(x, c):
     return jnp.argmin(jnp.sum((x[:, None] - c[None]) ** 2, -1), 1)
 
@@ -16,14 +17,16 @@ def build_step_cached(chunk):
     def step(x, c):
         return x[:chunk] @ c.T
 
-    return jax.jit(step)
+    return jax.jit(step)  # analyze: disable=PERF801 -- fixture: observatory registration is perf_good.py's subject
 
 
 @functools.partial(jax.jit, static_argnames=("opts",))
+# analyze: disable=PERF801 -- fixture: observatory registration is perf_good.py's subject
 def step_with_hashable_static(x, opts=(1, 2)):
     return x * opts[0]
 
 
 @jax.jit
+# analyze: disable=PERF801 -- fixture: observatory registration is perf_good.py's subject
 def step_takes_scale(x, scale):
     return x * scale

@@ -80,6 +80,7 @@ CASES = [
     ("donation", "donate", {"DON301"}),
     ("lock-discipline", "locks", {"LCK401", "LCK402"}),
     ("tracing-spans", "tracing", {"TRC701", "TRC702"}),
+    ("perf-observatory", "perf", {"PERF801"}),
     ("silent-excepts", "excepts", {"EXC501", "EXC502"}),
 ]
 
@@ -244,6 +245,38 @@ def test_cli_rules_listing(capsys):
     for rule in ("JIT101", "RET201", "DON301", "LCK401", "TRC701",
                  "EXC501", "MET601"):
         assert rule in out
+
+
+def test_perf801_coverage_is_scoped_to_the_enclosing_builder(tmp_path):
+    """Two builders both naming their program `run`: observing one must
+    NOT mask the other — coverage is per enclosing function, else the
+    engine's ~10 same-named builders make the rule vacuous."""
+    # Must live under the rule's SEMANTIC scope (kmeans_tpu/ops/) — the
+    # analyzer deliberately judges nothing outside it, explicit paths
+    # included.
+    mod = tmp_path / "kmeans_tpu" / "ops"
+    mod.mkdir(parents=True)
+    (mod / "mod.py").write_text(
+        "import functools\nimport jax\n"
+        "from kmeans_tpu.obs import costmodel\n\n"
+        "@functools.lru_cache(maxsize=8)\n"
+        "def build_a(n):\n"
+        "    @jax.jit\n"
+        "    def run(x):\n"
+        "        return (x + n).sum()\n"
+        "    return costmodel.observe(run, name='a.run')\n\n"
+        "@functools.lru_cache(maxsize=8)\n"
+        "def build_b(n):\n"
+        "    @jax.jit\n"
+        "    def run(x):\n"
+        "        return (x - n).sum()\n"
+        "    return run\n")
+    report = _run(files=["kmeans_tpu/ops/mod.py"],
+                  analyzers=_one("perf-observatory"), root=str(tmp_path))
+    # Only build_b's unobserved `run` may fire — and it must fire.
+    assert len(report.findings) == 1
+    assert report.findings[0].rule == "PERF801"
+    assert report.findings[0].line == 15  # build_b's def run
 
 
 # --------------------------------------------------------- --changed

@@ -10,8 +10,10 @@ import jax.numpy as jnp
 from kmeans_tpu import fit_lloyd, fit_lloyd_accelerated, fit_minibatch
 from kmeans_tpu.data import make_blobs
 from kmeans_tpu.models.accelerated import ACCEL_STEPS
-from kmeans_tpu.ops.anderson import (anderson_mix, anderson_push,
-                                     anderson_reset)
+from kmeans_tpu.ops.anderson import (OUTCOME_ACCEPTED, OUTCOME_FALLBACK,
+                                     OUTCOME_REJECTED, anderson_mix,
+                                     anderson_push, anderson_reset,
+                                     anderson_state, anderson_step)
 
 import oracles
 
@@ -100,6 +102,49 @@ def test_mix_exact_with_dim_plus_one_history():
     mixed, ok = anderson_mix(xs, rs, cnt, reg=jnp.asarray(1e-10))
     assert bool(ok)
     np.testing.assert_allclose(np.asarray(mixed), xstar, rtol=1e-3)
+
+
+def test_anderson_step_outcomes_and_history_clearing():
+    """THE shared accept/reject/fallback step (the one copy all three
+    production surfaces call): warm-up falls back, a good smooth history
+    accepts the mix, a rising objective rejects — rewinding to c_safe
+    and clearing the ring."""
+    kd = 6
+    c0 = jnp.arange(kd, dtype=jnp.float32).reshape(2, 3)
+    xs0, rs0, _ = anderson_reset(4, kd)
+    st = anderson_state(c0, xs0, rs0)
+    tol = jnp.asarray(1e-12, jnp.float32)   # keep the settle switch off
+    reg = jnp.asarray(1e-8, jnp.float32)
+
+    # Warm-up (one history pair after the push): plain fallback.
+    tc = c0 * 0.9
+    c1, st, out = anderson_step(c0, tc, jnp.asarray(100.0),
+                                jnp.sum((tc - c0) ** 2), st,
+                                tol=tol, reg=reg)
+    assert int(out) == OUTCOME_FALLBACK
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(tc))
+    assert int(st.count) == 1 and int(st.n_fb) == 1
+
+    # A second smooth contraction step: enough history, shrinking
+    # residual, falling objective — the mix is used.
+    tc2 = c1 * 0.9
+    c2, st, out = anderson_step(c1, tc2, jnp.asarray(90.0),
+                                jnp.sum((tc2 - c1) ** 2), st,
+                                tol=tol, reg=reg)
+    assert int(out) == OUTCOME_ACCEPTED
+    assert int(st.n_acc) == 1
+
+    # Objective exploding => rejection: rewind to c_safe (the last
+    # plain output tc2) and clear the history ring.
+    c3, st, out = anderson_step(c2, c2 * 0.9, jnp.asarray(1e6),
+                                jnp.asarray(0.01), st, tol=tol, reg=reg)
+    assert int(out) == OUTCOME_REJECTED
+    np.testing.assert_array_equal(np.asarray(c3), np.asarray(tc2))
+    assert int(st.count) == 0 and float(jnp.abs(st.xs).sum()) == 0.0
+    assert int(st.n_rej) == 1
+    # f_prev survived the rejection (the rewound iterate re-measures
+    # against the last ACCEPTED objective, not the diverged one).
+    assert float(st.f_prev) == 90.0
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,280 @@
+"""Compile/cost observatory (kmeans_tpu/obs/costmodel.py).
+
+Covers the ISSUE 9 acceptance surface:
+
+* compile accounting: first call per (wrapper, signature) counts a
+  compile; a DELIBERATE retrace (a second program instance re-compiling
+  an already-seen (function, signature) pair — the per-call-jit
+  regression) fires ``kmeans_tpu_retraces_total``; a NEW shape on the
+  same wrapper is a compile, not a retrace;
+* tracer invisibility: an observed function inlined into an enclosing
+  jit is not a compile unit;
+* ``cost_report``: real FLOPs/bytes from ``Lowered.cost_analysis`` on
+  the CPU backend, peak memory via ``memory=True``;
+* the VMEM estimator's verdict matches the ``pallas_supported`` /
+  ``delta_pallas_supported`` / ``hamerly_pallas_supported`` gates on
+  ALL FIVE bench configs (the costmodel smoke the tier-1 gate runs);
+* ``/metrics`` exposes compile-time and retrace counters during a live
+  fit, and the runner stamps compile_s/flops into its telemetry.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from kmeans_tpu.obs import REGISTRY, costmodel  # noqa: E402
+from kmeans_tpu.obs.costmodel import (COMPILES_TOTAL,  # noqa: E402
+                                      RETRACES_TOTAL, cost_report, observe,
+                                      observed, vmem_report)
+
+
+def _counts(name):
+    return (COMPILES_TOTAL.value(function=name),
+            RETRACES_TOTAL.value(function=name))
+
+
+def test_compile_and_steady_state_accounting():
+    name = "test.cm_basic"
+    c0, r0 = _counts(name)
+
+    @observed(name)
+    @jax.jit
+    def f(x):
+        return (x * x).sum()
+
+    x = jnp.ones((16, 4))
+    assert float(f(x)) == 64.0
+    f(x)
+    f(x)
+    c1, r1 = _counts(name)
+    assert c1 - c0 == 1          # one signature, one compile
+    assert r1 - r0 == 0
+    rec = f.last_record
+    assert rec is not None and rec["function"] == name
+    assert rec["seconds"] > 0 and rec["retrace"] is False
+
+
+def test_new_shape_is_a_compile_not_a_retrace():
+    name = "test.cm_shapes"
+
+    @observed(name)
+    @jax.jit
+    def f(x):
+        return x.sum()
+
+    f(jnp.ones((8, 2)))
+    c0, r0 = _counts(name)
+    f(jnp.ones((4, 2)))          # deliberate shape-signature change
+    c1, r1 = _counts(name)
+    assert c1 - c0 == 1 and r1 - r0 == 0
+
+
+def test_deliberate_retrace_fires_the_counter():
+    """The per-call-jit regression, provoked on purpose: a SECOND
+    program instance under the same name re-compiles a signature the
+    first already compiled — kmeans_tpu_retraces_total must fire."""
+    name = "test.cm_retrace"
+    x = jnp.ones((8, 3))
+
+    def build():
+        return observe(jax.jit(lambda x: x.sum()), name=name)
+
+    build()(x)
+    c0, r0 = _counts(name)
+    build()(x)                   # fresh jit, same (function, signature)
+    c1, r1 = _counts(name)
+    assert c1 - c0 == 1 and r1 - r0 == 1
+    assert build().last_record is None  # an unused instance records nothing
+
+
+def test_inlined_calls_are_invisible():
+    name = "test.cm_inline"
+
+    @observed(name)
+    @jax.jit
+    def inner(x):
+        return x * 2.0
+
+    @jax.jit
+    def outer(x):
+        return inner(x) + 1.0
+
+    c0, _ = _counts(name)
+    outer(jnp.ones((4,)))        # inner sees tracers only
+    c1, _ = _counts(name)
+    assert c1 == c0
+
+
+def test_disabled_observatory_is_pass_through():
+    name = "test.cm_disabled"
+
+    @observed(name)
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    costmodel.disable()
+    try:
+        f(jnp.ones((3,)))
+        assert _counts(name)[0] == 0
+    finally:
+        costmodel.enable()
+    f(jnp.ones((3,)))
+    assert _counts(name)[0] == 1
+
+
+def test_wrapper_delegates_aot_surface():
+    @observed("test.cm_delegate")
+    @jax.jit
+    def f(x):
+        return x.sum()
+
+    hlo = f.lower(jnp.ones((4,))).compile().as_text()
+    assert "HloModule" in hlo or len(hlo) > 0
+
+
+def test_cost_report_real_flops_and_memory():
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def f(x, *, k):
+        return (x @ x.T) * k
+
+    x = jnp.ones((32, 16))
+    rep = cost_report(f, x, k=2)
+    assert rep["flops"] and rep["flops"] > 2 * 32 * 32 * 16 * 0.5
+    assert rep["bytes_accessed"] and rep["bytes_accessed"] > 0
+    full = cost_report(f, x, k=2, memory=True)
+    assert full["peak_memory_bytes"] and full["peak_memory_bytes"] > 0
+    assert full["memory"]["argument_size_in_bytes"] >= x.size * 4
+
+
+def test_cost_report_never_raises_on_unlowerable():
+    rep = cost_report(object())          # no .lower at all
+    assert rep["flops"] is None and "error" in rep
+
+
+# ------------------------------------------------------------- VMEM
+
+_BF16 = dict(x_itemsize=2, cd_itemsize=2)
+
+
+def _bench_shapes():
+    from kmeans_tpu.data import BENCH_CONFIGS
+
+    return [(name, cfg["n"], cfg["d"], cfg["k"])
+            for name, cfg in BENCH_CONFIGS.items()]
+
+
+@pytest.mark.parametrize("name,n,d,k", _bench_shapes(),
+                         ids=[s[0] for s in _bench_shapes()])
+def test_vmem_estimator_matches_pallas_gates(name, n, d, k):
+    """THE acceptance smoke: the analytic estimator's verdict equals the
+    real dispatch gates on every bench config, for all three kernels."""
+    from kmeans_tpu.ops.pallas_lloyd import (delta_pallas_supported,
+                                             hamerly_pallas_supported,
+                                             pallas_supported)
+
+    assert vmem_report(d, k, kernel="classic", **_BF16)["supported"] == \
+        pallas_supported(n, d, k, **_BF16)
+    assert vmem_report(d, k, kernel="delta", **_BF16)["supported"] == \
+        delta_pallas_supported(n, d, k, **_BF16)
+    assert vmem_report(d, k, kernel="hamerly", **_BF16)["supported"] == \
+        hamerly_pallas_supported(n, d, k, **_BF16)
+
+
+def test_vmem_report_explains_unalignable_d():
+    rep = vmem_report(2, 3, kernel="classic")
+    assert rep["supported"] is False and rep["terms"] is None
+    assert "lane-alignable" in rep["why"]
+
+
+def test_vmem_report_overflow_names_terms_and_k_tile():
+    """A config far over budget must say why, by how much, and what
+    k-tile WOULD fit — and that tile must verify against the gate."""
+    from kmeans_tpu.ops.pallas_lloyd import pallas_supported
+
+    rep = vmem_report(2048, 100_000, kernel="classic", **_BF16)
+    assert rep["supported"] is False
+    assert rep["headroom_bytes"] < 0
+    assert "exceeds" in rep["why"] and "MiB" in rep["why"]
+    kt = rep["max_k_tile"]
+    assert kt and kt % 128 == 0 and kt < 100_000
+    assert pallas_supported(1, 2048, kt, **_BF16)
+    assert not pallas_supported(1, 2048, kt + 128, **_BF16)
+    assert sum(rep["terms"].values()) == rep["total_bytes"]
+
+
+def test_vmem_breakdown_kinds_are_ordered_supersets():
+    from kmeans_tpu.ops.pallas_lloyd import vmem_breakdown
+
+    c = vmem_breakdown("classic", d=2048, k=1000, **_BF16)
+    d_ = vmem_breakdown("delta", d=2048, k=1000, **_BF16)
+    h = vmem_breakdown("hamerly", d=2048, k=1000, **_BF16)
+    assert set(c) < set(d_) < set(h)
+    with pytest.raises(ValueError):
+        vmem_breakdown("nope", d=128, k=8)
+
+
+# ------------------------------------------------- live-fit integration
+
+def test_live_fit_exposes_compile_metrics_and_telemetry(tmp_path):
+    """Acceptance: /metrics (the registry exposition the serve layer
+    renders) shows compile-time counters during a live fit, and the
+    runner's compile+step telemetry event carries compile_s + cost."""
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models.runner import LloydRunner
+    from kmeans_tpu.obs import TelemetryWriter, read_events
+
+    rng = np.random.default_rng(0)
+    x = (rng.normal(size=(600, 8)).astype(np.float32)
+         + np.repeat(rng.normal(size=(3, 8)) * 6, 200, axis=0
+                     ).astype(np.float32))
+    c_before = COMPILES_TOTAL.value(function="runner.step")
+    path = str(tmp_path / "t.jsonl")
+    runner = LloydRunner(x, 3, config=KMeansConfig(k=3))
+    runner.init()
+    with TelemetryWriter(path) as tw:
+        state = runner.run(max_iter=20, telemetry=tw)
+    assert bool(state.converged)
+    assert COMPILES_TOTAL.value(function="runner.step") == c_before + 1
+
+    expo = REGISTRY.expose()
+    assert 'kmeans_tpu_compiles_total{function="runner.step"}' in expo
+    assert 'kmeans_tpu_retraces_total{function="runner.step"}' in expo
+    assert "kmeans_tpu_compile_seconds_bucket" in expo
+
+    events = [e for e in read_events(path) if e.get("event") == "iter"]
+    first = [e for e in events if e.get("phase") == "compile+step"]
+    assert first, "no compile+step event"
+    assert first[0].get("compile_s", 0) > 0
+    assert first[0].get("compile_flops", 0) > 0
+    steady = [e for e in events if e.get("phase") == "step"]
+    assert all("compile_s" not in e for e in steady)
+
+
+def test_second_runner_instance_is_a_visible_retrace():
+    """Two runner instances at identical shapes compile twice — the
+    observatory reports the second as a retrace (the per-instance-jit
+    cost RET202 documents, now a metric)."""
+    from kmeans_tpu.config import KMeansConfig
+    from kmeans_tpu.models.runner import LloydRunner
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    r_before = RETRACES_TOTAL.value(function="runner.step")
+
+    for _ in range(2):
+        r = LloydRunner(x, 2, config=KMeansConfig(k=2))
+        r.init()
+        r.run(max_iter=2)
+    assert RETRACES_TOTAL.value(function="runner.step") >= r_before + 1

@@ -1,0 +1,249 @@
+"""Perf-history regression ledger (tools/perf_history.py).
+
+Tier-1 coverage of the ISSUE 9 acceptance: the committed
+``PERF_HISTORY.json`` passes ``--check`` against the repo's own
+artifacts, and the gate DEMONSTRABLY fails (exit != 0) on an injected
+regression; synthetic multi-round ledgers exercise improvement /
+regression / missing-config / null-round semantics and the append-only
+merge."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools import perf_history as ph  # noqa: E402
+
+
+def _round_artifact(path, n, value, *, conv=None):
+    rec = {
+        "n": n, "rc": 0,
+        "parsed": {
+            "metric": "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000",
+            "value": value, "unit": "iter/s/chip", "vs_baseline": None,
+        },
+    }
+    if conv is not None:
+        rec["parsed"]["wallclock_to_converge_s"] = conv
+    with open(path, "w") as f:
+        json.dump(rec, f)
+
+
+def _all_artifact(path, rows, ts="2026-08-01T00:00Z"):
+    with open(path, "w") as f:
+        json.dump({"timestamp": ts,
+                   "rows": [{"config": c, "n": 1, "d": 1, "k": 1,
+                             "iters_per_s": v, "update": "delta",
+                             "backend": "xla"} for c, v in rows]}, f)
+
+
+# ------------------------------------------------------------ synthetic
+
+def test_improvement_trajectory_passes(tmp_path):
+    root = str(tmp_path)
+    _round_artifact(tmp_path / "BENCH_r01.json", 1, 10.0, conv=2.0)
+    _round_artifact(tmp_path / "BENCH_r02.json", 2, 12.0, conv=1.5)
+    ledger = ph.empty_ledger()
+    assert ph.merge(ledger, ph.collect_entries(root)) == 4
+    assert ph.check(ledger) == []
+    s = ledger["series"]["headline.iters_per_s_per_chip"]
+    assert [e["value"] for e in s["entries"]] == [10.0, 12.0]
+
+
+def test_regression_fails_and_tolerance_is_configurable(tmp_path):
+    root = str(tmp_path)
+    _round_artifact(tmp_path / "BENCH_r01.json", 1, 20.0)
+    _round_artifact(tmp_path / "BENCH_r02.json", 2, 18.0)   # -10%
+    ledger = ph.empty_ledger()
+    ph.merge(ledger, ph.collect_entries(root))
+    failures = ph.check(ledger, tolerance=0.05)
+    assert len(failures) == 1 and "REGRESSION" in failures[0]
+    assert "headline.iters_per_s_per_chip" in failures[0]
+    assert ph.check(ledger, tolerance=0.15) == []
+
+
+def test_lower_is_better_direction(tmp_path):
+    root = str(tmp_path)
+    _round_artifact(tmp_path / "BENCH_r01.json", 1, 10.0, conv=1.0)
+    _round_artifact(tmp_path / "BENCH_r02.json", 2, 10.0, conv=1.5)
+    ledger = ph.empty_ledger()
+    ph.merge(ledger, ph.collect_entries(root))
+    failures = ph.check(ledger, tolerance=0.05)
+    assert any("headline.converge_s" in f and "REGRESSION" in f
+               for f in failures)
+
+
+def test_null_rounds_are_recorded_but_never_judged(tmp_path):
+    root = str(tmp_path)
+    _round_artifact(tmp_path / "BENCH_r01.json", 1, 15.0)
+    _round_artifact(tmp_path / "BENCH_r02.json", 2, None)   # failed round
+    ledger = ph.empty_ledger()
+    ph.merge(ledger, ph.collect_entries(root))
+    s = ledger["series"]["headline.iters_per_s_per_chip"]
+    assert len(s["entries"]) == 2
+    assert ph.check(ledger) == []
+
+
+def test_missing_config_in_latest_artifact_fails(tmp_path):
+    root = str(tmp_path)
+    _all_artifact(tmp_path / "BENCH_ALL_latest.json",
+                  [("glove", 100.0), ("imagenet", 20.0)],
+                  ts="2026-08-01T00:00Z")
+    ledger = ph.empty_ledger()
+    ph.merge(ledger, ph.collect_entries(root))
+    assert ph.check(ledger) == []
+    # The next artifact drops a config: its series must FAIL, not fade.
+    _all_artifact(tmp_path / "BENCH_ALL_latest.json",
+                  [("glove", 101.0)], ts="2026-08-02T00:00Z")
+    ph.merge(ledger, ph.collect_entries(root))
+    failures = ph.check(ledger)
+    assert len(failures) == 1
+    assert "MISSING" in failures[0] and "all.imagenet" in failures[0]
+
+
+def test_merge_is_append_only_and_idempotent(tmp_path):
+    root = str(tmp_path)
+    _round_artifact(tmp_path / "BENCH_r01.json", 1, 10.0)
+    ledger = ph.empty_ledger()
+    assert ph.merge(ledger, ph.collect_entries(root)) == 2
+    assert ph.merge(ledger, ph.collect_entries(root)) == 0
+    # A *_latest overwrite with a NEW timestamp appends, never rewrites.
+    _all_artifact(tmp_path / "BENCH_ALL_latest.json", [("glove", 100.0)],
+                  ts="2026-08-01T00:00Z")
+    ph.merge(ledger, ph.collect_entries(root))
+    _all_artifact(tmp_path / "BENCH_ALL_latest.json", [("glove", 90.0)],
+                  ts="2026-08-02T00:00Z")
+    ph.merge(ledger, ph.collect_entries(root))
+    s = ledger["series"]["all.glove.iters_per_s"]
+    assert [e["value"] for e in s["entries"]] == [100.0, 90.0]
+
+
+def test_main_check_exit_codes_on_injected_regression(tmp_path, capsys):
+    """The CLI contract end to end: a healthy tmp repo checks 0; an
+    injected regression checks 1 (the acceptance's 'demonstrably
+    fails')."""
+    root = str(tmp_path)
+    ledger_path = str(tmp_path / "PERF_HISTORY.json")
+    _round_artifact(tmp_path / "BENCH_r01.json", 1, 21.0)
+    assert ph.main(["--root", root]) == 0              # writes the ledger
+    assert os.path.exists(ledger_path)
+    assert ph.main(["--root", root, "--check"]) == 0
+    _round_artifact(tmp_path / "BENCH_r02.json", 2, 5.0)   # inject
+    assert ph.main(["--root", root, "--check"]) == 1
+    err = capsys.readouterr().err
+    assert "REGRESSION" in err
+    # --check never writes: the committed ledger still lacks round 2.
+    committed = json.load(open(ledger_path))
+    entries = committed["series"]["headline.iters_per_s_per_chip"]["entries"]
+    assert [e["round"] for e in entries] == [1]
+
+
+def test_round_after_latest_record_becomes_latest_and_is_judged(tmp_path):
+    """A numbered-round artifact merged AFTER timestamped entries must
+    become the series' latest (append-only chronology) — a regressed
+    future round cannot hide behind an old *_latest record."""
+    root = str(tmp_path)
+    _round_artifact(tmp_path / "BENCH_r01.json", 1, 20.0)
+    with open(tmp_path / "BENCH_LOCAL_latest.json", "w") as f:
+        json.dump({"metric":
+                   "lloyd_iters_per_sec_per_chip@N=1.28M,d=2048,k=1000",
+                   "value": 21.45, "timestamp": "2026-07-31T18:14Z"}, f)
+    ledger = ph.empty_ledger()
+    ph.merge(ledger, ph.collect_entries(root))
+    assert ph.check(ledger) == []
+    _round_artifact(tmp_path / "BENCH_r06.json", 6, 5.0)   # regressed
+    ph.merge(ledger, ph.collect_entries(root))
+    s = ledger["series"]["headline.iters_per_s_per_chip"]
+    assert s["entries"][-1]["round"] == 6                  # IS the latest
+    assert any("REGRESSION" in f for f in ph.check(ledger))
+
+
+def test_converge_only_artifact_does_not_trip_missing(tmp_path):
+    """A wallclock-only record (bench --converge) is a valid by-design
+    artifact: it must not read as the iters series 'missing'."""
+    root = str(tmp_path)
+    _round_artifact(tmp_path / "BENCH_r01.json", 1, 20.0, conv=2.0)
+    with open(tmp_path / "BENCH_LOCAL_conv.json", "w") as f:
+        json.dump({"metric":
+                   "wallclock_to_converge_s@N=1.28M,d=2048,k=1000",
+                   "value": 1.9, "timestamp": "2026-08-01T00:00Z"}, f)
+    ledger = ph.empty_ledger()
+    ph.merge(ledger, ph.collect_entries(root))
+    assert ph.check(ledger) == []
+
+
+def test_same_minute_rerecord_is_not_swallowed(tmp_path):
+    """A re-record whose timestamp collides with an existing entry but
+    whose value differs is a NEW observation: it must append and be
+    judged (a 5x p99 regression 30 s after a good record must not be
+    dropped as a dedup 'duplicate')."""
+    root = str(tmp_path)
+    ledger = ph.empty_ledger()
+    for ts, p99 in ((1785866610.0, 1.0), (1785866640.0, 5.0)):
+        with open(tmp_path / "BENCH_OPEN_latest.json", "w") as f:
+            json.dump({"bench": "serve_open", "ts": ts,
+                       "p99_ms": p99, "qps": 150.0}, f)
+        ph.merge(ledger, ph.collect_entries(root))
+    s = ledger["series"]["serve.open_p99_ms"]
+    assert [e["value"] for e in s["entries"]] == [1.0, 5.0]
+    assert any("serve.open_p99_ms" in f and "REGRESSION" in f
+               for f in ph.check(ledger))
+
+
+def test_open_loop_artifact_feeds_the_ledger(tmp_path):
+    root = str(tmp_path)
+    with open(tmp_path / "BENCH_OPEN_latest.json", "w") as f:
+        json.dump({"bench": "serve_open", "ts": 1785866629.0,
+                   "p99_ms": 1.2, "qps": 150.0}, f)
+    ledger = ph.empty_ledger()
+    ph.merge(ledger, ph.collect_entries(root))
+    assert ledger["series"]["serve.open_p99_ms"]["entries"][0]["value"] \
+        == 1.2
+    assert ledger["series"]["serve.open_qps"]["direction"] == "up"
+
+
+# ------------------------------------------------------------- the repo
+
+def test_repo_ledger_is_committed_and_checks_clean():
+    """THE tier-1 gate: the committed PERF_HISTORY.json, merged with the
+    repo's current artifacts, has no regression and no missing series —
+    and it actually contains the round trajectory."""
+    ledger_path = os.path.join(_ROOT, ph.LEDGER)
+    assert os.path.exists(ledger_path), \
+        "PERF_HISTORY.json must be committed (python tools/perf_history.py)"
+    ledger = ph.load_ledger(ledger_path)
+    merged = ph.merge(ledger, ph.collect_entries(_ROOT))
+    failures = ph.check(ledger)
+    assert not failures, "\n".join(failures)
+    head = ledger["series"]["headline.iters_per_s_per_chip"]["entries"]
+    assert len([e for e in head if e.get("round") is not None]) >= 3, \
+        "the ledger must carry the committed round trajectory"
+    assert merged == 0, (
+        f"{merged} artifact entries are missing from the committed "
+        "ledger — run `python tools/perf_history.py` and commit")
+
+
+def test_repo_main_check_passes(capsys):
+    assert ph.main(["--root", _ROOT, "--check"]) == 0
+    capsys.readouterr()
+
+
+def test_render_history_table():
+    import importlib
+
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    try:
+        bench_table = importlib.import_module("bench_table")
+    finally:
+        sys.path.pop(0)
+    out = bench_table.render_history()
+    assert "headline.iters_per_s_per_chip" in out
+    assert "| Round / record |" in out
+    assert "| r1 |" in out

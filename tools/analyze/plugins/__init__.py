@@ -14,6 +14,8 @@ def all_analyzers() -> List[Analyzer]:
     from tools.analyze.plugins.jit_hygiene import JitHygieneAnalyzer
     from tools.analyze.plugins.locks import LockDisciplineAnalyzer
     from tools.analyze.plugins.metrics_catalog import MetricsCatalogAnalyzer
+    from tools.analyze.plugins.perf_observatory import \
+        PerfObservatoryAnalyzer
     from tools.analyze.plugins.retrace import RetraceAnalyzer
     from tools.analyze.plugins.tracing_spans import TracingSpansAnalyzer
 
@@ -23,6 +25,7 @@ def all_analyzers() -> List[Analyzer]:
         DonationAnalyzer(),
         LockDisciplineAnalyzer(),
         TracingSpansAnalyzer(),
+        PerfObservatoryAnalyzer(),
         ExceptsAnalyzer(),
         MetricsCatalogAnalyzer(),
     ]

@@ -35,6 +35,7 @@ RULES = [
 #: catalog check.
 MODULES = [
     "kmeans_tpu.obs",
+    "kmeans_tpu.obs.costmodel",
     "kmeans_tpu.utils.retry",
     "kmeans_tpu.utils.checkpoint",
     "kmeans_tpu.data.stream",

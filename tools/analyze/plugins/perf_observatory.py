@@ -1,0 +1,186 @@
+"""perf-observatory: hot jitted entry points must be compile-observed.
+
+The compile observatory (``kmeans_tpu/obs/costmodel.py``,
+docs/OBSERVABILITY.md "Compile & cost") only sees what is registered
+with it: an unobserved jit is invisible to the retrace counter, the
+compile-seconds histogram, and the cost gauges — exactly the blind spot
+that let per-call-jit regressions live as an AST-lint-only concern.
+This rule closes the loop: within the HOT-PATH scope (the ops kernels,
+the fused model loops, the runner, the sharded engine, the serve assign
+kernels), every jit usage must be covered by the observatory:
+
+* a jit-decorated ``def`` carries an ``@observed("name")`` decorator
+  above the jit decoration, OR its name is later passed through
+  ``costmodel.observe(fn, name=...)`` (the builder idiom:
+  ``return costmodel.observe(run, name="engine...")``);
+* a bare ``jax.jit(...)`` call is wrapped directly
+  (``observe(jax.jit(f), name=...)``) or its assignment target is
+  observe()'d.
+
+Out-of-scope modules (cold-path model families, tests, bench) are not
+judged — observation costs a per-call signature hash, which is priced
+for the hot paths and pointless for one-shot cold fits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.analyze.astutil import ModuleNames, dotted, jit_decoration
+from tools.analyze.core import Analyzer, Finding, Rule
+
+RULES = [
+    Rule("PERF801", "error",
+         "jitted call site not registered with the compile observatory",
+         "An unobserved hot-path jit is invisible to the retrace/"
+         "compile-time metrics (kmeans_tpu_retraces_total, "
+         "kmeans_tpu_compile_seconds): wrap it with "
+         "kmeans_tpu.obs.costmodel.observe(fn, name=...) or decorate "
+         "with @observed(name) above the jit decoration "
+         "(docs/OBSERVABILITY.md \"Compile & cost\")."),
+]
+
+#: The hot-path scope this rule polices (prefix-matched relpaths): the
+#: jitted entry points the observatory instruments by contract.
+SCOPE = (
+    "kmeans_tpu/ops/",
+    "kmeans_tpu/serve/",
+    "kmeans_tpu/models/lloyd.py",
+    "kmeans_tpu/models/accelerated.py",
+    "kmeans_tpu/models/runner.py",
+    "kmeans_tpu/parallel/engine.py",
+)
+
+
+def _is_observe_name(expr: ast.AST, leaf: str) -> bool:
+    d = dotted(expr)
+    return d is not None and (d == leaf or d.endswith("." + leaf))
+
+
+def _has_observed_decorator(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call) and _is_observe_name(dec.func,
+                                                          "observed"):
+            return True
+        if _is_observe_name(dec, "observed"):
+            return True
+    return False
+
+
+#: Out-of-scope paths explicit runs may still judge: the rule's own
+#: test fixtures (they exist to be scanned on purpose).
+_FIXTURE_PREFIX = "tests/analyze_fixtures"
+
+
+class PerfObservatoryAnalyzer(Analyzer):
+    name = "perf-observatory"
+    rules = RULES
+    scope = SCOPE
+
+    def check_source(self, src) -> List[Finding]:
+        # Unlike the other analyzers' scopes (noise/speed cuts that an
+        # explicit file list deliberately overrides), this rule's scope
+        # is SEMANTIC: cold-path modules are not "noisy here", they are
+        # genuinely not judged — observation costs a per-call signature
+        # hash that is priced for hot paths only.  So an explicit
+        # `python -m tools.analyze kmeans_tpu` must not suddenly demand
+        # registration from every cold model family; only in-scope
+        # files (and the rule's own fixtures) are ever judged.
+        rel = src.rel
+        if not any(rel == p or rel.startswith(p) for p in SCOPE) \
+                and not rel.startswith(_FIXTURE_PREFIX):
+            return []
+        tree = src.tree
+        names = ModuleNames(tree)
+        rule = RULES[0]
+        out: List[Finding] = []
+
+        # Parent links (decorator detection + observe-wrap detection).
+        parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def enclosing_fn(node) -> Optional[ast.AST]:
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, ast.FunctionDef):
+                    return cur
+                cur = parents.get(cur)
+            return None
+
+        # Names covered by an observe(...) call, scoped to the ENCLOSING
+        # function: `costmodel.observe(run, name=...)` inside builder A
+        # covers A's `run` only — every engine builder names its program
+        # `run`, and a module-wide name match would let one observed
+        # builder mask every unobserved sibling.  Inline-wrapped jit
+        # calls (`observe(jax.jit(f), ...)`) are collected by node so
+        # the bare-call check below skips them.
+        covered_names: Set[tuple] = set()       # (id(enclosing)|None, name)
+        wrapped_calls: Set[int] = set()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_observe_name(node.func, "observe")):
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                enc = enclosing_fn(node)
+                covered_names.add((id(enc) if enc else None, target.id))
+            elif isinstance(target, ast.Call):
+                wrapped_calls.add(id(target))
+
+        def name_covered(name: str, node) -> bool:
+            enc = enclosing_fn(node)
+            return (id(enc) if enc else None, name) in covered_names
+
+        # Assignment targets whose value is a jit call and whose NAME is
+        # observe()'d later (step = jax.jit(f); step = observe(step,...))
+        # are covered via covered_names.
+        def assign_target_name(call: ast.Call) -> Optional[str]:
+            parent = parents.get(call)
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        return t.id
+            return None
+
+        decorator_nodes = set()
+        for fn in (n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)):
+            for dec in fn.decorator_list:
+                for sub in ast.walk(dec):
+                    decorator_nodes.add(id(sub))
+
+        # 1) jit-decorated functions.
+        for fn in (n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)):
+            if jit_decoration(fn, names) is None:
+                continue
+            if _has_observed_decorator(fn) or name_covered(fn.name, fn):
+                continue
+            out.append(Finding(
+                rule.id, rule.severity, src.rel, fn.lineno,
+                f"jitted `{fn.name}` is not registered with the compile "
+                "observatory — add @observed(\"<name>\") above the jit "
+                "decoration, or wrap it with costmodel.observe(...) "
+                "where it is returned/stored"))
+
+        # 2) bare jax.jit(...) calls (builder returns, inline wraps).
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and names.is_jit_expr(node.func)):
+                continue
+            if id(node) in decorator_nodes or id(node) in wrapped_calls:
+                continue
+            tname = assign_target_name(node)
+            if tname is not None and name_covered(tname, node):
+                continue
+            out.append(Finding(
+                rule.id, rule.severity, src.rel, node.lineno,
+                "`jax.jit(...)` result is not registered with the "
+                "compile observatory — wrap it: "
+                "costmodel.observe(jax.jit(...), name=\"<name>\")"))
+        return out

@@ -443,8 +443,24 @@ def run_smoke(args) -> int:
         slo_ok = p99 is not None and p99 <= SMOKE_OPEN_P99_MS
         ok = ok and slo_ok
         rec.update({"smoke_ok": ok, "p99_ms": p99, "late": out["late"],
+                    "p50_ms": out.get("p50_ms"),
                     "slo_p99_ms": SMOKE_OPEN_P99_MS, "slo_ok": slo_ok,
                     "offered_qps": SMOKE_OPEN_RATE})
+    if args.record and ok and open_loop:
+        # Perf-history feed (ROADMAP 2c): the open-loop p99 joins the
+        # tracked trajectory — tools/perf_history.py ingests this
+        # artifact into the serve.open_* series.  Only successful runs
+        # record (a CI-noise SLO miss must not poison the ledger), and
+        # only on request (tier-1 runs the smoke WITHOUT --record so
+        # tests never dirty the tree).
+        path = (args.record if isinstance(args.record, str)
+                else os.path.join(_REPO, "BENCH_OPEN_latest.json"))
+        artifact = {"bench": "serve_open", "ts": round(time.time(), 3),
+                    **rec}
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+        print(f"[loadgen] recorded {path}", file=sys.stderr)
     print(json.dumps(rec))
     return 0 if ok else 1
 
@@ -481,8 +497,18 @@ def main(argv=None) -> int:
                         "BENCH_SERVE_latest.json")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1-sized acceptance run")
+    p.add_argument("--record", nargs="?", const=True, default=None,
+                   metavar="PATH",
+                   help="with --smoke --mode open: on success, write the "
+                        "open-loop SLO artifact (default "
+                        "BENCH_OPEN_latest.json) for the perf-history "
+                        "ledger (tools/perf_history.py)")
     args = p.parse_args(argv)
 
+    if args.record and not (args.smoke and args.mode == "open"):
+        print("--record records the open-loop SLO smoke; use it with "
+              "--smoke --mode open", file=sys.stderr)
+        return 2
     if args.smoke:
         return run_smoke(args)
     if args.bench:

@@ -1,0 +1,474 @@
+#!/usr/bin/env python
+"""Perf-history ledger: every committed BENCH artifact, one trajectory.
+
+The round-by-round ``BENCH_*.json`` artifacts were write-only — nothing
+detected a perf regression or rendered the trajectory.  This tool makes
+them a LEDGER:
+
+* ``python tools/perf_history.py`` ingests every ``BENCH_*`` artifact in
+  the repo, MERGES the new entries into the committed
+  ``PERF_HISTORY.json`` (append-only: existing entries are never
+  rewritten, dedup is by (series, source, round/timestamp)), and writes
+  it back;
+* ``python tools/perf_history.py --check [--tolerance 0.05]`` exits
+  non-zero when any tracked series' LATEST value regresses beyond the
+  tolerance vs the series' best-known value, or when a series tracked
+  by a multi-series artifact (a bench config, a serve metric) is
+  missing from that artifact's newest ingest — so the 21.45 iter/s/chip
+  headline (and the serve p99, the soak RTO, …) can never silently
+  backslide.  Runs in tier-1 (tests/test_perf_history.py);
+* ``python tools/bench_table.py --history`` renders the trajectory.
+
+Tracked series (direction ``up`` = higher is better):
+
+* ``headline.iters_per_s_per_chip`` / ``headline.converge_s`` — the
+  driver metric's two halves, per round (``BENCH_r*.json``) and per
+  on-chip builder record (``BENCH_LOCAL_*.json``);
+* ``all.<config>.iters_per_s`` (+ ``.converge_s`` when recorded) — the
+  5-config table (``BENCH_ALL_latest.json``);
+* ``serve.batched_qps`` / ``serve.batched_p99_ms`` / ``serve.speedup``
+  — the serving evidence protocol (``BENCH_SERVE_latest.json``);
+* ``serve.open_p99_ms`` / ``serve.open_qps`` — the open-loop loadgen
+  SLO smoke (``BENCH_OPEN_latest.json``, written by
+  ``tools/loadgen.py --smoke --mode open --record``; ROADMAP 2c);
+* ``soak.rto_s_max`` — the worst kill/resume recovery time
+  (``BENCH_SOAK_latest.json``);
+* ``accel.<config>.nested_seconds_reduction`` — the nested schedule's
+  wall-clock claim (``BENCH_ACCEL_latest.json`` medians);
+* ``input.fit_s`` / ``input.iters_per_s`` — the real-data fit
+  (``BENCH_INPUT_latest.json``).
+
+Entries carry provenance (source file, round or artifact timestamp,
+``carried`` for carry-forward values) and ``null``-valued rounds (failed
+measurements) are recorded but never judged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LEDGER = "PERF_HISTORY.json"
+
+#: Default regression tolerance vs best-known (relative).
+DEFAULT_TOLERANCE = 0.05
+
+
+def _now() -> str:
+    return datetime.datetime.now(
+        datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ")
+
+
+def _epoch_iso(ts: float) -> str:
+    # Full second resolution: these timestamps are dedup-key material,
+    # and a minute-resolution string would silently swallow a re-record
+    # landing within the same minute as an existing entry.
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class Entry(dict):
+    """One observation: series metadata + one (round/ts, value) point."""
+
+    def __init__(self, series: str, value, *, unit: str, direction: str,
+                 group: str, source: str, round: Optional[int] = None,
+                 ts: Optional[str] = None, **extra):
+        super().__init__(series=series, value=value, unit=unit,
+                         direction=direction, group=group, source=source,
+                         round=round, ts=ts, **extra)
+
+
+# ------------------------------------------------------------ ingestion
+
+def _headline_entries(rec: dict, *, source: str, round: Optional[int],
+                      ts: Optional[str]) -> List[Entry]:
+    """The two driver-metric halves out of one bench record (a BENCH_r*
+    ``parsed`` object or a BENCH_LOCAL_* record)."""
+    out: List[Entry] = []
+    metric = rec.get("metric", "")
+    carried = bool(rec.get("carried_forward"))
+    common = dict(group="headline", source=source, round=round, ts=ts)
+    if carried:
+        common["carried"] = True
+    if metric.startswith("lloyd_iters_per_sec_per_chip@"):
+        out.append(Entry("headline.iters_per_s_per_chip", rec.get("value"),
+                         unit="iter/s/chip", direction="up", **common))
+        out.append(Entry("headline.converge_s",
+                         rec.get("wallclock_to_converge_s"),
+                         unit="s", direction="down", **common))
+    elif metric.startswith("wallclock_to_converge_s@"):
+        out.append(Entry("headline.converge_s", rec.get("value"),
+                         unit="s", direction="down", **common))
+        # Paired null entry: a converge-only run is a VALID artifact
+        # (bench --converge), not the iters series dropping out — the
+        # null keeps the two headline series aligned so the MISSING
+        # check never fires on it (nulls are recorded, never judged).
+        out.append(Entry("headline.iters_per_s_per_chip", None,
+                         unit="iter/s/chip", direction="up", **common))
+    return out
+
+
+def _ingest_rounds(root: str) -> List[Entry]:
+    out: List[Entry] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r[0-9]*.json"))):
+        rec = _load_json(path)
+        if rec is None:
+            continue
+        parsed = rec.get("parsed")
+        rnd = rec.get("n")
+        if not isinstance(parsed, dict) or rnd is None:
+            continue
+        out.extend(_headline_entries(parsed, source=os.path.basename(path),
+                                     round=int(rnd), ts=None))
+    return out
+
+
+def _ingest_local(root: str) -> List[Entry]:
+    out: List[Entry] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_LOCAL_*.json"))):
+        rec = _load_json(path)
+        if rec is None:
+            continue
+        out.extend(_headline_entries(rec, source=os.path.basename(path),
+                                     round=None, ts=rec.get("timestamp")))
+    return out
+
+
+def _ingest_all(root: str) -> List[Entry]:
+    rec = _load_json(os.path.join(root, "BENCH_ALL_latest.json"))
+    if rec is None:
+        return []
+    ts = rec.get("timestamp")
+    out: List[Entry] = []
+    for row in rec.get("rows", []):
+        cfg = row.get("config", "?")
+        common = dict(group="all", source="BENCH_ALL_latest.json",
+                      round=None, ts=ts)
+        out.append(Entry(f"all.{cfg}.iters_per_s", row.get("iters_per_s"),
+                         unit="iter/s", direction="up", **common))
+        if "seconds_to_converge" in row:
+            out.append(Entry(f"all.{cfg}.converge_s",
+                             row.get("seconds_to_converge"),
+                             unit="s", direction="down", **common))
+    return out
+
+
+def _ingest_serve(root: str) -> List[Entry]:
+    rec = _load_json(os.path.join(root, "BENCH_SERVE_latest.json"))
+    if rec is None:
+        return []
+    ts = _epoch_iso(rec["ts"]) if isinstance(rec.get("ts"), (int, float)) \
+        else rec.get("ts")
+    common = dict(group="serve", source="BENCH_SERVE_latest.json",
+                  round=None, ts=ts)
+    batched = rec.get("batched", {})
+    return [
+        Entry("serve.batched_qps", batched.get("qps"),
+              unit="req/s", direction="up", **common),
+        Entry("serve.batched_p99_ms", batched.get("p99_ms"),
+              unit="ms", direction="down", **common),
+        Entry("serve.speedup", rec.get("speedup"),
+              unit="x", direction="up", **common),
+    ]
+
+
+def _ingest_open(root: str) -> List[Entry]:
+    rec = _load_json(os.path.join(root, "BENCH_OPEN_latest.json"))
+    if rec is None:
+        return []
+    ts = _epoch_iso(rec["ts"]) if isinstance(rec.get("ts"), (int, float)) \
+        else rec.get("ts")
+    common = dict(group="serve_open", source="BENCH_OPEN_latest.json",
+                  round=None, ts=ts)
+    return [
+        Entry("serve.open_p99_ms", rec.get("p99_ms"),
+              unit="ms", direction="down", **common),
+        Entry("serve.open_qps", rec.get("qps"),
+              unit="req/s", direction="up", **common),
+    ]
+
+
+def _ingest_soak(root: str) -> List[Entry]:
+    rec = _load_json(os.path.join(root, "BENCH_SOAK_latest.json"))
+    if rec is None:
+        return []
+    ts = _epoch_iso(rec["ts"]) if isinstance(rec.get("ts"), (int, float)) \
+        else rec.get("ts")
+    rtos = [v for v in (rec.get("rto_s") or {}).values()
+            if isinstance(v, (int, float))]
+    return [Entry("soak.rto_s_max", max(rtos) if rtos else None,
+                  unit="s", direction="down", group="soak",
+                  source="BENCH_SOAK_latest.json", round=None, ts=ts)]
+
+
+def _ingest_accel(root: str) -> List[Entry]:
+    rec = _load_json(os.path.join(root, "BENCH_ACCEL_latest.json"))
+    if rec is None:
+        return []
+    ts = rec.get("timestamp")
+    out: List[Entry] = []
+    for cfg, med in (rec.get("medians") or {}).items():
+        out.append(Entry(f"accel.{cfg}.nested_seconds_reduction",
+                         med.get("nested_seconds_reduction"),
+                         unit="x", direction="up", group="accel",
+                         source="BENCH_ACCEL_latest.json", round=None,
+                         ts=ts))
+    return out
+
+
+def _ingest_input(root: str) -> List[Entry]:
+    rec = _load_json(os.path.join(root, "BENCH_INPUT_latest.json"))
+    if rec is None:
+        return []
+    ts = rec.get("timestamp")
+    common = dict(group="input", source="BENCH_INPUT_latest.json",
+                  round=None, ts=ts)
+    return [
+        Entry("input.fit_s", rec.get("value"), unit="s",
+              direction="down", **common),
+        Entry("input.iters_per_s", rec.get("lloyd_iters_per_sec"),
+              unit="iter/s", direction="up", **common),
+    ]
+
+
+def collect_entries(root: str) -> List[Entry]:
+    """Every observation the artifacts in ``root`` currently support."""
+    out: List[Entry] = []
+    for fn in (_ingest_rounds, _ingest_local, _ingest_all, _ingest_serve,
+               _ingest_open, _ingest_soak, _ingest_accel, _ingest_input):
+        out.extend(fn(root))
+    return out
+
+
+# --------------------------------------------------------------- ledger
+
+def _entry_key(series: str, e: dict):
+    # The VALUE is part of the identity: a re-record from the same
+    # source whose timestamp collides (minute-resolution artifact
+    # strings, same-second re-runs) but whose measurement differs is a
+    # NEW observation that must append and be judged, not be dropped as
+    # a duplicate.
+    return (series, e.get("source"), e.get("round"), e.get("ts"),
+            e.get("value"))
+
+
+def _order_key(e: dict):
+    """Within ONE ingest batch: numbered rounds first (the driver's
+    historical round artifacts predate the *_latest records), then by
+    timestamp.  Across batches the ledger is append-only — a later
+    ingest IS later in time, so merged batches append after existing
+    entries and are never re-sorted into the past (a future BENCH_r06
+    must become the series' latest, not sort behind old ts entries)."""
+    rnd = e.get("round")
+    return (0, rnd, "") if rnd is not None else (1, 0, e.get("ts") or "")
+
+
+def empty_ledger() -> dict:
+    return {"version": 1, "updated": _now(), "series": {}}
+
+
+def merge(ledger: dict, entries: List[Entry]) -> int:
+    """Append the NEW observations into ``ledger`` (in place); returns
+    how many were new.  Existing entries are never modified — the ledger
+    is the append-only trajectory the *_latest artifacts overwrite."""
+    series = ledger.setdefault("series", {})
+    fresh: Dict[str, List[dict]] = {}
+    for e in entries:
+        name = e["series"]
+        s = series.setdefault(name, {
+            "unit": e["unit"], "direction": e["direction"],
+            "group": e["group"], "entries": [],
+        })
+        keys = {_entry_key(name, x) for x in s["entries"]}
+        keys.update(_entry_key(name, x) for x in fresh.get(name, ()))
+        point = {k: v for k, v in e.items()
+                 if k not in ("series", "unit", "direction", "group")}
+        if _entry_key(name, point) in keys:
+            continue
+        fresh.setdefault(name, []).append(point)
+    added = 0
+    for name, batch in fresh.items():
+        # Sort the NEW batch internally, then APPEND: existing entries
+        # keep their positions (append-only), so the newest ingest is
+        # the series' latest no matter how its round/ts key compares to
+        # history.
+        batch.sort(key=_order_key)
+        series[name]["entries"].extend(batch)
+        added += len(batch)
+    ledger["updated"] = _now()
+    return added
+
+
+def load_ledger(path: str) -> Optional[dict]:
+    return _load_json(path)
+
+
+def write_ledger(path: str, ledger: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ledger, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------- check
+
+def _is_worse(last: float, best: float, direction: str,
+              tolerance: float) -> bool:
+    if direction == "up":
+        return last < best * (1.0 - tolerance)
+    return last > best * (1.0 + tolerance)
+
+
+def series_stats(s: dict):
+    """``(measured_entries, latest_value, best_value)`` of one ledger
+    series — THE one aggregation :func:`check`, the CLI summary, and
+    ``tools/bench_table.py --history`` all share (if the judging ever
+    changes, the gate and every rendering change together)."""
+    vals = [e for e in s["entries"] if e.get("value") is not None]
+    if not vals:
+        return vals, None, None
+    values = [float(e["value"]) for e in vals]
+    best = max(values) if s["direction"] == "up" else min(values)
+    return vals, vals[-1]["value"], best
+
+
+def check(ledger: dict, *, tolerance: float = DEFAULT_TOLERANCE
+          ) -> List[str]:
+    """Regression/missing failures of the ledger's current state.
+
+    * **regression** — a series' newest non-null value is worse than its
+      best-known value beyond ``tolerance`` (relative);
+    * **missing** — a series fed by a multi-series group (the 5-config
+      table, the serve protocol) has no entry at the group's newest
+      round/timestamp: a config silently dropped from the latest
+      artifact must fail, not fade out of the trajectory.
+    """
+    failures: List[str] = []
+    series = ledger.get("series", {})
+    newest_by_group: Dict[str, Any] = {}
+    series_newest: Dict[str, Any] = {}
+    for name, s in series.items():
+        if not s["entries"]:
+            continue
+        # The ledger is append-only: a series' newest observation is its
+        # LAST entry (null-valued entries included — they mark "this
+        # artifact was ingested", which is exactly what missing-ness is
+        # judged against).
+        newest = _order_key(s["entries"][-1])
+        series_newest[name] = newest
+        g = s.get("group", "?")
+        if g not in newest_by_group or newest > newest_by_group[g]:
+            newest_by_group[g] = newest
+    for name in sorted(series):
+        s = series[name]
+        vals, _, best = series_stats(s)
+        if not vals:
+            continue
+        last = vals[-1]
+        if _is_worse(float(last["value"]), best, s["direction"], tolerance):
+            failures.append(
+                f"REGRESSION {name}: latest {last['value']} {s['unit']} "
+                f"({last.get('source')}) is worse than best-known {best} "
+                f"beyond the {tolerance:.0%} tolerance")
+        g = s.get("group", "?")
+        if series_newest[name] < newest_by_group[g]:
+            failures.append(
+                f"MISSING {name}: no entry at the newest {g!r} artifact "
+                f"ingest — the series dropped out of the latest "
+                f"measurement (last seen {last.get('ts') or last.get('round')})")
+    return failures
+
+
+# ----------------------------------------------------------------- main
+
+def summary_lines(ledger: dict) -> List[str]:
+    out = []
+    for name in sorted(ledger.get("series", {})):
+        s = ledger["series"][name]
+        vals, latest, best = series_stats(s)
+        if not vals:
+            out.append(f"{name}: no measured values "
+                       f"({len(s['entries'])} null entries)")
+            continue
+        arrow = "↑" if s["direction"] == "up" else "↓"
+        out.append(
+            f"{name} [{arrow}{s['unit']}]: latest {latest} | "
+            f"best {best} | {len(vals)} measured / "
+            f"{len(s['entries'])} entries")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="BENCH artifact ledger: build/merge PERF_HISTORY.json "
+                    "and gate on regressions")
+    ap.add_argument("--root", default=_REPO,
+                    help="artifact directory (default: repo root)")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path (default: <root>/PERF_HISTORY.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="regression gate: exit 1 on any series whose "
+                         "latest value is worse than best-known beyond "
+                         "the tolerance, or missing from the newest "
+                         "artifact of its group; never writes")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help=f"relative regression tolerance "
+                         f"(default {DEFAULT_TOLERANCE})")
+    ap.add_argument("--print", dest="print_", action="store_true",
+                    help="print the per-series summary and exit (no write)")
+    args = ap.parse_args(argv)
+
+    ledger_path = args.ledger or os.path.join(args.root, LEDGER)
+    ledger = load_ledger(ledger_path) or empty_ledger()
+    added = merge(ledger, collect_entries(args.root))
+
+    if args.check:
+        failures = check(ledger, tolerance=args.tolerance)
+        for f in failures:
+            print(f, file=sys.stderr)
+        if added:
+            print(f"note: {added} artifact entr{'y' if added == 1 else 'ies'}"
+                  f" not yet in {os.path.basename(ledger_path)} — run "
+                  "`python tools/perf_history.py` to record them",
+                  file=sys.stderr)
+        if failures:
+            print(f"perf-history check FAILED ({len(failures)} finding(s))",
+                  file=sys.stderr)
+            return 1
+        n = len(ledger.get("series", {}))
+        print(f"perf-history check OK ({n} series, "
+              f"tolerance {args.tolerance:.0%})")
+        return 0
+
+    if args.print_:
+        for line in summary_lines(ledger):
+            print(line)
+        return 0
+
+    write_ledger(ledger_path, ledger)
+    print(f"{os.path.basename(ledger_path)}: +{added} entries, "
+          f"{len(ledger['series'])} series")
+    for line in summary_lines(ledger):
+        print("  " + line)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

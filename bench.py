@@ -18,7 +18,8 @@ iter/s/chip; ``vs_baseline`` is measured-rate / 1.25, so 1.0 means exactly on
 target and higher is better.  For the converge half the budget is the
 north-star 10 s scaled by 8/n_chips.
 
-Run `python bench.py --all` for the full 5-config table (human-readable,
+Run `python bench.py --all` for the full per-config table — every
+BENCH_CONFIGS shape, extreme-k ``codebook`` included (human-readable,
 extra lines go to stderr); ``--converge`` / ``--iters-only`` restrict to one
 half of the metric.
 """
@@ -197,13 +198,13 @@ def _record_input_local(out):
 
 
 def _record_all_local(rows):
-    """Persist the 5-config ``--all`` measurements (table source of truth)."""
+    """Persist the per-config ``--all`` measurements (table source of truth)."""
     rec = {
         "timestamp": datetime.datetime.now(
             datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ"),
         "rows": rows,
         "note": ("auto-recorded by bench.py --all on a successful TPU run; "
-                 "README's 5-config table is generated from this file by "
+                 "README's per-config table is generated from this file by "
                  "tools/bench_table.py and pinned by "
                  "tests/test_bench_evidence.py"),
     }
@@ -1221,7 +1222,9 @@ def bench_input_file(path, k, *, iters=10, chunk_size=None, verbose=True,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--all", action="store_true", help="run all 5 configs")
+    ap.add_argument("--all", action="store_true",
+                    help="run every BENCH_CONFIGS shape (the BASELINE "
+                         "five + the extreme-k codebook stress config)")
     ap.add_argument("--input", default=None, metavar="PATH.npy",
                     help="cluster a real (n, d) feature matrix instead of "
                          "synthetic shapes; requires --k")
@@ -1500,7 +1503,7 @@ def _run_benches(args, metric, unit, fresh=None):
                         _free_device_buffers()
             all_rows.append(row)
         if dev.platform == "tpu" and len(all_rows) == len(BENCH_CONFIGS):
-            # The 5-config table artifact: README's table is GENERATED
+            # The per-config table artifact: README's table is GENERATED
             # from this file (tools/bench_table.py) and a test pins the
             # two equal, so the judged evidence doc cannot drift from the
             # measurement (VERDICT r4 item 7).  A PARTIAL run (a config

@@ -40,13 +40,18 @@ def make_blobs(
 
 
 #: The five evaluation configs from BASELINE.json (shapes only; data is
-#: synthetic with matching dimensions — zero-egress environment).
+#: synthetic with matching dimensions — zero-egress environment), plus
+#: ``codebook``: the extreme-k stress shape (a vector-quantization
+#: codebook at the headline n and d) whose (k, d) block overflows VMEM
+#: and therefore exercises the k-tiled streaming kernels (ISSUE 11)
+#: rather than the resident-codebook path.
 BENCH_CONFIGS = {
     "blobs2d": dict(n=500, d=2, k=3, minibatch=False),
     "mnist": dict(n=60_000, d=784, k=10, minibatch=False),
     "glove": dict(n=400_000, d=300, k=1000, minibatch=False),
     "cifar10": dict(n=50_000, d=3072, k=100, minibatch=True),
     "imagenet": dict(n=1_280_000, d=2048, k=1000, minibatch=True),
+    "codebook": dict(n=1_280_000, d=2048, k=65536, minibatch=True),
 }
 
 

@@ -206,6 +206,9 @@ def _anderson_loop(x, centroids0, weights, tol, xs0, rs0, reg, *, max_iter,
             # resolve_backend gated "pallas" at the classic kernel's
             # footprint; hand "auto" down so delta_pass re-gates at the
             # delta kernel's own footprint (the fit_lloyd loop's idiom).
+            # Both gates are kernel_plan-backed (ISSUE 11): shapes whose
+            # codebook overflows VMEM route to the k-tiled streaming
+            # kernels instead of demoting to XLA.
             backend="auto" if backend == "pallas" else backend,
             # The safeguard reads the objective EVERY sweep, so the
             # raw-score shortcut is never safe here.

@@ -518,24 +518,31 @@ def _mib(b: float) -> float:
 
 def vmem_report(d: int, k: int, *, kernel: str = "classic",
                 block_rows: Optional[int] = None, mc: Optional[int] = None,
-                x_itemsize: int = 2, cd_itemsize: int = 2
-                ) -> Dict[str, Any]:
+                x_itemsize: int = 2, cd_itemsize: int = 2,
+                k_tile: Optional[int] = None) -> Dict[str, Any]:
     """Analytic VMEM preflight for the Pallas Lloyd kernels: *whether* a
     (k, d, block) config fits the budget — by construction the same
     verdict as ``pallas_supported``/``delta_pallas_supported``/
     ``hamerly_pallas_supported``, because both sum the ONE
     :func:`kmeans_tpu.ops.pallas_lloyd.vmem_breakdown` — plus *why* and
-    *by how much*: per-operand byte terms, headroom or overflow, and the
-    k-tiling preflight ROADMAP item 1 needs (``max_k_tile``: the largest
-    lane-multiple centroid slice that WOULD fit at this d/block, i.e.
-    the tile size a k-tiled kernel should stream).
+    *by how much*: per-operand byte terms, headroom or overflow.
+
+    ``k_tile`` prices the K-TILED streaming kernel (ISSUE 11) at that
+    slice width instead of the resident-codebook layout; ``supported``
+    then reports whether the TILED footprint fits.  Without it, the
+    report also carries ``max_k_tile``: the widest lane-multiple slice
+    whose tiled footprint fits at this d/block — the tile
+    :func:`kmeans_tpu.ops.pallas_lloyd.kernel_plan` dispatches (the one
+    function both consult, so preflight and dispatch cannot drift), and
+    ``plan`` with that decision (untiled/tiled/refuse + why).
 
     Imports jax/pallas lazily (this is an obs module); itemsizes default
     to the production bf16 path.
     """
     from kmeans_tpu.ops.pallas_lloyd import (VMEM_KERNEL_DEFAULTS, _LANE,
-                                             _vmem_budget, padded_d,
-                                             vmem_breakdown)
+                                             _vmem_budget, kernel_plan,
+                                             padded_d, vmem_breakdown)
+    from kmeans_tpu.ops.pallas_lloyd import max_k_tile as _max_k_tile
 
     if kernel not in VMEM_KERNEL_DEFAULTS:
         raise ValueError(f"unknown kernel kind {kernel!r}; "
@@ -547,14 +554,16 @@ def vmem_report(d: int, k: int, *, kernel: str = "classic",
     base = {
         "kernel": kernel, "d": d, "k": k, "block_rows": t, "mc": mc_eff,
         "x_itemsize": x_itemsize, "cd_itemsize": cd_itemsize,
-        "budget_bytes": budget,
+        "k_tile": k_tile, "budget_bytes": budget,
     }
     terms = vmem_breakdown(kernel, d=d, k=k, block_rows=t, mc=mc_eff,
-                           x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+                           x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
+                           k_tile=k_tile)
     if terms is None:
         return {**base, "supported": False, "terms": None,
                 "total_bytes": None, "headroom_bytes": None,
                 "d_padded": 0, "k_padded": None, "max_k_tile": None,
+                "plan": None,
                 "why": (f"d={d} is not lane-alignable: the next multiple "
                         f"of {_LANE} exceeds the zero-padding FLOP "
                         "inflation cap — the kernel is unreachable at "
@@ -562,39 +571,30 @@ def vmem_report(d: int, k: int, *, kernel: str = "classic",
     total = sum(terms.values())
     supported = total <= budget
 
-    def fits_at_k(kk: int) -> bool:
-        tt = vmem_breakdown(kernel, d=d, k=kk, block_rows=t, mc=mc_eff,
-                            x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
-        return tt is not None and sum(tt.values()) <= budget
-
-    # Largest lane-multiple k-slice that fits (the k-tile preflight):
-    # binary search over multiples of the lane width, bounded by k.
-    max_k_tile = None
-    hi = -(-k // _LANE)                       # k_pad in lanes
-    if fits_at_k(min(k, _LANE)):
-        lo_l, hi_l = 1, hi
-        while lo_l < hi_l:
-            mid = (lo_l + hi_l + 1) // 2
-            if fits_at_k(min(k, mid * _LANE)):
-                lo_l = mid
-            else:
-                hi_l = mid - 1
-        max_k_tile = min(k, lo_l * _LANE)
+    # The widest tile the TILED kernel could stream here, and the dispatch
+    # decision — both from the shared gate module, never recomputed.
+    max_k_tile = _max_k_tile(kernel, d, k, block_rows=block_rows, mc=mc,
+                             x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+    plan = kernel_plan(kernel, d, k, block_rows=block_rows, mc=mc,
+                       x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
 
     ranked = sorted(terms.items(), key=lambda kv: kv[1], reverse=True)
     top = ", ".join(f"{name} {_mib(b):.1f} MiB" for name, b in ranked[:3])
+    layout = (f"k_tile={k_tile} streaming" if k_tile is not None
+              else "resident codebook")
     if supported:
-        why = (f"fits: {_mib(total):.1f} of {_mib(budget):.1f} MiB "
+        why = (f"fits ({layout}): {_mib(total):.1f} of "
+               f"{_mib(budget):.1f} MiB "
                f"({100.0 * total / budget:.0f}% of budget; largest terms: "
                f"{top})")
     else:
         why = (f"exceeds the {_mib(budget):.1f} MiB budget by "
-               f"{_mib(total - budget):.1f} MiB "
-               f"({_mib(total):.1f} MiB total; dominated by {top})")
-        if max_k_tile is not None and max_k_tile < k:
-            why += (f"; a k-tile of {max_k_tile} centroids would fit — "
-                    "stream centroid slices with a running argmin carry "
-                    "(ROADMAP item 1)")
+               f"{_mib(total - budget):.1f} MiB ({layout}; "
+               f"{_mib(total):.1f} MiB total; dominated by {top})")
+        if k_tile is None and plan.mode == "tiled":
+            why += (f"; the tiled kernel dispatches at k_tile="
+                    f"{plan.k_tile} — stream centroid slices with a "
+                    "running argmin carry (ROADMAP item 1, shipped)")
     return {
         **base,
         "supported": supported,
@@ -605,5 +605,7 @@ def vmem_report(d: int, k: int, *, kernel: str = "classic",
         "headroom_bytes": budget - total,
         "utilization": total / budget if budget else None,
         "max_k_tile": max_k_tile,
+        "plan": {"mode": plan.mode, "k_tile": plan.k_tile,
+                 "why": plan.why},
         "why": why,
     }

@@ -53,12 +53,11 @@ from jax import lax
 from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.distance import matmul_precision
 from kmeans_tpu.ops.lloyd import _platform_of, lloyd_pass, weights_exact
-from kmeans_tpu.ops.pallas_lloyd import (accumulate_pallas,
-                                         delta_pallas_supported,
-                                         lloyd_delta_pallas)
+from kmeans_tpu.ops.pallas_lloyd import (KernelPlan, accumulate_pallas,
+                                         kernel_plan, lloyd_delta_pallas)
 
-__all__ = ["delta_pass", "delta_pallas_ok", "resolve_delta_backend",
-           "default_cap", "DELTA_REFRESH"]
+__all__ = ["delta_pass", "delta_pallas_ok", "delta_kernel_plan",
+           "resolve_delta_backend", "default_cap", "DELTA_REFRESH"]
 
 #: Full-reduction refresh period of delta-update loops: one sweep in every
 #: DELTA_REFRESH recomputes sums/counts from scratch, bounding the f32
@@ -69,9 +68,9 @@ __all__ = ["delta_pass", "delta_pallas_ok", "resolve_delta_backend",
 DELTA_REFRESH = 16
 
 
-def delta_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,
-                    compute_dtype=None, platform=None) -> bool:
-    """Whether the fused Mosaic delta kernel can serve this sweep — THE one
+def delta_kernel_plan(x, k: int, *, weights=None, weights_are_binary=False,
+                      compute_dtype=None, platform=None) -> KernelPlan:
+    """Full dispatch decision for the fused Mosaic delta kernel — THE one
     copy of the gate (``delta_pass`` dispatches on it; ``fit_plan`` and the
     bench report from it, so the evidence cannot drift from the dispatch).
     The VMEM pricing runs at the DELTA kernel's own footprint
@@ -80,20 +79,32 @@ def delta_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,
     512-row estimate and must not be trusted here.  Dtypes canonicalize
     (x64-off: a float64 host array computes — and occupies VMEM — as f32),
     so metadata-only callers like ``fit_plan`` judge the dtype the
-    arithmetic runs in."""
+    arithmetic runs in.  Modes: ``untiled`` (resident codebook), ``tiled``
+    (k-sliced streaming, ISSUE 11), ``refuse``."""
     from jax.dtypes import canonicalize_dtype
 
     x_dtype = jnp.dtype(canonicalize_dtype(x.dtype))
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_dtype
     n, d = x.shape
-    return (
-        weights_exact(cd, weights=weights,
-                      weights_are_binary=weights_are_binary)
-        and _platform_of(x, platform) == "tpu"
-        and delta_pallas_supported(n, d, k,
-                                   x_itemsize=x_dtype.itemsize,
-                                   cd_itemsize=cd.itemsize)
+    if not weights_exact(cd, weights=weights,
+                         weights_are_binary=weights_are_binary):
+        return KernelPlan("refuse", None,
+                          "fractional weights in a non-f32 compute dtype")
+    if _platform_of(x, platform) != "tpu":
+        return KernelPlan("refuse", None, "not running on TPU")
+    return kernel_plan("delta", d, k, x_itemsize=x_dtype.itemsize,
+                       cd_itemsize=cd.itemsize)
+
+
+def delta_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,
+                    compute_dtype=None, platform=None) -> bool:
+    """Bool veneer over :func:`delta_kernel_plan` (kept for callers that
+    only branch on dispatchability)."""
+    plan = delta_kernel_plan(
+        x, k, weights=weights, weights_are_binary=weights_are_binary,
+        compute_dtype=compute_dtype, platform=platform,
     )
+    return plan.mode != "refuse"
 
 
 def resolve_delta_backend(backend, x, k: int, *, weights=None,
@@ -248,21 +259,21 @@ def delta_pass(
     # f32 compute, same policy as the fused kernel's one-hot cast.  The
     # fit loop hands this function "auto" (see delta_pallas_ok: the gate
     # prices the delta kernel's own VMEM footprint).
-    supported = delta_pallas_ok(
+    plan = delta_kernel_plan(
         x, k, weights=weights, weights_are_binary=weights_are_binary,
         compute_dtype=compute_dtype,
     )
-    if backend == "pallas" and not supported:
+    if backend == "pallas" and plan.mode == "refuse":
         raise ValueError(
             "pallas delta pass unsupported here (needs TPU-shaped VMEM at "
             "block_rows=1024, lane-alignable d, and binary weights unless "
-            "f32); use backend='auto' to fall back"
+            f"f32): {plan.why}; use backend='auto' to fall back"
         )
     # "pallas_interpret" is the CPU-mesh kernel hook (same as lloyd_pass's):
     # the fused delta kernel runs in interpreter mode, VMEM gates waived.
     interpret = backend == "pallas_interpret"
     use_pallas = (backend == "pallas" or interpret
-                  or (backend == "auto" and supported))
+                  or (backend == "auto" and plan.mode != "refuse"))
     w_all = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
 
     if use_pallas:
@@ -275,7 +286,7 @@ def delta_pass(
          _dense_tiles) = lloyd_delta_pallas(
             x, centroids, labels_prev, weights=weights,
             compute_dtype=compute_dtype, with_mind=with_mind,
-            interpret=interpret,
+            interpret=interpret, k_tile=plan.k_tile,
         )
 
         def incremental(_):
@@ -285,9 +296,12 @@ def delta_pass(
             sums, counts = incremental(None)
         else:
             def full(_):
+                # The delta plan's tile is safe here too: the labeled
+                # accumulation is a strict subset of the delta footprint.
                 s, c, _ = accumulate_pallas(
                     x, labels, k, weights=w_all,
                     compute_dtype=compute_dtype, interpret=interpret,
+                    k_tile=plan.k_tile,
                 )
                 return s, c
 

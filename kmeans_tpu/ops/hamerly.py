@@ -74,10 +74,11 @@ from jax import lax
 from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 from kmeans_tpu.ops.lloyd import _platform_of, weights_exact
-from kmeans_tpu.ops.pallas_lloyd import (hamerly_pallas_supported,
+from kmeans_tpu.ops.pallas_lloyd import (KernelPlan, kernel_plan,
                                          lloyd_hamerly_pallas, padded_d)
 
-__all__ = ["hamerly_pass", "hamerly_pallas_ok", "resolve_hamerly_backend",
+__all__ = ["hamerly_pass", "hamerly_pallas_ok", "hamerly_kernel_plan",
+           "resolve_hamerly_backend",
            "row_norms", "HAMERLY_MARGIN_REL", "closure_candidates"]
 
 #: Relative soundness margin over the f32 dot-accumulation error bound
@@ -207,23 +208,37 @@ def closure_candidates(centroids, *, n_groups: Optional[int] = None,
     return mu.astype(np.float32), cand, thr
 
 
-def hamerly_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,
-                      compute_dtype=None, platform=None) -> bool:
-    """Dispatch gate for the fused Mosaic Hamerly kernel — THE one copy
-    (mirrors :func:`kmeans_tpu.ops.delta.delta_pallas_ok`)."""
+def hamerly_kernel_plan(x, k: int, *, weights=None, weights_are_binary=False,
+                        compute_dtype=None, platform=None) -> KernelPlan:
+    """Full dispatch decision for the fused Mosaic Hamerly kernel — THE one
+    copy (mirrors :func:`kmeans_tpu.ops.delta.delta_kernel_plan`).  Modes:
+    ``untiled`` (resident codebook), ``tiled`` (k-sliced streaming, ISSUE
+    11 — note the tiled path scores every row, forgoing the pruning win),
+    ``refuse``."""
     from jax.dtypes import canonicalize_dtype
 
     x_dtype = jnp.dtype(canonicalize_dtype(x.dtype))
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_dtype
     n, d = x.shape
-    return (
-        weights_exact(cd, weights=weights,
-                      weights_are_binary=weights_are_binary)
-        and _platform_of(x, platform) == "tpu"
-        and hamerly_pallas_supported(n, d, k,
-                                     x_itemsize=x_dtype.itemsize,
-                                     cd_itemsize=cd.itemsize)
+    if not weights_exact(cd, weights=weights,
+                         weights_are_binary=weights_are_binary):
+        return KernelPlan("refuse", None,
+                          "fractional weights in a non-f32 compute dtype")
+    if _platform_of(x, platform) != "tpu":
+        return KernelPlan("refuse", None, "not running on TPU")
+    return kernel_plan("hamerly", d, k, x_itemsize=x_dtype.itemsize,
+                       cd_itemsize=cd.itemsize)
+
+
+def hamerly_pallas_ok(x, k: int, *, weights=None, weights_are_binary=False,
+                      compute_dtype=None, platform=None) -> bool:
+    """Bool veneer over :func:`hamerly_kernel_plan` (kept for callers that
+    only branch on dispatchability)."""
+    plan = hamerly_kernel_plan(
+        x, k, weights=weights, weights_are_binary=weights_are_binary,
+        compute_dtype=compute_dtype, platform=platform,
     )
+    return plan.mode != "refuse"
 
 
 def resolve_hamerly_backend(backend, x, k: int, *, weights=None,
@@ -338,18 +353,20 @@ def hamerly_pass(
     need = (sb2 + margin >= slb2) | sentinel
 
     use_pallas = False
+    plan = None
     if backend != "xla":
-        ok = hamerly_pallas_ok(
+        plan = hamerly_kernel_plan(
             x, k, weights=weights, weights_are_binary=weights_are_binary,
             compute_dtype=compute_dtype,
         )
-        if backend == "pallas" and not ok:
+        if backend == "pallas" and plan.mode == "refuse":
             raise ValueError(
                 "pallas hamerly pass unsupported here (needs TPU-shaped "
                 "VMEM at block_rows=1024, lane-alignable d, and binary "
-                "weights unless f32); use backend='auto' to fall back"
+                f"weights unless f32): {plan.why}; use backend='auto' to "
+                "fall back"
             )
-        use_pallas = ok or backend == "pallas_interpret"
+        use_pallas = plan.mode != "refuse" or backend == "pallas_interpret"
 
     if use_pallas:
         (labels, sb3, slb3, dsums, dcounts, n_rec, _dense) = \
@@ -357,6 +374,7 @@ def hamerly_pass(
                 x, centroids, labels_prev, need, sb2, slb2,
                 weights=weights, compute_dtype=compute_dtype,
                 interpret=(backend == "pallas_interpret"),
+                k_tile=plan.k_tile,
             )
         sums = sums_prev + dsums
         counts = counts_prev + dcounts

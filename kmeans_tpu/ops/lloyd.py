@@ -35,7 +35,8 @@ from jax import lax
 
 from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.distance import matmul_precision, sq_norms
-from kmeans_tpu.ops.pallas_lloyd import lloyd_pass_pallas, pallas_supported
+from kmeans_tpu.ops.pallas_lloyd import (KernelPlan, kernel_plan,
+                                         lloyd_pass_pallas)
 
 __all__ = ["lloyd_pass", "resolve_backend", "weights_exact"]
 
@@ -65,22 +66,35 @@ def _platform_of(x, platform=None) -> str:
     return jax.default_backend()
 
 
-def _pallas_ok(x, k, *, weights, weights_are_binary, compute_dtype,
-               platform=None) -> bool:
+def _pallas_plan(x, k, *, weights, weights_are_binary, compute_dtype,
+                 platform=None) -> KernelPlan:
+    """Full dispatch decision for the fused classic kernel: ``untiled``
+    (resident codebook), ``tiled`` (k-sliced streaming, ISSUE 11) or
+    ``refuse`` — the exactness/platform vetoes fold in as refusals."""
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
     # The kernel's one-hot tile is cast to cd for the MXU — exact only per
     # the shared weights_exact policy (mirrors the XLA eff_update demotion).
     # Unaligned d is the KERNEL's business (zero-column lane padding under
-    # pallas_lloyd.padded_d); pallas_supported prices it in.
-    return (
-        weights_exact(cd, weights=weights,
-                      weights_are_binary=weights_are_binary)
-        and _platform_of(x, platform) == "tpu"
-        and pallas_supported(
-            x.shape[0], x.shape[1], k,
-            x_itemsize=x.dtype.itemsize, cd_itemsize=cd.itemsize,
-        )
+    # pallas_lloyd.padded_d); kernel_plan prices it in.
+    if not weights_exact(cd, weights=weights,
+                         weights_are_binary=weights_are_binary):
+        return KernelPlan("refuse", None,
+                          "fractional weights in a non-f32 compute dtype")
+    if _platform_of(x, platform) != "tpu":
+        return KernelPlan("refuse", None, "not running on TPU")
+    return kernel_plan(
+        "classic", x.shape[1], k,
+        x_itemsize=x.dtype.itemsize, cd_itemsize=cd.itemsize,
     )
+
+
+def _pallas_ok(x, k, *, weights, weights_are_binary, compute_dtype,
+               platform=None) -> bool:
+    plan = _pallas_plan(
+        x, k, weights=weights, weights_are_binary=weights_are_binary,
+        compute_dtype=compute_dtype, platform=platform,
+    )
+    return plan.mode != "refuse"
 
 
 def resolve_backend(
@@ -210,21 +224,21 @@ def lloyd_pass(
         # trimmed, accelerated, runner, ...) run under any KMeansConfig.
         update = "matmul"
     if backend != "xla":
-        ok = _pallas_ok(
+        plan = _pallas_plan(
             x, centroids.shape[0], weights=weights,
             weights_are_binary=weights_are_binary,
             compute_dtype=compute_dtype,
         )
-        if backend == "pallas" and not ok:
+        if backend == "pallas" and plan.mode == "refuse":
             raise ValueError(
                 "pallas backend unsupported here (needs TPU, d within 1.5x "
-                "of a 128 multiple, VMEM-resident (k,d), and binary "
-                "weights unless f32)"
+                "of a 128 multiple, a k-tile that fits VMEM, and binary "
+                f"weights unless f32): {plan.why}"
             )
-        if ok:
+        if plan.mode != "refuse":
             return lloyd_pass_pallas(
                 x, centroids, weights=weights, compute_dtype=compute_dtype,
-                with_update=with_update,
+                with_update=with_update, k_tile=plan.k_tile,
             )
     return _lloyd_pass_xla(
         x, centroids, weights=weights, chunk_size=chunk_size,

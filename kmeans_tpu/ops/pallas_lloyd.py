@@ -29,7 +29,7 @@ north-star numeric engine (BASELINE.json).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,7 +48,8 @@ from kmeans_tpu.ops.distance import matmul_precision, sq_norms
 __all__ = ["lloyd_pass_pallas", "accumulate_pallas", "pallas_supported",
            "lloyd_delta_pallas", "delta_pallas_supported",
            "lloyd_hamerly_pallas", "hamerly_pallas_supported",
-           "vmem_breakdown", "VMEM_KERNEL_DEFAULTS"]
+           "vmem_breakdown", "VMEM_KERNEL_DEFAULTS",
+           "KernelPlan", "kernel_plan", "max_k_tile"]
 
 # Fallback VMEM budget when the device can't be queried (non-TPU default
 # backend, e.g. interpret-mode tests on the CPU mesh).  Calibrated
@@ -98,7 +99,8 @@ VMEM_KERNEL_DEFAULTS = {
 def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
                    block_rows: Optional[int] = None,
                    mc: Optional[int] = None,
-                   x_itemsize: int = 2, cd_itemsize: int = 2):
+                   x_itemsize: int = 2, cd_itemsize: int = 2,
+                   k_tile: Optional[int] = None):
     """Named VMEM byte terms of one kernel's resident+streamed operands.
 
     THE one copy of the footprint arithmetic: the ``*_supported`` gates
@@ -106,6 +108,17 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
     :func:`kmeans_tpu.obs.costmodel.vmem_report` renders it as the
     *why/by-how-much* preflight for k-tiling (ROADMAP item 1) — the two
     can never disagree because there is nothing else to agree with.
+
+    ``k_tile=None`` prices the UNTILED kernel (full ``(d, k_pad)``
+    centroid block resident).  With ``k_tile`` (a lane multiple), prices
+    the K-TILED two-pass kernel instead: the streamed-argmin pass's
+    double-buffered centroid slices plus the fold pass's per-slice
+    accumulators, summed together (conservative — the two passes are
+    separate ``pallas_call``s, so this over- rather than under-counts).
+    The tiled table is shared by all three kinds: the tiled delta and
+    hamerly paths reuse the classic streamed-argmin pass plus a signed
+    fold, with no compaction machinery (their extra tiled terms are the
+    signed-fold tile and, for hamerly, the second-min carry).
 
     Returns an ordered ``{term: bytes}`` dict at the PADDED shapes
     (``padded_d(d)``, ``k`` rounded to the 128 lane), or ``None`` when
@@ -122,6 +135,27 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
     if not d_eff:
         return None
     k_pad = _round_up(k, _LANE)
+    if k_tile is not None:
+        kt = _round_up(min(k_tile, k_pad), _LANE)
+        terms = {
+            # ---- pass A: streamed argmin over (d, kt) centroid slices
+            "ct_tile_stream": 2 * d_eff * kt * cd_itemsize,
+            "csq_tile_stream": 2 * kt * 4,
+            "x_stream": 2 * t * d_eff * x_itemsize,
+            "dist_tile": t * kt * 4,
+            "argmin_carry": 2 * t * _LANE * 4,    # (best, label) per row
+            # ---- pass B: per-slice fold, x re-streamed once per slice
+            "fold_x_stream": 2 * t * d_eff * x_itemsize,
+            "fold_sums_tile": kt * d_eff * 4,
+            "fold_counts_tile": kt * 4,
+            "fold_onehot_tile": t * kt * (4 + cd_itemsize),
+        }
+        if kind in ("delta", "hamerly"):
+            # Signed ±w fold builds two one-hot products per tile.
+            terms["signed_fold_tile"] = t * kt * (4 + cd_itemsize)
+        if kind == "hamerly":
+            terms["second_min_carry"] = t * _LANE * 4
+        return terms
     terms = {
         "centroids_ct": d_eff * k_pad * cd_itemsize,  # resident (d, k) -2x
         "sums_acc": k_pad * d_eff * 4,                # resident f32 accum
@@ -143,9 +177,11 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
 
 
 def _fits_budget(kind: str, d: int, k: int, *, block_rows, mc,
-                 x_itemsize: int, cd_itemsize: int) -> bool:
+                 x_itemsize: int, cd_itemsize: int,
+                 k_tile: Optional[int] = None) -> bool:
     terms = vmem_breakdown(kind, d=d, k=k, block_rows=block_rows, mc=mc,
-                           x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+                           x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
+                           k_tile=k_tile)
     return terms is not None and sum(terms.values()) <= _vmem_budget()
 
 
@@ -205,6 +241,90 @@ def delta_pallas_supported(n: int, d: int, k: int, *,
     small-VMEM generations and VMEM-marginal shapes."""
     return _fits_budget("delta", d, k, block_rows=block_rows, mc=mc,
                         x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+
+
+class KernelPlan(NamedTuple):
+    """A dispatch decision for one Pallas kernel kind at one shape —
+    what the ``*_supported`` bare bools grew into (ISSUE 11): *how* to
+    run, not just whether the untiled kernel fits.
+
+    ``mode`` is ``"untiled"`` (everything VMEM-resident, the fast path),
+    ``"tiled"`` (stream ``k_tile``-wide centroid slices with a running
+    argmin carry), or ``"refuse"`` (not even a one-lane tile fits, or
+    ``d`` is unalignable).  ``k_tile`` is the lane-multiple slice width
+    when ``mode == "tiled"``, else ``None``.  ``why`` is a one-line
+    human-readable reason for the choice."""
+
+    mode: str
+    k_tile: Optional[int]
+    why: str
+
+
+def max_k_tile(kind: str, d: int, k: int, *,
+               block_rows: Optional[int] = None, mc: Optional[int] = None,
+               x_itemsize: int = 2, cd_itemsize: int = 2) -> Optional[int]:
+    """Largest lane-multiple centroid slice whose TILED footprint fits
+    the VMEM budget (capped at ``k`` rounded to the lane), or ``None``
+    when even a single 128-lane slice overflows — THE one tile-size
+    search, shared by :func:`kernel_plan` and the compile observatory's
+    ``vmem_report`` so preflight and dispatch cannot disagree."""
+    d_eff = padded_d(d)
+    if not d_eff:
+        return None
+    k_pad = _round_up(max(k, 1), _LANE)
+
+    def fits(lanes: int) -> bool:
+        return _fits_budget(kind, d, k, block_rows=block_rows, mc=mc,
+                            x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
+                            k_tile=lanes * _LANE)
+
+    hi_l = k_pad // _LANE
+    if not fits(1):
+        return None
+    lo_l = 1
+    while lo_l < hi_l:
+        mid = (lo_l + hi_l + 1) // 2
+        if fits(mid):
+            lo_l = mid
+        else:
+            hi_l = mid - 1
+    return lo_l * _LANE
+
+
+def kernel_plan(kind: str, d: int, k: int, *,
+                block_rows: Optional[int] = None, mc: Optional[int] = None,
+                x_itemsize: int = 2, cd_itemsize: int = 2) -> KernelPlan:
+    """Shape-level dispatch decision for one kernel kind (see
+    :class:`KernelPlan`).  Prefers the untiled kernel whenever its
+    resident footprint fits (strictly fewer HBM reads: the fold rides
+    the argmin's single pass over ``x``); otherwise picks the largest
+    tile :func:`max_k_tile` admits; refuses only when ``d`` is
+    unalignable or nothing fits.
+
+    The platform / weight-exactness halves of dispatch stay with the
+    callers (``ops.lloyd._pallas_plan`` and friends) — this function
+    prices shapes only, so metadata-only callers (``fit_plan``, the
+    bench preflight, ``vmem_report``) can share it."""
+    if padded_d(d) == 0:
+        return KernelPlan(
+            "refuse", None,
+            f"d={d} is not lane-alignable within the "
+            f"{_PAD_INFLATION_CAP}x zero-padding cap")
+    if _fits_budget(kind, d, k, block_rows=block_rows, mc=mc,
+                    x_itemsize=x_itemsize, cd_itemsize=cd_itemsize):
+        return KernelPlan("untiled", None,
+                          "resident (k, d) footprint fits the VMEM budget")
+    kt = max_k_tile(kind, d, k, block_rows=block_rows, mc=mc,
+                    x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+    if kt is not None:
+        return KernelPlan(
+            "tiled", kt,
+            f"resident (k, d) overflows VMEM; stream {kt}-wide centroid "
+            "slices with a running argmin carry")
+    return KernelPlan(
+        "refuse", None,
+        "even a single 128-lane centroid slice exceeds the VMEM budget "
+        "at this d/block_rows")
 
 
 def _neg2_ct(centroids, cd):
@@ -332,7 +452,7 @@ def _kernel(x_ref, w_ref, ct_ref, csq_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("block_rows", "compute_dtype", "with_update",
-                     "raw_scores", "interpret", "sub_split"),
+                     "raw_scores", "interpret", "sub_split", "k_tile"),
 )
 def lloyd_pass_pallas(
     x: jax.Array,
@@ -346,6 +466,7 @@ def lloyd_pass_pallas(
     raw_scores: bool = False,
     interpret: bool = False,
     sub_split: int = 4,
+    k_tile: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fused assign(+reduce) sweep as a single Pallas kernel.
 
@@ -365,6 +486,13 @@ def lloyd_pass_pallas(
     * ``raw_scores`` — return ``min_k(||c||² - 2x·c)`` (no row norm, no
       clamp) in the ``min_d2`` slot, for exact cross-shard tie-breaking.
       The ``inertia`` output is meaningless in this mode.
+
+    ``k_tile`` (static, lane multiple) switches to the K-TILED two-pass
+    path: centroid slices stream through VMEM with a running argmin carry
+    and the fold runs per slice — bit-exact with the untiled kernel (same
+    lowest-index tie-break; see the tiled section's header comment).  The
+    dispatchers pass :func:`kernel_plan`'s choice; ``None`` keeps the
+    untiled fast path.
     """
     n, d_in = x.shape
     k = centroids.shape[0]
@@ -384,7 +512,10 @@ def lloyd_pass_pallas(
 
     t = block_rows
     n_pad = _round_up(max(n, 1), t)
-    k_pad = _round_up(k, _LANE)
+    tiled = k_tile is not None
+    if tiled:
+        _check_k_tile(k_tile, t)
+    k_pad = _round_up(k, k_tile) if tiled else _round_up(k, _LANE)
 
     w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
     if n_pad != n:
@@ -402,9 +533,28 @@ def lloyd_pass_pallas(
             [c_sq, jnp.full((k_pad - k,), jnp.inf, f32)]
         )
 
-    grid = (n_chunks,)
     if block_rows % sub_split or (block_rows // sub_split) % 8:
         sub_split = 1        # sub-tiles must be whole sublane groups
+
+    if tiled:
+        labels, min_d2 = _tiled_argmin(
+            x, c_t, c_sq, t=t, k_tile=k_tile, cd=cd, raw_scores=raw_scores,
+            with_second=False, interpret=interpret)
+        if with_update:
+            # sub_split mirrors the untiled kernel's fold grouping so the
+            # f32 accumulation associates identically (bit-exactness).
+            sums, counts = _tiled_fold(
+                x, w, labels[:, 0], None, k_pad=k_pad, k_tile=k_tile, t=t,
+                cd=cd, interpret=interpret, sub_split=sub_split)
+        else:
+            sums = jnp.zeros((k_pad, d), f32)
+            counts = jnp.zeros((1, k_pad), f32)
+        labels = labels[:n, 0]
+        min_d2 = min_d2[:n, 0]
+        inertia = jnp.sum(min_d2 * w[:n])
+        return labels, min_d2, sums[:k, :d_in], counts[0, :k], inertia
+
+    grid = (n_chunks,)
     kernel = functools.partial(_kernel, cd=cd, with_update=with_update,
                                raw_scores=raw_scores, sub_split=sub_split)
     labels, min_d2, sums, counts = pl.pallas_call(
@@ -631,7 +781,7 @@ def _delta_kernel(x_ref, w_ref, prev_ref, ct_ref, csq_ref, tri_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("block_rows", "mc", "compute_dtype", "interpret",
-                     "sub_split", "with_mind"),
+                     "sub_split", "with_mind", "k_tile"),
 )
 def lloyd_delta_pallas(
     x: jax.Array,
@@ -645,6 +795,7 @@ def lloyd_delta_pallas(
     interpret: bool = False,
     sub_split: int = 4,
     with_mind: bool = True,
+    k_tile: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array,
            jax.Array, jax.Array]:
     """Fused incremental Lloyd sweep (see :func:`_delta_kernel`).
@@ -670,6 +821,14 @@ def lloyd_delta_pallas(
     (no row norm, no clamp) in the min_d2 slot and a matching raw
     ``inertia`` — for loops that converge on centroid shift and never read
     either, saving the (T, d) row-norm pass.
+
+    ``k_tile`` (static, lane multiple) switches to the K-TILED path: the
+    streamed-argmin pass scores every row, a cheap XLA epilogue derives
+    the changed mask, and the dual signed fold runs one centroid slice at
+    a time.  There is no compaction branch tiled (``dense_tiles`` reports
+    0) — at tiling-regime k·d the (mc, k_pad) machinery wouldn't fit
+    anyway — but the delta CONTRACT is unchanged: exact signed
+    corrections over ``labels_prev``, valid on every sweep.
     """
     n, d_in = x.shape
     k = centroids.shape[0]
@@ -694,7 +853,10 @@ def lloyd_delta_pallas(
     if t % sub_split or (t // sub_split) % 8:
         sub_split = 1
     n_pad = _round_up(max(n, 1), t)
-    k_pad = _round_up(k, _LANE)
+    tiled = k_tile is not None
+    if tiled:
+        _check_k_tile(k_tile, t)
+    k_pad = _round_up(k, k_tile) if tiled else _round_up(k, _LANE)
 
     w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
     prev = labels_prev.astype(jnp.int32)
@@ -713,6 +875,28 @@ def lloyd_delta_pallas(
         c_sq = jnp.concatenate(
             [c_sq, jnp.full((k_pad - k,), jnp.inf, f32)]
         )
+
+    if tiled:
+        lab2, mind2 = _tiled_argmin(
+            x, c_t, c_sq, t=t, k_tile=k_tile, cd=cd,
+            raw_scores=not with_mind, with_second=False,
+            interpret=interpret)
+        lab = lab2[:, 0]
+        # Same changed rule as the kernel branch predicate: zero-weight
+        # rows (incl. padding) are never "changed"; sentinel prev makes
+        # every real row changed, so the first sweep's delta over zero
+        # sums_prev IS the full reduction.
+        changed = (lab != prev) & (w > 0.0)
+        wch = w * changed.astype(f32)
+        sums, counts = _tiled_fold(
+            x, wch, lab, prev, k_pad=k_pad, k_tile=k_tile, t=t, cd=cd,
+            interpret=interpret)
+        labels = lab[:n]
+        min_d2 = mind2[:n, 0]
+        inertia = jnp.sum(min_d2 * w[:n])
+        n_changed = jnp.sum(changed).astype(jnp.int32)
+        return (labels, min_d2, sums[:k, :d_in], counts[0, :k], inertia,
+                n_changed, jnp.zeros((), jnp.int32))
 
     tri = (jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
            >= jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)).astype(cd)
@@ -943,7 +1127,7 @@ def _hamerly_kernel(x_ref, w_ref, prev_ref, need_ref, sbin_ref, slbin_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("block_rows", "mc", "compute_dtype", "interpret",
-                     "sub_split"),
+                     "sub_split", "k_tile"),
 )
 def lloyd_hamerly_pallas(
     x: jax.Array,
@@ -959,6 +1143,7 @@ def lloyd_hamerly_pallas(
     compute_dtype=None,
     interpret: bool = False,
     sub_split: int = 4,
+    k_tile: Optional[int] = None,
 ) -> Tuple[jax.Array, ...]:
     """Fused Hamerly-pruned sweep (see :func:`_hamerly_kernel`).
 
@@ -971,6 +1156,14 @@ def lloyd_hamerly_pallas(
     ``need`` forced True (the caller's rule) and route those rows through
     recomputation; with zero ``sums_prev`` the delta IS the full
     reduction.
+
+    ``k_tile`` (static, lane multiple) switches to the K-TILED path: the
+    streamed-argmin pass (with the online second-min carry) scores EVERY
+    row — the compaction/pruning machinery needs a resident (mc, k_pad)
+    score tile, which is exactly what doesn't fit in this regime — then
+    the ``need`` mask selects fresh vs carried (label, bounds) per row and
+    the dual signed fold applies one slice at a time.  Same outputs as
+    the untiled kernel's dense branch; ``dense_tiles`` reports 0.
     """
     n, d_in = x.shape
     k = centroids.shape[0]
@@ -993,8 +1186,11 @@ def lloyd_hamerly_pallas(
         )
     if t % sub_split or (t // sub_split) % 8:
         sub_split = 1
+    tiled = k_tile is not None
+    if tiled:
+        _check_k_tile(k_tile, t)
     n_pad = _round_up(max(n, 1), t)
-    k_pad = _round_up(k, _LANE)
+    k_pad = _round_up(k, k_tile) if tiled else _round_up(k, _LANE)
 
     w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
     prev = labels_prev.astype(jnp.int32)
@@ -1019,6 +1215,26 @@ def lloyd_hamerly_pallas(
         c_t = jnp.concatenate([c_t, jnp.zeros((d, k_pad - k), cd)], axis=1)
         c_sq = jnp.concatenate(
             [c_sq, jnp.full((k_pad - k,), jnp.inf, f32)])
+
+    if tiled:
+        lab2, m1_2, m2_2 = _tiled_argmin(
+            x, c_t, c_sq, t=t, k_tile=k_tile, cd=cd,
+            raw_scores=True, with_second=True, interpret=interpret)
+        lab_f = lab2[:, 0]
+        m1 = m1_2[:, 0]
+        m2 = m2_2[:, 0]
+        need_b = needf > 0.0
+        labels = jnp.where(need_b, lab_f, prev)
+        sb = jnp.where(need_b, m1, sb_in)
+        slb = jnp.where(need_b, m2, slb_in)
+        changed = (labels != prev) & (w > 0.0)
+        wch = w * changed.astype(f32)
+        sums, counts = _tiled_fold(
+            x, wch, labels, prev, k_pad=k_pad, k_tile=k_tile, t=t, cd=cd,
+            interpret=interpret)
+        n_recomputed = jnp.sum(needf).astype(jnp.int32)
+        return (labels[:n], sb[:n], slb[:n], sums[:k, :d_in],
+                counts[0, :k], n_recomputed, jnp.zeros((), jnp.int32))
 
     tri = (jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
            >= jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)).astype(cd)
@@ -1069,6 +1285,224 @@ def lloyd_hamerly_pallas(
             counts[0, :k], n_recomputed, dense_tiles)
 
 
+# ---------------------------------------------------------------------------
+# K-tiled two-pass path (ISSUE 11): when the resident (d, k_pad) centroid
+# block overflows VMEM, the wrappers above stream lane-multiple centroid
+# slices instead.  Pass A (grid = (row tiles, k slices), k minor) runs the
+# distance matmul one (d, k_tile) slice at a time, merging each slice's
+# within-tile argmin into a per-row (best, label[, second]) carry held in
+# VMEM scratch — the FlashAttention-style online argmin.  Pass B (grid =
+# (k slices, row tiles), rows minor so each (k_tile, d) output block
+# accumulates over CONSECUTIVE grid steps — Pallas only preserves output
+# blocks across same-index neighbours) folds sums/counts per slice,
+# re-streaming x once per slice.
+#
+# Bit-exactness with the untiled kernels is by construction, not accident:
+# the per-column dot product contracts over the same d in the same order
+# regardless of how many columns share the matmul, the within-slice argmin
+# picks the lowest local index (_argmin_rows), and the carry merge uses a
+# STRICT < so ties keep the earlier slice — together reproducing
+# jnp.argmin's lowest-global-index tie-break.  The fold contracts over the
+# tile's rows per output element, also independent of column count, and
+# row tiles accumulate in the same i order as the untiled fold.
+# ---------------------------------------------------------------------------
+
+
+def _tiled_argmin_kernel(x_ref, ct_ref, csq_ref, *refs, cd, raw_scores,
+                         with_second):
+    """One (row tile, k slice) step of the streamed-argmin pass."""
+    if with_second:
+        labels_ref, mind_ref, slb_ref, best_s, lab_s, sec_s = refs
+    else:
+        labels_ref, mind_ref, best_s, lab_s = refs
+    j = pl.program_id(1)
+    nkt = pl.num_programs(1)
+    kt = ct_ref.shape[1]
+
+    xb = x_ref[:]                                  # (T, d)
+    xb_c = xb.astype(cd)
+    prod = jnp.dot(xb_c, ct_ref[:], preferred_element_type=jnp.float32,
+                   precision=matmul_precision(cd))
+    part = csq_ref[:] + prod                       # ct carries the -2x
+    t_min, lab_rel, _ = _argmin_rows(part, kt)
+    lab_abs = lab_rel + j * kt
+    if with_second:
+        t_sec = _second_min_rows(part, lab_rel)
+
+    @pl.when(j == 0)
+    def _():
+        best_s[:] = t_min[:, None]
+        lab_s[:] = lab_abs[:, None]
+        if with_second:
+            sec_s[:] = t_sec[:, None]
+
+    @pl.when(j > 0)
+    def _():
+        pb = best_s[:][:, 0]
+        plab = lab_s[:][:, 0]
+        # STRICT <: on a tie the earlier slice's (lower) index wins,
+        # matching jnp.argmin on the full score matrix.
+        take = t_min < pb
+        best_s[:] = jnp.where(take, t_min, pb)[:, None]
+        lab_s[:] = jnp.where(take, lab_abs, plab)[:, None]
+        if with_second:
+            # Online second-min merge — exact (pure min/max lattice): the
+            # global runner-up is the loser of the two group minima or one
+            # of the groups' own runners-up.
+            ps = sec_s[:][:, 0]
+            sec_s[:] = jnp.minimum(jnp.minimum(ps, t_sec),
+                                   jnp.maximum(pb, t_min))[:, None]
+
+    @pl.when(j == nkt - 1)
+    def _():
+        labels_ref[:] = lab_s[:]
+        best = best_s[:][:, 0]
+        mind = best if raw_scores else jnp.maximum(best + _row_sq(xb), 0.0)
+        mind_ref[:] = mind[:, None]
+        if with_second:
+            slb_ref[:] = sec_s[:]
+
+
+def _tiled_argmin(x, c_t, c_sq, *, t, k_tile, cd, raw_scores, with_second,
+                  interpret):
+    """Pass A driver: (labels, min_d2[, second]) as (n_pad, 1) columns.
+
+    Inputs arrive pre-padded (rows to ``t``, columns to a ``k_tile``
+    multiple with +inf ``c_sq`` on padding, which can never win)."""
+    n_pad, d = x.shape
+    k_pad = c_t.shape[1]
+    f32 = jnp.float32
+    row = pl.BlockSpec((t, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+    out_specs = [row, row] + ([row] if with_second else [])
+    out_shape = ([jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)]
+                 + [jax.ShapeDtypeStruct((n_pad, 1), f32)]
+                 * (2 if with_second else 1))
+    scratch = [pltpu.VMEM((t, 1), f32), pltpu.VMEM((t, 1), jnp.int32)]
+    if with_second:
+        scratch.append(pltpu.VMEM((t, 1), f32))
+    kernel = functools.partial(_tiled_argmin_kernel, cd=cd,
+                               raw_scores=raw_scores,
+                               with_second=with_second)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_pad // t, k_pad // k_tile),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, k_tile), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_tile), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        compiler_params=CompilerParams(
+            vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(x, c_t, c_sq[None, :])
+
+
+def _tiled_fold_kernel(x_ref, w_ref, lab_ref, *refs, cd, dual, sub_split):
+    """One (k slice, row tile) step of the tiled fold pass."""
+    if dual:
+        lab2_ref, sums_ref, counts_ref = refs
+    else:
+        sums_ref, counts_ref = refs
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    xb_c = x_ref[:].astype(cd)
+    w = w_ref[:][:, 0]
+    lab = lab_ref[:][:, 0]
+    t = xb_c.shape[0]
+    kt = sums_ref.shape[0]
+    if dual:
+        # Signed ±w fold, spelled as in the untiled kernels' dense branch
+        # (one signed matrix, one matmul over the WHOLE tile's rows —
+        # those kernels do not sub-split their fold).  Absolute column
+        # ids; labels outside this slice (other slices, sentinels) match
+        # no column — the untiled sentinel mechanics.
+        cols = jax.lax.broadcasted_iota(jnp.int32, (t, kt), 1) + j * kt
+        prev = lab2_ref[:][:, 0]
+        signed = (jnp.where(lab[:, None] == cols, w[:, None], 0.0)
+                  - jnp.where(prev[:, None] == cols, w[:, None], 0.0))
+        counts_ref[:] += jnp.sum(signed, axis=0, keepdims=True)
+        sums_ref[:] += jax.lax.dot_general(
+            signed.astype(cd), xb_c,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(cd),
+        )
+    else:
+        # Fold per sub-tile in the SAME row grouping as the caller's
+        # untiled kernel (classic folds per sub_split'th of the tile;
+        # accumulate folds the whole tile => sub_split=1), so the f32
+        # accumulation associates identically — bit-exact, not just close.
+        ts = t // sub_split
+        cols = (jax.lax.broadcasted_iota(jnp.int32, (ts, kt), 1) + j * kt)
+        for s in range(sub_split):
+            rows = slice(s * ts, (s + 1) * ts)
+            _fold_tile(sums_ref, counts_ref, lab[rows], w[rows],
+                       xb_c[rows, :], cols, cd=cd)
+
+
+def _tiled_fold(x, w, lab, lab2, *, k_pad, k_tile, t, cd, interpret,
+                sub_split=1):
+    """Pass B driver: ``(sums (k_pad, d), counts (1, k_pad))`` from padded
+    rows and absolute labels.  ``lab2`` switches on the dual signed fold
+    (+w at ``lab``, -w at ``lab2``) for the delta/hamerly corrections."""
+    n_pad, d = x.shape
+    f32 = jnp.float32
+    dual = lab2 is not None
+    row = pl.BlockSpec((t, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((t, d), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+        row, row,
+    ]
+    ops = [x, w[:, None], lab[:, None]]
+    if dual:
+        in_specs.append(row)
+        ops.append(lab2[:, None])
+    kernel = functools.partial(_tiled_fold_kernel, cd=cd, dual=dual,
+                               sub_split=sub_split)
+    return pl.pallas_call(
+        kernel,
+        grid=(k_pad // k_tile, n_pad // t),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((k_tile, d), lambda j, i: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_tile), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d), f32),
+            jax.ShapeDtypeStruct((1, k_pad), f32),
+        ],
+        compiler_params=CompilerParams(
+            vmem_limit_bytes=_vmem_budget() + 8 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(*ops)
+
+
+def _check_k_tile(k_tile, block_rows):
+    if k_tile % _LANE:
+        raise ValueError(
+            f"k_tile must be a multiple of {_LANE}; got {k_tile}")
+    if block_rows % 8:
+        raise ValueError(
+            f"tiled kernels need block_rows in whole sublane groups; "
+            f"got {block_rows}")
+
+
 def _acc_kernel(x_ref, w_ref, lab_ref, g_ref,
                 sums_ref, counts_ref, mind_ref, *, cd):
     """One row tile of the labeled-accumulation sweep: one-hot from the
@@ -1097,7 +1531,8 @@ def _acc_kernel(x_ref, w_ref, lab_ref, g_ref,
 @observed("ops.accumulate_pallas", cost=True)
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "block_rows", "compute_dtype", "interpret"),
+    static_argnames=("k", "block_rows", "compute_dtype", "interpret",
+                     "k_tile"),
 )
 def accumulate_pallas(
     x: jax.Array,
@@ -1109,6 +1544,7 @@ def accumulate_pallas(
     block_rows: int = 512,
     compute_dtype=None,
     interpret: bool = False,
+    k_tile: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Fused update-reduction for rows whose labels are already known.
 
@@ -1126,6 +1562,11 @@ def accumulate_pallas(
     ``d`` lane-aligns by zero-column padding under the same
     :func:`padded_d` policy as the fused pass (exact; the two kernels must
     never diverge on it — the TP body runs them back to back).
+
+    ``k_tile`` (static, lane multiple) streams the fold one centroid slice
+    at a time (see the k-tiled section) when the ``(k_pad, d)`` sums block
+    would overflow VMEM; ``min_d2`` is then finished with an XLA epilogue
+    (``max(scores + ||x||², 0)`` needs no per-cluster state).
     """
     n, d_in = x.shape
     d = padded_d(d_in)
@@ -1140,8 +1581,11 @@ def accumulate_pallas(
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
 
     t = block_rows
+    tiled = k_tile is not None
+    if tiled:
+        _check_k_tile(k_tile, t)
     n_pad = _round_up(max(n, 1), t)
-    k_pad = _round_up(k, _LANE)
+    k_pad = _round_up(k, k_tile) if tiled else _round_up(k, _LANE)
 
     w = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
     g = jnp.zeros((n,), f32) if scores is None else scores.astype(f32)
@@ -1157,6 +1601,14 @@ def accumulate_pallas(
             [lab, jnp.full((n_pad - n,), k_pad, jnp.int32)]
         )
     n_chunks = n_pad // t
+
+    if tiled:
+        sums, counts = _tiled_fold(
+            x, w, lab, None, k_pad=k_pad, k_tile=k_tile, t=t, cd=cd,
+            interpret=interpret)
+        mind = jnp.maximum(
+            g + jnp.sum(x.astype(f32) * x.astype(f32), axis=1), 0.0)
+        return sums[:k, :d_in], counts[0, :k], mind[:n]
 
     row_spec = pl.BlockSpec((t, 1), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)

@@ -54,8 +54,8 @@ from kmeans_tpu.ops.lloyd import (
 )
 from kmeans_tpu.ops.pallas_lloyd import (
     accumulate_pallas,
+    kernel_plan,
     lloyd_pass_pallas,
-    pallas_supported,
 )
 from kmeans_tpu.ops.update import apply_update
 
@@ -596,9 +596,19 @@ def _tp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, model_axis,
     k_off = lax.axis_index(model_axis) * k_loc
     valid = (k_off + jnp.arange(k_loc)) < k_real
 
+    # Static-shape tile decision at trace time (the same shared gate the
+    # resolver consulted); a k-slice too big to sit resident streams
+    # through the tiled kernels instead of bouncing to XLA.
+    cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+          else x_loc.dtype)
+    plan = kernel_plan("classic", x_loc.shape[1], k_loc,
+                       x_itemsize=x_loc.dtype.itemsize,
+                       cd_itemsize=cd.itemsize)
+    k_tile = plan.k_tile if plan.mode != "refuse" else None
+
     lab_l, raw_l, _, _, _ = lloyd_pass_pallas(
         x_loc, c_loc, valid_cols=valid, with_update=False, raw_scores=True,
-        compute_dtype=compute_dtype, interpret=interpret,
+        compute_dtype=compute_dtype, interpret=interpret, k_tile=k_tile,
     )
     g = lax.pmin(raw_l, model_axis)
     cand = jnp.where(raw_l == g, lab_l + k_off, k_pad_total)
@@ -607,7 +617,7 @@ def _tp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, model_axis,
     # Shard-relative labels; accumulate_pallas drops out-of-range rows.
     sums, counts, mind = accumulate_pallas(
         x_loc, lab_g - k_off, k_loc, scores=g, weights=w_loc,
-        compute_dtype=compute_dtype, interpret=interpret,
+        compute_dtype=compute_dtype, interpret=interpret, k_tile=k_tile,
     )
     inertia = jnp.sum(mind * w_loc)
 
@@ -640,11 +650,11 @@ def _fp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
     x byte crosses the ICI once; sums/counts then ``psum`` over BOTH axes
     (every row is processed exactly once mesh-wide).
 
-    Requires the full (k, d) centroids resident per chip — exactly the
-    regime the kernel's VMEM gate admits — so the engine only routes here
-    when :func:`pallas_supported` holds for the full d; larger k·d stays on
-    the XLA partial-contraction body, which never materialises full
-    centroids.
+    Requires the full (k, d) centroids in HBM per chip — the kernel's VMEM
+    gate (:func:`kernel_plan` on the full d) decides whether they sit
+    resident or stream through as k-tiles; a shape even the tiled kernel
+    refuses stays on the XLA partial-contraction body, which never
+    materialises full centroids.
     """
     fp = lax.psum(1, feature_axis)
     j = lax.axis_index(feature_axis)
@@ -658,9 +668,15 @@ def _fp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
     )                                                       # (blk, d) full rows
     w_rows = lax.dynamic_slice(w_loc, (j * blk,), (blk,))
 
+    cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+          else x_rows.dtype)
+    plan = kernel_plan("classic", d_loc * fp, k,
+                       x_itemsize=x_rows.dtype.itemsize,
+                       cd_itemsize=cd.itemsize)
     lab_blk, mind_blk, sums, counts, _ = lloyd_pass_pallas(
         x_rows, c_full, weights=w_rows, with_update=True,
         compute_dtype=compute_dtype, interpret=interpret,
+        k_tile=plan.k_tile if plan.mode != "refuse" else None,
     )
 
     both = (data_axis, feature_axis)
@@ -768,9 +784,10 @@ def _resolve_sharded_backend(req, platform, *, d, k_slice, x_itemsize,
     """
     cd_size = (jnp.dtype(compute_dtype).itemsize
                if compute_dtype is not None else x_itemsize)
-    ok = weights_exact and pallas_supported(
-        0, d, k_slice, x_itemsize=x_itemsize, cd_itemsize=cd_size
-    )
+    plan = kernel_plan(
+        "classic", d, k_slice, x_itemsize=x_itemsize, cd_itemsize=cd_size
+    ) if weights_exact else None
+    ok = plan is not None and plan.mode != "refuse"
     if req == "auto":
         return "pallas" if (platform == "tpu" and ok) else "xla"
     if req in ("pallas", "pallas_interpret") and not ok:
@@ -778,7 +795,8 @@ def _resolve_sharded_backend(req, platform, *, d, k_slice, x_itemsize,
                   "cast the one-hot tile to the compute dtype)"
                   if not weights_exact
                   else f"needs d lane-alignable within the 1.5x zero-pad "
-                       f"cap and VMEM-resident (k_slice={k_slice}, d={d})")
+                       f"cap and a VMEM-fitting k-tile "
+                       f"(k_slice={k_slice}, d={d}): {plan.why}")
         raise ValueError(
             f"pallas backend unsupported for this sharded fit: {reason}"
         )

@@ -155,14 +155,55 @@ def _build_dense(rows: int, k: int, d: int):
 
     Scores ``csq - 2·x@c.T`` (the row norm is an argmin-invariant
     per-row constant, so it is never computed — the same ranking
-    function the training kernels use)."""
+    function the training kernels use).  When the shared VMEM gate
+    (:func:`kmeans_tpu.ops.pallas_lloyd.kernel_plan`) says the resident
+    ``(rows, k)`` score block is over budget, the argmin runs as a
+    k-chunked scan with a running (best, label) carry — the XLA twin of
+    the training kernels' tiled streaming path (same strict-< merge, so
+    the lowest-index tie-break is preserved; platform-neutral, so CPU
+    serve processes take it too)."""
     import jax
     import jax.numpy as jnp
 
-    def kernel(x, c, csq):
-        prod = jnp.matmul(x, c.T, preferred_element_type=jnp.float32)
-        return jnp.argmin(csq[None, :] - 2.0 * prod,
-                          axis=1).astype(jnp.int32)
+    from kmeans_tpu.ops.pallas_lloyd import kernel_plan
+
+    plan = kernel_plan("classic", d, k, x_itemsize=4, cd_itemsize=4)
+
+    if plan.mode == "tiled":
+        k_tile = plan.k_tile
+        k_pad = -(-k // k_tile) * k_tile
+
+        def kernel(x, c, csq):
+            cp = jnp.concatenate(
+                [c, jnp.zeros((k_pad - k, d), c.dtype)]) if k_pad != k else c
+            csqp = jnp.concatenate(
+                [csq, jnp.full((k_pad - k,), jnp.inf, csq.dtype)]
+            ) if k_pad != k else csq
+            cs = cp.reshape(k_pad // k_tile, k_tile, d)
+            qs = csqp.reshape(k_pad // k_tile, k_tile)
+
+            def body(carry, tile):
+                best, lab = carry
+                ct, qt, off = tile
+                prod = jnp.matmul(x, ct.T,
+                                  preferred_element_type=jnp.float32)
+                part = qt[None, :] - 2.0 * prod
+                t_min = jnp.min(part, axis=1)
+                t_lab = jnp.argmin(part, axis=1).astype(jnp.int32) + off
+                take = t_min < best          # strict: ties keep lower index
+                return (jnp.where(take, t_min, best),
+                        jnp.where(take, t_lab, lab)), None
+
+            offs = jnp.arange(k_pad // k_tile, dtype=jnp.int32) * k_tile
+            init = (jnp.full((rows,), jnp.inf, jnp.float32),
+                    jnp.zeros((rows,), jnp.int32))
+            (_, lab), _ = jax.lax.scan(body, init, (cs, qs, offs))
+            return lab
+    else:
+        def kernel(x, c, csq):
+            prod = jnp.matmul(x, c.T, preferred_element_type=jnp.float32)
+            return jnp.argmin(csq[None, :] - 2.0 * prod,
+                              axis=1).astype(jnp.int32)
 
     # Compile-observed (docs/OBSERVABILITY.md "Compile & cost"): if this
     # builder's lru_cache ever evicts and a bucket recompiles, the

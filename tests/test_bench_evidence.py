@@ -53,8 +53,15 @@ def test_artifacts_are_well_formed():
     assert local.get("update") in ("delta", "full")
     with open(os.path.join(_REPO, "BENCH_ALL_latest.json")) as f:
         allrec = json.load(f)
+    from kmeans_tpu.data import BENCH_CONFIGS
+
     names = [r["config"] for r in allrec["rows"]]
-    assert names == ["blobs2d", "mnist", "glove", "cifar10", "imagenet"]
+    # The BASELINE five are mandatory and ordered; later stress configs
+    # (extreme-k ``codebook``, ISSUE 11) appear once a post-tiling
+    # on-chip --all run records them — any extra row must be a real
+    # BENCH_CONFIGS shape, in registry order.
+    assert names == [c for c in BENCH_CONFIGS if c in set(names)]
+    assert names[:5] == ["blobs2d", "mnist", "glove", "cifar10", "imagenet"]
     for r in allrec["rows"]:
         assert r["iters_per_s"] > 0
         assert r["backend"] in ("pallas", "xla")

@@ -200,8 +200,9 @@ def test_vmem_report_explains_unalignable_d():
 
 def test_vmem_report_overflow_names_terms_and_k_tile():
     """A config far over budget must say why, by how much, and what
-    k-tile WOULD fit — and that tile must verify against the gate."""
-    from kmeans_tpu.ops.pallas_lloyd import pallas_supported
+    k-tile the STREAMING kernel dispatches at — and that tile must
+    verify against the tiled-footprint gate (ISSUE 11)."""
+    from kmeans_tpu.ops.pallas_lloyd import _fits_budget, kernel_plan
 
     rep = vmem_report(2048, 100_000, kernel="classic", **_BF16)
     assert rep["supported"] is False
@@ -209,8 +210,15 @@ def test_vmem_report_overflow_names_terms_and_k_tile():
     assert "exceeds" in rep["why"] and "MiB" in rep["why"]
     kt = rep["max_k_tile"]
     assert kt and kt % 128 == 0 and kt < 100_000
-    assert pallas_supported(1, 2048, kt, **_BF16)
-    assert not pallas_supported(1, 2048, kt + 128, **_BF16)
+    # max_k_tile is the largest tile whose TILED footprint fits; one
+    # lane-multiple larger must overflow.
+    assert _fits_budget("classic", 2048, 100_000, k_tile=kt, block_rows=None, mc=None, **_BF16)
+    assert not _fits_budget("classic", 2048, 100_000, k_tile=kt + 128, block_rows=None, mc=None, **_BF16)
+    # The dispatch plan agrees with the report and routes to tiling.
+    plan = kernel_plan("classic", 2048, 100_000, **_BF16)
+    assert rep["plan"]["mode"] == plan.mode == "tiled"
+    assert rep["plan"]["k_tile"] == plan.k_tile == kt
+    assert "k_tile=%d" % kt in rep["why"]
     assert sum(rep["terms"].values()) == rep["total_bytes"]
 
 

@@ -1,0 +1,351 @@
+"""K-tiled Pallas Lloyd kernels (ISSUE 11): bit-exactness vs untiled.
+
+The tiled path streams lane-multiple centroid slices through VMEM with a
+running ``(best_dist, best_label)`` carry (pass A) and folds sums/counts
+one slice at a time (pass B).  Its contract is BIT-exactness with the
+resident-codebook kernels: the per-slice argmin computes the identical
+f32 score values the resident kernel computes (same matmul shapes per
+row, same ``csq - 2·x@c`` spelling), the strict-``<`` carry merge keeps
+the lowest index on ties exactly like a resident argmin, and the fold
+reproduces each kernel's accumulation grouping (the classic kernel folds
+per sub-tile, delta/hamerly/accumulate fold whole tiles).  So every
+comparison below is ``assert_array_equal`` — no tolerances.
+
+Interpret mode on CPU (tier-1); the compiled Mosaic path shares the
+lowering-independent semantics and runs on-chip via ``bench.py --all``'s
+``codebook`` config (n=1.28M, d=2048, k=65536).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.ops.pallas_lloyd import (KernelPlan, accumulate_pallas,
+                                         kernel_plan, lloyd_delta_pallas,
+                                         lloyd_hamerly_pallas,
+                                         lloyd_pass_pallas, max_k_tile)
+
+
+def _pair(rng, n, d, k):
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32) * 2)
+    c = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32) * 2)
+    return x, c
+
+
+def _np_sums(x, lab, k, w=None):
+    n, d = x.shape
+    s = np.zeros((k, d), np.float32)
+    c = np.zeros((k,), np.float32)
+    wn = np.ones(n, np.float32) if w is None else np.asarray(w)
+    for i in range(n):
+        if 0 <= lab[i] < k:
+            s[lab[i]] += wn[i] * np.asarray(x)[i]
+            c[lab[i]] += wn[i]
+    return s, c
+
+
+def _assert_same(got, want, names):
+    for g, w, name in zip(got, want, names):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w),
+                                      err_msg=name)
+
+
+# ------------------------------------------------------------- classic
+
+#: k across / on / off the 128-wide tile boundary: below one tile,
+#: exactly one, just past one, exactly two, and a ragged three tiles.
+@pytest.mark.parametrize("k", [100, 128, 130, 256, 300])
+def test_classic_tiled_matches_untiled_bitexact(rng, k):
+    n, d = 1030, 128
+    x, c = _pair(rng, n, d, k)
+    w = jnp.asarray((rng.random(n) > 0.3).astype(np.float32))
+    want = lloyd_pass_pallas(x, c, weights=w, interpret=True)
+    got = lloyd_pass_pallas(x, c, weights=w, k_tile=128, interpret=True)
+    _assert_same(got, want,
+                 ("labels", "min_d2", "sums", "counts", "inertia"))
+
+
+def test_classic_tiled_tie_straddling_tile_edge(rng):
+    """Duplicate centroids on either side of the k_tile=128 boundary:
+    the strict-< carry merge must keep the LOWER index (127), exactly
+    like the resident argmin's tie-break."""
+    n, d, k = 520, 128, 256
+    x, c = _pair(rng, n, d, k)
+    c = c.at[128].set(c[127])
+    # Plant rows exactly at the duplicated centroid so the tie is hit.
+    x = x.at[:16].set(jnp.broadcast_to(c[127], (16, d)))
+    want = lloyd_pass_pallas(x, c, interpret=True)
+    got = lloyd_pass_pallas(x, c, k_tile=128, interpret=True)
+    _assert_same(got, want,
+                 ("labels", "min_d2", "sums", "counts", "inertia"))
+    lab = np.asarray(got[0])
+    assert (lab[:16] == 127).all()        # lower index wins the tie
+    assert not (lab == 128).any()
+
+
+def test_classic_tiled_matches_xla(rng):
+    from kmeans_tpu.ops.lloyd import lloyd_pass
+
+    n, d, k = 700, 128, 200
+    x, c = _pair(rng, n, d, k)
+    want = lloyd_pass(x, c)
+    got = lloyd_pass_pallas(x, c, k_tile=128, interpret=True)
+    for w, g, name in zip(want, got,
+                          ("labels", "min_d2", "sums", "counts", "inertia")):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g),
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+def test_classic_tiled_padded_d_bitexact(rng):
+    """Satellite 4 runtime half: unaligned d (300 -> 384 zero-column
+    lane padding) composes with k-tiling — padded columns contribute
+    zero to every slice's scores and fold, bit-exactly."""
+    n, d, k = 520, 300, 256
+    x, c = _pair(rng, n, d, k)
+    want = lloyd_pass_pallas(x, c, interpret=True)
+    got = lloyd_pass_pallas(x, c, k_tile=128, interpret=True)
+    assert got[2].shape == (k, d)
+    _assert_same(got, want,
+                 ("labels", "min_d2", "sums", "counts", "inertia"))
+
+
+def test_classic_tiled_rejects_bad_tile(rng):
+    x, c = _pair(rng, 64, 128, 10)
+    with pytest.raises(ValueError, match="k_tile"):
+        lloyd_pass_pallas(x, c, k_tile=100, interpret=True)
+
+
+# --------------------------------------------------------------- delta
+
+def test_delta_tiled_sentinel_sweep_bitexact(rng):
+    """All-changed first sweep (sentinel prev): every untiled tile takes
+    the dense branch — whole-tile fold on both sides, so the tiled
+    outputs are bit-identical (dense_tiles differs by design: the tiled
+    path has no compact/dense split and reports 0).
+
+    block_rows=128 here: the whole-tile folds on either side emit fold
+    dots with DIFFERENT output widths (k_pad vs k_tile), and XLA:CPU's
+    threaded gemm splits contractions longer than ~128 rows into
+    width-dependent partial sums (interpret-mode artifact — on TPU the
+    MXU accumulates each output column over rows in one fixed order
+    regardless of width).  A 128-row contraction is below the split
+    threshold, so the grouping contract is testable bit-exactly."""
+    n, d, k = 1024, 128, 200
+    x, c = _pair(rng, n, d, k)
+    prev = jnp.full((n,), -1, jnp.int32)
+    want = lloyd_delta_pallas(x, c, prev, block_rows=128, mc=64,
+                              interpret=True)
+    got = lloyd_delta_pallas(x, c, prev, block_rows=128, mc=64,
+                             k_tile=128, interpret=True)
+    names = ("labels", "mind", "dsums", "dcounts", "inertia", "n_changed")
+    _assert_same(got[:6], want[:6], names)
+    assert int(want[6]) == n // 128 and int(got[6]) == 0
+
+
+def test_delta_tiled_incremental_sweep_exact(rng):
+    """Moderate churn with weights: the untiled kernel takes the MXU
+    compaction branch (different fold grouping, so not bit-comparable),
+    but labels/mind are still bit-identical and the signed delta must
+    reproduce the numpy oracle: sums_new - sums_old at f32."""
+    n, d, k, t = 1024, 128, 32, 256
+    x, c = _pair(rng, n, d, k)
+    w = np.ones((n,), np.float32)
+    w[rng.random(n) < 0.2] = 0.0
+    wj = jnp.asarray(w)
+    lab_ref = np.asarray(lloyd_pass_pallas(
+        x, c, weights=wj, interpret=True)[0])
+    prev = lab_ref.copy()
+    pert = rng.random(n) < 0.07
+    prev[pert] = rng.integers(0, k, pert.sum())
+
+    want = lloyd_delta_pallas(x, c, jnp.asarray(prev.astype(np.int32)),
+                              weights=wj, block_rows=t, mc=64,
+                              interpret=True)
+    got = lloyd_delta_pallas(x, c, jnp.asarray(prev.astype(np.int32)),
+                             weights=wj, block_rows=t, mc=64,
+                             k_tile=128, interpret=True)
+    _assert_same(got[:2], want[:2], ("labels", "mind"))
+    assert int(got[5]) == int(want[5])          # n_changed
+    s_new, c_new = _np_sums(x, lab_ref, k, w)
+    s_old, c_old = _np_sums(x, prev, k, w)
+    np.testing.assert_allclose(np.asarray(got[2]), s_new - s_old, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(got[3]), c_new - c_old, atol=1e-4)
+
+
+# ------------------------------------------------------------- hamerly
+
+def test_hamerly_tiled_need_all_true_bitexact(rng):
+    """need all-True + sentinel prev: the untiled kernel's dense branch
+    refreshes every row with raw scores and folds whole tiles — exactly
+    the tiled path's semantics, so every output is bit-identical."""
+    n, d, k = 512, 128, 200
+    x, c = _pair(rng, n, d, k)
+    prev = jnp.full((n,), -1, jnp.int32)
+    need = jnp.ones((n,), bool)
+    zeros = jnp.zeros((n,), jnp.float32)
+    want = lloyd_hamerly_pallas(x, c, prev, need, zeros, zeros,
+                                block_rows=128, mc=64, interpret=True)
+    got = lloyd_hamerly_pallas(x, c, prev, need, zeros, zeros,
+                               block_rows=128, mc=64, k_tile=128,
+                               interpret=True)
+    names = ("labels", "sb", "slb", "dsums", "dcounts", "n_recomputed")
+    _assert_same(got[:6], want[:6], names)
+    assert int(got[6]) == 0                       # dense_tiles: by design
+
+
+def test_hamerly_tiled_need_mask_semantics(rng):
+    """Partial need: rows with need=False must carry (prev, sb, slb)
+    through untouched, rows with need=True get the fresh streamed
+    (label, bounds), and the signed fold covers exactly the rows whose
+    label changed — verified against the all-need run + numpy fold."""
+    n, d, k = 512, 128, 64
+    x, c = _pair(rng, n, d, k)
+    prev_np = np.asarray(lloyd_pass_pallas(x, c, interpret=True)[0]).copy()
+    # Perturb a third of the labels so need=True rows really move.
+    pert = rng.random(n) < 0.33
+    prev_np[pert] = rng.integers(0, k, pert.sum())
+    prev = jnp.asarray(prev_np.astype(np.int32))
+    need_np = rng.random(n) < 0.5
+    need = jnp.asarray(need_np)
+    sb0 = jnp.asarray(rng.random(n).astype(np.float32))
+    slb0 = jnp.asarray(rng.random(n).astype(np.float32) + 1.0)
+
+    fresh = lloyd_hamerly_pallas(
+        x, c, prev, jnp.ones((n,), bool), sb0, slb0,
+        block_rows=128, mc=64, k_tile=128, interpret=True)
+    got = lloyd_hamerly_pallas(
+        x, c, prev, need, sb0, slb0,
+        block_rows=128, mc=64, k_tile=128, interpret=True)
+
+    exp_lab = np.where(need_np, np.asarray(fresh[0]), prev_np)
+    np.testing.assert_array_equal(np.asarray(got[0]), exp_lab)
+    np.testing.assert_array_equal(
+        np.asarray(got[1]), np.where(need_np, np.asarray(fresh[1]),
+                                     np.asarray(sb0)))
+    np.testing.assert_array_equal(
+        np.asarray(got[2]), np.where(need_np, np.asarray(fresh[2]),
+                                     np.asarray(slb0)))
+    assert int(got[5]) == int(need_np.sum())
+    s_new, c_new = _np_sums(x, exp_lab, k)
+    s_old, c_old = _np_sums(x, prev_np, k)
+    np.testing.assert_allclose(np.asarray(got[3]), s_new - s_old, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(got[4]), c_new - c_old, atol=1e-4)
+
+
+# ---------------------------------------------------------- accumulate
+
+def test_accumulate_tiled_bitexact(rng):
+    """block_rows=128 for the same reason as the delta sentinel test:
+    accumulate folds whole tiles, and XLA:CPU's threaded gemm splits
+    contractions past ~128 rows into output-width-dependent partial
+    sums (interpret-mode artifact only)."""
+    n, d, k = 700, 128, 300
+    x, _ = _pair(rng, n, d, k)
+    lab = jnp.asarray(rng.integers(0, k, n).astype(np.int32))
+    # Sentinel labels fold nothing on either path.
+    lab = lab.at[:5].set(-1)
+    g = jnp.asarray(rng.normal(size=(n,)).astype(np.float32))
+    w = jnp.asarray(rng.random(n).astype(np.float32))
+    want = accumulate_pallas(x, lab, k, scores=g, weights=w,
+                             block_rows=128, interpret=True)
+    got = accumulate_pallas(x, lab, k, scores=g, weights=w, k_tile=128,
+                            block_rows=128, interpret=True)
+    _assert_same(got, want, ("sums", "counts", "mind"))
+
+
+# ------------------------------------------------------ dispatch plans
+
+def test_kernel_plan_modes():
+    small = kernel_plan("classic", 128, 8)
+    assert small.mode == "untiled" and small.k_tile is None
+
+    big = kernel_plan("classic", 2048, 100_000, x_itemsize=2, cd_itemsize=2)
+    assert big.mode == "tiled"
+    assert big.k_tile and big.k_tile % 128 == 0
+    assert big.k_tile == max_k_tile("classic", 2048, 100_000,
+                                    x_itemsize=2, cd_itemsize=2)
+    assert "stream" in big.why
+
+    assert kernel_plan("classic", 2, 3).mode == "refuse"      # unalignable d
+    # x_stream alone overflows at block_rows=512: honest refusal, not a
+    # degenerate one-lane tile.
+    assert kernel_plan("classic", 8192, 8192,
+                       x_itemsize=4, cd_itemsize=4).mode == "refuse"
+
+
+def test_kernel_plan_padded_d_large_k():
+    """Satellite 4 plan half: the glove d=300 at extreme k used to die
+    at the resident-codebook gate; the plan now streams it (the pad
+    inflation cap stays a FLOP policy, the tiled footprint prices the
+    padded d=384)."""
+    plan = kernel_plan("classic", 300, 65536, x_itemsize=2, cd_itemsize=2)
+    assert plan.mode == "tiled" and plan.k_tile >= 128
+
+
+def test_kernel_plan_kind_footprints_order():
+    """delta/hamerly carry strictly more per-tile operands (signed fold,
+    second-min carry), so at the same overflowing shape their tile can
+    only be <= the classic one."""
+    kw = dict(x_itemsize=2, cd_itemsize=2)
+    ck = kernel_plan("classic", 2048, 65536, **kw)
+    dk = kernel_plan("delta", 2048, 65536, **kw)
+    hk = kernel_plan("hamerly", 2048, 65536, **kw)
+    assert ck.mode == dk.mode == hk.mode == "tiled"
+    assert dk.k_tile <= ck.k_tile and hk.k_tile <= dk.k_tile
+
+
+def test_caller_plans_fold_in_vetoes(rng):
+    """The per-kernel caller plans keep the platform / weight-exactness
+    vetoes and delegate shapes to the shared kernel_plan."""
+    from kmeans_tpu.ops.delta import delta_kernel_plan
+    from kmeans_tpu.ops.hamerly import hamerly_kernel_plan
+    from kmeans_tpu.ops.lloyd import _pallas_plan
+
+    x = jnp.zeros((256, 128), jnp.float32)
+    frac_w = jnp.asarray(rng.random(256).astype(np.float32))
+    for plan_fn in (
+        lambda **kw: _pallas_plan(x, 16, weights=kw.get("weights"),
+                                  weights_are_binary=False,
+                                  compute_dtype=kw.get("compute_dtype"),
+                                  platform=kw.get("platform", "tpu")),
+        lambda **kw: delta_kernel_plan(x, 16, **kw),
+        lambda **kw: hamerly_kernel_plan(x, 16, **kw),
+    ):
+        assert plan_fn(platform="tpu").mode != "refuse"
+        assert plan_fn(platform="cpu").mode == "refuse"
+        # Fractional weights in a bf16 one-hot are inexact: refuse.
+        p = plan_fn(platform="tpu", weights=frac_w,
+                    compute_dtype="bfloat16")
+        assert p.mode == "refuse" and isinstance(p, KernelPlan)
+
+
+# ------------------------------------------------------------- serving
+
+def test_serve_dense_scan_matches_argmin(rng, monkeypatch):
+    """The serve-side XLA twin of the tiled path: force the gate to
+    'tiled' and check the k-chunked scan produces exactly the resident
+    argmin's labels, lowest-index ties included (duplicate centroid
+    straddling the chunk edge)."""
+    import kmeans_tpu.ops.pallas_lloyd as pl
+    from kmeans_tpu.serve import assign
+
+    monkeypatch.setattr(
+        pl, "kernel_plan",
+        lambda kind, d, k, **kw: KernelPlan("tiled", 128, "forced (test)"))
+    assign._build_dense.cache_clear()
+    try:
+        rows, k, d = 32, 300, 64
+        fn = assign._build_dense(rows, k, d)
+        x = rng.normal(size=(rows, d)).astype(np.float32)
+        c = rng.normal(size=(k, d)).astype(np.float32)
+        c[128] = c[127]
+        x[:4] = c[127]
+        csq = (c.astype(np.float32) ** 2).sum(axis=1)
+        got = np.asarray(fn(jnp.asarray(x), jnp.asarray(c),
+                            jnp.asarray(csq)))
+        prod = x @ c.T
+        want = np.argmin(csq[None, :] - 2.0 * prod, axis=1)
+        np.testing.assert_array_equal(got, want)
+        assert (got[:4] == 127).all()
+    finally:
+        assign._build_dense.cache_clear()

@@ -25,7 +25,10 @@ Tracked series (direction ``up`` = higher is better):
   driver metric's two halves, per round (``BENCH_r*.json``) and per
   on-chip builder record (``BENCH_LOCAL_*.json``);
 * ``all.<config>.iters_per_s`` (+ ``.converge_s`` when recorded) — the
-  5-config table (``BENCH_ALL_latest.json``);
+  per-config table (``BENCH_ALL_latest.json``: the five BASELINE
+  shapes plus the extreme-k ``codebook`` stress config; ``codebook``
+  is seeded as a null placeholder until its first on-chip run, so the
+  MISSING gate covers it from day one);
 * ``serve.batched_qps`` / ``serve.batched_p99_ms`` / ``serve.speedup``
   — the serving evidence protocol (``BENCH_SERVE_latest.json``);
 * ``serve.open_p99_ms`` / ``serve.open_qps`` — the open-loop loadgen
@@ -355,7 +358,7 @@ def check(ledger: dict, *, tolerance: float = DEFAULT_TOLERANCE
 
     * **regression** — a series' newest non-null value is worse than its
       best-known value beyond ``tolerance`` (relative);
-    * **missing** — a series fed by a multi-series group (the 5-config
+    * **missing** — a series fed by a multi-series group (the per-config
       table, the serve protocol) has no entry at the group's newest
       round/timestamp: a config silently dropped from the latest
       artifact must fail, not fade out of the trajectory.
@@ -378,6 +381,21 @@ def check(ledger: dict, *, tolerance: float = DEFAULT_TOLERANCE
             newest_by_group[g] = newest
     for name in sorted(series):
         s = series[name]
+        if not s["entries"]:
+            continue
+        # Missing-ness is judged on ALL entries, nulls included: a
+        # series seeded with a null placeholder (a config awaiting its
+        # first on-chip measurement, e.g. ``all.codebook.*``) still
+        # pins the config into the group — if a later artifact drops
+        # it, the series goes stale at the old ts and MUST fail here,
+        # not fade out because it never had a measured value.
+        g = s.get("group", "?")
+        tail = s["entries"][-1]
+        if series_newest[name] < newest_by_group[g]:
+            failures.append(
+                f"MISSING {name}: no entry at the newest {g!r} artifact "
+                f"ingest — the series dropped out of the latest "
+                f"measurement (last seen {tail.get('ts') or tail.get('round')})")
         vals, _, best = series_stats(s)
         if not vals:
             continue
@@ -387,12 +405,6 @@ def check(ledger: dict, *, tolerance: float = DEFAULT_TOLERANCE
                 f"REGRESSION {name}: latest {last['value']} {s['unit']} "
                 f"({last.get('source')}) is worse than best-known {best} "
                 f"beyond the {tolerance:.0%} tolerance")
-        g = s.get("group", "?")
-        if series_newest[name] < newest_by_group[g]:
-            failures.append(
-                f"MISSING {name}: no entry at the newest {g!r} artifact "
-                f"ingest — the series dropped out of the latest "
-                f"measurement (last seen {last.get('ts') or last.get('round')})")
     return failures
 
 

@@ -1233,6 +1233,11 @@ def main():
     ap.add_argument("--converge", action="store_true",
                     help="only the wall-clock-of-a-full-fit metric "
                          "(k-means|| seeding + Lloyd to tol)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the serving evidence protocol instead "
+                         "(delegates to tools/loadgen.py --bench; writes "
+                         "BENCH_SERVE_latest.json with the JSON-vs-binary "
+                         "wire phases — no accelerator probe needed)")
     ap.add_argument("--accel", action="store_true",
                     help="accelerated-convergence evidence protocol: "
                          "plain Lloyd vs Anderson vs Anderson+nested "
@@ -1292,6 +1297,12 @@ def main():
                          "mid-computation blocks forever), emit the "
                          "carry-forward artifact line and exit")
     args = ap.parse_args()
+    if args.serve:
+        # Serving bench is CPU/host work — skip the accelerator probe
+        # and the carry-forward machinery entirely.
+        from tools import loadgen
+
+        raise SystemExit(loadgen.main(["--bench"]))
     if args.input is not None and args.k is None:
         ap.error("--input requires --k")
     if args.trace:

@@ -321,6 +321,18 @@ class ServeConfig:
     #: its own GEMMs (measured faster on this host); raise it for
     #: single-threaded-BLAS deployments (OPENBLAS_NUM_THREADS=1).
     assign_kernel_threads: int = 1
+    #: Backend for the closure-pruned candidate stage (ISSUE 12):
+    #: ``host`` = the grouped BLAS GEMM (measured 17x faster than the
+    #: gather formulation on XLA:CPU), ``device`` = the jitted
+    #: accelerator-resident candidate kernel
+    #: (:func:`kmeans_tpu.ops.hamerly.closure_assign_device` — a TPU
+    #: deployment keeps the batch on-device), ``auto`` = device only
+    #: when the jax runtime is already live in this process AND its
+    #: default backend is not CPU (auto never initializes jax itself,
+    #: preserving the pruned-only serve process's no-jax guarantee).
+    #: Both routes are exact: the same triangle-inequality certificate
+    #: gates both, and failing rows rescore densely.
+    assign_pruned_backend: str = "auto"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -79,7 +79,8 @@ from kmeans_tpu.ops.pallas_lloyd import (KernelPlan, kernel_plan,
 
 __all__ = ["hamerly_pass", "hamerly_pallas_ok", "hamerly_kernel_plan",
            "resolve_hamerly_backend",
-           "row_norms", "HAMERLY_MARGIN_REL", "closure_candidates"]
+           "row_norms", "HAMERLY_MARGIN_REL", "closure_candidates",
+           "closure_assign_device"]
 
 #: Relative soundness margin over the f32 dot-accumulation error bound
 #: (γ_d ≈ d·2⁻²⁴ ≈ 1.2e-4 at d=2048; the bound enters twice per dot and
@@ -206,6 +207,83 @@ def closure_candidates(centroids, *, n_groups: Optional[int] = None,
     else:
         thr = np.full((g_n,), np.inf, np.float32)
     return mu.astype(np.float32), cand, thr
+
+
+def closure_assign_device(x, gc, gsq, cand, csq_cand, thr, c, *,
+                          m_tile: int, margin_rel: float = HAMERLY_MARGIN_REL):
+    """Accelerator-side closure-pruned assignment: the device twin of the
+    serve layer's host grouped-GEMM kernel (ISSUE 12 — TPU deployments
+    want the batch to stay on-device; XLA:CPU keeps the host path, where
+    this gather formulation measures 17x slower than grouped BLAS).
+
+    Route each row to its nearest of G group centers, gather its group's
+    candidate list (``m`` per-group candidate centroids, distance-sorted
+    by :func:`closure_candidates`), and stream the candidates through an
+    ``m_tile``-chunked :func:`lax.scan` with a running ``(best, pos)``
+    carry — the same strict-< merge the k-tiled kernels use, so the
+    winning POSITION is the first minimum over the candidate list and
+    the label tie-break matches the host kernel's ``argmin`` exactly.
+    The triangle-inequality certificate is evaluated on-device too;
+    rows failing it rescore densely on the caller's side (pruning stays
+    exact, never approximate).
+
+    Args (all device arrays; shapes static under jit):
+      x (B, d) f32 padded batch; gc (G, d) group centers; gsq (G,) their
+      squared norms; cand (G, m) int32 candidate ids; csq_cand (G, m)
+      the candidates' squared norms; thr (G,) exclusion thresholds;
+      c (k, d) the centroids.
+
+    Returns ``(labels (B,) int32, ok (B,) bool)``.
+    """
+    n_b, _ = x.shape
+    m = cand.shape[1]
+    mt = max(1, min(int(m_tile), m))
+    f32 = jnp.float32
+    # Group routing: gsq - 2·x@gc.T (first-min argmin, like the host's).
+    sg = gsq[None, :] - 2.0 * jnp.matmul(
+        x, gc.T, preferred_element_type=f32)
+    g = jnp.argmin(sg, axis=1)
+    sg_best = jnp.min(sg, axis=1)
+    cand_g = cand[g]                                       # (B, m)
+    csq_g = csq_cand[g]                                    # (B, m)
+    n_tiles = -(-m // mt)
+    m_pad = n_tiles * mt
+    if m_pad != m:
+        # Padding slots carry +inf norms: their scores are +inf, and the
+        # strict-< merge can never take them over a real candidate.
+        cand_g = jnp.concatenate(
+            [cand_g, jnp.zeros((n_b, m_pad - m), jnp.int32)], axis=1)
+        csq_g = jnp.concatenate(
+            [csq_g, jnp.full((n_b, m_pad - m), jnp.inf, f32)], axis=1)
+    idx_t = cand_g.reshape(n_b, n_tiles, mt).transpose(1, 0, 2)
+    csq_t = csq_g.reshape(n_b, n_tiles, mt).transpose(1, 0, 2)
+
+    def body(carry, tile):
+        best, pos = carry
+        idx, q, off = tile
+        cc = c[idx]                                        # (B, mt, d)
+        prod = jnp.einsum("bmd,bd->bm", cc, x,
+                          preferred_element_type=f32)
+        part = q - 2.0 * prod
+        t_min = jnp.min(part, axis=1)
+        t_pos = jnp.argmin(part, axis=1).astype(jnp.int32) + off
+        take = t_min < best        # strict: ties keep the earlier slot
+        return (jnp.where(take, t_min, best),
+                jnp.where(take, t_pos, pos)), None
+
+    offs = jnp.arange(n_tiles, dtype=jnp.int32) * mt
+    init = (jnp.full((n_b,), jnp.inf, f32),
+            jnp.zeros((n_b,), jnp.int32))
+    (best, pos), _ = lax.scan(body, init, (idx_t, csq_t, offs))
+    labels = jnp.take_along_axis(cand_g, pos[:, None], axis=1)[:, 0]
+    # The certificate, same formula as the host kernel: with b the best
+    # candidate DISTANCE and dg the group-center distance, every
+    # excluded centroid is at least thr[g] - dg away.
+    xsq = jnp.einsum("bd,bd->b", x, x)
+    dg = jnp.sqrt(jnp.maximum(xsq + sg_best, 0.0))
+    b = jnp.sqrt(jnp.maximum(xsq + best, 0.0))
+    ok = b + margin_rel * (b + dg + 1.0) <= thr[g] - dg
+    return labels.astype(jnp.int32), ok
 
 
 def hamerly_kernel_plan(x, k: int, *, weights=None, weights_are_binary=False,

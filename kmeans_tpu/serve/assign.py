@@ -37,9 +37,25 @@ throughput without touching the hot-swap contract:
   gather (``c[cand[g]]`` + batched einsum) measures 17× slower than the
   dense matmul it was meant to beat, and ``lax.ragged_dot`` 10× slower
   (memory-bound gather / poor CPU lowering), while grouped BLAS beats
-  dense by ~2.7× and the per-request baseline by ~7× in points/s.  An
-  accelerator-resident grouped kernel is the natural next step
-  (ROADMAP); the dispatch seam is one function.
+  dense by ~2.7× and the per-request baseline by ~7× in points/s.  The
+  accelerator-resident formulation now exists too
+  (:func:`kmeans_tpu.ops.hamerly.closure_assign_device`: per-row
+  candidate gather streamed through an m-tiled ``lax.scan`` with the
+  same strict-< merge and certificate), behind a backend dispatch
+  (``ServeConfig.assign_pruned_backend``): ``auto`` keeps XLA:CPU on
+  the measured-faster host path and routes to the device kernel only
+  when a live jax runtime reports a non-CPU backend — a TPU serve
+  process keeps the batch on-device.
+* **Binary wire protocol** — the zero-copy framing for
+  ``POST /api/assign`` (``Content-Type: application/x-kmeans-points``;
+  docs/SERVING.md has the byte layout).  JSON float parsing dominated
+  HTTP-transport CPU at high point counts; the binary frame parses via
+  ``np.frombuffer`` into the micro-batcher with no per-float work at
+  all, and labels (+ optional distances) return as raw little-endian
+  arrays.  The codec lives here (:func:`encode_points` /
+  :func:`decode_points` / :func:`encode_labels` / :func:`decode_labels`
+  + :class:`WireError`); the HTTP layer negotiates on Content-Type and
+  keeps the JSON path untouched as the fallback.
 
 Hot-swap contract (PR 6, preserved exactly): the registry generation is
 read ONCE per coalesced batch; every request in the batch is answered
@@ -53,6 +69,7 @@ from __future__ import annotations
 import collections
 import functools
 import queue
+import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -69,6 +86,15 @@ __all__ = [
     "NoModelError",
     "QueueFullError",
     "AssignTimeoutError",
+    "WireError",
+    "encode_points",
+    "decode_points",
+    "encode_labels",
+    "decode_labels",
+    "WIRE_POINTS_CONTENT_TYPE",
+    "WIRE_LABELS_CONTENT_TYPE",
+    "WIRE_FLAG_DISTANCES",
+    "WIRE_VERSION",
 ]
 
 # ---------------------------------------------------------------------------
@@ -119,6 +145,20 @@ _FALLBACK_ROWS_TOTAL = obs.counter(
     "rescored by the dense kernel (pruning stays exact; this counts "
     "what it cost)",
 )
+WIRE_REQUESTS_TOTAL = obs.counter(
+    "kmeans_tpu_assign_wire_requests_total",
+    "POST /api/assign requests by negotiated wire format (binary = the "
+    "application/x-kmeans-points frame, json = the legacy object; "
+    "malformed frames count before they 400, so rejects are visible)",
+    labels=("format",),
+)
+WIRE_BYTES_TOTAL = obs.counter(
+    "kmeans_tpu_assign_wire_bytes_total",
+    "POST /api/assign body bytes by direction (rx = request payload "
+    "read, tx = response payload written), both wire formats — the "
+    "transport-cost denominator behind the binary protocol's win",
+    labels=("direction",),
+)
 
 #: Relative certificate margin: the pruned kernel's f32 distance error
 #: is ~1e-6·d relative; 1e-3 follows the same two-orders-of-magnitude
@@ -140,6 +180,145 @@ class QueueFullError(RuntimeError):
 class AssignTimeoutError(RuntimeError):
     """A request outlived ``assign_timeout_s`` waiting for its batch —
     pathological (a stalled kernel), surfaced as a 503."""
+
+
+# ---------------------------------------------------------------------------
+# Binary wire protocol (docs/SERVING.md has the byte-layout tables).
+# Versioned little-endian frames; the request payload is read zero-copy
+# via np.frombuffer (read-only is fine — the engine only reads rows),
+# so transport cost stops scaling with digits-per-float.
+# ---------------------------------------------------------------------------
+
+WIRE_POINTS_CONTENT_TYPE = "application/x-kmeans-points"
+WIRE_LABELS_CONTENT_TYPE = "application/x-kmeans-labels"
+
+#: Frame version both directions; a decoder seeing a higher version
+#: rejects loudly instead of misparsing a future layout.
+WIRE_VERSION = 1
+#: Payload dtype code: 1 = little-endian float32 (the only code v1
+#: speaks; the slot exists so f16/bf16 payloads can negotiate later).
+_WIRE_DTYPE_F32 = 1
+#: Request flag bit: client wants per-row distances to the assigned
+#: centroid appended to the response (raw f32, after the labels).
+WIRE_FLAG_DISTANCES = 0x1
+
+_WIRE_POINTS_MAGIC = b"KMPT"
+_WIRE_LABELS_MAGIC = b"KMLB"
+#: magic(4) version(u8) dtype(u8) flags(u16) n(u32) d(u32) = 16 bytes,
+#: then n*d f32 row-major points.
+_POINTS_HEADER = struct.Struct("<4sBBHII")
+#: magic(4) version(u8) dtype(u8) flags(u16) n(u32) k(u32)
+#: generation(u64) = 24 bytes, then n i32 labels (+ n f32 distances
+#: when the distances flag is set).
+_LABELS_HEADER = struct.Struct("<4sBBHIIQ")
+
+
+class WireError(ValueError):
+    """A malformed binary assign frame — truncated/oversized header
+    fields, wrong magic/version/dtype, payload length mismatch.  A
+    ValueError subclass so the HTTP layer's standard 400-with-JSON-error
+    mapping applies unchanged."""
+
+
+def encode_points(points, *, want_distances: bool = False) -> bytes:
+    """Client-side framing of an (n, d) float32 point matrix."""
+    x = np.ascontiguousarray(points, np.float32)
+    if x.ndim != 2 or x.shape[0] < 1 or x.shape[1] < 1:
+        raise WireError(
+            f"points must be a non-empty (n, d) matrix; got shape "
+            f"{tuple(x.shape)}")
+    flags = WIRE_FLAG_DISTANCES if want_distances else 0
+    return _POINTS_HEADER.pack(
+        _WIRE_POINTS_MAGIC, WIRE_VERSION, _WIRE_DTYPE_F32, flags,
+        x.shape[0], x.shape[1]) + x.tobytes()
+
+
+def decode_points(body: bytes, *, max_points: int = 0):
+    """Server-side parse of a points frame -> ``(x, flags)`` with ``x``
+    an (n, d) float32 view INTO ``body`` (zero-copy; read-only, which
+    the engine contract allows — it only reads request rows).  Raises
+    :class:`WireError` (-> HTTP 400) on any malformation, including a
+    header-declared ``n`` beyond ``max_points`` (a frame asking for an
+    unbounded distance computation is malformed, not merely large)."""
+    if len(body) < _POINTS_HEADER.size:
+        raise WireError(
+            f"truncated frame: {len(body)} bytes is shorter than the "
+            f"{_POINTS_HEADER.size}-byte points header")
+    magic, ver, dtype, flags, n, d = _POINTS_HEADER.unpack_from(body)
+    if magic != _WIRE_POINTS_MAGIC:
+        raise WireError(
+            f"bad magic {magic!r}: not an {WIRE_POINTS_CONTENT_TYPE} "
+            f"frame")
+    if ver != WIRE_VERSION:
+        raise WireError(
+            f"unsupported wire version {ver} (this server speaks "
+            f"version {WIRE_VERSION})")
+    if dtype != _WIRE_DTYPE_F32:
+        raise WireError(
+            f"unsupported payload dtype code {dtype} (version "
+            f"{WIRE_VERSION} speaks little-endian float32 = "
+            f"{_WIRE_DTYPE_F32})")
+    if n < 1 or d < 1:
+        raise WireError(
+            f"frame declares an empty point matrix (n={n}, d={d})")
+    if max_points and n > max_points:
+        raise WireError(
+            f"frame declares n={n} points; this server accepts at most "
+            f"{max_points} per request")
+    want = _POINTS_HEADER.size + 4 * n * d
+    if len(body) != want:
+        raise WireError(
+            f"payload length mismatch: header declares n={n} d={d} "
+            f"({want} bytes total), frame is {len(body)} bytes")
+    x = np.frombuffer(body, dtype="<f4", count=n * d,
+                      offset=_POINTS_HEADER.size).reshape(n, d)
+    return x, int(flags)
+
+
+def encode_labels(labels, *, generation: int, k: int,
+                  distances=None) -> bytes:
+    """Server-side framing of the assign response: raw i32 labels plus
+    optional raw f32 distances, with the generation the hot-swap
+    contract requires every response to report."""
+    lab = np.ascontiguousarray(labels, np.int32)
+    flags = WIRE_FLAG_DISTANCES if distances is not None else 0
+    out = _LABELS_HEADER.pack(
+        _WIRE_LABELS_MAGIC, WIRE_VERSION, _WIRE_DTYPE_F32, flags,
+        lab.shape[0], int(k), int(generation)) + lab.tobytes()
+    if distances is not None:
+        out += np.ascontiguousarray(distances, np.float32).tobytes()
+    return out
+
+
+def decode_labels(body: bytes):
+    """Client-side parse -> ``(labels, distances_or_None, generation,
+    k)``.  The symmetric half of :func:`encode_labels` (loadgen, tests,
+    and the docs/SERVING.md quickstart use it)."""
+    if len(body) < _LABELS_HEADER.size:
+        raise WireError(
+            f"truncated frame: {len(body)} bytes is shorter than the "
+            f"{_LABELS_HEADER.size}-byte labels header")
+    magic, ver, dtype, flags, n, k, generation = \
+        _LABELS_HEADER.unpack_from(body)
+    if magic != _WIRE_LABELS_MAGIC:
+        raise WireError(
+            f"bad magic {magic!r}: not an {WIRE_LABELS_CONTENT_TYPE} "
+            f"frame")
+    if ver != WIRE_VERSION or dtype != _WIRE_DTYPE_F32:
+        raise WireError(
+            f"unsupported labels frame (version {ver}, dtype {dtype})")
+    with_dist = bool(flags & WIRE_FLAG_DISTANCES)
+    want = _LABELS_HEADER.size + 4 * n * (2 if with_dist else 1)
+    if len(body) != want:
+        raise WireError(
+            f"payload length mismatch: header declares n={n} "
+            f"distances={with_dist} ({want} bytes), frame is "
+            f"{len(body)} bytes")
+    off = _LABELS_HEADER.size
+    lab = np.frombuffer(body, dtype="<i4", count=n, offset=off)
+    dist = (np.frombuffer(body, dtype="<f4", count=n, offset=off + 4 * n)
+            if with_dist else None)
+    return lab, dist, int(generation), int(k)
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +392,39 @@ def _build_dense(rows: int, k: int, d: int):
     from kmeans_tpu.obs import costmodel
 
     return costmodel.observe(jax.jit(kernel), name="serve.assign_dense")
+
+
+#: Element budget for the device candidate kernel's gathered
+#: ``(rows, m_tile, d)`` block (f32: 64 MB) — the m-tile streams the
+#: candidate gather the way the dense path's k-chunk scan streams the
+#: codebook, so one batch never materializes rows*m*d at once.
+_DEV_GATHER_ELEMS = 1 << 24
+
+
+@functools.lru_cache(maxsize=64)
+def _build_pruned_dev(rows: int, k: int, d: int, g_n: int, m: int):
+    """Jitted device-resident closure-pruned kernel for one padded batch
+    shape (ISSUE 12): group routing + per-row candidate gather streamed
+    through an m-tiled scan with the strict-< merge, certificate
+    included — :func:`kmeans_tpu.ops.hamerly.closure_assign_device` is
+    the math, this builder fixes the shapes and the m-tile.  Rows whose
+    certificate fails rescore densely on the host, exactly like the
+    host kernel's fallback (shared code in the engine)."""
+    import jax
+
+    from kmeans_tpu.ops.hamerly import closure_assign_device
+
+    m_tile = max(1, min(m, _DEV_GATHER_ELEMS // max(1, rows * d)))
+
+    def kernel(x, gc, gsq, cand, csq_cand, thr, c):
+        return closure_assign_device(
+            x, gc, gsq, cand, csq_cand, thr, c,
+            m_tile=m_tile, margin_rel=_CERT_MARGIN_REL)
+
+    from kmeans_tpu.obs import costmodel
+
+    return costmodel.observe(jax.jit(kernel),
+                             name="serve.assign_pruned_dev")
 
 
 def _score_groups(xs, bounds, prep, s_out, g_lo, g_hi):
@@ -320,13 +532,14 @@ class PreparedModel:
 
     __slots__ = ("gen", "k", "d", "csq", "pruned", "g_n", "m",
                  "gc", "gc2", "gsq", "cand", "csq_cand", "thr",
-                 "cand_mats2", "_dev")
+                 "cand_mats2", "_dev", "_pdev")
 
     def __init__(self, gen, *, prune_min_k: int = 256):
         self.gen = gen
         self.k, self.d = gen.k, gen.d
         self.csq = gen.sq_norms()
         self._dev = None
+        self._pdev = None
         self.pruned = bool(prune_min_k) and gen.k >= int(prune_min_k)
         if self.pruned:
             from kmeans_tpu.ops.hamerly import closure_candidates
@@ -357,6 +570,21 @@ class PreparedModel:
             self._dev = (jnp.asarray(self.gen.centroids),
                          jnp.asarray(self.csq))
         return self._dev
+
+    def pruned_dev(self):
+        """The closure tables on device for the device-resident pruned
+        kernel — ``(gc, gsq, cand, csq_cand, thr, centroids)``,
+        transferred once per generation (same lazy build-once contract
+        as :meth:`dense_dev`; only the dispatcher thread touches it)."""
+        if self._pdev is None:
+            import jax.numpy as jnp
+
+            self._pdev = (jnp.asarray(self.gc), jnp.asarray(self.gsq),
+                          jnp.asarray(self.cand),
+                          jnp.asarray(self.csq_cand),
+                          jnp.asarray(self.thr),
+                          jnp.asarray(self.gen.centroids))
+        return self._pdev
 
 
 class _Pending:
@@ -433,6 +661,7 @@ class AssignEngine:
         self._shape_hits = 0
         self._shape_misses = 0
         self._bucket_counts: collections.Counter = collections.Counter()
+        self._pruned_route_cached: Optional[str] = None
 
     # ------------------------------------------------------------ client
     def submit(self, points: np.ndarray):
@@ -645,15 +874,15 @@ class AssignEngine:
             b <<= 1
         return min(b, max(self._max_rows, rows))
 
-    def _dense_kernel(self, bucket: int, prep: PreparedModel):
+    def _cached_kernel(self, builder, *key):
         # Accounting reads the REAL lru_cache, not a shadow set: if the
         # builder cache ever evicts and retraces, that must show up as
         # a miss (the whole point of the metric).  The before/after
         # read is racy across concurrent dispatchers — at worst one
         # batch's hit/miss attribution swaps, never the totals' drift.
-        before = _build_dense.cache_info().misses
-        fn = _build_dense(bucket, prep.k, prep.d)
-        hit = _build_dense.cache_info().misses == before
+        before = builder.cache_info().misses
+        fn = builder(*key)
+        hit = builder.cache_info().misses == before
         with self._stats_lock:
             if hit:
                 self._shape_hits += 1
@@ -661,6 +890,38 @@ class AssignEngine:
                 self._shape_misses += 1
         _SHAPE_CACHE_TOTAL.labels(event="hit" if hit else "miss").inc()
         return fn
+
+    def _dense_kernel(self, bucket: int, prep: PreparedModel):
+        return self._cached_kernel(_build_dense, bucket, prep.k, prep.d)
+
+    def _pruned_route(self) -> str:
+        """``host`` | ``device`` — the pruned-stage backend dispatch
+        (ISSUE 12), resolved once per engine.  ``auto`` routes to the
+        device kernel only when the jax runtime is ALREADY imported in
+        this process and reports a non-CPU default backend: XLA:CPU
+        keeps the measured-17x-faster host grouped BLAS, and a
+        pruned-only CPU serve process keeps its no-jax-runtime
+        guarantee (auto never imports jax itself — on a TPU host the
+        dense path / training side has long since initialized it)."""
+        route = self._pruned_route_cached
+        if route is None:
+            mode = str(getattr(self.cfg, "assign_pruned_backend",
+                               "auto")).lower()
+            if mode in ("host", "device"):
+                route = mode
+            else:
+                import sys
+
+                jax_mod = sys.modules.get("jax")
+                route = "host"
+                if jax_mod is not None:
+                    try:
+                        if jax_mod.default_backend() != "cpu":
+                            route = "device"
+                    except Exception:
+                        route = "host"
+            self._pruned_route_cached = route
+        return route
 
     def _pad(self, x: np.ndarray, bucket: int) -> np.ndarray:
         if x.shape[0] == bucket:
@@ -737,8 +998,11 @@ class AssignEngine:
         with _tracing.span("assign.kernel", category="serve_kernel",
                            kernel=kind, rows=rows):
             if kind == "pruned":
-                labels, ok = _pruned_host(x, prep, pool=self._pool,
-                                          chunks=self._kernel_threads)
+                if self._pruned_route() == "device":
+                    labels, ok = self._pruned_device(prep, x, rows)
+                else:
+                    labels, ok = _pruned_host(x, prep, pool=self._pool,
+                                              chunks=self._kernel_threads)
                 bad = np.flatnonzero(~ok)
                 if bad.size:
                     # Certificate failures rescore densely: pruning is
@@ -758,3 +1022,18 @@ class AssignEngine:
             c_dev, csq_dev = prep.dense_dev()
             return np.asarray(fn(self._pad(x, bucket), c_dev,
                                  csq_dev))[:rows]
+
+    def _pruned_device(self, prep: PreparedModel, x: np.ndarray,
+                       rows: int) -> Tuple[np.ndarray, np.ndarray]:
+        """The device-resident candidate kernel path: pad to the bucket
+        ladder (same compiled-shape discipline as the dense path),
+        dispatch the jitted gather-scan kernel, hand back host arrays
+        for the shared certificate-fallback rescore.  Labels copy out
+        because the fallback writes into them (np views of device
+        buffers are read-only)."""
+        bucket = self._bucket(rows)
+        fn = self._cached_kernel(_build_pruned_dev, bucket, prep.k,
+                                 prep.d, prep.g_n, prep.m)
+        labels, ok = fn(self._pad(x, bucket), *prep.pruned_dev())
+        return (np.array(labels[:rows], np.int32),
+                np.asarray(ok)[:rows])

@@ -337,6 +337,20 @@ class _Room:
         })
 
 
+class _BackloggedHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a serving-grade accept backlog.
+
+    socketserver's default ``request_queue_size`` is 5: clients that
+    open a connection per request (urllib, curl) at a few hundred QPS
+    overflow it and see kernel RSTs — measured as connection-reset
+    drops in the binary-wire loadgen phases.  The listen queue is
+    bounded by the kernel's somaxconn anyway; 128 covers the burst of
+    a reconnecting worker pool without unbounded accept debt.
+    """
+
+    request_queue_size = 128
+
+
 class KMeansServer:
     """All rooms + the HTTP server object.
 
@@ -1333,6 +1347,16 @@ class KMeansServer:
                 queued request sees, and nothing is ever dropped for a
                 swap.  The direct path reads it once per request, as
                 before.
+
+                Wire negotiation (ISSUE 12): Content-Type
+                ``application/x-kmeans-points`` selects the binary frame
+                both ways (zero-copy ``np.frombuffer`` parse, raw i32
+                labels + optional f32 distances back as
+                ``application/x-kmeans-labels``); anything else takes
+                the legacy JSON path, byte-for-byte unchanged.
+                Malformed binary frames raise :class:`WireError` — a
+                ValueError, so the standard 400 + JSON error body
+                applies (binary clients still get parseable errors).
                 """
                 import numpy as np
 
@@ -1350,18 +1374,31 @@ class KMeansServer:
                     # erroring.
                     return self._busy("no model generation published yet; "
                                       "retry shortly")
-                body = self._body()
-                pts = body.get("points")
-                if not isinstance(pts, list) or not pts:
-                    raise ValueError("points must be a non-empty list of "
-                                     "rows")
-                cap = int(server.config.assign_max_points)
-                if len(pts) > cap:
-                    raise PayloadTooLargeError(
-                        f"assign accepts at most {cap} points per "
-                        f"request, got {len(pts)}"
-                    )
-                x = np.asarray(pts, np.float32)
+                ctype = (self.headers.get("Content-Type") or "")
+                ctype = ctype.split(";", 1)[0].strip().lower()
+                binary = ctype == serve_assign.WIRE_POINTS_CONTENT_TYPE
+                raw = self._read_bounded()
+                serve_assign.WIRE_REQUESTS_TOTAL.labels(
+                    format="binary" if binary else "json").inc()
+                serve_assign.WIRE_BYTES_TOTAL.labels(
+                    direction="rx").inc(len(raw))
+                flags = 0
+                if binary:
+                    x, flags = serve_assign.decode_points(
+                        raw, max_points=int(server.config.assign_max_points))
+                else:
+                    body = json.loads(raw) if raw else {}
+                    pts = body.get("points")
+                    if not isinstance(pts, list) or not pts:
+                        raise ValueError("points must be a non-empty list "
+                                         "of rows")
+                    cap = int(server.config.assign_max_points)
+                    if len(pts) > cap:
+                        raise PayloadTooLargeError(
+                            f"assign accepts at most {cap} points per "
+                            f"request, got {len(pts)}"
+                        )
+                    x = np.asarray(pts, np.float32)
                 if x.ndim != 2 or x.shape[1] != gen.d:
                     raise ValueError(
                         f"points must be (n, {gen.d}) for generation "
@@ -1382,16 +1419,40 @@ class KMeansServer:
                 serve_assign.ASSIGN_REQUEST_SECONDS.labels(
                     path=path).observe(time.perf_counter() - t0)
                 _ASSIGN_POINTS_TOTAL.inc(x.shape[0])
-                return self._json({
+                if binary:
+                    dist = None
+                    if flags & serve_assign.WIRE_FLAG_DISTANCES:
+                        # Distances computed HERE, not in the engine: the
+                        # engine's return contract stays labels-only, and
+                        # only clients that set the flag pay the extra
+                        # O(n·d) pass.
+                        diff = x - gen_used.centroids[labels]
+                        dist = np.sqrt(np.einsum("nd,nd->n", diff, diff,
+                                                 dtype=np.float32))
+                    frame = serve_assign.encode_labels(
+                        labels, generation=gen_used.generation,
+                        k=gen_used.k, distances=dist)
+                    serve_assign.WIRE_BYTES_TOTAL.labels(
+                        direction="tx").inc(len(frame))
+                    self._headers_for(
+                        serve_assign.WIRE_LABELS_CONTENT_TYPE,
+                        length=len(frame))
+                    self.wfile.write(frame)
+                    return
+                payload = json.dumps({
                     "labels": [int(v) for v in labels],
                     "generation": gen_used.generation,
                     "k": gen_used.k,
-                })
+                }).encode()
+                serve_assign.WIRE_BYTES_TOTAL.labels(
+                    direction="tx").inc(len(payload))
+                self._headers_for("application/json", length=len(payload))
+                self.wfile.write(payload)
 
         return Handler
 
     def start(self, *, background: bool = True) -> ThreadingHTTPServer:
-        self.httpd = ThreadingHTTPServer(
+        self.httpd = _BackloggedHTTPServer(
             (self.config.host, self.config.port), self.make_handler()
         )
         # The tracer hold rides start()/stop(), NOT construction (a

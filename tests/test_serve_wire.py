@@ -1,0 +1,263 @@
+"""Binary wire protocol + device-resident candidate kernel (ISSUE 12,
+docs/SERVING.md): codec round-trips, malformed-frame hardening (every
+reject is a 400 with a JSON error body), content negotiation leaving
+legacy JSON clients byte-compatible, the wire metrics counters, and
+host-vs-device bit-exact labels for the closure-pruned stage."""
+
+import dataclasses
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import ServeConfig
+from kmeans_tpu.continuous.registry import Generation, ModelRegistry
+from kmeans_tpu.serve import KMeansServer
+from kmeans_tpu.serve import assign as A
+
+
+def _cfg(**kw):
+    return dataclasses.replace(
+        ServeConfig(host="127.0.0.1", port=0, tracing=False), **kw)
+
+
+def _engine(gen_or_fn, **kw):
+    fn = gen_or_fn if callable(gen_or_fn) else (lambda: gen_or_fn)
+    return A.AssignEngine(fn, _cfg(**kw))
+
+
+def _post_raw(base, data, ctype):
+    """POST raw bytes; returns (status, body_bytes, content_type)."""
+    req = urllib.request.Request(
+        base + "/api/assign", data=data,
+        headers={"Content-Type": ctype}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read(), r.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+@pytest.fixture()
+def wire_server():
+    reg = ModelRegistry()
+    s = KMeansServer(_cfg(assign_max_points=64), registry=reg)
+    httpd = s.start(background=True)
+    s.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    s.reg = reg
+    yield s
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Codec round-trips (no server)
+# ---------------------------------------------------------------------------
+
+def test_points_codec_round_trip_is_zero_copy():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    body = A.encode_points(x)
+    assert len(body) == 16 + 4 * 12
+    got, flags = A.decode_points(body)
+    assert flags == 0
+    np.testing.assert_array_equal(got, x)
+    # Zero-copy contract: the decoded matrix is a VIEW into the frame
+    # bytes (read-only is fine — the engine only reads request rows).
+    assert got.base is not None and not got.flags.writeable
+
+
+def test_points_codec_distances_flag_round_trips():
+    x = np.ones((2, 2), np.float32)
+    _, flags = A.decode_points(A.encode_points(x, want_distances=True))
+    assert flags & A.WIRE_FLAG_DISTANCES
+
+
+def test_labels_codec_round_trip_with_and_without_distances():
+    lab = np.array([3, 0, 7], np.int32)
+    got, dist, gen, k = A.decode_labels(
+        A.encode_labels(lab, generation=12, k=9))
+    np.testing.assert_array_equal(got, lab)
+    assert dist is None and gen == 12 and k == 9
+
+    d = np.array([0.5, 1.5, 2.5], np.float32)
+    got, dist, gen, k = A.decode_labels(
+        A.encode_labels(lab, generation=3, k=8, distances=d))
+    np.testing.assert_array_equal(got, lab)
+    np.testing.assert_array_equal(dist, d)
+    assert gen == 3 and k == 8
+
+
+def test_decode_points_rejects_malformed_frames():
+    good = A.encode_points(np.ones((2, 3), np.float32))
+    cases = [
+        good[:10],                                   # truncated header
+        b"XXXX" + good[4:],                          # bad magic
+        good[:4] + b"\x09" + good[5:],               # bad version
+        good[:5] + b"\x07" + good[6:],               # bad dtype
+        good[:-4],                                   # payload too short
+        good + b"\x00" * 4,                          # payload too long
+    ]
+    for body in cases:
+        with pytest.raises(A.WireError):
+            A.decode_points(body)
+    with pytest.raises(A.WireError):
+        A.decode_points(good, max_points=1)          # oversized n
+    # WireError IS a ValueError: that is what routes it onto the
+    # server's existing 400 path.
+    assert issubclass(A.WireError, ValueError)
+
+
+# ---------------------------------------------------------------------------
+# HTTP: negotiation, hardening, metrics
+# ---------------------------------------------------------------------------
+
+def test_binary_http_round_trip_matches_engine(wire_server):
+    c, _ = np.random.RandomState(0).randn(32, 4).astype(np.float32), None
+    wire_server.reg.publish(c)
+    x = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    st, body, ctype = _post_raw(wire_server.base, A.encode_points(x),
+                                A.WIRE_POINTS_CONTENT_TYPE)
+    assert st == 200 and ctype == A.WIRE_LABELS_CONTENT_TYPE
+    lab, dist, gen, k = A.decode_labels(body)
+    assert dist is None and gen == 1 and k == 32
+    ref = A.assign_direct(wire_server.reg.current(), x)
+    np.testing.assert_array_equal(lab, ref)
+
+
+def test_binary_http_distances_flag_returns_euclidean(wire_server):
+    c = np.eye(4, dtype=np.float32) * 3
+    wire_server.reg.publish(c)
+    x = np.zeros((2, 4), np.float32)
+    x[1, 0] = 3.0
+    st, body, _ = _post_raw(
+        wire_server.base, A.encode_points(x, want_distances=True),
+        A.WIRE_POINTS_CONTENT_TYPE)
+    assert st == 200
+    lab, dist, _, _ = A.decode_labels(body)
+    want = np.sqrt(((x - c[lab]) ** 2).sum(1)).astype(np.float32)
+    np.testing.assert_allclose(dist, want, rtol=1e-6)
+
+
+def test_json_clients_see_the_legacy_response_unchanged(wire_server):
+    """Content negotiation must not disturb old clients: same status,
+    same Content-Type, exactly the same three response keys."""
+    wire_server.reg.publish(np.zeros((2, 3), np.float32))
+    req = urllib.request.Request(
+        wire_server.base + "/api/assign",
+        data=json.dumps({"points": [[0, 0, 0], [1, 1, 1]]}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+        assert r.headers.get("Content-Type") == "application/json"
+        out = json.loads(r.read())
+    assert set(out) == {"labels", "generation", "k"}
+    assert out["labels"] == [0, 0] and out["k"] == 2
+
+
+def test_malformed_binary_frames_get_400_with_json_error(wire_server):
+    wire_server.reg.publish(np.zeros((2, 3), np.float32))
+    good = A.encode_points(np.ones((2, 3), np.float32))
+    bad_frames = [
+        good[:10],                           # truncated header
+        b"XXXX" + good[4:],                  # bad magic
+        good[:4] + b"\x09" + good[5:],       # unknown version
+        good[:5] + b"\x07" + good[6:],       # unknown dtype
+        good[:-4],                           # length mismatch
+        A.encode_points(np.ones((65, 3), np.float32)),   # n > cap (64)
+        A.encode_points(np.full((2, 3), np.nan, np.float32)),  # nonfinite
+        A.encode_points(np.ones((2, 5), np.float32)),    # wrong d
+    ]
+    for frame in bad_frames:
+        st, body, ctype = _post_raw(wire_server.base, frame,
+                                    A.WIRE_POINTS_CONTENT_TYPE)
+        assert st == 400, frame[:16]
+        assert ctype == "application/json"
+        assert "error" in json.loads(body)
+
+
+def test_wire_metrics_count_both_formats(wire_server):
+    wire_server.reg.publish(np.zeros((2, 3), np.float32))
+    frame = A.encode_points(np.ones((2, 3), np.float32))
+    _post_raw(wire_server.base, frame, A.WIRE_POINTS_CONTENT_TYPE)
+    _post_raw(wire_server.base,
+              json.dumps({"points": [[0, 0, 0]]}).encode(),
+              "application/json")
+    with urllib.request.urlopen(wire_server.base + "/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    assert 'kmeans_tpu_assign_wire_requests_total{format="binary"}' in text
+    assert 'kmeans_tpu_assign_wire_requests_total{format="json"}' in text
+    assert 'kmeans_tpu_assign_wire_bytes_total{direction="rx"}' in text
+    assert 'kmeans_tpu_assign_wire_bytes_total{direction="tx"}' in text
+
+
+# ---------------------------------------------------------------------------
+# Device-resident candidate kernel: bit-exact vs host grouped BLAS
+# ---------------------------------------------------------------------------
+
+def _int_valued(k, d, n, seed=0):
+    """Small-integer-valued f32 data: every dot product is exact in
+    f32, so host BLAS and XLA compute IDENTICAL scores — argmin ties
+    included — and label equality is a bit-level statement."""
+    rng = np.random.RandomState(seed)
+    c = rng.randint(-8, 8, size=(k, d)).astype(np.float32)
+    x = rng.randint(-8, 8, size=(n, d)).astype(np.float32)
+    return c, x
+
+
+def test_device_kernel_labels_bit_exact_vs_host():
+    k, d = 64, 8
+    c, x = _int_valued(k, d, 200, seed=4)
+    gen = Generation(c, 1)
+    ref = ((x * x).sum(1)[:, None] - 2.0 * (x @ c.T)
+           + (c * c).sum(1)[None, :]).argmin(1).astype(np.int32)
+    got = {}
+    for backend in ("host", "device"):
+        eng = _engine(gen, assign_prune_min_k=16,
+                      assign_pruned_backend=backend)
+        try:
+            labels, g = eng.submit(x)
+            assert g.generation == 1
+            got[backend] = np.asarray(labels)
+        finally:
+            eng.stop()
+    # Bit-exact across backends — and both equal the dense argmin with
+    # NumPy's lowest-index tie-break (integer data makes this exact).
+    np.testing.assert_array_equal(got["host"], got["device"])
+    np.testing.assert_array_equal(got["device"], ref)
+
+
+def test_device_kernel_exact_on_adversarial_float_data():
+    """Certificate-failing rows rescore densely on both backends, so
+    final labels agree even on uniform float data."""
+    k, d = 64, 8
+    rng = np.random.RandomState(11)
+    c = rng.randn(k, d).astype(np.float32)
+    x = rng.randn(128, d).astype(np.float32) * 30
+    gen = Generation(c, 1)
+    out = {}
+    for backend in ("host", "device"):
+        eng = _engine(gen, assign_prune_min_k=16,
+                      assign_pruned_backend=backend)
+        try:
+            labels, _ = eng.submit(x)
+            d_got = ((x - c[labels]) ** 2).sum(1)
+            out[backend] = d_got
+        finally:
+            eng.stop()
+    d_ref = ((x * x).sum(1)[:, None] - 2.0 * (x @ c.T)
+             + (c * c).sum(1)[None, :]).min(1)
+    for backend, d_got in out.items():
+        np.testing.assert_allclose(d_got, d_ref, rtol=1e-4, atol=1e-3)
+
+
+def test_auto_backend_stays_on_host_for_cpu_jax():
+    """The acceptance contract: auto dispatch leaves XLA:CPU (and
+    jax-less processes) on the measured-faster host grouped BLAS."""
+    c, x = _int_valued(64, 8, 16, seed=5)
+    eng = _engine(Generation(c, 1), assign_prune_min_k=16)   # auto
+    try:
+        eng.submit(x)
+        assert eng._pruned_route() == "host"
+    finally:
+        eng.stop()

@@ -15,6 +15,13 @@ transports:
   from worker threads (the engine's own cost, no socket/JSON overhead);
   ``http`` POSTs real JSON over real sockets (add ``--base`` to aim at
   an external server instead of the built-in one).
+* **wire formats** (``--wire json|binary``, ISSUE 12): ``binary``
+  speaks the ``application/x-kmeans-points`` frame from
+  ``kmeans_tpu.serve.assign`` — raw little-endian f32 payload, raw
+  i32 labels back — on both transports (inproc runs the codec
+  round-trip without sockets, so framing cost is measured even where
+  there is no wire).  Client-side encoding happens OUTSIDE the timed
+  window on http, same as the JSON path.
 
 ``--bench`` runs the committed evidence protocol (ISSUE 7), closed
 loop at k=1000, d=300, all under the same harness:
@@ -29,7 +36,13 @@ loop at k=1000, d=300, all under the same harness:
    conflated with the norm-caching fix;
 3. ``batched`` — the engine;
 4. ``hot_swap`` — the engine under full load with a generation
-   published every 250 ms; zero dropped requests required.
+   published every 250 ms; zero dropped requests required;
+5. ``http_json`` / ``http_binary`` — the engine over real sockets at
+   ``--points-http`` rows/request (default 512), JSON vs the binary
+   frame: the transport-cost comparison the ISSUE 12 gate reads
+   (binary QPS >= 2x JSON at >= 256 points/request, p99 no worse);
+6. ``hot_swap_binary`` — the swap drill repeated over the binary
+   HTTP path; zero drops required there too.
 
 Writes ``BENCH_SERVE_latest.json``; render it with
 ``python tools/bench_table.py --serve``.
@@ -55,6 +68,7 @@ import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 import numpy as np
@@ -68,6 +82,11 @@ if _REPO not in sys.path:
 #: drill.
 GATE_SPEEDUP = 5.0
 GATE_MAX_DROPPED = 0
+
+#: ISSUE 12 gate: binary-wire HTTP QPS >= this multiple of JSON HTTP
+#: QPS at >= 256 points/request, with p99 no worse and zero drops
+#: across the binary hot-swap drill.
+GATE_BINARY_SPEEDUP = 2.0
 
 
 def _make_data(k: int, d: int, n: int, seed: int = 0):
@@ -152,19 +171,62 @@ def _send_inproc(server, pts):
         return f"unavailable: {e}"
 
 
-def _send_http(base, body):
-    req = urllib.request.Request(
-        base + "/api/assign", data=body,
-        headers={"Content-Type": "application/json"}, method="POST")
-    try:
-        with urllib.request.urlopen(req, timeout=30) as r:
-            r.read()
-            return "ok" if r.status == 200 else f"status {r.status}"
-    except urllib.error.HTTPError as e:
-        e.read()
-        return f"status {e.code}"
-    except OSError as e:
-        return f"io: {e}"
+class _HttpClient:
+    """Per-worker keep-alive connection (the server speaks HTTP/1.1
+    with Content-Length on every response): one TCP connect per
+    worker, not per request.  Per-request connections measure handshake
+    churn instead of wire cost and overflow the accept backlog at a few
+    hundred QPS (kernel RSTs counted as drops).  One reconnect+resend
+    per request on a dead persistent connection — the standard client
+    move for an idempotent POST whose keep-alive peer went away."""
+
+    def __init__(self, base, ctype="application/json"):
+        u = urllib.parse.urlparse(base)
+        self._addr = (u.hostname, u.port)
+        self._ctype = ctype
+        self._conn = None
+
+    def send(self, body):
+        import http.client
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    *self._addr, timeout=30)
+            try:
+                self._conn.request(
+                    "POST", "/api/assign", body=body,
+                    headers={"Content-Type": self._ctype})
+                r = self._conn.getresponse()
+                r.read()
+                return ("ok" if r.status == 200
+                        else f"status {r.status}")
+            except (http.client.HTTPException, OSError) as e:
+                self._conn.close()
+                self._conn = None
+                if attempt:
+                    return f"io: {e}"
+        return "io: unreachable"
+
+
+def binary_inproc_sender(server):
+    """Binary framing without sockets: encode the points frame, decode
+    it zero-copy (exactly the server handler's parse), run the engine,
+    then frame + parse the labels response — so ``--transport inproc
+    --wire binary`` measures the codec's cost in isolation."""
+    from kmeans_tpu.serve import assign as sa
+
+    def send(pts):
+        x, _ = sa.decode_points(sa.encode_points(pts))
+        try:
+            labels, gen, _path = server.assign_points(x)
+        except (sa.NoModelError, sa.QueueFullError,
+                sa.AssignTimeoutError) as e:
+            return f"unavailable: {e}"
+        sa.decode_labels(sa.encode_labels(
+            labels, generation=gen.generation, k=gen.k))
+        return "ok"
+
+    return send
 
 
 def legacy_sender(server):
@@ -203,11 +265,18 @@ def _engine_stats_delta(before: dict, after: dict) -> dict:
 
 
 def run_load(server, base, queries, *, points: int, duration: float,
-             concurrency: int, rate: float = 0.0, sender=None) -> dict:
+             concurrency: int, rate: float = 0.0, sender=None,
+             wire: str = "json") -> dict:
     """One measured window; closed loop unless ``rate`` > 0.
     ``sender`` overrides the default transport (a callable
-    ``pts -> "ok" | error-string``)."""
+    ``pts -> "ok" | error-string``).  ``wire="binary"`` switches the
+    http transport to the ISSUE 12 frame (ignored when ``sender`` is
+    given; pass :func:`binary_inproc_sender` for inproc binary)."""
     res = _Result()
+    encode = ctype = None
+    if wire == "binary" and base is not None and sender is None:
+        from kmeans_tpu.serve import assign as sa
+        encode, ctype = sa.encode_points, sa.WIRE_POINTS_CONTENT_TYPE
     if points > queries.shape[0]:
         # Silently sending fewer rows than requested would overstate
         # points/s (the accounting multiplies by `points`).
@@ -225,6 +294,8 @@ def run_load(server, base, queries, *, points: int, duration: float,
         rng = np.random.RandomState(1000 + wid)
         lats, ok, dropped, late, errors = [], 0, 0, 0, []
         body = None
+        client = (_HttpClient(base, ctype or "application/json")
+                  if base is not None and sender is None else None)
         while True:
             now = time.perf_counter()
             if now >= stop:
@@ -245,15 +316,16 @@ def run_load(server, base, queries, *, points: int, duration: float,
             pts = queries[off:off + points]
             if base is not None and sender is None:
                 # Serialize OUTSIDE the timed window: client-side
-                # json.dumps is loadgen cost, not server latency.
-                body = json.dumps({"points": pts.tolist()}).encode()
+                # encoding is loadgen cost, not server latency.
+                body = (encode(pts) if encode is not None
+                        else json.dumps({"points": pts.tolist()}).encode())
             t0 = time.perf_counter()
             if sender is not None:
                 out = sender(pts)
             elif base is None:
                 out = _send_inproc(server, pts)
             else:
-                out = _send_http(base, body)
+                out = client.send(body)
             lat = time.perf_counter() - t0
             if out == "ok":
                 ok += 1
@@ -317,6 +389,7 @@ def run_bench(args) -> int:
         "params": {"k": k, "d": d, "points_per_request": points,
                    "concurrency": conc, "duration_s": dur,
                    "transport": "inproc",
+                   "points_per_request_http": args.points_http,
                    "swap_interval_s": args.swap_every},
     }
 
@@ -357,17 +430,55 @@ def run_bench(args) -> int:
         reg.generation - gen_before
     server.stop()
 
+    ph = args.points_http
+    print(f"[loadgen] HTTP transport: JSON vs binary wire at "
+          f"n/req={ph}", file=sys.stderr)
+    server, reg, base, x = _make_server(k, d, batching=True,
+                                        seed=args.seed, http=True)
+    run_load(server, base, x, points=ph, duration=0.5,
+             concurrency=conc)        # warmup (closure tables + jit)
+    record["http_json"] = run_load(server, base, x, points=ph,
+                                   duration=dur, concurrency=conc)
+    record["http_binary"] = run_load(server, base, x, points=ph,
+                                     duration=dur, concurrency=conc,
+                                     wire="binary")
+
+    print("[loadgen] hot-swap drill over the binary HTTP path",
+          file=sys.stderr)
+    stop_evt = threading.Event()
+    gen_before = reg.generation
+    _swap_thread(reg, args.swap_every, stop_evt)
+    record["hot_swap_binary"] = run_load(server, base, x, points=ph,
+                                         duration=dur, concurrency=conc,
+                                         wire="binary")
+    stop_evt.set()
+    record["hot_swap_binary"]["generations_published"] = \
+        reg.generation - gen_before
+    server.stop()
+
     legacy_qps = record["per_request_legacy"]["qps"] or 1e-9
     cached_qps = record["per_request_cached"]["qps"] or 1e-9
     record["speedup"] = round(record["batched"]["qps"] / legacy_qps, 2)
     record["speedup_vs_cached"] = round(
         record["batched"]["qps"] / cached_qps, 2)
+    json_http_qps = record["http_json"]["qps"] or 1e-9
+    record["binary_speedup"] = round(
+        record["http_binary"]["qps"] / json_http_qps, 2)
     gates = {
         "speedup_min": GATE_SPEEDUP,
         "speedup_ok": record["speedup"] >= GATE_SPEEDUP,
         "swap_dropped": record["hot_swap"]["dropped"],
         "swap_ok": (record["hot_swap"]["dropped"] <= GATE_MAX_DROPPED
                     and record["hot_swap"]["generations_published"] > 0),
+        "binary_speedup_min": GATE_BINARY_SPEEDUP,
+        "binary_speedup_ok": (record["binary_speedup"]
+                              >= GATE_BINARY_SPEEDUP),
+        "binary_p99_ok": (record["http_binary"]["p99_ms"]
+                          <= record["http_json"]["p99_ms"]),
+        "binary_swap_dropped": record["hot_swap_binary"]["dropped"],
+        "binary_swap_ok": (
+            record["hot_swap_binary"]["dropped"] <= GATE_MAX_DROPPED
+            and record["hot_swap_binary"]["generations_published"] > 0),
     }
     record["gates"] = gates
     out = args.out or os.path.join(_REPO, "BENCH_SERVE_latest.json")
@@ -382,8 +493,15 @@ def run_bench(args) -> int:
         "batched_qps": record["batched"]["qps"],
         "batched_p99_ms": record["batched"]["p99_ms"],
         "swap_dropped": gates["swap_dropped"],
+        "http_json_qps": record["http_json"]["qps"],
+        "http_binary_qps": record["http_binary"]["qps"],
+        "binary_speedup": record["binary_speedup"],
+        "binary_p99_ms": record["http_binary"]["p99_ms"],
+        "binary_swap_dropped": gates["binary_swap_dropped"],
         "artifact": out}))
-    if not (gates["speedup_ok"] and gates["swap_ok"]):
+    if not (gates["speedup_ok"] and gates["swap_ok"]
+            and gates["binary_speedup_ok"] and gates["binary_p99_ok"]
+            and gates["binary_swap_ok"]):
         print(f"[loadgen] GATES FAILED: {gates}", file=sys.stderr)
         return 1
     return 0
@@ -410,34 +528,68 @@ def run_smoke(args) -> int:
     SLO bound with zero drops: the open-loop latency tripwire ROADMAP
     item 2c asks CI to hold.
     """
+    from kmeans_tpu.serve import assign as sa
+
     open_loop = args.mode == "open"
+    # The http listener always starts: the binary-wire smoke below
+    # exercises real-socket framing regardless of the main window's
+    # --transport (inproc callers still measure inproc).
     server, reg, base, x = _make_server(
-        32, 8, batching=True, seed=args.seed,
-        http=(args.transport == "http"))
+        32, 8, batching=True, seed=args.seed, http=True)
+    base_main = base if args.transport == "http" else None
     try:
         stop_evt = threading.Event()
         _swap_thread(reg, 0.3, stop_evt)
         if open_loop:
             # Warmup outside the measured window: the first batch pays
             # the jit compile, which would otherwise own the p99.
-            run_load(server, base, x, points=8, duration=0.4,
+            run_load(server, base_main, x, points=8, duration=0.4,
                      concurrency=4)
-            out = run_load(server, base, x, points=8, duration=1.2,
+            out = run_load(server, base_main, x, points=8, duration=1.2,
                            concurrency=4, rate=SMOKE_OPEN_RATE)
         else:
-            out = run_load(server, base, x, points=8, duration=1.2,
+            out = run_load(server, base_main, x, points=8, duration=1.2,
                            concurrency=4)
         stop_evt.set()
+
+        # Binary wire smoke (ISSUE 12), swaps stopped so the round-trip
+        # comparison below is against a stable generation: short
+        # windows on both transports, then one framed POST whose
+        # decoded labels must match the engine exactly.
+        bin_in = run_load(server, None, x, points=8, duration=0.3,
+                          concurrency=2,
+                          sender=binary_inproc_sender(server))
+        bin_http = run_load(server, base, x, points=8, duration=0.3,
+                            concurrency=2, wire="binary")
+        pts = x[:16]
+        req = urllib.request.Request(
+            base + "/api/assign", data=sa.encode_points(
+                pts, want_distances=True),
+            headers={"Content-Type": sa.WIRE_POINTS_CONTENT_TYPE},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            lab, dist, _gen, _k = sa.decode_labels(r.read())
+        want, _gu, _path = server.assign_points(pts)
+        wire_exact = (np.array_equal(lab, np.asarray(want))
+                      and dist is not None and dist.shape == (16,)
+                      and bool(np.isfinite(dist).all()))
     finally:
         server.stop()
     eng = out.get("engine", {})
     ok = (out["ok"] > 0 and out["dropped"] == 0
           and eng.get("batches", 0) > 0
-          and reg.generation > 1)
+          and reg.generation > 1
+          and bin_in["ok"] > 0 and bin_in["dropped"] == 0
+          and bin_http["ok"] > 0 and bin_http["dropped"] == 0
+          and wire_exact)
     rec = {"smoke_ok": ok, "mode": args.mode, "qps": out["qps"],
            "ok": out["ok"], "dropped": out["dropped"],
            "batches": eng.get("batches"),
-           "generations": reg.generation}
+           "generations": reg.generation,
+           "binary_inproc_ok": bin_in["ok"],
+           "binary_http_ok": bin_http["ok"],
+           "binary_dropped": bin_in["dropped"] + bin_http["dropped"],
+           "wire_exact": wire_exact}
     if open_loop:
         p99 = out.get("p99_ms")
         slo_ok = p99 is not None and p99 <= SMOKE_OPEN_P99_MS
@@ -484,6 +636,14 @@ def main(argv=None) -> int:
     p.add_argument("--duration", type=float, default=5.0)
     p.add_argument("--points", type=int, default=64,
                    help="rows per request")
+    p.add_argument("--wire", choices=("json", "binary"), default="json",
+                   help="wire format for ad-hoc runs: the legacy JSON "
+                        "object or the application/x-kmeans-points "
+                        "frame (ISSUE 12); works on both transports")
+    p.add_argument("--points-http", type=int, default=512,
+                   dest="points_http",
+                   help="rows per request for the --bench HTTP phases "
+                        "(the binary gate is defined at >= 256)")
     p.add_argument("--k", type=int, default=1000)
     p.add_argument("--d", type=int, default=300)
     p.add_argument("--seed", type=int, default=0)
@@ -524,12 +684,16 @@ def main(argv=None) -> int:
         server, _, base, x = _make_server(
             args.k, args.d, batching=not args.no_batching,
             seed=args.seed, http=(args.transport == "http"))
+    sender = None
+    if args.wire == "binary" and args.transport != "http":
+        sender = binary_inproc_sender(server)
     try:
         out = run_load(
             server, base if args.transport == "http" else None, x,
             points=args.points, duration=args.duration,
             concurrency=args.concurrency,
-            rate=(args.rate if args.mode == "open" else 0.0))
+            rate=(args.rate if args.mode == "open" else 0.0),
+            sender=sender, wire=args.wire)
     finally:
         if server is not None:
             server.stop()

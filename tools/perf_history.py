@@ -30,7 +30,10 @@ Tracked series (direction ``up`` = higher is better):
   is seeded as a null placeholder until its first on-chip run, so the
   MISSING gate covers it from day one);
 * ``serve.batched_qps`` / ``serve.batched_p99_ms`` / ``serve.speedup``
-  — the serving evidence protocol (``BENCH_SERVE_latest.json``);
+  — the serving evidence protocol (``BENCH_SERVE_latest.json``); plus
+  ``serve.binary_qps`` / ``serve.binary_p99_ms`` — the binary-wire
+  HTTP phase (ISSUE 12), null-seeded from older artifacts that predate
+  the phase so the MISSING gate covers them without judging history;
 * ``serve.open_p99_ms`` / ``serve.open_qps`` — the open-loop loadgen
   SLO smoke (``BENCH_OPEN_latest.json``, written by
   ``tools/loadgen.py --smoke --mode open --record``; ROADMAP 2c);
@@ -181,6 +184,11 @@ def _ingest_serve(root: str) -> List[Entry]:
     common = dict(group="serve", source="BENCH_SERVE_latest.json",
                   round=None, ts=ts)
     batched = rec.get("batched", {})
+    # Artifacts from before the binary-wire phase (ISSUE 12) lack
+    # http_binary: seed those series as nulls at the same ts so the
+    # MISSING gate holds them to the group's newest ingest without
+    # judging a measurement that never happened.
+    binary = rec.get("http_binary") or {}
     return [
         Entry("serve.batched_qps", batched.get("qps"),
               unit="req/s", direction="up", **common),
@@ -188,6 +196,10 @@ def _ingest_serve(root: str) -> List[Entry]:
               unit="ms", direction="down", **common),
         Entry("serve.speedup", rec.get("speedup"),
               unit="x", direction="up", **common),
+        Entry("serve.binary_qps", binary.get("qps"),
+              unit="req/s", direction="up", **common),
+        Entry("serve.binary_p99_ms", binary.get("p99_ms"),
+              unit="ms", direction="down", **common),
     ]
 
 

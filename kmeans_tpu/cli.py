@@ -275,6 +275,32 @@ def _cmd_train(args) -> int:
                   file=sys.stderr)
             return 2
 
+    # --comm configures the sharded engine's sweep-merge collective; only
+    # paths that reach fit_lloyd_sharded (directly, or via the spherical/
+    # auto-k/bisecting/spectral inner fits) read it — everything else must
+    # reject rather than mislead (the --update convention above).
+    if getattr(args, "comm", None):
+        comm_models = model in ("lloyd", "spherical", "xmeans", "gmeans",
+                                "bisecting", "spectral")
+        if args.stream or not comm_models or not (args.mesh
+                                                  and args.mesh > 1):
+            why = ("--stream" if args.stream
+                   else f"--model {model}" if not comm_models
+                   else f"--mesh {args.mesh or 1}")
+            print("error: --comm configures the sharded sweep-merge "
+                  "collective; it needs --mesh > 1 and a lloyd-family "
+                  f"model (no effect with {why})", file=sys.stderr)
+            return 2
+        if bool(args.progress or args.checkpoint or args.resume
+                or args.profile or args.telemetry or args.trace
+                or args.xla_trace):
+            print("error: --comm rides the fused sharded fit; the "
+                  "step-paced runner (--progress/--checkpoint/--resume/"
+                  "--profile/--telemetry/--trace/--xla-trace) steps the "
+                  "allreduce merge — drop those flags or --comm",
+                  file=sys.stderr)
+            return 2
+
     if args.profile and args.xla_trace and args.profile != args.xla_trace:
         # --profile is the legacy spelling of --xla-trace; two different
         # directories would silently drop one — reject the ambiguity
@@ -298,6 +324,8 @@ def _cmd_train(args) -> int:
         cfg_kw["batch_size"] = args.batch_size
     if getattr(args, "update", None):
         cfg_kw["update"] = args.update
+    if getattr(args, "comm", None):
+        cfg_kw["comm"] = args.comm
     if args.accel:
         cfg_kw["accel"] = args.accel
     if args.schedule:
@@ -1021,6 +1049,16 @@ def main(argv=None) -> int:
                         "score bounds (single-device lloyd, win is "
                         "data-dependent); explicit choices error where "
                         "unsupported")
+    t.add_argument("--comm", default=None,
+                   choices=["auto", "allreduce", "scatter"],
+                   help="sweep-merge collective of the sharded lloyd fit "
+                        "(needs --mesh > 1): 'allreduce' psums the full "
+                        "per-shard sums+counts slab and updates centroids "
+                        "replicated; 'scatter' reduce-scatters the slab so "
+                        "each shard owns and updates a k/mesh slice, then "
+                        "all-gathers only the finished centroids (the "
+                        "owner-computed update — wins once the (k, d) slab "
+                        "is large); default auto picks by slab size")
     t.add_argument("--accel", default=None, choices=["beta", "anderson"],
                    help="accelerated-fit extrapolation (selects --model "
                         "accelerated when no model is given): 'anderson' "

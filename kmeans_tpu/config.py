@@ -122,6 +122,15 @@ class KMeansConfig:
     #: "pallas" (forced; raises when unsupported), or "pallas_interpret"
     #: (the kernel in interpreter mode — CPU-mesh tests only, slow).
     backend: str = "auto"
+    #: Sweep-merge collective of the SHARDED engine's DP paths: "allreduce"
+    #: (psum the full per-shard sums|counts|inertia slab, update replicated
+    #: on every device), "scatter" (reduce-scatter the slab so each data
+    #: shard owns and updates a k/dp centroid slice, then all-gather only
+    #: the finished centroids — RAISES on model_axis/feature_axis meshes,
+    #: whose bodies already own slices), or "auto" (scatter once the f32
+    #: (k, d) slab crosses the engine's byte threshold and dp > 1).
+    #: Single-device fits ignore it.
+    comm: str = "auto"
 
     # Accelerated-fit engine (models/accelerated.py).
     #: Extrapolation scheme of the accelerated Lloyd loop: "beta" (the
@@ -164,6 +173,8 @@ class KMeansConfig:
             raise ValueError(f"unknown empty-cluster policy {self.empty!r}")
         if self.backend not in ("auto", "xla", "pallas", "pallas_interpret"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.comm not in ("auto", "allreduce", "scatter"):
+            raise ValueError(f"unknown comm {self.comm!r}")
         if self.accel not in ("beta", "anderson"):
             raise ValueError(f"unknown accel {self.accel!r}")
         if not 2 <= self.anderson_m <= 64:

@@ -87,6 +87,8 @@ __all__ = [
     "COST_FLOPS",
     "COST_BYTES",
     "COST_PEAK_BYTES",
+    "COLLECTIVE_BYTES",
+    "record_collective_bytes",
 ]
 
 #: Compile-scale buckets: an XLA:CPU toy compiles in ~10 ms, the fused
@@ -140,6 +142,15 @@ COST_PEAK_BYTES = _gauge(
     "(Compiled.memory_analysis; captured only by explicit "
     "cost_report(memory=True) — it pays a second backend compile)",
     labels=("function",),
+)
+COLLECTIVE_BYTES = _gauge(
+    "kmeans_tpu_engine_collective_bytes",
+    "Estimated per-device wire bytes one sweep's merge collectives move "
+    "for the most recent sharded fit, by comm strategy (ring model: "
+    "allreduce counts the packed sums|counts|inertia slab twice minus "
+    "the resident share; scatter counts the reduce-scatter of the packed "
+    "slab plus the centroid all-gather)",
+    labels=("function", "comm"),
 )
 
 #: Completed-compile records kept for inspection/telemetry stamping.
@@ -448,6 +459,13 @@ def record_cost(name: str, cost: Dict[str, Any]) -> None:
     if cost.get("peak_memory_bytes") is not None:
         COST_PEAK_BYTES.labels(function=name).set(
             float(cost["peak_memory_bytes"]))
+
+
+def record_collective_bytes(name: str, comm: str, nbytes: float) -> None:
+    """Stamp the engine's per-sweep collective-bytes estimate for one
+    (function, comm-strategy) pair — the engine computes the ring-model
+    estimate (it knows dp/k/d); the observatory only owns the gauge."""
+    COLLECTIVE_BYTES.labels(function=name, comm=comm).set(float(nbytes))
 
 
 def cost_report(fn: Callable, *args, memory: bool = False,

@@ -157,6 +157,92 @@ def _apply_center_update(c, sums, counts, *, center_update,
     return _renormalize_update(c, sums, counts, norm_sq=norm_sq)
 
 
+def _fused_psum_merge(axis, sums, counts, inertia=None):
+    """ONE collective for the per-sweep merge on the allreduce path.
+
+    A tuple ``lax.psum((sums, counts, inertia), axis)`` still lowers to
+    three separate ``all-reduce`` HLO ops (one per operand, measured on
+    this toolchain), so the fusion is done by packing: counts ride as an
+    extra feature column and the scalar inertia is broadcast into a second
+    extra column (every row carries the local value, so the reduced value
+    is the global total in every row — replicated for free).  The wire
+    cost is 2k extra floats against the k·d slab; the launch count drops
+    from three to one.  ``axis`` may be a tuple of mesh axes (the Ulysses
+    body reduces over data × feature jointly).
+    """
+    k, d = sums.shape
+    cols = [sums, counts[:, None].astype(sums.dtype)]
+    if inertia is not None:
+        cols.append(jnp.full((k, 1), inertia, sums.dtype))
+    packed = lax.psum(jnp.concatenate(cols, axis=1), axis)
+    if inertia is None:
+        return packed[:, :d], packed[:, d]
+    return packed[:, :d], packed[:, d], packed[0, d + 1]
+
+
+def _scatter_merge_update(c, sums, counts, x_loc, min_d2, *, data_axis,
+                          empty, center_update):
+    """``comm="scatter"`` merge: owner-computed centroid update on k-slices.
+
+    ONE ``reduce-scatter`` of the packed per-shard ``(sums | counts)`` slab
+    hands each data shard ownership of a contiguous ``k/dp`` slice; the
+    divide (:func:`_apply_center_update`), the ``empty="farthest"`` healing,
+    and the centroid-shift reduction all run on that slice only — versus
+    the legacy path's dp×-replicated update after a full ``(k, d+1)``
+    all-reduce.  One tiled ``all_gather`` of the finished f32 centroids
+    then replicates them for the next assign pass: the wire carries one
+    centroid slab instead of sums *plus* counts, and peak update-phase
+    compute/memory drops by dp×.
+
+    k pads to a dp multiple INSIDE the body (zero sums/counts → zero
+    centroid rows, masked out of healing via ``valid``, sliced off after
+    the gather), so callers and the assign pass never see pad rows.
+    Healing reuses :func:`_reseed_empty_farthest_tp` with the data axis
+    standing in for the model axis — the k-slice index IS the data-shard
+    index, so the exclusive-sum rank offset reproduces the single-device
+    "r-th empty slot takes the r-th ranked winner" mapping exactly.
+
+    Returns ``(new_c, counts_loc, shift_sq)``: full replicated ``(k, d)``
+    centroids, this shard's ``(k_pad/dp,)`` count slice, and the global
+    squared centroid shift (replicated scalar).  ``min_d2`` (pre-masked:
+    pad rows at ``-inf``) is only consulted when ``empty="farthest"``.
+    """
+    f32 = jnp.float32
+    k, d = c.shape
+    dp = lax.psum(1, data_axis)
+    k_pad = (-k) % dp
+    if k_pad:
+        sums = jnp.concatenate([sums, jnp.zeros((k_pad, d), sums.dtype)])
+        counts = jnp.concatenate([counts, jnp.zeros((k_pad,), counts.dtype)])
+        c_full = jnp.concatenate([c, jnp.zeros((k_pad, d), c.dtype)])
+    else:
+        c_full = c
+    k_loc = (k + k_pad) // dp
+    packed = jnp.concatenate([sums, counts[:, None].astype(sums.dtype)],
+                             axis=1)
+    packed = lax.psum_scatter(packed, data_axis, scatter_dimension=0,
+                              tiled=True)                  # (k_loc, d+1)
+    sums_loc = packed[:, :d]
+    counts_loc = packed[:, d]
+    me = lax.axis_index(data_axis)
+    c_loc = lax.dynamic_slice_in_dim(c_full, me * k_loc, k_loc, axis=0)
+    new_c_loc = _apply_center_update(c_loc, sums_loc, counts_loc,
+                                     center_update=center_update)
+    if empty == "farthest":
+        valid = (me * k_loc + jnp.arange(k_loc)) < k
+        new_c_loc = _reseed_empty_farthest_tp(
+            new_c_loc, counts_loc, valid, x_loc, min_d2,
+            data_axis, data_axis, k,
+        )
+    shift_sq = lax.psum(
+        jnp.sum((new_c_loc - c_loc) ** 2), data_axis
+    )
+    new_c = lax.all_gather(
+        new_c_loc.astype(f32), data_axis, axis=0, tiled=True
+    )[:k]
+    return new_c, counts_loc, shift_sq
+
+
 # ---------------------------------------------------------------------------
 # Local (per-shard) passes
 # ---------------------------------------------------------------------------
@@ -312,16 +398,29 @@ def _dp_fused_pass(x_loc, c, w_loc, *, backend, chunk_size, compute_dtype,
 
 def _dp_local_pass(x_loc, c, w_loc, *, data_axis, chunk_size, compute_dtype,
                    update, with_labels, backend="xla", empty="keep",
-                   weights_binary=True, center_update="mean"):
-    """DP shard body: fused local pass + psum merge; centroids replicated."""
+                   weights_binary=True, center_update="mean",
+                   comm="allreduce"):
+    """DP shard body: fused local pass + collective merge; centroids
+    replicated.  ``comm="scatter"`` swaps the all-reduce merge for the
+    owner-computed k-slice update (:func:`_scatter_merge_update`) and
+    returns ``(new_c, shift_sq, counts_loc)`` instead — the sweep loop
+    consumes the slice-computed shift and the step's inertia/labels are
+    dead anyway (the final labeling pass always runs allreduce)."""
     labels, min_d2, sums, counts, inertia = _dp_fused_pass(
         x_loc, c, w_loc, backend=backend, chunk_size=chunk_size,
         compute_dtype=compute_dtype, update=update,
         weights_binary=weights_binary,
     )
-    sums = lax.psum(sums, data_axis)
-    counts = lax.psum(counts, data_axis)
-    inertia = lax.psum(inertia, data_axis)
+    if comm == "scatter":
+        # Padding rows (weight 0) must never be nominated as reseed targets.
+        masked = jnp.where(w_loc > 0, min_d2, -jnp.inf)
+        new_c, counts_loc, shift_sq = _scatter_merge_update(
+            c, sums, counts, x_loc, masked, data_axis=data_axis,
+            empty=empty, center_update=center_update,
+        )
+        return new_c, shift_sq, counts_loc
+    sums, counts, inertia = _fused_psum_merge(data_axis, sums, counts,
+                                              inertia)
     new_c = _apply_center_update(c, sums, counts, center_update=center_update)
     if empty == "farthest":
         # Padding rows (weight 0) must never be nominated as reseed targets.
@@ -387,9 +486,8 @@ def _tp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis, k_real,
             jnp.zeros((), f32))
     (sums, counts, inertia), (labs, minds) = lax.scan(body, init, (xs, ws))
 
-    sums = lax.psum(sums, data_axis)
-    counts = lax.psum(counts, data_axis)
-    inertia = lax.psum(inertia, data_axis)
+    sums, counts, inertia = _fused_psum_merge(data_axis, sums, counts,
+                                              inertia)
     # k-slices hold full feature rows, so the sphere renorm is slice-local.
     new_c_loc = _apply_center_update(c_loc, sums, counts,
                                      center_update=center_update)
@@ -477,9 +575,8 @@ def _tpfp_local_pass(x_loc, c_loc, w_loc, *, data_axis, model_axis,
     (sums, counts, inertia), (labs, minds) = lax.scan(body, init, (xs, ws,
                                                                    xs_sq))
 
-    sums = lax.psum(sums, data_axis)
-    counts = lax.psum(counts, data_axis)
-    inertia = lax.psum(inertia, data_axis)
+    sums, counts, inertia = _fused_psum_merge(data_axis, sums, counts,
+                                              inertia)
     new_c_loc = _apply_center_update(c_loc, sums, counts,
                                      center_update=center_update,
                                      feature_axis=feature_axis)
@@ -553,9 +650,8 @@ def _fp_local_pass(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
         body, init, (xs, ws, xs_sq)
     )
 
-    sums = lax.psum(sums, data_axis)                         # (k, d_loc) slice
-    counts = lax.psum(counts, data_axis)
-    inertia = lax.psum(inertia, data_axis)
+    sums, counts, inertia = _fused_psum_merge(data_axis, sums, counts,
+                                              inertia)     # (k, d_loc) slice
     new_c_loc = _apply_center_update(c_loc, sums, counts,
                                      center_update=center_update,
                                      feature_axis=feature_axis)
@@ -621,9 +717,8 @@ def _tp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, model_axis,
     )
     inertia = jnp.sum(mind * w_loc)
 
-    sums = lax.psum(sums, data_axis)
-    counts = lax.psum(counts, data_axis)
-    inertia = lax.psum(inertia, data_axis)
+    sums, counts, inertia = _fused_psum_merge(data_axis, sums, counts,
+                                              inertia)
     new_c_loc = _apply_center_update(c_loc, sums, counts,
                                      center_update=center_update)
     if empty == "farthest":
@@ -680,9 +775,9 @@ def _fp_local_pass_pallas(x_loc, c_loc, w_loc, *, data_axis, feature_axis,
     )
 
     both = (data_axis, feature_axis)
-    sums = lax.psum(sums, both)                             # (k, d) full
-    counts = lax.psum(counts, both)
-    inertia = lax.psum(jnp.sum(mind_blk * w_rows), both)
+    sums, counts, inertia = _fused_psum_merge(
+        both, sums, counts, jnp.sum(mind_blk * w_rows)
+    )                                                       # (k, d) full
     new_c_full = _apply_center_update(c_full, sums, counts,
                                       center_update=center_update)
     if empty == "farthest":
@@ -801,6 +896,53 @@ def _resolve_sharded_backend(req, platform, *, d, k_slice, x_itemsize,
             f"pallas backend unsupported for this sharded fit: {reason}"
         )
     return req
+
+
+#: ``comm="auto"`` switches to the reduce-scatter merge once the f32
+#: (k, d) centroid slab crosses this size: below it the update compute is
+#: trivial and the extra all-gather launch costs more than dp×-replicated
+#: divides save (the headline 1000×300 slab is 1.2 MB and stays on
+#: allreduce; the codebook 65536×2048 slab is 512 MB and scatters).
+_SCATTER_AUTO_MIN_BYTES = 4 << 20
+
+
+def _resolve_comm(req, *, dp, sharded_axes, k, d):
+    """THE sweep-merge strategy policy (mirrors ``resolve_update`` /
+    ``_resolve_sharded_backend``): explicit "scatter" RAISES where it
+    cannot hold (TP/FP meshes already own k- or d-slices — there is no
+    replicated update to shard); "auto" picks scatter when the slab is
+    big enough to pay for the extra gather launch and dp > 1."""
+    if req not in ("auto", "allreduce", "scatter"):
+        raise ValueError(f"unknown comm {req!r}")
+    if req == "scatter":
+        if sharded_axes:
+            raise ValueError(
+                "comm='scatter' shards the centroid update over the data "
+                "axis; it does not compose with model_axis/feature_axis "
+                "(those bodies already compute slice-local updates)"
+            )
+        return "scatter"
+    if req == "allreduce" or sharded_axes or dp <= 1:
+        return "allreduce"
+    return ("scatter" if 4 * k * d >= _SCATTER_AUTO_MIN_BYTES
+            else "allreduce")
+
+
+def _sweep_collective_bytes(comm, *, dp, k, d):
+    """Ring-model estimate of per-device wire bytes one DP sweep's merge
+    collectives move (f32 throughout).  Allreduce: the packed
+    ``(k, d+2)`` sums|counts|inertia slab crosses the ring twice minus
+    the resident share.  Scatter: the packed ``(k_pad, d+1)`` slab rides
+    ONE reduce-scatter (each byte crosses once, minus the resident
+    share) and the finished ``(k_pad, d)`` centroids one all-gather."""
+    if dp <= 1:
+        return 0
+    if comm == "scatter":
+        k_pad = k + ((-k) % dp)
+        rs = 4 * k_pad * (d + 1) * (dp - 1) // dp
+        ag = 4 * k_pad * d * (dp - 1) // dp
+        return rs + ag
+    return 2 * 4 * k * (d + 2) * (dp - 1) // dp
 
 
 def fit_lloyd_sharded(
@@ -957,12 +1099,16 @@ def fit_lloyd_sharded(
             cfg.backend, x, k, weights_are_binary=weights_binary,
             weights=w_host, compute_dtype=cfg.compute_dtype, platform=plat,
         )
+    comm = _resolve_comm(
+        cfg.comm, dp=dp, sharded_axes=bool(model_axis or feature_axis),
+        k=k, d=x.shape[1],
+    )
     if update == "delta":
         # DP incremental loop: per-shard carried (labels, sums, counts),
         # one psum per sweep, per-shard fallback on tile overflow.
         run = _build_lloyd_delta_run(
             mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, max_it,
-            backend, cfg.empty, center_update,
+            backend, cfg.empty, center_update, comm,
         )
     elif update == "hamerly":
         # DP bound-pruned loop (round 5): per-shard carried
@@ -971,7 +1117,7 @@ def fit_lloyd_sharded(
         # vectors; one psum per sweep.
         run = _build_lloyd_hamerly_run(
             mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, max_it,
-            backend,
+            backend, comm,
         )
     else:
         run = _build_lloyd_run(
@@ -982,7 +1128,7 @@ def fit_lloyd_sharded(
             # weight type doesn't force a spurious recompile of an
             # identical program.
             weights_binary if not (model_axis or feature_axis) else True,
-            center_update,
+            center_update, comm,
         )
     layout = _mesh_layout(dp, mp, fp)
     # Whole-fit span with a child per phase the host can see: the fused
@@ -1009,6 +1155,14 @@ def fit_lloyd_sharded(
                 f"lloyd.{update}", backend, layout,
                 dp * mp * fp, time.perf_counter() - t_run0, n_sweeps,
             )
+            if not (model_axis or feature_axis):
+                # TP/FP merges are slice-local by construction; the comm
+                # knob (and its bytes estimate) is a DP-merge story.
+                costmodel.record_collective_bytes(
+                    f"lloyd.{update}", comm,
+                    _sweep_collective_bytes(comm, dp=dp, k=k,
+                                            d=x.shape[1]),
+                )
     return KMeansState(
         c[:k, :d_real], labels[:n], inertia, n_iter, converged, counts[:k]
     )
@@ -1018,9 +1172,19 @@ def fit_lloyd_sharded(
 def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
                      compute_dtype, update, max_it, backend="xla",
                      empty="keep", feature_axis=None, weights_binary=True,
-                     center_update="mean"):
+                     center_update="mean", comm="allreduce"):
     """Jitted whole-fit program, cached so repeated same-shaped fits reuse
-    the compiled executable (jax.jit caches by function identity)."""
+    the compiled executable (jax.jit caches by function identity).
+
+    ``comm="scatter"`` (DP only — :func:`_resolve_comm` guarantees no
+    model/feature axis reaches here with it) swaps the sweep step for the
+    reduce-scatter merge body: the step returns the slice-computed global
+    shift directly and the while body consumes it instead of re-deriving
+    the shift from full centroids, and ``c0`` is donated — the gathered
+    f32 centroids replace it every sweep, so XLA can reuse the buffer.
+    """
+    assert comm == "allreduce" or (model_axis is None
+                                   and feature_axis is None), comm
     use_pallas = backend in ("pallas", "pallas_interpret")
     interpret = backend == "pallas_interpret"
     if model_axis is not None and feature_axis is not None:
@@ -1099,19 +1263,25 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
         out_step = (P(model_axis), P(), P(model_axis))
         out_final = (P(model_axis), P(), P(model_axis), P(data_axis))
 
+    if comm == "scatter":
+        # (new_c full, shift_sq, counts slice) — counts stay sliced on the
+        # wire; the step's counts are dead (the final pass re-derives them).
+        out_step = (P(), P(), P(data_axis))
     step = jax.shard_map(
-        functools.partial(local, with_labels=False),
+        functools.partial(local, with_labels=False, comm=comm)
+        if comm == "scatter" else functools.partial(local, with_labels=False),
         mesh=mesh, in_specs=in_specs, out_specs=out_step, check_vma=False,
     )
     # The final labeling pass discards its centroid output, so reseeding
-    # there would only add dead collectives — always run it plain.
+    # there would only add dead collectives — always run it plain.  It also
+    # always merges by allreduce: its inertia/counts outputs must come back
+    # replicated, and its centroid output is dead.
     final_kw = {"with_labels": True, "empty": "keep"}
     final = jax.shard_map(
         functools.partial(local, **final_kw),
         mesh=mesh, in_specs=in_specs, out_specs=out_final, check_vma=False,
     )
 
-    @jax.jit
     def run(x, w, c0, tol_v):
         def cond(s):
             c, it, shift_sq, done = s
@@ -1119,8 +1289,11 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
 
         def body(s):
             c, it, _, _ = s
-            new_c, _, _ = step(x, c, w)
-            shift_sq = jnp.sum((new_c - c) ** 2)
+            if comm == "scatter":
+                new_c, shift_sq, _ = step(x, c, w)
+            else:
+                new_c, _, _ = step(x, c, w)
+                shift_sq = jnp.sum((new_c - c) ** 2)
             return (new_c, it + 1, shift_sq, shift_sq <= tol_v)
 
         c, n_iter, _, converged = lax.while_loop(
@@ -1131,12 +1304,16 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
         _, inertia, counts, labels = final(x, c, w)
         return c, labels, inertia, n_iter, converged, counts
 
-    return costmodel.observe(run, name="engine.lloyd_run")
+    run = jax.jit(run, donate_argnums=(2,) if comm == "scatter" else ())
+    name = ("engine.lloyd_scatter_run" if comm == "scatter"
+            else "engine.lloyd_run")
+    return costmodel.observe(run, name=name)
 
 
 def _dp_delta_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
                          force_full, *, data_axis, chunk_size,
-                         compute_dtype, backend, empty, center_update):
+                         compute_dtype, backend, empty, center_update,
+                         comm="allreduce"):
     """DP shard body for the incremental (delta) update: each shard runs
     :func:`kmeans_tpu.ops.delta.delta_pass` on its rows — carrying ITS OWN
     (labels, sums, counts) state, so a shard whose tile budget overflows
@@ -1158,8 +1335,17 @@ def _dp_delta_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
         weights_are_binary=True, force_full=force_full,
         with_mind=(empty == "farthest"),
     )
-    g_sums = lax.psum(sums_new, data_axis)
-    g_counts = lax.psum(counts_new, data_axis)
+    if comm == "scatter":
+        masked = (jnp.where(w_loc > 0, min_d2, -jnp.inf)
+                  if empty == "farthest" else min_d2)
+        new_c, _, shift_sq = _scatter_merge_update(
+            c, sums_new, counts_new, x_loc, masked, data_axis=data_axis,
+            empty=empty, center_update=center_update,
+        )
+        # The carried per-shard (sums, counts) stay un-reduced — the delta
+        # invariant is per-shard, so the scatter merge composes unchanged.
+        return new_c, labels, sums_new, counts_new, shift_sq
+    g_sums, g_counts = _fused_psum_merge(data_axis, sums_new, counts_new)
     new_c = _apply_center_update(c, g_sums, g_counts,
                                  center_update=center_update)
     if empty == "farthest":
@@ -1172,21 +1358,27 @@ def _dp_delta_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
 
 @functools.lru_cache(maxsize=32)
 def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
-                           max_it, backend, empty, center_update):
+                           max_it, backend, empty, center_update,
+                           comm="allreduce"):
     """Jitted whole-fit program for the DP ``update="delta"`` path: the
     while_loop carries per-shard labels and reduction state (stacked over
     ``data_axis``) alongside the replicated centroids.  The final labeling
-    pass is the classic dense body (same as every other run builder)."""
+    pass is the classic dense body (same as every other run builder).
+    ``comm="scatter"`` only changes how the per-shard (sums, counts) merge
+    into centroids — the carried delta state is untouched."""
     local = functools.partial(
         _dp_delta_local_pass, data_axis=data_axis, chunk_size=chunk_size,
         compute_dtype=compute_dtype, backend=backend, empty=empty,
-        center_update=center_update,
+        center_update=center_update, comm=comm,
     )
+    step_out = (P(), P(data_axis), P(data_axis), P(data_axis))
+    if comm == "scatter":
+        step_out = step_out + (P(),)                       # shift_sq
     step = jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(data_axis), P(), P(data_axis), P(data_axis),
                   P(data_axis), P(data_axis), P()),
-        out_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
+        out_specs=step_out,
         check_vma=False,
     )
     final_local = functools.partial(
@@ -1203,7 +1395,6 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
     dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
     from kmeans_tpu.ops.delta import DELTA_REFRESH
 
-    @jax.jit
     def run(x, w, c0, tol_v):
         n = x.shape[0]
         k, d = c0.shape
@@ -1214,11 +1405,17 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
 
         def body(s):
             c, it, _, _, lab, sums, counts = s
-            new_c, lab, sums, counts = step(
-                x, c, w, lab, sums, counts,
-                (it % DELTA_REFRESH) == 0,
-            )
-            shift_sq = jnp.sum((new_c - c) ** 2)
+            if comm == "scatter":
+                new_c, lab, sums, counts, shift_sq = step(
+                    x, c, w, lab, sums, counts,
+                    (it % DELTA_REFRESH) == 0,
+                )
+            else:
+                new_c, lab, sums, counts = step(
+                    x, c, w, lab, sums, counts,
+                    (it % DELTA_REFRESH) == 0,
+                )
+                shift_sq = jnp.sum((new_c - c) ** 2)
             return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
                     counts)
 
@@ -1233,12 +1430,16 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
         _, inertia, counts, labels = final(x, c, w)
         return c, labels, inertia, n_iter, converged, counts
 
-    return costmodel.observe(run, name="engine.lloyd_delta_run")
+    run = jax.jit(run, donate_argnums=(2,) if comm == "scatter" else ())
+    name = ("engine.lloyd_delta_scatter_run" if comm == "scatter"
+            else "engine.lloyd_delta_run")
+    return costmodel.observe(run, name=name)
 
 
 def _dp_hamerly_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
                            sb, slb, c_cd, csq_prev, rno_loc, *, data_axis,
-                           chunk_size, compute_dtype, backend):
+                           chunk_size, compute_dtype, backend,
+                           comm="allreduce"):
     """DP shard body for the Hamerly bound-pruned update: each shard runs
     :func:`kmeans_tpu.ops.hamerly.hamerly_pass` on its rows, carrying ITS
     OWN (labels, sums, counts, sb, slb) — the score bounds are per-row
@@ -1258,15 +1459,25 @@ def _dp_hamerly_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
         backend="auto" if backend == "pallas" else backend,
         weights_are_binary=True,
     )
-    g_sums = lax.psum(sums_new, data_axis)
-    g_counts = lax.psum(counts_new, data_axis)
+    if comm == "scatter":
+        # Hamerly always runs empty="keep" (validated at fit entry), so the
+        # slice update is the bare divide; the bound bookkeeping (c_cd2,
+        # csq2) is recomputed from the replicated INPUT centroids inside
+        # hamerly_pass and is untouched by how the merge is communicated.
+        new_c, _, shift_sq = _scatter_merge_update(
+            c, sums_new, counts_new, x_loc, sb, data_axis=data_axis,
+            empty="keep", center_update="mean",
+        )
+        return (new_c, labels, sums_new, counts_new, sb2, slb2, c_cd2,
+                csq2, shift_sq)
+    g_sums, g_counts = _fused_psum_merge(data_axis, sums_new, counts_new)
     new_c = apply_update(c, g_sums, g_counts)
     return (new_c, labels, sums_new, counts_new, sb2, slb2, c_cd2, csq2)
 
 
 @functools.lru_cache(maxsize=32)
 def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
-                             max_it, backend):
+                             max_it, backend, comm="allreduce"):
     """Jitted whole-fit program for the DP ``update="hamerly"`` path:
     like :func:`_build_lloyd_delta_run` but the carried per-shard state
     additionally holds the (sb, slb) score bounds, and the refresh
@@ -1277,15 +1488,18 @@ def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
 
     local = functools.partial(
         _dp_hamerly_local_pass, data_axis=data_axis, chunk_size=chunk_size,
-        compute_dtype=compute_dtype, backend=backend,
+        compute_dtype=compute_dtype, backend=backend, comm=comm,
     )
+    step_out = (P(), P(data_axis), P(data_axis), P(data_axis),
+                P(data_axis), P(data_axis), P(), P())
+    if comm == "scatter":
+        step_out = step_out + (P(),)                       # shift_sq
     step = jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(data_axis), P(), P(data_axis), P(data_axis),
                   P(data_axis), P(data_axis), P(data_axis), P(data_axis),
                   P(), P(), P(data_axis)),
-        out_specs=(P(), P(data_axis), P(data_axis), P(data_axis),
-                   P(data_axis), P(data_axis), P(), P()),
+        out_specs=step_out,
         check_vma=False,
     )
     rno_sm = jax.shard_map(
@@ -1309,7 +1523,6 @@ def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
     cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
           else None)
 
-    @jax.jit
     def run(x, w, c0, tol_v):
         n = x.shape[0]
         k, d = c0.shape
@@ -1326,9 +1539,16 @@ def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
             lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
             sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
             counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
-            (new_c, lab, sums, counts, sb, slb, c_cd, csq) = step(
-                x, c, w, lab_e, sums_e, counts_e, sb, slb, c_cd, csq, rno)
-            shift_sq = jnp.sum((new_c - c) ** 2)
+            if comm == "scatter":
+                (new_c, lab, sums, counts, sb, slb, c_cd, csq,
+                 shift_sq) = step(
+                    x, c, w, lab_e, sums_e, counts_e, sb, slb, c_cd, csq,
+                    rno)
+            else:
+                (new_c, lab, sums, counts, sb, slb, c_cd, csq) = step(
+                    x, c, w, lab_e, sums_e, counts_e, sb, slb, c_cd, csq,
+                    rno)
+                shift_sq = jnp.sum((new_c - c) ** 2)
             return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
                     counts, sb, slb, c_cd, csq)
 
@@ -1347,7 +1567,10 @@ def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
         _, inertia, counts, labels = final(x, c, w)
         return c, labels, inertia, n_iter, converged, counts
 
-    return costmodel.observe(run, name="engine.lloyd_hamerly_run")
+    run = jax.jit(run, donate_argnums=(2,) if comm == "scatter" else ())
+    name = ("engine.lloyd_hamerly_scatter_run" if comm == "scatter"
+            else "engine.lloyd_hamerly_run")
+    return costmodel.observe(run, name=name)
 
 
 @functools.lru_cache(maxsize=32)

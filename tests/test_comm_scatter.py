@@ -1,0 +1,172 @@
+"""The ``comm="scatter"`` sweep merge (ISSUE 13): reduce-scattered
+k-sharded centroid updates must be LABEL-EXACT vs both the legacy
+allreduce merge and the single-device fit, across mesh shapes, k-padding
+remainders, empty-cluster healing, and all three sweep families —
+plus the policy (`_resolve_comm`) and donation contracts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.lloyd import fit_lloyd
+from kmeans_tpu.parallel import make_mesh
+from kmeans_tpu.parallel.engine import (
+    _resolve_comm,
+    _SCATTER_AUTO_MIN_BYTES,
+    _sweep_collective_bytes,
+    fit_lloyd_sharded,
+)
+
+
+def _data(n=257, d=16, k=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    return x, x[:k].copy()
+
+
+def _fit_pair(x, c0, k, mesh, *, comm, max_iter=20, **cfg_kw):
+    """(sharded state, single-device reference) at identical inits."""
+    cfg = KMeansConfig(k=k, max_iter=max_iter, comm=comm, **cfg_kw)
+    st = fit_lloyd_sharded(x, k, mesh=mesh, init=c0, max_iter=max_iter,
+                           config=cfg)
+    ref_kw = {kk: v for kk, v in cfg_kw.items() if kk != "update"}
+    ref = fit_lloyd(x, k, init=c0, max_iter=max_iter,
+                    config=KMeansConfig(k=k, max_iter=max_iter, **ref_kw))
+    return st, ref
+
+
+@pytest.mark.parametrize("shape,axes", [
+    ((8,), ("data",)),
+    ((4, 2), ("data", "model")),
+    ((2, 4), ("data", "model")),
+    ((2, 2, 2), ("data", "model", "feature")),
+])
+def test_scatter_label_exact_across_mesh_shapes(cpu_devices, shape, axes):
+    """The full MULTICHIP shape sweep: data-parallel scatter fits (the
+    extra mesh axes left unused — shard_map replicates over them) are
+    label-exact vs single-device AND vs the allreduce merge."""
+    mesh = make_mesh(shape, axes, devices=cpu_devices)
+    x, c0 = _data()
+    st, ref = _fit_pair(x, c0, 5, mesh, comm="scatter")
+    np.testing.assert_array_equal(np.asarray(st.labels),
+                                  np.asarray(ref.labels))
+    st_ar, _ = _fit_pair(x, c0, 5, mesh, comm="allreduce")
+    np.testing.assert_array_equal(np.asarray(st.labels),
+                                  np.asarray(st_ar.labels))
+    assert int(st.n_iter) == int(ref.n_iter)
+
+
+@pytest.mark.parametrize("k", [5, 6, 13])
+def test_scatter_k_not_divisible_by_dp(cpu_devices, k):
+    """k % dp != 0: the in-body zero-padding must never leak pad rows
+    into labels, counts, or the returned centroid shapes."""
+    mesh = make_mesh((8, 1), ("data", "model"), devices=cpu_devices)
+    x, c0 = _data(n=300, d=12, k=k, seed=1)
+    st, ref = _fit_pair(x, c0, k, mesh, comm="scatter")
+    np.testing.assert_array_equal(np.asarray(st.labels),
+                                  np.asarray(ref.labels))
+    assert st.centroids.shape == (k, 12)
+    assert st.counts.shape == (k,)
+    np.testing.assert_allclose(np.asarray(st.counts),
+                               np.asarray(ref.counts))
+
+
+def test_scatter_empty_farthest_healing_matches(cpu_devices):
+    """empty="farthest" on the SLICED update: the r-th empty slot must
+    take the r-th ranked winner exactly as single-device does.  Far-away
+    duplicate init rows force empties deterministically."""
+    mesh = make_mesh((8, 1), ("data", "model"), devices=cpu_devices)
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(320, 8)).astype(np.float32)
+    k = 6
+    # Duplicated init centroids -> at least one cluster starves.
+    c0 = np.concatenate([x[:3], x[:3] + 1e3]).astype(np.float32)
+    st, ref = _fit_pair(x, c0, k, mesh, comm="scatter", max_iter=10,
+                        empty="farthest")
+    np.testing.assert_array_equal(np.asarray(st.labels),
+                                  np.asarray(ref.labels))
+    st_ar, _ = _fit_pair(x, c0, k, mesh, comm="allreduce", max_iter=10,
+                         empty="farthest")
+    np.testing.assert_array_equal(np.asarray(st.labels),
+                                  np.asarray(st_ar.labels))
+
+
+@pytest.mark.parametrize("update", ["delta", "hamerly"])
+def test_scatter_incremental_families_label_exact(cpu_devices, update):
+    """The delta and hamerly sweep bodies carry per-shard bound/label
+    state; the scatter merge must leave that bookkeeping consistent
+    (labels and iteration counts identical to single-device)."""
+    mesh = make_mesh((8, 1), ("data", "model"), devices=cpu_devices)
+    x, c0 = _data(n=300, d=12, k=6, seed=2)
+    st, ref = _fit_pair(x, c0, 6, mesh, comm="scatter", max_iter=15,
+                        update=update)
+    np.testing.assert_array_equal(np.asarray(st.labels),
+                                  np.asarray(ref.labels))
+    assert int(st.n_iter) == int(ref.n_iter)
+
+
+def test_scatter_rejects_model_and_feature_axes(cpu_devices):
+    """Explicit comm="scatter" on a TP (or FP) mesh must raise — those
+    bodies already own k-/d-slices; there is no replicated update to
+    shard."""
+    mesh = make_mesh((4, 2), ("data", "model"), devices=cpu_devices)
+    x, c0 = _data()
+    with pytest.raises(ValueError, match="comm='scatter'"):
+        fit_lloyd_sharded(x, 5, mesh=mesh, init=c0, max_iter=3,
+                          model_axis="model",
+                          config=KMeansConfig(k=5, max_iter=3,
+                                              comm="scatter"))
+
+
+def test_resolve_comm_policy():
+    """auto: scatter iff DP-only, dp > 1, and the f32 (k, d) slab crosses
+    the byte threshold (headline 1000x300 stays allreduce; codebook
+    65536x2048 scatters)."""
+    assert _resolve_comm("auto", dp=8, sharded_axes=False,
+                         k=1000, d=300) == "allreduce"
+    assert _resolve_comm("auto", dp=8, sharded_axes=False,
+                         k=65536, d=2048) == "scatter"
+    assert _resolve_comm("auto", dp=1, sharded_axes=False,
+                         k=65536, d=2048) == "allreduce"
+    assert _resolve_comm("auto", dp=8, sharded_axes=True,
+                         k=65536, d=2048) == "allreduce"
+    # The threshold itself is the boundary: >= scatters.
+    k_at = _SCATTER_AUTO_MIN_BYTES // (4 * 128)
+    assert _resolve_comm("auto", dp=8, sharded_axes=False,
+                         k=k_at, d=128) == "scatter"
+    assert _resolve_comm("allreduce", dp=8, sharded_axes=False,
+                         k=65536, d=2048) == "allreduce"
+    with pytest.raises(ValueError, match="unknown comm"):
+        _resolve_comm("ring", dp=8, sharded_axes=False, k=10, d=10)
+
+
+def test_sweep_collective_bytes_model():
+    """The gauge estimate: scatter must beat allreduce for every dp > 1
+    (it is why the path exists), and dp=1 moves nothing."""
+    assert _sweep_collective_bytes("scatter", dp=1, k=100, d=10) == 0
+    for dp in (2, 4, 8):
+        ar = _sweep_collective_bytes("allreduce", dp=dp, k=1024, d=256)
+        sc = _sweep_collective_bytes("scatter", dp=dp, k=1024, d=256)
+        assert 0 < sc < ar
+
+
+def test_scatter_run_donates_centroid_buffer(cpu_devices):
+    """DON301 contract: the scatter run donates c0 (the gathered f32
+    centroids replace it every sweep), so the input buffer is deleted
+    after the fit — and no donation warning fires."""
+    from kmeans_tpu.parallel.engine import _build_lloyd_run
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh((8, 1), ("data", "model"), devices=cpu_devices)
+    x_h, c0_h = _data(n=256, d=16, k=8)
+    x = jax.device_put(jnp.asarray(x_h), NamedSharding(mesh, P("data")))
+    w = jax.device_put(jnp.ones((256,), jnp.float32),
+                       NamedSharding(mesh, P("data")))
+    c0 = jax.device_put(jnp.asarray(c0_h), NamedSharding(mesh, P()))
+    run = _build_lloyd_run(mesh, "data", None, 8, 1024, None, "matmul",
+                           5, "xla", "keep", None, True, "mean", "scatter")
+    run(x, w, c0, jnp.asarray(1e-4, jnp.float32))
+    assert c0.is_deleted()

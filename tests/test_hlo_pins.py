@@ -67,6 +67,88 @@ def _assert_no_row_gather(hlo, budget, *, what):
             f"budget {budget} — rows are crossing the ICI")
 
 
+def _collective_counts(hlo):
+    """HLO op counts of the three sweep-merge collectives (sync and
+    async-start spellings both count; the paired -done ops don't)."""
+    counts = {"all-reduce": 0, "all-gather": 0, "reduce-scatter": 0}
+    for line in hlo.splitlines():
+        for op in counts:
+            if f"{op}(" in line or f"{op}-start(" in line:
+                counts[op] += 1
+    return counts
+
+
+def test_scatter_step_lowers_to_reduce_scatter_plus_one_gather(cpu_devices):
+    """ISSUE 13 pin: the ``comm="scatter"`` sweep merge is ONE
+    reduce-scatter of the packed sums|counts slab plus ONE all-gather of
+    the finished centroids (budgeted at the padded (k, d) slab — nothing
+    row-scale), with the only all-reduce the scalar shift."""
+    from kmeans_tpu.parallel.engine import _dp_local_pass
+    import functools
+
+    mesh = _mesh(cpu_devices)
+    x, w = _sharded_xw(mesh)
+    c0 = x[:K]
+    step = jax.jit(jax.shard_map(
+        functools.partial(
+            _dp_local_pass, data_axis="data", chunk_size=1024,
+            compute_dtype=None, update="matmul", with_labels=False,
+            comm="scatter"),
+        mesh=mesh, in_specs=(P("data"), P(), P("data")),
+        out_specs=(P(), P(), P("data")), check_vma=False))
+    hlo = step.lower(x, c0, w).compile().as_text()
+    counts = _collective_counts(hlo)
+    assert counts["reduce-scatter"] == 1, counts
+    assert counts["all-gather"] == 1, counts
+    k_pad = K + (-K) % 8
+    _assert_no_row_gather(hlo, k_pad * D, what="scatter sweep merge")
+    # The one permitted all-reduce is the scalar centroid shift.
+    assert counts["all-reduce"] <= 1, counts
+
+
+def test_allreduce_step_merge_is_one_collective(cpu_devices):
+    """ISSUE 13 satellite pin: the legacy path's (sums, counts, inertia)
+    merge is ONE packed all-reduce per sweep, not three (a tuple psum
+    still lowers to three separate all-reduce ops on this toolchain —
+    the fusion is the packed slab in ``_fused_psum_merge``)."""
+    from kmeans_tpu.parallel.engine import _dp_local_pass
+    import functools
+
+    mesh = _mesh(cpu_devices)
+    x, w = _sharded_xw(mesh)
+    c0 = x[:K]
+    step = jax.jit(jax.shard_map(
+        functools.partial(
+            _dp_local_pass, data_axis="data", chunk_size=1024,
+            compute_dtype=None, update="matmul", with_labels=False),
+        mesh=mesh, in_specs=(P("data"), P(), P("data")),
+        out_specs=(P(), P(), P()), check_vma=False))
+    hlo = step.lower(x, c0, w).compile().as_text()
+    counts = _collective_counts(hlo)
+    assert counts["all-reduce"] == 1, counts
+    assert counts["reduce-scatter"] == 0, counts
+
+
+def test_scatter_run_collective_story(cpu_devices):
+    """The WHOLE compiled scatter fit: reduce-scatter present, exactly
+    one centroid-sized all-gather (the sweep gather; the final labeling
+    pass merges by packed all-reduce and gathers nothing)."""
+    from kmeans_tpu.parallel.engine import _build_lloyd_run
+
+    mesh = _mesh(cpu_devices)
+    x, w = _sharded_xw(mesh)
+    c0 = x[:K]
+    run = _build_lloyd_run(mesh, "data", None, K, 1024, None, "matmul",
+                           5, "xla", "keep", None, True, "mean", "scatter")
+    hlo = run.lower(x, w, c0,
+                    jnp.asarray(1e-4, jnp.float32)).compile().as_text()
+    counts = _collective_counts(hlo)
+    assert counts["reduce-scatter"] >= 1, counts
+    assert counts["all-gather"] == 1, counts
+    k_pad = K + (-K) % 8
+    _assert_no_row_gather(hlo, k_pad * D, what="scatter lloyd run")
+
+
 def test_tied_gmm_run_has_no_row_gather(cpu_devices):
     """The tied scatter comment (engine.py `_build_gmm_run`) becomes a
     pin: the WHOLE compiled tied fit moves nothing row-scale."""

@@ -1,0 +1,142 @@
+"""Multi-chip sweep benchmark: allreduce vs reduce-scatter centroid merge.
+
+Times ONE Lloyd sweep of the DP-sharded engine on the virtual 8-device CPU
+mesh for both merge strategies (``comm="allreduce"`` — the legacy fused-psum
+path — and ``comm="scatter"`` — the k-sharded ``psum_scatter`` update) at the
+two shapes the paper narrative cares about:
+
+* **headline** — k=1000, d=300: the (k, d) slab is ~1.2 MB; the auto policy
+  keeps this on allreduce (replication is cheaper than the extra gather).
+* **codebook** — k=65536, d=2048: a 512 MB f32 codebook; the whole point of
+  the scatter path.  n is kept tiny so the assignment pass doesn't drown the
+  merge being measured.
+
+The timings land in ``MULTICHIP_r<N>.json`` under a ``timings`` key that
+``tools/perf_history.py`` ingests as the ``multichip.*`` series.  On the
+1-core CI host these numbers measure the XLA CPU lowering of the collective
+schedule, not real inter-chip bandwidth — the artifact records the host so
+readers can weigh them accordingly.
+
+Run it::
+
+    python -m tools.bench_multichip                       # full shapes
+    python -m tools.bench_multichip --quick               # CI-sized codebook
+    python -m tools.bench_multichip --out MULTICHIP_r06.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# The mesh needs 8 devices BEFORE jax initializes its backends.
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SHAPES = {
+    # name -> (n, k, d, chunk, sweeps)
+    "headline": (4096, 1000, 300, 1024, 4),
+    "codebook": (256, 65536, 2048, 256, 2),
+}
+QUICK_SHAPES = {
+    "headline": (2048, 1000, 300, 1024, 2),
+    "codebook": (256, 8192, 512, 256, 2),
+}
+
+
+def _time_sweep(mesh, n, k, d, chunk, sweeps, comm):
+    """Seconds per Lloyd sweep for one comm strategy (compile excluded)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kmeans_tpu.parallel.engine import _build_lloyd_run
+
+    rng = np.random.default_rng(0)
+    x_h = rng.normal(size=(n, d)).astype(np.float32)
+    c_h = rng.normal(size=(k, d)).astype(np.float32)
+
+    x = jax.device_put(jnp.asarray(x_h), NamedSharding(mesh, P("data")))
+    w = jax.device_put(jnp.ones((n,), jnp.float32),
+                       NamedSharding(mesh, P("data")))
+    rep = NamedSharding(mesh, P())
+    # tol=0 -> the run executes exactly `sweeps` iterations.
+    tol_v = jnp.asarray(0.0, jnp.float32)
+
+    run = _build_lloyd_run(mesh, "data", None, k, chunk, None, "matmul",
+                           sweeps, "xla", "keep", None, True, "mean", comm)
+
+    def _call():
+        # Fresh replicated centroids every call: the scatter run DONATES
+        # this buffer (the gathered f32 result replaces it each sweep).
+        c0 = jax.device_put(jnp.asarray(c_h), rep)
+        t0 = time.perf_counter()
+        out = run(x, w, c0, tol_v)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    _call()                      # compile + first execute
+    best = min(_call() for _ in range(2))
+    return best / sweeps
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="MULTICHIP_r06.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized codebook shape (minutes -> seconds)")
+    args = ap.parse_args(argv)
+
+    import kmeans_tpu  # noqa: F401  (compat shim before any jax.shard_map)
+    import jax
+
+    from kmeans_tpu.parallel import make_mesh
+
+    devs = jax.devices("cpu")[:8]
+    if len(devs) < 8:
+        print(f"need 8 devices, have {len(devs)}", file=sys.stderr)
+        return 1
+
+    shapes = QUICK_SHAPES if args.quick else SHAPES
+    timings = {}
+    with jax.default_device(devs[0]):
+        mesh = make_mesh((8, 1), ("data", "model"), devices=devs)
+        for name, (n, k, d, chunk, sweeps) in shapes.items():
+            row = {}
+            for comm in ("allreduce", "scatter"):
+                t = _time_sweep(mesh, n, k, d, chunk, sweeps, comm)
+                row[f"{comm}_sweep_s"] = round(t, 6)
+                print(f"{name:9s} comm={comm:9s} n={n} k={k} d={d}: "
+                      f"{t:.4f}s/sweep", flush=True)
+            timings[name] = row
+
+    rec = {
+        "n_devices": 8,
+        "ok": True,
+        "skipped": False,
+        "quick": bool(args.quick),
+        "host_platform": devs[0].platform,
+        "host_cpu_count": os.cpu_count(),
+        "shapes": {name: {"n": s[0], "k": s[1], "d": s[2], "sweeps": s[4]}
+                   for name, s in shapes.items()},
+        "timings": timings,
+        "note": ("per-sweep seconds of the DP-sharded Lloyd run on the "
+                 "8-virtual-device CPU mesh; measures the XLA CPU lowering "
+                 "of each collective schedule, not inter-chip bandwidth"),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(rec, fh, indent=2)
+        fh.write("\n")
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

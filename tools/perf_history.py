@@ -42,7 +42,12 @@ Tracked series (direction ``up`` = higher is better):
 * ``accel.<config>.nested_seconds_reduction`` — the nested schedule's
   wall-clock claim (``BENCH_ACCEL_latest.json`` medians);
 * ``input.fit_s`` / ``input.iters_per_s`` — the real-data fit
-  (``BENCH_INPUT_latest.json``).
+  (``BENCH_INPUT_latest.json``);
+* ``multichip.<shape>.<comm>_sweep_s`` — the host-platform-mesh sweep
+  time of each comm path (allreduce vs reduce-scatter merge) at the
+  headline and codebook shapes (``MULTICHIP_r*.json``; rounds that
+  predate the timings are null-seeded so the MISSING gate covers the
+  grid without judging history).
 
 Entries carry provenance (source file, round or artifact timestamp,
 ``carried`` for carry-forward values) and ``null``-valued rounds (failed
@@ -247,6 +252,46 @@ def _ingest_accel(root: str) -> List[Entry]:
     return out
 
 
+#: The (shape, comm) grid every MULTICHIP timing artifact must cover:
+#: a round that drops a cell goes MISSING at the next ingest.
+_MULTICHIP_SERIES = tuple(
+    f"multichip.{shape}.{comm}_sweep_s"
+    for shape in ("headline", "codebook")
+    for comm in ("allreduce", "scatter")
+)
+
+
+def _ingest_multichip(root: str) -> List[Entry]:
+    """The host-platform-mesh sweep timings (``MULTICHIP_r*.json``).
+
+    Rounds r01-r05 predate the comm-path timings (they recorded only the
+    dryrun verdict): every series is null-seeded from them, so the
+    MISSING gate holds the grid to the group's newest round without
+    judging measurements that never happened — the serve/soak
+    null-seeding pattern.
+    """
+    out: List[Entry] = []
+    for path in sorted(glob.glob(os.path.join(root,
+                                              "MULTICHIP_r[0-9]*.json"))):
+        rec = _load_json(path)
+        if rec is None:
+            continue
+        m = re.search(r"MULTICHIP_r(\d+)", os.path.basename(path))
+        if m is None:
+            continue
+        rnd = int(m.group(1))
+        timings = rec.get("timings") or {}
+        for series in _MULTICHIP_SERIES:
+            _, shape, metric = series.split(".")
+            comm = metric[:-len("_sweep_s")]
+            value = (timings.get(shape) or {}).get(f"{comm}_sweep_s")
+            out.append(Entry(series, value, unit="s", direction="down",
+                             group="multichip",
+                             source=os.path.basename(path), round=rnd,
+                             ts=None))
+    return out
+
+
 def _ingest_input(root: str) -> List[Entry]:
     rec = _load_json(os.path.join(root, "BENCH_INPUT_latest.json"))
     if rec is None:
@@ -266,7 +311,8 @@ def collect_entries(root: str) -> List[Entry]:
     """Every observation the artifacts in ``root`` currently support."""
     out: List[Entry] = []
     for fn in (_ingest_rounds, _ingest_local, _ingest_all, _ingest_serve,
-               _ingest_open, _ingest_soak, _ingest_accel, _ingest_input):
+               _ingest_open, _ingest_soak, _ingest_accel, _ingest_input,
+               _ingest_multichip):
         out.extend(fn(root))
     return out
 

@@ -237,6 +237,45 @@ def _cmd_train(args) -> int:
               if model == "gmm" and getattr(args, "covariance_type", None)
               else {})
 
+    # --ckpt-dir turns on the sharded engine's ELASTIC path: sweep-granular
+    # mesh-agnostic checkpoints cut by fit_lloyd_sharded itself (distinct
+    # from --checkpoint, which paces the step-wise runner / streamed fits).
+    # With it, --resume means "resume the engine from that directory" —
+    # possibly on a different --mesh or --comm than the run that saved it.
+    engine_ckpt = bool(getattr(args, "ckpt_dir", None))
+    if engine_ckpt:
+        if args.stream or model != "lloyd" or not (args.mesh
+                                                   and args.mesh > 1):
+            why = ("--stream" if args.stream
+                   else f"--model {model}" if model != "lloyd"
+                   else f"--mesh {args.mesh or 1}")
+            print("error: --ckpt-dir is the sharded engine's elastic "
+                  "checkpoint; it needs --model lloyd --mesh > 1 (no "
+                  f"effect with {why}) — the step-paced and streamed "
+                  "paths checkpoint via --checkpoint", file=sys.stderr)
+            return 2
+        if bool(args.progress or args.checkpoint or args.profile
+                or args.telemetry or args.trace or args.xla_trace):
+            print("error: --ckpt-dir rides the fused sharded fit; drop "
+                  "the step-paced flags (--progress/--checkpoint/"
+                  "--profile/--telemetry/--trace/--xla-trace) or use "
+                  "--checkpoint with the runner instead", file=sys.stderr)
+            return 2
+        if args.resume and os.path.realpath(args.resume) != \
+                os.path.realpath(args.ckpt_dir):
+            print("error: an elastic --resume continues from (and keeps "
+                  "saving into) one directory; --resume must match "
+                  "--ckpt-dir", file=sys.stderr)
+            return 2
+    if getattr(args, "ckpt_every", None) is not None:
+        if not engine_ckpt:
+            print("error: --ckpt-every paces the elastic engine "
+                  "checkpoint; it needs --ckpt-dir", file=sys.stderr)
+            return 2
+        if args.ckpt_every < 1:
+            print("error: --ckpt-every must be positive", file=sys.stderr)
+            return 2
+
     # --update configures the Lloyd-family centroid reduction; paths that
     # never read cfg.update — or that silently demote "delta" to the dense
     # reduction (accelerated/spherical/trimmed, and the step-wise runner)
@@ -249,8 +288,11 @@ def _cmd_train(args) -> int:
                   f"it has no effect with --model {model}"
                   f"{' --stream' if args.stream else ''}", file=sys.stderr)
             return 2
+        # With --ckpt-dir, --resume belongs to the elastic engine, not
+        # the step-wise runner.
         runner_flags = bool(args.progress or args.checkpoint
-                            or args.resume or args.profile
+                            or (args.resume and not engine_ckpt)
+                            or args.profile
                             or args.telemetry or args.trace
                             or args.xla_trace)
         if args.update in ("delta", "hamerly") and model != "lloyd":
@@ -291,7 +333,8 @@ def _cmd_train(args) -> int:
                   "collective; it needs --mesh > 1 and a lloyd-family "
                   f"model (no effect with {why})", file=sys.stderr)
             return 2
-        if bool(args.progress or args.checkpoint or args.resume
+        if bool(args.progress or args.checkpoint
+                or (args.resume and not engine_ckpt)
                 or args.profile or args.telemetry or args.trace
                 or args.xla_trace):
             print("error: --comm rides the fused sharded fit; the "
@@ -350,7 +393,7 @@ def _cmd_train(args) -> int:
     # loop (runner or streamed) — the one-shot fused fits have no
     # iteration boundary to emit events or spans at.
     stream_ckpt = args.stream and (args.checkpoint or args.resume)
-    want_runner = not args.stream and bool(
+    want_runner = not args.stream and not engine_ckpt and bool(
         args.progress or args.checkpoint or args.resume or args.profile
         or args.telemetry or args.trace or args.xla_trace
     )
@@ -469,6 +512,16 @@ def _cmd_train(args) -> int:
                 print(f"error: cannot resume from {args.resume!r}: {e}",
                       file=sys.stderr)
                 return 2
+            except ValueError as e:
+                # e.g. an elastic engine bundle handed to the runner
+                # (--resume without --ckpt-dir routes here).
+                print(f"error: cannot resume from {args.resume!r}: {e}",
+                      file=sys.stderr)
+                if "fit_lloyd_sharded" in str(e):
+                    print(f"hint: resume the elastic sharded fit with "
+                          f"--ckpt-dir {args.resume} --resume "
+                          f"{args.resume}", file=sys.stderr)
+                return 2
             print(f"resumed from {args.resume} at iteration {step}",
                   file=sys.stderr)
         else:
@@ -551,6 +604,25 @@ def _cmd_train(args) -> int:
         }[model]
         fit_kw = ({"trim_fraction": trim_fraction}
                   if model == "trimmed" else {}) | gmm_kw
+        if engine_ckpt:
+            if args.resume:
+                # A mistyped --resume dir must not silently train from
+                # scratch (and overwrite it) with exit 0 — same contract
+                # as the streamed resume path.
+                from kmeans_tpu.utils.checkpoint import latest_step
+
+                step = latest_step(args.ckpt_dir)
+                if step is None:
+                    print(f"error: no checkpoint found at "
+                          f"{args.ckpt_dir!r} to resume from",
+                          file=sys.stderr)
+                    return 2
+                print(f"resuming sharded fit from {args.ckpt_dir} at "
+                      f"sweep {step}", file=sys.stderr)
+            fit_kw |= {"ckpt_dir": args.ckpt_dir,
+                       "ckpt_every": args.ckpt_every,
+                       "ckpt_keep": args.checkpoint_keep,
+                       "resume": bool(args.resume)}
         state = fit(np.asarray(x), k, mesh=mesh, config=kcfg, **fit_kw)
     elif args.stream:
         ckpt_kw = {}
@@ -1097,7 +1169,17 @@ def main(argv=None) -> int:
                    help="retain up to N displaced checkpoints as step-"
                         "tagged siblings (rolling history; 0 = none)")
     t.add_argument("--resume", help="resume from this checkpoint directory "
-                   "(a streamed resume keeps saving into the same dir)")
+                   "(a streamed resume keeps saving into the same dir; "
+                   "with --ckpt-dir, resumes the sharded engine — the "
+                   "mesh/comm may differ from the run that saved it)")
+    t.add_argument("--ckpt-dir", help="elastic checkpoint directory for "
+                   "the fused sharded fit (--model lloyd --mesh > 1): "
+                   "sweep-granular, mesh-agnostic bundles the engine cuts "
+                   "itself every --ckpt-every sweeps and on SIGTERM/"
+                   "SIGINT")
+    t.add_argument("--ckpt-every", type=int, default=None,
+                   help="sweeps between elastic engine checkpoints "
+                        "(default 10)")
     t.add_argument("--profile", help="write a jax.profiler trace to this dir")
     t.add_argument("--trace", metavar="OUT.json",
                    help="write the run's host span timeline (compile / "

@@ -196,6 +196,32 @@ class KMeansConfig:
         return self
 
 
+def engine_fingerprint(cfg: "KMeansConfig", *, k: int, d: int,
+                       center_update: str = "mean",
+                       tol: Optional[float] = None) -> dict:
+    """Mesh-agnostic identity of a sharded fit, stored in (and checked
+    against) an elastic checkpoint bundle.  JSON-primitive values only —
+    the dict must compare equal after a meta.json round-trip.
+
+    Deliberately EXCLUDES everything a resume may legitimately change:
+    mesh shape, device count, comm mode, backend, chunk_size (execution
+    choices that never alter the trajectory the checkpoint sits on) and
+    max_iter (a resume may extend the sweep budget).
+    """
+    return {
+        "k": int(k),
+        "d": int(d),
+        "update": cfg.update,
+        "empty": cfg.empty,
+        "init": cfg.init,
+        "seed": int(cfg.seed),
+        "tol": float(tol if tol is not None else cfg.tol),
+        "compute_dtype": (None if cfg.compute_dtype is None
+                          else str(cfg.compute_dtype)),
+        "center_update": center_update,
+    }
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout for the sharded engine (SURVEY.md §2.6).

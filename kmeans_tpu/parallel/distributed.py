@@ -22,7 +22,8 @@ import jax
 from kmeans_tpu.utils import faults
 from kmeans_tpu.utils.retry import RetryPolicy
 
-__all__ = ["ensure_initialized", "is_multiprocess", "process_info"]
+__all__ = ["ensure_initialized", "heartbeat", "is_multiprocess",
+           "process_info"]
 
 _initialized = False
 
@@ -119,6 +120,19 @@ def ensure_initialized(
             reset_partial_init(0, None)
         raise
     _initialized = True
+
+
+def heartbeat() -> None:
+    """Liveness probe at the elastic engine's segment boundaries.
+
+    jax.distributed's own health checking is connection-level; what the
+    elastic loop needs is a HOST-side site that fires once per segment so
+    the fault harness (``KMEANS_TPU_FAULTS=dist.heartbeat:...``) can model
+    a worker dying between collectives — the failure mode the two-process
+    DCN kill/resume drill rehearses.  Single-process runs hit the same
+    site, so the drill's timing is representative everywhere.
+    """
+    faults.check("dist.heartbeat")
 
 
 def is_multiprocess() -> bool:

@@ -25,8 +25,9 @@ over the stepped ``shard_map`` — one compiled program for the whole fit.
 from __future__ import annotations
 
 import functools
+import os
 import time
-from typing import Optional
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.config import KMeansConfig, engine_fingerprint
 from kmeans_tpu.models.init import init_centroids, resolve_fit_config
 from kmeans_tpu.models.lloyd import KMeansState
 from kmeans_tpu.obs import (
@@ -58,6 +59,7 @@ from kmeans_tpu.ops.pallas_lloyd import (
     lloyd_pass_pallas,
 )
 from kmeans_tpu.ops.update import apply_update
+from kmeans_tpu.utils import faults
 
 #: Sharded-engine observability (docs/OBSERVABILITY.md).  A sharded fit
 #: is ONE fused XLA program (the while_loop over the shard_map), so
@@ -85,6 +87,24 @@ _ENGINE_SHARDS = _obs_gauge(
     "kmeans_tpu_engine_shards",
     "Device count of the most recent sharded fit's mesh",
 )
+_ENGINE_CKPT_SECONDS = _obs_histogram(
+    "kmeans_tpu_engine_ckpt_seconds",
+    "Wall time of one engine checkpoint cut at a sweep boundary (device "
+    "pull of the finished global f32 centroids + verified atomic save)",
+)
+_ENGINE_RESUMES_TOTAL = _obs_counter(
+    "kmeans_tpu_engine_resumes_total",
+    "Sharded-fit resume attempts by outcome (ok = restored and continued; "
+    "finished = the checkpoint was already converged; refused = config "
+    "fingerprint contradiction; error = missing or corrupt checkpoint)",
+    labels=("outcome",),
+)
+
+#: Default sweep cadence of the elastic checkpoint loop: one host
+#: round-trip (centroid pull + verified save) every N sweeps bounds the
+#: overhead to ~cost(save)/N of a sweep — at the headline shape the save
+#: is milliseconds against a multi-second sweep, far under the 5% gate.
+ENGINE_CKPT_EVERY = 10
 
 
 def _mesh_layout(dp: int, mp: int, fp: int) -> str:
@@ -960,6 +980,10 @@ def fit_lloyd_sharded(
     tol: Optional[float] = None,
     max_iter: Optional[int] = None,
     center_update: str = "mean",
+    ckpt_dir: Optional[str] = None,
+    ckpt_every: Optional[int] = None,
+    ckpt_keep: int = 0,
+    resume: Union[bool, str] = False,
 ) -> KMeansState:
     """Full-batch Lloyd on a device mesh (DP, optionally DP×TP or DP×FP).
 
@@ -974,8 +998,36 @@ def fit_lloyd_sharded(
     coreset fits sharded at no extra cost.  Fractional weights demote the
     one-hot MXU update to the exact segment reduction (and gate off the
     bf16 kernel bodies) exactly as the single-device pass does.
+
+    ``ckpt_dir`` turns on elastic training: the fit runs as host-visible
+    sweep segments, and every ``ckpt_every`` sweeps (default
+    :data:`ENGINE_CKPT_EVERY`, and always on SIGTERM/SIGINT after the
+    in-flight segment drains) the finished GLOBAL f32 centroids are pulled
+    to host and saved as a checkpoint-v2 bundle (SHA-256 verified,
+    fsynced) together with the sweep index, RNG key, and a config
+    fingerprint.  The bundle is deliberately NOT per-device shards:
+    ``resume=True`` (or ``resume=<dir>``) restores it onto whatever mesh
+    THIS call was given — a different shape, device count, or comm mode —
+    because the delta/hamerly carried state is re-derived by the forced
+    refresh at each segment start.  Resume ignores ``init`` (the
+    checkpoint's centroids win) and refuses a checkpoint whose fingerprint
+    (k/d/update/tol/dtype/seed) contradicts this call's config.
     """
     cfg, key = resolve_fit_config(k, key, config)
+    if isinstance(resume, str) and resume:
+        if ckpt_dir is not None and (os.path.realpath(ckpt_dir)
+                                     != os.path.realpath(resume)):
+            raise ValueError(
+                f"resume={resume!r} names a different directory than "
+                f"ckpt_dir={ckpt_dir!r}; pass one of them"
+            )
+        ckpt_dir = resume
+    if resume and ckpt_dir is None:
+        raise ValueError(
+            "resume=True needs ckpt_dir (or pass the directory itself as "
+            "resume=<path>)"
+        )
+    elastic = ckpt_dir is not None
     if center_update not in ("mean", "sphere"):
         raise ValueError(f"unknown center_update {center_update!r}")
     if center_update == "sphere" and cfg.empty == "farthest":
@@ -990,6 +1042,13 @@ def fit_lloyd_sharded(
     dp = axis_sizes[data_axis]
     mp = axis_sizes[model_axis] if model_axis else 1
     fp = axis_sizes[feature_axis] if feature_axis else 1
+    if elastic and jax.process_count() > 1 and (model_axis or feature_axis):
+        raise ValueError(
+            "elastic checkpointing pulls the global centroids to host, "
+            "which needs them fully addressable on every process; "
+            "multi-process meshes are supported DP-only (model_axis/"
+            "feature_axis must be None)"
+        )
 
     d_real = x.shape[1]
     d_pad = (-d_real) % fp
@@ -1012,6 +1071,15 @@ def fit_lloyd_sharded(
     x = jax.device_put(x, NamedSharding(mesh, x_spec))
     w = jax.device_put(jnp.asarray(w_host), NamedSharding(mesh, P(data_axis)))
 
+    fp_want = (engine_fingerprint(cfg, k=k, d=d_real,
+                                  center_update=center_update, tol=tol)
+               if elastic else None)
+    start_it = 0
+    resume_meta = None
+    if resume:
+        init, start_it, resume_meta = _load_engine_resume(
+            ckpt_dir, fp_want, k=k, d_real=d_real)
+
     # --- init (global view; XLA auto-shards the init computation) ---
     if init is not None and not isinstance(init, str):
         c0 = jnp.asarray(init, jnp.float32)
@@ -1028,10 +1096,13 @@ def fit_lloyd_sharded(
             cfg=cfg,
         )
 
-    if center_update == "sphere":
+    if center_update == "sphere" and resume_meta is None:
         # Every init route (array, ++, ||, random) must land ON the sphere
         # (matching fit_spherical's c0 = normalize_rows(c0)): k-means||'s
         # refine step returns means of unit vectors, whose norm is < 1.
+        # Resumed centroids are a mid-trajectory cut that is already on
+        # the sphere — renormalizing would perturb them by an ulp and
+        # break exactness vs the uninterrupted run.
         from kmeans_tpu.models.spherical import normalize_rows
 
         c0 = normalize_rows(c0)
@@ -1103,6 +1174,19 @@ def fit_lloyd_sharded(
         cfg.comm, dp=dp, sharded_axes=bool(model_axis or feature_axis),
         k=k, d=x.shape[1],
     )
+    if elastic:
+        return _fit_lloyd_elastic(
+            x, w, c0, tol_v,
+            k=k, d_real=d_real, n=n, mesh=mesh, cfg=cfg, key=key,
+            data_axis=data_axis, model_axis=model_axis,
+            feature_axis=feature_axis, update=update, backend=backend,
+            comm=comm, center_update=center_update,
+            weights_binary=weights_binary, max_it=max_it,
+            dp=dp, mp=mp, fp=fp,
+            ckpt_dir=ckpt_dir, ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
+            start_it=start_it, resume_meta=resume_meta,
+            fingerprint=fp_want,
+        )
     if update == "delta":
         # DP incremental loop: per-shard carried (labels, sums, counts),
         # one psum per sweep, per-shard fallback on tile overflow.
@@ -1168,23 +1252,165 @@ def fit_lloyd_sharded(
     )
 
 
-@functools.lru_cache(maxsize=64)
-def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
-                     compute_dtype, update, max_it, backend="xla",
-                     empty="keep", feature_axis=None, weights_binary=True,
-                     center_update="mean", comm="allreduce"):
-    """Jitted whole-fit program, cached so repeated same-shaped fits reuse
-    the compiled executable (jax.jit caches by function identity).
+def _load_engine_resume(ckpt_dir, fp_want, *, k, d_real):
+    """Restore an elastic checkpoint: verified load, fingerprint check,
+    outcome accounting.  Returns ``(centroids, start_it, meta)`` — the
+    centroids feed the ordinary explicit-init path, so mesh placement and
+    padding are the same code every fresh fit runs."""
+    from kmeans_tpu.utils.checkpoint import (
+        CorruptCheckpointError,
+        load_array_checkpoint,
+    )
 
-    ``comm="scatter"`` (DP only — :func:`_resolve_comm` guarantees no
-    model/feature axis reaches here with it) swaps the sweep step for the
-    reduce-scatter merge body: the step returns the slice-computed global
-    shift directly and the while body consumes it instead of re-deriving
-    the shift from full centroids, and ``c0`` is donated — the gathered
-    f32 centroids replace it every sweep, so XLA can reuse the buffer.
+    faults.check("engine.resume")
+    try:
+        arrays, meta = load_array_checkpoint(ckpt_dir)
+    except (FileNotFoundError, CorruptCheckpointError):
+        _ENGINE_RESUMES_TOTAL.labels(outcome="error").inc()
+        raise
+    extra = meta.get("extra") or {}
+    fp_have = extra.get("fingerprint")
+    if fp_have != fp_want:
+        _ENGINE_RESUMES_TOTAL.labels(outcome="refused").inc()
+        raise ValueError(
+            f"refusing to resume from {ckpt_dir!r}: checkpoint fingerprint "
+            f"{fp_have!r} contradicts this fit's {fp_want!r} (k, d, update, "
+            "tol, dtype and seed must match; mesh shape, device count and "
+            "comm mode may differ freely)"
+        )
+    c_host = np.asarray(arrays["centroids"], np.float32)
+    if c_host.shape != (k, d_real):
+        # Unreachable when the fingerprint matched (it pins k and d);
+        # kept as a hard stop against a hand-edited meta.json.
+        _ENGINE_RESUMES_TOTAL.labels(outcome="refused").inc()
+        raise ValueError(
+            f"checkpoint centroids shape {c_host.shape} != {(k, d_real)}"
+        )
+    _ENGINE_RESUMES_TOTAL.labels(
+        outcome="finished" if extra.get("converged") else "ok").inc()
+    return c_host, int(meta.get("step", 0)), meta
+
+
+def _fit_lloyd_elastic(x, w, c0, tol_v, *, k, d_real, n, mesh, cfg, key,
+                       data_axis, model_axis, feature_axis, update,
+                       backend, comm, center_update, weights_binary,
+                       max_it, dp, mp, fp, ckpt_dir, ckpt_every,
+                       ckpt_keep, start_it, resume_meta, fingerprint):
+    """Host-segmented sweep loop with mesh-agnostic checkpoints.
+
+    The fit runs as compiled SEGMENTS of ``ckpt_every`` sweeps; at every
+    boundary the host sees the merged global centroids and (a) cuts a
+    checkpoint-v2 bundle, (b) polls the :class:`PreemptionGuard` —
+    SIGTERM/SIGINT lets the in-flight segment drain, cuts one final
+    checkpoint, and raises :class:`Preempted` with a copy-pasteable
+    resume hint.  The classic update's trajectory is identical to the
+    fused program's (same per-sweep shift test); delta/hamerly re-derive
+    their carried state at each segment start, so their trajectory equals
+    an uninterrupted ELASTIC run with the same cadence — the parity
+    contract the kill/resume drills assert.
     """
-    assert comm == "allreduce" or (model_axis is None
-                                   and feature_axis is None), comm
+    from kmeans_tpu.parallel.distributed import heartbeat
+    from kmeans_tpu.utils.checkpoint import save_array_checkpoint
+    from kmeans_tpu.utils.preempt import Preempted, PreemptionGuard
+
+    every = int(ckpt_every) if ckpt_every else ENGINE_CKPT_EVERY
+    if every <= 0:
+        raise ValueError(f"ckpt_every must be positive, got {ckpt_every}")
+    if update == "delta":
+        seg = _build_lloyd_delta_seg(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, backend,
+            cfg.empty, center_update, comm)
+        fin = _build_dense_final(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, backend,
+            center_update)
+    elif update == "hamerly":
+        seg = _build_lloyd_hamerly_seg(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, backend,
+            comm)
+        fin = _build_dense_final(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, backend,
+            "mean")
+    else:
+        wb = weights_binary if not (model_axis or feature_axis) else True
+        seg = _build_lloyd_seg(
+            mesh, data_axis, model_axis, k, cfg.chunk_size,
+            cfg.compute_dtype, update, backend, cfg.empty, feature_axis,
+            wb, center_update, comm)
+        fin = _build_lloyd_final(
+            mesh, data_axis, model_axis, k, cfg.chunk_size,
+            cfg.compute_dtype, update, backend, cfg.empty, feature_axis,
+            wb, center_update)
+    layout = _mesh_layout(dp, mp, fp)
+
+    def cut(c, it, done):
+        """One checkpoint: pull the finished global f32 centroids, save a
+        verified v2 bundle.  Multi-process meshes save from process 0
+        only (the centroids are replicated; every process loads the same
+        shared-filesystem bundle on resume)."""
+        faults.check("engine.ckpt")
+        t0 = time.perf_counter()
+        c_host = np.asarray(jax.device_get(c), np.float32)[:k, :d_real]
+        if jax.process_index() == 0:
+            save_array_checkpoint(
+                ckpt_dir, {"centroids": c_host}, step=it, config=cfg,
+                key=key,
+                extra={"engine": "fit_lloyd_sharded",
+                       "fingerprint": fingerprint, "converged": bool(done),
+                       "layout": layout, "comm": comm, "update": update},
+                keep=ckpt_keep,
+            )
+        _ENGINE_CKPT_SECONDS.observe(time.perf_counter() - t0)
+
+    it = start_it
+    done = bool(((resume_meta or {}).get("extra") or {}).get("converged"))
+    c = c0
+    t_run0 = time.perf_counter()
+    with PreemptionGuard() as guard, _tracing.span(
+            "fit_lloyd_sharded", category="fit", kind=f"lloyd.{update}",
+            backend=backend, layout=layout):
+        while it < max_it and not done:
+            stop = min(it + every, max_it)
+            with _tracing.span("sweep_segment", category="assign"):
+                c, it_a, _, done_a = seg(
+                    x, w, c, jnp.asarray(it, jnp.int32),
+                    jnp.asarray(stop, jnp.int32), tol_v)
+            # Host boundary: the segment's outputs are the merged global
+            # state every shard agrees on.
+            faults.check("engine.sweep_merge")
+            it, done = int(it_a), bool(done_a)
+            preempted = guard.triggered
+            cut(c, it, done)
+            heartbeat()
+            if preempted and not done and it < max_it:
+                raise Preempted.during(
+                    "fit_lloyd_sharded", path=ckpt_dir, step=it,
+                    resume_hint=f"--ckpt-dir {ckpt_dir} --resume {ckpt_dir}",
+                )
+        with _tracing.span("final_labeling", category="assign"):
+            _, inertia, counts, labels = fin(x, w, c)
+        if _OBS_REGISTRY.enabled:
+            with _tracing.span("host_sync", category="host_sync"):
+                jax.block_until_ready(labels)
+            _observe_sharded_fit(
+                f"lloyd.{update}", backend, layout, dp * mp * fp,
+                time.perf_counter() - t_run0, max(it - start_it, 1))
+            if not (model_axis or feature_axis):
+                costmodel.record_collective_bytes(
+                    f"lloyd.{update}", comm,
+                    _sweep_collective_bytes(comm, dp=dp, k=k, d=x.shape[1]))
+    return KMeansState(
+        c[:k, :d_real], labels[:n], inertia,
+        jnp.asarray(it, jnp.int32), jnp.asarray(done), counts[:k],
+    )
+
+
+def _lloyd_step_final(mesh, data_axis, model_axis, k_real, chunk_size,
+                      compute_dtype, update, backend, empty, feature_axis,
+                      weights_binary, center_update, comm):
+    """Build the (step, final) shard_mapped passes of the classic update —
+    the one copy of the body/spec selection shared by the fused whole-fit
+    program (:func:`_build_lloyd_run`) and the elastic sweep-segment
+    program (:func:`_build_lloyd_seg`)."""
     use_pallas = backend in ("pallas", "pallas_interpret")
     interpret = backend == "pallas_interpret"
     if model_axis is not None and feature_axis is not None:
@@ -1281,6 +1507,31 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
         functools.partial(local, **final_kw),
         mesh=mesh, in_specs=in_specs, out_specs=out_final, check_vma=False,
     )
+    return step, final
+
+
+@functools.lru_cache(maxsize=64)
+def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
+                     compute_dtype, update, max_it, backend="xla",
+                     empty="keep", feature_axis=None, weights_binary=True,
+                     center_update="mean", comm="allreduce"):
+    """Jitted whole-fit program, cached so repeated same-shaped fits reuse
+    the compiled executable (jax.jit caches by function identity).
+
+    ``comm="scatter"`` (DP only — :func:`_resolve_comm` guarantees no
+    model/feature axis reaches here with it) swaps the sweep step for the
+    reduce-scatter merge body: the step returns the slice-computed global
+    shift directly and the while body consumes it instead of re-deriving
+    the shift from full centroids, and ``c0`` is donated — the gathered
+    f32 centroids replace it every sweep, so XLA can reuse the buffer.
+    """
+    assert comm == "allreduce" or (model_axis is None
+                                   and feature_axis is None), comm
+    step, final = _lloyd_step_final(
+        mesh, data_axis, model_axis, k_real, chunk_size, compute_dtype,
+        update, backend, empty, feature_axis, weights_binary,
+        center_update, comm,
+    )
 
     def run(x, w, c0, tol_v):
         def cond(s):
@@ -1308,6 +1559,71 @@ def _build_lloyd_run(mesh, data_axis, model_axis, k_real, chunk_size,
     name = ("engine.lloyd_scatter_run" if comm == "scatter"
             else "engine.lloyd_run")
     return costmodel.observe(run, name=name)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_seg(mesh, data_axis, model_axis, k_real, chunk_size,
+                     compute_dtype, update, backend="xla", empty="keep",
+                     feature_axis=None, weights_binary=True,
+                     center_update="mean", comm="allreduce"):
+    """Jitted sweep-SEGMENT program for the elastic checkpoint loop: runs
+    sweeps ``[it0, it_stop)`` of the classic update and hands control back
+    to the host at the boundary.  ``it0``/``it_stop`` are traced scalars,
+    so every segment length (including the short tail before ``max_iter``)
+    reuses one compiled executable.  Replicated global centroids are the
+    ONLY state crossing the boundary — which is exactly what makes the
+    checkpoint cut there mesh-agnostic."""
+    assert comm == "allreduce" or (model_axis is None
+                                   and feature_axis is None), comm
+    step, _ = _lloyd_step_final(
+        mesh, data_axis, model_axis, k_real, chunk_size, compute_dtype,
+        update, backend, empty, feature_axis, weights_binary,
+        center_update, comm,
+    )
+
+    def seg(x, w, c0, it0, it_stop, tol_v):
+        def cond(s):
+            c, it, shift_sq, done = s
+            return (it < it_stop) & ~done
+
+        def body(s):
+            c, it, _, _ = s
+            if comm == "scatter":
+                new_c, shift_sq, _ = step(x, c, w)
+            else:
+                new_c, _, _ = step(x, c, w)
+                shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v)
+
+        return lax.while_loop(
+            cond, body, (c0, it0, jnp.asarray(jnp.inf, jnp.float32),
+                         jnp.zeros((), bool)),
+        )
+
+    seg = jax.jit(seg, donate_argnums=(2,) if comm == "scatter" else ())
+    name = ("engine.lloyd_seg_scatter_run" if comm == "scatter"
+            else "engine.lloyd_seg_run")
+    return costmodel.observe(seg, name=name)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_final(mesh, data_axis, model_axis, k_real, chunk_size,
+                       compute_dtype, update, backend="xla", empty="keep",
+                       feature_axis=None, weights_binary=True,
+                       center_update="mean"):
+    """Jitted final labeling pass for the elastic loop — cached WITHOUT
+    ``comm`` in the key (the final pass always merges by allreduce), so
+    one executable serves every comm mode a fit shape resumes under."""
+    _, final = _lloyd_step_final(
+        mesh, data_axis, model_axis, k_real, chunk_size, compute_dtype,
+        update, backend, empty, feature_axis, weights_binary,
+        center_update, "allreduce",
+    )
+
+    def fin(x, w, c):
+        return final(x, c, w)
+
+    return costmodel.observe(jax.jit(fin), name="engine.lloyd_final_run")
 
 
 def _dp_delta_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
@@ -1356,6 +1672,60 @@ def _dp_delta_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
     return new_c, labels, sums_new, counts_new
 
 
+def _dense_final_sm(mesh, data_axis, chunk_size, compute_dtype, backend,
+                    center_update):
+    """The classic dense DP labeling pass as a shard_map — the shared
+    final pass of the delta and hamerly programs (fused and segmented)."""
+    final_local = functools.partial(
+        _dp_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, update="matmul", backend=backend,
+        with_labels=True, empty="keep", center_update=center_update,
+    )
+    return jax.shard_map(
+        final_local, mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis)),
+        out_specs=(P(), P(), P(), P(data_axis)),
+        check_vma=False,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _build_dense_final(mesh, data_axis, chunk_size, compute_dtype, backend,
+                       center_update="mean"):
+    """Jitted standalone dense labeling pass for the elastic delta and
+    hamerly loops (their segments carry no labels across the boundary,
+    so the final pass is a separate one-compile program)."""
+    final = _dense_final_sm(mesh, data_axis, chunk_size, compute_dtype,
+                            backend, center_update)
+
+    def fin(x, w, c):
+        return final(x, c, w)
+
+    return costmodel.observe(jax.jit(fin),
+                             name="engine.lloyd_dense_final_run")
+
+
+def _delta_step_sm(mesh, data_axis, chunk_size, compute_dtype, backend,
+                   empty, center_update, comm):
+    """The delta sweep step as a shard_map, shared by the fused and
+    segmented delta programs."""
+    local = functools.partial(
+        _dp_delta_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, backend=backend, empty=empty,
+        center_update=center_update, comm=comm,
+    )
+    step_out = (P(), P(data_axis), P(data_axis), P(data_axis))
+    if comm == "scatter":
+        step_out = step_out + (P(),)                       # shift_sq
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis), P(data_axis),
+                  P(data_axis), P(data_axis), P()),
+        out_specs=step_out,
+        check_vma=False,
+    )
+
+
 @functools.lru_cache(maxsize=32)
 def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
                            max_it, backend, empty, center_update,
@@ -1366,32 +1736,10 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
     pass is the classic dense body (same as every other run builder).
     ``comm="scatter"`` only changes how the per-shard (sums, counts) merge
     into centroids — the carried delta state is untouched."""
-    local = functools.partial(
-        _dp_delta_local_pass, data_axis=data_axis, chunk_size=chunk_size,
-        compute_dtype=compute_dtype, backend=backend, empty=empty,
-        center_update=center_update, comm=comm,
-    )
-    step_out = (P(), P(data_axis), P(data_axis), P(data_axis))
-    if comm == "scatter":
-        step_out = step_out + (P(),)                       # shift_sq
-    step = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(data_axis), P(), P(data_axis), P(data_axis),
-                  P(data_axis), P(data_axis), P()),
-        out_specs=step_out,
-        check_vma=False,
-    )
-    final_local = functools.partial(
-        _dp_local_pass, data_axis=data_axis, chunk_size=chunk_size,
-        compute_dtype=compute_dtype, update="matmul", backend=backend,
-        with_labels=True, empty="keep", center_update=center_update,
-    )
-    final = jax.shard_map(
-        final_local, mesh=mesh,
-        in_specs=(P(data_axis), P(), P(data_axis)),
-        out_specs=(P(), P(), P(), P(data_axis)),
-        check_vma=False,
-    )
+    step = _delta_step_sm(mesh, data_axis, chunk_size, compute_dtype,
+                          backend, empty, center_update, comm)
+    final = _dense_final_sm(mesh, data_axis, chunk_size, compute_dtype,
+                            backend, center_update)
     dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
     from kmeans_tpu.ops.delta import DELTA_REFRESH
 
@@ -1436,6 +1784,57 @@ def _build_lloyd_delta_run(mesh, data_axis, chunk_size, compute_dtype,
     return costmodel.observe(run, name=name)
 
 
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_delta_seg(mesh, data_axis, chunk_size, compute_dtype,
+                           backend, empty, center_update,
+                           comm="allreduce"):
+    """Sweep-segment program for the delta update.  Every segment rebuilds
+    the carried per-shard (labels, sums, counts) from the sentinel — the
+    first sweep of a segment is a forced full refresh, and the cadence
+    inside a segment is SEGMENT-relative (``(it - it0) % DELTA_REFRESH``).
+    A resumed run therefore replays the exact refresh schedule of an
+    uninterrupted run with the same ``ckpt_every``, and centroids alone
+    cross the boundary — the delta checkpoint is mesh-agnostic."""
+    step = _delta_step_sm(mesh, data_axis, chunk_size, compute_dtype,
+                          backend, empty, center_update, comm)
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+    from kmeans_tpu.ops.delta import DELTA_REFRESH
+
+    def seg(x, w, c0, it0, it_stop, tol_v):
+        n = x.shape[0]
+        k, d = c0.shape
+
+        def cond(s):
+            return (s[1] < it_stop) & ~s[3]
+
+        def body(s):
+            c, it, _, _, lab, sums, counts = s
+            refresh = ((it - it0) % DELTA_REFRESH) == 0
+            if comm == "scatter":
+                new_c, lab, sums, counts, shift_sq = step(
+                    x, c, w, lab, sums, counts, refresh)
+            else:
+                new_c, lab, sums, counts = step(
+                    x, c, w, lab, sums, counts, refresh)
+                shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
+                    counts)
+
+        init = (
+            c0, it0,
+            jnp.asarray(jnp.inf, jnp.float32), jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),     # sentinel -> first sweep full
+            jnp.zeros((dp * k, d), jnp.float32),
+            jnp.zeros((dp * k,), jnp.float32),
+        )
+        return lax.while_loop(cond, body, init)[:4]
+
+    seg = jax.jit(seg, donate_argnums=(2,) if comm == "scatter" else ())
+    name = ("engine.lloyd_delta_seg_scatter_run" if comm == "scatter"
+            else "engine.lloyd_delta_seg_run")
+    return costmodel.observe(seg, name=name)
+
+
 def _dp_hamerly_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
                            sb, slb, c_cd, csq_prev, rno_loc, *, data_axis,
                            chunk_size, compute_dtype, backend,
@@ -1475,15 +1874,11 @@ def _dp_hamerly_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
     return (new_c, labels, sums_new, counts_new, sb2, slb2, c_cd2, csq2)
 
 
-@functools.lru_cache(maxsize=32)
-def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
-                             max_it, backend, comm="allreduce"):
-    """Jitted whole-fit program for the DP ``update="hamerly"`` path:
-    like :func:`_build_lloyd_delta_run` but the carried per-shard state
-    additionally holds the (sb, slb) score bounds, and the refresh
-    cadence resets via the sentinel trick OUTSIDE the shard body
-    (elementwise on the sharded arrays — no collectives)."""
-    from kmeans_tpu.ops.delta import DELTA_REFRESH
+def _hamerly_step_parts(mesh, data_axis, chunk_size, compute_dtype,
+                        backend, comm):
+    """The hamerly sweep step + row-norms pass as shard_maps, shared by
+    the fused and segmented hamerly programs.  Returns
+    ``(step, rno_sm, dp, cd)``."""
     from kmeans_tpu.ops.hamerly import row_norms
 
     local = functools.partial(
@@ -1508,20 +1903,26 @@ def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
         mesh=mesh, in_specs=(P(data_axis),), out_specs=P(data_axis),
         check_vma=False,
     )
-    final_local = functools.partial(
-        _dp_local_pass, data_axis=data_axis, chunk_size=chunk_size,
-        compute_dtype=compute_dtype, update="matmul", backend=backend,
-        with_labels=True, empty="keep", center_update="mean",
-    )
-    final = jax.shard_map(
-        final_local, mesh=mesh,
-        in_specs=(P(data_axis), P(), P(data_axis)),
-        out_specs=(P(), P(), P(), P(data_axis)),
-        check_vma=False,
-    )
     dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
     cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
           else None)
+    return step, rno_sm, dp, cd
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
+                             max_it, backend, comm="allreduce"):
+    """Jitted whole-fit program for the DP ``update="hamerly"`` path:
+    like :func:`_build_lloyd_delta_run` but the carried per-shard state
+    additionally holds the (sb, slb) score bounds, and the refresh
+    cadence resets via the sentinel trick OUTSIDE the shard body
+    (elementwise on the sharded arrays — no collectives)."""
+    from kmeans_tpu.ops.delta import DELTA_REFRESH
+
+    step, rno_sm, dp, cd = _hamerly_step_parts(
+        mesh, data_axis, chunk_size, compute_dtype, backend, comm)
+    final = _dense_final_sm(mesh, data_axis, chunk_size, compute_dtype,
+                            backend, "mean")
 
     def run(x, w, c0, tol_v):
         n = x.shape[0]
@@ -1571,6 +1972,67 @@ def _build_lloyd_hamerly_run(mesh, data_axis, chunk_size, compute_dtype,
     name = ("engine.lloyd_hamerly_scatter_run" if comm == "scatter"
             else "engine.lloyd_hamerly_run")
     return costmodel.observe(run, name=name)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_hamerly_seg(mesh, data_axis, chunk_size, compute_dtype,
+                             backend, comm="allreduce"):
+    """Sweep-segment program for the hamerly update: like
+    :func:`_build_lloyd_delta_seg`, the segment starts from the sentinel
+    (labels -1, zeroed sums/counts/bounds) so its first sweep is a full
+    refresh that re-derives every carried quantity — including the score
+    bounds — from the replicated centroids alone."""
+    from kmeans_tpu.ops.delta import DELTA_REFRESH
+
+    step, rno_sm, dp, cd = _hamerly_step_parts(
+        mesh, data_axis, chunk_size, compute_dtype, backend, comm)
+
+    def seg(x, w, c0, it0, it_stop, tol_v):
+        n = x.shape[0]
+        k, d = c0.shape
+        f32 = jnp.float32
+        rno = rno_sm(x)
+        c_cd0 = c0.astype(cd if cd is not None else x.dtype)
+
+        def cond(s):
+            return (s[1] < it_stop) & ~s[3]
+
+        def body(s):
+            (c, it, _, _, lab, sums, counts, sb, slb, c_cd, csq) = s
+            refresh = ((it - it0) % DELTA_REFRESH) == 0
+            lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
+            sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
+            counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
+            if comm == "scatter":
+                (new_c, lab, sums, counts, sb, slb, c_cd, csq,
+                 shift_sq) = step(
+                    x, c, w, lab_e, sums_e, counts_e, sb, slb, c_cd, csq,
+                    rno)
+            else:
+                (new_c, lab, sums, counts, sb, slb, c_cd, csq) = step(
+                    x, c, w, lab_e, sums_e, counts_e, sb, slb, c_cd, csq,
+                    rno)
+                shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
+                    counts, sb, slb, c_cd, csq)
+
+        init = (
+            c0, it0,
+            jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((dp * k, d), f32),
+            jnp.zeros((dp * k,), f32),
+            jnp.zeros((n,), f32),              # sb
+            jnp.zeros((n,), f32),              # slb
+            c_cd0,
+            jnp.zeros((k,), f32),
+        )
+        return lax.while_loop(cond, body, init)[:4]
+
+    seg = jax.jit(seg, donate_argnums=(2,) if comm == "scatter" else ())
+    name = ("engine.lloyd_hamerly_seg_scatter_run" if comm == "scatter"
+            else "engine.lloyd_hamerly_seg_run")
+    return costmodel.observe(seg, name=name)
 
 
 @functools.lru_cache(maxsize=32)

@@ -488,6 +488,17 @@ def load_checkpoint(path: str) -> Tuple[Any, dict]:
     from kmeans_tpu.models.lloyd import KMeansState
 
     arrays, meta = load_array_checkpoint(path)
+    missing = [f for f in ("centroids", "labels", "inertia", "n_iter",
+                           "converged", "counts") if f not in arrays]
+    if missing:
+        # A digest-valid bundle of the WRONG kind (e.g. the elastic
+        # engine's centroids-only checkpoint) must be a clear refusal,
+        # not a KeyError from the middle of state reconstruction.
+        engine = (meta.get("extra") or {}).get("engine")
+        saved_by = f"; it was saved by {engine}" if engine else ""
+        raise ValueError(
+            f"checkpoint at {path!r} is not a step-paced runner "
+            f"checkpoint (missing {', '.join(missing)}){saved_by}")
     state = KMeansState(
         arrays["centroids"],
         arrays["labels"],

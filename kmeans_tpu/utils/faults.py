@@ -21,6 +21,10 @@ Site catalog (see docs/RESILIENCE.md for the authoritative list):
 ``stream.read``         one host batch/chunk read in the streaming loader
 ``native.compile``      the native loader's g++ invocation
 ``dist.init``           ``jax.distributed.initialize`` attempt
+``dist.heartbeat``      per-segment liveness probe of the elastic engine
+``engine.sweep_merge``  elastic sweep segment returned, merged state on host
+``engine.ckpt``         elastic engine checkpoint cut, before the save
+``engine.resume``       elastic engine resume, before the verified load
 ``serve.sse_emit``      one SSE event write in the serve layer
 ``continuous.compact``  sliding-window coreset compaction, pre-mutation
 ``continuous.refit``    continuous-pipeline refit, before the fit runs
@@ -62,8 +66,21 @@ import time
 from contextlib import contextmanager
 from typing import Iterable, Optional
 
+from kmeans_tpu.obs import counter as _obs_counter
+
 __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "check", "install",
            "clear", "active", "parse_spec"]
+
+#: Fires only when a rule actually injects (never on the zero-cost no-op
+#: path), so a drill's assertion "the fault really happened" has a metric
+#: to read — and a soak report can show which sites a run exercised.
+_FAULT_INJECTIONS_TOTAL = _obs_counter(
+    "kmeans_tpu_fault_injections_total",
+    "Fault-harness injections that fired, by site and action (counts "
+    "actual injections, not site visits; kill injections exit before "
+    "any scrape and are visible only to same-process readers)",
+    labels=("site", "action"),
+)
 
 
 class InjectedFault(OSError):
@@ -135,6 +152,7 @@ class FaultPlan:
                     break
         if fire is None:
             return
+        _FAULT_INJECTIONS_TOTAL.labels(site=site, action=fire.action).inc()
         if fire.action == "raise":
             raise InjectedFault(f"injected fault at {site!r}")
         if fire.action == "stall":

@@ -6,6 +6,18 @@ devices across both processes, and one full sharded fit runs over it —
 the same engine code path that rides ICI single-host rides DCN here.
 
 Usage: python dcn_worker.py <coordinator_addr> <num_procs> <process_id>
+
+The elastic drill mode (ISSUE 14) reuses the same join flow for the
+two-process kill/resume drill::
+
+    python dcn_worker.py <coord> <nproc> <pid> elastic <ckpt_dir> <0|1>
+
+Both workers run an elastic ``fit_lloyd_sharded`` over the joint mesh;
+the driver injects ``engine.sweep_merge:kill@2`` into BOTH processes (a
+coordinated preemption — every worker dies at the same sweep boundary,
+so no survivor hangs in a collective), then restarts both on a fresh
+coordinator port with the final argument ``1`` to resume from the
+checkpoint process 0 saved.
 """
 
 import os
@@ -28,8 +40,38 @@ from kmeans_tpu.parallel.distributed import (  # noqa: E402
 )
 
 
+def elastic_main(coord, nproc, pid, ckpt_dir, resume):
+    """The two-process elastic kill/resume drill body (ISSUE 14).
+
+    DP-only over the joint mesh (elastic + multiprocess is DP-only by
+    contract: the host checkpoint pull needs fully addressable
+    centroids).  Classic update, so the resumed trajectory is exactly
+    the uninterrupted one — the driver asserts parity on the replicated
+    outputs (counts, inertia, n_iter) against a single-process fit."""
+    ensure_initialized(coord, nproc, pid)
+    info = process_info()
+    assert info["process_count"] == nproc, info
+    assert is_multiprocess()
+
+    from kmeans_tpu.parallel import fit_lloyd_sharded, make_mesh
+
+    rng = np.random.default_rng(5)
+    k, n, d = 5, 512, 8
+    x = (rng.normal(size=(n, d)) * 2.0).astype(np.float32)
+    mesh = make_mesh((4 * nproc, 1), ("data", "model"))
+    kw = {"resume": True} if resume else {"init": x[:k].copy()}
+    st = fit_lloyd_sharded(x, k, mesh=mesh, tol=0.0, max_iter=24,
+                           ckpt_dir=ckpt_dir, ckpt_every=3, **kw)
+    counts = ",".join(str(int(c)) for c in np.asarray(st.counts))
+    print(f"DCN_ELASTIC_OK pid={pid} sweeps={int(st.n_iter)} "
+          f"inertia={float(st.inertia):.6f} counts={counts}", flush=True)
+
+
 def main():
     coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    if len(sys.argv) > 4 and sys.argv[4] == "elastic":
+        elastic_main(coord, nproc, pid, sys.argv[5], sys.argv[6] == "1")
+        return
     ensure_initialized(coord, nproc, pid)
     info = process_info()
     assert info["process_count"] == nproc, info

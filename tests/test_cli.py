@@ -767,3 +767,84 @@ def test_continuous_resume_round_trip(tmp_path, capsys):
     lines = [json.loads(line) for line in out.splitlines()]
     assert lines[0]["event"] == "resumed" and lines[0]["generation"] >= 1
     assert lines[-1]["event"] == "done" and lines[-1]["batches"] == 12
+
+
+# ---------------------------------------------------------------------------
+# Elastic sharded training on the CLI (ISSUE 14): --ckpt-dir/--ckpt-every/
+# --resume on the sharded train path
+# ---------------------------------------------------------------------------
+
+
+def test_cli_engine_ckpt_validation_errors(tmp_path, capsys):
+    """Every invalid --ckpt-dir combination is one actionable line + exit
+    2: the flag is the sharded-engine path's, not the runner's."""
+    ck = str(tmp_path / "ck")
+    for argv in (
+        # elastic is the sharded engine: no mesh / mesh 1 can't take it
+        ["train", "--n", "200", "--d", "4", "--k", "3",
+         "--ckpt-dir", ck],
+        # step-paced runner flags pace by iteration, not sweep segment
+        ["train", "--n", "200", "--d", "4", "--k", "3", "--mesh", "8",
+         "--ckpt-dir", ck, "--progress"],
+        # --ckpt-every without --ckpt-dir
+        ["train", "--n", "200", "--d", "4", "--k", "3", "--mesh", "8",
+         "--ckpt-every", "5"],
+        # --resume naming a different directory than --ckpt-dir
+        ["train", "--n", "200", "--d", "4", "--k", "3", "--mesh", "8",
+         "--ckpt-dir", ck, "--resume", str(tmp_path / "other")],
+    ):
+        rc, _, err = _run(capsys, argv)
+        assert rc == 2, argv
+        assert "Traceback" not in err
+        assert "--ckpt-dir" in err or "--ckpt-every" in err or \
+            "--resume" in err, err
+
+
+def test_cli_engine_ckpt_resume_round_trip(tmp_path, capsys):
+    """Sharded train with --ckpt-dir, then --resume on a SMALLER mesh:
+    the mesh-agnostic bundle restores and the fit completes."""
+    ck = str(tmp_path / "ck")
+    base = ["train", "--n", "512", "--d", "6", "--k", "4", "--seed", "3",
+            "--max-iter", "40", "--tol", "0", "--ckpt-dir", ck]
+    rc, out, _ = _run(capsys, base + ["--mesh", "8"])
+    assert rc == 0
+    first = json.loads(out.splitlines()[0])
+    assert first["mode"] == "lloyd"
+    rc, out, err = _run(capsys, base + ["--mesh", "4", "--resume", ck])
+    assert rc == 0
+    assert "resuming sharded fit" in err
+    again = json.loads(out.splitlines()[0])
+    assert again["inertia"] == pytest.approx(first["inertia"], rel=1e-5)
+
+
+def test_cli_engine_resume_empty_dir_is_clean_error(tmp_path, capsys):
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    rc, _, err = _run(capsys, [
+        "train", "--n", "200", "--d", "4", "--k", "3", "--mesh", "8",
+        "--ckpt-dir", ck, "--resume", ck,
+    ])
+    assert rc == 2
+    assert "Traceback" not in err
+    assert "no checkpoint" in err
+
+
+def test_cli_engine_bundle_to_runner_resume_is_clean_error(tmp_path,
+                                                          capsys):
+    """--resume pointing at an ELASTIC engine bundle without --ckpt-dir
+    routes to the step-paced runner; that must be a clean refusal with a
+    hint to the right flags, not a KeyError from state reconstruction."""
+    ck = str(tmp_path / "ck")
+    rc, _, _ = _run(capsys, [
+        "train", "--n", "256", "--d", "4", "--k", "3", "--seed", "3",
+        "--mesh", "8", "--ckpt-dir", ck,
+    ])
+    assert rc == 0
+    rc, _, err = _run(capsys, [
+        "train", "--n", "256", "--d", "4", "--k", "3", "--seed", "3",
+        "--resume", ck,
+    ])
+    assert rc == 2
+    assert "Traceback" not in err and "KeyError" not in err
+    assert "not a step-paced runner checkpoint" in err
+    assert f"--ckpt-dir {ck} --resume {ck}" in err

@@ -52,6 +52,79 @@ def test_two_process_dcn_fit():
         assert f"DCN_OK pid={pid} procs=2 devices=8" in out, out
 
 
+def _spawn_workers(coord, extra, *, fault=None):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("KMEANS_TPU_FAULTS", None)
+    if fault:
+        env["KMEANS_TPU_FAULTS"] = fault
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coord, "2", str(pid)] + extra,
+            cwd=_REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+@pytest.mark.slow
+def test_two_process_dcn_kill_resume_elastic(tmp_path):
+    """The DCN half of the ISSUE 14 drill: BOTH workers are killed at the
+    same sweep boundary (a coordinated preemption — no survivor left
+    hanging in a collective), then both restart on a FRESH coordinator
+    port and resume from the checkpoint process 0 cut.  Parity on the
+    replicated outputs against a single-process fit of the same problem
+    (classic update: the elastic trajectory equals the fused one).
+
+    On images whose jax CPU backend cannot run multiprocess computations
+    (the current 0.4.37 image raises INVALID_ARGUMENT on any
+    cross-process collective) this drill is env-xfailed in conftest.py
+    alongside test_two_process_dcn_fit — same root cause."""
+    import numpy as np
+
+    from kmeans_tpu.utils.checkpoint import latest_step
+
+    ck = str(tmp_path / "ck")
+    extra = ["elastic", ck, "0"]
+    procs, outs = _spawn_workers(f"127.0.0.1:{_free_port()}", extra,
+                                 fault="engine.sweep_merge:kill@2")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 137, f"worker {pid}: {p.returncode}\n{out}"
+    assert latest_step(ck) == 3
+
+    procs, outs = _spawn_workers(f"127.0.0.1:{_free_port()}",
+                                 ["elastic", ck, "1"])
+    rows = {}
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith("DCN_ELASTIC_OK"))
+        rows[pid] = dict(tok.split("=", 1) for tok in line.split()[1:])
+
+    from kmeans_tpu.models import fit_lloyd
+
+    rng = np.random.default_rng(5)
+    x = (rng.normal(size=(512, 8)) * 2.0).astype(np.float32)
+    want = fit_lloyd(x, 5, init=x[:5].copy(), tol=0.0, max_iter=24)
+    for pid in (0, 1):
+        assert rows[pid]["sweeps"] == str(int(want.n_iter))
+        assert rows[pid]["counts"] == ",".join(
+            str(int(c)) for c in np.asarray(want.counts))
+        assert float(rows[pid]["inertia"]) == pytest.approx(
+            float(want.inertia), rel=1e-5)
+
+
 def test_ensure_initialized_noop_without_config():
     from kmeans_tpu.parallel.distributed import ensure_initialized
 

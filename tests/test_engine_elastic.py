@@ -1,0 +1,178 @@
+"""Elastic sharded training (ISSUE 14): mesh-migrating checkpoint/resume.
+
+The parity contract under test (docs/RESILIENCE.md "Elastic sharded
+training"): the classic update's elastic trajectory is identical to the
+fused whole-fit program's, so a kill/resume run — even one that resumes
+on a different mesh shape, device count, or comm mode — must finish
+label-exact against the plain uninterrupted fit.  Delta/hamerly re-derive
+their carried bounds at every segment start, so their yardstick is an
+uninterrupted ELASTIC run with the same ``ckpt_every`` cadence.
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.parallel import cpu_mesh, fit_lloyd_sharded
+from kmeans_tpu.parallel.engine import _ENGINE_RESUMES_TOTAL
+from kmeans_tpu.utils import faults
+from kmeans_tpu.utils.checkpoint import latest_step
+from kmeans_tpu.utils.preempt import Preempted
+
+K = 10
+MAX_IT = 40
+
+
+@pytest.fixture(scope="module")
+def xdata():
+    rng = np.random.default_rng(1)
+    return rng.normal(size=(1024, 16)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def c0(xdata):
+    return xdata[:K].copy()
+
+
+@pytest.fixture(scope="module")
+def ref_plain(xdata, c0, cpu_devices):
+    """The uninterrupted fused fit on (8, 1) — the classic-update yardstick."""
+    return fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), init=c0,
+                             tol=0.0, max_iter=MAX_IT)
+
+
+def _assert_same(got, want):
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids), atol=1e-5)
+    assert int(got.n_iter) == int(want.n_iter)
+
+
+def test_classic_elastic_matches_fused(xdata, c0, ref_plain, cpu_devices,
+                                       tmp_path):
+    got = fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), init=c0,
+                            tol=0.0, max_iter=MAX_IT,
+                            ckpt_dir=str(tmp_path / "ck"), ckpt_every=4)
+    _assert_same(got, ref_plain)
+    assert latest_step(str(tmp_path / "ck")) == int(got.n_iter)
+
+
+def test_mesh_migration_dp_to_tp(xdata, c0, ref_plain, cpu_devices,
+                                 tmp_path):
+    """Partial fit on the (8, 1) DP mesh, resumed on a (4, 2) DP x TP
+    mesh: the checkpoint carries global f32 centroids, not shards, so the
+    new mesh re-places them like any explicit init."""
+    ck = str(tmp_path / "ck")
+    part = fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), init=c0,
+                             tol=0.0, max_iter=7, ckpt_dir=ck,
+                             ckpt_every=3)
+    assert not bool(part.converged)
+    got = fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((4, 2)),
+                            model_axis="model", tol=0.0, max_iter=MAX_IT,
+                            resume=ck, ckpt_every=3)
+    _assert_same(got, ref_plain)
+
+
+def test_preempt_resume_scatter_to_allreduce_shrunk(xdata, c0, ref_plain,
+                                                    cpu_devices, tmp_path):
+    """SIGTERM mid-run on 8 devices with comm='scatter', resume on a
+    4-device mesh with comm='allreduce'.  k=10 does not divide either dp,
+    exercising the scatter update's k-padding on both meshes.  Classic
+    update, so the plain fused fit stays the yardstick."""
+    ck = str(tmp_path / "ck")
+    cfg = KMeansConfig(k=K, max_iter=MAX_IT, tol=0.0, comm="scatter")
+    before = _ENGINE_RESUMES_TOTAL.value(outcome="ok")
+    with faults.active("engine.sweep_merge:sigterm@2"):
+        with pytest.raises(Preempted) as ei:
+            fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), config=cfg,
+                              init=c0, ckpt_dir=ck, ckpt_every=4)
+    assert ei.value.step == 8
+    assert latest_step(ck) == 8
+    assert ck in ei.value.resume_hint
+    cfg2 = KMeansConfig(k=K, max_iter=MAX_IT, tol=0.0, comm="allreduce")
+    got = fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((4, 1)), config=cfg2,
+                            resume=ck, ckpt_every=4)
+    assert _ENGINE_RESUMES_TOTAL.value(outcome="ok") == before + 1
+    _assert_same(got, ref_plain)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("update,comm", [
+    ("delta", "allreduce"), ("delta", "scatter"),
+    ("hamerly", "allreduce"), ("hamerly", "scatter"),
+])
+def test_bounds_family_kill_resume_exact(xdata, c0, cpu_devices, tmp_path,
+                                         update, comm):
+    """The delta/hamerly kill matrix: preempt at a sweep boundary, resume
+    on a shrunk mesh with the comm mode flipped to allreduce, and land
+    label-exact on the uninterrupted ELASTIC run with the same cadence
+    (bounds are re-derived by the segment-start refresh, so cadence — not
+    mesh or comm — defines the trajectory)."""
+    cfg = KMeansConfig(k=K, max_iter=MAX_IT, tol=0.0, update=update,
+                       comm=comm)
+    ck = str(tmp_path / "a")
+    ref = fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), config=cfg,
+                            init=c0, ckpt_dir=str(tmp_path / "b"),
+                            ckpt_every=4)
+    with faults.active("engine.sweep_merge:sigterm@2"):
+        with pytest.raises(Preempted):
+            fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), config=cfg,
+                              init=c0, ckpt_dir=ck, ckpt_every=4)
+    cfg2 = KMeansConfig(k=K, max_iter=MAX_IT, tol=0.0, update=update,
+                        comm="allreduce")
+    got = fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((4, 1)), config=cfg2,
+                            resume=ck, ckpt_every=4)
+    _assert_same(got, ref)
+
+
+def test_resume_fingerprint_mismatch_refused(xdata, c0, cpu_devices,
+                                             tmp_path):
+    """A checkpoint from a different problem (here: different seed, which
+    the fingerprint pins) must be refused, not silently adopted."""
+    ck = str(tmp_path / "ck")
+    fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), init=c0, tol=0.0,
+                      max_iter=4, ckpt_dir=ck, ckpt_every=2)
+    before = _ENGINE_RESUMES_TOTAL.value(outcome="refused")
+    with pytest.raises(ValueError, match="fingerprint"):
+        fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)),
+                          config=KMeansConfig(k=K, seed=99, tol=0.0),
+                          resume=ck)
+    assert _ENGINE_RESUMES_TOTAL.value(outcome="refused") == before + 1
+
+
+def test_resume_missing_checkpoint_errors(xdata, cpu_devices, tmp_path):
+    before = _ENGINE_RESUMES_TOTAL.value(outcome="error")
+    with pytest.raises(FileNotFoundError):
+        fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), tol=0.0,
+                          resume=str(tmp_path / "nope"))
+    assert _ENGINE_RESUMES_TOTAL.value(outcome="error") == before + 1
+
+
+def test_resume_converged_checkpoint_short_circuits(xdata, c0, ref_plain,
+                                                    cpu_devices, tmp_path):
+    """Resuming a checkpoint whose run already converged re-labels and
+    returns — no extra sweeps, outcome counted as 'finished'."""
+    ck = str(tmp_path / "ck")
+    done = fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), init=c0,
+                             tol=0.0, max_iter=MAX_IT, ckpt_dir=ck,
+                             ckpt_every=4)
+    assert bool(done.converged)
+    before = _ENGINE_RESUMES_TOTAL.value(outcome="finished")
+    again = fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((4, 1)), tol=0.0,
+                              max_iter=MAX_IT, resume=ck)
+    assert _ENGINE_RESUMES_TOTAL.value(outcome="finished") == before + 1
+    assert int(again.n_iter) == int(done.n_iter)
+    _assert_same(again, ref_plain)
+
+
+def test_elastic_argument_validation(xdata, cpu_devices, tmp_path):
+    with pytest.raises(ValueError, match="ckpt_every"):
+        fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)),
+                          ckpt_dir=str(tmp_path / "ck"), ckpt_every=-1)
+    with pytest.raises(ValueError, match="resume"):
+        fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)), resume=True)
+    with pytest.raises(ValueError, match="resume"):
+        fit_lloyd_sharded(xdata, K, mesh=cpu_mesh((8, 1)),
+                          ckpt_dir=str(tmp_path / "ck"),
+                          resume=str(tmp_path / "other"))

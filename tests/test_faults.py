@@ -790,6 +790,110 @@ def test_continuous_sigterm_mid_refit_then_resume(tmp_path):
     assert res.stdout.decode().split()[3] == "14"
 
 
+# ---------------------------------------------------------------------------
+# Elastic-engine crash matrix (ISSUE 14): kill the process at each engine
+# injection site mid-sharded-fit; the restarted child resumes from the
+# surviving verified checkpoint on a SHRUNK mesh (8 -> 4 devices) and must
+# finish label-exact against the uninterrupted fit.
+# ---------------------------------------------------------------------------
+
+_ENGINE_CHILD = r"""
+import sys
+sys.modules["orbax"] = None
+sys.modules["orbax.checkpoint"] = None
+import numpy as np, jax
+from jax.sharding import Mesh
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.parallel.engine import fit_lloyd_sharded
+
+ck, ndev, resume, out = (sys.argv[1], int(sys.argv[2]),
+                         sys.argv[3] == "1", sys.argv[4])
+rng = np.random.default_rng(1)
+x = rng.normal(size=(512, 8)).astype(np.float32)
+mesh = Mesh(np.array(jax.devices()[:ndev]).reshape(ndev, 1),
+            ("data", "model"))
+cfg = KMeansConfig(k=6, max_iter=30, tol=0.0)
+kw = {"resume": True} if resume else {"init": x[:6].copy()}
+st = fit_lloyd_sharded(x, 6, mesh=mesh, config=cfg, ckpt_dir=ck,
+                       ckpt_every=3, **kw)
+np.save(out, np.asarray(st.labels))
+print("DONE", int(st.n_iter))
+"""
+
+
+def _run_engine_child(ck, out, *, ndev=8, resume=False, fault=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("KMEANS_TPU_FAULTS", None)
+    if fault:
+        env["KMEANS_TPU_FAULTS"] = fault
+    return subprocess.run(
+        [sys.executable, "-c", _ENGINE_CHILD, str(ck), str(ndev),
+         "1" if resume else "0", str(out)],
+        env=env, capture_output=True, timeout=600,
+    )
+
+
+@pytest.fixture(scope="module")
+def engine_reference(cpu_devices):
+    """The uninterrupted fit on the child's exact problem (classic update:
+    the elastic trajectory equals the fused one, so one in-process fused
+    run yardsticks every kill/resume child)."""
+    from kmeans_tpu.parallel import cpu_mesh, fit_lloyd_sharded
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(512, 8)).astype(np.float32)
+    st = fit_lloyd_sharded(x, 6, mesh=cpu_mesh((8, 1)), init=x[:6].copy(),
+                           tol=0.0, max_iter=30)
+    return np.asarray(st.labels)
+
+
+# engine.sweep_merge stays in tier-1 as the representative (the richest
+# site: segment drained, merge done, checkpoint NOT yet cut); the rest of
+# the matrix rides the slow lane.
+_ENGINE_MATRIX = [
+    pytest.param("engine.sweep_merge:kill@2", id="sweep_merge"),
+    pytest.param("engine.ckpt:kill@2", id="ckpt",
+                 marks=pytest.mark.slow),
+    pytest.param("ckpt.mid_swap:kill@2", id="mid_swap",
+                 marks=pytest.mark.slow),
+    pytest.param("dist.heartbeat:kill@2", id="heartbeat",
+                 marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize("fault", _ENGINE_MATRIX)
+def test_engine_crash_matrix_kill_then_resume_shrunk(tmp_path, fault,
+                                                     engine_reference):
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "labels.npy")
+    res = _run_engine_child(ck, out, fault=fault)
+    assert res.returncode == 137, (fault, res.stderr.decode())
+    # Whatever the kill tore, the surviving checkpoint loads verified.
+    arrays, meta = load_array_checkpoint(ck)
+    assert meta["digests"] and meta["step"] >= 3
+    assert meta["extra"]["engine"] == "fit_lloyd_sharded"
+    res = _run_engine_child(ck, out, ndev=4, resume=True)
+    assert res.returncode == 0, (fault, res.stderr.decode())
+    assert res.stdout.decode().startswith("DONE")
+    np.testing.assert_array_equal(np.load(out), engine_reference)
+
+
+@pytest.mark.slow
+def test_engine_kill_during_resume_then_restart(tmp_path, engine_reference):
+    """A preemption that lands DURING the resume itself: the verified load
+    never mutates the checkpoint, so the next restart just works."""
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "labels.npy")
+    res = _run_engine_child(ck, out, fault="engine.sweep_merge:kill@2")
+    assert res.returncode == 137, res.stderr.decode()
+    res = _run_engine_child(ck, out, ndev=4, resume=True,
+                            fault="engine.resume:kill@1")
+    assert res.returncode == 137, res.stderr.decode()
+    res = _run_engine_child(ck, out, ndev=4, resume=True)
+    assert res.returncode == 0, res.stderr.decode()
+    np.testing.assert_array_equal(np.load(out), engine_reference)
+
+
 def test_compile_retry_skips_deterministic_failures():
     """Missing g++ / a blown compile cap are permanent: no backoff burn
     under the native loader's module lock."""

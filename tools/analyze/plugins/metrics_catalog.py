@@ -38,6 +38,7 @@ MODULES = [
     "kmeans_tpu.obs.costmodel",
     "kmeans_tpu.utils.retry",
     "kmeans_tpu.utils.checkpoint",
+    "kmeans_tpu.utils.faults",
     "kmeans_tpu.data.stream",
     "kmeans_tpu.models.runner",
     "kmeans_tpu.models.accelerated",

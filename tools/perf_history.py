@@ -39,6 +39,10 @@ Tracked series (direction ``up`` = higher is better):
   ``tools/loadgen.py --smoke --mode open --record``; ROADMAP 2c);
 * ``soak.rto_s_max`` — the worst kill/resume recovery time
   (``BENCH_SOAK_latest.json``);
+* ``soak.engine_rto_s`` — the elastic engine drill's recovery time
+  (kill mid-sweep → fresh process → verified checkpoint restored on a
+  shrunk mesh; same artifact, null-seeded from records that predate
+  the drill);
 * ``accel.<config>.nested_seconds_reduction`` — the nested schedule's
   wall-clock claim (``BENCH_ACCEL_latest.json`` medians);
 * ``input.fit_s`` / ``input.iters_per_s`` — the real-data fit
@@ -232,9 +236,20 @@ def _ingest_soak(root: str) -> List[Entry]:
         else rec.get("ts")
     rtos = [v for v in (rec.get("rto_s") or {}).values()
             if isinstance(v, (int, float))]
-    return [Entry("soak.rto_s_max", max(rtos) if rtos else None,
-                  unit="s", direction="down", group="soak",
-                  source="BENCH_SOAK_latest.json", round=None, ts=ts)]
+    common = dict(group="soak", source="BENCH_SOAK_latest.json",
+                  round=None, ts=ts)
+    engine = rec.get("engine") or {}
+    return [
+        Entry("soak.rto_s_max", max(rtos) if rtos else None,
+              unit="s", direction="down", **common),
+        # The elastic engine drill's recovery time: child killed mid-sweep
+        # → fresh process → newest verified checkpoint restored on a
+        # SHRUNK mesh.  Kept as its own series (not folded into
+        # soak.rto_s_max): a full jax restart + resume is a different
+        # budget than the continuous pipeline's in-process hot swap.
+        Entry("soak.engine_rto_s", engine.get("rto_s"),
+              unit="s", direction="down", **common),
+    ]
 
 
 def _ingest_accel(root: str) -> List[Entry]:

@@ -18,6 +18,12 @@ drift-aware serving loop and writes them to ``BENCH_SOAK_latest.json``:
 * **Drift recovery** — after the synthetic stream drifts, the partial
   (warm-start) refit's per-point inertia on the window must land within
   5% of a from-scratch refit on the same window.
+* **Elastic-engine RTO** — a sharded ``fit_lloyd_sharded`` run on the
+  8-device mesh is KILLED at its second sweep boundary and resumed on 4
+  devices; the drill clocks death -> verified-checkpoint-restore, proves
+  the resumed fit label-exact against an uninterrupted elastic run, and
+  gates checkpoint overhead at ``MAX_ENGINE_OVERHEAD`` of fit wall time
+  (the ``soak.engine_rto_s`` series in PERF_HISTORY).
 
 Run it::
 
@@ -51,6 +57,10 @@ MAX_RECOVERY_RATIO = 1.05
 #: Kill drill sites: each is exercised with ``kill@2`` (the site's second
 #: hit, so one good publish exists to fall back on).
 KILL_SITES = ("continuous.refit", "registry.swap", "ckpt.mid_swap")
+
+#: Engine-drill ceiling: checkpoint time as a fraction of the whole fit
+#: at the default ``ckpt_every`` cadence (ISSUE 14 acceptance gate).
+MAX_ENGINE_OVERHEAD = 0.05
 
 
 def _stream_args(p) -> list:
@@ -248,6 +258,131 @@ def phase_sigterm(p, workdir: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Phase 2b: elastic-engine drill — kill a sharded fit mid-sweep, resume it
+# on a SHRUNK mesh, clock the RTO, and prove exactness + checkpoint
+# overhead (ISSUE 14; docs/RESILIENCE.md "Elastic sharded training").
+# ---------------------------------------------------------------------------
+
+_ENGINE_CHILD = r"""
+import sys, time
+sys.modules["orbax"] = None
+sys.modules["orbax.checkpoint"] = None
+import numpy as np, jax
+from jax.sharding import Mesh
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.parallel import engine
+from kmeans_tpu.utils.checkpoint import load_array_checkpoint
+
+mode, ck, ndev, out = sys.argv[1], sys.argv[2], int(sys.argv[3]), sys.argv[4]
+n, d, k, max_iter = (int(a) for a in sys.argv[5:9])
+rng = np.random.default_rng(17)
+x = rng.normal(size=(n, d)).astype(np.float32) * 3.0
+mesh = Mesh(np.array(jax.devices()[:ndev]).reshape(ndev, 1),
+            ("data", "model"))
+cfg = KMeansConfig(k=k, max_iter=max_iter, tol=0.0)
+kw = {"init": x[:k].copy()}
+if mode == "resume":
+    # The verified restore IS the recovery moment: after this load the
+    # run owns a good global state and sweeps can continue.  The fit
+    # below re-loads through the same path; this probe only timestamps.
+    arrays, meta = load_array_checkpoint(ck)
+    print("ENGINE_RESUMED", "step=%d" % meta["step"], "ts=%.6f" % time.time(),
+          flush=True)
+    kw = {"resume": True}
+t0 = time.perf_counter()
+st = engine.fit_lloyd_sharded(x, k, mesh=mesh, config=cfg, ckpt_dir=ck,
+                              **kw)
+wall = time.perf_counter() - t0
+np.save(out + ".labels.npy", np.asarray(st.labels))
+np.save(out + ".centroids.npy", np.asarray(st.centroids, np.float32))
+ckpt_count, ckpt_sum, _ = engine._ENGINE_CKPT_SECONDS.snapshot()
+print("ENGINE_DONE", "sweeps=%d" % int(st.n_iter), "wall=%.4f" % wall,
+      "ckpt_count=%d" % ckpt_count, "ckpt_sum=%.4f" % ckpt_sum, flush=True)
+"""
+
+
+def _engine_child(mode, ck, ndev, out, ep, *, fault: str = None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("KMEANS_TPU_FAULTS", None)
+    if fault:
+        env["KMEANS_TPU_FAULTS"] = fault
+    return subprocess.run(
+        [sys.executable, "-c", _ENGINE_CHILD, mode, ck, str(ndev), out,
+         str(ep["n"]), str(ep["d"]), str(ep["k"]), str(ep["max_iter"])],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+
+
+def _kv(line: str) -> dict:
+    return {k: v for k, _, v in
+            (tok.partition("=") for tok in line.split()[1:])}
+
+
+def phase_engine_elastic(ep, workdir: str) -> dict:
+    """Kill an (8, 1)-mesh elastic fit at its second sweep boundary,
+    resume on 4 devices, and yardstick against an uninterrupted elastic
+    run with the same checkpoint cadence (classic update: label-exact)."""
+    ck = os.path.join(workdir, "engine_ck")
+    ref_ck = os.path.join(workdir, "engine_ck_ref")
+    out = os.path.join(workdir, "engine_resumed")
+    ref_out = os.path.join(workdir, "engine_ref")
+    for d in (ck, ref_ck):
+        shutil.rmtree(d, ignore_errors=True)
+    row = {"site": "engine.sweep_merge",
+           "fault": "engine.sweep_merge:kill@2"}
+
+    res = _engine_child("run", ck, 8, out, ep,
+                        fault="engine.sweep_merge:kill@2")
+    t_dead = time.time()
+    row["kill_exit"] = res.returncode
+    if res.returncode != 137:
+        row["error"] = (f"expected exit 137, got {res.returncode}: "
+                        f"{res.stderr[-500:]}")
+        return row
+
+    res = _engine_child("resume", ck, 4, out, ep)
+    row["resume_exit"] = res.returncode
+    lines = res.stdout.splitlines()
+    resumed = next((_kv(ln) for ln in lines
+                    if ln.startswith("ENGINE_RESUMED")), None)
+    done = next((_kv(ln) for ln in lines
+                 if ln.startswith("ENGINE_DONE")), None)
+    if res.returncode != 0 or resumed is None or done is None:
+        row["error"] = f"resume failed: {res.stderr[-500:]}"
+        return row
+    # RTO: process death -> the restarted child's VERIFIED checkpoint
+    # load on the shrunk mesh.  Dominated by interpreter + jax import +
+    # segment recompile on a cold child — the honest restart cost.
+    row["rto_s"] = round(float(resumed["ts"]) - t_dead, 3)
+    row["resumed_step"] = int(resumed["step"])
+    row["final_sweeps"] = int(done["sweeps"])
+
+    res = _engine_child("run", ref_ck, 8, ref_out, ep)
+    if res.returncode != 0:
+        row["error"] = f"reference run failed: {res.stderr[-500:]}"
+        return row
+    ref_done = _kv(next(ln for ln in res.stdout.splitlines()
+                        if ln.startswith("ENGINE_DONE")))
+    import numpy as np
+    lab = np.load(out + ".labels.npy")
+    ref_lab = np.load(ref_out + ".labels.npy")
+    cent = np.load(out + ".centroids.npy")
+    ref_cent = np.load(ref_out + ".centroids.npy")
+    row["exact"] = bool(np.array_equal(lab, ref_lab)
+                        and np.allclose(cent, ref_cent, atol=1e-5))
+    # Overhead from the UNINTERRUPTED run: every checkpoint cut at the
+    # default cadence over the whole fit, as a fraction of its wall time.
+    wall = float(ref_done["wall"])
+    row["ckpt_count"] = int(ref_done["ckpt_count"])
+    row["overhead_frac"] = round(float(ref_done["ckpt_sum"]) / wall, 4)
+    row["ok"] = bool(row["exact"]
+                     and row["final_sweeps"] == int(ref_done["sweeps"])
+                     and row["overhead_frac"] <= MAX_ENGINE_OVERHEAD)
+    return row
+
+
+# ---------------------------------------------------------------------------
 # Phase 3: drift recovery — partial refit vs from-scratch on one window
 # ---------------------------------------------------------------------------
 
@@ -320,6 +455,13 @@ def run_soak(p, *, out_path: str, workdir: str) -> dict:
               f"{row.get('final_generation', '?')}", file=sys.stderr)
     print("soak: SIGTERM drill...", file=sys.stderr)
     sigterm = phase_sigterm(p, workdir)
+    print("soak: elastic-engine drill (kill@sweep, resume on 4 of 8 "
+          "devices)...", file=sys.stderr)
+    eng = phase_engine_elastic(p["engine"], workdir)
+    print(f"soak:   engine: exit {eng.get('kill_exit')} -> RTO "
+          f"{eng.get('rto_s', '?')}s, exact={eng.get('exact', '?')}, "
+          f"ckpt overhead {eng.get('overhead_frac', '?')}",
+          file=sys.stderr)
     print("soak: drift-recovery phase...", file=sys.stderr)
     drift = phase_drift_recovery(p)
     print(f"soak:   partial {drift['partial_inertia_pp']:.3f} vs scratch "
@@ -336,6 +478,8 @@ def run_soak(p, *, out_path: str, workdir: str) -> dict:
                             f"{row.get('error', row)}")
     if not sigterm.get("ok"):
         failures.append(f"sigterm drill: {sigterm.get('error', sigterm)}")
+    if not eng.get("ok"):
+        failures.append(f"engine drill: {eng.get('error', eng)}")
     if not drift.get("ok"):
         failures.append(
             f"drift recovery ratio {drift['ratio']} > "
@@ -349,6 +493,7 @@ def run_soak(p, *, out_path: str, workdir: str) -> dict:
         "hot_swap": hot,
         "kill_resume": kills,
         "sigterm": sigterm,
+        "engine": eng,
         "drift_recovery": drift,
         "rto_s": {r["site"]: r.get("rto_s") for r in kills},
         "ok": not failures,
@@ -365,11 +510,13 @@ def default_params(quick: bool) -> dict:
         return {"k": 3, "d": 4, "batch_n": 256, "batches": 20,
                 "drift_at": 8, "drift": 8.0, "window_batches": 4,
                 "compact_above": 4096, "coreset": 1024,
-                "refit_iters": 12, "hammer_threads": 2}
+                "refit_iters": 12, "hammer_threads": 2,
+                "engine": {"n": 2048, "d": 8, "k": 8, "max_iter": 30}}
     return {"k": 4, "d": 8, "batch_n": 512, "batches": 60,
             "drift_at": 25, "drift": 6.0, "window_batches": 8,
             "compact_above": 16384, "coreset": 4096,
-            "refit_iters": 25, "hammer_threads": 4}
+            "refit_iters": 25, "hammer_threads": 4,
+            "engine": {"n": 8192, "d": 16, "k": 16, "max_iter": 40}}
 
 
 def main(argv=None) -> int:

@@ -295,26 +295,27 @@ def _cmd_train(args) -> int:
                             or args.profile
                             or args.telemetry or args.trace
                             or args.xla_trace)
-        if args.update in ("delta", "hamerly") and model != "lloyd":
+        if args.update in ("delta", "hamerly", "yinyang") \
+                and model != "lloyd":
             print(f"error: --update {args.update} (the incremental sweep) "
                   "runs only in the lloyd family; accelerated/spherical/"
                   "trimmed use the dense reduction (or --update auto to "
                   "let the policy decide)", file=sys.stderr)
             return 2
-        if args.update == "delta" and runner_flags and args.mesh \
-                and args.mesh > 1:
-            print("error: --update delta with runner flags (--progress/"
-                  "--checkpoint/--resume/--profile/--telemetry/--trace/"
-                  "--xla-trace) runs single-device only; the mesh runner "
-                  "steps the dense reduction — drop --mesh or the runner "
-                  "flags, or use --update auto", file=sys.stderr)
-            return 2
-        if args.update == "hamerly" and runner_flags:
-            print("error: --update hamerly runs the fit_lloyd loops "
-                  "(single-device or DP mesh), not the step-wise runner; "
-                  "drop --progress/--checkpoint/--resume/--profile/"
-                  "--telemetry/--trace/--xla-trace or use --update auto",
+        if args.update in ("delta", "hamerly", "yinyang") and runner_flags \
+                and args.mesh and args.mesh > 1:
+            print(f"error: --update {args.update} with runner flags "
+                  "(--progress/--checkpoint/--resume/--profile/"
+                  "--telemetry/--trace/--xla-trace) runs single-device "
+                  "only; the mesh runner steps the dense reduction — drop "
+                  "--mesh or the runner flags, or use --update auto",
                   file=sys.stderr)
+            return 2
+        if args.update in ("hamerly", "yinyang") and args.accel:
+            print(f"error: --update {args.update} carries refresh-cadence "
+                  "score bounds that do not compose with --accel's "
+                  "between-sweep extrapolation; drop --accel or use "
+                  "--update auto/delta", file=sys.stderr)
             return 2
 
     # --comm configures the sharded engine's sweep-merge collective; only
@@ -367,6 +368,16 @@ def _cmd_train(args) -> int:
         cfg_kw["batch_size"] = args.batch_size
     if getattr(args, "update", None):
         cfg_kw["update"] = args.update
+    if getattr(args, "yinyang_groups", None) is not None:
+        if args.yinyang_groups < 1:
+            print("error: --yinyang-groups must be >= 1", file=sys.stderr)
+            return 2
+        if getattr(args, "update", None) not in (None, "auto", "yinyang"):
+            print(f"error: --yinyang-groups configures the yinyang group "
+                  f"bounds; it has no effect with --update {args.update}",
+                  file=sys.stderr)
+            return 2
+        cfg_kw["yinyang_groups"] = args.yinyang_groups
     if getattr(args, "comm", None):
         cfg_kw["comm"] = args.comm
     if args.accel:
@@ -1112,15 +1123,23 @@ def main(argv=None) -> int:
                    help="minibatch/stream batch size (default 8192)")
     t.add_argument("--update", default=None,
                    choices=["auto", "matmul", "segment", "delta",
-                            "hamerly"],
+                            "hamerly", "yinyang"],
                    help="Lloyd centroid-update reduction (default auto: the "
                         "incremental 'delta' sweep wherever its gates pass "
                         "— single-device and DP-mesh lloyd fits with exact "
-                        "weights — else the dense reduction); 'hamerly' "
-                        "additionally prunes the distance pass with exact "
-                        "score bounds (single-device lloyd, win is "
+                        "weights — else the dense reduction; large fits "
+                        "additionally switch delta<->yinyang at runtime "
+                        "from the measured recompute fraction); 'hamerly' "
+                        "prunes the distance pass with exact score bounds, "
+                        "'yinyang' sharpens them with per-group drift "
+                        "(lloyd single-device or DP mesh, win is "
                         "data-dependent); explicit choices error where "
                         "unsupported")
+    t.add_argument("--yinyang-groups", type=int, default=None,
+                   help="centroid group count t of the yinyang bounds "
+                        "(default ceil(k/10); t=1 degenerates to hamerly, "
+                        "t=k to per-centroid bounds); needs --update "
+                        "yinyang or auto")
     t.add_argument("--comm", default=None,
                    choices=["auto", "allreduce", "scatter"],
                    help="sweep-merge collective of the sharded lloyd fit "

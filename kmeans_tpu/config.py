@@ -112,8 +112,21 @@ class KMeansConfig:
     #: exact labels, but the win is DATA-DEPENDENT: large on naturally
     #: clustered data where first/second-centroid gaps are wide, absent
     #: when k far exceeds the natural cluster count; single-device and
-    #: DP-mesh Lloyd fits, empty="keep" only; see kmeans_tpu.ops.hamerly).
+    #: DP-mesh Lloyd fits, empty="keep" only; see kmeans_tpu.ops.hamerly),
+    #: or "yinyang" (forced group-bound pruning: hamerly's test with
+    #: t ≈ k/10 per-GROUP drift bounds instead of one global one, so a
+    #: single fast-moving centroid no longer poisons every row's lower
+    #: bound — same exactness contract, same fit-shape support, strictly
+    #: tighter filtering; see kmeans_tpu.ops.yinyang).  Under "auto" the
+    #: fit loop also engages the runtime-adaptive delta ↔ yinyang switch
+    #: on large fits, judged each refresh period from the measured
+    #: recompute fraction (kmeans_tpu.models.lloyd).
     update: str = "auto"
+    #: Yinyang group count t (None = max(1, ceil(k / 10))).  t=1
+    #: degenerates to hamerly's single bound; t=k tracks one bound per
+    #: centroid.  Groups are formed once per fit from the initial
+    #: centroids (kmeans_tpu.ops.yinyang.centroid_groups).
+    yinyang_groups: Optional[int] = None
     #: Empty-cluster policy: "keep" (retain old centroid) or "farthest"
     #: (reseed to the currently-worst-fit points).
     empty: str = "keep"
@@ -167,8 +180,11 @@ class KMeansConfig:
         if self.init not in ("k-means++", "k-means||", "random", "given"):
             raise ValueError(f"unknown init {self.init!r}")
         if self.update not in ("auto", "matmul", "segment", "delta",
-                               "hamerly"):
+                               "hamerly", "yinyang"):
             raise ValueError(f"unknown update {self.update!r}")
+        if self.yinyang_groups is not None and self.yinyang_groups < 1:
+            raise ValueError(
+                f"yinyang_groups must be >= 1, got {self.yinyang_groups}")
         if self.empty not in ("keep", "farthest"):
             raise ValueError(f"unknown empty-cluster policy {self.empty!r}")
         if self.backend not in ("auto", "xla", "pallas", "pallas_interpret"):

@@ -23,6 +23,7 @@ from jax import lax
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import resolve_fit_inputs
+from kmeans_tpu.obs import counter as _obs_counter, gauge as _obs_gauge
 from kmeans_tpu.obs.costmodel import observed
 from kmeans_tpu.ops.lloyd import (lloyd_pass, resolve_backend,
                                   resolve_update, weights_exact)
@@ -30,6 +31,23 @@ from kmeans_tpu.ops.update import apply_update, reseed_empty_farthest
 
 __all__ = ["KMeansState", "fit_lloyd", "fit_plan", "KMeans",
            "best_of_n_init"]
+
+#: Pruned-sweep observability (docs/OBSERVABILITY.md): exact row/group
+#: counters the hamerly/yinyang passes already compute on-device, stamped
+#: once per fit (a single host pull at fit exit — dense/delta fits stamp
+#: nothing and stay sync-free).
+_SWEEP_RECOMPUTE_ROWS = _obs_counter(
+    "kmeans_tpu_sweep_recompute_rows_total",
+    "Rows whose distances a pruned-exact Lloyd fit actually recomputed, "
+    "summed over its sweeps (exact on-device counters; backend-"
+    "independent)",
+    labels=("update",),
+)
+_SWEEP_GROUP_FILTER_FRACTION = _obs_gauge(
+    "kmeans_tpu_sweep_group_filter_fraction",
+    "Fraction of (recomputed row, centroid group) pairs the most recent "
+    "yinyang fit's local group filter proved need no distances",
+)
 
 
 class KMeansState(NamedTuple):
@@ -48,7 +66,7 @@ class KMeansState(NamedTuple):
     jax.jit,
     static_argnames=(
         "max_iter", "chunk_size", "compute_dtype", "update", "empty",
-        "backend",
+        "backend", "groups",
     ),
 )
 def _lloyd_loop(
@@ -56,6 +74,9 @@ def _lloyd_loop(
     centroids0,
     weights,
     tol,
+    group_of=None,
+    switch_high=None,
+    reprobe=None,
     *,
     max_iter,
     chunk_size,
@@ -63,7 +84,27 @@ def _lloyd_loop(
     update,
     empty,
     backend="xla",
+    groups=None,
 ):
+    """Returns ``(KMeansState, diag)``.  ``diag`` is a dict of traced
+    scalars — exact on-device counters of the pruned flavors
+    (``recompute_rows``/``rows_seen`` summed over sweeps,
+    ``group_pairs_pruned``/``group_pairs_seen`` of the yinyang local
+    filter, ``final_flavor``: -1 dense, 0 delta, 1 yinyang, 2 hamerly;
+    for ``update="adaptive"`` the flavor the fit ENDED on).  Unmeasured
+    fields are -1; callers that never fetch them pay no host sync.
+
+    ``update="yinyang"`` needs ``group_of`` (a (k,) int32 centroid →
+    group map, :func:`kmeans_tpu.ops.yinyang.centroid_groups`) and the
+    static ``groups`` count.  ``update="adaptive"`` (layered by
+    :func:`fit_lloyd` under ``"auto"``) additionally takes the policy
+    scalars ``switch_high``/``reprobe`` TRACED so tests can tune them
+    without invalidating the jit cache: it runs the delta loop but, at
+    each ``DELTA_REFRESH`` boundary, probes/judges the yinyang flavor by
+    the trailing period's measured recompute fraction (sentinel refresh
+    makes the boundary a safe switch point — every carried bound is
+    re-derived from scratch there).
+    """
     kw = dict(
         weights=weights,
         chunk_size=chunk_size,
@@ -71,6 +112,16 @@ def _lloyd_loop(
         update=update,           # lloyd_pass maps "delta" -> "matmul"
         backend=backend,
     )
+    f32 = jnp.float32
+
+    def _diag(rec=-1.0, seen=-1.0, gp=-1.0, gs=-1.0, flavor=-1):
+        return {
+            "recompute_rows": jnp.asarray(rec, f32),
+            "rows_seen": jnp.asarray(seen, f32),
+            "group_pairs_pruned": jnp.asarray(gp, f32),
+            "group_pairs_seen": jnp.asarray(gs, f32),
+            "final_flavor": jnp.asarray(flavor, jnp.int32),
+        }
 
     def reseed(new_c, counts, min_d2):
         if empty != "farthest":
@@ -146,6 +197,7 @@ def _lloyd_loop(
         )
         centroids = lax.while_loop(cond, body, init)
         centroids, n_iter, shift_sq, converged = centroids[:4]
+        diag = _diag(flavor=0)
     elif update == "hamerly":
         # Bound-pruned exact loop (ops/hamerly): rows whose carried score
         # bounds prove the argmin unchanged skip even the distance
@@ -159,7 +211,6 @@ def _lloyd_loop(
 
         n, d = x.shape
         k = centroids0.shape[0]
-        f32 = jnp.float32
         cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
               else x.dtype)
         rno = row_norms(x, compute_dtype=compute_dtype)   # static per fit
@@ -173,18 +224,20 @@ def _lloyd_loop(
             return (s[1] < max_iter) & ~s[3]
 
         def body(s):
-            (c, it, _, _, lab, sums, counts, sb, slb, c_cd, csq) = s
+            (c, it, _, _, lab, sums, counts, sb, slb, c_cd, csq,
+             rec_t, seen_t) = s
             refresh = (it % DELTA_REFRESH) == 0
             lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
             sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
             counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
-            (lab, sums, counts, sb, slb, c_cd, csq, _) = hamerly_pass(
+            (lab, sums, counts, sb, slb, c_cd, csq, n_rec) = hamerly_pass(
                 x, c, lab_e, sums_e, counts_e, sb, slb, c_cd, csq, rno,
                 **hkw)
             new_c = apply_update(c, sums, counts)
             shift_sq = jnp.sum((new_c - c) ** 2)
             return (new_c, it + 1, shift_sq, shift_sq <= tol, lab, sums,
-                    counts, sb, slb, c_cd, csq)
+                    counts, sb, slb, c_cd, csq,
+                    rec_t + n_rec.astype(f32), seen_t + f32(n))
 
         init = (
             centroids0.astype(f32),
@@ -198,9 +251,210 @@ def _lloyd_loop(
             jnp.zeros((n,), f32),          # slb
             centroids0.astype(cd),
             jnp.zeros((k,), f32),          # csq_prev (unused on sentinel)
+            jnp.zeros((), f32),            # recompute_rows total
+            jnp.zeros((), f32),            # rows_seen total
         )
-        centroids = lax.while_loop(cond, body, init)
-        centroids, n_iter, shift_sq, converged = centroids[:4]
+        final = lax.while_loop(cond, body, init)
+        centroids, n_iter, shift_sq, converged = final[:4]
+        diag = _diag(flavor=2)
+        diag["recompute_rows"] = final[11]
+        diag["rows_seen"] = final[12]
+    elif update == "yinyang":
+        # Group-bound pruned exact loop (ops/yinyang): hamerly's carried
+        # state with the single slb replaced by (n, t) per-group
+        # competitor bounds — per-group drift keeps one fast-moving
+        # centroid from poisoning every row's lower bound.  Same
+        # sentinel-reset refresh cadence; ``group_of`` is the fit-static
+        # centroid → group map formed from the initial centroids.
+        from kmeans_tpu.ops.delta import DELTA_REFRESH, default_cap
+        from kmeans_tpu.ops.hamerly import row_norms
+        from kmeans_tpu.ops.yinyang import yinyang_pass
+
+        n, d = x.shape
+        k = centroids0.shape[0]
+        t = int(groups)
+        cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+              else x.dtype)
+        rno = row_norms(x, compute_dtype=compute_dtype)   # static per fit
+        ykw = dict(
+            weights=weights, cap=default_cap(n), chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            backend="auto" if backend == "pallas" else backend,
+        )
+
+        def cond(s):
+            return (s[1] < max_iter) & ~s[3]
+
+        def body(s):
+            (c, it, _, _, lab, sums, counts, sb, glb, c_cd, csq,
+             rec_t, seen_t, gp_p, gp_s) = s
+            refresh = (it % DELTA_REFRESH) == 0
+            lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
+            sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
+            counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
+            (lab, sums, counts, sb, glb, c_cd, csq, n_rec, n_gp) = \
+                yinyang_pass(
+                    x, c, lab_e, sums_e, counts_e, sb, glb, c_cd, csq,
+                    rno, group_of, **ykw)
+            new_c = apply_update(c, sums, counts)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            nr = n_rec.astype(f32)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol, lab, sums,
+                    counts, sb, glb, c_cd, csq,
+                    rec_t + nr, seen_t + f32(n),
+                    gp_p + n_gp.astype(f32), gp_s + nr * f32(t))
+
+        init = (
+            centroids0.astype(f32),
+            jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, f32),
+            jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((k, d), f32),
+            jnp.zeros((k,), f32),
+            jnp.zeros((n,), f32),          # sb (sentinel sweep overwrites)
+            jnp.zeros((n, t), f32),        # glb
+            centroids0.astype(cd),
+            jnp.zeros((k,), f32),          # csq_prev (unused on sentinel)
+            jnp.zeros((), f32),            # recompute_rows total
+            jnp.zeros((), f32),            # rows_seen total
+            jnp.zeros((), f32),            # group pairs pruned
+            jnp.zeros((), f32),            # group pairs seen
+        )
+        final = lax.while_loop(cond, body, init)
+        centroids, n_iter, shift_sq, converged = final[:4]
+        diag = _diag(flavor=1)
+        diag["recompute_rows"] = final[11]
+        diag["rows_seen"] = final[12]
+        diag["group_pairs_pruned"] = final[13]
+        diag["group_pairs_seen"] = final[14]
+    elif update == "adaptive":
+        # Runtime-adaptive delta ↔ yinyang (the "auto" policy made an
+        # on-device measurement): runs the delta loop, but each
+        # DELTA_REFRESH boundary is a safe switch point (the sentinel
+        # refresh re-derives every carried bound), so the policy probes
+        # the yinyang flavor there and judges it by the trailing
+        # period's MEASURED recompute fraction — demote back to delta
+        # when the fraction exceeds ``switch_high`` (pruning isn't
+        # paying for its bound upkeep), re-probe after ``reprobe``
+        # demoted periods (drift decays as the fit converges, so
+        # pruning that lost early often pays later).  Both scalars
+        # arrive traced: tests tune them without re-tracing this loop.
+        from kmeans_tpu.ops.delta import (DELTA_REFRESH, default_cap,
+                                          delta_pass)
+        from kmeans_tpu.ops.hamerly import row_norms
+        from kmeans_tpu.ops.yinyang import yinyang_pass
+
+        n, d = x.shape
+        k = centroids0.shape[0]
+        t = int(groups)
+        i32 = jnp.int32
+        cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+              else x.dtype)
+        rno = row_norms(x, compute_dtype=compute_dtype)
+        cap = default_cap(n)
+        ykw = dict(
+            weights=weights, cap=cap, chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            backend="auto" if backend == "pallas" else backend,
+        )
+        dkw = dict(
+            weights=weights, cap=cap, chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            backend="auto" if backend == "pallas" else backend,
+        )
+
+        def cond(s):
+            return (s[1] < max_iter) & ~s[3]
+
+        def body(s):
+            (c, it, _, _, lab, sums, counts, sb, glb, c_cd, csq, flavor,
+             since_probe, per_rec, per_sweeps,
+             rec_t, seen_t, gp_p, gp_s) = s
+            refresh = (it % DELTA_REFRESH) == 0
+            # ---- the policy, judged only at boundaries after period 0.
+            judge = refresh & (it > 0)
+            frac = per_rec / jnp.maximum(
+                per_sweeps.astype(f32) * f32(n), 1.0)
+            demote = judge & (flavor == 1) & (frac > switch_high)
+            bump = jnp.where(judge & (flavor == 0),
+                             since_probe + 1, since_probe)
+            promote = judge & (flavor == 0) & (bump >= reprobe)
+            flavor = jnp.where(demote, 0, jnp.where(promote, 1, flavor))
+            since_probe = jnp.where(demote | promote, 0, bump)
+            per_rec = jnp.where(refresh, 0.0, per_rec)
+            per_sweeps = jnp.where(refresh, 0, per_sweeps)
+            # ---- one sweep of whichever flavor survived the judgment.
+            lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
+            sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
+            counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
+
+            def yin_sweep(_):
+                (lab2, sums2, counts2, sb2, glb2, c_cd2, csq2, n_rec,
+                 n_gp) = yinyang_pass(
+                    x, c, lab_e, sums_e, counts_e, sb, glb, c_cd, csq,
+                    rno, group_of, **ykw)
+                nr = n_rec.astype(f32)
+                return (lab2, sums2, counts2, sb2, glb2, c_cd2, csq2,
+                        nr, n_gp.astype(f32), nr * f32(t))
+
+            def delta_flavor(_):
+                def refresh_sweep(_):
+                    labels, _m, s2, c2, _ = lloyd_pass(x, c, **kw)
+                    return labels, s2, c2
+
+                def delta_sweep(_):
+                    labels, _m, s2, c2, _, _ = delta_pass(
+                        x, c, lab_e, sums_e, counts_e, **dkw)
+                    return labels, s2, c2
+
+                lab2, sums2, counts2 = lax.cond(
+                    refresh, refresh_sweep, delta_sweep, None)
+                # Delta scores every row — its honest recompute count.
+                return (lab2, sums2, counts2, sb, glb, c_cd, csq,
+                        f32(n), jnp.zeros((), f32), jnp.zeros((), f32))
+
+            (lab, sums, counts, sb, glb, c_cd, csq, nr, ngp, nps) = \
+                lax.cond(flavor == 1, yin_sweep, delta_flavor, None)
+            new_c = apply_update(c, sums, counts)
+            shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol, lab, sums,
+                    counts, sb, glb, c_cd, csq, flavor, since_probe,
+                    per_rec + nr, per_sweeps + 1,
+                    rec_t + nr, seen_t + f32(n), gp_p + ngp, gp_s + nps)
+
+        init = (
+            centroids0.astype(f32),
+            jnp.zeros((), i32),
+            jnp.asarray(jnp.inf, f32),
+            jnp.zeros((), bool),
+            jnp.full((n,), -1, i32),
+            jnp.zeros((k, d), f32),
+            jnp.zeros((k,), f32),
+            jnp.zeros((n,), f32),          # sb
+            jnp.zeros((n, t), f32),        # glb
+            centroids0.astype(cd),
+            jnp.zeros((k,), f32),          # csq_prev
+            jnp.zeros((), i32),            # flavor: start on delta
+            # First judgment promotes: the first yinyang probe runs in
+            # period 1, so the policy is measuring within 2 periods of
+            # any fit long enough to care.
+            (reprobe - 1).astype(i32),
+            jnp.zeros((), f32),            # period recompute rows
+            jnp.zeros((), i32),            # period sweep count
+            jnp.zeros((), f32),            # recompute_rows total
+            jnp.zeros((), f32),            # rows_seen total
+            jnp.zeros((), f32),            # group pairs pruned
+            jnp.zeros((), f32),            # group pairs seen
+        )
+        final = lax.while_loop(cond, body, init)
+        centroids, n_iter, shift_sq, converged = final[:4]
+        diag = _diag()
+        diag["final_flavor"] = final[11]
+        diag["recompute_rows"] = final[15]
+        diag["rows_seen"] = final[16]
+        diag["group_pairs_pruned"] = final[17]
+        diag["group_pairs_seen"] = final[18]
     else:
         def cond(s):
             c, it, shift_sq, done = s
@@ -221,9 +475,11 @@ def _lloyd_loop(
         )
         centroids, n_iter, shift_sq, converged = lax.while_loop(
             cond, body, init)
+        diag = _diag()
     # Final consistent view: labels/inertia/counts at the *final* centroids.
     labels, _, _, counts, inertia = lloyd_pass(x, centroids, **kw)
-    return KMeansState(centroids, labels, inertia, n_iter, converged, counts)
+    return (KMeansState(centroids, labels, inertia, n_iter, converged,
+                        counts), diag)
 
 
 def fit_lloyd(
@@ -236,11 +492,18 @@ def fit_lloyd(
     weights: Optional[jax.Array] = None,
     tol: Optional[float] = None,
     max_iter: Optional[int] = None,
+    diag: bool = False,
 ) -> KMeansState:
     """Fit full-batch Lloyd k-means.
 
     ``init`` may be an (k, d) array of starting centroids (overrides
     ``config.init``) or a method name.
+
+    ``diag=True`` additionally returns the pruned-sweep diagnostics as a
+    dict of host floats (``{"recompute_rows", "rows_seen",
+    "group_pairs_pruned", "group_pairs_seen", "final_flavor"}``; -1
+    where the resolved flavor measures nothing) — the bench's evidence
+    counters and the auto-switch policy's observable.
     """
     cfg, key, centroids0 = resolve_fit_inputs(x, k, key, config, init, weights)
     backend = resolve_backend(
@@ -256,24 +519,70 @@ def fit_lloyd(
     update = resolve_update(
         cfg.update, w_exact=weights_exact(cd, weights=weights),
     )
-    if update == "hamerly" and cfg.empty == "farthest":
+    if update in ("hamerly", "yinyang") and cfg.empty == "farthest":
         raise ValueError(
-            "update='hamerly' prunes rows from the distance pass, so no "
+            f"update={update!r} prunes rows from the distance pass, so no "
             "per-sweep min_d2 exists for the farthest-reseed policy; use "
             "empty='keep' or update='auto'/'delta'"
         )
-    return _lloyd_loop(
+    # The "auto" policy's runtime-adaptive layer: resolve_update's static
+    # answer stays "delta" (the pinned public contract), but large fits
+    # upgrade to the measuring loop that probes yinyang each refresh
+    # period.  Constants read at CALL time (monkeypatch-friendly) and
+    # passed traced, so tuning them never re-traces the loop.
+    from kmeans_tpu.ops import yinyang as _yy
+
+    adaptive = (cfg.update == "auto" and update == "delta"
+                and cfg.empty == "keep"
+                and x.shape[0] >= _yy.AUTO_MIN_ROWS)
+    group_of = None
+    switch_high = None
+    reprobe = None
+    groups = None
+    if update == "yinyang" or adaptive:
+        if adaptive:
+            update = "adaptive"
+            switch_high = jnp.asarray(_yy.AUTO_SWITCH_HIGH, jnp.float32)
+            reprobe = jnp.asarray(_yy.AUTO_REPROBE_PERIODS, jnp.int32)
+        # Group formation is host-side NumPy, once per fit, from the
+        # initial centroids (deterministic given init + seed).
+        g_np, groups = _yy.centroid_groups(
+            jax.device_get(centroids0), cfg.yinyang_groups,
+            seed=cfg.seed)
+        group_of = jnp.asarray(g_np)
+    state, dg = _lloyd_loop(
         x,
         centroids0,
         weights,
         jnp.asarray(tol if tol is not None else cfg.tol, jnp.float32),
+        group_of,
+        switch_high,
+        reprobe,
         max_iter=max_iter if max_iter is not None else cfg.max_iter,
         chunk_size=cfg.chunk_size,
         compute_dtype=cfg.compute_dtype,
         update=update,
         empty=cfg.empty,
         backend=backend,
+        groups=groups,
     )
+    host_diag = None
+    if update in ("hamerly", "yinyang", "adaptive"):
+        # One host pull per fit stamps the exact counters; dense/delta
+        # fits skip it entirely and stay sync-free.
+        host_diag = {kk: float(v) for kk, v in jax.device_get(dg).items()}
+        _SWEEP_RECOMPUTE_ROWS.labels(update=update).inc(
+            max(host_diag["recompute_rows"], 0.0))
+        if host_diag["group_pairs_seen"] > 0:
+            _SWEEP_GROUP_FILTER_FRACTION.set(
+                host_diag["group_pairs_pruned"]
+                / host_diag["group_pairs_seen"])
+    if diag:
+        if host_diag is None:
+            host_diag = {kk: float(v)
+                         for kk, v in jax.device_get(dg).items()}
+        return state, host_diag
+    return state
 
 
 def fit_plan(
@@ -288,13 +597,17 @@ def fit_plan(
     tests assert against (so "the judged number is the shipped path" is a
     checkable claim, not a README sentence).
 
-    Returns ``{"update", "backend", "delta_backend"}``: the resolved
-    reduction flavor, the resolved classic-sweep backend, and — when
-    ``update == "delta"`` — which backend the delta sweeps themselves run
-    (``"pallas"`` for the fused Mosaic kernel, ``"xla"`` for the
-    gather-based route), mirroring the re-gating :func:`fit_lloyd`'s loop
-    performs at the delta kernel's own VMEM footprint.  Raises exactly
-    where :func:`fit_lloyd` would (explicit unsupported choices).
+    Returns ``{"update", "backend", "delta_backend", "adaptive"}``: the
+    resolved reduction flavor, the resolved classic-sweep backend, and —
+    when ``update`` is an incremental flavor — which backend its sweeps
+    themselves run (``"pallas"`` for the fused Mosaic kernel, ``"xla"``
+    for the gather-based route), mirroring the re-gating
+    :func:`fit_lloyd`'s loop performs at each kernel's own VMEM
+    footprint.  ``adaptive`` reports whether the "auto" policy's
+    runtime delta ↔ yinyang switch engages for this shape (the resolved
+    ``update`` stays ``"delta"`` — that is the loop's starting flavor).
+    Raises exactly where :func:`fit_lloyd` would (explicit unsupported
+    choices).
     """
     from kmeans_tpu.ops.delta import resolve_delta_backend
 
@@ -335,8 +648,29 @@ def fit_plan(
             backend, x, k, weights=weights,
             compute_dtype=cfg.compute_dtype,
         )
+    elif update == "yinyang":
+        from kmeans_tpu.ops.yinyang import (default_groups,
+                                            resolve_yinyang_backend)
+
+        if cfg.empty == "farthest":
+            raise ValueError(
+                "update='yinyang' prunes rows from the distance pass, so "
+                "no per-sweep min_d2 exists for the farthest-reseed "
+                "policy; use empty='keep' or update='auto'/'delta'"
+            )
+        _, delta_backend = resolve_yinyang_backend(
+            backend, x, k,
+            groups=(cfg.yinyang_groups if cfg.yinyang_groups is not None
+                    else default_groups(k)),
+            weights=weights, compute_dtype=cfg.compute_dtype,
+        )
+    from kmeans_tpu.ops import yinyang as _yy
+
+    adaptive = (cfg.update == "auto" and update == "delta"
+                and cfg.empty == "keep"
+                and x.shape[0] >= _yy.AUTO_MIN_ROWS)
     return {"update": update, "backend": backend,
-            "delta_backend": delta_backend}
+            "delta_backend": delta_backend, "adaptive": adaptive}
 
 
 def best_of_n_init(fit_one, key, n_init, *, score=lambda s: float(s.inertia)):
@@ -425,6 +759,7 @@ class KMeans(NearestCentroidMixin):
     chunk_size: int = 4096
     compute_dtype: Optional[str] = None
     update: str = "auto"
+    yinyang_groups: Optional[int] = None
     empty: str = "keep"
     backend: str = "auto"
 
@@ -442,6 +777,7 @@ class KMeans(NearestCentroidMixin):
             chunk_size=self.chunk_size,
             compute_dtype=self.compute_dtype,
             update=self.update,
+            yinyang_groups=self.yinyang_groups,
             empty=self.empty,
             backend=self.backend,
         )

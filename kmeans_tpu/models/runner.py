@@ -25,7 +25,7 @@ import numpy as np
 
 from kmeans_tpu.config import KMeansConfig
 from kmeans_tpu.models.init import init_centroids
-from kmeans_tpu.models.lloyd import KMeansState
+from kmeans_tpu.models.lloyd import KMeansState, _SWEEP_RECOMPUTE_ROWS
 from kmeans_tpu.obs import (
     costmodel as _costmodel,
     counter as _obs_counter,
@@ -160,10 +160,17 @@ class LloydRunner:
         self._stepped = False
         self._stepped_delta = False
 
-        # Carried (labels, sums, counts) of the incremental update between
-        # step() calls; None = next sweep must be a full refresh (fresh
-        # runner, post-resume, post-init).
+        # Carried state of the incremental update between step() calls;
+        # None = next sweep must be a full refresh (fresh runner,
+        # post-resume, post-init).  delta carries (labels, sums, counts);
+        # hamerly/yinyang additionally carry their drift bounds
+        # (sb, slb|glb, c_prev_cd, csq_prev).  ``_bound_tail`` holds the
+        # fit-static trailing args of the bound step (row norms, and for
+        # yinyang the centroid→group map).
         self._dstate = None
+        self._bound_tail = ()
+        self._group_of = None
+        self._t = None
 
         # Step-paced Anderson acceleration: the runner applies the
         # shared safeguarded decision (ops.anderson.anderson_step — THE
@@ -216,12 +223,21 @@ class LloydRunner:
             # fit_lloyd's default takes), carried across step() calls so
             # the serve train stream runs the headline kernel too.
             self._update = resolve_update(cfg.update, w_exact=True)
-            if self._update == "hamerly":
-                raise ValueError(
-                    "LloydRunner steps the delta or dense loops; the "
-                    "bound-pruned hamerly loop runs through fit_lloyd "
-                    "(use update='auto' or 'delta' here)"
-                )
+            if self._update in ("hamerly", "yinyang"):
+                if self._accel_step is not None:
+                    raise ValueError(
+                        f"accel='anderson' extrapolates between sweeps, "
+                        f"which would interleave with update="
+                        f"{self._update!r}'s carried-bound refresh "
+                        "cadence; use update='delta' under acceleration"
+                    )
+                if self.cfg.empty == "farthest":
+                    raise ValueError(
+                        f"update={self._update!r} prunes rows, so the "
+                        "per-sweep min-distances that empty='farthest' "
+                        "reseeds from are never computed; use "
+                        "empty='keep'"
+                    )
             self._backend = resolve_backend(
                 cfg.backend, self.x, k, compute_dtype=cfg.compute_dtype,
             )
@@ -279,6 +295,79 @@ class LloydRunner:
                 self._step_delta = _costmodel.observe(
                     step_delta, name="runner.step_delta")
 
+            if self._update in ("hamerly", "yinyang"):
+                from kmeans_tpu.ops.delta import default_cap
+                from kmeans_tpu.ops.hamerly import (_NORM_INFLATE,
+                                                    hamerly_pass, row_norms)
+
+                bkw = dict(
+                    cap=default_cap(self.x.shape[0]),
+                    chunk_size=cfg.chunk_size,
+                    compute_dtype=cfg.compute_dtype,
+                    # Re-gate at the bound kernel's own VMEM footprint
+                    # (models/lloyd._lloyd_loop does the same).
+                    backend="auto" if backend == "pallas" else backend,
+                )
+                # Fit-static per-row norms (the drift-bound R_r terms).
+                # ``rno`` is the cast-row norm inflated by the f32 slack;
+                # un-inflating recovers xsq for the inertia estimate.
+                self._rno = row_norms(self.x,
+                                      compute_dtype=cfg.compute_dtype)
+                self._bound_tail = (self._rno,)
+
+                def _bound_outputs(c, sums2, counts2, sb3, rno):
+                    new_c = apply_update(c, sums2, counts2)
+                    shift_sq = jnp.sum((new_c - c) ** 2)
+                    # Pruned sweeps never score every row, so exact
+                    # inertia is unavailable mid-run (finalize() reports
+                    # it).  sb is each row's drift-inflated own-centroid
+                    # score bound: sum(xsq + sb) is an upper estimate,
+                    # exact (up to bf16 scoring) on refresh sweeps.
+                    xsq = (rno / _NORM_INFLATE) ** 2
+                    inertia = jnp.sum(jnp.maximum(xsq + sb3, 0.0))
+                    return new_c, inertia, shift_sq
+
+                # Carried (labels, sums, counts, sb, slb|glb) donated like
+                # the delta step: run() overwrites self._dstate with the
+                # returns, and refresh sweeps feed freshly built sentinel
+                # arrays.  c_prev_cd/csq are NOT donated — the sentinel's
+                # c_prev_cd can alias the live self.centroids buffer.
+                if self._update == "hamerly":
+                    @functools.partial(jax.jit,
+                                       donate_argnums=(2, 3, 4, 5, 6))
+                    def step_bound(x, c, lab, sums, counts, sb, slb,
+                                   c_cd, csq, rno):
+                        (lab2, sums2, counts2, sb3, slb3, c_cd2, csq2,
+                         n_rec) = hamerly_pass(
+                            x, c, lab, sums, counts, sb, slb, c_cd, csq,
+                            rno, **bkw)
+                        new_c, inertia, shift_sq = _bound_outputs(
+                            c, sums2, counts2, sb3, rno)
+                        return (new_c, inertia, shift_sq, lab2, sums2,
+                                counts2, sb3, slb3, c_cd2, csq2, n_rec)
+
+                    self._step_delta = _costmodel.observe(
+                        step_bound, name="runner.step_hamerly")
+                else:
+                    from kmeans_tpu.ops.yinyang import yinyang_pass
+
+                    @functools.partial(jax.jit,
+                                       donate_argnums=(2, 3, 4, 5, 6))
+                    def step_bound(x, c, lab, sums, counts, sb, glb,
+                                   c_cd, csq, rno, group_of):
+                        (lab2, sums2, counts2, sb3, glb3, c_cd2, csq2,
+                         n_rec, n_gp) = yinyang_pass(
+                            x, c, lab, sums, counts, sb, glb, c_cd, csq,
+                            rno, group_of, **bkw)
+                        new_c, inertia, shift_sq = _bound_outputs(
+                            c, sums2, counts2, sb3, rno)
+                        return (new_c, inertia, shift_sq, lab2, sums2,
+                                counts2, sb3, glb3, c_cd2, csq2, n_rec,
+                                n_gp)
+
+                    self._step_delta = _costmodel.observe(
+                        step_bound, name="runner.step_yinyang")
+
             # Compile-observed under a STABLE name: each runner instance
             # compiles its own program, so a second instance re-tracing
             # an already-seen signature is a visible retrace (the
@@ -299,11 +388,12 @@ class LloydRunner:
             # The step-wise mesh path runs the dense per-sweep reduction
             # (stateless shard bodies); the carried-state incremental loop
             # on a mesh is fit_lloyd_sharded's _build_lloyd_delta_run.
-            if self.cfg.update in ("delta", "hamerly"):
+            if self.cfg.update in ("delta", "hamerly", "yinyang"):
                 raise ValueError(
                     "LloydRunner on a mesh runs the dense per-sweep "
-                    "reduction; use fit_lloyd_sharded(update='delta') for "
-                    "the incremental sharded loop, or update='auto'"
+                    "reduction; use fit_lloyd_sharded(update='delta'/"
+                    "'hamerly'/'yinyang') for the incremental sharded "
+                    "loops, or update='auto'"
                 )
             self._update = ("matmul" if self.cfg.update == "auto"
                             else self.cfg.update)
@@ -367,9 +457,33 @@ class LloydRunner:
             self._step_prog = step
             self._step = lambda x, c: step(x, c, self._w)
 
+    def _sentinel_bound_state(self):
+        """Fresh carried state for a bound-pruned refresh sweep: the
+        ``labels_prev = -1`` sentinel plus zeroed sums/counts/bounds makes
+        :func:`hamerly_pass`/:func:`yinyang_pass` run a full reduction
+        (every row recomputed, bounds re-derived exactly) — the same
+        reset the fused loop applies every ``DELTA_REFRESH`` iterations."""
+        n, d = self.x.shape
+        k = self.k
+        f32 = jnp.float32
+        cd = (jnp.dtype(self.cfg.compute_dtype)
+              if self.cfg.compute_dtype is not None else self.x.dtype)
+        lower = (jnp.zeros((n, self._t), f32)
+                 if self._update == "yinyang" else jnp.zeros((n,), f32))
+        return (
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((k, d), f32),
+            jnp.zeros((k,), f32),
+            jnp.zeros((n,), f32),          # sb (sentinel sweep overwrites)
+            lower,                          # slb | glb
+            self.centroids.astype(cd),
+            jnp.zeros((k,), f32),           # csq_prev (unused on sentinel)
+        )
+
     # ------------------------------------------------------------------ API
     def init(self, init=None) -> None:
         self._dstate = None          # carried delta state is init-specific
+        self._group_of = None        # yinyang groups re-form per init
         if init is not None and not isinstance(init, str):
             self.centroids = jnp.asarray(init, jnp.float32)
         else:
@@ -414,6 +528,18 @@ class LloydRunner:
         """
         if self.centroids is None:
             self.init()
+        if (self.mesh is None and self._update == "yinyang"
+                and self._group_of is None):
+            # Fit-static centroid→group map, formed once from the CURRENT
+            # centroids (the fused fit does the same from centroids0; a
+            # resume re-derives it — bounds are init/resume-specific).
+            from kmeans_tpu.ops import yinyang as _yy
+
+            g_np, self._t = _yy.centroid_groups(
+                jax.device_get(self.centroids), self.cfg.yinyang_groups,
+                seed=self.cfg.seed)
+            self._group_of = jnp.asarray(g_np)
+            self._bound_tail = (self._rno, self._group_of)
         if checkpoint_path and checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}"
@@ -498,7 +624,37 @@ class LloydRunner:
                                      iteration=self.iteration + 1):
                     t0 = time.perf_counter()
                     ran_delta = False
-                    if self.mesh is None and self._update == "delta":
+                    n_rec = n_gp = None
+                    if (self.mesh is None
+                            and self._update in ("hamerly", "yinyang")):
+                        # Bound-carrying loop: sentinel refresh on the
+                        # first sweep after (re)init/resume and every
+                        # DELTA_REFRESH-th iteration (fused cadence),
+                        # the carried (labels, sums, counts, sb, slb|glb)
+                        # sweep otherwise.  ONE jitted program either
+                        # way — refresh differs only in the fed values.
+                        from kmeans_tpu.ops.delta import DELTA_REFRESH
+
+                        refresh = (self._dstate is None
+                                   or self.iteration % DELTA_REFRESH == 0)
+                        if refresh:
+                            self._dstate = self._sentinel_bound_state()
+                        ran_delta = True   # carried-state program slot
+                        first = not self._stepped_delta
+                        with _tracing.span(
+                                "sweep",
+                                category="compile" if first else "assign",
+                                sweep=("refresh" if refresh
+                                       else self._update)):
+                            out = self._step_delta(
+                                self.x, self.centroids,
+                                *self._dstate, *self._bound_tail)
+                        new_c, inertia, shift_sq = out[0], out[1], out[2]
+                        self._dstate = out[3:10]
+                        n_rec = out[10]
+                        if self._update == "yinyang":
+                            n_gp = out[11]
+                    elif self.mesh is None and self._update == "delta":
                         # Incremental loop: full refresh on the first sweep
                         # after (re)init/resume and every DELTA_REFRESH-th
                         # iteration (drift bound, same cadence as
@@ -597,6 +753,19 @@ class LloydRunner:
                         extra = ({} if outcome is None
                                  else {"accel": outcome})
                         extra.update(compile_extra)
+                        if n_rec is not None:
+                            # Pruning effectiveness of THIS sweep: the
+                            # fraction of rows whose distances were
+                            # actually recomputed (exact on-device
+                            # counter; 1.0 on refresh sweeps).
+                            rec = float(n_rec)
+                            extra["recompute_fraction"] = (
+                                rec / self.x.shape[0])
+                            _SWEEP_RECOMPUTE_ROWS.labels(
+                                update=self._update).inc(max(rec, 0.0))
+                        if n_gp is not None and float(n_rec) > 0:
+                            extra["group_filter_fraction"] = (
+                                float(n_gp) / (float(n_rec) * self._t))
                         if tw is not None:
                             tw.iteration(info, model="lloyd",
                                          device=device, phase=phase,
@@ -662,7 +831,8 @@ class LloydRunner:
             out["compile_s"] = rec["seconds"]
         try:
             if ran_delta:
-                args = (self.x, self.centroids) + tuple(self._dstate)
+                args = ((self.x, self.centroids) + tuple(self._dstate)
+                        + tuple(self._bound_tail))
             elif self.mesh is not None:
                 args = (self.x, self.centroids, self._w)
             else:
@@ -733,6 +903,7 @@ class LloydRunner:
         state, meta = load_checkpoint(path)
         self.centroids = jnp.asarray(state.centroids, jnp.float32)
         self._dstate = None          # stale across a process boundary
+        self._group_of = None        # groups re-form from the new centroids
         self.iteration = int(meta["step"])
         if "key" in meta:
             self.key = meta["key"]

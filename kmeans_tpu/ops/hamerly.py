@@ -80,7 +80,7 @@ from kmeans_tpu.ops.pallas_lloyd import (KernelPlan, kernel_plan,
 __all__ = ["hamerly_pass", "hamerly_pallas_ok", "hamerly_kernel_plan",
            "resolve_hamerly_backend",
            "row_norms", "HAMERLY_MARGIN_REL", "closure_candidates",
-           "closure_assign_device"]
+           "closure_assign_device", "centroid_mini_kmeans"]
 
 #: Relative soundness margin over the f32 dot-accumulation error bound
 #: (γ_d ≈ d·2⁻²⁴ ≈ 1.2e-4 at d=2048; the bound enters twice per dot and
@@ -117,6 +117,61 @@ def row_norms(x, *, compute_dtype=None, chunk_size: int = 65536) -> jax.Array:
     _, out = lax.scan(body, None,
                       xp.reshape(-1, chunk_size, d))
     return out.reshape(-1)[:n] * _NORM_INFLATE
+
+
+def centroid_mini_kmeans(centroids, n_groups: int, *, seed: int = 0,
+                         iters: int = 8):
+    """Farthest-point-seeded NumPy k-means over the *centroid set* — THE
+    one copy of the centroid-grouping machinery, shared by
+    :func:`closure_candidates` (serve-time candidate tables) and
+    :func:`kmeans_tpu.ops.yinyang.centroid_groups` (training-side group
+    bounds).  Groups must land ON the centroid set's natural clusters:
+    farthest-point (maxmin) init plus a single-take reseed order for
+    groups emptied mid-iteration (two empty groups must not reseed to the
+    same centroid — they would stay duplicates forever).
+
+    Returns ``(mu (G, d) f32 group centers, lab (k,) int32 assignment of
+    each centroid to its nearest FINAL group center)``.
+    """
+    import numpy as np
+
+    c = np.asarray(centroids, np.float32)
+    if c.ndim != 2:
+        raise ValueError(f"centroids must be (k, d); got {c.shape}")
+    k, _d = c.shape
+    g_n = max(1, min(int(n_groups), k))
+    rng = np.random.RandomState(seed)
+    csq = np.einsum("kd,kd->k", c, c)
+    first = int(rng.randint(k))
+    picks = [first]
+    mind = np.maximum(csq + csq[first] - 2.0 * (c @ c[first]), 0.0)
+    for _ in range(g_n - 1):
+        nxt = int(mind.argmax())
+        picks.append(nxt)
+        mind = np.minimum(
+            mind, np.maximum(csq + csq[nxt] - 2.0 * (c @ c[nxt]), 0.0))
+    mu = c[picks].copy()
+    for _ in range(max(1, int(iters))):
+        musq = np.einsum("gd,gd->g", mu, mu)
+        d2 = csq[:, None] - 2.0 * (c @ mu.T) + musq[None, :]
+        lab = d2.argmin(axis=1)
+        # Reseed order for groups emptied THIS iteration: centroids by
+        # decreasing distance to their assigned center, each taken at
+        # most once.
+        far_order = np.argsort(-np.take_along_axis(
+            d2, lab[:, None], axis=1)[:, 0])
+        reseed_at = 0
+        for g in range(g_n):
+            members = c[lab == g]
+            if members.shape[0]:
+                mu[g] = members.mean(axis=0)
+            else:
+                # The fits' empty="farthest" policy, in miniature.
+                mu[g] = c[int(far_order[min(reseed_at, k - 1)])]
+                reseed_at += 1
+    musq = np.einsum("gd,gd->g", mu, mu)
+    lab = (csq[:, None] - 2.0 * (c @ mu.T) + musq[None, :]).argmin(axis=1)
+    return mu.astype(np.float32), lab.astype(np.int32)
 
 
 def closure_candidates(centroids, *, n_groups: Optional[int] = None,
@@ -159,42 +214,13 @@ def closure_candidates(centroids, *, n_groups: Optional[int] = None,
     # certificate failures at k=1000 with ~10x fewer FLOPs).
     m = int(cand_len) if cand_len else min(k, max(16, 3 * -(-k // g_n)))
     m = max(1, min(m, k))
-    rng = np.random.RandomState(seed)
-    csq = np.einsum("kd,kd->k", c, c)
     # Farthest-point (maxmin) init: the certificate's slack is
     # ``thr_g − ||x − μ_g||``, so group centers must land ON the
     # centroid set's natural clusters — a random pick leaves empty
     # groups and merged clusters, which blows up ``||x − μ_g||`` and
     # with it the dense-fallback rate (measured: 16% vs ~0 at k=1000).
-    first = int(rng.randint(k))
-    picks = [first]
-    mind = np.maximum(csq + csq[first] - 2.0 * (c @ c[first]), 0.0)
-    for _ in range(g_n - 1):
-        nxt = int(mind.argmax())
-        picks.append(nxt)
-        mind = np.minimum(
-            mind, np.maximum(csq + csq[nxt] - 2.0 * (c @ c[nxt]), 0.0))
-    mu = c[picks].copy()
-    for _ in range(max(1, int(iters))):
-        musq = np.einsum("gd,gd->g", mu, mu)
-        d2 = csq[:, None] - 2.0 * (c @ mu.T) + musq[None, :]
-        lab = d2.argmin(axis=1)
-        # Reseed order for groups emptied THIS iteration: centroids by
-        # decreasing distance to their assigned center, each taken at
-        # most once — two empty groups must not reseed to the same
-        # centroid (they would stay duplicates forever, silently
-        # shrinking the effective group count).
-        far_order = np.argsort(-np.take_along_axis(
-            d2, lab[:, None], axis=1)[:, 0])
-        reseed_at = 0
-        for g in range(g_n):
-            members = c[lab == g]
-            if members.shape[0]:
-                mu[g] = members.mean(axis=0)
-            else:
-                # The fits' empty="farthest" policy, in miniature.
-                mu[g] = c[int(far_order[min(reseed_at, k - 1)])]
-                reseed_at += 1
+    mu, _ = centroid_mini_kmeans(c, g_n, seed=seed, iters=iters)
+    csq = np.einsum("kd,kd->k", c, c)
     musq = np.einsum("gd,gd->g", mu, mu)
     # (G, k) exact distances group-center -> centroid (f64 sqrt of a
     # clamped f32 quadratic: thresholds must not go negative-fuzzy).

@@ -154,7 +154,7 @@ def resolve_update(
         if w_exact and not sharded_axes:
             return "delta"
         return "matmul" if w_exact else "segment"
-    if update in ("delta", "hamerly"):
+    if update in ("delta", "hamerly", "yinyang"):
         if sharded_axes:
             raise ValueError(
                 f"update={update!r} carries per-shard row state; it does "
@@ -216,10 +216,11 @@ def lloyd_pass(
     """
     if backend not in ("xla", "pallas", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
-    if update in ("auto", "delta", "hamerly"):
-        # "delta"/"hamerly" are LOOP-level structures (carried row state
-        # in fit_lloyd); a single stateless sweep's reduction is the
-        # dense matmul.  Accepting them — and the "auto" config default —
+    if update in ("auto", "delta", "hamerly", "yinyang", "adaptive"):
+        # "delta"/"hamerly"/"yinyang" (and the fit loop's internal
+        # "adaptive") are LOOP-level structures (carried row state in
+        # fit_lloyd); a single stateless sweep's reduction is the dense
+        # matmul.  Accepting them — and the "auto" config default —
         # here lets every model that forwards cfg.update (spherical,
         # trimmed, accelerated, runner, ...) run under any KMeansConfig.
         update = "matmul"

@@ -93,6 +93,7 @@ VMEM_KERNEL_DEFAULTS = {
     "classic": (512, None),
     "delta": (1024, 128),
     "hamerly": (1024, 256),
+    "yinyang": (1024, 256),
 }
 
 
@@ -100,7 +101,8 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
                    block_rows: Optional[int] = None,
                    mc: Optional[int] = None,
                    x_itemsize: int = 2, cd_itemsize: int = 2,
-                   k_tile: Optional[int] = None):
+                   k_tile: Optional[int] = None,
+                   groups: Optional[int] = None):
     """Named VMEM byte terms of one kernel's resident+streamed operands.
 
     THE one copy of the footprint arithmetic: the ``*_supported`` gates
@@ -115,10 +117,17 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
     double-buffered centroid slices plus the fold pass's per-slice
     accumulators, summed together (conservative — the two passes are
     separate ``pallas_call``s, so this over- rather than under-counts).
-    The tiled table is shared by all three kinds: the tiled delta and
-    hamerly paths reuse the classic streamed-argmin pass plus a signed
-    fold, with no compaction machinery (their extra tiled terms are the
-    signed-fold tile and, for hamerly, the second-min carry).
+    The tiled table is shared by every kind: the tiled delta and
+    hamerly/yinyang paths reuse the classic streamed-argmin pass plus a
+    signed fold, with no compaction machinery (their extra tiled terms are
+    the signed-fold tile and, for hamerly/yinyang, the second-min carry).
+
+    ``kind="yinyang"`` prices the hamerly footprint PLUS the group-bound
+    state the yinyang family carries (ISSUE 15): the per-row ``(T, G)``
+    group lower-bound tile streamed in and out (``G`` = ``groups`` rounded
+    to the lane — the (n, t) bound state lives in HBM, only one row-tile's
+    slice is VMEM-resident), the resident per-group drift vectors, and the
+    ``(k,)`` group-id map.
 
     Returns an ordered ``{term: bytes}`` dict at the PADDED shapes
     (``padded_d(d)``, ``k`` rounded to the 128 lane), or ``None`` when
@@ -135,6 +144,10 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
     if not d_eff:
         return None
     k_pad = _round_up(k, _LANE)
+    # Lane-rounded group count for the yinyang bound tiles (t ≈ k/10 by
+    # the family's default policy when the caller doesn't say).
+    g_pad = _round_up(max(1, groups if groups is not None else -(-k // 10)),
+                      _LANE)
     if k_tile is not None:
         kt = _round_up(min(k_tile, k_pad), _LANE)
         terms = {
@@ -150,11 +163,14 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
             "fold_counts_tile": kt * 4,
             "fold_onehot_tile": t * kt * (4 + cd_itemsize),
         }
-        if kind in ("delta", "hamerly"):
+        if kind in ("delta", "hamerly", "yinyang"):
             # Signed ±w fold builds two one-hot products per tile.
             terms["signed_fold_tile"] = t * kt * (4 + cd_itemsize)
-        if kind == "hamerly":
+        if kind in ("hamerly", "yinyang"):
             terms["second_min_carry"] = t * _LANE * 4
+        if kind == "yinyang":
+            terms["glb_tile_stream"] = 2 * 2 * t * g_pad * 4
+            terms["group_drift"] = 2 * g_pad * 4 + k_pad * 4
         return terms
     terms = {
         "centroids_ct": d_eff * k_pad * cd_itemsize,  # resident (d, k) -2x
@@ -164,24 +180,32 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
         "dist_tile": t * k_pad * 4,                   # (T, k) scores
         "onehot_tile": t * k_pad * (4 + cd_itemsize),
     }
-    if kind in ("delta", "hamerly"):
+    if kind in ("delta", "hamerly", "yinyang"):
         terms["tri_prefix"] = t * t * cd_itemsize     # resident (T, T) tri
         terms["compaction"] = mc * t * (4 + cd_itemsize)   # p_mat + builds
         terms["x_compact"] = mc * d_eff * 4           # gathered (mc, d)
         terms["signed_onehot"] = mc * k_pad * (4 + cd_itemsize)
         terms["dense_fold"] = t * k_pad * (4 + cd_itemsize)
-    if kind == "hamerly":
+    if kind in ("hamerly", "yinyang"):
         terms["score_tile"] = mc * k_pad * 4          # compacted (mc, k)
         terms["writeback_pack"] = (mc + t) * _LANE * 4
+    if kind == "yinyang":
+        # (T, G) group lower-bound tile, streamed in AND out (the (n, t)
+        # state is HBM-resident), plus the per-group min-Δ/max-δ drift
+        # vectors and the (k,) group-id map, all f32/i32.
+        terms["glb_tile_stream"] = 2 * 2 * t * g_pad * 4
+        terms["group_min_tile"] = mc * g_pad * 4
+        terms["group_drift"] = 2 * g_pad * 4 + k_pad * 4
     return terms
 
 
 def _fits_budget(kind: str, d: int, k: int, *, block_rows, mc,
                  x_itemsize: int, cd_itemsize: int,
-                 k_tile: Optional[int] = None) -> bool:
+                 k_tile: Optional[int] = None,
+                 groups: Optional[int] = None) -> bool:
     terms = vmem_breakdown(kind, d=d, k=k, block_rows=block_rows, mc=mc,
                            x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
-                           k_tile=k_tile)
+                           k_tile=k_tile, groups=groups)
     return terms is not None and sum(terms.values()) <= _vmem_budget()
 
 
@@ -262,7 +286,8 @@ class KernelPlan(NamedTuple):
 
 def max_k_tile(kind: str, d: int, k: int, *,
                block_rows: Optional[int] = None, mc: Optional[int] = None,
-               x_itemsize: int = 2, cd_itemsize: int = 2) -> Optional[int]:
+               x_itemsize: int = 2, cd_itemsize: int = 2,
+               groups: Optional[int] = None) -> Optional[int]:
     """Largest lane-multiple centroid slice whose TILED footprint fits
     the VMEM budget (capped at ``k`` rounded to the lane), or ``None``
     when even a single 128-lane slice overflows — THE one tile-size
@@ -276,7 +301,7 @@ def max_k_tile(kind: str, d: int, k: int, *,
     def fits(lanes: int) -> bool:
         return _fits_budget(kind, d, k, block_rows=block_rows, mc=mc,
                             x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
-                            k_tile=lanes * _LANE)
+                            k_tile=lanes * _LANE, groups=groups)
 
     hi_l = k_pad // _LANE
     if not fits(1):
@@ -293,7 +318,8 @@ def max_k_tile(kind: str, d: int, k: int, *,
 
 def kernel_plan(kind: str, d: int, k: int, *,
                 block_rows: Optional[int] = None, mc: Optional[int] = None,
-                x_itemsize: int = 2, cd_itemsize: int = 2) -> KernelPlan:
+                x_itemsize: int = 2, cd_itemsize: int = 2,
+                groups: Optional[int] = None) -> KernelPlan:
     """Shape-level dispatch decision for one kernel kind (see
     :class:`KernelPlan`).  Prefers the untiled kernel whenever its
     resident footprint fits (strictly fewer HBM reads: the fold rides
@@ -311,11 +337,13 @@ def kernel_plan(kind: str, d: int, k: int, *,
             f"d={d} is not lane-alignable within the "
             f"{_PAD_INFLATION_CAP}x zero-padding cap")
     if _fits_budget(kind, d, k, block_rows=block_rows, mc=mc,
-                    x_itemsize=x_itemsize, cd_itemsize=cd_itemsize):
+                    x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
+                    groups=groups):
         return KernelPlan("untiled", None,
                           "resident (k, d) footprint fits the VMEM budget")
     kt = max_k_tile(kind, d, k, block_rows=block_rows, mc=mc,
-                    x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+                    x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
+                    groups=groups)
     if kt is not None:
         return KernelPlan(
             "tiled", kt,

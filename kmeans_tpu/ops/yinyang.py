@@ -1,0 +1,495 @@
+"""Yinyang group-drift pruned exact Lloyd sweep: per-GROUP lower bounds
+where hamerly carries one global one.
+
+:mod:`kmeans_tpu.ops.hamerly` prunes with a single runner-up bound
+
+    slb' = slb + min_c Δ_c − 2·R_r·max_c δ_c
+
+whose drift term is the GLOBAL worst-case centroid motion — at k=1000 a
+single fast-moving centroid poisons the lower bound for every row, and
+the measured recompute fraction stalls near 77% at the headline config
+(VERDICT round review).  This module carries t ≈ k/10 per-group bounds
+instead (Ding et al., "Yinyang K-Means: A Drop-In Replacement of the
+Classic K-Means with Consistent Speedup"; grouping machinery shared with
+the serve-side cluster closures via
+:func:`kmeans_tpu.ops.hamerly.centroid_mini_kmeans`):
+
+* ``group_of (k,) int32`` maps each centroid to one of ``t`` groups,
+  formed ONCE per fit from the initial centroids by the farthest-point
+  mini-k-means (:func:`centroid_groups`) — groups land on the centroid
+  set's natural clusters, so slow groups stay slow together.
+* Carried per row: ``sb`` (same upper bound on the assigned centroid's
+  score as hamerly) and ``glb (n, t)`` with ``glb[r, g] ≤
+  min_{c ∈ g, c ≠ a_r} s(r, c)`` — a lower bound on the best
+  *competitor* inside each group.
+* Drift tightens PER GROUP, with the identical η/margin derivation as
+  hamerly (same f32/bf16 score function ``s(r, c) = ||c||²_f32 −
+  2·dot_f32(x_r, bf16(c))``, same Cauchy-Schwarz bound on bf16-rounded
+  values, same :data:`~kmeans_tpu.ops.hamerly.HAMERLY_MARGIN_REL`
+  soundness margin):
+
+      glb'[r, g] = glb[r, g] + min_{c∈g} Δ_c − 2·R_r·max_{c∈g} δ_c
+
+Filtering is two-level.  The GROUP filter skips a row entirely when
+``sb' + margin < min_g glb'[r, g]`` (with t=1 this IS hamerly's test,
+bit for bit — tested).  Surviving rows then apply the LOCAL filter: a
+group ``g`` with ``sb' + margin < glb'[r, g]`` provably cannot contain
+the new argmin, so its centroids need no distances.  The assigned
+centroid's own group is ALWAYS treated as failing — the argmin must be
+allowed to stay put.  The XLA route computes the full-width score
+matrix and masks passing groups' columns to +inf before the argmin: the
+masked result provably equals the full argmin (every masked centroid's
+computed score exceeds ``s'(r, a_r) + margin``, margin absorbing the η
+accumulation slack, and the lowest-index tie-break only ever compares
+scored columns), so the FLOP win of the local filter is a property the
+TPU kernel's grouped compaction exploits while the XLA route keeps its
+width-independent gemm (the XLA:CPU threaded gemm splits wide
+contractions output-width-dependently — group-blocked matmuls would
+break bit-parity with the dense path; see the kernel-parity comment in
+:mod:`kmeans_tpu.ops.pallas_lloyd`).
+
+Bound refresh after a recompute touches ONLY failing groups:
+``glb[r, g] ← min_{c∈g, c≠label} s(r, c)`` from the actually-computed
+scores; passing groups keep their drifted bound.  Refreshing a passing
+group from a broadcast second-best would re-poison it with the fast
+group's small bound — per-group refresh is what makes the bounds
+compound across sweeps instead of collapsing to hamerly's.
+
+Exactness scope: identical to the hamerly contract (labels bit-exact
+given identical carried centroids; fits match the dense path through
+convergence; adversarial near-tie tests force recomputes rather than
+permit errors).  The sentinel refresh contract is also identical:
+``labels_prev = -1`` with zero ``sums_prev`` forces every row to
+recompute and the signed fold IS the full reduction.
+
+The Pallas route reuses :func:`~kmeans_tpu.ops.pallas_lloyd.
+lloyd_hamerly_pallas` (in-tile compaction + PR 11 k-tiling) with the
+yinyang ``need`` mask for labels/sb/fold, then refreshes ``glb`` with
+the gathered-XLA helper — counters and bound values are therefore
+backend-independent by construction.  Folding the per-group mins into
+the kernel's compacted score tile (pricing already in
+``vmem_breakdown("yinyang")``) is open kernel work; until then the
+Pallas route double-scores the recomputed rows for the refresh.
+
+The reference has no analog (its assignment is human drag-and-drop,
+/root/reference/app.mjs:358-372); north-star numeric engine work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kmeans_tpu.obs.costmodel import observed
+from kmeans_tpu.ops.distance import matmul_precision, sq_norms
+from kmeans_tpu.ops.hamerly import (HAMERLY_MARGIN_REL, _NORM_INFLATE,
+                                    centroid_mini_kmeans, row_norms)
+from kmeans_tpu.ops.lloyd import _platform_of, weights_exact
+from kmeans_tpu.ops.pallas_lloyd import (KernelPlan, kernel_plan,
+                                         lloyd_hamerly_pallas, padded_d)
+
+__all__ = ["yinyang_pass", "yinyang_pallas_ok", "yinyang_kernel_plan",
+           "resolve_yinyang_backend", "centroid_groups", "default_groups",
+           "row_norms", "AUTO_SWITCH_HIGH", "AUTO_REPROBE_PERIODS",
+           "AUTO_MIN_ROWS"]
+
+#: ``update="auto"`` runtime policy: switch yinyang → delta when the
+#: trailing refresh period's measured recompute fraction exceeds this
+#: (pruning is paying for its bound upkeep below it; the delta loop's
+#: plain refresh is cheaper above it).  Hysteresis comes from the probe
+#: cadence, not a second threshold: a flavor runs a full DELTA_REFRESH
+#: period before it can be judged.
+AUTO_SWITCH_HIGH = 0.5
+
+#: How many refresh periods a demoted (delta) phase runs before the
+#: policy re-probes yinyang — centroid drift decays monotonically in a
+#: converging fit, so pruning that lost early often pays later.
+AUTO_REPROBE_PERIODS = 8
+
+#: Rows below which ``update="auto"`` never engages the adaptive loop:
+#: bound upkeep is O(n·t) per sweep and the dense matmul is already
+#: cheap — measured break-even is far above this floor.
+AUTO_MIN_ROWS = 16384
+
+
+def default_groups(k: int) -> int:
+    """The family's default group count, t ≈ k/10 (Ding et al.'s
+    recommendation; lane-rounding happens in the kernel pricing, not
+    here — ``group_of`` is exact regardless)."""
+    return max(1, -(-int(k) // 10))
+
+
+def centroid_groups(centroids, n_groups: Optional[int] = None, *,
+                    seed: int = 0, iters: int = 8):
+    """(group_of (k,) int32 NumPy, t) — the once-per-fit centroid →
+    group assignment, host-side (NumPy) like the serve closures: group
+    formation must not need a device and must be deterministic given
+    (centroids, seed).
+
+    ``n_groups=None`` uses :func:`default_groups`.  ``t >= k`` returns
+    the identity map (per-centroid groups — the bounds degenerate to
+    exact per-competitor tracking); ``t == 1`` the all-zeros map
+    (degenerates to hamerly, tested bit-for-bit).
+    """
+    import numpy as np
+
+    c = np.asarray(centroids, np.float32)
+    if c.ndim != 2:
+        raise ValueError(f"centroids must be (k, d); got {c.shape}")
+    k = c.shape[0]
+    t = default_groups(k) if n_groups is None else int(n_groups)
+    t = max(1, min(t, k))
+    if t == k:
+        return np.arange(k, dtype=np.int32), k
+    if t == 1:
+        return np.zeros((k,), np.int32), 1
+    _, lab = centroid_mini_kmeans(c, t, seed=seed, iters=iters)
+    return lab, t
+
+
+def yinyang_kernel_plan(x, k: int, *, groups: Optional[int] = None,
+                        weights=None, weights_are_binary=False,
+                        compute_dtype=None, platform=None) -> KernelPlan:
+    """Full dispatch decision for the Mosaic yinyang route — mirrors
+    :func:`kmeans_tpu.ops.hamerly.hamerly_kernel_plan`, with the extra
+    (T, G) bound-tile terms priced via ``vmem_breakdown("yinyang")``."""
+    from jax.dtypes import canonicalize_dtype
+
+    x_dtype = jnp.dtype(canonicalize_dtype(x.dtype))
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x_dtype
+    n, d = x.shape
+    if not weights_exact(cd, weights=weights,
+                         weights_are_binary=weights_are_binary):
+        return KernelPlan("refuse", None,
+                          "fractional weights in a non-f32 compute dtype")
+    if _platform_of(x, platform) != "tpu":
+        return KernelPlan("refuse", None, "not running on TPU")
+    return kernel_plan("yinyang", d, k, x_itemsize=x_dtype.itemsize,
+                       cd_itemsize=cd.itemsize, groups=groups)
+
+
+def yinyang_pallas_ok(x, k: int, *, groups: Optional[int] = None,
+                      weights=None, weights_are_binary=False,
+                      compute_dtype=None, platform=None) -> bool:
+    """Bool veneer over :func:`yinyang_kernel_plan`."""
+    plan = yinyang_kernel_plan(
+        x, k, groups=groups, weights=weights,
+        weights_are_binary=weights_are_binary,
+        compute_dtype=compute_dtype, platform=platform,
+    )
+    return plan.mode != "refuse"
+
+
+def resolve_yinyang_backend(backend, x, k: int, *,
+                            groups: Optional[int] = None, weights=None,
+                            weights_are_binary=False, compute_dtype=None,
+                            platform=None):
+    """(effective_request, concrete_route) — mirrors
+    :func:`kmeans_tpu.ops.hamerly.resolve_hamerly_backend` so
+    ``fit_plan`` and the bench cannot drift from the pass dispatch."""
+    eff = "auto" if backend == "pallas" else backend
+    if eff == "pallas_interpret":
+        return eff, "pallas_interpret"
+    ok = yinyang_pallas_ok(x, k, groups=groups, weights=weights,
+                           weights_are_binary=weights_are_binary,
+                           compute_dtype=compute_dtype, platform=platform)
+    return eff, ("pallas" if (eff in ("auto", "pallas") and ok) else "xla")
+
+
+def _group_drift(big_d, delta_c, group_of, t: int):
+    """Per-group ``(min_g Δ, max_g δ)`` — the two drift reductions.
+    Empty groups get (+huge, 0): their glb column drifts to +huge and
+    never fails the filter, which is vacuously sound (no centroid lives
+    there to be missed)."""
+    gmin_D = jax.ops.segment_min(big_d, group_of, num_segments=t)
+    gmax_dc = jnp.maximum(
+        jax.ops.segment_max(delta_c, group_of, num_segments=t), 0.0)
+    return gmin_D, gmax_dc
+
+
+def _scores_grouped_chunked(x, fail, centroids, csq, group_of, *,
+                            chunk_size, compute_dtype):
+    """(labels, m1, glb_new (n, t)) with passing-group columns masked to
+    +inf before the argmin — the XLA route's scoring pass.  ``glb_new``
+    is the per-group competitor min (label column excluded) over the
+    UNMASKED scores; callers keep it only where ``fail`` holds, so the
+    masked columns' values never leak into carried state."""
+    n, d = x.shape
+    k = centroids.shape[0]
+    t = fail.shape[1]
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    ct = centroids.astype(cd).T
+    pad = (-n) % chunk_size
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
+        fail = jnp.concatenate(
+            [fail, jnp.ones((pad, t), jnp.bool_)])
+
+    def body(_, tile):
+        xb, fb = tile
+        prod = jnp.matmul(xb.astype(cd), ct, preferred_element_type=f32,
+                          precision=matmul_precision(cd))
+        part = csq[None, :] - 2.0 * prod
+        part_m = jnp.where(jnp.take(fb, group_of, axis=1), part, jnp.inf)
+        m1 = jnp.min(part_m, axis=1)
+        cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+        labels = jnp.min(
+            jnp.where(part_m <= m1[:, None], cols, k), axis=1
+        ).astype(jnp.int32)
+        part_ex = jnp.where(cols == labels[:, None], jnp.inf, part)
+        glb_new = jax.ops.segment_min(part_ex.T, group_of,
+                                      num_segments=t).T
+        return None, (labels, m1, glb_new)
+
+    _, (lab, m1, glb) = lax.scan(
+        body, None, (x.reshape(-1, chunk_size, d),
+                     fail.reshape(-1, chunk_size, t)))
+    return (lab.reshape(-1)[:n], m1.reshape(-1)[:n],
+            glb.reshape(-1, t)[:n])
+
+
+def _group_mins_chunked(x, labels, centroids, csq, group_of, t: int, *,
+                        chunk_size, compute_dtype):
+    """(n, t) per-group competitor mins for KNOWN labels — the Pallas
+    route's glb refresh (the kernel already produced the labels; this
+    rescore computes the SAME ``part`` matrix the XLA route's scorer
+    does — same chunking, same precision — so the refreshed bounds are
+    bitwise backend-independent)."""
+    n, d = x.shape
+    k = centroids.shape[0]
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    ct = centroids.astype(cd).T
+    pad = (-n) % chunk_size
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
+        labels = jnp.concatenate(
+            [labels, jnp.zeros((pad,), jnp.int32)])
+
+    def body(_, tile):
+        xb, lb = tile
+        prod = jnp.matmul(xb.astype(cd), ct, preferred_element_type=f32,
+                          precision=matmul_precision(cd))
+        part = csq[None, :] - 2.0 * prod
+        cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+        part_ex = jnp.where(cols == lb[:, None], jnp.inf, part)
+        glb_new = jax.ops.segment_min(part_ex.T, group_of,
+                                      num_segments=t).T
+        return None, glb_new
+
+    _, glb = lax.scan(body, None, (x.reshape(-1, chunk_size, d),
+                                   labels.reshape(-1, chunk_size)))
+    return glb.reshape(-1, t)[:n]
+
+
+def _glb_refresh(x, centroids, csq, labels_new, need, fail, glb2,
+                 group_of, *, cap, chunk_size, compute_dtype):
+    """Failing-group glb refresh for the Pallas route: the kernel hands
+    back labels/sb/fold; this recomputes the recomputed rows' group mins
+    on the XLA side (documented double-scoring — open kernel work) with
+    the same incremental/full cap routing as the XLA route."""
+    n = x.shape[0]
+    t = glb2.shape[1]
+    n_rec = jnp.sum(need).astype(jnp.int32)
+
+    def incremental(_):
+        idx = jnp.nonzero(need, size=cap, fill_value=n)[0]
+        valid = idx < n
+        safe = jnp.where(valid, idx, 0)
+        glb_new = _group_mins_chunked(
+            x[safe], jnp.where(valid, labels_new[safe], 0), centroids,
+            csq, group_of, t, chunk_size=min(chunk_size, cap),
+            compute_dtype=compute_dtype)
+        upd = jnp.where(fail[safe], glb_new, glb2[safe])
+        return glb2.at[idx].set(upd, mode="drop")
+
+    def full(_):
+        glb_new = _group_mins_chunked(
+            x, labels_new, centroids, csq, group_of, t,
+            chunk_size=chunk_size, compute_dtype=compute_dtype)
+        return jnp.where(need[:, None] & fail, glb_new, glb2)
+
+    return lax.cond(n_rec <= cap, incremental, full, None)
+
+
+@observed("ops.yinyang_pass")
+@functools.partial(
+    jax.jit,
+    static_argnames=("cap", "chunk_size", "compute_dtype", "backend",
+                     "weights_are_binary"),
+)
+# analyze: disable=DON301 -- public eager entry, same contract as ops.hamerly.hamerly_pass: callers may reuse the carried state after the call; the jitted fit loops carry it internally
+def yinyang_pass(
+    x: jax.Array,
+    centroids: jax.Array,
+    labels_prev: jax.Array,
+    sums_prev: jax.Array,
+    counts_prev: jax.Array,
+    sb: jax.Array,
+    glb: jax.Array,
+    c_prev_cd: jax.Array,
+    csq_prev: jax.Array,
+    rno: jax.Array,
+    group_of: jax.Array,
+    *,
+    weights: Optional[jax.Array] = None,
+    cap: int,
+    chunk_size: int = 4096,
+    compute_dtype=None,
+    backend: str = "xla",
+    weights_are_binary: bool = False,
+) -> Tuple[jax.Array, ...]:
+    """One yinyang-pruned Lloyd sweep.
+
+    Args mirror :func:`kmeans_tpu.ops.hamerly.hamerly_pass` with the
+    single global ``slb`` replaced by ``glb (n, t)`` per-group
+    competitor bounds and the extra ``group_of (k,) int32`` centroid →
+    group map (:func:`centroid_groups`; the group count ``t`` is
+    ``glb.shape[1]``).  The sentinel refresh contract is identical:
+    ``labels_prev = -1`` with zero ``sums_prev`` forces every row and
+    the signed fold IS the full reduction.
+
+    Returns ``(labels, sums, counts, sb', glb', c_cd, csq,
+    n_recomputed, n_group_pruned)`` — the last an exact count of
+    (recomputed row, passing group) pairs whose distances the local
+    filter proved unnecessary (the observability gauge's numerator;
+    backend-independent like ``n_recomputed``).
+    """
+    n, d = x.shape
+    k = centroids.shape[0]
+    t = glb.shape[1]
+    f32 = jnp.float32
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+
+    c_cd = centroids.astype(cd)
+    c_cd_f32 = c_cd.astype(f32)
+    csq = sq_norms(centroids)
+    cprev_f32 = c_prev_cd.astype(f32)
+    # Inflated: δ must OVER-estimate ||Δc|| (f32 norm rounding slack) —
+    # same derivation as hamerly, reduced per group instead of globally.
+    delta_c = jnp.sqrt(jnp.maximum(
+        jnp.sum((c_cd_f32 - cprev_f32) ** 2, axis=1),
+        0.0)) * _NORM_INFLATE                                     # (k,)
+    big_d = csq - csq_prev                                        # (k,)
+    cmax = jnp.sqrt(jnp.maximum(jnp.max(csq), 0.0))
+    gmin_D, gmax_dc = _group_drift(big_d, delta_c, group_of, t)
+
+    sentinel = labels_prev < 0
+    lab_safe = jnp.clip(labels_prev, 0, k - 1)
+    sb2 = sb + big_d[lab_safe] + 2.0 * rno * delta_c[lab_safe]
+    glb2 = glb + gmin_D[None, :] - 2.0 * rno[:, None] * gmax_dc[None, :]
+    margin = HAMERLY_MARGIN_REL * (rno * cmax + 1.0)
+    w_all = jnp.ones((n,), f32) if weights is None else weights.astype(f32)
+
+    # Two-level filter.  GROUP: a row whose sb' clears every group's
+    # bound keeps its argmin.  LOCAL: among recomputed rows, a passing
+    # group contributes no distances; the assigned centroid's own group
+    # is ALWAYS failing (the argmin must be allowed to stay put — and
+    # with t=1 this forces fail == need, i.e. exactly hamerly).
+    fail = (sb2[:, None] + margin[:, None] >= glb2) | sentinel[:, None]
+    need = jnp.any(fail, axis=1)
+    own = group_of[lab_safe]                                      # (n,)
+    fail = fail | (jnp.arange(t, dtype=jnp.int32)[None, :]
+                   == own[:, None])
+    n_group_pruned = jnp.sum(need[:, None] & ~fail).astype(jnp.int32)
+
+    use_pallas = False
+    plan = None
+    if backend != "xla":
+        plan = yinyang_kernel_plan(
+            x, k, groups=t, weights=weights,
+            weights_are_binary=weights_are_binary,
+            compute_dtype=compute_dtype,
+        )
+        if backend == "pallas" and plan.mode == "refuse":
+            raise ValueError(
+                "pallas yinyang pass unsupported here (needs TPU-shaped "
+                "VMEM at block_rows=1024, lane-alignable d, and binary "
+                f"weights unless f32): {plan.why}; use backend='auto' to "
+                "fall back"
+            )
+        use_pallas = plan.mode != "refuse" or backend == "pallas_interpret"
+
+    if use_pallas:
+        # The hamerly kernel with the yinyang need mask: identical
+        # labels/sb (the masked argmin provably equals the full one —
+        # module docstring), fold from the same compacted tile.  The
+        # kernel's slb output is hamerly's global second-min; yinyang
+        # discards it and refreshes glb on the XLA side instead.
+        (labels, sb3, _slb3, dsums, dcounts, n_rec, _dense) = \
+            lloyd_hamerly_pallas(
+                x, centroids, labels_prev, need, sb2,
+                jnp.min(glb2, axis=1),
+                weights=weights, compute_dtype=compute_dtype,
+                interpret=(backend == "pallas_interpret"),
+                k_tile=plan.k_tile,
+            )
+        glb3 = _glb_refresh(
+            x, centroids, csq, labels, need, fail, glb2, group_of,
+            cap=cap, chunk_size=chunk_size, compute_dtype=compute_dtype)
+        sums = sums_prev + dsums
+        counts = counts_prev + dcounts
+        return (labels, sums, counts, sb3, glb3, c_cd, csq, n_rec,
+                n_group_pruned)
+
+    # ---- XLA route: gather the needed rows, score them with passing
+    # groups masked to +inf, scatter back.
+    n_rec = jnp.sum(need).astype(jnp.int32)
+    pred = n_rec <= cap
+
+    def incremental(_):
+        idx = jnp.nonzero(need, size=cap, fill_value=n)[0]
+        valid = idx < n
+        safe = jnp.where(valid, idx, 0)
+        rows = x[safe]
+        fail_r = fail[safe]
+        lab_r, m1_r, glb_r = _scores_grouped_chunked(
+            rows, fail_r, centroids, csq, group_of,
+            chunk_size=min(chunk_size, cap), compute_dtype=compute_dtype)
+        lab_old_r = jnp.where(valid, labels_prev[safe], 0)
+        w_r = jnp.where(valid, w_all[safe], 0.0)
+        # Signed fold over CHANGED recomputed rows only (pre-zeroing the
+        # weight keeps unchanged rows' +w/-w from inexact cancellation).
+        ch = (lab_r != lab_old_r) & valid
+        wg = jnp.where(ch, w_r, 0.0)
+        lab_new_f = jnp.where(ch, lab_r, -1)
+        lab_old_f = jnp.where(ch & (lab_old_r >= 0), lab_old_r, -1)
+        from kmeans_tpu.ops.delta import _accumulate_xla
+
+        ds, dc = _accumulate_xla(
+            rows, lab_new_f, wg, lab_old_f, -wg, k,
+            chunk_size=min(chunk_size, cap), compute_dtype=compute_dtype)
+        # Scatter with the UNCLAMPED indices + mode="drop": a clamped
+        # fill slot would collide with a legitimate write at row 0.
+        labels = labels_prev.at[idx].set(lab_r, mode="drop")
+        sb_o = sb2.at[idx].set(m1_r, mode="drop")
+        glb_o = glb2.at[idx].set(
+            jnp.where(fail_r, glb_r, glb2[safe]), mode="drop")
+        return labels, sums_prev + ds, counts_prev + dc, sb_o, glb_o
+
+    def full(_):
+        lab_f, m1_f, glb_f = _scores_grouped_chunked(
+            x, fail, centroids, csq, group_of, chunk_size=chunk_size,
+            compute_dtype=compute_dtype)
+        labels = jnp.where(need, lab_f, labels_prev)
+        sb_o = jnp.where(need, m1_f, sb2)
+        glb_o = jnp.where(need[:, None] & fail, glb_f, glb2)
+        ch = (labels != labels_prev) & (w_all > 0.0)
+        wg = jnp.where(ch, w_all, 0.0)
+        from kmeans_tpu.ops.delta import _accumulate_xla
+
+        ds, dc = _accumulate_xla(
+            x, jnp.where(ch, labels, -1), wg,
+            jnp.where(ch & (labels_prev >= 0), labels_prev, -1), -wg, k,
+            chunk_size=chunk_size, compute_dtype=compute_dtype)
+        return labels, sums_prev + ds, counts_prev + dc, sb_o, glb_o
+
+    labels, sums, counts, sb3, glb3 = lax.cond(pred, incremental, full,
+                                               None)
+    return (labels, sums, counts, sb3, glb3, c_cd, csq, n_rec,
+            n_group_pruned)

@@ -1008,8 +1008,8 @@ def fit_lloyd_sharded(
     fingerprint.  The bundle is deliberately NOT per-device shards:
     ``resume=True`` (or ``resume=<dir>``) restores it onto whatever mesh
     THIS call was given — a different shape, device count, or comm mode —
-    because the delta/hamerly carried state is re-derived by the forced
-    refresh at each segment start.  Resume ignores ``init`` (the
+    because the delta/hamerly/yinyang carried state is re-derived by the
+    forced refresh at each segment start.  Resume ignores ``init`` (the
     checkpoint's centroids win) and refuses a checkpoint whose fingerprint
     (k/d/update/tol/dtype/seed) contradicts this call's config.
     """
@@ -1138,10 +1138,10 @@ def fit_lloyd_sharded(
         cfg.update, w_exact=w_exact,
         sharded_axes=bool(model_axis or feature_axis),
     )
-    if update == "hamerly" and (cfg.empty != "keep"
-                                or center_update != "mean"):
+    if update in ("hamerly", "yinyang") and (cfg.empty != "keep"
+                                             or center_update != "mean"):
         raise ValueError(
-            "update='hamerly' prunes rows from the distance pass (no "
+            f"update={update!r} prunes rows from the distance pass (no "
             "per-sweep min_d2 for farthest-reseed, mean updates only); "
             "use empty='keep' with the default center update, or "
             "update='auto'/'delta'"
@@ -1203,6 +1203,25 @@ def fit_lloyd_sharded(
             mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, max_it,
             backend, comm,
         )
+    elif update == "yinyang":
+        # DP group-bound pruned loop: hamerly's shard story with the
+        # (n, t) per-group lower bounds carried per shard.  The
+        # centroid→group map is formed ONCE on the host from the initial
+        # centroids (yinyang raises for model/feature sharding, so c0 is
+        # unpadded and replicated here).
+        from kmeans_tpu.ops.yinyang import centroid_groups
+
+        g_np, t_groups = centroid_groups(
+            np.asarray(jax.device_get(c0), np.float32),
+            cfg.yinyang_groups, seed=cfg.seed)
+        group_of = jnp.asarray(g_np)
+        yy_run = _build_lloyd_yinyang_run(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, max_it,
+            backend, t_groups, comm,
+        )
+
+        def run(x, w, c0, tol_v):
+            return yy_run(x, w, c0, group_of, tol_v)
     else:
         run = _build_lloyd_run(
             mesh, data_axis, model_axis, k, cfg.chunk_size,
@@ -1304,9 +1323,9 @@ def _fit_lloyd_elastic(x, w, c0, tol_v, *, k, d_real, n, mesh, cfg, key,
     SIGTERM/SIGINT lets the in-flight segment drain, cuts one final
     checkpoint, and raises :class:`Preempted` with a copy-pasteable
     resume hint.  The classic update's trajectory is identical to the
-    fused program's (same per-sweep shift test); delta/hamerly re-derive
-    their carried state at each segment start, so their trajectory equals
-    an uninterrupted ELASTIC run with the same cadence — the parity
+    fused program's (same per-sweep shift test); delta/hamerly/yinyang
+    re-derive their carried state at each segment start, so their
+    trajectory equals an uninterrupted ELASTIC run with the same cadence — the parity
     contract the kill/resume drills assert.
     """
     from kmeans_tpu.parallel.distributed import heartbeat
@@ -1327,6 +1346,28 @@ def _fit_lloyd_elastic(x, w, c0, tol_v, *, k, d_real, n, mesh, cfg, key,
         seg = _build_lloyd_hamerly_seg(
             mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, backend,
             comm)
+        fin = _build_dense_final(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, backend,
+            "mean")
+    elif update == "yinyang":
+        # Groups form from the centroids this elastic run STARTS from —
+        # on a resume that is the checkpointed centroids, so the group
+        # count (and map) may differ from the pre-preemption run's; the
+        # segment boundary re-derives all carried bounds from scratch
+        # either way, so exactness is unaffected.
+        from kmeans_tpu.ops.yinyang import centroid_groups
+
+        g_np, t_groups = centroid_groups(
+            np.asarray(jax.device_get(c0), np.float32),
+            cfg.yinyang_groups, seed=cfg.seed)
+        group_of = jnp.asarray(g_np)
+        yy_seg = _build_lloyd_yinyang_seg(
+            mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, backend,
+            t_groups, comm)
+
+        def seg(x, w, c0, it0, it_stop, tol_v):
+            return yy_seg(x, w, c0, group_of, it0, it_stop, tol_v)
+
         fin = _build_dense_final(
             mesh, data_axis, cfg.chunk_size, cfg.compute_dtype, backend,
             "mean")
@@ -1692,8 +1733,8 @@ def _dense_final_sm(mesh, data_axis, chunk_size, compute_dtype, backend,
 @functools.lru_cache(maxsize=32)
 def _build_dense_final(mesh, data_axis, chunk_size, compute_dtype, backend,
                        center_update="mean"):
-    """Jitted standalone dense labeling pass for the elastic delta and
-    hamerly loops (their segments carry no labels across the boundary,
+    """Jitted standalone dense labeling pass for the elastic delta,
+    hamerly and yinyang loops (their segments carry no labels across the boundary,
     so the final pass is a separate one-compile program)."""
     final = _dense_final_sm(mesh, data_axis, chunk_size, compute_dtype,
                             backend, center_update)
@@ -2032,6 +2073,211 @@ def _build_lloyd_hamerly_seg(mesh, data_axis, chunk_size, compute_dtype,
     seg = jax.jit(seg, donate_argnums=(2,) if comm == "scatter" else ())
     name = ("engine.lloyd_hamerly_seg_scatter_run" if comm == "scatter"
             else "engine.lloyd_hamerly_seg_run")
+    return costmodel.observe(seg, name=name)
+
+
+def _dp_yinyang_local_pass(x_loc, c, w_loc, lab_prev, sums_loc, counts_loc,
+                           sb, glb, c_cd, csq_prev, rno_loc, group_of, *,
+                           data_axis, chunk_size, compute_dtype, backend,
+                           comm="allreduce"):
+    """DP shard body for the Yinyang group-bound update: each shard runs
+    :func:`kmeans_tpu.ops.yinyang.yinyang_pass` on its rows, carrying ITS
+    OWN (labels, sums, counts, sb, glb) — like the hamerly body
+    (:func:`_dp_hamerly_local_pass`) with the single lower bound widened
+    to the (rows, t) per-group matrix, which is still pure row state and
+    shards over ``data_axis`` for free.  The per-group drift reductions
+    (segment_min of Δ, segment_max of δ over each group) run inside
+    ``yinyang_pass`` on the REPLICATED (c, c_cd, csq, group_of) inputs,
+    so every shard derives identical group drifts with no collective; the
+    only communication per sweep stays the one (sums, counts) merge."""
+    from kmeans_tpu.ops.delta import default_cap
+    from kmeans_tpu.ops.yinyang import yinyang_pass
+
+    n_loc = x_loc.shape[0]
+    (labels, sums_new, counts_new, sb2, glb2, c_cd2, csq2, _, _) = \
+        yinyang_pass(
+            x_loc, c, lab_prev, sums_loc, counts_loc, sb, glb, c_cd,
+            csq_prev, rno_loc, group_of, weights=w_loc,
+            cap=default_cap(n_loc), chunk_size=chunk_size,
+            compute_dtype=compute_dtype,
+            backend="auto" if backend == "pallas" else backend,
+            weights_are_binary=True,
+        )
+    if comm == "scatter":
+        # Yinyang always runs empty="keep" (validated at fit entry), so
+        # the slice update is the bare divide; the bound bookkeeping
+        # (c_cd2, csq2) is recomputed from the replicated INPUT centroids
+        # inside yinyang_pass and is untouched by the merge route.
+        new_c, _, shift_sq = _scatter_merge_update(
+            c, sums_new, counts_new, x_loc, sb, data_axis=data_axis,
+            empty="keep", center_update="mean",
+        )
+        return (new_c, labels, sums_new, counts_new, sb2, glb2, c_cd2,
+                csq2, shift_sq)
+    g_sums, g_counts = _fused_psum_merge(data_axis, sums_new, counts_new)
+    new_c = apply_update(c, g_sums, g_counts)
+    return (new_c, labels, sums_new, counts_new, sb2, glb2, c_cd2, csq2)
+
+
+def _yinyang_step_parts(mesh, data_axis, chunk_size, compute_dtype,
+                        backend, comm):
+    """The yinyang sweep step + row-norms pass as shard_maps, shared by
+    the fused and segmented yinyang programs.  Returns
+    ``(step, rno_sm, dp, cd)``."""
+    from kmeans_tpu.ops.hamerly import row_norms
+
+    local = functools.partial(
+        _dp_yinyang_local_pass, data_axis=data_axis, chunk_size=chunk_size,
+        compute_dtype=compute_dtype, backend=backend, comm=comm,
+    )
+    step_out = (P(), P(data_axis), P(data_axis), P(data_axis),
+                P(data_axis), P(data_axis), P(), P())
+    if comm == "scatter":
+        step_out = step_out + (P(),)                       # shift_sq
+    step = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(data_axis), P(), P(data_axis), P(data_axis),
+                  P(data_axis), P(data_axis), P(data_axis), P(data_axis),
+                  P(), P(), P(data_axis), P()),
+        out_specs=step_out,
+        check_vma=False,
+    )
+    rno_sm = jax.shard_map(
+        functools.partial(row_norms, compute_dtype=compute_dtype,
+                          chunk_size=chunk_size),
+        mesh=mesh, in_specs=(P(data_axis),), out_specs=P(data_axis),
+        check_vma=False,
+    )
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+    cd = (jnp.dtype(compute_dtype) if compute_dtype is not None
+          else None)
+    return step, rno_sm, dp, cd
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_yinyang_run(mesh, data_axis, chunk_size, compute_dtype,
+                             max_it, backend, t, comm="allreduce"):
+    """Jitted whole-fit program for the DP ``update="yinyang"`` path:
+    :func:`_build_lloyd_hamerly_run` with the carried lower bound widened
+    to the (n, t) per-group matrix and the replicated centroid→group map
+    as an extra run argument (``t`` is static: it fixes the glb carry
+    shape).  Same sentinel refresh cadence, same one-merge-per-sweep."""
+    from kmeans_tpu.ops.delta import DELTA_REFRESH
+
+    step, rno_sm, dp, cd = _yinyang_step_parts(
+        mesh, data_axis, chunk_size, compute_dtype, backend, comm)
+    final = _dense_final_sm(mesh, data_axis, chunk_size, compute_dtype,
+                            backend, "mean")
+
+    def run(x, w, c0, group_of, tol_v):
+        n = x.shape[0]
+        k, d = c0.shape
+        f32 = jnp.float32
+        rno = rno_sm(x)
+        c_cd0 = c0.astype(cd if cd is not None else x.dtype)
+
+        def cond(s):
+            return (s[1] < max_it) & ~s[3]
+
+        def body(s):
+            (c, it, _, _, lab, sums, counts, sb, glb, c_cd, csq) = s
+            refresh = (it % DELTA_REFRESH) == 0
+            lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
+            sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
+            counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
+            if comm == "scatter":
+                (new_c, lab, sums, counts, sb, glb, c_cd, csq,
+                 shift_sq) = step(
+                    x, c, w, lab_e, sums_e, counts_e, sb, glb, c_cd, csq,
+                    rno, group_of)
+            else:
+                (new_c, lab, sums, counts, sb, glb, c_cd, csq) = step(
+                    x, c, w, lab_e, sums_e, counts_e, sb, glb, c_cd, csq,
+                    rno, group_of)
+                shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
+                    counts, sb, glb, c_cd, csq)
+
+        init = (
+            c0, jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((dp * k, d), f32),       # per-shard sums, stacked
+            jnp.zeros((dp * k,), f32),
+            jnp.zeros((n,), f32),              # sb
+            jnp.zeros((n, t), f32),            # glb
+            c_cd0,
+            jnp.zeros((k,), f32),
+        )
+        c, n_iter, _, converged = lax.while_loop(cond, body, init)[:4]
+        _, inertia, counts, labels = final(x, c, w)
+        return c, labels, inertia, n_iter, converged, counts
+
+    run = jax.jit(run, donate_argnums=(2,) if comm == "scatter" else ())
+    name = ("engine.lloyd_yinyang_scatter_run" if comm == "scatter"
+            else "engine.lloyd_yinyang_run")
+    return costmodel.observe(run, name=name)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_yinyang_seg(mesh, data_axis, chunk_size, compute_dtype,
+                             backend, t, comm="allreduce"):
+    """Sweep-segment program for the yinyang update: like
+    :func:`_build_lloyd_hamerly_seg`, the segment starts from the
+    sentinel (labels -1, zeroed sums/counts/sb/glb) so its first sweep is
+    a full refresh that re-derives every carried quantity — including the
+    per-group bounds — from the replicated centroids alone; a resume may
+    therefore change mesh shape, comm mode, AND group count freely."""
+    from kmeans_tpu.ops.delta import DELTA_REFRESH
+
+    step, rno_sm, dp, cd = _yinyang_step_parts(
+        mesh, data_axis, chunk_size, compute_dtype, backend, comm)
+
+    def seg(x, w, c0, group_of, it0, it_stop, tol_v):
+        n = x.shape[0]
+        k, d = c0.shape
+        f32 = jnp.float32
+        rno = rno_sm(x)
+        c_cd0 = c0.astype(cd if cd is not None else x.dtype)
+
+        def cond(s):
+            return (s[1] < it_stop) & ~s[3]
+
+        def body(s):
+            (c, it, _, _, lab, sums, counts, sb, glb, c_cd, csq) = s
+            refresh = ((it - it0) % DELTA_REFRESH) == 0
+            lab_e = jnp.where(refresh, jnp.full_like(lab, -1), lab)
+            sums_e = jnp.where(refresh, jnp.zeros_like(sums), sums)
+            counts_e = jnp.where(refresh, jnp.zeros_like(counts), counts)
+            if comm == "scatter":
+                (new_c, lab, sums, counts, sb, glb, c_cd, csq,
+                 shift_sq) = step(
+                    x, c, w, lab_e, sums_e, counts_e, sb, glb, c_cd, csq,
+                    rno, group_of)
+            else:
+                (new_c, lab, sums, counts, sb, glb, c_cd, csq) = step(
+                    x, c, w, lab_e, sums_e, counts_e, sb, glb, c_cd, csq,
+                    rno, group_of)
+                shift_sq = jnp.sum((new_c - c) ** 2)
+            return (new_c, it + 1, shift_sq, shift_sq <= tol_v, lab, sums,
+                    counts, sb, glb, c_cd, csq)
+
+        init = (
+            c0, it0,
+            jnp.asarray(jnp.inf, f32), jnp.zeros((), bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((dp * k, d), f32),
+            jnp.zeros((dp * k,), f32),
+            jnp.zeros((n,), f32),              # sb
+            jnp.zeros((n, t), f32),            # glb
+            c_cd0,
+            jnp.zeros((k,), f32),
+        )
+        return lax.while_loop(cond, body, init)[:4]
+
+    seg = jax.jit(seg, donate_argnums=(2,) if comm == "scatter" else ())
+    name = ("engine.lloyd_yinyang_seg_scatter_run" if comm == "scatter"
+            else "engine.lloyd_yinyang_seg_run")
     return costmodel.observe(seg, name=name)
 
 

@@ -217,8 +217,15 @@ def test_unsupported_combinations_raise(rng, cpu_devices):
                           config=KMeansConfig(k=5, update="hamerly"))
     from kmeans_tpu.models.runner import LloydRunner
 
-    with pytest.raises(ValueError, match="hamerly"):
+    # The runner steps hamerly natively now; what does NOT compose is
+    # farthest-reseeding (pruned sweeps never compute the per-row
+    # min-distances it reseeds from) and between-sweep extrapolation.
+    with pytest.raises(ValueError, match="farthest"):
         LloydRunner(np.asarray(x), 5,
+                    config=KMeansConfig(k=5, update="hamerly",
+                                        empty="farthest"))
+    with pytest.raises(ValueError, match="accel"):
+        LloydRunner(np.asarray(x), 5, accel="anderson",
                     config=KMeansConfig(k=5, update="hamerly"))
 
 
@@ -234,7 +241,19 @@ def test_cli_hamerly_guards(capsys):
                "--update", "hamerly", "--mesh", "2"])
     assert rc == 0, capsys.readouterr().err
     capsys.readouterr()
+    # Runner flags are supported single-device (the bound-carrying
+    # step program), but not on a mesh, and not under --accel.
     rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
                "--update", "hamerly", "--progress"])
+    assert rc == 0, capsys.readouterr().err
+    capsys.readouterr()
+    rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
+               "--update", "hamerly", "--progress", "--mesh", "2"])
     assert rc == 2
-    assert "runner" in capsys.readouterr().err
+    assert "single-device" in capsys.readouterr().err
+    rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
+               "--update", "hamerly", "--accel", "anderson"])
+    assert rc == 2
+    # --accel selects the accelerated model, so the model-family guard
+    # fires before the accel-composition one — either way it refuses.
+    assert "lloyd family" in capsys.readouterr().err

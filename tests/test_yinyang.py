@@ -1,0 +1,321 @@
+"""The yinyang group-drift pruned exact sweep (kmeans_tpu.ops.yinyang).
+
+Same exactness contract as hamerly (tests/test_hamerly.py) with the
+family's own claims layered on: per-group bounds must (a) stay label-
+bit-exact against the dense path, (b) degenerate to hamerly bit-for-bit
+at t=1, (c) actually engage the local group filter on clustered data,
+and (d) drive the ``update="auto"`` runtime switch both directions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import KMeansConfig
+from kmeans_tpu.models.lloyd import fit_lloyd, fit_plan
+from kmeans_tpu.ops.delta import DELTA_REFRESH
+from kmeans_tpu.ops.hamerly import hamerly_pass
+from kmeans_tpu.ops.lloyd import lloyd_pass
+from kmeans_tpu.ops.update import apply_update
+from kmeans_tpu.ops.yinyang import (centroid_groups, default_groups,
+                                    row_norms, yinyang_pass)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(17)
+
+
+def _blobs(rng, n, d, k, sep=3.0):
+    centers = rng.normal(size=(k, d)).astype(np.float32) * sep
+    lab = rng.integers(0, k, n)
+    return (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+
+
+def _run_traj(x, c0, k, iters, backend, *, weights=None, cap=None,
+              groups=None, chunk=512, refresh=DELTA_REFRESH):
+    """(labels_per_sweep, centroids, recompute_counts, group_pruned,
+    (sb, glb)) of the yinyang loop, sweeping by hand so every
+    intermediate is assertable."""
+    n, d = x.shape
+    rno = row_norms(x, chunk_size=chunk)
+    group_np, t = centroid_groups(np.asarray(c0, np.float32),
+                                  n_groups=groups)
+    group_of = jnp.asarray(group_np)
+    c = c0
+    lab = jnp.full((n,), -1, jnp.int32)
+    sums = jnp.zeros((k, d), jnp.float32)
+    counts = jnp.zeros((k,), jnp.float32)
+    sb = jnp.zeros((n,), jnp.float32)
+    glb = jnp.zeros((n, t), jnp.float32)
+    c_cd = c0
+    csq = jnp.zeros((k,), jnp.float32)
+    labs, recs, gps = [], [], []
+    for i in range(iters):
+        if i % refresh == 0:
+            lab = jnp.full((n,), -1, jnp.int32)
+            sums = jnp.zeros((k, d), jnp.float32)
+            counts = jnp.zeros((k,), jnp.float32)
+        (lab, sums, counts, sb, glb, c_cd, csq, nrec,
+         ngp) = yinyang_pass(
+            x, c, lab, sums, counts, sb, glb, c_cd, csq, rno, group_of,
+            weights=weights, cap=cap if cap is not None else n,
+            chunk_size=chunk, backend=backend)
+        labs.append(np.asarray(lab))
+        recs.append(int(nrec))
+        gps.append(int(ngp))
+        c = apply_update(c, sums, counts)
+    return labs, np.asarray(c), recs, gps, (sb, glb)
+
+
+def _dense_traj(x, c0, k, iters, *, weights=None, chunk=512):
+    c = c0
+    labs = []
+    for _ in range(iters):
+        lab, _, sums, counts, _ = lloyd_pass(x, c, weights=weights,
+                                             chunk_size=chunk)
+        c = apply_update(c, sums, counts)
+        labs.append(np.asarray(lab))
+    return labs, np.asarray(c)
+
+
+def test_centroid_groups_partition(rng):
+    c = rng.normal(size=(23, 8)).astype(np.float32)
+    g, t = centroid_groups(c)                   # default t = ceil(k/10)
+    assert t == default_groups(23) == 3
+    assert g.shape == (23,) and g.dtype == np.int32
+    assert set(np.unique(g)) <= set(range(t))
+    # Deterministic given (centroids, seed).
+    g2, _ = centroid_groups(c)
+    np.testing.assert_array_equal(g, g2)
+    # Degenerate ends: t >= k is the identity map, t = 1 all-zeros.
+    gi, ti = centroid_groups(c, 40)
+    assert ti == 23
+    np.testing.assert_array_equal(gi, np.arange(23, dtype=np.int32))
+    g1, t1 = centroid_groups(c, 1)
+    assert t1 == 1 and not g1.any()
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas_interpret"])
+def test_matches_dense_trajectory_and_group_prunes(rng, backend):
+    n, d, k = 2400, 128, 24                     # t = 3; d lane-aligned
+    x = jnp.asarray(_blobs(rng, n, d, k))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    want, c_want = _dense_traj(x, c0, k, 8)
+    got, c_got, recs, gps, _ = _run_traj(x, c0, k, 8, backend)
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert (a == b).all(), f"diverged at sweep {i}"
+    np.testing.assert_allclose(c_got, c_want, atol=1e-4)
+    # Both filter levels must engage on blob data: rows skipped, and
+    # (row, group) pairs proved unnecessary among the recomputed.
+    assert recs[-1] < n // 4, recs
+    assert sum(gps) > 0, gps
+
+
+def test_t1_degenerates_to_hamerly_bitwise(rng):
+    """group_of = zeros IS hamerly: labels, recompute counts, sb and the
+    single glb column must all match hamerly's carried state exactly."""
+    n, d, k = 1500, 32, 8
+    x = jnp.asarray(_blobs(rng, n, d, k))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    rno = row_norms(x, chunk_size=512)
+    c_y = c_h = c0
+    lab_y = lab_h = jnp.full((n,), -1, jnp.int32)
+    sums_y = sums_h = jnp.zeros((k, d), jnp.float32)
+    cnt_y = cnt_h = jnp.zeros((k,), jnp.float32)
+    sb_y = sb_h = jnp.zeros((n,), jnp.float32)
+    glb = jnp.zeros((n, 1), jnp.float32)
+    slb = jnp.zeros((n,), jnp.float32)
+    ccd_y = ccd_h = c0
+    csq_y = csq_h = jnp.zeros((k,), jnp.float32)
+    group_of = jnp.zeros((k,), jnp.int32)
+    for _ in range(6):
+        (lab_y, sums_y, cnt_y, sb_y, glb, ccd_y, csq_y, rec_y,
+         gp_y) = yinyang_pass(
+            x, c_y, lab_y, sums_y, cnt_y, sb_y, glb, ccd_y, csq_y, rno,
+            group_of, cap=n, chunk_size=512, backend="xla")
+        (lab_h, sums_h, cnt_h, sb_h, slb, ccd_h, csq_h,
+         rec_h) = hamerly_pass(
+            x, c_h, lab_h, sums_h, cnt_h, sb_h, slb, ccd_h, csq_h, rno,
+            cap=n, chunk_size=512, backend="xla")
+        np.testing.assert_array_equal(np.asarray(lab_y),
+                                      np.asarray(lab_h))
+        assert int(rec_y) == int(rec_h)
+        assert int(gp_y) == 0                   # no group to prune away
+        np.testing.assert_array_equal(np.asarray(sb_y), np.asarray(sb_h))
+        np.testing.assert_array_equal(np.asarray(glb)[:, 0],
+                                      np.asarray(slb))
+        c_y = apply_update(c_y, sums_y, cnt_y)
+        c_h = apply_update(c_h, sums_h, cnt_h)
+        np.testing.assert_array_equal(np.asarray(c_y), np.asarray(c_h))
+
+
+def test_adversarial_near_ties_stay_exact(rng):
+    """Uniform noise with k=24: tiny first/second gaps must force
+    recomputes (poor pruning) and NEVER a wrong skip."""
+    n, d, k = 2000, 32, 24
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    want, _ = _dense_traj(x, c0, k, 7)
+    got, _, recs, _, _ = _run_traj(x, c0, k, 7, "xla")
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert (a == b).all(), f"diverged at sweep {i}"
+    assert recs[-1] > n // 2                    # honest cost of exactness
+
+
+def test_weights_cap_and_odd_group_count(rng):
+    """Binary weights + a group count that does not divide k + a cap
+    small enough to force the full-fallback branch — all in one pass
+    over the dense reference."""
+    n, d, k = 1600, 32, 10
+    x = jnp.asarray(_blobs(rng, n, d, k))
+    w = jnp.asarray((rng.random(n) > 0.3).astype(np.float32))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    want, c_want = _dense_traj(x, c0, k, 6, weights=w)
+    got, c_got, _, _, _ = _run_traj(x, c0, k, 6, "xla", weights=w,
+                                    groups=3, cap=8)
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert (a == b).all(), f"diverged at sweep {i}"
+    np.testing.assert_allclose(c_got, c_want, atol=1e-4)
+
+
+# ------------------------------------------------------------ fit-level
+
+def test_fit_lloyd_yinyang_matches_matmul_and_plan(rng):
+    x = jnp.asarray(_blobs(rng, 2500, 64, 12))
+    kw = dict(k=12, tol=1e-10, max_iter=30, backend="xla")
+    s_y, diag = fit_lloyd(x, 12, key=jax.random.key(3), diag=True,
+                          config=KMeansConfig(update="yinyang", **kw))
+    s_m = fit_lloyd(x, 12, key=jax.random.key(3),
+                    config=KMeansConfig(update="matmul", **kw))
+    np.testing.assert_array_equal(np.asarray(s_y.labels),
+                                  np.asarray(s_m.labels))
+    assert int(s_y.n_iter) == int(s_m.n_iter)
+    np.testing.assert_allclose(np.asarray(s_y.centroids),
+                               np.asarray(s_m.centroids), rtol=1e-5,
+                               atol=1e-5)
+    assert diag["final_flavor"] == 1
+    assert 0 < diag["recompute_rows"] < diag["rows_seen"]
+    assert diag["group_pairs_seen"] > 0
+    plan = fit_plan(x, 12, config=KMeansConfig(k=12, update="yinyang"))
+    assert plan["update"] == "yinyang"
+    assert plan["delta_backend"] == "xla"       # CPU test mesh
+
+
+def test_auto_adaptive_switches_both_directions(rng, monkeypatch):
+    """The "auto" policy's runtime layer: clustered data promotes to
+    yinyang at the first refresh judgment (and stays label-exact);
+    an impossible threshold demotes back to delta."""
+    import kmeans_tpu.ops.yinyang as yy
+
+    monkeypatch.setattr(yy, "AUTO_MIN_ROWS", 256)
+    n, d, k = 3000, 32, 12
+    x = jnp.asarray(_blobs(rng, n, d, k))
+    c0 = jnp.asarray(np.asarray(x)[rng.integers(0, n, k)])
+    s_auto, diag = fit_lloyd(x, k, config=KMeansConfig(k=k, update="auto"),
+                             init=c0, tol=-1.0, max_iter=40, diag=True)
+    assert diag["final_flavor"] == 1, diag      # promoted, ended yinyang
+    s_dense = fit_lloyd(x, k, config=KMeansConfig(k=k, update="matmul"),
+                        init=c0, tol=-1.0, max_iter=40)
+    np.testing.assert_array_equal(np.asarray(s_auto.labels),
+                                  np.asarray(s_dense.labels))
+    # Demote: the measured fraction can never beat a 5% bar on uniform
+    # noise, so the first judgment after the probe falls back to delta
+    # (and the 8-period re-probe is beyond max_iter).
+    monkeypatch.setattr(yy, "AUTO_SWITCH_HIGH", 0.05)
+    xu = jnp.asarray(rng.normal(size=(2000, 16)).astype(np.float32))
+    cu = jnp.asarray(np.asarray(xu)[rng.integers(0, 2000, 24)])
+    _, du = fit_lloyd(xu, 24, config=KMeansConfig(k=24, update="auto"),
+                      init=cu, tol=-1.0, max_iter=50, diag=True)
+    assert du["final_flavor"] == 0, du
+
+
+def test_runner_matches_fused_fit(rng):
+    """The bound-carrying runner step program reproduces the fused fit
+    (same init, same sweeps) label-exactly."""
+    from kmeans_tpu.models.runner import LloydRunner
+
+    x = _blobs(rng, 2000, 32, 8)
+    cfg = KMeansConfig(k=8, update="yinyang", tol=1e-10, max_iter=25,
+                       backend="xla")
+    r = LloydRunner(x, 8, key=jax.random.key(7), config=cfg)
+    got = r.run()
+    want = fit_lloyd(jnp.asarray(x), 8, key=jax.random.key(7), config=cfg)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    np.testing.assert_allclose(np.asarray(got.centroids),
+                               np.asarray(want.centroids), rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("comm", ["allreduce", "scatter"])
+def test_sharded_yinyang_matches_single_device(rng, cpu_devices, comm):
+    """The DP yinyang loop — per-shard carried (sb, glb), one merge per
+    sweep, under BOTH comm modes — reproduces the dense single-device
+    fit label-exactly on uneven rows."""
+    from kmeans_tpu.parallel import make_mesh
+    from kmeans_tpu.parallel.engine import fit_lloyd_sharded
+
+    n, d, k = 2107, 32, 6                       # uneven rows: pad path
+    x = _blobs(rng, n, d, k)
+    c0 = jnp.asarray(x[rng.integers(0, n, k)])  # shared explicit init:
+    # the engine's k-means++ and the single-device one are different
+    # sampling programs, so parity is only meaningful from one c0.
+    mesh = make_mesh((8, 1), ("data", "model"), devices=cpu_devices)
+    cfg = KMeansConfig(k=k, update="yinyang", comm=comm, tol=1e-10,
+                       max_iter=20, backend="xla")
+    got = fit_lloyd_sharded(x, k, mesh=mesh, key=jax.random.key(5),
+                            init=c0, config=cfg)
+    want = fit_lloyd(jnp.asarray(x), k, key=jax.random.key(5), init=c0,
+                     config=KMeansConfig(k=k, update="matmul", tol=1e-10,
+                                         max_iter=20, backend="xla"))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(want.labels))
+    assert int(got.n_iter) == int(want.n_iter)
+
+
+def test_unsupported_combinations_raise(rng, cpu_devices):
+    x = jnp.asarray(_blobs(rng, 1000, 32, 5))
+    with pytest.raises(ValueError, match="farthest"):
+        fit_lloyd(x, 5, key=jax.random.key(0),
+                  config=KMeansConfig(k=5, update="yinyang",
+                                      empty="farthest"))
+    with pytest.raises(ValueError, match="farthest"):
+        fit_plan(x, 5, config=KMeansConfig(k=5, update="yinyang",
+                                           empty="farthest"))
+    from kmeans_tpu.parallel import make_mesh
+    from kmeans_tpu.parallel.engine import fit_lloyd_sharded
+
+    mesh2 = make_mesh((4, 2), ("data", "model"), devices=cpu_devices)
+    with pytest.raises(ValueError, match="model_axis"):
+        fit_lloyd_sharded(np.asarray(x), 5, mesh=mesh2,
+                          key=jax.random.key(0), model_axis="model",
+                          config=KMeansConfig(k=5, update="yinyang"))
+    from kmeans_tpu.models.runner import LloydRunner
+
+    with pytest.raises(ValueError, match="farthest"):
+        LloydRunner(np.asarray(x), 5,
+                    config=KMeansConfig(k=5, update="yinyang",
+                                        empty="farthest"))
+    with pytest.raises(ValueError, match="accel"):
+        LloydRunner(np.asarray(x), 5, accel="anderson",
+                    config=KMeansConfig(k=5, update="yinyang"))
+
+
+def test_cli_yinyang_guards(capsys):
+    from kmeans_tpu.cli import main
+
+    rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
+               "--update", "yinyang", "--yinyang-groups", "2",
+               "--max-iter", "8"])
+    assert rc == 0, capsys.readouterr().err
+    capsys.readouterr()
+    rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
+               "--update", "yinyang", "--yinyang-groups", "0"])
+    assert rc == 2
+    assert "yinyang-groups" in capsys.readouterr().err
+    rc = main(["train", "--n", "400", "--d", "8", "--k", "3",
+               "--update", "delta", "--yinyang-groups", "2"])
+    assert rc == 2
+    assert "yinyang" in capsys.readouterr().err

@@ -40,6 +40,7 @@ MODULES = [
     "kmeans_tpu.utils.checkpoint",
     "kmeans_tpu.utils.faults",
     "kmeans_tpu.data.stream",
+    "kmeans_tpu.models.lloyd",
     "kmeans_tpu.models.runner",
     "kmeans_tpu.models.accelerated",
     "kmeans_tpu.models.streaming",

@@ -51,7 +51,14 @@ Tracked series (direction ``up`` = higher is better):
   time of each comm path (allreduce vs reduce-scatter merge) at the
   headline and codebook shapes (``MULTICHIP_r*.json``; rounds that
   predate the timings are null-seeded so the MISSING gate covers the
-  grid without judging history).
+  grid without judging history);
+* ``flavors.<config>.<flavor>_recompute_fraction`` /
+  ``flavors.<config>.yinyang_vs_hamerly`` — the pruned-sweep exact
+  recompute counters (``BENCH_FLAVORS_latest.json``, ``bench.py
+  --flavors``; backend-independent, so CPU runs are authoritative).
+  The full instance × flavor grid is null-seeded: an artifact that
+  drops an instance or a flavor goes MISSING at the next ingest
+  instead of fading out.
 
 Entries carry provenance (source file, round or artifact timestamp,
 ``carried`` for carry-forward values) and ``null``-valued rounds (failed
@@ -307,6 +314,46 @@ def _ingest_multichip(root: str) -> List[Entry]:
     return out
 
 
+#: The (instance, series) grid every flavors artifact must cover —
+#: null-seeded when a cell is absent, so the MISSING gate pins the grid.
+_FLAVORS_SERIES = tuple(
+    f"flavors.{cfg}.{metric}"
+    for cfg in ("headline-family", "clustered")
+    for metric in ("hamerly_recompute_fraction",
+                   "yinyang_recompute_fraction",
+                   "yinyang_vs_hamerly")
+)
+
+
+def _ingest_flavors(root: str) -> List[Entry]:
+    """The sweep-flavor recompute evidence (``BENCH_FLAVORS_latest.json``,
+    written by ``bench.py --flavors``).  The counters are exact and
+    backend-independent, so the fractions are judged like any other
+    series — lower is better, and a pruning regression beyond tolerance
+    fails the ``--check`` gate."""
+    rec = _load_json(os.path.join(root, "BENCH_FLAVORS_latest.json"))
+    if rec is None:
+        return []
+    ts = rec.get("timestamp")
+    by_cfg = {r.get("config"): r for r in rec.get("configs", [])}
+    out: List[Entry] = []
+    for series in _FLAVORS_SERIES:
+        _, cfg, metric = series.split(".", 2)
+        row = by_cfg.get(cfg) or {}
+        if metric == "yinyang_vs_hamerly":
+            value, unit = row.get("yinyang_vs_hamerly_recompute"), "x"
+        else:
+            flavor = metric.split("_", 1)[0]
+            value = (row.get("flavors", {}).get(flavor)
+                     or {}).get("recompute_fraction")
+            unit = "fraction"
+        out.append(Entry(series, value, unit=unit, direction="down",
+                         group="flavors",
+                         source="BENCH_FLAVORS_latest.json",
+                         round=None, ts=ts))
+    return out
+
+
 def _ingest_input(root: str) -> List[Entry]:
     rec = _load_json(os.path.join(root, "BENCH_INPUT_latest.json"))
     if rec is None:
@@ -327,7 +374,7 @@ def collect_entries(root: str) -> List[Entry]:
     out: List[Entry] = []
     for fn in (_ingest_rounds, _ingest_local, _ingest_all, _ingest_serve,
                _ingest_open, _ingest_soak, _ingest_accel, _ingest_input,
-               _ingest_multichip):
+               _ingest_multichip, _ingest_flavors):
         out.extend(fn(root))
     return out
 

@@ -1028,6 +1028,8 @@ def _cmd_continuous(args) -> int:
 def _cmd_serve(args) -> int:
     from kmeans_tpu.serve import serve
 
+    if args.workers > 1:
+        return _serve_fleet(args)
     print(f"serving on http://{args.host}:{args.port}/ (Ctrl-C to stop)",
           file=sys.stderr)
     if args.metrics:
@@ -1053,6 +1055,43 @@ def _cmd_serve(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     return 0
+
+
+def _serve_fleet(args) -> int:
+    """``serve --workers N``: supervise N SO_REUSEPORT worker processes
+    instead of serving in-process (docs/SERVING.md "Fleet")."""
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.serve.fleet import FleetSupervisor
+
+    overrides = {
+        "host": args.host, "port": args.port,
+        "persist_dir": args.persist_dir or None,
+        "metrics": args.metrics,
+        "telemetry_path": args.telemetry,
+        "model_dir": args.model_dir or None,
+        "assign_batching": args.assign_batching,
+        "assign_max_delay_s": (args.assign_max_delay_ms / 1000.0
+                               if args.assign_max_delay_ms is not None
+                               else None),
+        "assign_max_batch_rows": args.assign_max_batch,
+        "assign_max_points": args.assign_max_points,
+    }
+    try:
+        config = ServeConfig(**{k: v for k, v in overrides.items()
+                                if v is not None})
+        sup = FleetSupervisor(config, workers=args.workers)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(f"fleet: {args.workers} workers on "
+          f"http://{args.host}:{args.port}/ (SIGTERM drains, "
+          f"SIGHUP rolling-replaces, Ctrl-C to stop)", file=sys.stderr)
+    try:
+        return sup.run()
+    except KeyboardInterrupt:
+        # Second signal (PreemptionGuard escalation): hard stop.
+        sup.stop(graceful=False)
+        return 1
 
 
 def _cmd_bench(args) -> int:
@@ -1352,6 +1391,13 @@ def main(argv=None) -> int:
                    metavar="N",
                    help="per-request point cap on POST /api/assign "
                         "(default 4096)")
+    s.add_argument("--workers", type=int, default=1, metavar="N",
+                   help="run N supervised SO_REUSEPORT worker processes "
+                        "instead of serving in-process (crashed workers "
+                        "respawn with backoff; model-dir publishes are "
+                        "pushed to every worker; SIGTERM drains with "
+                        "zero in-flight drops, SIGHUP rolling-replaces "
+                        "— docs/SERVING.md \"Fleet\")")
     s.set_defaults(fn=_cmd_serve)
 
     b = sub.add_parser("bench", help="run the benchmark (one JSON line)")

@@ -386,6 +386,54 @@ class ServeConfig:
     #: Both routes are exact: the same triangle-inequality certificate
     #: gates both, and failing rows rescore densely.
     assign_pruned_backend: str = "auto"
+    #: Bind the listening socket with ``SO_REUSEPORT`` so N fleet worker
+    #: processes can share one port and let the kernel load-balance
+    #: accepted connections across them (docs/SERVING.md "Fleet").  Off
+    #: by default: a lone server WANTS the EADDRINUSE error a stale
+    #: twin would otherwise silently split traffic with.
+    reuse_port: bool = False
+    #: Fleet supervisor (kmeans_tpu.serve.fleet): worker heartbeat
+    #: cadence.  Each worker writes one heartbeat line per interval on
+    #: its pipe to the supervisor; the supervisor declares a worker dead
+    #: after ``fleet_heartbeat_timeout_s`` of silence (or immediately on
+    #: process exit / pipe EOF, whichever fires first).
+    fleet_heartbeat_s: float = 0.5
+    fleet_heartbeat_timeout_s: float = 3.0
+    #: Exponential respawn backoff for crashed workers: the Nth
+    #: consecutive failure of a slot waits ``base * 2**(N-1)`` seconds
+    #: (capped) before the next spawn, so a worker that dies at boot
+    #: cannot hot-loop the supervisor.  A worker that stays up past the
+    #: heartbeat timeout resets its slot's failure count.
+    fleet_backoff_base_s: float = 0.1
+    fleet_backoff_max_s: float = 5.0
+    #: Graceful-drain budget on SIGTERM/SIGHUP: workers get this long to
+    #: finish in-flight requests and exit cleanly before the supervisor
+    #: escalates to SIGKILL (the zero-in-flight-drops contract holds on
+    #: the graceful path; the escalation is the last-resort bound).
+    fleet_drain_s: float = 5.0
+    #: Cadence of the supervisor's registry watch: how often it checks
+    #: the model dir for a newer persisted generation to push to the
+    #: workers (the publish side is persist-then-swap, so the newest
+    #: step on disk is always servable).  The push replaces per-client
+    #: ``POST /api/model/reload`` polling; one swap window is roughly
+    #: this interval plus one worker ``load_latest``.
+    fleet_reload_poll_s: float = 0.1
+    #: Per-tenant admission control on ``POST /api/assign`` (docs/
+    #: SERVING.md "Fleet"): ``(class, priority, rate_per_s, burst)``
+    #: tuples.  Requests carry ``X-Tenant: <tenant>``; a tenant whose
+    #: name matches a configured class belongs to it, anything else
+    #: (including no header) falls to the lowest-priority class.  Each
+    #: distinct tenant value gets its own token bucket at its class's
+    #: rate (``rate_per_s`` 0 = unmetered); an empty tuple — the
+    #: default — disables admission control entirely.
+    tenant_classes: Tuple[Tuple[str, int, float, float], ...] = ()
+    #: Load shedding: once the assign queue passes this fraction of
+    #: ``assign_pending_limit``, lower-priority tenant classes are shed
+    #: (503 + honest Retry-After) BEFORE the queue itself overflows —
+    #: lowest priority sheds first at this threshold, higher priorities
+    #: shed at evenly spaced higher thresholds, and the top class sheds
+    #: only when the queue is actually full.
+    shed_start_fraction: float = 0.5
 
 
 @dataclasses.dataclass(frozen=True)

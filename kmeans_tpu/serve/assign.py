@@ -654,6 +654,10 @@ class AssignEngine:
         self._stats_lock = threading.Lock()
         self._prep: "collections.OrderedDict[int, PreparedModel]" = \
             collections.OrderedDict()
+        #: EWMA of dispatched requests/s across all workers — the drain
+        #: rate behind the honest Retry-After derivation (server._busy).
+        self._drain_ewma = 0.0
+        self._last_dispatch_ts: Optional[float] = None
         self._n_batches = 0
         self._n_rows = 0
         self._n_requests = 0
@@ -767,6 +771,27 @@ class AssignEngine:
                 continue
             p.error = NoModelError("server stopping")
             p.event.set()
+
+    @property
+    def closed(self) -> bool:
+        """True once :meth:`stop` has run — permanent; the /readyz
+        readiness probe reports a stopped engine as not-ready."""
+        return self._closed
+
+    def queue_depth(self) -> int:
+        """Requests currently waiting in the pending queue — the
+        measured backlog the honest ``Retry-After`` derivation divides
+        by the drain rate (docs/SERVING.md)."""
+        return self._q.qsize()
+
+    def drain_rate(self) -> float:
+        """EWMA of dispatched requests/s (0.0 until two batches have
+        dispatched) — the denominator of the queue-depth →
+        ``Retry-After`` estimate.  Deliberately requests/s, not rows/s:
+        the queue is bounded in requests, so the backlog-clearing time
+        a rejected client should wait is depth/requests-per-second."""
+        with self._stats_lock:
+            return self._drain_ewma
 
     def stats(self) -> Dict[str, object]:
         """Snapshot of the engine counters (loadgen/tests)."""
@@ -976,7 +1001,19 @@ class AssignEngine:
             x = (good[0].points if len(good) == 1
                  else np.concatenate([p.points for p in good]))
             labels = self._run_kernel(kind, prep, x, rows)
+        t_done = time.perf_counter()
         with self._stats_lock:
+            if self._last_dispatch_ts is not None:
+                # Batch-granularity drain estimate: requests finished
+                # over the gap since the previous batch completed.  The
+                # EWMA smooths the multi-worker interleaving; 0.8/0.2
+                # matches the arrival-gap estimator above.
+                rate = len(good) / max(t_done - self._last_dispatch_ts,
+                                       1e-6)
+                self._drain_ewma = (rate if self._drain_ewma == 0.0
+                                    else 0.8 * self._drain_ewma
+                                    + 0.2 * rate)
+            self._last_dispatch_ts = t_done
             self._n_batches += 1
             self._n_requests += len(good)
             self._n_rows += rows

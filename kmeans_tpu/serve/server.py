@@ -26,6 +26,7 @@ import json
 import os
 import queue
 import random
+import socket
 import sys
 import threading
 import time
@@ -110,12 +111,20 @@ _ASSIGN_POINTS_TOTAL = obs.counter(
     "kmeans_tpu_assign_points_total",
     "Points labeled by the /api/assign nearest-centroid endpoint",
 )
+_REQUESTS_SHED_TOTAL = obs.counter(
+    "kmeans_tpu_requests_shed_total",
+    "Requests shed by per-tenant admission control (token bucket "
+    "exhausted, or the tenant's priority class crossed its overload "
+    "shed threshold) — 503 + honest Retry-After, counted by the "
+    "tenant's priority class, before any model work or body parse",
+    labels=("tenant_class",),
+)
 
 _KNOWN_ROUTES = frozenset((
     "/", "/index.html", "/app.js", "/api/state", "/api/export",
     "/api/events", "/api/mutate", "/api/hello", "/api/import",
-    "/healthz", "/metrics", "/api/trace", "/api/assign", "/api/model",
-    "/api/model/reload",
+    "/healthz", "/readyz", "/metrics", "/api/trace", "/api/assign",
+    "/api/model", "/api/model/reload",
 ))
 
 
@@ -218,6 +227,109 @@ class CapacityError(RuntimeError):
 
 class PayloadTooLargeError(ValueError):
     """Request body (or imported board) exceeds a configured cap -> 413."""
+
+
+#: Ceiling on the queue-derived ``Retry-After`` (seconds): past a minute
+#: the estimate is telling the operator about an outage, not the client
+#: about backpressure — clients should keep probing at a bounded cadence.
+_RETRY_AFTER_CAP = 60.0
+
+
+class _TenantAdmission:
+    """Per-tenant admission control + priority-ordered load shedding on
+    ``POST /api/assign`` (docs/SERVING.md "Fleet").
+
+    ``ServeConfig.tenant_classes`` declares ``(class, priority,
+    rate_per_s, burst)`` tuples; a request's ``X-Tenant`` header names
+    its tenant, and the tenant's class is the one whose name it matches
+    (anything else — including no header — falls to the lowest-priority
+    class).  Two independent admission gates:
+
+    * **Token bucket per tenant** at the class's rate (``rate_per_s`` 0
+      = unmetered).  Buckets are keyed by the raw tenant value, so two
+      tenants of the same class cannot starve each other; the table is
+      LRU-bounded so arbitrary header values cannot grow it unbounded.
+    * **Overload shedding by priority**: once the assign queue passes
+      ``shed_start_fraction`` of its limit, classes shed lowest
+      priority first at evenly spaced thresholds — the top class sheds
+      only when the queue is actually full (where
+      :class:`~kmeans_tpu.serve.assign.QueueFullError` already fires).
+
+    Disabled entirely (every request admitted, zero per-request cost
+    beyond one attribute read) when ``tenant_classes`` is empty.
+    """
+
+    _MAX_TENANTS = 1024
+
+    def __init__(self, config: ServeConfig):
+        classes = tuple(config.tenant_classes or ())
+        self.enabled = bool(classes)
+        if not self.enabled:
+            return
+        self._classes = {}
+        for name, prio, rate, burst in classes:
+            self._classes[str(name)] = (int(prio), float(rate),
+                                        float(burst))
+        ranked = sorted(self._classes,
+                        key=lambda n: self._classes[n][0])
+        self.default_class = ranked[0]
+        start = min(max(float(config.shed_start_fraction), 0.0), 1.0)
+        n = len(ranked)
+        #: class -> queue-fraction threshold at which it sheds; lowest
+        #: priority at shed_start, top priority at 1.0 (i.e. only the
+        #: queue-full backpressure itself).
+        self._shed_at = {
+            name: (start if n == 1
+                   else start + (1.0 - start) * (i / (n - 1)))
+            for i, name in enumerate(ranked)
+        }
+        self._buckets: "collections.OrderedDict[str, list]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def resolve(self, tenant: Optional[str]) -> str:
+        """The priority class a request's ``X-Tenant`` value lands in."""
+        t = (tenant or "").strip()
+        return t if t in self._classes else self.default_class
+
+    def decide(self, tenant: Optional[str], queue_fraction: float,
+               now: Optional[float] = None
+               ) -> Optional[tuple]:
+        """``None`` = admitted; ``(tenant_class, reason)`` = shed.
+
+        ``queue_fraction`` is the measured assign-queue depth over its
+        limit — the overload signal the priority thresholds compare
+        against."""
+        if not self.enabled:
+            return None
+        cls = self.resolve(tenant)
+        prio, rate, burst = self._classes[cls]
+        if queue_fraction >= self._shed_at[cls]:
+            return (cls, f"overloaded (assign queue at "
+                         f"{queue_fraction:.0%}); tenant class "
+                         f"{cls!r} shed first — retry shortly")
+        if rate <= 0.0:
+            return None
+        key = (tenant or "").strip() or cls
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            b = self._buckets.get(key)
+            if b is None:
+                # Fresh bucket born full: a tenant's first burst up to
+                # ``burst`` requests is always admitted.
+                b = self._buckets[key] = [burst, t]
+                while len(self._buckets) > self._MAX_TENANTS:
+                    self._buckets.popitem(last=False)
+            else:
+                self._buckets.move_to_end(key)
+            tokens, last = b
+            tokens = min(burst, tokens + (t - last) * rate)
+            if tokens >= 1.0:
+                b[0], b[1] = tokens - 1.0, t
+                return None
+            b[0], b[1] = tokens, t
+        return (cls, f"tenant {key!r} over its {rate:g} req/s rate; "
+                     "retry shortly")
 
 
 class _Room:
@@ -346,9 +458,28 @@ class _BackloggedHTTPServer(ThreadingHTTPServer):
     drops in the binary-wire loadgen phases.  The listen queue is
     bounded by the kernel's somaxconn anyway; 128 covers the burst of
     a reconnecting worker pool without unbounded accept debt.
+
+    ``reuse_port`` sets ``SO_REUSEPORT`` before the bind (explicitly —
+    3.10's socketserver has no ``allow_reuse_port``): N fleet worker
+    processes then share one port and the kernel balances accepted
+    connections across their listen queues (kmeans_tpu.serve.fleet).
     """
 
     request_queue_size = 128
+
+    def __init__(self, addr, handler, *, reuse_port: bool = False):
+        self._reuse_port = bool(reuse_port)
+        super().__init__(addr, handler)
+
+    def server_bind(self):
+        if self._reuse_port:
+            if not hasattr(socket, "SO_REUSEPORT"):
+                raise OSError(
+                    "reuse_port requested but this platform has no "
+                    "SO_REUSEPORT — a fleet cannot share the port")
+            self.socket.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEPORT, 1)
+        super().server_bind()
 
 
 class KMeansServer:
@@ -385,6 +516,9 @@ class KMeansServer:
         self.assign_engine = (
             serve_assign.AssignEngine(self.current_model, self.config)
             if self.config.assign_batching else None)
+        #: Per-tenant admission control (inert when tenant_classes is
+        #: empty — the default; docs/SERVING.md "Fleet").
+        self.admission = _TenantAdmission(self.config)
         self._train_sem = threading.BoundedSemaphore(
             self.config.max_concurrent_train
         )
@@ -567,6 +701,49 @@ class KMeansServer:
         nothing published) — the one read the /api/assign path does."""
         reg = self.model_registry
         return reg.current() if reg is not None else None
+
+    def assign_queue_fraction(self) -> float:
+        """Measured assign-queue depth over its limit ∈ [0, 1] — the
+        overload signal admission control sheds against (0.0 on the
+        direct path, which has no queue to overload)."""
+        eng = self.assign_engine
+        if eng is None:
+            return 0.0
+        limit = max(1, int(self.config.assign_pending_limit))
+        return min(1.0, eng.queue_depth() / limit)
+
+    def retry_after_s(self) -> float:
+        """Honest ``Retry-After``: measured backlog over measured drain
+        rate, so clients back off proportionally to ACTUAL overload —
+        an idle queue advertises the floor, a deep one the real
+        clearing time (capped; the static ``retry_after_s`` config is
+        the floor and the no-signal fallback)."""
+        floor = float(self.config.retry_after_s)
+        eng = self.assign_engine
+        if eng is None:
+            return floor
+        depth, rate = eng.queue_depth(), eng.drain_rate()
+        if depth <= 0 or rate <= 0.0:
+            return floor
+        return min(max(depth / rate, floor), _RETRY_AFTER_CAP)
+
+    def readiness(self) -> tuple:
+        """``(ready, detail)`` for ``GET /readyz``: ready iff a model is
+        servable (or no registry is configured — a board-only server is
+        ready the moment it binds) AND the assign engine has not been
+        permanently stopped.  The supervisor and external load
+        balancers use this to tell "starting" from "serving"."""
+        gen = self.current_model()
+        model_ready = self.model_registry is None or gen is not None
+        eng = self.assign_engine
+        engine_ready = eng is None or not eng.closed
+        detail = {
+            "model": "none" if self.model_registry is None
+                     else (gen.generation if gen is not None else 0),
+            "engine": ("direct" if eng is None
+                       else "stopped" if eng.closed else "warm"),
+        }
+        return model_ready and engine_ready, detail
 
     def assign_points(self, points):
         """Label ``points`` (n, d) float32 — the one entry both the
@@ -1032,12 +1209,16 @@ class KMeansServer:
             def _busy(self, msg):
                 """503 + Retry-After: the server-side half of the retry
                 contract — tell the client WHEN to come back, not just
-                that it failed.  Bounded jitter decorrelates the comeback
-                times a capacity dip hands out, so the rejected cohort
-                doesn't return as one thundering herd (the same reason
-                RetryPolicy jitters its backoff)."""
+                that it failed.  The base value is MEASURED (assign
+                backlog over drain rate, server.retry_after_s), so
+                clients back off proportionally to actual overload
+                instead of a fixed config guess; bounded jitter still
+                decorrelates the comeback times a capacity dip hands
+                out, so the rejected cohort doesn't return as one
+                thundering herd (the same reason RetryPolicy jitters
+                its backoff)."""
                 _HTTP_503_TOTAL.inc()
-                ra = float(server.config.retry_after_s)
+                ra = server.retry_after_s()
                 jit = float(server.config.retry_after_jitter_s)
                 if jit > 0:
                     ra += random.uniform(0.0, jit)
@@ -1069,6 +1250,21 @@ class KMeansServer:
                         f"{server.config.max_import_bytes}-byte cap"
                     )
                 return self.rfile.read(length) if length else b""
+
+            def _drain_body(self):
+                """Consume the unread request body before an early
+                (pre-read) response on a keep-alive connection: unread
+                body bytes would be parsed as the NEXT request line,
+                desyncing every later request on the socket.  Oversized
+                bodies close the connection instead of draining
+                unboundedly."""
+                length = int(self.headers.get("Content-Length") or 0)
+                if length <= 0:
+                    return
+                if length > server.config.max_import_bytes:
+                    self.close_connection = True
+                    return
+                self.rfile.read(length)
 
             def _body(self):
                 raw = self._read_bounded()
@@ -1152,7 +1348,20 @@ class KMeansServer:
                                           "yet; retry shortly")
                     return self._json(gen.describe())
                 if path == "/healthz":
+                    # Liveness ONLY: the process is up and the handler
+                    # loop is turning.  Readiness (is there a model to
+                    # serve?) is /readyz — a load balancer that pulls a
+                    # worker on liveness during a model load would turn
+                    # a slow boot into an outage.
                     return self._json({"ok": True, "rooms": len(server.rooms)})
+                if path == "/readyz":
+                    ready, detail = server.readiness()
+                    if ready:
+                        return self._json({"ok": True, **detail})
+                    # Not-ready is retryable by definition: the fleet
+                    # supervisor holds traffic until this flips.
+                    return self._busy(
+                        "not ready: " + json.dumps(detail))
                 if path == "/metrics":
                     # Prometheus text exposition of the whole process
                     # registry: engine iteration histograms, retry /
@@ -1360,9 +1569,28 @@ class KMeansServer:
                 """
                 import numpy as np
 
+                if server.admission.enabled:
+                    # Admission decides FIRST, before any model or body
+                    # work — the point of shedding is that a rejected
+                    # request costs almost nothing.  The class rides the
+                    # shed counter; the 503 carries the honest
+                    # queue-derived Retry-After like every busy path.
+                    shed = server.admission.decide(
+                        self.headers.get("X-Tenant"),
+                        server.assign_queue_fraction())
+                    if shed is not None:
+                        cls, why = shed
+                        _REQUESTS_SHED_TOTAL.labels(
+                            tenant_class=cls).inc()
+                        # Drained, never parsed: a shed request still
+                        # pays body I/O (keep-alive framing demands
+                        # it) but no decode/model work.
+                        self._drain_body()
+                        return self._busy(why)
                 if server.model_registry is None:
                     # A server with no registry configured will NEVER have
                     # a model — advertising a retry would poll forever.
+                    self._drain_body()
                     return self._error("no model registry configured",
                                        HTTPStatus.NOT_FOUND)
                 gen = server.current_model()
@@ -1372,6 +1600,7 @@ class KMeansServer:
                     # loaded one) — same 503 + Retry-After shape as the
                     # capacity paths, so clients back off instead of
                     # erroring.
+                    self._drain_body()
                     return self._busy("no model generation published yet; "
                                       "retry shortly")
                 ctype = (self.headers.get("Content-Type") or "")
@@ -1453,7 +1682,8 @@ class KMeansServer:
 
     def start(self, *, background: bool = True) -> ThreadingHTTPServer:
         self.httpd = _BackloggedHTTPServer(
-            (self.config.host, self.config.port), self.make_handler()
+            (self.config.host, self.config.port), self.make_handler(),
+            reuse_port=self.config.reuse_port,
         )
         # The tracer hold rides start()/stop(), NOT construction (a
         # never-started server — room-table logic driven directly —

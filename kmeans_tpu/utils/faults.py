@@ -29,6 +29,12 @@ Site catalog (see docs/RESILIENCE.md for the authoritative list):
 ``continuous.compact``  sliding-window coreset compaction, pre-mutation
 ``continuous.refit``    continuous-pipeline refit, before the fit runs
 ``registry.swap``       model generation persisted, in-memory swap pending
+``fleet.worker_spawn``  fleet supervisor, before forking a worker process
+``fleet.heartbeat``     fleet WORKER, before each heartbeat write (so
+                        ``kill@2`` dies at the second heartbeat — the
+                        deterministic mid-load worker-kill drill)
+``fleet.reload_push``   fleet supervisor, before pushing RELOAD to one
+                        worker (a failed push retries next watcher tick)
 ======================  ====================================================
 
 Activation is programmatic (``faults.install(plan)`` / ``faults.active``)

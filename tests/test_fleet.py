@@ -1,0 +1,516 @@
+"""Serving-fleet tests (kmeans_tpu/serve/fleet.py + the admission /
+readiness surface in serve/server.py): per-tenant shed ordering and
+token buckets, /healthz vs /readyz, honest Retry-After, keep-alive
+framing across shed responses, and the multi-process supervisor drills
+— worker kill@2 mid-load with RTO, fleet-wide hot-swap generation
+consistency, rolling replace, and graceful drain with zero in-flight
+drops (docs/SERVING.md "Fleet", docs/RESILIENCE.md).
+
+The multi-process drills spawn real worker interpreters and ride the
+slow lane; test_fleet_boots_serves_and_drains_clean stays the fast
+tier-1 representative of the supervisor surface.
+"""
+
+import dataclasses
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import ServeConfig
+from kmeans_tpu.continuous.registry import ModelRegistry
+from kmeans_tpu.serve import KMeansServer
+from kmeans_tpu.serve import fleet as F
+from kmeans_tpu.serve.server import _TenantAdmission
+
+
+def _cfg(**kw):
+    return dataclasses.replace(
+        ServeConfig(host="127.0.0.1", port=0, tracing=False), **kw)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _fleet_cfg(model_dir, port, **kw):
+    """Drill-speed fleet config: tight heartbeats so death detection and
+    reload push land within a test-sized window."""
+    knobs = dict(model_dir=model_dir, assign_batching=False,
+                 metrics=False, fleet_heartbeat_s=0.1,
+                 fleet_heartbeat_timeout_s=1.0, fleet_backoff_base_s=0.05,
+                 fleet_reload_poll_s=0.05)
+    knobs.update(kw)
+    return _cfg(port=port, **knobs)
+
+
+def _publish(model_dir, k=4, d=3):
+    reg = ModelRegistry(path=model_dir)
+    c = (np.arange(k * d, dtype=np.float32).reshape(k, d)) * 10.0
+    reg.publish(c, trigger="initial")
+    return reg, c
+
+
+def _get(base, path, timeout=5.0):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _assign(base, rows, timeout=5.0):
+    req = urllib.request.Request(
+        base + "/api/assign",
+        data=json.dumps({"points": rows}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# Admission control: priority shed ordering + per-tenant token buckets
+# ---------------------------------------------------------------------------
+
+_THREE_CLASSES = (("batch", 0, 0.0, 0.0), ("standard", 1, 0.0, 0.0),
+                  ("premium", 2, 0.0, 0.0))
+
+
+def test_admission_disabled_without_classes():
+    adm = _TenantAdmission(_cfg())
+    assert not adm.enabled
+    assert adm.decide("anyone", 1.0) is None
+
+
+def test_admission_sheds_lowest_priority_first():
+    """Evenly spaced thresholds from shed_start_fraction up: the lowest
+    class sheds at queue 50%, the middle at 75%, and the top class only
+    when the queue is actually full."""
+    adm = _TenantAdmission(
+        _cfg(tenant_classes=_THREE_CLASSES, shed_start_fraction=0.5))
+    assert adm.decide("batch", 0.49) is None
+    shed = adm.decide("batch", 0.5)
+    assert shed is not None and shed[0] == "batch"
+    assert adm.decide("standard", 0.74) is None
+    assert adm.decide("standard", 0.75)[0] == "standard"
+    assert adm.decide("premium", 0.99) is None
+    assert adm.decide("premium", 1.0)[0] == "premium"
+
+
+def test_admission_unknown_tenant_lands_in_lowest_class():
+    adm = _TenantAdmission(
+        _cfg(tenant_classes=_THREE_CLASSES, shed_start_fraction=0.5))
+    assert adm.resolve("nobody-special") == "batch"
+    assert adm.resolve(None) == "batch"
+    assert adm.decide("nobody-special", 0.5)[0] == "batch"
+    assert adm.decide("premium", 0.5) is None
+
+
+def test_admission_token_bucket_burst_then_refill():
+    adm = _TenantAdmission(
+        _cfg(tenant_classes=(("batch", 0, 10.0, 2.0),)))
+    t0 = 100.0
+    assert adm.decide("alice", 0.0, now=t0) is None
+    assert adm.decide("alice", 0.0, now=t0) is None
+    shed = adm.decide("alice", 0.0, now=t0)
+    assert shed is not None and shed[0] == "batch"
+    assert "rate" in shed[1]
+    # 0.15 s at 10 req/s refills ~1.5 tokens: one more request fits,
+    # the next sheds again.
+    assert adm.decide("alice", 0.0, now=t0 + 0.15) is None
+    assert adm.decide("alice", 0.0, now=t0 + 0.15) is not None
+
+
+def test_admission_buckets_are_per_tenant():
+    """Two tenants of the same class meter independently — one tenant
+    burning its bucket cannot starve its neighbour."""
+    adm = _TenantAdmission(
+        _cfg(tenant_classes=(("batch", 0, 0.001, 2.0),)))
+    t0 = 100.0
+    for _ in range(2):
+        assert adm.decide("alice", 0.0, now=t0) is None
+    assert adm.decide("alice", 0.0, now=t0) is not None
+    for _ in range(2):
+        assert adm.decide("bob", 0.0, now=t0) is None
+    assert adm.decide("bob", 0.0, now=t0) is not None
+
+
+# ---------------------------------------------------------------------------
+# Supervisor surface: constructor contracts + line protocol
+# ---------------------------------------------------------------------------
+
+def test_supervisor_rejects_ephemeral_port():
+    with pytest.raises(ValueError, match="fixed port"):
+        F.FleetSupervisor(_cfg(port=0), workers=2)
+
+
+def test_supervisor_rejects_zero_workers():
+    with pytest.raises(ValueError, match="workers"):
+        F.FleetSupervisor(_cfg(port=8787), workers=0)
+
+
+def test_supervisor_forces_reuse_port():
+    sup = F.FleetSupervisor(_cfg(port=_free_port()), workers=1)
+    assert sup.config.reuse_port is True
+
+
+def test_heartbeat_line_protocol_roundtrip():
+    line = F._kv_line("FLEET_READY", pid=123, port=8787, gen=7)
+    assert line == "FLEET_READY pid=123 port=8787 gen=7"
+    assert F._parse_kv(line) == {"pid": "123", "port": "8787", "gen": "7"}
+    assert F._parse_kv("FLEET_HB") == {}
+
+
+# ---------------------------------------------------------------------------
+# Server endpoints: liveness vs readiness, honest Retry-After, and the
+# shed path's keep-alive framing (per-tenant shed ordering end to end)
+# ---------------------------------------------------------------------------
+
+def test_healthz_liveness_and_readyz_readiness():
+    """/healthz is pure liveness; /readyz flips only once a generation
+    is servable — the signal the supervisor and LBs gate traffic on."""
+    reg = ModelRegistry()
+    s = KMeansServer(_cfg(assign_batching=False), registry=reg)
+    httpd = s.start(background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        st, out = _get(base, "/healthz")
+        assert st == 200 and out["ok"] is True
+        st, out = _get(base, "/readyz")
+        assert st == 503 and "not ready" in out["error"]
+        reg.publish(np.zeros((2, 2), np.float32))
+        st, out = _get(base, "/readyz")
+        assert st == 200 and out["ok"] is True and out["model"] == 1
+    finally:
+        s.stop()
+
+
+def test_retry_after_floor_without_queue_signal():
+    """With no assign queue (direct path) the honest Retry-After falls
+    back to the configured floor."""
+    s = KMeansServer(_cfg(assign_batching=False, retry_after_s=3),
+                     registry=ModelRegistry())
+    assert s.retry_after_s() == 3.0
+
+
+def test_shed_ordering_over_keepalive_http():
+    """End-to-end per-tenant shed: the batch tenant's bucket empties and
+    sheds 503 + Retry-After while premium stays unmetered — and every
+    response after a shed on the SAME keep-alive socket still parses
+    (the shed path must drain the unread body or the next request line
+    desyncs into 400s)."""
+    reg = ModelRegistry()
+    reg.publish(np.array([[0.0, 0.0], [10.0, 10.0]], np.float32))
+    s = KMeansServer(
+        _cfg(assign_batching=False,
+             tenant_classes=(("batch", 0, 0.001, 2.0),
+                             ("premium", 1, 0.0, 0.0))),
+        registry=reg)
+    httpd = s.start(background=True)
+    port = httpd.server_address[1]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    body = json.dumps({"points": [[1.0, 1.0]]})
+
+    def roundtrip(tenant):
+        conn.request("POST", "/api/assign", body=body,
+                     headers={"Content-Type": "application/json",
+                              "X-Tenant": tenant})
+        r = conn.getresponse()
+        payload = r.read()
+        return r.status, r.getheader("Retry-After"), payload
+
+    try:
+        statuses = [roundtrip("batch") for _ in range(6)]
+        assert [st for st, _, _ in statuses] == [200] * 2 + [503] * 4
+        for st, retry_after, payload in statuses[2:]:
+            assert retry_after is not None and float(retry_after) >= 1.0
+            assert "rate" in json.loads(payload)["error"]
+        # Premium rides the same socket right after the sheds: unmetered,
+        # and 200 (not 400) proves the shed responses left the connection
+        # framed correctly.
+        for _ in range(3):
+            st, _, payload = roundtrip("premium")
+            assert st == 200
+            assert json.loads(payload)["labels"] == [0]
+    finally:
+        conn.close()
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet drills: real worker processes under the supervisor
+# ---------------------------------------------------------------------------
+
+def test_fleet_boots_serves_and_drains_clean(tmp_path):
+    """The fast fleet representative: two workers share one port, serve
+    the published generation, and a graceful stop drains both with zero
+    in-flight drops (every concurrent request completes 200)."""
+    tmp = str(tmp_path)
+    _publish(tmp)
+    port = _free_port()
+    sup = F.FleetSupervisor(_fleet_cfg(tmp, port), workers=2)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        sup.start()
+        assert sup.wait_ready(timeout=30.0), sup.events
+        st, out = _get(base, "/healthz")
+        assert st == 200 and out["ok"] is True
+        st, out = _assign(base, [[0.0, 0.0, 0.0]])
+        assert st == 200 and out["generation"] == 1
+        # A concurrent volley against both listeners, then the graceful
+        # stop: every request completes 200 and both workers report
+        # FLEET_DRAINED + exit 0 — the zero-drop path.  (Mid-drain load
+        # is exercised by the slow rolling-replace and kill drills.)
+        results = []
+        lock = threading.Lock()
+
+        def go():
+            st, _ = _assign(base, [[100.0, 110.0, 120.0]])
+            with lock:
+                results.append(st)
+
+        threads = [threading.Thread(target=go) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 8 and all(st == 200 for st in results)
+        clean = sup.stop(graceful=True)
+        assert clean, sup.events
+        assert len(sup.events_of("drained")) == 2, sup.events
+        assert all(e["returncode"] == 0 for e in sup.events_of("exit"))
+    finally:
+        sup.stop(graceful=False)
+
+
+@pytest.mark.slow
+def test_fleet_worker_kill_mid_load_recovers(tmp_path):
+    """The worker-kill drill (fleet.heartbeat:kill@2 on slot 1's first
+    incarnation): under paced load, the killed worker's slot respawns
+    within the RTO window, the hammer sees only in-flight connection
+    errors, and QPS recovers — the replacement serves."""
+    tmp = str(tmp_path)
+    _publish(tmp)
+    port = _free_port()
+    cfg = _fleet_cfg(tmp, port, fleet_heartbeat_s=0.25)
+    sup = F.FleetSupervisor(
+        cfg, workers=2,
+        worker_env={1: {"KMEANS_TPU_FAULTS": "fleet.heartbeat:kill@2"}})
+    base = f"http://127.0.0.1:{port}"
+    stop = threading.Event()
+    good, errors = [], []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                st, _ = _assign(base, [[0.0, 0.0, 0.0]], timeout=3.0)
+                good.append(st)
+            except Exception as e:  # allow-silent-except: counted below
+                errors.append(type(e).__name__)
+            # Paced: the drill measures supervisor recovery, not 1-core
+            # scheduler contention between hammer and worker boot.
+            stop.wait(0.02)
+
+    try:
+        sup.start()
+        assert sup.wait_ready(timeout=30.0), sup.events
+        t = threading.Thread(target=hammer)
+        t.start()
+        deadline = time.monotonic() + 20.0
+        exit_ev = ready_after = None
+        while time.monotonic() < deadline and ready_after is None:
+            exits = [e for e in sup.events_of("exit") if e["slot"] == 1]
+            if exits:
+                exit_ev = exits[0]
+                ready_after = next(
+                    (e for e in sup.events_of("ready")
+                     if e["slot"] == 1 and e["ts"] > exit_ev["ts"]),
+                    None)
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=10)
+        assert exit_ev is not None, sup.events
+        # The fault site SIGKILLs the worker at its 2nd heartbeat.
+        assert exit_ev["returncode"] == 137
+        assert exit_ev["incarnation"] == 1
+        assert ready_after is not None, sup.events
+        rto = ready_after["ts"] - exit_ev["ts"]
+        # The ledgered gate is 2 s (tools/soak FLEET_MAX_RTO_S); the
+        # test budget is looser to absorb shared-CI scheduling noise.
+        assert rto <= 5.0, f"RTO {rto:.2f}s"
+        assert len(sup.events_of("respawn")) >= 1
+        # Only in-flight connection errors — the kill drops at most the
+        # requests that were on the dead worker's socket.
+        assert len(errors) <= 5, errors
+        assert good and all(st == 200 for st in good)
+        # Recovery proof: the replacement answers.
+        st, out = _assign(base, [[0.0, 0.0, 0.0]])
+        assert st == 200 and out["generation"] == 1
+    finally:
+        stop.set()
+        sup.stop(graceful=False)
+
+
+@pytest.mark.slow
+def test_fleet_hot_swap_generation_consistency(tmp_path):
+    """The fleet-wide hot-swap hammer: publishes land mid-load, the
+    supervisor pushes RELOAD, and within one swap window every worker
+    reports the final generation — zero request errors throughout."""
+    tmp = str(tmp_path)
+    reg, c = _publish(tmp)
+    port = _free_port()
+    sup = F.FleetSupervisor(_fleet_cfg(tmp, port), workers=2)
+    base = f"http://127.0.0.1:{port}"
+    stop = threading.Event()
+    seen_gens, errors = set(), []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                st, out = _assign(base, [[0.0, 0.0, 0.0]], timeout=3.0)
+                if st == 200:
+                    seen_gens.add(out["generation"])
+                else:
+                    errors.append(st)
+            except Exception as e:  # allow-silent-except: counted below
+                errors.append(type(e).__name__)
+            stop.wait(0.02)
+
+    try:
+        sup.start()
+        assert sup.wait_ready(timeout=30.0), sup.events
+        t = threading.Thread(target=hammer)
+        t.start()
+        for gen in (2, 3):
+            time.sleep(0.3)
+            reg.publish(c + float(gen), trigger="drift")
+        final = reg.generation
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            gens = sup.worker_generations()
+            if all(g == final for g in gens.values()):
+                break
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=10)
+        assert all(g == final for g in sup.worker_generations().values()), \
+            (sup.worker_generations(), sup.events)
+        assert not errors, errors
+        # Every served generation was a real published one, and the
+        # fleet converged on the last.
+        assert seen_gens and seen_gens <= {1, 2, 3}
+        assert len(sup.events_of("reload_detected")) >= 1
+        assert len(sup.events_of("reload_push")) >= 2
+        st, out = _assign(base, [[0.0, 0.0, 0.0]])
+        assert st == 200 and out["generation"] == final
+    finally:
+        stop.set()
+        sup.stop(graceful=False)
+
+
+@pytest.mark.slow
+def test_fleet_rolling_replace_zero_downtime(tmp_path):
+    """SIGHUP semantics via rolling_replace(): every slot's pid changes,
+    requests never fail mid-roll, and no replacement counts as a crash
+    (drained predecessors, no sigkill events)."""
+    tmp = str(tmp_path)
+    _publish(tmp)
+    port = _free_port()
+    sup = F.FleetSupervisor(_fleet_cfg(tmp, port), workers=2)
+    base = f"http://127.0.0.1:{port}"
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                st, _ = _assign(base, [[0.0, 0.0, 0.0]], timeout=3.0)
+                if st != 200:
+                    errors.append(st)
+            except Exception as e:  # allow-silent-except: counted below
+                errors.append(type(e).__name__)
+            stop.wait(0.02)
+
+    try:
+        sup.start()
+        assert sup.wait_ready(timeout=30.0), sup.events
+        pids_before = {e["slot"]: e["pid"] for e in sup.events_of("spawn")}
+        t = threading.Thread(target=hammer)
+        t.start()
+        sup.rolling_replace()
+        stop.set()
+        t.join(timeout=10)
+        # The successor is READY before its predecessor drains, so the
+        # hammer rides through both rolls.  Fresh-connection clients can
+        # still land in a closing listener's accept queue (the known
+        # SO_REUSEPORT drain race — connections queued but never
+        # accepted are reset at close); that window is bounded to the
+        # close instant, so at most a couple of connection-level errors
+        # and NEVER an HTTP failure from an accepted request.
+        assert len(errors) <= 3, errors
+        assert all(isinstance(e, str) for e in errors), errors
+        rolled = {e["slot"]: e["pid"] for e in sup.events_of("rolled")}
+        assert set(rolled) == {0, 1}
+        assert all(rolled[s] != pids_before[s] for s in rolled)
+        assert not sup.events_of("sigkill"), sup.events
+        assert all(e["drained"] for e in sup.events_of("exit"))
+        st, _ = _assign(base, [[0.0, 0.0, 0.0]])
+        assert st == 200
+    finally:
+        stop.set()
+        sup.stop(graceful=False)
+
+
+@pytest.mark.slow
+def test_fleet_cli_sigterm_drains_and_exits_zero(tmp_path):
+    """`kmeans_tpu serve --workers 2` under SIGTERM: the supervisor
+    latches a drain, workers finish in flight and exit 0, and the CLI
+    itself returns 0 — the operator-facing graceful path."""
+    tmp = str(tmp_path)
+    _publish(tmp)
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("KMEANS_TPU_FAULTS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kmeans_tpu.cli", "serve",
+         "--workers", "2", "--port", str(port),
+         "--model-dir", tmp, "--no-assign-batching", "--no-metrics",
+         "--persist-dir", str(tmp_path / "rooms")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        deadline = time.monotonic() + 30.0
+        up = False
+        while time.monotonic() < deadline and not up:
+            try:
+                st, _ = _get(base, "/readyz", timeout=1.0)
+                up = st == 200
+            except Exception:  # allow-silent-except: still booting
+                time.sleep(0.1)
+        assert up, "fleet never became ready"
+        st, out = _assign(base, [[0.0, 0.0, 0.0]])
+        assert st == 200 and out["generation"] == 1
+        proc.send_signal(signal.SIGTERM)
+        out_text = proc.communicate(timeout=30)[0]
+        assert proc.returncode == 0, out_text
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()

@@ -48,6 +48,7 @@ MODULES = [
     "kmeans_tpu.parallel.engine",
     "kmeans_tpu.serve.assign",
     "kmeans_tpu.serve.server",
+    "kmeans_tpu.serve.fleet",
     "kmeans_tpu.continuous.drift",
     "kmeans_tpu.continuous.window",
     "kmeans_tpu.continuous.pipeline",

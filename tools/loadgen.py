@@ -42,7 +42,15 @@ loop at k=1000, d=300, all under the same harness:
    frame: the transport-cost comparison the ISSUE 12 gate reads
    (binary QPS >= 2x JSON at >= 256 points/request, p99 no worse);
 6. ``hot_swap_binary`` — the swap drill repeated over the binary
-   HTTP path; zero drops required there too.
+   HTTP path; zero drops required there too;
+7. ``fleet`` (ISSUE 16) — a supervised SO_REUSEPORT fleet on one
+   shared port, hammered by separate client PROCESSES (a single
+   client is GIL-bound and would mask server-side scaling): a
+   1-worker baseline window, then ``FLEET_WORKERS`` workers under
+   mid-load disk publishes that the supervisor PUSHES to every
+   worker (generation-consistency checked, zero drops, clean
+   drain), plus a deterministic per-tenant shed count.  ``--fleet``
+   runs just this phase and merges it into the committed artifact.
 
 Writes ``BENCH_SERVE_latest.json``; render it with
 ``python tools/bench_table.py --serve``.
@@ -87,6 +95,25 @@ GATE_MAX_DROPPED = 0
 #: QPS at >= 256 points/request, with p99 no worse and zero drops
 #: across the binary hot-swap drill.
 GATE_BINARY_SPEEDUP = 2.0
+
+#: ISSUE 16 gate: N-worker fleet aggregate QPS, normalized per
+#: AVAILABLE core — ``qps_N / (min(N, cores) * qps_1)`` — must reach
+#: this fraction, with zero drops under mid-load hot-swaps.  The
+#: normalization is what makes the gate honest on small hosts: raw
+#: 0.8*N scaling is physically impossible when N exceeds the core
+#: count, but per-core efficiency (the thing SO_REUSEPORT + processes
+#: actually buy: no shared GIL) is measurable anywhere.  The raw
+#: qps_1/qps_N/cores land in the artifact next to the ratio.
+GATE_FLEET_SCALING = 0.8
+FLEET_WORKERS = 4
+
+#: Fleet shed sub-phase sizing: one low-priority tenant fires
+#: ``FLEET_SHED_REQUESTS`` back-to-back requests against a token
+#: bucket of ``FLEET_SHED_BURST`` tokens refilling at ~0/s, so
+#: ``shed_total`` is the DETERMINISTIC difference (host speed changes
+#: the window's wall time, not the count) — a stable ledger series.
+FLEET_SHED_REQUESTS = 200
+FLEET_SHED_BURST = 20.0
 
 
 def _make_data(k: int, d: int, n: int, seed: int = 0):
@@ -379,6 +406,229 @@ def _swap_thread(reg, interval: float, stop_evt: threading.Event,
     return t
 
 
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fleet_client_procs(base: str, *, procs: int, concurrency: int,
+                        duration: float, points: int, k: int, d: int
+                        ) -> dict:
+    """Closed-loop load from ``procs`` SEPARATE client processes (each
+    one is this very loadgen aimed at --base): a single client process
+    is GIL-bound and would measure the CLIENT's ceiling, masking any
+    server-side scaling the fleet phase exists to detect."""
+    cmd_base = [sys.executable, "-m", "tools.loadgen",
+                "--transport", "http", "--base", base,
+                "--duration", str(duration),
+                "--concurrency", str(concurrency),
+                "--points", str(points), "--k", str(k), "--d", str(d)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("KMEANS_TPU_FAULTS", None)
+    import subprocess
+    children = [subprocess.Popen(cmd_base + ["--seed", str(i)],
+                                 cwd=_REPO, env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.DEVNULL, text=True)
+                for i in range(procs)]
+    agg = {"requests": 0, "ok": 0, "dropped": 0, "qps": 0.0,
+           "errors": []}
+    for c in children:
+        out, _ = c.communicate(timeout=duration + 120)
+        rec = json.loads(out)
+        agg["requests"] += rec["requests"]
+        agg["ok"] += rec["ok"]
+        agg["dropped"] += rec["dropped"]
+        agg["qps"] = round(agg["qps"] + rec["qps"], 1)
+        agg["errors"].extend(rec.get("errors", [])[:2])
+    return agg
+
+
+def _fleet_window(tmp: str, *, workers: int, swap_every: float,
+                  duration: float, points: int, k: int, d: int,
+                  client_procs: int, client_conc: int) -> dict:
+    """One measured fleet window: N supervised workers on a shared
+    port, client processes hammering it, and (when ``swap_every`` > 0)
+    generations published to the DISK registry mid-load so the
+    supervisor's push path — not client polling — swaps every worker."""
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.continuous.registry import ModelRegistry
+    from kmeans_tpu.serve.fleet import FleetSupervisor
+
+    reg = ModelRegistry(path=tmp)
+    if reg.current() is None:
+        reg.load_latest()       # adopt the generations already on disk
+    gen0 = reg.generation
+    port = _free_port()
+    cfg = ServeConfig(
+        host="127.0.0.1", port=port, model_dir=tmp,
+        assign_batching=False, metrics=False, tracing=False,
+        fleet_reload_poll_s=0.05)
+    sup = FleetSupervisor(cfg, workers=workers)
+    sup.start()
+    try:
+        if not sup.wait_ready(60.0):
+            raise RuntimeError(f"fleet of {workers} never went ready: "
+                               f"{sup.events[-5:]}")
+        base = f"http://127.0.0.1:{port}"
+        stop_evt = threading.Event()
+        swapper = None
+        if swap_every > 0:
+            swapper = _swap_thread(reg, swap_every, stop_evt)
+        out = _fleet_client_procs(
+            base, procs=client_procs, concurrency=client_conc,
+            duration=duration, points=points, k=k, d=d)
+        stop_evt.set()
+        if swapper is not None:
+            swapper.join(timeout=5)
+        out["generations_published"] = reg.generation - gen0
+        # Consistency: within one swap window of the last publish,
+        # every worker must report the final generation (the push
+        # protocol's no-stale-worker promise).
+        deadline = time.perf_counter() + 2.0
+        gens = sup.worker_generations()
+        while (time.perf_counter() < deadline
+               and not all(g == reg.generation for g in gens.values())):
+            time.sleep(0.05)
+            gens = sup.worker_generations()
+        out["worker_generations"] = sorted(gens.values())
+        out["final_generation"] = reg.generation
+        out["consistent"] = all(g == reg.generation
+                                for g in gens.values())
+        out["restarts"] = len(sup.events_of("respawn"))
+    finally:
+        clean = sup.stop(graceful=True)
+    out["drained_clean"] = clean
+    return out
+
+
+def _fleet_shed_phase(k: int, d: int) -> dict:
+    """Deterministic admission-control evidence: a near-empty-rate
+    token bucket for the lowest-priority tenant, a fixed request count,
+    so ``shed_total == FLEET_SHED_REQUESTS - FLEET_SHED_BURST`` exactly
+    — and the premium tenant, hitting the same server in the same
+    window, is never shed."""
+    import http.client
+
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.continuous.registry import ModelRegistry
+    from kmeans_tpu.serve import KMeansServer
+
+    c, x = _make_data(k, d, n=64)
+    reg = ModelRegistry()
+    reg.publish(c, trigger="initial")
+    cfg = ServeConfig(
+        host="127.0.0.1", port=0, assign_batching=False, tracing=False,
+        tenant_classes=(("batch", 0, 0.001, FLEET_SHED_BURST),
+                        ("premium", 1, 0.0, 0.0)))
+    server = KMeansServer(cfg, registry=reg)
+    httpd = server.start(background=True)
+    body = json.dumps({"points": x[:4].tolist()}).encode()
+    out = {"requests": 0, "shed_total": 0, "premium_requests": 0,
+           "premium_shed": 0, "retry_after_present": True}
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          httpd.server_address[1],
+                                          timeout=30)
+        for tenant, n, total_key, shed_key in (
+                ("batch", FLEET_SHED_REQUESTS, "requests", "shed_total"),
+                ("premium", int(FLEET_SHED_BURST), "premium_requests",
+                 "premium_shed")):
+            for _ in range(n):
+                conn.request("POST", "/api/assign", body=body,
+                             headers={"Content-Type": "application/json",
+                                      "X-Tenant": tenant})
+                r = conn.getresponse()
+                r.read()
+                out[total_key] += 1
+                if r.status == 503:
+                    out[shed_key] += 1
+                    if r.getheader("Retry-After") is None:
+                        out["retry_after_present"] = False
+    finally:
+        server.stop()
+    return out
+
+
+def run_fleet_phase(args) -> dict:
+    """The ISSUE 16 fleet evidence: single-worker baseline window, then
+    a FLEET_WORKERS window under mid-load hot-swaps, normalized per
+    available core, plus the deterministic shed count."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from kmeans_tpu.continuous.registry import ModelRegistry
+
+    k, d, points = args.k, args.d, args.points
+    cores = os.cpu_count() or 1
+    tmp = tempfile.mkdtemp(prefix="kmeans_fleet_")
+    try:
+        c, _ = _make_data(k, d, n=64, seed=args.seed)
+        ModelRegistry(path=tmp).publish(c, trigger="initial")
+        # BOTH windows run under the same mid-load swap cadence: on an
+        # oversubscribed host (cores < workers) each publish costs N
+        # serialized reloads, and a swap-free baseline would fold that
+        # reload cost into the scaling ratio — the ratio must isolate
+        # the multi-process overhead, not the swap overhead.
+        print(f"[loadgen] fleet baseline: 1 worker under mid-load "
+              f"hot-swaps, {args.duration}s", file=sys.stderr)
+        one = _fleet_window(
+            tmp, workers=1, swap_every=args.swap_every,
+            duration=args.duration, points=points, k=k, d=d,
+            client_procs=2, client_conc=8)
+        print(f"[loadgen] fleet: {FLEET_WORKERS} workers under "
+              f"mid-load hot-swaps, {args.duration}s", file=sys.stderr)
+        many = _fleet_window(
+            tmp, workers=FLEET_WORKERS, swap_every=args.swap_every,
+            duration=args.duration, points=points, k=k, d=d,
+            client_procs=2, client_conc=8)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("[loadgen] fleet: tenant shed phase", file=sys.stderr)
+    shed = _fleet_shed_phase(k, d)
+    qps1 = one["qps"] or 1e-9
+    scaling = round(
+        many["qps"] / (min(FLEET_WORKERS, cores) * qps1), 3)
+    return {
+        "ts": round(time.time(), 3),
+        "workers": FLEET_WORKERS,
+        "cores": cores,
+        "qps_1": one["qps"],
+        "qps_n": many["qps"],
+        "qps_scaling": scaling,
+        "scaling_normalization":
+            "qps_n / (min(workers, cores) * qps_1)",
+        "baseline": one,
+        "fleet": many,
+        "shed": shed,
+    }
+
+
+def fleet_gates(fleet: dict) -> dict:
+    shed = fleet["shed"]
+    return {
+        "fleet_scaling_min": GATE_FLEET_SCALING,
+        "fleet_scaling_ok": fleet["qps_scaling"] >= GATE_FLEET_SCALING,
+        "fleet_dropped": (fleet["baseline"]["dropped"]
+                          + fleet["fleet"]["dropped"]),
+        "fleet_swap_ok": (
+            fleet["fleet"]["dropped"] <= GATE_MAX_DROPPED
+            and fleet["fleet"]["generations_published"] > 0
+            and fleet["fleet"]["consistent"]
+            and fleet["fleet"]["drained_clean"]
+            and fleet["fleet"]["restarts"] == 0),
+        "fleet_shed_ok": (shed["shed_total"] > 0
+                          and shed["premium_shed"] == 0
+                          and shed["retry_after_present"]),
+    }
+
+
 def run_bench(args) -> int:
     """The committed evidence protocol -> BENCH_SERVE_latest.json."""
     k, d, points = args.k, args.d, args.points
@@ -456,6 +706,9 @@ def run_bench(args) -> int:
         reg.generation - gen_before
     server.stop()
 
+    print("[loadgen] fleet phase (ISSUE 16)", file=sys.stderr)
+    record["fleet"] = run_fleet_phase(args)
+
     legacy_qps = record["per_request_legacy"]["qps"] or 1e-9
     cached_qps = record["per_request_cached"]["qps"] or 1e-9
     record["speedup"] = round(record["batched"]["qps"] / legacy_qps, 2)
@@ -479,6 +732,7 @@ def run_bench(args) -> int:
         "binary_swap_ok": (
             record["hot_swap_binary"]["dropped"] <= GATE_MAX_DROPPED
             and record["hot_swap_binary"]["generations_published"] > 0),
+        **fleet_gates(record["fleet"]),
     }
     record["gates"] = gates
     out = args.out or os.path.join(_REPO, "BENCH_SERVE_latest.json")
@@ -498,11 +752,46 @@ def run_bench(args) -> int:
         "binary_speedup": record["binary_speedup"],
         "binary_p99_ms": record["http_binary"]["p99_ms"],
         "binary_swap_dropped": gates["binary_swap_dropped"],
+        "fleet_qps_scaling": record["fleet"]["qps_scaling"],
+        "fleet_shed_total": record["fleet"]["shed"]["shed_total"],
         "artifact": out}))
     if not (gates["speedup_ok"] and gates["swap_ok"]
             and gates["binary_speedup_ok"] and gates["binary_p99_ok"]
-            and gates["binary_swap_ok"]):
+            and gates["binary_swap_ok"] and gates["fleet_scaling_ok"]
+            and gates["fleet_swap_ok"] and gates["fleet_shed_ok"]):
         print(f"[loadgen] GATES FAILED: {gates}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_fleet_only(args) -> int:
+    """``--fleet``: run JUST the fleet phase and merge it into the
+    existing BENCH_SERVE_latest.json (its other phases' measurements —
+    and the artifact's own timestamp — stay as committed; the fleet
+    dict carries its own ``ts``).  The incremental path exists so
+    adding fleet evidence does not force re-measuring every earlier
+    protocol phase on whatever host happens to be running."""
+    out = args.out or os.path.join(_REPO, "BENCH_SERVE_latest.json")
+    record = {}
+    if os.path.exists(out):
+        with open(out) as f:
+            record = json.load(f)
+    record["fleet"] = run_fleet_phase(args)
+    gates = fleet_gates(record["fleet"])
+    record.setdefault("gates", {}).update(gates)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "fleet_qps_scaling": record["fleet"]["qps_scaling"],
+        "fleet_qps_1": record["fleet"]["qps_1"],
+        "fleet_qps_n": record["fleet"]["qps_n"],
+        "fleet_cores": record["fleet"]["cores"],
+        "fleet_shed_total": record["fleet"]["shed"]["shed_total"],
+        "artifact": out}))
+    if not (gates["fleet_scaling_ok"] and gates["fleet_swap_ok"]
+            and gates["fleet_shed_ok"]):
+        print(f"[loadgen] FLEET GATES FAILED: {gates}", file=sys.stderr)
         return 1
     return 0
 
@@ -655,6 +944,10 @@ def main(argv=None) -> int:
     p.add_argument("--bench", action="store_true",
                    help="run the evidence protocol and write "
                         "BENCH_SERVE_latest.json")
+    p.add_argument("--fleet", action="store_true",
+                   help="run only the multi-process fleet phase "
+                        "(ISSUE 16) and merge it into the existing "
+                        "BENCH_SERVE_latest.json")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1-sized acceptance run")
     p.add_argument("--record", nargs="?", const=True, default=None,
@@ -671,6 +964,8 @@ def main(argv=None) -> int:
         return 2
     if args.smoke:
         return run_smoke(args)
+    if args.fleet:
+        return run_fleet_only(args)
     if args.bench:
         return run_bench(args)
 

@@ -37,6 +37,16 @@ Tracked series (direction ``up`` = higher is better):
 * ``serve.open_p99_ms`` / ``serve.open_qps`` — the open-loop loadgen
   SLO smoke (``BENCH_OPEN_latest.json``, written by
   ``tools/loadgen.py --smoke --mode open --record``; ROADMAP 2c);
+* ``serve.fleet_qps_scaling`` / ``serve.shed_total`` — the
+  multi-process fleet phase (ISSUE 16, ``tools/loadgen.py --fleet``):
+  aggregate QPS of ``FLEET_WORKERS`` SO_REUSEPORT workers normalized
+  per available core (``qps_N / (min(N, cores) * qps_1)``), and the
+  deterministic per-tenant shed count; null-seeded from artifacts
+  predating the phase;
+* ``serve.fleet_rto_s`` — the fleet kill drill's recovery time
+  (worker SIGKILLed mid-load → supervisor respawn → replacement READY
+  on the shared port; ``BENCH_SOAK_latest.json``, null-seeded like the
+  engine drill);
 * ``soak.rto_s_max`` — the worst kill/resume recovery time
   (``BENCH_SOAK_latest.json``);
 * ``soak.engine_rto_s`` — the elastic engine drill's recovery time
@@ -205,6 +215,11 @@ def _ingest_serve(root: str) -> List[Entry]:
     # MISSING gate holds them to the group's newest ingest without
     # judging a measurement that never happened.
     binary = rec.get("http_binary") or {}
+    # Same null-seeding for artifacts predating the fleet phase
+    # (ISSUE 16): the per-core scaling efficiency and the deterministic
+    # shed count join the gate without judging history.
+    fleet = rec.get("fleet") or {}
+    shed = fleet.get("shed") or {}
     return [
         Entry("serve.batched_qps", batched.get("qps"),
               unit="req/s", direction="up", **common),
@@ -216,6 +231,10 @@ def _ingest_serve(root: str) -> List[Entry]:
               unit="req/s", direction="up", **common),
         Entry("serve.binary_p99_ms", binary.get("p99_ms"),
               unit="ms", direction="down", **common),
+        Entry("serve.fleet_qps_scaling", fleet.get("qps_scaling"),
+              unit="x", direction="up", **common),
+        Entry("serve.shed_total", shed.get("shed_total"),
+              unit="req", direction="up", **common),
     ]
 
 
@@ -246,6 +265,7 @@ def _ingest_soak(root: str) -> List[Entry]:
     common = dict(group="soak", source="BENCH_SOAK_latest.json",
                   round=None, ts=ts)
     engine = rec.get("engine") or {}
+    fleet = rec.get("fleet") or {}
     return [
         Entry("soak.rto_s_max", max(rtos) if rtos else None,
               unit="s", direction="down", **common),
@@ -255,6 +275,13 @@ def _ingest_soak(root: str) -> List[Entry]:
         # soak.rto_s_max): a full jax restart + resume is a different
         # budget than the continuous pipeline's in-process hot swap.
         Entry("soak.engine_rto_s", engine.get("rto_s"),
+              unit="s", direction="down", **common),
+        # The serving-fleet drill (ISSUE 16): worker SIGKILLed mid-load
+        # → supervisor respawn → replacement READY on the shared port.
+        # A third distinct budget — no jax, no checkpoint restore, just
+        # death detection + backoff + worker boot.  Null-seeded from
+        # artifacts predating the drill.
+        Entry("serve.fleet_rto_s", fleet.get("rto_s"),
               unit="s", direction="down", **common),
     ]
 

@@ -24,6 +24,14 @@ drift-aware serving loop and writes them to ``BENCH_SOAK_latest.json``:
   the resumed fit label-exact against an uninterrupted elastic run, and
   gates checkpoint overhead at ``MAX_ENGINE_OVERHEAD`` of fit wall time
   (the ``soak.engine_rto_s`` series in PERF_HISTORY).
+* **Serving-fleet RTO** (ISSUE 16) — a 2-worker ``FleetSupervisor``
+  fleet under live load has one worker killed at its second heartbeat
+  (``fleet.heartbeat:kill@2``); the drill clocks death ->
+  replacement-READY on the shared port (``serve.fleet_rto_s``, gated at
+  ``FLEET_MAX_RTO_S``), proves the push-based hot-swap reaches the
+  respawned fleet, tolerates only in-flight connection errors, and
+  requires a clean zero-drop drain.  ``--fleet-only`` reruns just this
+  drill and merges the row into the committed artifact.
 
 Run it::
 
@@ -61,6 +69,17 @@ KILL_SITES = ("continuous.refit", "registry.swap", "ckpt.mid_swap")
 #: Engine-drill ceiling: checkpoint time as a fraction of the whole fit
 #: at the default ``ckpt_every`` cadence (ISSUE 14 acceptance gate).
 MAX_ENGINE_OVERHEAD = 0.05
+
+#: Fleet drill ceiling (ISSUE 16): worker SIGKILL mid-load -> replacement
+#: READY on the shared port.  Covers death detection (pipe EOF), the
+#: respawn backoff's first step, and a full worker boot.
+FLEET_MAX_RTO_S = 2.0
+
+#: In-flight error budget for the fleet kill drill: only requests
+#: already accepted by (or sitting in the backlog of) the killed worker
+#: may fail — with the drill's 2 hammer threads that is a handful, not
+#: a flood.  New connections reroute to the surviving listener.
+FLEET_MAX_ERRORS = 5
 
 
 def _stream_args(p) -> list:
@@ -383,6 +402,139 @@ def phase_engine_elastic(ep, workdir: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Phase 2c: serving-fleet drill — SIGKILL a SO_REUSEPORT worker mid-load
+# via the fleet.heartbeat:kill@2 site, clock the supervisor's respawn
+# RTO, prove the push-based hot-swap lands on the respawned fleet, and
+# drain with zero in-flight drops (ISSUE 16; docs/SERVING.md "Fleet").
+# ---------------------------------------------------------------------------
+
+def phase_fleet(workdir: str) -> dict:
+    import numpy as np
+
+    from kmeans_tpu.config import ServeConfig
+    from kmeans_tpu.continuous.registry import ModelRegistry
+    from kmeans_tpu.serve.fleet import FleetSupervisor
+
+    model_dir = os.path.join(workdir, "fleet_model")
+    shutil.rmtree(model_dir, ignore_errors=True)
+    reg = ModelRegistry(path=model_dir)
+    c = np.random.RandomState(5).randn(8, 4).astype("float32")
+    reg.publish(c, trigger="initial")
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cfg = ServeConfig(
+        host="127.0.0.1", port=port, model_dir=model_dir,
+        assign_batching=False, metrics=False, tracing=False,
+        fleet_heartbeat_s=0.25, fleet_backoff_base_s=0.1,
+        fleet_reload_poll_s=0.05)
+    # Slot 1's FIRST incarnation carries the kill plan: it dies at its
+    # second heartbeat (~0.5 s after READY, squarely mid-load); the
+    # replacement the supervisor spawns comes back clean.
+    sup = FleetSupervisor(cfg, workers=2, worker_env={
+        1: {"KMEANS_TPU_FAULTS": "fleet.heartbeat:kill@2"}})
+    row = {"workers": 2, "fault": "fleet.heartbeat:kill@2"}
+    base = f"http://127.0.0.1:{port}"
+    stop = threading.Event()
+    stats = {"requests": 0, "good": 0, "errors": 0, "messages": []}
+    lock = threading.Lock()
+    body = json.dumps({"points": [[0.0] * 4, [1.0] * 4]}).encode()
+
+    def hammer():
+        while not stop.is_set():
+            req = urllib.request.Request(
+                base + "/api/assign", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    json.loads(r.read())
+                with lock:
+                    stats["requests"] += 1
+                    stats["good"] += 1
+            except Exception as e:   # in-flight casualties of the kill
+                with lock:
+                    stats["requests"] += 1
+                    stats["errors"] += 1
+                    if len(stats["messages"]) < 5:
+                        stats["messages"].append(repr(e))
+            # Paced, not closed-loop flood: the drill measures the
+            # SUPERVISOR's recovery, and an unthrottled hammer on a
+            # small host starves the replacement worker's boot of CPU,
+            # measuring scheduler contention instead of respawn time.
+            # ~100 req/s of continuous traffic is still squarely
+            # "mid-load" for the kill.
+            stop.wait(0.02)
+
+    sup.start()
+    threads = []
+    try:
+        if not sup.wait_ready(30.0):
+            row["error"] = f"fleet never ready: {sup.events[-5:]}"
+            return row
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 30
+        exit_ev = ready_ev = None
+        while time.time() < deadline and ready_ev is None:
+            exit_ev = next((e for e in sup.events_of("exit")
+                            if e["slot"] == 1), None)
+            if exit_ev is not None:
+                ready_ev = next(
+                    (e for e in sup.events_of("ready")
+                     if e["slot"] == 1 and e["ts"] > exit_ev["ts"]),
+                    None)
+            time.sleep(0.05)
+        if exit_ev is None or ready_ev is None:
+            row["error"] = (f"kill/respawn did not complete: "
+                            f"{sup.events[-8:]}")
+            return row
+        row["kill_exit"] = exit_ev["returncode"]
+        # RTO: worker death (exit observed) -> replacement READY on the
+        # shared port.  Event timestamps are one monotonic clock.
+        row["rto_s"] = round(ready_ev["ts"] - exit_ev["ts"], 3)
+        # Push-based swap across the respawned fleet: the supervisor's
+        # disk watcher must land the new generation on BOTH workers —
+        # including the replacement, whose pushed_step started at 0.
+        reg.publish(c + 1.0, trigger="drift")
+        deadline = time.time() + 10
+        gens = sup.worker_generations()
+        while (time.time() < deadline
+               and not all(g == reg.generation for g in gens.values())):
+            time.sleep(0.05)
+            gens = sup.worker_generations()
+        row["generation"] = reg.generation
+        row["worker_generations"] = sorted(gens.values())
+        row["gen_consistent"] = all(g == reg.generation
+                                    for g in gens.values())
+        time.sleep(0.5)               # post-recovery traffic window
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        stop.set()
+        clean = sup.stop(graceful=True)
+        for t in threads:
+            t.join(timeout=10)
+    row.update(
+        requests=stats["requests"], good=stats["good"],
+        errors=stats["errors"], error_messages=stats["messages"],
+        drained_clean=clean, restarts=len(sup.events_of("respawn")))
+    row["ok"] = bool(
+        row.get("kill_exit") == 137
+        and row.get("rto_s", 1e9) <= FLEET_MAX_RTO_S
+        and row.get("gen_consistent")
+        and clean
+        and stats["good"] > 0
+        and stats["errors"] <= FLEET_MAX_ERRORS)
+    return row
+
+
+# ---------------------------------------------------------------------------
 # Phase 3: drift recovery — partial refit vs from-scratch on one window
 # ---------------------------------------------------------------------------
 
@@ -462,6 +614,15 @@ def run_soak(p, *, out_path: str, workdir: str) -> dict:
           f"{eng.get('rto_s', '?')}s, exact={eng.get('exact', '?')}, "
           f"ckpt overhead {eng.get('overhead_frac', '?')}",
           file=sys.stderr)
+    print("soak: serving-fleet drill (worker kill@2 mid-load)...",
+          file=sys.stderr)
+    fleet = phase_fleet(workdir)
+    print(f"soak:   fleet: exit {fleet.get('kill_exit')} -> RTO "
+          f"{fleet.get('rto_s', '?')}s, "
+          f"{fleet.get('good', '?')} good / "
+          f"{fleet.get('errors', '?')} in-flight errors, "
+          f"consistent={fleet.get('gen_consistent', '?')}",
+          file=sys.stderr)
     print("soak: drift-recovery phase...", file=sys.stderr)
     drift = phase_drift_recovery(p)
     print(f"soak:   partial {drift['partial_inertia_pp']:.3f} vs scratch "
@@ -480,6 +641,8 @@ def run_soak(p, *, out_path: str, workdir: str) -> dict:
         failures.append(f"sigterm drill: {sigterm.get('error', sigterm)}")
     if not eng.get("ok"):
         failures.append(f"engine drill: {eng.get('error', eng)}")
+    if not fleet.get("ok"):
+        failures.append(f"fleet drill: {fleet.get('error', fleet)}")
     if not drift.get("ok"):
         failures.append(
             f"drift recovery ratio {drift['ratio']} > "
@@ -494,6 +657,7 @@ def run_soak(p, *, out_path: str, workdir: str) -> dict:
         "kill_resume": kills,
         "sigterm": sigterm,
         "engine": eng,
+        "fleet": fleet,
         "drift_recovery": drift,
         "rto_s": {r["site"]: r.get("rto_s") for r in kills},
         "ok": not failures,
@@ -527,6 +691,11 @@ def main(argv=None) -> int:
                                                   "BENCH_SOAK_latest.json"))
     ap.add_argument("--quick", action="store_true",
                     help="CI-sized drill (fewer batches, smaller window)")
+    ap.add_argument("--fleet-only", action="store_true",
+                    help="run just the serving-fleet kill drill and "
+                         "merge its row into the existing artifact "
+                         "(the other phases' committed measurements "
+                         "stay untouched)")
     ap.add_argument("--workdir", default=None,
                     help="scratch directory for the drill's model dirs "
                          "(default: a fresh tempdir, removed after)")
@@ -535,8 +704,31 @@ def main(argv=None) -> int:
     workdir = args.workdir or tempfile.mkdtemp(prefix="kmeans_soak_")
     own_workdir = args.workdir is None
     try:
-        report = run_soak(default_params(args.quick), out_path=args.out,
-                          workdir=workdir)
+        if args.fleet_only:
+            report = {}
+            if os.path.exists(args.out):
+                with open(args.out, encoding="utf-8") as f:
+                    report = json.load(f)
+            print("soak: serving-fleet drill (worker kill@2 mid-load)...",
+                  file=sys.stderr)
+            fleet = phase_fleet(workdir)
+            print(f"soak:   fleet: exit {fleet.get('kill_exit')} -> RTO "
+                  f"{fleet.get('rto_s', '?')}s", file=sys.stderr)
+            report["fleet"] = fleet
+            report.setdefault("failures", [])
+            report["failures"] = [
+                f for f in report["failures"]
+                if not f.startswith("fleet drill")]
+            if not fleet.get("ok"):
+                report["failures"].append(
+                    f"fleet drill: {fleet.get('error', fleet)}")
+            report["ok"] = not report["failures"]
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=2)
+            print(f"soak: wrote {args.out}", file=sys.stderr)
+        else:
+            report = run_soak(default_params(args.quick),
+                              out_path=args.out, workdir=workdir)
     finally:
         if own_workdir:
             shutil.rmtree(workdir, ignore_errors=True)

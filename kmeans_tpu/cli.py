@@ -1046,7 +1046,8 @@ def _cmd_serve(args) -> int:
                                   if args.assign_max_delay_ms is not None
                                   else None),
               assign_max_batch_rows=args.assign_max_batch,
-              assign_max_points=args.assign_max_points)
+              assign_max_points=args.assign_max_points,
+              assign_quant=args.assign_quant)
     except KeyboardInterrupt:
         pass
     except ValueError as e:
@@ -1075,6 +1076,7 @@ def _serve_fleet(args) -> int:
                                else None),
         "assign_max_batch_rows": args.assign_max_batch,
         "assign_max_points": args.assign_max_points,
+        "assign_quant": args.assign_quant,
     }
     try:
         config = ServeConfig(**{k: v for k, v in overrides.items()
@@ -1391,6 +1393,16 @@ def main(argv=None) -> int:
                    metavar="N",
                    help="per-request point cap on POST /api/assign "
                         "(default 4096)")
+    s.add_argument("--assign-quant", choices=("int8", "bf16", "off"),
+                   default=None,
+                   help="compressed-codebook scoring tier for "
+                        "/api/assign (docs/SERVING.md \"Compressed "
+                        "codebook\"): score against a per-centroid-"
+                        "scale quantized codebook with a provably safe "
+                        "error-bounded prune + exact f32 rescore — "
+                        "labels stay exact, the hot loop reads 4-8x "
+                        "fewer bytes (default off; at >=256 MiB f32 "
+                        "slabs the auto policy engages int8 anyway)")
     s.add_argument("--workers", type=int, default=1, metavar="N",
                    help="run N supervised SO_REUSEPORT worker processes "
                         "instead of serving in-process (crashed workers "

@@ -386,6 +386,27 @@ class ServeConfig:
     #: Both routes are exact: the same triangle-inequality certificate
     #: gates both, and failing rows rescore densely.
     assign_pruned_backend: str = "auto"
+    #: Compressed-codebook scoring tier (docs/SERVING.md "Compressed
+    #: codebook"; ``--assign-quant``): ``int8`` / ``bf16`` score each
+    #: batch against a per-centroid-scale quantized codebook
+    #: (:mod:`kmeans_tpu.quant`) whose exported error bounds make the
+    #: candidate prune provably complete, with the exact f32 machinery
+    #: rescoring only the ambiguous survivors — labels stay exactly the
+    #: dense path's while the hot loop reads 4-8x fewer bytes.  ``off``
+    #: (the default) leaves engagement to policy:
+    #: ``assign_pruned_backend="quant"`` opts in at int8, and ``auto``
+    #: engages int8 when the generation's f32 resident slab reaches
+    #: 256 MiB (the codebook-scale regime the tier exists for).  Only
+    #: engages for pruned-prepared models (``assign_prune_min_k``).
+    assign_quant: str = "off"
+    #: Batch-size floor for the quant tier: the host path's dequant
+    #: pass expands each routed group's packed tile once per batch, a
+    #: cost independent of the group's row count, so under this many
+    #: coalesced rows the expansion dominates and the f32 pruned path
+    #: measures strictly faster — small batches route there (labels
+    #: identical either way; both paths are exact).  Lower it only to
+    #: force the tier in tests/smokes with tiny batches.
+    assign_quant_min_rows: int = 512
     #: Bind the listening socket with ``SO_REUSEPORT`` so N fleet worker
     #: processes can share one port and let the kernel load-balance
     #: accepted connections across them (docs/SERVING.md "Fleet").  Off

@@ -537,7 +537,8 @@ def _mib(b: float) -> float:
 def vmem_report(d: int, k: int, *, kernel: str = "classic",
                 block_rows: Optional[int] = None, mc: Optional[int] = None,
                 x_itemsize: int = 2, cd_itemsize: int = 2,
-                k_tile: Optional[int] = None) -> Dict[str, Any]:
+                k_tile: Optional[int] = None,
+                quant: Optional[str] = None) -> Dict[str, Any]:
     """Analytic VMEM preflight for the Pallas Lloyd kernels: *whether* a
     (k, d, block) config fits the budget — by construction the same
     verdict as ``pallas_supported``/``delta_pallas_supported``/
@@ -553,6 +554,13 @@ def vmem_report(d: int, k: int, *, kernel: str = "classic",
     :func:`kmeans_tpu.ops.pallas_lloyd.kernel_plan` dispatches (the one
     function both consult, so preflight and dispatch cannot drift), and
     ``plan`` with that decision (untiled/tiled/refuse + why).
+
+    ``quant`` (``"int8"`` | ``"bf16"``) prices the compressed-codebook
+    serving tier (kmeans_tpu.quant) instead of the f32/bf16 training
+    slab: the codebook terms shrink to the quantized itemsize, a
+    ``quant_sideband`` term appears for the scale/error vectors, and
+    ``plan`` may come back ``"quantized"`` — the compressed codebook
+    resident where the f32 slab would spill.
 
     Imports jax/pallas lazily (this is an obs module); itemsizes default
     to the production bf16 path.
@@ -572,11 +580,11 @@ def vmem_report(d: int, k: int, *, kernel: str = "classic",
     base = {
         "kernel": kernel, "d": d, "k": k, "block_rows": t, "mc": mc_eff,
         "x_itemsize": x_itemsize, "cd_itemsize": cd_itemsize,
-        "k_tile": k_tile, "budget_bytes": budget,
+        "k_tile": k_tile, "quant": quant, "budget_bytes": budget,
     }
     terms = vmem_breakdown(kernel, d=d, k=k, block_rows=t, mc=mc_eff,
                            x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
-                           k_tile=k_tile)
+                           k_tile=k_tile, quant=quant)
     if terms is None:
         return {**base, "supported": False, "terms": None,
                 "total_bytes": None, "headroom_bytes": None,
@@ -592,9 +600,11 @@ def vmem_report(d: int, k: int, *, kernel: str = "classic",
     # The widest tile the TILED kernel could stream here, and the dispatch
     # decision — both from the shared gate module, never recomputed.
     max_k_tile = _max_k_tile(kernel, d, k, block_rows=block_rows, mc=mc,
-                             x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+                             x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
+                             quant=quant)
     plan = kernel_plan(kernel, d, k, block_rows=block_rows, mc=mc,
-                       x_itemsize=x_itemsize, cd_itemsize=cd_itemsize)
+                       x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
+                       quant=quant)
 
     ranked = sorted(terms.items(), key=lambda kv: kv[1], reverse=True)
     top = ", ".join(f"{name} {_mib(b):.1f} MiB" for name, b in ranked[:3])

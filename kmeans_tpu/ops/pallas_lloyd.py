@@ -96,13 +96,21 @@ VMEM_KERNEL_DEFAULTS = {
     "yinyang": (1024, 256),
 }
 
+#: Payload bytes per element of a compressed scoring codebook
+#: (kmeans_tpu.quant) — the ``quant=`` pricing the serve tier plans
+#: with.  Mirrors ``kmeans_tpu.quant.codebook.QUANT_MODES`` (kept as a
+#: literal here so the planner stays importable without the quant
+#: package and vice versa; a parity test pins the two together).
+QUANT_ITEMSIZE = {"int8": 1, "bf16": 2}
+
 
 def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
                    block_rows: Optional[int] = None,
                    mc: Optional[int] = None,
                    x_itemsize: int = 2, cd_itemsize: int = 2,
                    k_tile: Optional[int] = None,
-                   groups: Optional[int] = None):
+                   groups: Optional[int] = None,
+                   quant: Optional[str] = None):
     """Named VMEM byte terms of one kernel's resident+streamed operands.
 
     THE one copy of the footprint arithmetic: the ``*_supported`` gates
@@ -129,6 +137,15 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
     slice is VMEM-resident), the resident per-group drift vectors, and the
     ``(k,)`` group-id map.
 
+    ``quant`` (``"int8"`` | ``"bf16"``) prices the compressed-codebook
+    serving tier (kmeans_tpu.quant): the scoring copy of the codebook —
+    the resident ``centroids_ct`` block, or the tiled path's
+    ``ct_tile_stream`` slices — at :data:`QUANT_ITEMSIZE` bytes per
+    element instead of ``cd_itemsize``, plus a ``quant_sideband`` term
+    for the per-centroid scale / error-bound / cached-norm vectors the
+    tier keeps resident.  At k=65536 × d=2048 this is what turns the
+    512 MiB f32 slab into a 128 MiB int8 one.
+
     Returns an ordered ``{term: bytes}`` dict at the PADDED shapes
     (``padded_d(d)``, ``k`` rounded to the 128 lane), or ``None`` when
     ``d`` is not lane-alignable within the padding cap (the kernel is
@@ -137,6 +154,10 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
     if kind not in VMEM_KERNEL_DEFAULTS:
         raise ValueError(f"unknown kernel kind {kind!r}; "
                          f"have {sorted(VMEM_KERNEL_DEFAULTS)}")
+    if quant is not None and quant not in QUANT_ITEMSIZE:
+        raise ValueError(f"unknown quant mode {quant!r}; "
+                         f"have {sorted(QUANT_ITEMSIZE)}")
+    ct_itemsize = QUANT_ITEMSIZE[quant] if quant else cd_itemsize
     t_def, mc_def = VMEM_KERNEL_DEFAULTS[kind]
     t = block_rows if block_rows is not None else t_def
     mc = mc if mc is not None else mc_def
@@ -152,7 +173,7 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
         kt = _round_up(min(k_tile, k_pad), _LANE)
         terms = {
             # ---- pass A: streamed argmin over (d, kt) centroid slices
-            "ct_tile_stream": 2 * d_eff * kt * cd_itemsize,
+            "ct_tile_stream": 2 * d_eff * kt * ct_itemsize,
             "csq_tile_stream": 2 * kt * 4,
             "x_stream": 2 * t * d_eff * x_itemsize,
             "dist_tile": t * kt * 4,
@@ -171,9 +192,12 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
         if kind == "yinyang":
             terms["glb_tile_stream"] = 2 * 2 * t * g_pad * 4
             terms["group_drift"] = 2 * g_pad * 4 + k_pad * 4
+        if quant:
+            # Double-buffered per-slice scale/err/csq_hat f32 vectors.
+            terms["quant_sideband"] = 2 * 3 * kt * 4
         return terms
     terms = {
-        "centroids_ct": d_eff * k_pad * cd_itemsize,  # resident (d, k) -2x
+        "centroids_ct": d_eff * k_pad * ct_itemsize,  # resident (d, k) -2x
         "sums_acc": k_pad * d_eff * 4,                # resident f32 accum
         "counts_acc": k_pad * 4,
         "x_stream": 2 * t * d_eff * x_itemsize,       # double-buffered rows
@@ -196,16 +220,20 @@ def vmem_breakdown(kind: str = "classic", *, d: int, k: int,
         terms["glb_tile_stream"] = 2 * 2 * t * g_pad * 4
         terms["group_min_tile"] = mc * g_pad * 4
         terms["group_drift"] = 2 * g_pad * 4 + k_pad * 4
+    if quant:
+        # Resident per-centroid scale/err/csq_hat f32 vectors.
+        terms["quant_sideband"] = 3 * k_pad * 4
     return terms
 
 
 def _fits_budget(kind: str, d: int, k: int, *, block_rows, mc,
                  x_itemsize: int, cd_itemsize: int,
                  k_tile: Optional[int] = None,
-                 groups: Optional[int] = None) -> bool:
+                 groups: Optional[int] = None,
+                 quant: Optional[str] = None) -> bool:
     terms = vmem_breakdown(kind, d=d, k=k, block_rows=block_rows, mc=mc,
                            x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
-                           k_tile=k_tile, groups=groups)
+                           k_tile=k_tile, groups=groups, quant=quant)
     return terms is not None and sum(terms.values()) <= _vmem_budget()
 
 
@@ -273,6 +301,8 @@ class KernelPlan(NamedTuple):
     run, not just whether the untiled kernel fits.
 
     ``mode`` is ``"untiled"`` (everything VMEM-resident, the fast path),
+    ``"quantized"`` (only reachable via ``kernel_plan(..., quant=)``:
+    the f32 slab overflows but the compressed codebook stays resident),
     ``"tiled"`` (stream ``k_tile``-wide centroid slices with a running
     argmin carry), or ``"refuse"`` (not even a one-lane tile fits, or
     ``d`` is unalignable).  ``k_tile`` is the lane-multiple slice width
@@ -287,7 +317,8 @@ class KernelPlan(NamedTuple):
 def max_k_tile(kind: str, d: int, k: int, *,
                block_rows: Optional[int] = None, mc: Optional[int] = None,
                x_itemsize: int = 2, cd_itemsize: int = 2,
-               groups: Optional[int] = None) -> Optional[int]:
+               groups: Optional[int] = None,
+               quant: Optional[str] = None) -> Optional[int]:
     """Largest lane-multiple centroid slice whose TILED footprint fits
     the VMEM budget (capped at ``k`` rounded to the lane), or ``None``
     when even a single 128-lane slice overflows — THE one tile-size
@@ -301,7 +332,8 @@ def max_k_tile(kind: str, d: int, k: int, *,
     def fits(lanes: int) -> bool:
         return _fits_budget(kind, d, k, block_rows=block_rows, mc=mc,
                             x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
-                            k_tile=lanes * _LANE, groups=groups)
+                            k_tile=lanes * _LANE, groups=groups,
+                            quant=quant)
 
     hi_l = k_pad // _LANE
     if not fits(1):
@@ -319,13 +351,21 @@ def max_k_tile(kind: str, d: int, k: int, *,
 def kernel_plan(kind: str, d: int, k: int, *,
                 block_rows: Optional[int] = None, mc: Optional[int] = None,
                 x_itemsize: int = 2, cd_itemsize: int = 2,
-                groups: Optional[int] = None) -> KernelPlan:
+                groups: Optional[int] = None,
+                quant: Optional[str] = None) -> KernelPlan:
     """Shape-level dispatch decision for one kernel kind (see
     :class:`KernelPlan`).  Prefers the untiled kernel whenever its
     resident footprint fits (strictly fewer HBM reads: the fold rides
     the argmin's single pass over ``x``); otherwise picks the largest
     tile :func:`max_k_tile` admits; refuses only when ``d`` is
     unalignable or nothing fits.
+
+    With ``quant`` (``"int8"`` | ``"bf16"``) the caller holds a
+    compressed scoring codebook (kmeans_tpu.quant), and the plan gains a
+    rung between untiled-f32 and tiled: ``"quantized"`` — the FULL
+    compressed codebook stays resident where the f32 slab would not fit
+    (priced by ``vmem_breakdown(..., quant=)``); the tiled fallback then
+    streams quantized slices, so its k-tile is correspondingly larger.
 
     The platform / weight-exactness halves of dispatch stay with the
     callers (``ops.lloyd._pallas_plan`` and friends) — this function
@@ -341,14 +381,23 @@ def kernel_plan(kind: str, d: int, k: int, *,
                     groups=groups):
         return KernelPlan("untiled", None,
                           "resident (k, d) footprint fits the VMEM budget")
+    if quant is not None and _fits_budget(
+            kind, d, k, block_rows=block_rows, mc=mc,
+            x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
+            groups=groups, quant=quant):
+        return KernelPlan(
+            "quantized", None,
+            f"f32 resident (k, d) overflows VMEM but the {quant} "
+            "compressed codebook fits resident")
     kt = max_k_tile(kind, d, k, block_rows=block_rows, mc=mc,
                     x_itemsize=x_itemsize, cd_itemsize=cd_itemsize,
-                    groups=groups)
+                    groups=groups, quant=quant)
     if kt is not None:
+        stream = f"{quant} " if quant else ""
         return KernelPlan(
             "tiled", kt,
-            f"resident (k, d) overflows VMEM; stream {kt}-wide centroid "
-            "slices with a running argmin carry")
+            f"resident (k, d) overflows VMEM; stream {kt}-wide {stream}"
+            "centroid slices with a running argmin carry")
     return KernelPlan(
         "refuse", None,
         "even a single 128-lane centroid slice exceeds the VMEM budget "

@@ -1,0 +1,64 @@
+"""Compressed-codebook subsystem: quantized candidate scoring with an
+exact f32 rescore (docs/SERVING.md "Compressed codebook").
+
+At codebook scale (k=65536, d=2048) the f32 codebook is a 512 MiB
+resident slab — the serve kernels stream every byte of it per batch and
+the VMEM plans spill.  This package compresses the *scoring* copy of
+the codebook (per-centroid-scale symmetric int8, or bf16 truncation)
+and makes the compression **provably safe** instead of heuristic: each
+centroid exports an upper bound on its quantization error
+``err_j >= ||c_j - dequant(c_j)||``, and by the triangle inequality
+
+    | ||x - c_j|| - ||x - c_hat_j|| |  <=  ||c_j - c_hat_j||  <=  err_j
+
+so the true distance to every centroid lives in the interval
+``[d_hat_j - err_j, d_hat_j + err_j]`` around the quantized distance.
+A row's candidate set — everything whose lower bound does not exceed
+the smallest upper bound — therefore *provably contains the true
+argmin*, and the exact f32 machinery only rescores those survivors.
+Serving stays bit-exact-by-certificate while the hot loop reads 4-8x
+fewer bytes.
+
+Layout:
+
+* :mod:`kmeans_tpu.quant.codebook` — ``quantize_codebook`` /
+  ``dequantize`` and the :class:`QuantizedCodebook` container (pure
+  NumPy: building a quantized tier must not require a jax runtime —
+  the serve layer's PreparedModel builds on the hot-swap path).
+* :mod:`kmeans_tpu.quant.score` — the error-bounded pruning scorers:
+  the host candidate pruner the serve engine's grouped path composes
+  with, and the jax formulation behind the device-resident quantized
+  kernel (jax imported lazily, inside the builder, like every serve
+  kernel).
+
+The serve integration lives in :mod:`kmeans_tpu.serve.assign`
+(``ServeConfig.assign_quant``, ``assign_pruned_backend="quant"``); the
+VMEM pricing of the quantized tier lives in
+:func:`kmeans_tpu.ops.pallas_lloyd.vmem_breakdown` (``quant=`` kwarg).
+"""
+
+from kmeans_tpu.quant.codebook import (
+    QUANT_MODES,
+    QuantizedCodebook,
+    dequantize,
+    dequantize_matrix,
+    quantize_codebook,
+)
+from kmeans_tpu.quant.score import (
+    QUANT_MARGIN_REL,
+    quant_assign_device,
+    quant_candidates,
+    quant_prune,
+)
+
+__all__ = [
+    "QUANT_MODES",
+    "QUANT_MARGIN_REL",
+    "QuantizedCodebook",
+    "dequantize",
+    "dequantize_matrix",
+    "quantize_codebook",
+    "quant_assign_device",
+    "quant_candidates",
+    "quant_prune",
+]

@@ -1,0 +1,208 @@
+"""Error-bounded pruning over a quantized codebook.
+
+Both scorers here turn :class:`~kmeans_tpu.quant.codebook.
+QuantizedCodebook.err` into a *provably complete* candidate set via the
+triangle inequality: with ``dhat_j = ||x - c_hat_j||`` and
+``err_j >= ||c_j - c_hat_j||``, the true distance satisfies
+
+    dhat_j - err_j  <=  ||x - c_j||  <=  dhat_j + err_j
+
+so every centroid whose lower bound exceeds ``b = min_j upper_j`` is
+provably not the argmin, and the argmin itself always survives (its
+lower bound never exceeds its own upper bound, which is >= b only if it
+IS the min — and ``b``'s owner trivially survives).  f32 evaluation
+slop is absorbed by the same relative-margin discipline the rest of the
+repo uses (``assign._CERT_MARGIN_REL``, ``hamerly.HAMERLY_MARGIN_REL``):
+both bounds are slackened by ``margin_rel * (dhat + 1)``, orders of
+magnitude beyond f32 rounding on these expressions.
+
+:func:`quant_prune` is the host tier — pure NumPy, composed by the
+serve engine's grouped-BLAS path, with the exact f32 rescore of the
+ambiguous survivors inlined.  :func:`quant_assign_device` is the device
+tier — a k-tiled jax formulation mirroring the dense kernel's
+strict-< scan merges; jax is imported inside, like every serve kernel
+body, so importing this module never drags in a runtime.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["QUANT_MARGIN_REL", "quant_candidates", "quant_prune",
+           "quant_assign_device"]
+
+#: Relative soundness slack folded into both quantized distance bounds,
+#: matching the certificate margins in `serve.assign` and
+#: `ops.hamerly` — covers f32 evaluation error, which the per-centroid
+#: `err` (an exact-arithmetic bound) does not.
+QUANT_MARGIN_REL = 1e-3
+
+# Elementwise-gather budget for the exact-rescore centroid gather
+# (rows x survivors x d), mirroring assign._DEV_GATHER_ELEMS in spirit:
+# bounds the f32 scratch of one rescore chunk to ~16 MiB.
+_RESCORE_ELEMS = 1 << 22
+
+_IDX_INF = np.iinfo(np.int64).max
+
+
+def quant_candidates(dhat, err, *, margin_rel=QUANT_MARGIN_REL):
+    """Candidate mask from quantized distances + error bounds.
+
+    ``dhat``: ``(B, m)`` f32 quantized distances; ``err``: ``(B, m)``
+    (or broadcastable) f32 per-centroid bounds.  Returns ``(keep, iup,
+    b)``: the ``(B, m)`` bool survivor mask, the per-row argmin of the
+    upper bound (first-min, i.e. lowest column on exact ties — the
+    provable label when only one candidate survives), and the ``(B,)``
+    min upper bound itself.
+    """
+    slack = margin_rel * (dhat + np.float32(1.0))
+    upper = dhat + err + slack
+    lower = dhat - err - slack
+    iup = upper.argmin(axis=1)
+    b = np.take_along_axis(upper, iup[:, None], axis=1)[:, 0]
+    keep = lower <= b[:, None]
+    return keep, iup, b
+
+
+def quant_prune(x, xsq, s, err_cand, cand_rows, centroids, csq, *,
+                margin_rel=QUANT_MARGIN_REL,
+                rescore_elems=_RESCORE_ELEMS):
+    """Prune one routed batch against quantized scores, then rescore the
+    ambiguous survivors exactly in f32.
+
+    Inputs (all f32 unless noted): ``x`` ``(B, d)`` rows, ``xsq``
+    ``(B,)`` their squared norms, ``s`` ``(B, m)`` quantized score
+    offsets such that ``dhat^2 = xsq + s`` (i.e. ``csq_hat - 2 x.c_hat``,
+    as produced by the grouped GEMM), ``err_cand`` ``(B, m)`` the
+    per-candidate error bounds, ``cand_rows`` ``(B, m)`` int global
+    centroid ids aligned with ``s``'s columns, and the exact f32
+    ``centroids``/``csq`` for the rescore.
+
+    Returns ``(labels, se_best, n_cand, n_rescore)``: int64 global
+    labels; the exact f32 score offset of each chosen centroid
+    (``csq[label] - 2 x.c_label``, so callers recover the certified
+    distance as ``sqrt(max(xsq + se_best, 0))``); the ``(B,)`` survivor
+    counts; and how many rows needed the exact rescore.
+    """
+    n_rows = s.shape[0]
+    dhat = np.sqrt(np.maximum(xsq[:, None] + s, np.float32(0.0)))
+    keep, iup, _b = quant_candidates(dhat, err_cand, margin_rel=margin_rel)
+    n_cand = keep.sum(axis=1)
+    labels = cand_rows[np.arange(n_rows), iup].astype(np.int64)
+    amb = np.flatnonzero(n_cand > 1)
+    if amb.size:
+        # Padded gather over survivors only: survivors are compacted to
+        # the left (stable argsort of ~keep preserves candidate order,
+        # keeping the lowest-index tie-break exact), chunked so the
+        # (rows, R, d) centroid gather stays within the scratch budget.
+        keep_a = keep[amb]
+        r_max = int(keep_a.sum(axis=1).max())
+        pos = np.argsort(~keep_a, axis=1, kind="stable")[:, :r_max]
+        taken = np.take_along_axis(keep_a, pos, axis=1)
+        cidx = np.take_along_axis(cand_rows[amb], pos, axis=1)
+        d = centroids.shape[1]
+        step = max(1, int(rescore_elems) // max(1, r_max * d))
+        for i0 in range(0, amb.size, step):
+            i1 = min(amb.size, i0 + step)
+            rows = amb[i0:i1]
+            ci = cidx[i0:i1]
+            cg = centroids[ci]
+            se = csq[ci] - 2.0 * np.einsum(
+                "ad,ard->ar", x[rows], cg).astype(np.float32)
+            se[~taken[i0:i1]] = np.inf
+            # Exact lowest-centroid-id tie-break, independent of the
+            # survivor packing order.  ci must be widened BEFORE the
+            # where: under NEP 50 an int32 ci would pull the int64-max
+            # sentinel down to int32 (wrapping to -1, which then wins
+            # every min).
+            tied = se == se.min(axis=1, keepdims=True)
+            labels[rows] = np.where(tied, ci.astype(np.int64),
+                                    _IDX_INF).min(axis=1)
+    cbest = centroids[labels]
+    se_best = (csq[labels]
+               - 2.0 * np.einsum("bd,bd->b", x, cbest).astype(np.float32))
+    return labels, se_best.astype(np.float32), n_cand, int(amb.size)
+
+
+def quant_assign_device(x, q, scale, err, csq_hat, mode, *, k_tile=None,
+                        margin_rel=QUANT_MARGIN_REL):
+    """Device-resident quantized assign: k-tiled scan over the packed
+    codebook, labelling each row with its argmin *upper* bound and
+    certifying rows where no other centroid's lower bound can beat it.
+
+    Returns ``(labels, ok)``; ``ok=False`` rows are ambiguous under the
+    quantization error bound and must be rescored exactly by the caller
+    (the serve engine routes them through its dense fallback).  Tile
+    merges use the same strict-< first-occurrence discipline as the
+    dense serve kernel, so the argmin-upper label is the lowest global
+    id among exact ties.
+
+    jax is imported here, not at module scope — callers jit this via an
+    observed builder (``serve.assign._build_quant_dev``).
+    """
+    import jax.numpy as jnp
+
+    k, d = int(q.shape[0]), int(q.shape[1])
+    kt = int(k_tile) if k_tile else k
+    kt = max(1, min(kt, k))
+    n_t = -(-k // kt)
+    pad = n_t * kt - k
+    qp = jnp.pad(q, ((0, pad), (0, 0))).reshape(n_t, kt, d)
+    sp = jnp.pad(scale, (0, pad)).reshape(n_t, kt)
+    ep = jnp.pad(err, (0, pad)).reshape(n_t, kt)
+    cp = jnp.pad(csq_hat, (0, pad)).reshape(n_t, kt)
+    offs = (jnp.arange(n_t, dtype=jnp.int32) * kt)
+
+    xf = x.astype(jnp.float32)
+    xsq = jnp.sum(xf * xf, axis=1)
+    rows = xf.shape[0]
+    inf = jnp.float32(jnp.inf)
+    mrel = jnp.float32(margin_rel)
+    local = jnp.arange(kt, dtype=jnp.int32)
+
+    def tile(carry, inp):
+        b_up, lab, l1, i1, l2 = carry
+        qt, st, et, ct, off = inp
+        if mode == "bf16":
+            import jax.lax as lax
+            qf = lax.bitcast_convert_type(
+                jnp.left_shift(qt.astype(jnp.uint32), 16), jnp.float32)
+        else:
+            qf = qt.astype(jnp.float32)
+        prod = xf @ qf.T
+        sq = ct[None, :] - 2.0 * prod * st[None, :]
+        dhat = jnp.sqrt(jnp.maximum(xsq[:, None] + sq, 0.0))
+        slack = mrel * (dhat + 1.0)
+        valid = (off + local) < k
+        up = jnp.where(valid[None, :], dhat + et[None, :] + slack, inf)
+        lo = jnp.where(valid[None, :], dhat - et[None, :] - slack, inf)
+        # Tile-local reductions (argmin = first occurrence, preserving
+        # the lowest-global-id tie-break across in-order tiles).
+        t_ui = jnp.argmin(up, axis=1).astype(jnp.int32)
+        t_up = jnp.take_along_axis(up, t_ui[:, None], axis=1)[:, 0]
+        t_i1 = jnp.argmin(lo, axis=1).astype(jnp.int32)
+        t_l1 = jnp.take_along_axis(lo, t_i1[:, None], axis=1)[:, 0]
+        t_l2 = jnp.min(
+            jnp.where(local[None, :] == t_i1[:, None], inf, lo), axis=1)
+        take = t_up < b_up
+        b_up = jnp.where(take, t_up, b_up)
+        lab = jnp.where(take, off + t_ui, lab)
+        # Merge the two smallest lower bounds of both sides: second-
+        # smallest of {l1, l2, t_l1, t_l2} = min(max(l1, t_l1), l2, t_l2)
+        # because each side's pair is already ordered.
+        g_i1 = jnp.where(t_l1 < l1, off + t_i1, i1)
+        g_l2 = jnp.minimum(jnp.maximum(l1, t_l1), jnp.minimum(l2, t_l2))
+        g_l1 = jnp.minimum(l1, t_l1)
+        return (b_up, lab, g_l1, g_i1, g_l2), None
+
+    import jax.lax as lax
+    init = (jnp.full((rows,), inf),
+            jnp.zeros((rows,), jnp.int32),
+            jnp.full((rows,), inf),
+            jnp.full((rows,), -1, jnp.int32),
+            jnp.full((rows,), inf))
+    (b_up, lab, l1, i1, l2), _ = lax.scan(
+        tile, init, (qp, sp, ep, cp, offs))
+    l_excl = jnp.where(i1 == lab, l2, l1)
+    ok = l_excl > b_up
+    return lab, ok

@@ -46,6 +46,16 @@ throughput without touching the hot-swap contract:
   the measured-faster host path and routes to the device kernel only
   when a live jax runtime reports a non-CPU backend — a TPU serve
   process keeps the batch on-device.
+* **Compressed-codebook tier** — at codebook scale the f32 slab itself
+  is the bottleneck (k=65536 × d=2048 = 512 MiB read per batch), so
+  ``ServeConfig.assign_quant`` (or ``assign_pruned_backend="quant"``,
+  or the auto-policy at ≥256 MiB slabs) scores against a per-centroid-
+  scale int8/bf16 codebook (:mod:`kmeans_tpu.quant`) whose exported
+  error bounds make the prune *provably* complete; the exact f32
+  machinery rescores only the ambiguous survivors, and the same
+  closure certificate covers candidate completeness — labels stay
+  exactly the dense path's, 4-8× cheaper in bytes read
+  (docs/SERVING.md "Compressed codebook").
 * **Binary wire protocol** — the zero-copy framing for
   ``POST /api/assign`` (``Content-Type: application/x-kmeans-points``;
   docs/SERVING.md has the byte layout).  JSON float parsing dominated
@@ -78,6 +88,7 @@ import numpy as np
 
 from kmeans_tpu import obs
 from kmeans_tpu.obs import tracing as _tracing
+from kmeans_tpu.quant import (QUANT_MODES, dequantize_matrix, quant_prune)
 
 __all__ = [
     "AssignEngine",
@@ -128,7 +139,8 @@ _BATCH_ROWS = obs.histogram(
 _BATCHES_TOTAL = obs.counter(
     "kmeans_tpu_assign_batches_total",
     "Micro-batches dispatched, by kernel kind (pruned = closure-"
-    "candidate scoring; dense = all-k scoring)",
+    "candidate scoring; dense = all-k scoring; quant = compressed-"
+    "codebook scoring with exact rescore)",
     labels=("kernel",),
 )
 _SHAPE_CACHE_TOTAL = obs.counter(
@@ -144,6 +156,29 @@ _FALLBACK_ROWS_TOTAL = obs.counter(
     "Rows whose closure-pruning exactness certificate failed and were "
     "rescored by the dense kernel (pruning stays exact; this counts "
     "what it cost)",
+)
+_QUANT_REQUESTS_TOTAL = obs.counter(
+    "kmeans_tpu_assign_quant_requests_total",
+    "POST /api/assign requests answered through the compressed-codebook "
+    "scoring tier, by quantization tier (tier = int8 | bf16; docs/"
+    "SERVING.md \"Compressed codebook\")",
+    labels=("tier",),
+)
+_QUANT_CANDIDATES = obs.histogram(
+    "kmeans_tpu_assign_quant_candidates",
+    "Per-batch mean survivor fraction of the error-bounded quantized "
+    "prune (surviving candidates / candidates scored; host tier — the "
+    "device tier certifies rows without materializing counts).  Near 0 "
+    "= the quantized bounds are tight and almost every row resolves "
+    "without an exact rescore",
+    buckets=(0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0),
+)
+_QUANT_RESCORE_ROWS_TOTAL = obs.counter(
+    "kmeans_tpu_assign_quant_rescore_rows_total",
+    "Rows whose quantized candidate set stayed ambiguous and were "
+    "rescored by the exact f32 machinery (the survivors-only gather on "
+    "the host tier, the dense rescue on the device tier) — the price "
+    "of compression; labels stay exact either way",
 )
 WIRE_REQUESTS_TOTAL = obs.counter(
     "kmeans_tpu_assign_wire_requests_total",
@@ -164,6 +199,23 @@ WIRE_BYTES_TOTAL = obs.counter(
 #: is ~1e-6·d relative; 1e-3 follows the same two-orders-of-magnitude
 #: soundness discipline as ops.hamerly.HAMERLY_MARGIN_REL.
 _CERT_MARGIN_REL = 1e-3
+
+#: Auto-policy threshold for the compressed-codebook tier
+#: (``assign_pruned_backend="auto"`` / ``assign_quant="off"``): when the
+#: f32 resident codebook (k·d·4 bytes) reaches this size, scoring
+#: against it is memory-bound enough that the int8 tier wins on every
+#: backend — 256 MiB is half the codebook-scale slab that motivated the
+#: subsystem (k=65536 × d=2048 = 512 MiB) and far beyond any L3.
+_QUANT_AUTO_SLAB_BYTES = 1 << 28
+
+#: Batch-size floor for the quant tier: the host path's per-batch
+#: dequant pass expands every routed group's packed ``(d, m)`` tile
+#: exactly once regardless of the group's row count, so a near-empty
+#: batch pays the full expansion for a sliver of GEMM — measured at
+#: k=16384 × d=512, sub-512-row batches erase the tier's ~1.4x win.
+#: Batches below the floor take the f32 pruned path (same labels: both
+#: are exact).  Default of ``ServeConfig.assign_quant_min_rows``.
+_QUANT_MIN_ROWS = 512
 
 
 class NoModelError(RuntimeError):
@@ -427,6 +479,41 @@ def _build_pruned_dev(rows: int, k: int, d: int, g_n: int, m: int):
                              name="serve.assign_pruned_dev")
 
 
+@functools.lru_cache(maxsize=64)
+def _build_quant_dev(rows: int, k: int, d: int, mode: str):
+    """Jitted device-resident quantized scoring kernel for one padded
+    batch shape: the k-tiled bound scan over the packed int8/bf16
+    codebook (:func:`kmeans_tpu.quant.score.quant_assign_device`).  The
+    k-tile comes from the shared VMEM planner priced at the QUANTIZED
+    itemsize (``kernel_plan(..., quant=mode)``) — the whole point of the
+    tier is that the plan can keep the codebook resident where the f32
+    slab would spill or refuse."""
+    import jax
+
+    from kmeans_tpu.ops.pallas_lloyd import kernel_plan
+    from kmeans_tpu.quant.score import QUANT_MARGIN_REL, quant_assign_device
+
+    plan = kernel_plan("classic", d, k, x_itemsize=4, cd_itemsize=4,
+                       quant=mode)
+    k_tile = plan.k_tile if plan.mode == "tiled" else None
+    if plan.mode == "refuse":
+        # Even the quantized stream exceeds the modeled budget: stream a
+        # lane-multiple tile anyway (the scan is correct at any tile;
+        # the budget is advisory off-chip, and a refused shape must not
+        # brick serving).
+        k_tile = 4096
+
+    def kernel(x, q, scale, err, csqh):
+        return quant_assign_device(x, q, scale, err, csqh, mode,
+                                   k_tile=k_tile,
+                                   margin_rel=QUANT_MARGIN_REL)
+
+    from kmeans_tpu.obs import costmodel
+
+    return costmodel.observe(jax.jit(kernel),
+                             name="serve.assign_quant_dev")
+
+
 def _score_groups(xs, bounds, prep, s_out, g_lo, g_hi):
     """GEMM the rows routed to groups ``[g_lo, g_hi)`` — one contiguous
     ``(rows_g, d) @ (d, m)`` BLAS product per non-empty group, writing
@@ -505,6 +592,78 @@ def _pruned_host(x: np.ndarray, prep: "PreparedModel", pool=None,
     return labels, ok
 
 
+def _score_groups_quant(xs, bounds, tier, s_out, g_lo, g_hi):
+    """The quantized twin of :func:`_score_groups`: per non-empty group,
+    expand that group's packed ``(d, m)`` candidate payload into one
+    reusable f32 scratch tile (a cast/shift — the per-centroid scale
+    folds into the vectorized elementwise pass outside this loop), then
+    the same contiguous BLAS product.  The slab this loop actually
+    *reads* is 1/4 (int8) or 1/2 (bf16) the f32 candidate matrices —
+    the compression win on a memory-bound host."""
+    scratch = np.empty(tier.cand_q.shape[1:], np.float32)
+    for gg in range(g_lo, g_hi):
+        lo, hi = bounds[gg], bounds[gg + 1]
+        if lo == hi:
+            continue
+        qf = dequantize_matrix(tier.cand_q[gg], tier.mode, out=scratch)
+        np.matmul(xs[lo:hi], qf, out=s_out[lo:hi])
+
+
+def _quant_host(x: np.ndarray, prep: "PreparedModel", tier, pool=None,
+                chunks: int = 1):
+    """Quantized closure-pruned labels on the host: the grouped-BLAS
+    routing of :func:`_pruned_host`, but the candidate GEMM reads the
+    compressed codebook and the argmin is resolved by the error-bounded
+    prune + exact f32 rescore of :func:`kmeans_tpu.quant.score.
+    quant_prune` (provably exact — see that module's safety argument).
+
+    Two nested guarantees: the quantization error bound proves the
+    chosen label optimal *among the group's candidate list*, and the
+    closure certificate (identical to the f32 pruned path) proves the
+    candidate list complete among all k — rows failing it rescore
+    densely in the engine, exactly like the f32 path.
+
+    Returns ``(labels, ok, n_cand_sum, n_rescore)``: int32 labels, the
+    closure certificate, total surviving candidates (for the survivor-
+    fraction histogram), and rows that needed the exact rescore."""
+    n = x.shape[0]
+    g_n = prep.gc.shape[0]
+    sg = x @ prep.gc2                                          # (B, G)
+    sg += prep.gsq[None, :]
+    g = sg.argmin(axis=1)
+    order = np.argsort(g, kind="stable")
+    xs = x[order]
+    gso = g[order]
+    bounds = np.searchsorted(gso, np.arange(g_n + 1))
+    s = np.empty((n, prep.m), np.float32)
+    if pool is not None and chunks > 1 and n >= 256:
+        ranges = _group_splits(bounds, g_n, chunks)
+        futs = [pool.submit(_score_groups_quant, xs, bounds, tier, s,
+                            lo, hi)
+                for lo, hi in ranges[1:]]
+        _score_groups_quant(xs, bounds, tier, s, *ranges[0])
+        for f in futs:
+            f.result()
+    else:
+        _score_groups_quant(xs, bounds, tier, s, 0, g_n)
+    s *= tier.scale2_cand[gso]
+    s += tier.csqh_cand[gso]
+    xsq = np.einsum("bd,bd->b", xs, xs)
+    labels_s, se_best, n_cand, n_rescore = quant_prune(
+        xs, xsq, s, tier.err_cand[gso], prep.cand[gso],
+        prep.gen.centroids, prep.csq)
+    dg = np.sqrt(np.maximum(
+        xsq + np.take_along_axis(sg[order], gso[:, None], axis=1)[:, 0],
+        0.0))
+    b = np.sqrt(np.maximum(xsq + se_best, 0.0))
+    ok_s = b + _CERT_MARGIN_REL * (b + dg + 1.0) <= prep.thr[gso] - dg
+    labels = np.empty(n, np.int32)
+    ok = np.empty(n, bool)
+    labels[order] = labels_s.astype(np.int32)
+    ok[order] = ok_s
+    return labels, ok, int(n_cand.sum()), n_rescore
+
+
 def assign_direct(gen, x: np.ndarray) -> np.ndarray:
     """The per-request NumPy path (``assign_batching=False``, and the
     loadgen baseline): one immutable generation, squared norms cached on
@@ -514,6 +673,53 @@ def assign_direct(gen, x: np.ndarray) -> np.ndarray:
     d2 = ((x * x).sum(1)[:, None] - 2.0 * (x @ c.T)
           + gen.sq_norms()[None, :])
     return d2.argmin(1)
+
+
+class _QuantTier:
+    """The compressed scoring tier of ONE prepared generation: the
+    quantized codebook plus its per-group candidate packs for the
+    grouped GEMM — built lazily on the first quant-routed batch after a
+    publish (same build-once dispatcher-thread contract as
+    :meth:`PreparedModel.dense_dev`), so hot-swap keeps paying the
+    closure-table cost eagerly and the quantization cost only if the
+    tier is actually routed to."""
+
+    __slots__ = ("mode", "qcb", "cand_q", "scale2_cand", "csqh_cand",
+                 "err_cand", "_qdev")
+
+    def __init__(self, prep: "PreparedModel", mode: str):
+        from kmeans_tpu.quant import quantize_codebook
+
+        self.mode = mode
+        self.qcb = quantize_codebook(prep.gen.centroids, mode)
+        self._qdev = None
+        if prep.pruned:
+            cand, q = prep.cand, self.qcb.q
+            # Packed (G, d, m) payload tiles, the compressed twin of
+            # PreparedModel.cand_mats2.  The -2x cannot fold into an
+            # integer payload, so -2·scale folds into the per-candidate
+            # elementwise pass instead (uniform -2 for bf16).
+            self.cand_q = np.stack([
+                np.ascontiguousarray(q[cand[g]].T)
+                for g in range(prep.g_n)])
+            self.scale2_cand = np.ascontiguousarray(
+                (-2.0 * self.qcb.scale.astype(np.float64))
+                .astype(np.float32)[cand])
+            self.csqh_cand = self.qcb.csq_hat[cand]
+            self.err_cand = self.qcb.err[cand]
+
+    def device(self):
+        """The full packed codebook on device for the k-tiled quantized
+        kernel — ``(q, scale, err, csq_hat)``, transferred once per
+        generation (lazy build-once, dispatcher thread only)."""
+        if self._qdev is None:
+            import jax.numpy as jnp
+
+            self._qdev = (jnp.asarray(self.qcb.q),
+                          jnp.asarray(self.qcb.scale),
+                          jnp.asarray(self.qcb.err),
+                          jnp.asarray(self.qcb.csq_hat))
+        return self._qdev
 
 
 class PreparedModel:
@@ -532,7 +738,7 @@ class PreparedModel:
 
     __slots__ = ("gen", "k", "d", "csq", "pruned", "g_n", "m",
                  "gc", "gc2", "gsq", "cand", "csq_cand", "thr",
-                 "cand_mats2", "_dev", "_pdev")
+                 "cand_mats2", "_dev", "_pdev", "_quant")
 
     def __init__(self, gen, *, prune_min_k: int = 256):
         self.gen = gen
@@ -540,6 +746,7 @@ class PreparedModel:
         self.csq = gen.sq_norms()
         self._dev = None
         self._pdev = None
+        self._quant = None
         self.pruned = bool(prune_min_k) and gen.k >= int(prune_min_k)
         if self.pruned:
             from kmeans_tpu.ops.hamerly import closure_candidates
@@ -585,6 +792,16 @@ class PreparedModel:
                           jnp.asarray(self.thr),
                           jnp.asarray(self.gen.centroids))
         return self._pdev
+
+    def quant_tier(self, mode: str) -> _QuantTier:
+        """The compressed scoring tier in ``mode`` — built on first use
+        after a publish, cached for the generation's serving lifetime
+        (one mode is live at a time; a config flip rebuilds once)."""
+        tier = self._quant
+        if tier is None or tier.mode != mode:
+            tier = _QuantTier(self, mode)
+            self._quant = tier
+        return tier
 
 
 class _Pending:
@@ -662,6 +879,8 @@ class AssignEngine:
         self._n_rows = 0
         self._n_requests = 0
         self._n_fallback_rows = 0
+        self._n_quant_batches = 0
+        self._n_quant_rescore_rows = 0
         self._shape_hits = 0
         self._shape_misses = 0
         self._bucket_counts: collections.Counter = collections.Counter()
@@ -804,6 +1023,8 @@ class AssignEngine:
             "requests": self._n_requests,
             "rows": self._n_rows,
             "fallback_rows": self._n_fallback_rows,
+            "quant_batches": self._n_quant_batches,
+            "quant_rescore_rows": self._n_quant_rescore_rows,
             "shape_cache_hits": self._shape_hits,
             "shape_cache_misses": self._shape_misses,
             "batch_rows_pow2": dict(self._bucket_counts),
@@ -948,6 +1169,46 @@ class AssignEngine:
             self._pruned_route_cached = route
         return route
 
+    def _quant_mode(self, prep: PreparedModel,
+                    rows: Optional[int] = None) -> Optional[str]:
+        """``int8`` | ``bf16`` | None — whether this batch scores
+        through the compressed-codebook tier.  ``ServeConfig.
+        assign_quant`` forces a mode; ``assign_pruned_backend="quant"``
+        opts in at the default int8; otherwise the auto-policy engages
+        int8 exactly when the generation's f32 resident slab reaches
+        ``_QUANT_AUTO_SLAB_BYTES`` — the regime the subsystem exists
+        for.  The tier composes with the closure tables (its host path
+        prunes *within* each group's candidate list), so it only
+        engages for pruned-prepared models; below ``assign_prune_min_k``
+        the f32 slab is small enough that quantization is pure
+        overhead.
+
+        ``rows`` gates by batch size: the host tier's dequant pass
+        expands each routed group's packed tile once per batch, a cost
+        independent of how many rows land in the group — under
+        ``_QUANT_MIN_ROWS`` the expansion dominates the GEMM it feeds
+        and the f32 pruned path measures strictly faster, so small
+        batches (including every forced-mode one) route there."""
+        if not prep.pruned:
+            return None
+        if rows is not None and rows < int(getattr(
+                self.cfg, "assign_quant_min_rows", _QUANT_MIN_ROWS)):
+            return None
+        mode = str(getattr(self.cfg, "assign_quant", "off")).lower()
+        if mode in QUANT_MODES:
+            return mode
+        if mode not in ("off", ""):
+            raise ValueError(
+                f"assign_quant={mode!r}: expected int8 | bf16 | off")
+        backend = str(getattr(self.cfg, "assign_pruned_backend",
+                              "auto")).lower()
+        if backend == "quant":
+            return "int8"
+        if (backend == "auto"
+                and prep.k * prep.d * 4 >= _QUANT_AUTO_SLAB_BYTES):
+            return "int8"
+        return None
+
     def _pad(self, x: np.ndarray, bucket: int) -> np.ndarray:
         if x.shape[0] == bucket:
             return x
@@ -989,7 +1250,11 @@ class AssignEngine:
         _QUEUE_DELAY_SECONDS.observe(
             t_disp - min(p.t_enq for p in good))
         prep = self._prepared(gen)
-        kind = "pruned" if prep.pruned else "dense"
+        qmode = self._quant_mode(prep, rows)
+        kind = ("quant" if qmode
+                else "pruned" if prep.pruned else "dense")
+        if qmode:
+            _QUANT_REQUESTS_TOTAL.labels(tier=qmode).inc(len(good))
         # The batch span chains into the FIRST request's trace, so one
         # trace shows the whole request -> queue -> batch -> kernel
         # path; the request count rides as an attr.
@@ -1000,7 +1265,7 @@ class AssignEngine:
                               kernel=kind, generation=gen.generation):
             x = (good[0].points if len(good) == 1
                  else np.concatenate([p.points for p in good]))
-            labels = self._run_kernel(kind, prep, x, rows)
+            labels = self._run_kernel(kind, prep, x, rows, qmode=qmode)
         t_done = time.perf_counter()
         with self._stats_lock:
             if self._last_dispatch_ts is not None:
@@ -1031,9 +1296,12 @@ class AssignEngine:
             p.event.set()
 
     def _run_kernel(self, kind: str, prep: PreparedModel,
-                    x: np.ndarray, rows: int) -> np.ndarray:
+                    x: np.ndarray, rows: int,
+                    qmode: Optional[str] = None) -> np.ndarray:
         with _tracing.span("assign.kernel", category="serve_kernel",
                            kernel=kind, rows=rows):
+            if kind == "quant":
+                return self._run_quant(prep, x, rows, qmode)
             if kind == "pruned":
                 if self._pruned_route() == "device":
                     labels, ok = self._pruned_device(prep, x, rows)
@@ -1072,5 +1340,71 @@ class AssignEngine:
         fn = self._cached_kernel(_build_pruned_dev, bucket, prep.k,
                                  prep.d, prep.g_n, prep.m)
         labels, ok = fn(self._pad(x, bucket), *prep.pruned_dev())
+        return (np.array(labels[:rows], np.int32),
+                np.asarray(ok)[:rows])
+
+    def _run_quant(self, prep: PreparedModel, x: np.ndarray, rows: int,
+                   mode: str) -> np.ndarray:
+        """The compressed-codebook path (docs/SERVING.md "Compressed
+        codebook").  Host route: grouped GEMM over the packed candidate
+        tiles, error-bounded prune, exact f32 rescore of the ambiguous
+        survivors, then the SAME closure certificate + dense fallback
+        as the f32 pruned path.  Device route: the k-tiled quantized
+        bound scan over the resident compressed slab; rows it cannot
+        certify unique under the error bound rescore densely on the
+        host (counted as quant rescores — the closure fallback counter
+        keeps its certificate-only meaning)."""
+        tier = prep.quant_tier(mode)
+        route = self._pruned_route()
+        with _tracing.span("assign.quant", category="serve_quant",
+                           tier=mode, route=route, rows=rows):
+            if route == "device":
+                labels, ok = self._quant_device(prep, tier, x, rows)
+                bad = np.flatnonzero(~ok)
+                if bad.size:
+                    with self._stats_lock:
+                        self._n_quant_rescore_rows += int(bad.size)
+                    _QUANT_RESCORE_ROWS_TOTAL.inc(int(bad.size))
+                    sub = np.ascontiguousarray(x[bad])
+                    d2 = (-2.0 * (sub @ prep.gen.centroids.T)
+                          + prep.csq[None, :])
+                    labels[bad] = d2.argmin(axis=1).astype(np.int32)
+                with self._stats_lock:
+                    self._n_quant_batches += 1
+                return labels
+            labels, ok, n_cand, n_rescore = _quant_host(
+                x, prep, tier, pool=self._pool,
+                chunks=self._kernel_threads)
+            _QUANT_CANDIDATES.observe(n_cand / max(1, rows * prep.m))
+            if n_rescore:
+                _QUANT_RESCORE_ROWS_TOTAL.inc(n_rescore)
+            with self._stats_lock:
+                self._n_quant_batches += 1
+                self._n_quant_rescore_rows += n_rescore
+        bad = np.flatnonzero(~ok)
+        if bad.size:
+            # Closure-certificate failures, same meaning and fallback
+            # as the f32 pruned path (the quantization bound already
+            # proved the label optimal among the candidates; this
+            # covers candidate-list completeness).
+            with self._stats_lock:
+                self._n_fallback_rows += int(bad.size)
+            _FALLBACK_ROWS_TOTAL.inc(int(bad.size))
+            sub = np.ascontiguousarray(x[bad])
+            d2 = (-2.0 * (sub @ prep.gen.centroids.T)
+                  + prep.csq[None, :])
+            labels[bad] = d2.argmin(axis=1).astype(np.int32)
+        return labels
+
+    def _quant_device(self, prep: PreparedModel, tier: _QuantTier,
+                      x: np.ndarray, rows: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Bucket-padded dispatch of the jitted quantized scan — same
+        compiled-shape discipline as the dense/pruned device paths;
+        labels copy out because the rescore writes into them."""
+        bucket = self._bucket(rows)
+        fn = self._cached_kernel(_build_quant_dev, bucket, prep.k,
+                                 prep.d, tier.mode)
+        labels, ok = fn(self._pad(x, bucket), *tier.device())
         return (np.array(labels[:rows], np.int32),
                 np.asarray(ok)[:rows])

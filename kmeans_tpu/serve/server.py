@@ -1731,7 +1731,8 @@ def serve(host: str = "127.0.0.1", port: int = 8787, *,
           assign_batching: Optional[bool] = None,
           assign_max_delay_s: Optional[float] = None,
           assign_max_batch_rows: Optional[int] = None,
-          assign_max_points: Optional[int] = None) -> KMeansServer:
+          assign_max_points: Optional[int] = None,
+          assign_quant: Optional[str] = None) -> KMeansServer:
     # None = the ServeConfig default (one source of truth for knob
     # defaults; the CLI passes through only what the user set).
     extra = {k: v for k, v in (
@@ -1739,6 +1740,7 @@ def serve(host: str = "127.0.0.1", port: int = 8787, *,
         ("assign_max_delay_s", assign_max_delay_s),
         ("assign_max_batch_rows", assign_max_batch_rows),
         ("assign_max_points", assign_max_points),
+        ("assign_quant", assign_quant),
     ) if v is not None}
     s = KMeansServer(ServeConfig(host=host, port=port,
                                  persist_dir=persist_dir,

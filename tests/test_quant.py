@@ -1,0 +1,519 @@
+"""Compressed-codebook subsystem tests (kmeans_tpu/quant/ + its serve
+integration — docs/SERVING.md "Compressed codebook").
+
+The contract under test is exactness-by-certificate: the per-centroid
+error bound must make the quantized candidate prune *provably complete*
+(the true argmin always survives), so labels through the int8/bf16 tier
+are bit-identical to the dense f32 engine — including adversarial
+near-tie rows, degenerate scales (all-zero centroids, subnormal
+magnitudes), both engine routes, and across a hot-swap.  Plus the VMEM
+pricing side: the quantized resident slab at codebook scale must price
+at exactly itemsize/4 of the f32 slab, and the "quantized" kernel_plan
+rung must engage where f32 spills but the compressed slab fits.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import ServeConfig
+from kmeans_tpu.continuous.registry import Generation, ModelRegistry
+from kmeans_tpu.obs.costmodel import vmem_report
+from kmeans_tpu.ops.pallas_lloyd import (QUANT_ITEMSIZE, kernel_plan,
+                                         vmem_breakdown)
+from kmeans_tpu.quant import (QUANT_MODES, dequantize, dequantize_matrix,
+                              quant_candidates, quant_prune,
+                              quantize_codebook)
+from kmeans_tpu.serve import assign as A
+
+
+def _cfg(**kw):
+    return dataclasses.replace(
+        ServeConfig(host="127.0.0.1", port=0, tracing=False), **kw)
+
+
+def _engine(gen_or_fn, **kw):
+    fn = gen_or_fn if callable(gen_or_fn) else (lambda: gen_or_fn)
+    return A.AssignEngine(fn, _cfg(**kw))
+
+
+def _clustered(k, d, n, seed=0):
+    rng = np.random.RandomState(seed)
+    g = max(2, int(round(k ** 0.5)))
+    meta = rng.randn(g, d).astype(np.float32) * 10
+    c = (meta[rng.randint(g, size=k)]
+         + rng.randn(k, d).astype(np.float32))
+    x = (meta[rng.randint(g, size=n)]
+         + rng.randn(n, d).astype(np.float32) * 2)
+    return c.astype(np.float32), x.astype(np.float32)
+
+
+def _dense_labels(c, x):
+    d2 = ((x * x).sum(1)[:, None] - 2.0 * (x @ c.T)
+          + (c * c).sum(1)[None, :])
+    return d2.argmin(1)
+
+
+# ---------------------------------------------------------------------------
+# Codebook: layouts, error-bound soundness, degenerate scales
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", sorted(QUANT_MODES))
+def test_error_bound_holds_in_float64(mode):
+    rng = np.random.RandomState(3)
+    # Wild dynamic range per row: magnitudes spanning ~12 decades stress
+    # the per-centroid scale (int8) and the exponent-only rounding (bf16).
+    c = (rng.randn(64, 48) * np.exp(rng.uniform(-14, 14, (64, 48)))
+         ).astype(np.float32)
+    qcb = quantize_codebook(c, mode)
+    c_hat = dequantize(qcb)
+    resid = np.sqrt(((c.astype(np.float64)
+                      - c_hat.astype(np.float64)) ** 2).sum(1))
+    # err is the soundness contract: an UPPER bound on the true f64
+    # residual norm, never below it.
+    assert (qcb.err.astype(np.float64) >= resid).all()
+    assert np.isfinite(qcb.err).all()
+    assert (qcb.err >= 0).all()
+
+
+@pytest.mark.parametrize("mode", sorted(QUANT_MODES))
+def test_degenerate_rows_quantize_soundly(mode):
+    # All-zero centroid, a subnormal-magnitude row (f32 scale flushes to
+    # ~0), a single huge element, and a plain row — every one must round
+    # trip with a sound (finite, >= residual) bound.
+    c = np.zeros((4, 8), np.float32)
+    c[1] = 1e-42                      # subnormal f32 magnitudes
+    c[2, 3] = 1e18                    # huge dynamic range within a row
+    c[3] = np.arange(8, dtype=np.float32) - 3.5
+    qcb = quantize_codebook(c, mode)
+    c_hat = dequantize(qcb)
+    assert np.isfinite(c_hat).all()
+    assert np.isfinite(qcb.err).all()
+    resid = np.sqrt(((c.astype(np.float64)
+                      - c_hat.astype(np.float64)) ** 2).sum(1))
+    assert (qcb.err.astype(np.float64) >= resid).all()
+    # The all-zero row is exactly representable: zero payload, zero err.
+    assert qcb.err[0] == 0.0
+    np.testing.assert_array_equal(c_hat[0], 0.0)
+
+
+def test_int8_payload_range_and_scale():
+    rng = np.random.RandomState(0)
+    c = rng.randn(16, 12).astype(np.float32) * 5
+    qcb = quantize_codebook(c, "int8")
+    assert qcb.q.dtype == np.int8
+    # Symmetric +-127: -128 never appears, so |q|*scale <= row max |c|.
+    assert qcb.q.min() >= -127 and qcb.q.max() <= 127
+    np.testing.assert_allclose(
+        qcb.scale, np.abs(c).max(axis=1) / 127.0, rtol=1e-6)
+
+
+def test_bf16_roundtrip_is_bit_truncation():
+    c = np.array([[1.0, -2.5, 3.14159, 1e-18, -1e18, 0.0]], np.float32)
+    qcb = quantize_codebook(c, "bf16")
+    assert qcb.q.dtype == np.uint16
+    c_hat = dequantize(qcb)
+    # Round-to-nearest-even bf16 is within 1 part in 2^8 of f32.
+    np.testing.assert_allclose(c_hat, c, rtol=2 ** -8)
+    # Exactly-representable values (0, 1, powers of two) are exact.
+    assert c_hat[0, 0] == 1.0 and c_hat[0, 5] == 0.0
+
+
+def test_quantize_rejects_bad_inputs():
+    with pytest.raises(ValueError, match="unknown quantization mode"):
+        quantize_codebook(np.zeros((2, 2), np.float32), "fp4")
+    with pytest.raises(ValueError, match="must be"):
+        quantize_codebook(np.zeros(4, np.float32), "int8")
+    bad = np.zeros((2, 2), np.float32)
+    bad[0, 0] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        quantize_codebook(bad, "int8")
+    bad[0, 0] = np.inf
+    with pytest.raises(ValueError, match="non-finite"):
+        quantize_codebook(bad, "bf16")
+
+
+def test_dequantize_matrix_matches_dequantize():
+    rng = np.random.RandomState(1)
+    c = rng.randn(8, 6).astype(np.float32)
+    for mode in sorted(QUANT_MODES):
+        qcb = quantize_codebook(c, mode)
+        full = dequantize(qcb)
+        # dequantize_matrix expands the raw payload WITHOUT scales (the
+        # grouped-GEMM folds scales elementwise afterwards).
+        raw = dequantize_matrix(qcb.q, mode)
+        want = full / np.where(qcb.scale[:, None] == 0, 1.0,
+                               qcb.scale[:, None])
+        np.testing.assert_allclose(raw, want, rtol=1e-6)
+        out = np.empty_like(raw)
+        assert dequantize_matrix(qcb.q, mode, out=out) is out
+        np.testing.assert_array_equal(out, raw)
+
+
+def test_nbytes_counts_payload_and_sidebands():
+    c = np.zeros((32, 16), np.float32)
+    q8 = quantize_codebook(c, "int8")
+    qb = quantize_codebook(c, "bf16")
+    assert q8.nbytes() == 32 * 16 * 1 + 3 * 32 * 4
+    assert qb.nbytes() == 32 * 16 * 2 + 3 * 32 * 4
+    assert (q8.k, q8.d) == (32, 16)
+
+
+# ---------------------------------------------------------------------------
+# Pruning scorers: completeness, adversarial near-ties, NEP-50 regression
+# ---------------------------------------------------------------------------
+
+def test_candidate_set_contains_true_argmin_adversarial():
+    """Near-tie rows where the quantized scores CANNOT separate the top
+    centroids: the error bound must keep every plausible winner in the
+    candidate set, and the exact rescore must land the true argmin.
+
+    The shell radius is chosen adversarially for the QUANTIZATION —
+    inter-centroid gaps an order of magnitude below the int8/bf16 error
+    bound, so the quantized scores carry no signal about the winner —
+    while staying well above f32 rounding of the exact score expression,
+    so the rescore's verdict is well-defined."""
+    rng = np.random.RandomState(7)
+    d = 24
+    u = rng.randn(d).astype(np.float32)
+    u /= np.linalg.norm(u)
+    # 6 near-ties in a 3e-4 shell (int8 err here is ~17x the shell,
+    # bf16 ~4x) plus 26 far decoys the prune must discard every time.
+    near = u[None, :] + rng.randn(6, d).astype(np.float32) * 3e-4
+    far = rng.randn(26, d).astype(np.float32) * 5 + 10
+    c = np.concatenate([near, far]).astype(np.float32)
+    x = (u[None, :]
+         + rng.randn(200, d).astype(np.float32) * 0.15).astype(np.float32)
+    want = _dense_labels(c, x)
+    assert len(np.unique(want)) > 1          # the ties genuinely contend
+    for mode in sorted(QUANT_MODES):
+        qcb = quantize_codebook(c, mode)
+        assert (qcb.err[:6] > 4 * 3e-4).all(), mode
+        c_hat = dequantize(qcb)
+        xsq = (x * x).sum(1)
+        s = (qcb.csq_hat[None, :] - 2.0 * (x @ c_hat.T)).astype(np.float32)
+        dhat = np.sqrt(np.maximum(xsq[:, None] + s, 0.0))
+        keep, _iup, _b = quant_candidates(dhat, qcb.err[None, :])
+        # Completeness: the true argmin is never pruned.
+        assert keep[np.arange(len(x)), want].all(), mode
+        cand = np.broadcast_to(np.arange(32), (len(x), 32))
+        labels, se_best, n_cand, n_rescore = quant_prune(
+            x, xsq, s, np.broadcast_to(qcb.err, (len(x), 32)), cand,
+            c, (c * c).sum(1).astype(np.float32))
+        np.testing.assert_array_equal(labels, want)
+        # Every row is ambiguous in this regime — the rescore must be
+        # doing the work, not the prune getting lucky.
+        assert n_rescore == len(x)
+        assert (n_cand > 1).all()
+
+
+def test_quant_prune_separated_rows_skip_rescore():
+    c, x = _clustered(64, 16, 128, seed=5)
+    # Queries sitting ON codewords: quantized gaps dwarf the error
+    # bound, so every row resolves as a single survivor with NO rescore.
+    x = c[np.random.RandomState(6).randint(64, size=256)]
+    qcb = quantize_codebook(c, "int8")
+    c_hat = dequantize(qcb)
+    xsq = (x * x).sum(1)
+    s = (qcb.csq_hat[None, :] - 2.0 * (x @ c_hat.T)).astype(np.float32)
+    cand = np.broadcast_to(np.arange(64), (len(x), 64))
+    labels, _se, n_cand, n_rescore = quant_prune(
+        x, xsq, s, np.broadcast_to(qcb.err, (len(x), 64)), cand,
+        c, (c * c).sum(1).astype(np.float32))
+    np.testing.assert_array_equal(labels, _dense_labels(c, x))
+    assert n_rescore == 0
+    assert (n_cand == 1).all()
+
+
+def test_rescored_labels_are_valid_ids_nep50_regression():
+    """Regression: NumPy 2's NEP-50 promotion kept an int32 candidate
+    array's dtype through `np.where(tied, ci, int64_max)`, wrapping the
+    sentinel to -1 — which then won every tie-break min.  Rescored rows
+    must always produce in-range centroid ids."""
+    rng = np.random.RandomState(11)
+    u = rng.randn(8).astype(np.float32)
+    u /= np.linalg.norm(u)
+    # Same conditioning as the adversarial test: gaps far below the
+    # int8 error bound (every row rescores), far above f32 rounding.
+    c = (u[None, :] + rng.randn(16, 8).astype(np.float32) * 3e-4)
+    x = (u[None, :] + rng.randn(64, 8).astype(np.float32) * 0.15)
+    qcb = quantize_codebook(c, "int8")
+    c_hat = dequantize(qcb)
+    xsq = (x * x).sum(1)
+    s = (qcb.csq_hat[None, :] - 2.0 * (x @ c_hat.T)).astype(np.float32)
+    # int32 candidate ids — the dtype that triggered the wrap.
+    cand = np.broadcast_to(np.arange(16, dtype=np.int32), (64, 16))
+    labels, _se, _nc, n_rescore = quant_prune(
+        x, xsq, s, np.broadcast_to(qcb.err, (64, 16)), cand,
+        c, (c * c).sum(1).astype(np.float32))
+    assert n_rescore > 0
+    assert labels.min() >= 0 and labels.max() < 16
+    np.testing.assert_array_equal(labels, _dense_labels(c, x))
+
+
+def test_exact_tie_breaks_to_lowest_centroid_id():
+    # Two identical centroids: dense argmin picks the first; the quant
+    # tier's rescore tie-break must agree regardless of packing order.
+    c = np.array([[1.0, 1.0], [3.0, 3.0], [1.0, 1.0]], np.float32)
+    x = np.array([[1.0, 1.0], [1.1, 0.9], [2.0, 2.0]], np.float32)
+    qcb = quantize_codebook(c, "int8")
+    c_hat = dequantize(qcb)
+    xsq = (x * x).sum(1)
+    s = (qcb.csq_hat[None, :] - 2.0 * (x @ c_hat.T)).astype(np.float32)
+    cand = np.broadcast_to(np.arange(3), (3, 3))
+    labels, _se, _nc, _nr = quant_prune(
+        x, xsq, s, np.broadcast_to(qcb.err, (3, 3)), cand,
+        c, (c * c).sum(1).astype(np.float32))
+    np.testing.assert_array_equal(labels, _dense_labels(c, x))
+    assert labels[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: exact parity across modes x routes x hot-swap
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", sorted(QUANT_MODES))
+def test_engine_quant_labels_match_dense_f32(mode):
+    c, x = _clustered(512, 24, 700, seed=2)
+    want = _dense_labels(c, x)
+    gen = Generation(c, 1)
+    eng = _engine(gen, assign_quant=mode, assign_quant_min_rows=1,
+                  assign_prune_min_k=64)
+    try:
+        labels, g = eng.submit(x)
+        assert g.generation == 1
+        np.testing.assert_array_equal(labels, want)
+        st = eng.stats()
+        assert st["quant_batches"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_engine_quant_adversarial_near_ties_exact():
+    """The acceptance row: adversarial-float serve batch — zero
+    certificate violations means zero LABEL deviations, end to end."""
+    rng = np.random.RandomState(13)
+    d = 24
+    meta = rng.randn(16, d).astype(np.float32) * 2
+    # 16 shells x 32 near-duplicate centroids: intra-shell gaps sit far
+    # below the int8 error bound, so every batch row is ambiguous.
+    rep = np.repeat(np.arange(16), 32)
+    c = (meta[rep]
+         + rng.randn(512, d).astype(np.float32) * 5e-3)
+    x = (meta[rng.randint(16, size=400)]
+         + rng.randn(400, d).astype(np.float32) * 0.3)
+    want = _dense_labels(c, x)
+    eng = _engine(Generation(c, 1), assign_quant="int8",
+                  assign_quant_min_rows=1, assign_prune_min_k=64)
+    try:
+        labels, _g = eng.submit(x)
+        np.testing.assert_array_equal(labels, want)
+        # These rows are genuinely ambiguous under int8 error bounds —
+        # the exact-rescore machinery must have engaged.
+        assert eng.stats()["quant_rescore_rows"] > 0
+    finally:
+        eng.stop()
+
+
+@pytest.mark.parametrize("mode", sorted(QUANT_MODES))
+def test_device_kernel_parity_and_certificate(mode):
+    """quant_assign_device on this host's backend: certified rows carry
+    the exact dense label; uncertified rows exist only where ambiguity
+    is real (and the engine rescues them densely)."""
+    import jax
+
+    c, x = _clustered(256, 16, 300, seed=4)
+    want = _dense_labels(c, x)
+    qcb = quantize_codebook(c, mode)
+    from kmeans_tpu.quant import quant_assign_device
+
+    lab, ok = jax.jit(
+        lambda xx: quant_assign_device(
+            xx, qcb.q, qcb.scale, qcb.err, qcb.csq_hat, mode,
+            k_tile=96))(x)
+    lab, ok = np.array(lab), np.asarray(ok)
+    # Soundness: every certified row is the true argmin.
+    np.testing.assert_array_equal(lab[ok], want[ok])
+    # With clustered data the bound certifies a solid majority; the
+    # uncertified tail is exactly what the dense rescue is for.
+    assert ok.mean() > 0.3
+    d2 = ((x * x).sum(1)[:, None] - 2.0 * (x @ c.T)
+          + (c * c).sum(1)[None, :])
+    lab[~ok] = d2[~ok].argmin(1)
+    np.testing.assert_array_equal(lab, want)
+
+
+def test_engine_quant_exact_across_hot_swap():
+    reg = ModelRegistry()
+    c1, x = _clustered(256, 12, 600, seed=8)
+    reg.publish(c1)
+    eng = _engine(reg.current, assign_quant="int8",
+                  assign_quant_min_rows=1, assign_prune_min_k=64)
+    try:
+        labels, g = eng.submit(x)
+        assert g.generation == 1
+        np.testing.assert_array_equal(labels, _dense_labels(c1, x))
+        c2, _ = _clustered(256, 12, 1, seed=9)
+        reg.publish(c2)
+        labels2, g2 = eng.submit(x)
+        assert g2.generation == 2
+        # The swapped generation's quant tier is built lazily on this
+        # first routed batch — labels must be exact against the NEW
+        # codebook immediately.
+        np.testing.assert_array_equal(labels2, _dense_labels(c2, x))
+        assert eng.stats()["quant_batches"] >= 2
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Policy: mode selection, auto slab threshold, batch-size floor
+# ---------------------------------------------------------------------------
+
+def _prep(k=512, d=8, prune_min_k=64, seed=0):
+    c, _ = _clustered(k, d, 1, seed=seed)
+    return A.PreparedModel(Generation(c, 1), prune_min_k=prune_min_k)
+
+
+def test_quant_mode_forced_and_off():
+    prep = _prep()
+    eng = _engine(prep.gen, assign_quant="bf16", assign_prune_min_k=64)
+    try:
+        assert eng._quant_mode(prep, rows=4096) == "bf16"
+        # Below the batch floor the f32 pruned path wins — route there.
+        assert eng._quant_mode(prep, rows=4) is None
+        assert eng._quant_mode(prep) == "bf16"
+    finally:
+        eng.stop()
+    eng = _engine(prep.gen, assign_prune_min_k=64)  # default: off
+    try:
+        assert eng._quant_mode(prep, rows=4096) is None
+    finally:
+        eng.stop()
+
+
+def test_quant_mode_backend_and_auto_slab_policy():
+    prep = _prep()
+    eng = _engine(prep.gen, assign_pruned_backend="quant",
+                  assign_prune_min_k=64)
+    try:
+        assert eng._quant_mode(prep, rows=4096) == "int8"
+    finally:
+        eng.stop()
+    # Auto policy keys on the f32 resident slab size: below the
+    # threshold quant is pure overhead, at/above it int8 engages.
+    eng = _engine(prep.gen, assign_prune_min_k=64)
+    try:
+        small = prep  # 512 x 8 f32 = 16 KiB << threshold
+        assert eng._quant_mode(small, rows=4096) is None
+
+        class _Big:
+            pruned = True
+            k = 1 << 16
+            d = 1 << 11  # 512 MiB f32 slab
+
+        assert eng._quant_mode(_Big(), rows=4096) == "int8"
+    finally:
+        eng.stop()
+
+
+def test_quant_mode_rejects_unknown_and_skips_unpruned():
+    prep = _prep()
+    eng = _engine(prep.gen, assign_quant="fp8", assign_prune_min_k=64)
+    try:
+        with pytest.raises(ValueError, match="assign_quant"):
+            eng._quant_mode(prep, rows=4096)
+    finally:
+        eng.stop()
+    # Quant composes with the closure tables: an unpruned prep (k below
+    # assign_prune_min_k) never routes through the tier.
+    unpruned = _prep(k=32, d=8, prune_min_k=64)
+    assert not unpruned.pruned
+    eng = _engine(unpruned.gen, assign_quant="int8")
+    try:
+        assert eng._quant_mode(unpruned, rows=4096) is None
+    finally:
+        eng.stop()
+
+
+def test_batch_floor_routes_small_batches_to_f32_pruned():
+    c, x = _clustered(512, 12, 64, seed=3)
+    eng = _engine(Generation(c, 1), assign_quant="int8",
+                  assign_prune_min_k=64)  # default floor: 512 rows
+    try:
+        labels, _g = eng.submit(x)  # 64 rows < 512 -> f32 pruned path
+        np.testing.assert_array_equal(labels, _dense_labels(c, x))
+        assert eng.stats()["quant_batches"] == 0
+    finally:
+        eng.stop()
+
+
+def test_quant_tier_is_cached_per_generation_and_mode():
+    prep = _prep()
+    t1 = prep.quant_tier("int8")
+    assert prep.quant_tier("int8") is t1
+    t2 = prep.quant_tier("bf16")
+    assert t2 is not t1 and t2.mode == "bf16"
+
+
+# ---------------------------------------------------------------------------
+# VMEM pricing: slab ratio at codebook scale, the "quantized" plan rung
+# ---------------------------------------------------------------------------
+
+def test_quant_itemsize_pins_codebook_modes():
+    # The planner's literal copy must never drift from the quant
+    # package's source of truth.
+    assert QUANT_ITEMSIZE == QUANT_MODES
+
+
+def test_codebook_scale_slab_ratio_is_quarter():
+    # The acceptance bound: int8 resident codebook <= 1/4 the f32 slab
+    # at k=65536 x d=2048 — priced by the same vmem_breakdown the serve
+    # policy consults.
+    kw = dict(d=2048, k=65536, x_itemsize=4, cd_itemsize=4)
+    f32 = vmem_breakdown("classic", **kw)["centroids_ct"]
+    for mode, itemsize in QUANT_MODES.items():
+        q = vmem_breakdown("classic", quant=mode, **kw)["centroids_ct"]
+        assert q * 4 == f32 * itemsize
+    assert (vmem_breakdown("classic", quant="int8", **kw)["centroids_ct"]
+            / f32) == 0.25
+
+
+def test_vmem_breakdown_quant_sideband_and_validation():
+    terms = vmem_breakdown("classic", d=256, k=4096, x_itemsize=4,
+                           cd_itemsize=4, quant="int8")
+    assert terms["quant_sideband"] > 0
+    with pytest.raises(ValueError, match="unknown quant mode"):
+        vmem_breakdown("classic", d=256, k=4096, quant="fp4")
+
+
+def test_kernel_plan_quantized_rung():
+    # A shape where the f32 resident slab overflows VMEM but the int8
+    # copy fits: the plan must take the "quantized" rung, and without
+    # quant= it must tile.  Small block_rows keeps the per-tile
+    # distance/one-hot terms from dominating, so the codebook slab is
+    # what decides — the serve-shaped regime the rung exists for.
+    kw = dict(block_rows=128, x_itemsize=4, cd_itemsize=4)
+    shape = None
+    for d, k in ((1024, 3072), (2048, 1536), (1024, 4096), (512, 8192)):
+        base = kernel_plan("classic", d, k, **kw)
+        q = kernel_plan("classic", d, k, quant="int8", **kw)
+        if base.mode != "untiled" and q.mode == "quantized":
+            shape = (d, k, base, q)
+            break
+    assert shape is not None, "no shape hit the quantized rung"
+    d, k, base, q = shape
+    assert base.mode == "tiled"
+    assert "compressed codebook" in q.why
+    # vmem_report agrees (same vmem_breakdown underneath).
+    rep = vmem_report(d, k, kernel="classic", block_rows=128,
+                      x_itemsize=4, cd_itemsize=4, quant="int8")
+    assert rep["plan"]["mode"] == "quantized"
+
+
+def test_kernel_plan_small_shape_stays_untiled_under_quant():
+    # quant= must never DOWNGRADE a shape that already fits in f32.
+    plan = kernel_plan("classic", 128, 512, block_rows=128,
+                       x_itemsize=4, cd_itemsize=4, quant="int8")
+    assert plan.mode == "untiled"

@@ -48,9 +48,9 @@ def _slow_kernel(engine, delay):
     long enough for followers to pile up (the coalescing window)."""
     orig = engine._run_kernel
 
-    def slow(kind, prep, x, rows):
+    def slow(kind, prep, x, rows, **kw):
         time.sleep(delay)
-        return orig(kind, prep, x, rows)
+        return orig(kind, prep, x, rows, **kw)
 
     engine._run_kernel = slow
     return engine

@@ -46,6 +46,7 @@ RULES = [
 SCOPE = (
     "kmeans_tpu/ops/",
     "kmeans_tpu/serve/",
+    "kmeans_tpu/quant/",
     "kmeans_tpu/models/lloyd.py",
     "kmeans_tpu/models/accelerated.py",
     "kmeans_tpu/models/runner.py",

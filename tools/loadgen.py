@@ -115,6 +115,23 @@ FLEET_WORKERS = 4
 FLEET_SHED_REQUESTS = 200
 FLEET_SHED_BURST = 20.0
 
+#: ISSUE 17 quant phase: codebook-shaped serving scale — large enough
+#: that the int8 candidate GEMM's 4x-smaller working set beats the f32
+#: closure-pruned path, small enough to measure on a CI CPU.  The gate
+#: is points/s(quant int8) >= points/s(f32 pruned) at this shape, exact
+#: label parity vs the dense f32 engine (zero certificate violations),
+#: and the vmem-priced resident codebook at k=65536 x d=2048 no more
+#: than a quarter of the f32 slab.  Queries are codeword + small
+#: residual (``QUANT_VQ_JITTER``) — the large-k VQ-serving regime the
+#: tier exists for (a query far from every codeword is a training-set
+#: outlier, not the serving steady state); both the f32 control and the
+#: quant window measure the SAME pool, so the comparison is like-for-
+#: like.
+QUANT_K = 16384
+QUANT_D = 512
+QUANT_VQ_JITTER = 0.25
+GATE_QUANT_SLAB_RATIO = 0.25
+
 
 def _make_data(k: int, d: int, n: int, seed: int = 0):
     """Clustered synthetic model + query pool: k centroids scattered
@@ -132,18 +149,26 @@ def _make_data(k: int, d: int, n: int, seed: int = 0):
 
 
 def _make_server(k: int, d: int, *, batching: bool, seed: int = 0,
-                 http: bool = False):
+                 http: bool = False, vq_jitter: float = None, **cfg_kw):
     """In-process server + in-memory registry with generation 1
-    published; returns (server, registry, base_url_or_None, queries)."""
+    published; returns (server, registry, base_url_or_None, queries).
+    Extra keywords override :class:`ServeConfig` fields (the quant
+    phase forces ``assign_quant`` / ``assign_prune_min_k`` this way).
+    ``vq_jitter`` replaces the query pool with codeword + N(0, jitter)
+    rows — the VQ-serving shape of the quant phase."""
     from kmeans_tpu.config import ServeConfig
     from kmeans_tpu.continuous.registry import ModelRegistry
     from kmeans_tpu.serve import KMeansServer
 
     c, x = _make_data(k, d, n=8192, seed=seed)
+    if vq_jitter is not None:
+        rng = np.random.RandomState(seed + 1)
+        x = (c[rng.randint(k, size=x.shape[0])]
+             + rng.randn(*x.shape).astype(np.float32) * vq_jitter)
     reg = ModelRegistry()
     reg.publish(c, trigger="initial")
     cfg = ServeConfig(host="127.0.0.1", port=0, assign_batching=batching,
-                      tracing=False)
+                      tracing=False, **cfg_kw)
     server = KMeansServer(cfg, registry=reg)
     base = None
     if http:
@@ -279,6 +304,7 @@ def _engine_stats_delta(before: dict, after: dict) -> dict:
     construction (warmup included)."""
     out = {}
     for key in ("batches", "requests", "rows", "fallback_rows",
+                "quant_batches", "quant_rescore_rows",
                 "shape_cache_hits", "shape_cache_misses"):
         out[key] = after.get(key, 0) - before.get(key, 0)
     b0 = before.get("batch_rows_pow2", {})
@@ -629,6 +655,107 @@ def fleet_gates(fleet: dict) -> dict:
     }
 
 
+def run_quant_phase(args) -> dict:
+    """ISSUE 17: compressed-codebook serving at codebook-shaped k.
+
+    Three measured windows at :data:`QUANT_K` x :data:`QUANT_D` —
+    f32 closure-pruned (the incumbent), quant int8 (the tier under
+    test), and dense f32 (the exactness oracle, ``assign_prune_min_k``
+    pushed above k) — then an end-to-end parity probe: the SAME query
+    rows through the quant engine and the dense engine must label
+    identically (the error-bound candidate certificate is provable, so
+    any mismatch is a bug, not noise).  The vmem slab ratio at the
+    paper's k=65536 x d=2048 target rides along, priced by the SAME
+    :func:`kmeans_tpu.ops.pallas_lloyd.vmem_breakdown` the kernel
+    dispatch uses."""
+    from kmeans_tpu.ops.pallas_lloyd import vmem_breakdown
+
+    qk, qd = QUANT_K, QUANT_D
+    points, conc, dur = args.points, args.concurrency, args.duration
+    rec = {"ts": round(time.time(), 3), "k": qk, "d": qd,
+           "points_per_request": points, "vq_jitter": QUANT_VQ_JITTER}
+
+    print(f"[loadgen] quant phase (ISSUE 17): k={qk} d={qd}, "
+          f"f32-pruned vs int8 interleaved (best of 2)", file=sys.stderr)
+    f32_server, _, _, x = _make_server(qk, qd, batching=True,
+                                       seed=args.seed,
+                                       vq_jitter=QUANT_VQ_JITTER)
+    q_server, _, _, _ = _make_server(qk, qd, batching=True,
+                                     seed=args.seed,
+                                     vq_jitter=QUANT_VQ_JITTER,
+                                     assign_quant="int8")
+    # Warmups build the closure tables / quant tier outside the windows.
+    run_load(f32_server, None, x, points=points, duration=0.5,
+             concurrency=conc)
+    run_load(q_server, None, x, points=points, duration=0.5,
+             concurrency=conc)
+    # A/B/A/B interleave, best window per path: the two paths differ by
+    # tens of percent while this shared-CPU host drifts by about as
+    # much between back-to-back windows — interleaving decorrelates the
+    # drift and max() discards the stalls, the standard discipline for
+    # a ratio gate on noisy hosts.
+    f32_runs, q_runs = [], []
+    for _ in range(2):
+        f32_runs.append(run_load(f32_server, None, x, points=points,
+                                 duration=dur, concurrency=conc))
+        q_runs.append(run_load(q_server, None, x, points=points,
+                               duration=dur, concurrency=conc))
+    rec["pruned_f32"] = max(f32_runs, key=lambda w: w["points_per_s"])
+    rec["quant_int8"] = max(q_runs, key=lambda w: w["points_per_s"])
+    rec["pruned_f32"]["window_points_per_s"] = [
+        w["points_per_s"] for w in f32_runs]
+    rec["quant_int8"]["window_points_per_s"] = [
+        w["points_per_s"] for w in q_runs]
+    f32_server.stop()
+
+    print("[loadgen] quant phase: dense f32 oracle window",
+          file=sys.stderr)
+    dense_server, _, _, _ = _make_server(
+        qk, qd, batching=True, seed=args.seed,
+        vq_jitter=QUANT_VQ_JITTER, assign_prune_min_k=qk + 1)
+    run_load(dense_server, None, x, points=points, duration=0.5,
+             concurrency=conc)
+    rec["dense_f32"] = run_load(dense_server, None, x, points=points,
+                                duration=dur, concurrency=conc)
+
+    # Parity probe: same rows through both engines; the quant path's
+    # certificate guarantees the true argmin survives pruning, so the
+    # labels must be bit-identical to the dense f32 engine's.
+    pts = x[:512]
+    lab_q, _, _ = q_server.assign_points(pts)
+    lab_d, _, _ = dense_server.assign_points(pts)
+    rec["parity_rows"] = int(pts.shape[0])
+    rec["mismatches"] = int(np.count_nonzero(
+        np.asarray(lab_q, np.int64) != np.asarray(lab_d, np.int64)))
+    q_server.stop()
+    dense_server.stop()
+
+    # Resident-slab pricing at the paper-scale target shape, straight
+    # from the dispatch-owned footprint arithmetic.
+    f32_ct = vmem_breakdown("classic", d=2048, k=65536,
+                            x_itemsize=4, cd_itemsize=4)["centroids_ct"]
+    int8_ct = vmem_breakdown("classic", d=2048, k=65536,
+                             x_itemsize=4, cd_itemsize=4,
+                             quant="int8")["centroids_ct"]
+    rec["slab"] = {"k": 65536, "d": 2048,
+                   "f32_bytes": int(f32_ct), "int8_bytes": int(int8_ct),
+                   "ratio": round(int8_ct / f32_ct, 4)}
+    return rec
+
+
+def quant_gates(rec: dict) -> dict:
+    pps_q = rec["quant_int8"]["points_per_s"] or 0.0
+    pps_f = rec["pruned_f32"]["points_per_s"] or 1e-9
+    return {
+        "quant_speedup": round(pps_q / pps_f, 2),
+        "quant_speedup_ok": pps_q >= pps_f,
+        "quant_mismatches": rec["mismatches"],
+        "quant_parity_ok": rec["mismatches"] == 0,
+        "quant_slab_ratio": rec["slab"]["ratio"],
+        "quant_slab_ok": rec["slab"]["ratio"] <= GATE_QUANT_SLAB_RATIO,
+    }
+
+
 def run_bench(args) -> int:
     """The committed evidence protocol -> BENCH_SERVE_latest.json."""
     k, d, points = args.k, args.d, args.points
@@ -709,6 +836,8 @@ def run_bench(args) -> int:
     print("[loadgen] fleet phase (ISSUE 16)", file=sys.stderr)
     record["fleet"] = run_fleet_phase(args)
 
+    record["quant"] = run_quant_phase(args)
+
     legacy_qps = record["per_request_legacy"]["qps"] or 1e-9
     cached_qps = record["per_request_cached"]["qps"] or 1e-9
     record["speedup"] = round(record["batched"]["qps"] / legacy_qps, 2)
@@ -733,6 +862,7 @@ def run_bench(args) -> int:
             record["hot_swap_binary"]["dropped"] <= GATE_MAX_DROPPED
             and record["hot_swap_binary"]["generations_published"] > 0),
         **fleet_gates(record["fleet"]),
+        **quant_gates(record["quant"]),
     }
     record["gates"] = gates
     out = args.out or os.path.join(_REPO, "BENCH_SERVE_latest.json")
@@ -754,11 +884,15 @@ def run_bench(args) -> int:
         "binary_swap_dropped": gates["binary_swap_dropped"],
         "fleet_qps_scaling": record["fleet"]["qps_scaling"],
         "fleet_shed_total": record["fleet"]["shed"]["shed_total"],
+        "quant_speedup": gates["quant_speedup"],
+        "quant_mismatches": gates["quant_mismatches"],
         "artifact": out}))
     if not (gates["speedup_ok"] and gates["swap_ok"]
             and gates["binary_speedup_ok"] and gates["binary_p99_ok"]
             and gates["binary_swap_ok"] and gates["fleet_scaling_ok"]
-            and gates["fleet_swap_ok"] and gates["fleet_shed_ok"]):
+            and gates["fleet_swap_ok"] and gates["fleet_shed_ok"]
+            and gates["quant_speedup_ok"] and gates["quant_parity_ok"]
+            and gates["quant_slab_ok"]):
         print(f"[loadgen] GATES FAILED: {gates}", file=sys.stderr)
         return 1
     return 0
@@ -792,6 +926,40 @@ def run_fleet_only(args) -> int:
     if not (gates["fleet_scaling_ok"] and gates["fleet_swap_ok"]
             and gates["fleet_shed_ok"]):
         print(f"[loadgen] FLEET GATES FAILED: {gates}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_quant_only(args) -> int:
+    """``--quant``: run JUST the compressed-codebook phase (ISSUE 17)
+    and merge it into the existing BENCH_SERVE_latest.json — the same
+    incremental contract as ``--fleet``: earlier phases' committed
+    measurements stay untouched, the quant dict carries its own
+    ``ts``."""
+    out = args.out or os.path.join(_REPO, "BENCH_SERVE_latest.json")
+    record = {}
+    if os.path.exists(out):
+        with open(out) as f:
+            record = json.load(f)
+    record["quant"] = run_quant_phase(args)
+    gates = quant_gates(record["quant"])
+    record.setdefault("gates", {}).update(gates)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    q = record["quant"]
+    print(json.dumps({
+        "quant_points_per_s": q["quant_int8"]["points_per_s"],
+        "pruned_f32_points_per_s": q["pruned_f32"]["points_per_s"],
+        "dense_f32_points_per_s": q["dense_f32"]["points_per_s"],
+        "quant_speedup": gates["quant_speedup"],
+        "quant_p99_ms": q["quant_int8"]["p99_ms"],
+        "quant_mismatches": gates["quant_mismatches"],
+        "quant_slab_ratio": gates["quant_slab_ratio"],
+        "artifact": out}))
+    if not (gates["quant_speedup_ok"] and gates["quant_parity_ok"]
+            and gates["quant_slab_ok"]):
+        print(f"[loadgen] QUANT GATES FAILED: {gates}", file=sys.stderr)
         return 1
     return 0
 
@@ -864,13 +1032,39 @@ def run_smoke(args) -> int:
                       and bool(np.isfinite(dist).all()))
     finally:
         server.stop()
+
+    # Compressed-codebook smoke (ISSUE 17): a pruned-shaped model with
+    # the int8 tier forced end-to-end through the engine, plus exact
+    # label parity against a dense-f32 engine on the same generation
+    # (the error-bound certificate makes any mismatch a bug).
+    qserver, _, _, qx = _make_server(512, 32, batching=True,
+                                     seed=args.seed, assign_quant="int8",
+                                     assign_quant_min_rows=1)
+    dserver, _, _, _ = _make_server(512, 32, batching=True,
+                                    seed=args.seed,
+                                    assign_prune_min_k=1024)
+    try:
+        q_out = run_load(qserver, None, qx, points=8, duration=0.4,
+                         concurrency=2)
+        q_eng = q_out.get("engine", {})
+        qpts = qx[:64]
+        lab_q, _, _ = qserver.assign_points(qpts)
+        lab_d, _, _ = dserver.assign_points(qpts)
+        quant_exact = np.array_equal(np.asarray(lab_q, np.int64),
+                                     np.asarray(lab_d, np.int64))
+    finally:
+        qserver.stop()
+        dserver.stop()
+    quant_ok = (q_out["ok"] > 0 and q_out["dropped"] == 0
+                and q_eng.get("quant_batches", 0) > 0 and quant_exact)
+
     eng = out.get("engine", {})
     ok = (out["ok"] > 0 and out["dropped"] == 0
           and eng.get("batches", 0) > 0
           and reg.generation > 1
           and bin_in["ok"] > 0 and bin_in["dropped"] == 0
           and bin_http["ok"] > 0 and bin_http["dropped"] == 0
-          and wire_exact)
+          and wire_exact and quant_ok)
     rec = {"smoke_ok": ok, "mode": args.mode, "qps": out["qps"],
            "ok": out["ok"], "dropped": out["dropped"],
            "batches": eng.get("batches"),
@@ -878,7 +1072,10 @@ def run_smoke(args) -> int:
            "binary_inproc_ok": bin_in["ok"],
            "binary_http_ok": bin_http["ok"],
            "binary_dropped": bin_in["dropped"] + bin_http["dropped"],
-           "wire_exact": wire_exact}
+           "wire_exact": wire_exact,
+           "quant_ok": quant_ok,
+           "quant_batches": q_eng.get("quant_batches"),
+           "quant_exact": bool(quant_exact)}
     if open_loop:
         p99 = out.get("p99_ms")
         slo_ok = p99 is not None and p99 <= SMOKE_OPEN_P99_MS
@@ -948,6 +1145,10 @@ def main(argv=None) -> int:
                    help="run only the multi-process fleet phase "
                         "(ISSUE 16) and merge it into the existing "
                         "BENCH_SERVE_latest.json")
+    p.add_argument("--quant", action="store_true",
+                   help="run only the compressed-codebook phase "
+                        "(ISSUE 17) and merge it into the existing "
+                        "BENCH_SERVE_latest.json")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1-sized acceptance run")
     p.add_argument("--record", nargs="?", const=True, default=None,
@@ -966,6 +1167,8 @@ def main(argv=None) -> int:
         return run_smoke(args)
     if args.fleet:
         return run_fleet_only(args)
+    if args.quant:
+        return run_quant_only(args)
     if args.bench:
         return run_bench(args)
 

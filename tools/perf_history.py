@@ -220,6 +220,9 @@ def _ingest_serve(root: str) -> List[Entry]:
     # shed count join the gate without judging history.
     fleet = rec.get("fleet") or {}
     shed = fleet.get("shed") or {}
+    # And for artifacts predating the compressed-codebook phase
+    # (ISSUE 17): the quant tier's throughput and tail latency.
+    quant = (rec.get("quant") or {}).get("quant_int8") or {}
     return [
         Entry("serve.batched_qps", batched.get("qps"),
               unit="req/s", direction="up", **common),
@@ -235,6 +238,10 @@ def _ingest_serve(root: str) -> List[Entry]:
               unit="x", direction="up", **common),
         Entry("serve.shed_total", shed.get("shed_total"),
               unit="req", direction="up", **common),
+        Entry("serve.quant_qps", quant.get("qps"),
+              unit="req/s", direction="up", **common),
+        Entry("serve.quant_p99_ms", quant.get("p99_ms"),
+              unit="ms", direction="down", **common),
     ]
 
 

@@ -1047,7 +1047,13 @@ def _cmd_serve(args) -> int:
                                   else None),
               assign_max_batch_rows=args.assign_max_batch,
               assign_max_points=args.assign_max_points,
-              assign_quant=args.assign_quant)
+              assign_quant=args.assign_quant,
+              trace_dir=args.trace_dir or None,
+              slo=args.slo,
+              slo_latency_target_s=(args.slo_latency_target_ms / 1000.0
+                                    if args.slo_latency_target_ms
+                                    is not None else None),
+              slo_min_samples=args.slo_min_samples)
     except KeyboardInterrupt:
         pass
     except ValueError as e:
@@ -1077,6 +1083,13 @@ def _serve_fleet(args) -> int:
         "assign_max_batch_rows": args.assign_max_batch,
         "assign_max_points": args.assign_max_points,
         "assign_quant": args.assign_quant,
+        "trace_dir": args.trace_dir or None,
+        "slo": args.slo,
+        "slo_latency_target_s": (args.slo_latency_target_ms / 1000.0
+                                 if args.slo_latency_target_ms
+                                 is not None else None),
+        "slo_min_samples": args.slo_min_samples,
+        "fleet_obs_port": args.fleet_obs_port,
     }
     try:
         config = ServeConfig(**{k: v for k, v in overrides.items()
@@ -1403,6 +1416,38 @@ def main(argv=None) -> int:
                         "labels stay exact, the hot loop reads 4-8x "
                         "fewer bytes (default off; at >=256 MiB f32 "
                         "slabs the auto policy engages int8 anyway)")
+    s.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="spool completed spans to per-process JSONL "
+                        "files under DIR (tools/trace_view.py --fleet "
+                        "DIR merges them into one Chrome trace; with "
+                        "--workers N the supervisor also proxies the "
+                        "merged view at its obs endpoint's /api/trace "
+                        "— docs/OBSERVABILITY.md \"Fleet "
+                        "observability\")")
+    s.add_argument("--slo", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="burn-rate SLO monitor (docs/OBSERVABILITY.md): "
+                        "rolling latency/availability windows; while "
+                        "any window's burn rate is in breach, /readyz "
+                        "returns 503 and "
+                        "kmeans_tpu_slo_breach_total increments "
+                        "(default off)")
+    s.add_argument("--slo-latency-target-ms", type=float, default=None,
+                   metavar="MS",
+                   help="latency SLO threshold: a request slower than "
+                        "this is a bad event for the latency burn rate "
+                        "(default 250)")
+    s.add_argument("--slo-min-samples", type=int, default=None,
+                   metavar="N",
+                   help="minimum events in a window before it can "
+                        "breach (default 50 — tiny idle windows must "
+                        "not flap /readyz)")
+    s.add_argument("--fleet-obs-port", type=int, default=None,
+                   metavar="PORT",
+                   help="fixed port for the supervisor's fleet "
+                        "observability endpoint (--workers N only; "
+                        "default: an ephemeral port, announced in the "
+                        "supervisor's obs_up event)")
     s.add_argument("--workers", type=int, default=1, metavar="N",
                    help="run N supervised SO_REUSEPORT worker processes "
                         "instead of serving in-process (crashed workers "

@@ -455,6 +455,44 @@ class ServeConfig:
     #: shed at evenly spaced higher thresholds, and the top class sheds
     #: only when the queue is actually full.
     shed_start_fraction: float = 0.5
+    #: Fleet trace spool (docs/OBSERVABILITY.md "Fleet observability"):
+    #: directory where each worker appends its completed spans as
+    #: ``spans-<pid>.jsonl`` so ``tools/trace_view.py --fleet`` (and the
+    #: supervisor's ``/api/trace`` proxy) can merge one Chrome trace
+    #: across worker processes.  None disables spooling — the per-
+    #: process span ring keeps working either way.
+    trace_dir: Optional[str] = None
+    #: Port of the fleet supervisor's own observability endpoint
+    #: (``/metrics`` aggregated across workers, ``/api/trace`` merged
+    #: spool, ``/healthz``, ``/readyz``).  0 binds an ephemeral port
+    #: (printed in the supervisor's FLEET_OBS event and exposed as
+    #: ``FleetSupervisor.obs_port``); None disables the endpoint.
+    fleet_obs_port: Optional[int] = 0
+    #: SLO monitor (kmeans_tpu.obs.slo; docs/OBSERVABILITY.md "Fleet
+    #: observability"): off = no recorder, ``/readyz`` gates on model/
+    #: engine readiness only (the pre-ISSUE-20 behavior).
+    slo: bool = False
+    #: Latency SLO: a request slower than this is an error-budget-bad
+    #: event; the objective is the good fraction required (0.99 = 1%
+    #: budget).
+    slo_latency_target_s: float = 0.25
+    slo_latency_objective: float = 0.99
+    #: Availability SLO: 5xx or shed responses are bad events.
+    slo_availability_objective: float = 0.999
+    #: Rolling lookback windows and their burn-rate thresholds, matched
+    #: one-to-one (multi-window shape: short windows demand a much
+    #: higher burn before breaching).  Burn = bad fraction / error
+    #: budget; breach flips ``/readyz`` to 503 and increments
+    #: ``kmeans_tpu_slo_breach_total{window,slo}``.
+    slo_windows_s: Tuple[float, ...] = (10.0, 60.0, 300.0)
+    slo_burn_thresholds: Tuple[float, ...] = (14.4, 6.0, 1.0)
+    #: A window breaches only with at least this many events in it —
+    #: also the recovery mechanism: when load stops, the window drains
+    #: below the floor and the breach clears.
+    slo_min_samples: int = 50
+    #: Burn re-evaluation rate limit (the readiness path's cost between
+    #: evaluations is one clock read).
+    slo_eval_s: float = 0.25
 
 
 @dataclasses.dataclass(frozen=True)

@@ -32,11 +32,16 @@ from kmeans_tpu.obs.registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    ParsedFamily,
+    ParsedSample,
     REGISTRY,
     counter,
     gauge,
     histogram,
+    parse_exposition,
+    render_exposition,
 )
+from kmeans_tpu.obs import fleetview, slo
 from kmeans_tpu.obs.telemetry import (
     TelemetryWriter,
     read_events,
@@ -54,12 +59,18 @@ __all__ = [
     "counter",
     "gauge",
     "histogram",
+    "ParsedFamily",
+    "ParsedSample",
+    "parse_exposition",
+    "render_exposition",
     "TelemetryWriter",
     "read_events",
     "summarize_events",
     "summarize_by_run",
     "costmodel",
     "tracing",
+    "fleetview",
+    "slo",
     "enable",
     "disable",
     "enabled",

@@ -27,6 +27,7 @@ with a different kind or label set raises.
 from __future__ import annotations
 
 import bisect
+import dataclasses
 import math
 import re
 import threading
@@ -42,6 +43,10 @@ __all__ = [
     "gauge",
     "histogram",
     "DEFAULT_BUCKETS",
+    "ParsedSample",
+    "ParsedFamily",
+    "parse_exposition",
+    "render_exposition",
 ]
 
 _NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
@@ -449,6 +454,199 @@ class MetricsRegistry:
             lines.append(f"# TYPE {m.name} {m.kind}")
             lines.extend(m.samples())
         return "\n".join(lines) + "\n" if lines else ""
+
+
+# ------------------------------------------------------------------ parser
+#
+# The exact inverse of :meth:`MetricsRegistry.expose` — the fleet
+# supervisor scrapes every worker's /metrics, parses the text back into
+# structured samples, relabels and rolls them up, and re-renders
+# (kmeans_tpu.obs.fleetview).  The round-trip contract
+# ``render_exposition(parse_exposition(text)) == text`` for any text
+# this module's :meth:`expose` produces is pinned by tests/test_obs.py.
+
+
+@dataclasses.dataclass(frozen=True)
+class ParsedSample:
+    """One exposition sample line: name, ordered labels, value."""
+
+    name: str
+    labels: Tuple[Tuple[str, str], ...]
+    value: float
+
+    def label_dict(self) -> Dict[str, str]:
+        return dict(self.labels)
+
+
+@dataclasses.dataclass
+class ParsedFamily:
+    """One metric family as scraped: HELP/TYPE header plus samples."""
+
+    name: str
+    kind: str                       # counter | gauge | histogram | untyped
+    help: str
+    samples: List[ParsedSample] = dataclasses.field(default_factory=list)
+
+
+#: Exposition suffixes that attach a sample to its histogram family.
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"      # metric name
+    r"(\{.*\})?"                        # optional label block
+    r"\s+(\S+)"                         # value
+    r"(?:\s+(-?\d+))?$"                 # optional timestamp (ignored)
+)
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(s: str, *, quote: bool) -> str:
+    """Reverse :func:`_escape_help` / :func:`_escape_label_value`."""
+    if "\\" not in s:
+        return s
+    out: List[str] = []
+    i, n = 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "\\" and i + 1 < n:
+            nxt = s[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if quote and nxt == '"':
+                out.append('"')
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_value(token: str) -> float:
+    low = token.lower()
+    if low in ("+inf", "inf"):
+        return float("inf")
+    if low == "-inf":
+        return float("-inf")
+    if low == "nan":
+        return float("nan")
+    return float(token)
+
+
+def _parse_labels(block: str) -> Tuple[Tuple[str, str], ...]:
+    """``{a="x",b="y"}`` -> ``(("a","x"), ("b","y"))``; strict."""
+    inner = block[1:-1]
+    if not inner:
+        return ()
+    pairs: List[Tuple[str, str]] = []
+    pos = 0
+    while True:
+        m = _LABEL_PAIR_RE.match(inner, pos)
+        if m is None:
+            raise ValueError(f"malformed label block {block!r} at {pos}")
+        pairs.append((m.group(1), _unescape(m.group(2), quote=True)))
+        pos = m.end()
+        if pos == len(inner):
+            break
+        if inner[pos] != ",":
+            raise ValueError(f"malformed label block {block!r} at {pos}")
+        pos += 1
+    return tuple(pairs)
+
+
+def _family_for(name: str,
+                families: Dict[str, ParsedFamily]) -> ParsedFamily:
+    """The family a sample line belongs to: exact name, or — for
+    histogram exposition samples — the base name before the suffix."""
+    fam = families.get(name)
+    if fam is not None:
+        return fam
+    for sfx in _HIST_SUFFIXES:
+        if name.endswith(sfx):
+            base = families.get(name[: -len(sfx)])
+            if base is not None and base.kind == "histogram":
+                return base
+    fam = families[name] = ParsedFamily(name, "untyped", "")
+    return fam
+
+
+def parse_exposition(text: str) -> Dict[str, ParsedFamily]:
+    """Parse Prometheus text exposition (format 0.0.4) back into
+    families, insertion-ordered as encountered.
+
+    The inverse of :meth:`MetricsRegistry.expose`: every sample —
+    including escaped label values and histogram ``+Inf`` buckets —
+    round-trips exactly through :func:`render_exposition`.  Malformed
+    lines raise ``ValueError`` (a truncated or corrupt worker scrape
+    must be *rejected*, not silently half-aggregated)."""
+    families: Dict[str, ParsedFamily] = {}
+    for raw in text.splitlines():
+        line = raw.rstrip("\r")
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            for prefix in ("# HELP ", "# TYPE "):
+                if line.startswith(prefix):
+                    rest = line[len(prefix):]
+                    name, sep, payload = rest.partition(" ")
+                    if not _NAME_RE.match(name):
+                        raise ValueError(
+                            f"malformed header line {line!r}")
+                    fam = families.get(name)
+                    if fam is None:
+                        fam = families[name] = ParsedFamily(
+                            name, "untyped", "")
+                    if prefix == "# HELP ":
+                        fam.help = _unescape(payload, quote=False)
+                    else:
+                        kind = payload.strip()
+                        if kind not in ("counter", "gauge", "histogram",
+                                        "summary", "untyped"):
+                            raise ValueError(
+                                f"unknown metric type {kind!r} for "
+                                f"{name!r}")
+                        fam.kind = kind
+                    break
+            # Any other comment line is legal exposition: skip it.
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed sample line {line!r}")
+        name, block, value_tok = m.group(1), m.group(2), m.group(3)
+        labels = _parse_labels(block) if block else ()
+        fam = _family_for(name, families)
+        fam.samples.append(
+            ParsedSample(name, labels, _parse_value(value_tok)))
+    return families
+
+
+def render_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    """Parsed-label tuple back to exposition text (``{}``-free when
+    empty) — the formatting twin of :func:`_render_labels`."""
+    if not labels:
+        return ""
+    pairs = [f'{k}="{_escape_label_value(v)}"' for k, v in labels]
+    return "{" + ",".join(pairs) + "}"
+
+
+def render_exposition(families: Iterable[ParsedFamily]) -> str:
+    """Families back to exposition text, preserving family and sample
+    order — ``render_exposition(parse_exposition(t).values()) == t``
+    for any ``t`` that :meth:`MetricsRegistry.expose` produced."""
+    lines: List[str] = []
+    for fam in families:
+        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for s in fam.samples:
+            lines.append(
+                f"{s.name}{render_labels(s.labels)} {_fmt_value(s.value)}")
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 #: The process-global default registry every subsystem registers into.

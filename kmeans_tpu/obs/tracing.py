@@ -77,6 +77,7 @@ __all__ = [
     "disable",
     "enabled",
     "export_chrome_trace",
+    "span_to_event",
 ]
 
 #: Default completed-span ring capacity.  At ~200 bytes/span this bounds
@@ -268,6 +269,17 @@ class Tracer:
         self.capacity = capacity
         self._spans: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        #: Optional completed-span sink (``fn(span)``), called after the
+        #: ring append — the fleet trace spool
+        #: (kmeans_tpu.obs.fleetview.SpanSpool) hooks here so spans
+        #: outlive the ring AND the process.  Must be fast and must not
+        #: raise; exceptions are swallowed (a broken spool must never
+        #: take down the traced request).
+        self._sink = None
+
+    def set_sink(self, sink) -> None:
+        """Install (or clear, with ``None``) the completed-span sink."""
+        self._sink = sink
 
     # ------------------------------------------------------------ control
     def enable(self) -> None:
@@ -303,6 +315,12 @@ class Tracer:
     def _record(self, span: Span) -> None:
         with self._lock:
             self._spans.append(span)
+        sink = self._sink
+        if sink is not None:
+            try:
+                sink(span)
+            except Exception:  # allow-silent-except: a failing sink (spool disk full, torn dir) must not take down the traced operation; the ring above already kept the span
+                pass
 
     def snapshot(self) -> List[Span]:
         """Completed spans currently buffered, oldest first."""
@@ -322,21 +340,7 @@ class Tracer:
         tids = set()
         for s in self.snapshot():
             tids.add(s.tid)
-            args = {"trace_id": s.trace_id, "span_id": s.span_id}
-            if s.parent_id is not None:
-                args["parent_id"] = s.parent_id
-            for k, v in s.attrs.items():
-                args[str(k)] = _json_value(v)
-            events.append({
-                "name": s.name,
-                "cat": s.category,
-                "ph": "X",
-                "ts": round(s.ts_us, 3),
-                "dur": round(s.dur_us or 0.0, 3),
-                "pid": pid,
-                "tid": s.tid,
-                "args": args,
-            })
+            events.append(span_to_event(s, pid))
         meta = [{
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": "kmeans_tpu"},
@@ -358,6 +362,28 @@ class Tracer:
             with open(path, "w", encoding="utf-8") as f:
                 f.write(text)
         return text
+
+
+def span_to_event(s: Span, pid: Optional[int] = None) -> Dict[str, Any]:
+    """One completed span as a Chrome trace-event dict (``ph: "X"``),
+    strictly JSON-safe — shared by :meth:`Tracer.to_events` and the
+    fleet trace spool, so a spooled span and a ring-exported span render
+    identically."""
+    args: Dict[str, Any] = {"trace_id": s.trace_id, "span_id": s.span_id}
+    if s.parent_id is not None:
+        args["parent_id"] = s.parent_id
+    for k, v in s.attrs.items():
+        args[str(k)] = _json_value(v)
+    return {
+        "name": s.name,
+        "cat": s.category,
+        "ph": "X",
+        "ts": round(s.ts_us, 3),
+        "dur": round(s.dur_us or 0.0, 3),
+        "pid": os.getpid() if pid is None else pid,
+        "tid": s.tid,
+        "args": args,
+    }
 
 
 #: The process-global default tracer (disabled until a capture turns it

@@ -1263,8 +1263,14 @@ class AssignEngine:
                 _tracing.span("assign.batch", category="serve_batch",
                               rows=rows, requests=len(good),
                               kernel=kind, generation=gen.generation):
-            x = (good[0].points if len(good) == 1
-                 else np.concatenate([p.points for p in good]))
+            # Batch assembly is the host->device staging phase: the
+            # concatenate materializes the contiguous buffer the kernel
+            # transfers.  Its own span category lets trace_view
+            # --attribution split transfer from kernel wall-time.
+            with _tracing.span("assign.stage", category="serve_transfer",
+                               rows=rows, requests=len(good)):
+                x = (good[0].points if len(good) == 1
+                     else np.concatenate([p.points for p in good]))
             labels = self._run_kernel(kind, prep, x, rows, qmode=qmode)
         t_done = time.perf_counter()
         with self._stats_lock:

@@ -47,11 +47,22 @@ each heartbeat write — ``fleet.heartbeat:kill@2`` is the worker-kill
 drill: the process dies at its second heartbeat, mid-load), and
 ``fleet.reload_push`` (supervisor, before each per-worker push).
 
-The supervisor process never serves HTTP itself; its metrics
-(``kmeans_tpu_fleet_workers{state}``, ``kmeans_tpu_fleet_restarts_total``)
-live in the supervisor's process registry, readable in-process by
-drills and embedders.  Workers expose the normal ``/metrics`` on the
-shared port.
+The supervisor never serves REQUEST traffic itself, but it does run
+the fleet's observability endpoint
+(:class:`~kmeans_tpu.obs.fleetview.FleetObsServer`, port
+``ServeConfig.fleet_obs_port``): its ``/metrics`` scrapes every live
+worker's private obs port (announced via ``obs=`` on the
+``FLEET_READY`` line), aggregates per-worker-labeled series plus
+fleet rollups (worker lanes only — the supervisor's own registry,
+``kmeans_tpu_fleet_workers{state}`` /
+``kmeans_tpu_fleet_restarts_total``, rides along as lane
+``worker="sup"`` but never folds into a rollup) —
+``/api/trace`` serves the merged cross-worker span spool, and
+``/readyz`` gates on the fleet SLO monitor's burn-rate windows
+(docs/OBSERVABILITY.md "Fleet observability").  Workers still expose
+the normal ``/metrics`` on the shared port, but a scrape of that
+lands on ONE kernel-picked worker — the supervisor pane is the
+fleet-wide view.
 """
 
 from __future__ import annotations
@@ -132,6 +143,7 @@ class _WorkerHandle:
         self.ready_ts: Optional[float] = None
         self.last_hb = self.spawned_ts
         self.generation = 0
+        self.obs_port: Optional[int] = None  # worker's private obs endpoint
         self.gen_ts: Optional[float] = None
         self.pushed_step = 0           # newest step RELOAD was delivered for
         self.drained = False
@@ -188,6 +200,73 @@ class FleetSupervisor:
         self._target_step = 0
         self._threads: List[threading.Thread] = []
         self._started = False
+        #: The fleet observability pane (``obs.fleetview.FleetObsServer``)
+        #: and its SLO monitor — created in :meth:`start` when
+        #: ``config.fleet_obs_port`` is not None.
+        self.obs_server = None
+        self.slo_monitor = None
+
+    # ------------------------------------------------------- observability
+    def _obs_targets(self) -> List[tuple]:
+        """Live workers' ``(lane, obs_port)`` scrape targets — re-read
+        from the worker table on every scrape, so respawns/drains are
+        picked up without re-wiring."""
+        with self._lock:
+            return [(str(slot), h.obs_port)
+                    for slot, h in sorted(self._workers.items())
+                    if h.state == "live" and h.obs_port
+                    and h.proc.poll() is None]
+
+    def _obs_lane_names(self) -> Dict[int, str]:
+        """pid -> human lane name for the merged fleet trace."""
+        with self._lock:
+            return {h.proc.pid: f"worker {slot}"
+                    for slot, h in self._workers.items()}
+
+    def _obs_ready(self) -> tuple:
+        live = self.live_count()
+        return live >= 1, {"role": "supervisor", "live_workers": live,
+                           "target_workers": self.n_workers}
+
+    def _start_obs(self) -> None:
+        if self.config.fleet_obs_port is None:
+            return
+        from kmeans_tpu.obs.fleetview import FleetObsServer
+
+        if self.config.slo:
+            from kmeans_tpu.obs.slo import SLOMonitor
+
+            # The supervisor's SLO is fed by its per-worker scrape
+            # outcomes (FleetObsServer records each scrape's latency
+            # and failure), so its /readyz catches slow-but-alive
+            # workers the per-request worker SLOs cannot see from
+            # outside.
+            self.slo_monitor = SLOMonitor(
+                latency_target_s=float(self.config.slo_latency_target_s),
+                latency_objective=float(self.config.slo_latency_objective),
+                availability_objective=float(
+                    self.config.slo_availability_objective),
+                windows_s=tuple(self.config.slo_windows_s),
+                burn_thresholds=tuple(self.config.slo_burn_thresholds),
+                min_samples=int(self.config.slo_min_samples),
+                eval_s=float(self.config.slo_eval_s),
+            )
+        self.obs_server = FleetObsServer(
+            targets_fn=self._obs_targets,
+            host=self.config.host or "127.0.0.1",
+            port=int(self.config.fleet_obs_port),
+            trace_dir=self.config.trace_dir,
+            lane_names_fn=self._obs_lane_names,
+            slo=self.slo_monitor,
+            ready_fn=self._obs_ready,
+        ).start()
+        self._event("obs_up", port=self.obs_server.port)
+
+    @property
+    def obs_port(self) -> Optional[int]:
+        """The fleet observability endpoint's bound port (None when
+        disabled via ``fleet_obs_port=None``)."""
+        return self.obs_server.port if self.obs_server else None
 
     # ------------------------------------------------------------ events
     def _event(self, kind: str, slot: Optional[int] = None, **detail):
@@ -247,10 +326,12 @@ class FleetSupervisor:
                     h.ready_ts = _now()
                     h.last_hb = h.ready_ts
                     h.generation = int(kv.get("gen", 0))
+                    h.obs_port = int(kv.get("obs", 0)) or None
                     if h.state == "starting":
                         h.state = "live"
                     self._event("ready", h.slot, pid=h.proc.pid,
-                                generation=h.generation)
+                                generation=h.generation,
+                                obs_port=h.obs_port)
                 elif line.startswith("FLEET_GEN"):
                     kv = _parse_kv(line)
                     h.generation = int(kv.get("gen", 0))
@@ -278,6 +359,7 @@ class FleetSupervisor:
         with self._lock:
             for slot in range(self.n_workers):
                 self._workers[slot] = self._spawn(slot)
+        self._start_obs()
         self._threads = [
             threading.Thread(target=self._monitor_loop, daemon=True,
                              name="fleet-monitor"),
@@ -500,6 +582,9 @@ class FleetSupervisor:
             clean = self.drain()
         self._drain_evt.set()
         self._stop_evt.set()
+        if self.obs_server is not None:
+            self.obs_server.stop()
+            self.obs_server = None
         with self._lock:
             handles = list(self._workers.values())
         for h in handles:
@@ -568,12 +653,30 @@ def _worker_main() -> int:
     cfg_dict = json.loads(cfg_json)
     cfg_dict["tenant_classes"] = tuple(
         tuple(t) for t in cfg_dict.get("tenant_classes") or ())
+    # dataclasses.asdict turned the tuple knobs into JSON lists;
+    # restore the tuples the dataclass declares.
+    for knob in ("slo_windows_s", "slo_burn_thresholds"):
+        if cfg_dict.get(knob) is not None:
+            cfg_dict[knob] = tuple(cfg_dict[knob])
     config = ServeConfig(**cfg_dict)
 
     from kmeans_tpu.serve.server import KMeansServer
 
     server = KMeansServer(config)
     server.start(background=True)
+
+    # The private per-worker obs endpoint: the serving port is shared
+    # (SO_REUSEPORT), so the supervisor needs a per-process address to
+    # scrape.  Ephemeral port, announced on the FLEET_READY line.
+    obs_srv = None
+    if config.fleet_obs_port is not None:
+        from kmeans_tpu.obs.fleetview import WorkerObsServer
+
+        try:
+            obs_srv = WorkerObsServer().start()
+        except OSError as e:      # pragma: no cover - bind exhaustion
+            print(f"fleet worker: obs endpoint failed: {e}",
+                  file=sys.stderr)
 
     drain_evt = threading.Event()
     # PreemptionGuard semantics without the guard object (its handler
@@ -608,7 +711,8 @@ def _worker_main() -> int:
         g = server.current_model()
         return g.generation if g is not None else 0
 
-    emit("FLEET_READY", pid=os.getpid(), port=config.port, gen=_gen())
+    emit("FLEET_READY", pid=os.getpid(), port=config.port, gen=_gen(),
+         obs=obs_srv.port if obs_srv is not None else 0)
     hb_s = max(0.01, float(config.fleet_heartbeat_s))
     next_hb = time.monotonic() + hb_s
     while not drain_evt.is_set():
@@ -641,6 +745,8 @@ def _worker_main() -> int:
     # connections to the surviving listeners), let in-flight handlers
     # finish, then report and exit 0.
     server.stop()
+    if obs_srv is not None:
+        obs_srv.stop()
     emit("FLEET_DRAINED", ts=round(time.time(), 6))
     return 0
 

@@ -131,6 +131,15 @@ _KNOWN_ROUTES = frozenset((
 def _route_label(path: str) -> str:
     return path if path in _KNOWN_ROUTES else "other"
 
+
+#: Probe/introspection routes the SLO monitor never records: a breached
+#: /readyz answers 503 by design, and /api/events "latency" is the
+#: subscription lifetime — feeding either back into the burn rate would
+#: self-sustain a breach (or fake one) forever.
+_SLO_EXEMPT_ROUTES = frozenset((
+    "/healthz", "/readyz", "/metrics", "/api/trace", "/api/events",
+))
+
 #: One-shot model families the train op can run (lloyd streams per-iteration
 #: via LloydRunner instead).  The one source of truth for validation AND
 #: dispatch — names resolve on kmeans_tpu.models at run time.
@@ -519,6 +528,27 @@ class KMeansServer:
         #: Per-tenant admission control (inert when tenant_classes is
         #: empty — the default; docs/SERVING.md "Fleet").
         self.admission = _TenantAdmission(self.config)
+        #: Burn-rate SLO monitor (kmeans_tpu.obs.slo; ``config.slo``):
+        #: fed by every finished non-probe request, gates readiness() —
+        #: a breach flips /readyz to 503 so the LB/supervisor drains
+        #: this worker before users feel the latency.
+        self.slo_monitor = None
+        if self.config.slo:
+            from kmeans_tpu.obs.slo import SLOMonitor
+
+            self.slo_monitor = SLOMonitor(
+                latency_target_s=self.config.slo_latency_target_s,
+                latency_objective=self.config.slo_latency_objective,
+                availability_objective=(
+                    self.config.slo_availability_objective),
+                windows_s=tuple(self.config.slo_windows_s),
+                burn_thresholds=tuple(self.config.slo_burn_thresholds),
+                min_samples=self.config.slo_min_samples,
+                eval_s=self.config.slo_eval_s,
+            )
+        #: Fleet trace spool (config.trace_dir): installed as the
+        #: tracer's completed-span sink for the start()..stop() window.
+        self._span_spool = None
         self._train_sem = threading.BoundedSemaphore(
             self.config.max_concurrent_train
         )
@@ -731,8 +761,10 @@ class KMeansServer:
         """``(ready, detail)`` for ``GET /readyz``: ready iff a model is
         servable (or no registry is configured — a board-only server is
         ready the moment it binds) AND the assign engine has not been
-        permanently stopped.  The supervisor and external load
-        balancers use this to tell "starting" from "serving"."""
+        permanently stopped AND no SLO burn window is in breach (when
+        ``config.slo`` is on — docs/OBSERVABILITY.md "Fleet
+        observability").  The supervisor and external load balancers
+        use this to tell "starting" from "serving"."""
         gen = self.current_model()
         model_ready = self.model_registry is None or gen is not None
         eng = self.assign_engine
@@ -743,7 +775,15 @@ class KMeansServer:
             "engine": ("direct" if eng is None
                        else "stopped" if eng.closed else "warm"),
         }
-        return model_ready and engine_ready, detail
+        slo_ready = True
+        mon = self.slo_monitor
+        if mon is not None:
+            slo_ready = mon.healthy()
+            detail["slo"] = {
+                "ok": slo_ready,
+                "breaches": [list(b) for b in mon.breaches()],
+            }
+        return model_ready and engine_ready and slo_ready, detail
 
     def assign_points(self, points):
         """Label ``points`` (n, d) float32 — the one entry both the
@@ -1152,14 +1192,23 @@ class KMeansServer:
 
             def _observe_request(self, method, path, t0):
                 route = _route_label(path)
+                status = getattr(self, "_obs_status", 0)
                 _HTTP_REQUESTS_TOTAL.labels(
-                    method=method, route=route,
-                    status=str(getattr(self, "_obs_status", 0)),
+                    method=method, route=route, status=str(status),
                 ).inc()
                 if route != "/api/events":
+                    elapsed = time.perf_counter() - t0
                     _HTTP_REQUEST_SECONDS.labels(
                         method=method, route=route,
-                    ).observe(time.perf_counter() - t0)
+                    ).observe(elapsed)
+                    # SLO recording skips the probe/introspection routes:
+                    # a breached /readyz answers 503 BY DESIGN, and
+                    # counting those against the availability SLO would
+                    # make every breach self-sustaining.  A 5xx here
+                    # covers both genuine errors and admission sheds.
+                    mon = server.slo_monitor
+                    if mon is not None and route not in _SLO_EXEMPT_ROUTES:
+                        mon.record(elapsed, error=status >= 500)
 
             def _request_trace_id(self):
                 """Adopt a well-formed incoming ``X-Trace-Id`` (the
@@ -1387,6 +1436,12 @@ class KMeansServer:
                     # and load in Perfetto (https://ui.perfetto.dev), or
                     # pipe into tools/trace_view.py for a text
                     # flamegraph (docs/OBSERVABILITY.md).
+                    # KNOWN LIMIT: this is THIS process's ring only.  In
+                    # a SO_REUSEPORT fleet the kernel routes this GET to
+                    # an arbitrary worker — use the supervisor obs
+                    # endpoint's /api/trace (the merged trace-dir spool
+                    # across all worker pids) or trace_view --fleet for
+                    # the whole-fleet view.
                     if not server.config.tracing:
                         return self._error("tracing disabled",
                                            HTTPStatus.NOT_FOUND)
@@ -1698,6 +1753,16 @@ class KMeansServer:
                 _TRACER_HOLDS[0] += 1
                 self._tracer_held = True
                 _tracing.TRACER.enable()
+        if self.config.tracing and self.config.trace_dir \
+                and self._span_spool is None:
+            # Fleet trace spool: completed spans also append to
+            # <trace_dir>/spans-<pid>.jsonl so the supervisor (or
+            # tools/trace_view.py --fleet) can merge one trace across
+            # worker processes (docs/OBSERVABILITY.md).
+            from kmeans_tpu.obs.fleetview import SpanSpool
+
+            self._span_spool = SpanSpool(self.config.trace_dir)
+            _tracing.TRACER.set_sink(self._span_spool)
         if background:
             t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
             t.start()
@@ -1714,6 +1779,10 @@ class KMeansServer:
             # AFTER the HTTP teardown: handler threads still waiting on
             # a batch get their 503 from the drain instead of hanging.
             self.assign_engine.stop()
+        if self._span_spool is not None:
+            _tracing.TRACER.set_sink(None)
+            self._span_spool.close()
+            self._span_spool = None
         if self._tracer_held:        # idempotent: one release per server
             self._tracer_held = False
             with _TRACER_HOLDS_LOCK:
@@ -1732,7 +1801,11 @@ def serve(host: str = "127.0.0.1", port: int = 8787, *,
           assign_max_delay_s: Optional[float] = None,
           assign_max_batch_rows: Optional[int] = None,
           assign_max_points: Optional[int] = None,
-          assign_quant: Optional[str] = None) -> KMeansServer:
+          assign_quant: Optional[str] = None,
+          trace_dir: Optional[str] = None,
+          slo: Optional[bool] = None,
+          slo_latency_target_s: Optional[float] = None,
+          slo_min_samples: Optional[int] = None) -> KMeansServer:
     # None = the ServeConfig default (one source of truth for knob
     # defaults; the CLI passes through only what the user set).
     extra = {k: v for k, v in (
@@ -1741,6 +1814,10 @@ def serve(host: str = "127.0.0.1", port: int = 8787, *,
         ("assign_max_batch_rows", assign_max_batch_rows),
         ("assign_max_points", assign_max_points),
         ("assign_quant", assign_quant),
+        ("trace_dir", trace_dir),
+        ("slo", slo),
+        ("slo_latency_target_s", slo_latency_target_s),
+        ("slo_min_samples", slo_min_samples),
     ) if v is not None}
     s = KMeansServer(ServeConfig(host=host, port=port,
                                  persist_dir=persist_dir,

@@ -1,0 +1,424 @@
+"""Tests for kmeans_tpu.obs.fleetview — the fleet observability plane
+(ISSUE 20): exposition aggregation semantics (counter/histogram rollups,
+per-worker re-labeling, gauge exclusion), the cross-process span spool
+and merged Chrome trace, supervisor scrape resilience against dead and
+garbage lanes, and the in-suite 2-worker mini-drill that pins the
+acceptance invariant: the supervisor's rollup equals the arithmetic sum
+of the individual worker scrapes.
+"""
+
+import dataclasses
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.config import ServeConfig
+from kmeans_tpu.continuous.registry import ModelRegistry
+from kmeans_tpu.obs import fleetview as fv
+from kmeans_tpu.obs import tracing as tracing_mod
+from kmeans_tpu.obs.fleetview import (FleetObsServer, SpanSpool,
+                                      aggregate_expositions,
+                                      aggregate_families, merge_spool,
+                                      read_spool_events, spool_path)
+from kmeans_tpu.obs.registry import (ParsedFamily, ParsedSample,
+                                     parse_exposition)
+from kmeans_tpu.serve import fleet as F
+from tools import trace_view
+
+
+def _fam(name, kind, samples, help_=""):
+    f = ParsedFamily(name, kind, help_)
+    f.samples.extend(samples)
+    return f
+
+
+def _s(name, labels, value):
+    return ParsedSample(name, tuple(labels), float(value))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# Aggregation semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_rollup_is_arithmetic_sum_plus_per_lane():
+    lanes = {
+        "1": {"kmeans_tpu_x_total": _fam("kmeans_tpu_x_total", "counter", [
+            _s("kmeans_tpu_x_total", [("route", "/a")], 3.0)])},
+        "0": {"kmeans_tpu_x_total": _fam("kmeans_tpu_x_total", "counter", [
+            _s("kmeans_tpu_x_total", [("route", "/a")], 2.0),
+            _s("kmeans_tpu_x_total", [("route", "/b")], 7.0)])},
+    }
+    out = aggregate_families(lanes)
+    fam = out["kmeans_tpu_x_total"]
+    rollup = {s.labels: s.value for s in fam.samples
+              if "worker" not in s.label_dict()}
+    assert rollup == {(("route", "/a"),): 5.0, (("route", "/b"),): 7.0}
+    per_lane = {(s.label_dict()["worker"], s.label_dict()["route"]):
+                s.value for s in fam.samples
+                if "worker" in s.label_dict()}
+    assert per_lane == {("0", "/a"): 2.0, ("0", "/b"): 7.0,
+                        ("1", "/a"): 3.0}
+    # Numeric lane order: lane "0"'s samples precede lane "1"'s.
+    workers = [s.label_dict()["worker"] for s in fam.samples
+               if "worker" in s.label_dict()]
+    assert workers == sorted(workers, key=int)
+
+
+def test_histogram_buckets_merge_bucketwise():
+    def lane(count_01, count_inf, total, n):
+        return {"kmeans_tpu_h_seconds": _fam(
+            "kmeans_tpu_h_seconds", "histogram", [
+                _s("kmeans_tpu_h_seconds_bucket", [("le", "0.1")], count_01),
+                _s("kmeans_tpu_h_seconds_bucket", [("le", "+Inf")], count_inf),
+                _s("kmeans_tpu_h_seconds_sum", [], total),
+                _s("kmeans_tpu_h_seconds_count", [], n)])}
+    out = aggregate_families({"0": lane(1, 4, 2.5, 4),
+                              "1": lane(2, 6, 3.5, 6)})
+    fam = out["kmeans_tpu_h_seconds"]
+    rollup = [s for s in fam.samples if "worker" not in s.label_dict()]
+    # Bucket order preserved from the first emitting lane.
+    assert [(s.name, s.labels, s.value) for s in rollup] == [
+        ("kmeans_tpu_h_seconds_bucket", (("le", "0.1"),), 3.0),
+        ("kmeans_tpu_h_seconds_bucket", (("le", "+Inf"),), 10.0),
+        ("kmeans_tpu_h_seconds_sum", (), 6.0),
+        ("kmeans_tpu_h_seconds_count", (), 10.0),
+    ]
+
+
+def test_gauges_are_per_lane_only():
+    lanes = {
+        "0": {"kmeans_tpu_gen": _fam("kmeans_tpu_gen", "gauge", [
+            _s("kmeans_tpu_gen", [], 3.0)])},
+        "1": {"kmeans_tpu_gen": _fam("kmeans_tpu_gen", "gauge", [
+            _s("kmeans_tpu_gen", [], 3.0)])},
+    }
+    fam = aggregate_families(lanes)["kmeans_tpu_gen"]
+    # No unlabeled rollup: generation 3 + generation 3 is not 6.
+    assert all("worker" in s.label_dict() for s in fam.samples)
+    assert sorted((s.label_dict()["worker"], s.value)
+                  for s in fam.samples) == [("0", 3.0), ("1", 3.0)]
+
+
+def test_preexisting_worker_label_renamed_exported_worker():
+    # The supervisor's own scrape_errors counter carries worker=<lane>;
+    # re-labeling must keep it (as exported_worker) rather than clobber
+    # two samples onto one key — and the sup lane contributes NO rollup
+    # samples (its registry is the supervisor process's telemetry, not
+    # part of the fleet sum).
+    lanes = {"sup": {"kmeans_tpu_fleet_scrape_errors_total": _fam(
+        "kmeans_tpu_fleet_scrape_errors_total", "counter", [
+            _s("kmeans_tpu_fleet_scrape_errors_total",
+               [("worker", "0")], 1.0),
+            _s("kmeans_tpu_fleet_scrape_errors_total",
+               [("worker", "1")], 2.0)])}}
+    fam = aggregate_families(lanes)["kmeans_tpu_fleet_scrape_errors_total"]
+    assert all("exported_worker" in s.label_dict() for s in fam.samples)
+    relabeled = {(s.label_dict()["exported_worker"],
+                  s.label_dict()["worker"]): s.value
+                 for s in fam.samples}
+    assert relabeled == {("0", "sup"): 1.0, ("1", "sup"): 2.0}
+
+
+def test_sup_lane_excluded_from_rollup():
+    # A same-named counter in the supervisor's own registry must not
+    # inflate the fleet rollup: rollup == sum of WORKER lanes only.
+    fam_def = lambda v: {"kmeans_tpu_x_total": _fam(
+        "kmeans_tpu_x_total", "counter",
+        [_s("kmeans_tpu_x_total", [("route", "/a")], v)])}
+    out = aggregate_families({"0": fam_def(2.0), "1": fam_def(3.0),
+                              "sup": fam_def(100.0)})
+    fam = out["kmeans_tpu_x_total"]
+    rollup = [s for s in fam.samples if "worker" not in s.label_dict()]
+    assert [(s.labels, s.value) for s in rollup] == [
+        ((("route", "/a"),), 5.0)]
+    # The sup lane's sample still appears, per-lane.
+    assert {(s.label_dict()["worker"]): s.value for s in fam.samples
+            if "worker" in s.label_dict()} == {
+        "0": 2.0, "1": 3.0, "sup": 100.0}
+
+
+def test_aggregate_expositions_drops_unparseable_lane():
+    good = ("# TYPE kmeans_tpu_ok_total counter\n"
+            "kmeans_tpu_ok_total 4\n")
+    families, bad = aggregate_expositions({"0": good, "1": "{{{ nope\n"})
+    assert bad == ["1"]
+    fam = families["kmeans_tpu_ok_total"]
+    assert {s.labels: s.value for s in fam.samples} == {
+        (): 4.0, (("worker", "0"),): 4.0}
+
+
+# ---------------------------------------------------------------------------
+# Trace spool + merge
+# ---------------------------------------------------------------------------
+
+def test_span_spool_roundtrip_and_merge(tmp_path):
+    tracer = tracing_mod.Tracer(enabled=True)
+    spool = SpanSpool(str(tmp_path), flush_events=1)
+    tracer.set_sink(spool)
+    with tracer.span("req", category="http", trace_id="ab12cd34",
+                     rows=2):
+        with tracer.span("inner", category="serve_kernel"):
+            pass
+    spool.close()
+    import os
+    by_pid = read_spool_events(str(tmp_path))
+    assert list(by_pid) == [os.getpid()]
+    events = by_pid[os.getpid()]
+    assert {e["name"] for e in events} == {"req", "inner"}
+    req = next(e for e in events if e["name"] == "req")
+    assert req["ph"] == "X" and req["cat"] == "http"
+    assert req["args"]["trace_id"] == "ab12cd34"
+    doc = merge_spool(str(tmp_path), {os.getpid(): "worker 0"})
+    json.dumps(doc, allow_nan=False)     # strict-JSON by construction
+    procs = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert procs[0]["args"]["name"] == "worker 0"
+    assert len([e for e in doc["traceEvents"]
+                if e.get("ph") == "X"]) == 2
+
+
+def test_read_spool_tolerates_torn_tail_only(tmp_path):
+    path = spool_path(str(tmp_path), pid=123)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"name": "ok", "ph": "X", "ts": 1.0}) + "\n")
+        f.write('{"name": "torn-mid-append')        # crash tore the tail
+    assert read_spool_events(str(tmp_path)) == {
+        123: [{"name": "ok", "ph": "X", "ts": 1.0}]}
+    # A malformed line anywhere BUT the tail is corruption, not a tear.
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"oops\n')
+        f.write(json.dumps({"name": "ok", "ph": "X", "ts": 1.0}) + "\n")
+    with pytest.raises(ValueError):
+        read_spool_events(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Scrape resilience (satellite: dead / truncated lanes)
+# ---------------------------------------------------------------------------
+
+class _FixedHandler(BaseHTTPRequestHandler):
+    body = b""
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(self.body)))
+        self.end_headers()
+        self.wfile.write(self.body)
+
+
+def _fixed_server(body: bytes):
+    handler = type("H", (_FixedHandler,), {"body": body})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_scrape_fleet_partial_aggregate_and_error_counters():
+    good = _fixed_server(b"# TYPE kmeans_tpu_ok_total counter\n"
+                         b"kmeans_tpu_ok_total 5\n")
+    garbage = _fixed_server(b"}{ definitely not an exposition\n")
+    dead_port = _free_port()
+    errs = fv._FLEET_SCRAPE_ERRORS_TOTAL
+    base = {lane: errs.value(worker=lane) for lane in ("0", "1", "2")}
+    obs = FleetObsServer(
+        targets_fn=lambda: [("0", good.server_address[1]),
+                            ("1", garbage.server_address[1]),
+                            ("2", dead_port)],
+        scrape_timeout_s=2.0)
+    try:
+        text = obs.scrape_fleet()
+    finally:
+        obs._httpd.server_close()
+        good.shutdown()
+        garbage.shutdown()
+    families = parse_exposition(text)
+    # The good lane survives: rollup AND per-worker series.
+    fam = families["kmeans_tpu_ok_total"]
+    assert {s.labels: s.value for s in fam.samples} == {
+        (): 5.0, (("worker", "0"),): 5.0}
+    # Both bad lanes bumped the error counter: the dead lane at scrape
+    # time, the garbage lane at parse time.
+    assert errs.value(worker="1") == base["1"] + 1
+    assert errs.value(worker="2") == base["2"] + 1
+    assert errs.value(worker="0") == base["0"]
+    # The re-aggregated sup lane already reflects this pass's bumps
+    # (no rollup: the counter lives only in the sup lane, which rides
+    # along per-lane with its worker label kept as exported_worker).
+    efam = families["kmeans_tpu_fleet_scrape_errors_total"]
+    sup_copies = {s.label_dict()["exported_worker"]: s.value
+                  for s in efam.samples
+                  if s.label_dict().get("worker") == "sup"}
+    assert sup_copies["1"] >= 1.0 and sup_copies["2"] >= 1.0
+    assert not any("worker" not in s.label_dict()
+                   for s in efam.samples)
+
+
+def test_fleet_obs_readiness_gates_on_slo():
+    from kmeans_tpu.obs.slo import SLOMonitor
+    now = [500.0]
+    mon = SLOMonitor(latency_target_s=0.01, windows_s=(10.0,),
+                     burn_thresholds=(1.0,), min_samples=5, eval_s=0.0,
+                     clock=lambda: now[0])
+    obs = FleetObsServer(targets_fn=lambda: [], slo=mon,
+                         ready_fn=lambda: (True, {"role": "supervisor"}))
+    try:
+        ready, detail = obs.readiness()
+        assert ready and detail["ready"]
+        for _ in range(10):
+            mon.record(1.0)
+        ready, detail = obs.readiness()
+        assert not ready
+        assert ["10s", "latency"] in detail["slo"]["breaches"]
+        now[0] += 11.0                       # window drains
+        ready, _ = obs.readiness()
+        assert ready
+    finally:
+        obs._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Attribution (tools/trace_view.py) on synthetic events
+# ---------------------------------------------------------------------------
+
+def test_attribution_splits_phases_per_pid():
+    def ev(pid, cat, dur, **args):
+        return {"ph": "X", "pid": pid, "tid": 1, "ts": 0.0, "dur": dur,
+                "name": cat, "cat": cat, "args": args}
+    events = [
+        ev(1, "http", 1000.0, trace_id="ab12"),
+        ev(1, "serve_queue", 100.0),
+        ev(1, "serve_transfer", 50.0),
+        ev(1, "serve_kernel", 400.0),
+        ev(1, "serve_quant", 150.0),        # nested in the kernel span
+        ev(2, "http", 500.0, trace_id="ab12"),
+        ev(2, "serve_kernel", 200.0),
+    ]
+    rows = trace_view.attribution(events)
+    assert rows[1]["requests"] == 1
+    assert rows[1]["request_us"] == pytest.approx(1000.0)
+    assert rows[1]["queue_us"] == pytest.approx(100.0)
+    assert rows[1]["transfer_us"] == pytest.approx(50.0)
+    assert rows[1]["rescore_us"] == pytest.approx(150.0)
+    # Kernel time excludes the nested rescore slice.
+    assert rows[1]["kernel_us"] == pytest.approx(250.0)
+    assert rows[2]["kernel_us"] == pytest.approx(200.0)
+    assert rows[2]["rescore_us"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# The in-suite mini-drill: 2 workers, real supervisor pane
+# ---------------------------------------------------------------------------
+
+_DRILL_TRACE_ID = "fade0000fade0000"
+
+
+def _assign_traced(base, rows, timeout=5.0):
+    req = urllib.request.Request(
+        base + "/api/assign",
+        data=json.dumps({"points": rows}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Trace-Id": _DRILL_TRACE_ID}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _scrape(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def test_fleet_obs_mini_drill(tmp_path):
+    """The tier-1 fleet-observability representative: a real 2-worker
+    fleet under the supervisor pane.  Pins the acceptance invariant —
+    the supervisor's `/metrics` rollup equals the arithmetic sum of the
+    individual worker scrapes — plus per-worker series presence and a
+    merged trace holding one X-Trace-Id across >= 2 worker pids."""
+    tmp = str(tmp_path / "model")
+    trace_dir = str(tmp_path / "spool")
+    reg = ModelRegistry(path=tmp)
+    reg.publish(np.arange(12, dtype=np.float32).reshape(4, 3) * 10.0,
+                trigger="initial")
+    port = _free_port()
+    cfg = dataclasses.replace(
+        ServeConfig(host="127.0.0.1", port=port, model_dir=tmp,
+                    assign_batching=False, metrics=True, tracing=True,
+                    trace_dir=trace_dir, fleet_heartbeat_s=0.1,
+                    fleet_heartbeat_timeout_s=1.0,
+                    fleet_backoff_base_s=0.05, fleet_reload_poll_s=0.05))
+    sup = F.FleetSupervisor(cfg, workers=2)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        sup.start()
+        assert sup.wait_ready(timeout=30.0), sup.events
+        assert sup.obs_port is not None
+        targets = sup._obs_targets()
+        assert len(targets) == 2 and all(p for _, p in targets)
+        # urllib opens a fresh connection per request, so SO_REUSEPORT
+        # spreads these across both workers (all-on-one is p ~= 2^-39).
+        for _ in range(40):
+            st, out = _assign_traced(base, [[0.0, 0.0, 0.0]])
+            assert st == 200 and out["generation"] == 1
+
+        # Individual worker scrapes first; traffic is quiesced, so the
+        # supervisor pass that follows sees identical counters.
+        per_worker = {}
+        for lane, obs_port in targets:
+            st, text = _scrape(f"http://127.0.0.1:{obs_port}/metrics")
+            assert st == 200
+            per_worker[lane] = parse_exposition(text)
+        st, text = _scrape(f"http://127.0.0.1:{sup.obs_port}/metrics")
+        assert st == 200
+        fleet = parse_exposition(text)
+
+        fam = fleet["kmeans_tpu_http_requests_total"]
+        lanes_seen = {s.label_dict().get("worker") for s in fam.samples
+                      if "worker" in s.label_dict()}
+        assert {"0", "1"} <= lanes_seen
+        # THE acceptance pin: every rollup sample equals the arithmetic
+        # sum of the same (name, labels) key across the worker scrapes.
+        rollups = [s for s in fam.samples
+                   if "worker" not in s.label_dict()]
+        assert rollups
+        for s in rollups:
+            expected = sum(
+                w.value
+                for lane in per_worker
+                for w in per_worker[lane].get(
+                    "kmeans_tpu_http_requests_total",
+                    ParsedFamily("", "counter", "")).samples
+                if w.name == s.name and w.labels == s.labels)
+            assert s.value == expected, (s.name, s.labels)
+        assign = [s for s in rollups
+                  if s.label_dict().get("route") == "/api/assign"
+                  and s.label_dict().get("status") == "200"]
+        assert sum(s.value for s in assign) == 40.0
+        # Supervisor probes answer on the obs port.
+        st, _ = _scrape(f"http://127.0.0.1:{sup.obs_port}/readyz")
+        assert st == 200
+        clean = sup.stop(graceful=True)        # drain flushes the spools
+        assert clean, sup.events
+    finally:
+        sup.stop(graceful=False)
+
+    doc = merge_spool(trace_dir)
+    json.dumps(doc, allow_nan=False)
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    req_spans = [e for e in spans if e.get("cat") == "http"
+                 and e.get("args", {}).get("trace_id") == _DRILL_TRACE_ID]
+    assert len(req_spans) == 40
+    assert len({e["pid"] for e in req_spans}) >= 2

@@ -181,6 +181,60 @@ def test_exposition_is_valid_and_escapes_labels():
     validate_prometheus_text(obs.REGISTRY.expose())
 
 
+def test_parse_exposition_round_trips():
+    """The fleet aggregator's parser (ISSUE 20): parse_exposition must
+    reproduce every sample the registry rendered — including escaped
+    label values and +Inf histogram buckets — and render_exposition must
+    round-trip back to an identical parse."""
+    from kmeans_tpu.obs.registry import parse_exposition, render_exposition
+
+    reg = MetricsRegistry()
+    c = reg.counter("kmeans_tpu_rt_total", "requests", labels=("path",))
+    nasty = 'a"b\\c\nd'
+    c.labels(path=nasty).inc(3)
+    g = reg.gauge("kmeans_tpu_rt_depth", "queue depth")
+    g.set(-2.5)
+    h = reg.histogram("kmeans_tpu_rt_seconds", "latency",
+                      buckets=(0.5, 2.0))
+    h.observe(1.0)
+    h.observe(100.0)
+    text = reg.expose()
+
+    families = parse_exposition(text)
+    assert families["kmeans_tpu_rt_total"].kind == "counter"
+    assert families["kmeans_tpu_rt_total"].help == "requests"
+    (s,) = families["kmeans_tpu_rt_total"].samples
+    assert s.label_dict() == {"path": nasty}      # unescaped back
+    assert s.value == 3.0
+    (gs,) = families["kmeans_tpu_rt_depth"].samples
+    assert gs.value == -2.5
+    hist = families["kmeans_tpu_rt_seconds"]
+    assert hist.kind == "histogram"
+    buckets = {s.label_dict()["le"]: s.value for s in hist.samples
+               if s.name == "kmeans_tpu_rt_seconds_bucket"}
+    assert buckets == {"0.5": 0.0, "2": 1.0, "+Inf": 2.0}
+    by_name = {s.name: s.value for s in hist.samples
+               if not s.labels}
+    assert by_name["kmeans_tpu_rt_seconds_count"] == 2.0
+    assert by_name["kmeans_tpu_rt_seconds_sum"] == 101.0
+
+    # render(parse(text)) parses back to the identical structure
+    # (ParsedFamily/ParsedSample are dataclasses: deep equality).
+    assert parse_exposition(render_exposition(families.values())) \
+        == families
+    # The global registry — every real wired family — round-trips too.
+    real = parse_exposition(obs.REGISTRY.expose())
+    assert parse_exposition(render_exposition(real.values())) == real
+
+
+def test_parse_exposition_rejects_garbage():
+    from kmeans_tpu.obs.registry import parse_exposition
+    with pytest.raises(ValueError):
+        parse_exposition("}{ not an exposition\n")
+    with pytest.raises(ValueError):
+        parse_exposition('kmeans_tpu_x_total{unclosed="v 1\n')
+
+
 def test_concurrent_increments_are_lossless():
     reg = MetricsRegistry()
     c = reg.counter("kmeans_tpu_cc_total", "c", labels=("t",))

@@ -1,0 +1,197 @@
+"""Tests for kmeans_tpu.obs.slo — the rolling-window burn-rate SLO
+monitor (ISSUE 20) and its readiness gate in the serve layer.
+
+Every monitor here runs on an injected clock so breach / recovery
+transitions are deterministic: advance the list-backed clock, never
+sleep.
+"""
+
+import pytest
+
+from kmeans_tpu.config import ServeConfig
+from kmeans_tpu.obs import slo as slo_mod
+from kmeans_tpu.obs.slo import SLOMonitor, window_label
+from kmeans_tpu.serve.server import KMeansServer
+
+
+def _clocked(**kw):
+    """(monitor, now) with an injectable mutable clock; eval_s=0 so
+    every healthy()/snapshot() call re-evaluates."""
+    now = [1000.0]
+    kw.setdefault("eval_s", 0.0)
+    mon = SLOMonitor(clock=lambda: now[0], **kw)
+    return mon, now
+
+
+# --------------------------------------------------------------- labels
+def test_window_label_closed_set():
+    assert window_label(10.0) == "10s"
+    assert window_label(60.0) == "1m"
+    assert window_label(300.0) == "5m"
+    assert window_label(2.0) == "2s"
+    assert window_label(1.5) == "1.5s"
+
+
+# ----------------------------------------------------- ctor validation
+def test_ctor_rejects_mismatched_thresholds():
+    with pytest.raises(ValueError, match="one-to-one"):
+        SLOMonitor(windows_s=(10.0, 60.0), burn_thresholds=(1.0,))
+
+
+@pytest.mark.parametrize("kw", [
+    {"latency_objective": 0.0},
+    {"latency_objective": 1.0},
+    {"availability_objective": 1.5},
+])
+def test_ctor_rejects_degenerate_objectives(kw):
+    with pytest.raises(ValueError):
+        SLOMonitor(**kw)
+
+
+# ------------------------------------------------------------ burn math
+def test_burn_rate_is_bad_fraction_over_budget():
+    # objective 0.9 -> budget 0.1; 2 bad of 10 -> burn 2.0.
+    mon, now = _clocked(latency_target_s=0.1, latency_objective=0.9,
+                        windows_s=(10.0,), burn_thresholds=(100.0,),
+                        min_samples=1)
+    for i in range(10):
+        mon.record(0.5 if i < 2 else 0.01)
+    snap = mon.snapshot(force=True)
+    assert snap["10s"]["burn"]["latency"] == pytest.approx(2.0)
+    assert snap["10s"]["n"] == 10
+    assert mon.healthy()          # threshold 100 never reached
+
+
+def test_min_samples_floor_blocks_breach():
+    mon, now = _clocked(latency_target_s=0.01, windows_s=(10.0,),
+                        burn_thresholds=(1.0,), min_samples=50)
+    for _ in range(49):           # every request bad, but n < floor
+        mon.record(1.0)
+    assert mon.healthy()
+    assert mon.breaches() == []
+    mon.record(1.0)               # n reaches the floor -> breach
+    assert not mon.healthy()
+    assert mon.breaches() == [("10s", "latency")]
+
+
+def test_availability_slo_counts_errors_and_sheds():
+    mon, now = _clocked(availability_objective=0.5, windows_s=(10.0,),
+                        burn_thresholds=(1.0,), min_samples=4,
+                        latency_target_s=10.0)
+    mon.record(0.01, error=True)
+    mon.record(0.01, shed=True)
+    mon.record(0.01)
+    mon.record(0.01)
+    assert not mon.healthy()      # 2/4 bad / 0.5 budget = burn 1.0
+    assert ("10s", "availability") in mon.breaches()
+    assert ("10s", "latency") not in mon.breaches()
+
+
+# ----------------------------------------------- transitions & recovery
+def test_breach_counter_increments_once_per_transition():
+    mon, now = _clocked(latency_target_s=0.01, windows_s=(10.0,),
+                        burn_thresholds=(1.0,), min_samples=5)
+    ctr = slo_mod._SLO_BREACH_TOTAL
+    base = ctr.value(window="10s", slo="latency")
+    for _ in range(10):
+        mon.record(1.0)
+    assert not mon.healthy()
+    # Re-evaluating while still in breach must not re-count.
+    now[0] += 1.0
+    assert not mon.healthy()
+    now[0] += 1.0
+    mon.snapshot(force=True)
+    assert ctr.value(window="10s", slo="latency") == base + 1
+
+
+def test_recovery_when_window_drains():
+    mon, now = _clocked(latency_target_s=0.01, windows_s=(10.0,),
+                        burn_thresholds=(1.0,), min_samples=5)
+    for _ in range(10):
+        mon.record(1.0)
+    assert not mon.healthy()
+    # Age every event out of the window: sample floor no longer met.
+    now[0] += 11.0
+    assert mon.healthy()
+    assert mon.breaches() == []
+    snap = mon.snapshot(force=True)
+    assert snap["10s"]["n"] == 0
+    # A fresh burst re-breaches (transition counted again).
+    ctr = slo_mod._SLO_BREACH_TOTAL
+    base = ctr.value(window="10s", slo="latency")
+    for _ in range(10):
+        mon.record(1.0)
+    assert not mon.healthy()
+    assert ctr.value(window="10s", slo="latency") == base + 1
+
+
+def test_eval_rate_limit_caches_verdict():
+    mon, now = _clocked(latency_target_s=0.01, windows_s=(10.0,),
+                        burn_thresholds=(1.0,), min_samples=5,
+                        eval_s=5.0)
+    assert mon.healthy()          # first call evaluates (empty -> ok)
+    for _ in range(10):
+        mon.record(1.0)
+    # Within eval_s the cached verdict stands despite the bad burst.
+    now[0] += 1.0
+    assert mon.healthy()
+    now[0] += 5.0                 # past eval_s -> re-evaluates
+    assert not mon.healthy()
+
+
+def test_multi_window_short_needs_higher_burn():
+    # Short window threshold 14.4, long window 1.0 (the default shape):
+    # a burn of 10 breaches only the long window.
+    mon, now = _clocked(latency_target_s=0.01, latency_objective=0.99,
+                        windows_s=(10.0, 60.0),
+                        burn_thresholds=(14.4, 1.0), min_samples=10)
+    for i in range(100):          # 10% bad -> burn 10.0
+        mon.record(1.0 if i % 10 == 0 else 0.001)
+    assert not mon.healthy()
+    assert mon.breaches() == [("1m", "latency")]
+    snap = mon.snapshot(force=True)
+    assert snap["10s"]["breach"]["latency"] is False
+    assert snap["1m"]["breach"]["latency"] is True
+
+
+def test_snapshot_reports_percentiles():
+    mon, now = _clocked(windows_s=(60.0,), burn_thresholds=(100.0,),
+                        min_samples=1, latency_target_s=10.0)
+    for ms in (1, 2, 3, 4, 100):
+        mon.record(ms / 1e3)
+    snap = mon.snapshot(force=True)
+    row = snap["1m"]
+    assert row["n"] == 5
+    assert row["p99_ms"] == pytest.approx(100.0)
+    assert row["p50_ms"] == pytest.approx(3.0)
+    assert row["error_rate"] == 0.0
+
+
+# ------------------------------------------------- serve readiness gate
+def test_server_readiness_gated_on_slo(tmp_path):
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0, slo=True,
+                                 tracing=False))
+    assert s.slo_monitor is not None        # config.slo built one
+    # Swap in a deterministic monitor so the gate flips on our clock.
+    mon, now = _clocked(latency_target_s=0.01, windows_s=(10.0,),
+                        burn_thresholds=(1.0,), min_samples=5)
+    s.slo_monitor = mon
+    ready, detail = s.readiness()
+    assert ready and detail["slo"]["ok"]
+    for _ in range(10):
+        mon.record(1.0)
+    ready, detail = s.readiness()
+    assert not ready
+    assert detail["slo"]["ok"] is False
+    assert ["10s", "latency"] in detail["slo"]["breaches"]
+    now[0] += 11.0                          # window drains -> recovers
+    ready, detail = s.readiness()
+    assert ready and detail["slo"]["ok"]
+
+
+def test_server_without_slo_has_no_monitor():
+    s = KMeansServer(ServeConfig(host="127.0.0.1", port=0,
+                                 tracing=False))
+    assert s.slo_monitor is None
+    ready, detail = s.readiness()
+    assert "slo" not in detail

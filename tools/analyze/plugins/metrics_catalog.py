@@ -36,6 +36,8 @@ RULES = [
 MODULES = [
     "kmeans_tpu.obs",
     "kmeans_tpu.obs.costmodel",
+    "kmeans_tpu.obs.slo",
+    "kmeans_tpu.obs.fleetview",
     "kmeans_tpu.utils.retry",
     "kmeans_tpu.utils.checkpoint",
     "kmeans_tpu.utils.faults",

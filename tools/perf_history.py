@@ -223,6 +223,12 @@ def _ingest_serve(root: str) -> List[Entry]:
     # And for artifacts predating the compressed-codebook phase
     # (ISSUE 17): the quant tier's throughput and tail latency.
     quant = (rec.get("quant") or {}).get("quant_int8") or {}
+    # And the SLO burn-rate drill (ISSUE 20): breach_total counts
+    # transitions INTO breach during the drill (>=1 proves the monitor
+    # fires).  The ledger tracks the POST-RECOVERY steady-state p99,
+    # not the breach-time gauge — the latter is measured under
+    # deliberate overload and wobbles 10x run to run.
+    slo = fleet.get("slo") or {}
     return [
         Entry("serve.batched_qps", batched.get("qps"),
               unit="req/s", direction="up", **common),
@@ -241,6 +247,10 @@ def _ingest_serve(root: str) -> List[Entry]:
         Entry("serve.quant_qps", quant.get("qps"),
               unit="req/s", direction="up", **common),
         Entry("serve.quant_p99_ms", quant.get("p99_ms"),
+              unit="ms", direction="down", **common),
+        Entry("serve.slo_breach_total", slo.get("breach_total"),
+              unit="count", direction="up", **common),
+        Entry("serve.slo_p99_ms", slo.get("steady_p99_ms"),
               unit="ms", direction="down", **common),
     ]
 

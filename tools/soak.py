@@ -511,6 +511,28 @@ def phase_fleet(workdir: str) -> dict:
         row["worker_generations"] = sorted(gens.values())
         row["gen_consistent"] = all(g == reg.generation
                                     for g in gens.values())
+        # Supervisor observability pane mid-drill (ISSUE 20): one
+        # aggregated scrape, whose fleet rollup must already carry the
+        # restart the kill just caused — the pane an operator's
+        # alerting would have seen the incident on.
+        try:
+            from kmeans_tpu.obs.registry import parse_exposition
+
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{sup.obs_port}/metrics",
+                    timeout=5) as r:
+                fams = parse_exposition(r.read().decode())
+            rst = fams.get("kmeans_tpu_fleet_restarts_total")
+            # Supervisor-process counter: it rides the pane as lane
+            # worker="sup" (the sup lane gets no rollup samples).
+            row["obs_restarts_total"] = sum(
+                s.value for s in (rst.samples if rst else ())
+                if s.label_dict().get("worker") == "sup")
+            row["obs_scrape_ok"] = (
+                row["obs_restarts_total"] >= 1)
+        except Exception as e:
+            row["obs_scrape_ok"] = False
+            row["obs_error"] = repr(e)
         time.sleep(0.5)               # post-recovery traffic window
         stop.set()
         for t in threads:
@@ -528,6 +550,7 @@ def phase_fleet(workdir: str) -> dict:
         row.get("kill_exit") == 137
         and row.get("rto_s", 1e9) <= FLEET_MAX_RTO_S
         and row.get("gen_consistent")
+        and row.get("obs_scrape_ok")
         and clean
         and stats["good"] > 0
         and stats["errors"] <= FLEET_MAX_ERRORS)

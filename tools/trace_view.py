@@ -12,21 +12,37 @@ collapse into one line with a count — a 200-iteration fit reads as four
 lines, not eight hundred.  A span whose parent was evicted from the
 tracer's ring buffer simply surfaces as a root; nothing dangles.
 
+Fleet mode (``--fleet``): ``path`` is a span-spool DIRECTORY (the
+``ServeConfig.trace_dir`` the workers spooled ``spans-<pid>.jsonl``
+files into — docs/OBSERVABILITY.md "Fleet observability").  The spools
+merge into one Chrome trace with a process lane per worker pid;
+``--out merged.json`` writes the strict-JSON document Perfetto loads,
+and ``--attribution`` prints the per-worker request wall-time split
+across the serving phases (queue wait / host->device transfer staging /
+kernel / quantized-prescore rescore).
+
 Usage:
     python tools/trace_view.py out.json               # flamegraph
     python tools/trace_view.py out.json --flat        # per-category totals
     python tools/trace_view.py out.json --min-us 500  # hide tiny spans
+    python tools/trace_view.py --fleet /tmp/spool --out merged.json
+    python tools/trace_view.py --fleet /tmp/spool --attribution
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
 __all__ = ["load_events", "build_forest", "aggregate", "render",
-           "render_flat"]
+           "render_flat", "attribution", "render_attribution"]
 
 
 def load_events(path: str) -> List[dict]:
@@ -155,28 +171,126 @@ def render_flat(events: List[dict], *, out=None) -> None:
               file=out)
 
 
+#: Attribution phases: category -> report column.  ``serve_quant``
+#: spans nest INSIDE ``serve_kernel`` spans, so the kernel column
+#: subtracts the rescore total — the four columns are disjoint slices
+#: of request wall-time (docs/OBSERVABILITY.md "Fleet observability").
+_ATTRIBUTION_PHASES = (
+    ("queue", "serve_queue"),
+    ("transfer", "serve_transfer"),
+    ("kernel", "serve_kernel"),
+    ("rescore", "serve_quant"),
+)
+
+
+def attribution(events: List[dict]) -> Dict[int, Dict[str, float]]:
+    """Per-pid request wall-time attribution over the serving phases.
+
+    Returns ``{pid: {"requests": n, "request_us": total, "queue_us":
+    ..., "transfer_us": ..., "kernel_us": ..., "rescore_us": ...}}``.
+    ``kernel_us`` excludes the nested quantized-rescore time so the
+    four phase columns do not double-count.
+    """
+    out: Dict[int, Dict[str, float]] = {}
+    for e in events:
+        pid = e.get("pid", 0)
+        row = out.setdefault(pid, {
+            "requests": 0, "request_us": 0.0,
+            **{f"{k}_us": 0.0 for k, _ in _ATTRIBUTION_PHASES}})
+        cat = str(e.get("cat", ""))
+        dur = float(e.get("dur", 0))
+        if cat == "http":
+            row["requests"] += 1
+            row["request_us"] += dur
+        for col, phase_cat in _ATTRIBUTION_PHASES:
+            if cat == phase_cat:
+                row[f"{col}_us"] += dur
+    for row in out.values():
+        row["kernel_us"] = max(0.0, row["kernel_us"] - row["rescore_us"])
+    return out
+
+
+def render_attribution(events: List[dict],
+                       lane_names: Optional[Dict[int, str]] = None, *,
+                       out=None) -> None:
+    out = out or sys.stdout
+    table = attribution(events)
+    cols = ["requests", "request"] + [c for c, _ in _ATTRIBUTION_PHASES]
+    names = {pid: (lane_names or {}).get(pid, f"pid {pid}")
+             for pid in table}
+    width = max([len(n) for n in names.values()] + [6])
+    print(f"{'worker'.ljust(width)}  " +
+          "  ".join(f"{c:>9}" for c in cols), file=out)
+    for pid in sorted(table):
+        row = table[pid]
+        cells = [f"{row['requests']:>9}"]
+        cells += [f"{_fmt_us(row[f'{c}_us']):>9}"
+                  for c in ["request"] + [c for c, _ in
+                                          _ATTRIBUTION_PHASES]]
+        print(f"{names[pid].ljust(width)}  " + "  ".join(cells),
+              file=out)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python tools/trace_view.py", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("path", help="Chrome trace-event JSON "
                                 "(fit --trace / bench --trace / "
-                                "GET /api/trace)")
+                                "GET /api/trace), or with --fleet the "
+                                "span-spool directory "
+                                "(ServeConfig.trace_dir)")
     p.add_argument("--min-us", type=float, default=0.0,
                    help="hide aggregated rows totalling under this many "
                         "microseconds")
     p.add_argument("--flat", action="store_true",
                    help="per-category totals instead of the flamegraph")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat PATH as a trace-spool directory of "
+                        "spans-<pid>.jsonl files and merge every "
+                        "worker's spool into one trace")
+    p.add_argument("--out", metavar="MERGED.json", default=None,
+                   help="with --fleet: write the merged strict-JSON "
+                        "Chrome trace here (loadable in Perfetto) "
+                        "instead of rendering text")
+    p.add_argument("--attribution", action="store_true",
+                   help="per-worker request wall-time split across the "
+                        "serving phases (queue / transfer / kernel / "
+                        "rescore) instead of the flamegraph")
     args = p.parse_args(argv)
+    if (args.out or args.attribution) and not args.fleet:
+        # --attribution also reads single traces, but --out is merge-only.
+        if args.out:
+            p.error("--out requires --fleet (single traces are already "
+                    "on disk)")
     try:
-        events = load_events(args.path)
+        if args.fleet:
+            from kmeans_tpu.obs.fleetview import merge_spool
+
+            doc = merge_spool(args.path)
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, allow_nan=False)
+                n = sum(1 for e in doc["traceEvents"]
+                        if e.get("ph") == "X")
+                pids = {e.get("pid") for e in doc["traceEvents"]
+                        if e.get("ph") == "X"}
+                print(f"wrote {args.out}: {n} spans across "
+                      f"{len(pids)} worker processes", file=sys.stderr)
+                return 0
+            events = [e for e in doc["traceEvents"]
+                      if e.get("ph") == "X"]
+        else:
+            events = load_events(args.path)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"error: cannot read {args.path!r}: {e}", file=sys.stderr)
         return 2
     if not events:
         print("(no spans in trace)", file=sys.stderr)
         return 0
-    if args.flat:
+    if args.attribution:
+        render_attribution(events)
+    elif args.flat:
         render_flat(events)
     else:
         render(build_forest(events), min_us=args.min_us)
